@@ -1,0 +1,20 @@
+"""Low-level op namespace.
+
+Analog of the reference's `paddle._C_ops` (python/paddle/_C_ops.py:20, a
+re-export of `core.eager.ops` — the generated Python-C functions). Here every
+registered kernel is exposed by name; attribute lookup goes straight to the
+op registry.
+"""
+from .ops.dispatch import OPS as _OPS
+from . import ops as _ops_pkg  # noqa: F401  (ensures kernels are registered)
+
+
+def __getattr__(name):
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise AttributeError(f"_C_ops has no op {name!r}") from None
+
+
+def __dir__():
+    return sorted(_OPS)

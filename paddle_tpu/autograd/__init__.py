@@ -1,0 +1,8 @@
+"""Autograd: the GradNode tape engine + functional APIs.
+
+Reference analog: `paddle/fluid/eager` (engine) + `python/paddle/autograd`.
+"""
+from ..ops.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .engine import GradNode, grad, run_backward  # noqa: F401
+from .backward_mode import backward  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

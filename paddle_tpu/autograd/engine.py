@@ -1,0 +1,263 @@
+"""Define-by-run autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(`paddle/fluid/eager/grad_node_info.h:197` GradNodeBase,
+`paddle/fluid/eager/backward.cc:105` RunBackward): every dispatched op that
+touches a differentiable input records ONE `GradNode` whose backward function
+is the `jax.vjp` pullback of the op's XLA-lowered kernel — per-op generated
+GradNode subclasses and TensorWrappers in the reference collapse into a
+closure holding XLA residuals on device. The traversal (reverse topological
+with in-degree counting, gradient accumulation per node output, leaf
+accumulation into ``Tensor.grad``, hooks) mirrors the reference engine.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+_node_counter = itertools.count()
+
+
+def _is_float0(g) -> bool:
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def _zeros_like_aval(aval):
+    shape, dtype = aval
+    if np.issubdtype(np.dtype(dtype), np.inexact):
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+class GradNode:
+    """One backward step: holds the vjp pullback of a dispatched op.
+
+    Reference analog: a generated ``<Op>GradNode`` (eager_gen.py:1149) plus its
+    TensorWrappers; here the pullback closure owns the saved activations.
+    """
+
+    __slots__ = (
+        "id",
+        "name",
+        "vjp_fn",
+        "out_avals",
+        "out_treedef",
+        "edges",
+        "out_grads",
+        "out_hooks",
+    )
+
+    def __init__(self, name, vjp_fn, out_avals, out_treedef, edges):
+        self.id = next(_node_counter)
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals  # [(shape, dtype)] per output leaf
+        self.out_treedef = out_treedef
+        self.edges = edges  # per tensor-input: ("node", node, idx) | ("leaf", tensor) | None
+        self.out_grads: List[Optional[Any]] = [None] * len(out_avals)
+        self.out_hooks: Dict[int, list] = {}
+
+    def accumulate(self, idx: int, grad):
+        if grad is None or _is_float0(grad):
+            return
+        cur = self.out_grads[idx]
+        self.out_grads[idx] = grad if cur is None else cur + grad
+
+    def free(self):
+        self.vjp_fn = None
+        self.out_grads = [None] * len(self.out_avals)
+
+    def __repr__(self):
+        return f"<GradNode {self.name}#{self.id} outs={len(self.out_avals)}>"
+
+
+def _leaf_accumulate(tensor, grad, capture):
+    if grad is None or _is_float0(grad):
+        return
+    for hook in tensor._backward_hooks:
+        res = hook(tensor._wrap_grad(grad))
+        if res is not None:
+            grad = res._data if hasattr(res, "_data") else res
+    if capture is not None:
+        if id(tensor) in capture["leaf"]:
+            slot = capture["leaf"][id(tensor)]
+            capture["got"][slot] = (
+                grad if capture["got"][slot] is None else capture["got"][slot] + grad
+            )
+        # paddle.grad must never write .grad of any tensor (only_inputs mode)
+        if capture.get("only_inputs", True):
+            return
+    if tensor.stop_gradient:
+        return
+    cur = tensor._grad
+    tensor._grad = grad if cur is None else cur + grad
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+    capture: Optional[dict] = None,
+):
+    """Run the reverse pass from ``tensors`` (reference: backward.cc:105).
+
+    ``capture`` (used by ``paddle.grad``) maps tensor identities to output
+    slots: {"leaf": {id->slot}, "node": {(node_id,out_idx)->slot},
+    "got": [...], "only_inputs": bool}.
+    """
+    import jax.numpy as jnp
+
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length must match tensors length")
+
+    # 1. Seed gradients.
+    roots: List[GradNode] = []
+    seeded = set()
+    for t, g in zip(tensors, grad_tensors):
+        garr = g._data if hasattr(g, "_data") else g
+        if garr is None:
+            if not np.issubdtype(np.dtype(t._data.dtype), np.inexact) or t._data.size != 1:
+                if t._data.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar outputs; "
+                        f"got shape {t.shape}"
+                    )
+            garr = jnp.ones(t._data.shape, t._data.dtype)
+        node = t._grad_node
+        if node is None:
+            _leaf_accumulate(t, garr, capture)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time after it "
+                    "was freed. Specify retain_graph=True on the first backward."
+                )
+            node.accumulate(t._out_index, garr)
+            if id(node) not in seeded:
+                seeded.add(id(node))
+                roots.append(node)
+
+    # 2. Discover reachable subgraph + in-degrees (reference: getInDegreeMap).
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    for n in roots:
+        indeg.setdefault(id(n), 0)
+        nodes[id(n)] = n
+    while stack:
+        n = stack.pop()
+        for e in n.edges:
+            if e is not None and e[0] == "node":
+                tgt = e[1]
+                indeg[id(tgt)] = indeg.get(id(tgt), 0) + 1
+                if id(tgt) not in nodes:
+                    nodes[id(tgt)] = tgt
+                    stack.append(tgt)
+
+    # 3. Process queue.
+    ready = [n for n in nodes.values() if indeg[id(n)] == 0]
+    processed = 0
+    while ready:
+        node = ready.pop()
+        processed += 1
+        # Output hooks (non-leaf tensor hooks).
+        for idx, hooks in node.out_hooks.items():
+            g = node.out_grads[idx]
+            if g is None:
+                g = _zeros_like_aval(node.out_avals[idx])
+            for hook in hooks:
+                res = hook(_wrap_bare(g))
+                if res is not None:
+                    g = res._data if hasattr(res, "_data") else res
+            node.out_grads[idx] = g
+        # Capture for paddle.grad on non-leaf tensors.
+        if capture is not None:
+            for idx in range(len(node.out_avals)):
+                key = (node.id, idx)
+                if key in capture["node"]:
+                    slot = capture["node"][key]
+                    g = node.out_grads[idx]
+                    if g is not None and not _is_float0(g):
+                        capture["got"][slot] = (
+                            g if capture["got"][slot] is None else capture["got"][slot] + g
+                        )
+        cotangents = [
+            g if g is not None else _zeros_like_aval(av)
+            for g, av in zip(node.out_grads, node.out_avals)
+        ]
+        cot_tree = jax.tree.unflatten(node.out_treedef, cotangents)
+        in_grads = node.vjp_fn(cot_tree)
+        if not retain_graph:
+            node.free()
+        else:
+            node.out_grads = [None] * len(node.out_avals)
+        for e, g in zip(node.edges, in_grads):
+            if e is None:
+                continue
+            kind = e[0]
+            if kind == "node":
+                _, tgt, idx = e
+                tgt.accumulate(idx, g)
+                indeg[id(tgt)] -= 1
+                if indeg[id(tgt)] == 0:
+                    ready.append(tgt)
+            else:
+                _leaf_accumulate(e[1], g, capture)
+    # Any nodes not processed had unreachable contributions pending; that is
+    # fine (they were not on a path from the seeds).
+    return processed
+
+
+def _wrap_bare(g):
+    from ..core.tensor import Tensor
+
+    return Tensor._from_data(g, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """``paddle.grad`` parity (reference: general_grad.h / api in eager)."""
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order via the tape) is not supported yet; "
+            "use paddle.incubate.autograd functional jacobian/hessian"
+        )
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    capture = {"leaf": {}, "node": {}, "got": [None] * len(inputs), "only_inputs": only_inputs}
+    for slot, t in enumerate(inputs):
+        if t._grad_node is not None:
+            capture["node"][(t._grad_node.id, t._out_index)] = slot
+        else:
+            capture["leaf"][id(t)] = slot
+    if retain_graph is None:
+        retain_graph = False
+    run_backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
+    results = []
+    for slot, t in enumerate(inputs):
+        g = capture["got"][slot]
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"The {slot}-th input has no gradient path to outputs; "
+                "set allow_unused=True to return None for it"
+            )
+        results.append(None if g is None else Tensor._from_data(g, stop_gradient=True))
+    return results

@@ -1,0 +1,111 @@
+"""PyLayer: user-defined forward/backward.
+
+Reference analog: `paddle/fluid/eager/pylayer/` + python/paddle/autograd/py_layer.py.
+The custom backward plugs into the tape as a GradNode whose "vjp" calls the
+user's static backward method.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..ops import dispatch
+from .engine import GradNode
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        # method, not property: paddle API is `ctx.saved_tensor()`
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)
+        ]
+        needs_grad = dispatch.is_grad_enabled() and any(
+            (not t.stop_gradient or t._grad_node is not None) for t in in_tensors
+        )
+        with dispatch.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outputs, Tensor)
+        out_list = [outputs] if single else list(outputs)
+        if needs_grad:
+            edges = []
+            diff_inputs = []
+            for t in in_tensors:
+                if not t.stop_gradient or t._grad_node is not None:
+                    if t._grad_node is not None:
+                        edges.append(("node", t._grad_node, t._out_index))
+                    else:
+                        edges.append(("leaf", t))
+                    diff_inputs.append(t)
+                else:
+                    edges.append(None)
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                cot_tensors = tuple(Tensor._from_data(c) for c in cots)
+                with dispatch.no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if isinstance(grads, Tensor) or grads is None:
+                    grads = (grads,)
+                garr = [None if g is None else (g._data if isinstance(g, Tensor) else g) for g in grads]
+                # align to ALL inputs (non-diff slots get None)
+                out = []
+                gi = 0
+                for t in in_tensors:
+                    if not t.stop_gradient or t._grad_node is not None:
+                        out.append(garr[gi] if gi < len(garr) else None)
+                        gi += 1
+                    else:
+                        out.append(None)
+                return out
+
+            out_leaves = [t._data for t in out_list]
+            _, out_treedef = jax.tree.flatten(tuple(out_leaves))
+            node = GradNode(
+                cls.__name__,
+                vjp_fn,
+                [(tuple(o.shape), o.dtype) for o in out_leaves],
+                out_treedef,
+                edges,
+            )
+            import numpy as np
+
+            for i, t in enumerate(out_list):
+                if np.issubdtype(np.dtype(t._data.dtype), np.inexact):
+                    t._grad_node = node
+                    t._out_index = i
+                    t.stop_gradient = False
+        return outputs
+
+
+# Alias matching paddle.autograd.PyLayer's legacy name
+LegacyPyLayer = PyLayer

@@ -1,0 +1,1 @@
+"""Core runtime: dtype/place/flags/enforce/rng/Tensor (SURVEY.md §2.1 analogs)."""

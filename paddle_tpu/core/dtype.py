@@ -1,0 +1,152 @@
+"""Data type system.
+
+TPU-native analog of the reference's phi DataType enum
+(`paddle/phi/common/data_type.h`) — here a thin wrapper over numpy/jax
+dtypes so that a ``DType`` compares equal to its string name, its numpy
+dtype, and itself, which is what user code written against the reference
+expects (``x.dtype == paddle.float32`` / ``x.dtype == 'float32'``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_CANONICAL = {
+    "bool": np.dtype(np.bool_),
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "complex64": np.dtype(np.complex64),
+    "complex128": np.dtype(np.complex128),
+}
+
+
+class DType:
+    """A framework dtype. Compares equal to name strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+    _registry: dict = {}
+
+    def __new__(cls, name):
+        if isinstance(name, DType):
+            return name
+        key = cls._canonical_name(name)
+        inst = cls._registry.get(key)
+        if inst is None:
+            inst = object.__new__(cls)
+            inst.name = key
+            inst.np_dtype = _np_for(key)
+            cls._registry[key] = inst
+        return inst
+
+    @staticmethod
+    def _canonical_name(name) -> str:
+        if isinstance(name, str):
+            n = name
+        else:
+            n = np.dtype(name).name  # handles np dtypes, python types
+        if n == "bfloat16":
+            return "bfloat16"
+        if n not in _CANONICAL and n not in ("bfloat16",):
+            # things like 'float' / 'int'
+            n = np.dtype(n).name
+        if n not in _CANONICAL and n != "bfloat16":
+            raise TypeError(f"Unsupported dtype: {name!r}")
+        return n
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == DType._canonical_name(other)
+            except TypeError:
+                return False
+        try:
+            return self.name == DType._canonical_name(other)
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        if eq is NotImplemented:
+            return eq
+        return not eq
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("uint8", "int8", "int16", "int32", "int64")
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+def _np_for(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return _CANONICAL[name]
+
+
+# Canonical instances --------------------------------------------------------
+bool_ = DType("bool")
+uint8 = DType("uint8")
+int8 = DType("int8")
+int16 = DType("int16")
+int32 = DType("int32")
+int64 = DType("int64")
+float16 = DType("float16")
+bfloat16 = DType("bfloat16")
+float32 = DType("float32")
+float64 = DType("float64")
+complex64 = DType("complex64")
+complex128 = DType("complex128")
+
+_DEFAULT_DTYPE = float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (reference: python/paddle/framework/framework.py)."""
+    global _DEFAULT_DTYPE
+    d = DType(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports [float16, bfloat16, float32, float64]"
+            f", but received {d}"
+        )
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE.name
+
+
+def to_np(d) -> np.dtype:
+    return DType(d).np_dtype
+
+
+def from_jax(jd) -> DType:
+    return DType(np.dtype(jd).name if np.dtype(jd).name != "bfloat16" else "bfloat16")

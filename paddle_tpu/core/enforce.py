@@ -1,0 +1,49 @@
+"""Error enforcement utilities.
+
+Analog of the reference's enforce macros (`paddle/phi/core/enforce.h`,
+PADDLE_ENFORCE_*): raise rich, typed errors with an error-summary header.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference: phi::enforce::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg: str = "Enforce condition failed", *args, exc=InvalidArgumentError):
+    if not cond:
+        raise exc(msg % args if args else msg)
+
+
+def enforce_eq(a, b, msg: str = ""):
+    if a != b:
+        raise InvalidArgumentError(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg: str = ""):
+    if not a > b:
+        raise InvalidArgumentError(f"Expected {a!r} > {b!r}. {msg}")
+
+
+def not_implemented(what: str):
+    raise UnimplementedError(what)

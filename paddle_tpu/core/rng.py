@@ -1,0 +1,84 @@
+"""Random number generation.
+
+Analog of the reference's `phi::Generator` (`paddle/phi/core/generator.h`)
+built on JAX's splittable PRNG: a global Generator holds a key that is split
+on every consumption — functional, reproducible, and trace-friendly (a traced
+key can be installed via `scoped_rng_key`, which is how jitted programs thread
+randomness as an explicit input instead of a captured constant).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def split(self, n: int = 1):
+        with self._lock:
+            keys = jax.random.split(self._key, n + 1)
+            self._key = keys[0]
+            return keys[1] if n == 1 else keys[1:]
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+
+default_generator = Generator(0)
+_tls = threading.local()
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def next_key():
+    """Get a fresh PRNG key: the scoped (traced) key if installed, else global."""
+    stack = getattr(_tls, "scoped", None)
+    if stack:
+        key, count = stack[-1]
+        sub = jax.random.fold_in(key, count)
+        stack[-1] = (key, count + 1)
+        return sub
+    return default_generator.split()
+
+
+@contextlib.contextmanager
+def scoped_rng_key(key):
+    """Install a (possibly traced) key for ops executed in this scope."""
+    stack = getattr(_tls, "scoped", None)
+    if stack is None:
+        stack = _tls.scoped = []
+    stack.append((key, 0))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(states):
+    default_generator.set_state(states[0])

@@ -1,0 +1,517 @@
+"""The eager Tensor.
+
+TPU-native analog of the reference's eager Tensor (`paddle/phi/core/dense_tensor.h:37`
+DenseTensor + `paddle/fluid/eager/autograd_meta.h` AutogradMeta + the pybind
+eager Tensor type `paddle/fluid/pybind/eager.cc`): a `jax.Array` living in
+PJRT-managed HBM plus autograd metadata (stop_gradient, grad, producer
+GradNode). Allocation, streams and memcpy from the reference's
+AllocatorFacade collapse into PJRT buffer management; `numpy()`/`item()` are
+the D2H path.
+
+Most of the `paddle.Tensor` method surface (reference: python/paddle/tensor/*)
+is patched on by :mod:`paddle_tpu.tensor` at import time via
+:func:`register_tensor_method`.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional
+
+import numpy as np
+
+from . import dtype as dtype_mod
+from .dtype import DType
+from .place import Place, current_place, jax_device
+
+_name_counter = itertools.count()
+_ops_cache = {}
+
+
+def _ops():
+    """Late import of the op namespace to break the core<->ops cycle."""
+    mod = _ops_cache.get("ops")
+    if mod is None:
+        from .. import _C_ops as mod
+
+        _ops_cache["ops"] = mod
+    return mod
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_backward_hooks",
+        "is_parameter",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True, name=None):
+        import jax.numpy as jnp
+
+        if data is None:
+            data = jnp.zeros([], dtype_mod.to_np(dtype or dtype_mod.get_default_dtype()))
+        self._data = _coerce_array(data, dtype, place)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name or f"generated_tensor_{next(_name_counter)}"
+        self.persistable = False
+        self.is_parameter = False
+        self.trainable = True
+        self._backward_hooks: List = []
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def _from_data(cls, data, stop_gradient=True, name=None):
+        t = object.__new__(cls)
+        t._data = data
+        t.stop_gradient = stop_gradient
+        t._grad = None
+        t._grad_node = None
+        t._out_index = 0
+        t.name = name or f"generated_tensor_{next(_name_counter)}"
+        t.persistable = False
+        t.is_parameter = False
+        t.trainable = True
+        t._backward_hooks = []
+        return t
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return dtype_mod.from_jax(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        dev = getattr(self._data, "devices", None)
+        if dev:
+            d = next(iter(self._data.devices()))
+            kind = "cpu" if d.platform == "cpu" else "tpu"
+            return Place(kind, d.id)
+        return current_place()  # tracer: report the ambient place
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad is None:
+            return None
+        g = Tensor._from_data(self._grad, stop_gradient=True, name=self.name + "@GRAD")
+        return g
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (value._data if isinstance(value, Tensor) else value)
+
+    def _wrap_grad(self, g):
+        return Tensor._from_data(g, stop_gradient=True)
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd import engine
+
+        engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Reference: eager hooks (paddle/fluid/eager/hooks.h)."""
+        if self._grad_node is not None:
+            self._grad_node.out_hooks.setdefault(self._out_index, []).append(hook)
+            node, idx = self._grad_node, self._out_index
+
+            class _Handle:
+                def remove(self_h):
+                    try:
+                        node.out_hooks[idx].remove(hook)
+                    except (KeyError, ValueError):
+                        pass
+
+            return _Handle()
+        self._backward_hooks.append(hook)
+        hooks = self._backward_hooks
+
+        class _Handle:
+            def remove(self_h):
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor._from_data(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    # -- host transfer -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- device transfer -----------------------------------------------------
+    def to(self, *args, **kwargs):
+        import jax
+
+        device = None
+        dtype = None
+        for a in args:
+            if isinstance(a, (Place, str)) and not _is_dtype_like(a):
+                device = a
+            else:
+                dtype = a
+        device = kwargs.get("device", device)
+        dtype = kwargs.get("dtype", dtype)
+        data = self._data
+        if dtype is not None:
+            data = data.astype(dtype_mod.to_np(dtype))
+        if device is not None:
+            p = device if isinstance(device, Place) else Place(device)
+            data = jax.device_put(data, jax_device(p))
+        out = Tensor._from_data(data, stop_gradient=self.stop_gradient, name=self.name)
+        return out
+
+    def cpu(self):
+        return self.to(Place("cpu"))
+
+    def tpu(self, device_id=0):
+        return self.to(Place("tpu", device_id))
+
+    cuda = tpu  # compat: accelerator transfer
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # -- in-place data rebind (functional under the hood) --------------------
+    def _rebind(self, other: "Tensor"):
+        """Adopt another tensor's value+grad-node (functional in-place)."""
+        self._data = other._data
+        self._grad_node = other._grad_node
+        self._out_index = other._out_index
+        if other._grad_node is not None:
+            self.stop_gradient = False
+        return self
+
+    def set_value(self, value):
+        arr = value._data if isinstance(value, Tensor) else _coerce_array(value, self.dtype, None)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {list(arr.shape)} vs {self.shape}"
+            )
+        self._data = arr.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- misc ----------------------------------------------------------------
+    def clone(self):
+        return _ops().assign(self)
+
+    def astype(self, dtype):
+        return _ops().cast(self, dtype)
+
+    def cast(self, dtype):
+        return _ops().cast(self, dtype)
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    @property
+    def T(self):
+        return _ops().transpose(self, list(range(self.ndim))[::-1])
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.numpy().item(), spec)
+        return format(str(self), spec)
+
+    def __repr__(self):
+        try:
+            vals = np.array2string(
+                np.asarray(self._data), precision=8, separator=", ", threshold=100
+            )
+        except Exception:
+            vals = f"<{type(self._data).__name__}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+            f"       {vals})"
+        )
+
+    __hash__ = object.__hash__
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, idx):
+        return _ops().getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        self._rebind(_ops().setitem(self, value, idx))
+
+    # -- arithmetic dunders (delegate to the op library) ---------------------
+    def __add__(self, o):
+        return _ops().add(self, o)
+
+    def __radd__(self, o):
+        return _ops().add(o, self)
+
+    def __sub__(self, o):
+        return _ops().subtract(self, o)
+
+    def __rsub__(self, o):
+        return _ops().subtract(o, self)
+
+    def __mul__(self, o):
+        return _ops().multiply(self, o)
+
+    def __rmul__(self, o):
+        return _ops().multiply(o, self)
+
+    def __truediv__(self, o):
+        return _ops().divide(self, o)
+
+    def __rtruediv__(self, o):
+        return _ops().divide(o, self)
+
+    def __floordiv__(self, o):
+        return _ops().floor_divide(self, o)
+
+    def __rfloordiv__(self, o):
+        return _ops().floor_divide(o, self)
+
+    def __mod__(self, o):
+        return _ops().remainder(self, o)
+
+    def __rmod__(self, o):
+        return _ops().remainder(o, self)
+
+    def __pow__(self, o):
+        return _ops().pow(self, o)
+
+    def __rpow__(self, o):
+        return _ops().elementwise_rpow(self, o)
+
+    def __neg__(self):
+        return _ops().scale(self, -1.0)
+
+    def __abs__(self):
+        return _ops().abs(self)
+
+    def __matmul__(self, o):
+        return _ops().matmul(self, o)
+
+    def __rmatmul__(self, o):
+        return _ops().matmul(o, self)
+
+    def __eq__(self, o):
+        return _ops().equal(self, o)
+
+    def __ne__(self, o):
+        return _ops().not_equal(self, o)
+
+    def __lt__(self, o):
+        return _ops().less_than(self, o)
+
+    def __le__(self, o):
+        return _ops().less_equal(self, o)
+
+    def __gt__(self, o):
+        return _ops().greater_than(self, o)
+
+    def __ge__(self, o):
+        return _ops().greater_equal(self, o)
+
+    def __invert__(self):
+        return _ops().logical_not(self)
+
+    def __and__(self, o):
+        return _ops().logical_and(self, o) if self.dtype == "bool" else _ops().bitwise_and(self, o)
+
+    def __or__(self, o):
+        return _ops().logical_or(self, o) if self.dtype == "bool" else _ops().bitwise_or(self, o)
+
+    def __xor__(self, o):
+        return _ops().logical_xor(self, o) if self.dtype == "bool" else _ops().bitwise_xor(self, o)
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (reference: EagerParamBase, python/paddle/base/framework.py)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, name=name, stop_gradient=not trainable)
+        self.is_parameter = True
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, name=None, trainable=True):
+        p = cls.__new__(cls)
+        p._data = t._data if isinstance(t, Tensor) else t
+        p.stop_gradient = not trainable
+        p._grad = None
+        p._grad_node = None
+        p._out_index = 0
+        p.name = name or f"param_{next(_name_counter)}"
+        p.persistable = True
+        p.is_parameter = True
+        p.trainable = trainable
+        p._backward_hooks = []
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        p.need_clip = True
+        return p
+
+
+def _is_dtype_like(x) -> bool:
+    if isinstance(x, DType):
+        return True
+    if isinstance(x, str):
+        try:
+            DType(x)
+            return True
+        except TypeError:
+            return False
+    return False
+
+
+def _coerce_array(data, dtype=None, place=None):
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(data, Tensor):
+        data = data._data
+    if isinstance(data, (jnp.ndarray, jax.Array)) or hasattr(data, "aval"):
+        arr = data
+        if dtype is not None:
+            arr = arr.astype(dtype_mod.to_np(dtype))
+        return arr
+    np_arr = np.asarray(data)
+    if dtype is not None:
+        np_arr = np_arr.astype(dtype_mod.to_np(dtype))
+    elif np_arr.dtype == np.float64:
+        np_arr = np_arr.astype(dtype_mod.to_np(dtype_mod.get_default_dtype()))
+    dev = jax_device(place if isinstance(place, Place) else (Place(place) if place else None))
+    return jax.device_put(np_arr, dev)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """``paddle.to_tensor`` parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def register_tensor_method(name, fn=None):
+    """Patch a method onto Tensor (reference pattern: python/paddle/tensor/__init__.py
+    attaching the tensor method library onto the pybind type)."""
+    if fn is None:
+
+        def deco(f):
+            setattr(Tensor, name, f)
+            return f
+
+        return deco
+    setattr(Tensor, name, fn)
+    return fn
+
+
+# Register Tensor as a jax pytree so jitted functions can take/return Tensors.
+import jax.tree_util as _jtu
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor._from_data(children[0])
+    t.stop_gradient = aux[0]
+    return t
+
+
+_jtu.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+_jtu.register_pytree_node(
+    Parameter,
+    _tensor_flatten,
+    lambda aux, ch: Tensor._from_data(ch[0], stop_gradient=aux[0]),
+)

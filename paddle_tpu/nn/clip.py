@@ -1,0 +1,90 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm et al., consumed by Optimizer)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        return [
+            (p, None if g is None else jnp.clip(g, self.min, self.max)) for p, g in params_grads
+        ]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, (g.astype(jnp.float32) * factor).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference: ClipGradByGlobalNorm (nn/clip.py) — the hybrid-parallel-aware
+    variant lives in distributed.fleet (sums per-group partial norms)."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return None
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def __call__(self, params_grads):
+        gn = self._global_norm([g for p, g in params_grads if p_needs_clip(p)])
+        if gn is None:
+            return params_grads
+        factor = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not p_needs_clip(p):
+                out.append((p, g))
+            else:
+                out.append((p, (g.astype(jnp.float32) * factor).astype(g.dtype)))
+        return out
+
+
+def p_needs_clip(p):
+    return getattr(p, "need_clip", True)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility also exposed by paddle.nn.utils."""
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return None
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = (p._grad.astype(jnp.float32) * factor).astype(p._grad.dtype)
+    return total

@@ -1,0 +1,31 @@
+"""The op library.
+
+Analog of the reference's phi kernel library + generated C++ API
+(`paddle/phi/kernels`, `paddle/phi/api`): importing this package registers
+every kernel into the op registry (`dispatch.OPS`), the runtime analog of
+`KernelFactory` (`paddle/phi/core/kernel_factory.h:316`). The YAML op schema
+(`paddle_tpu/ops/yaml/ops.yaml`) documents each op's signature for parity
+checking and drives the generated `_C_ops` namespace.
+"""
+from . import dispatch
+from .dispatch import (  # noqa: F401
+    OPS,
+    call_op,
+    enable_grad,
+    get_op,
+    is_grad_enabled,
+    no_grad,
+    register_op,
+    set_grad_enabled,
+)
+from .kernels import (  # noqa: F401
+    comparison,
+    creation,
+    linalg,
+    manipulation,
+    math,
+    nn_ops,
+    random,
+    reduce,
+    search,
+)

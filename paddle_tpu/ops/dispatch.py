@@ -1,0 +1,178 @@
+"""Op dispatch: the eager hot path.
+
+TPU-native re-design of the reference's dygraph dispatch stack (SURVEY.md CS1:
+generated `*_ad_func` -> KernelKeyParser -> KernelFactory -> phi kernel,
+`paddle/phi/core/kernel_factory.h:316`). Here every op is a JAX-traceable
+kernel function: dispatch unwraps Tensors to jax.Arrays, runs the kernel
+(XLA-compiled and cached by jax under the hood — the analog of the
+reference's kernel-selection cache), and, when autograd is live, records a
+single GradNode holding the op's `jax.vjp` pullback (replacing the generated
+GradNode subclasses of `eager_gen.py`).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Dict
+
+import jax
+import numpy as np
+
+from ..core import flags
+from ..core.tensor import Tensor
+
+
+def _grad_node_cls():
+    from ..autograd.engine import GradNode
+
+    return GradNode
+
+OPS: Dict[str, Callable] = {}
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """``paddle.no_grad`` parity: context manager AND decorator."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _wrap_out(arr, node=None, idx=0):
+    t = Tensor._from_data(arr)
+    if node is not None and np.issubdtype(np.dtype(arr.dtype), np.inexact):
+        t._grad_node = node
+        t._out_index = idx
+        t.stop_gradient = False
+    return t
+
+
+def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+    t_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    in_tensors = [leaves[i] for i in t_slots]
+    arrays = [t._data for t in in_tensors]
+
+    needs_grad = (
+        not nondiff
+        and is_grad_enabled()
+        and any(
+            (not t.stop_gradient or t._grad_node is not None)
+            and np.issubdtype(np.dtype(t._data.dtype), np.inexact)
+            for t in in_tensors
+        )
+    )
+
+    if needs_grad:
+
+        def pure(*arrs):
+            ls = list(leaves)
+            for slot, a in zip(t_slots, arrs):
+                ls[slot] = a
+            a2, k2 = jax.tree.unflatten(treedef, ls)
+            return kernel(*a2, **k2)
+
+        out, vjp_fn = jax.vjp(pure, *arrays)
+        out_leaves, out_treedef = jax.tree.flatten(out)
+        edges = []
+        for t in in_tensors:
+            if (not t.stop_gradient or t._grad_node is not None) and np.issubdtype(
+                np.dtype(t._data.dtype), np.inexact
+            ):
+                if t._grad_node is not None:
+                    edges.append(("node", t._grad_node, t._out_index))
+                else:
+                    edges.append(("leaf", t))
+            else:
+                edges.append(None)
+        node = _grad_node_cls()(
+            name,
+            lambda cot, _f=vjp_fn: _f(cot),
+            [(tuple(o.shape), o.dtype) for o in out_leaves],
+            out_treedef,
+            edges,
+        )
+        out_tensors = [_wrap_out(o, node, i) for i, o in enumerate(out_leaves)]
+        result = jax.tree.unflatten(out_treedef, out_tensors)
+    else:
+        ls = list(leaves)
+        for slot, a in zip(t_slots, arrays):
+            ls[slot] = a
+        a2, k2 = jax.tree.unflatten(treedef, ls)
+        out = kernel(*a2, **k2)
+        result = jax.tree.map(_wrap_out, out)
+
+    if flags.flag_value("check_nan_inf"):
+        _check_nan_inf(name, result)
+    return result
+
+
+def _check_nan_inf(name, result):
+    """FLAGS_check_nan_inf analog (reference: new_executor/nan_inf_utils)."""
+    import jax.numpy as jnp
+
+    for t in jax.tree.leaves(result, is_leaf=_is_tensor):
+        if isinstance(t, Tensor) and np.issubdtype(np.dtype(t._data.dtype), np.floating):
+            arr = t._data
+            if hasattr(arr, "aval") and not hasattr(arr, "devices"):
+                continue  # tracer: skip eager check inside traces
+            if bool(jnp.any(~jnp.isfinite(arr))):
+                raise FloatingPointError(f"Operator {name} output contains Inf/Nan")
+
+
+def register_op(name_or_fn=None, *, name=None, nondiff=False):
+    """Register a JAX kernel as a framework op (analog of PD_REGISTER_KERNEL,
+    `paddle/phi/core/kernel_registry.h:196`)."""
+
+    def deco(kernel):
+        opname = name or getattr(kernel, "__name__", None)
+
+        @functools.wraps(kernel)
+        def api(*args, **kwargs):
+            return call_op(opname, kernel, args, kwargs, nondiff=nondiff)
+
+        api._kernel = kernel
+        api._op_name = opname
+        OPS[opname] = api
+        return api
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    if isinstance(name_or_fn, str):
+        name = name_or_fn
+    return deco
+
+
+def get_op(name: str):
+    return OPS[name]

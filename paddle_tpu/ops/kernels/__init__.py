@@ -1,0 +1,1 @@
+"""Kernel implementations, grouped like `paddle/phi/kernels` (SURVEY.md §2.1)."""

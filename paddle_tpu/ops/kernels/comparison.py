@@ -1,0 +1,95 @@
+"""Comparison / logical / bitwise kernels.
+
+Analog of `paddle/phi/kernels/compare_kernel.*`, `logical_kernel.*`,
+`bitwise_kernel.*`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+@register_op(nondiff=True)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@register_op(nondiff=True)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@register_op(nondiff=True)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@register_op(nondiff=True)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@register_op(nondiff=True)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@register_op(nondiff=True)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@register_op(nondiff=True)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@register_op(nondiff=True)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@register_op(nondiff=True)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@register_op(nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register_op(nondiff=True)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register_op(nondiff=True)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register_op(nondiff=True)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register_op(nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register_op(nondiff=True)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op(nondiff=True)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op(nondiff=True)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)

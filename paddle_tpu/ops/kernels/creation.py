@@ -1,0 +1,170 @@
+"""Tensor creation kernels (analog of `paddle/phi/kernels/full_kernel.*`,
+`arange_kernel.*`, `eye_kernel.*` ...)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ..dispatch import register_op
+
+
+def _np_dtype(d, default=None):
+    if d is None:
+        d = default or dtype_mod.get_default_dtype()
+    return dtype_mod.to_np(d)
+
+
+@register_op(nondiff=True)
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return jnp.full(shape, fill_value, _np_dtype(dtype))
+
+
+@register_op
+def full_like(x, fill_value, dtype=None):
+    return jnp.full(x.shape, fill_value, _np_dtype(dtype) if dtype else x.dtype)
+
+
+@register_op
+def zeros_like(x, dtype=None):
+    return jnp.zeros(x.shape, _np_dtype(dtype) if dtype else x.dtype)
+
+
+@register_op
+def ones_like(x, dtype=None):
+    return jnp.ones(x.shape, _np_dtype(dtype) if dtype else x.dtype)
+
+
+@register_op(nondiff=True)
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return jnp.arange(start, end, step, dtype=_np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_np_dtype(dtype))
+
+
+@register_op
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@register_op
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@register_op
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1:
+        out = jnp.diag(x, offset)
+        if padding_value != 0:
+            n = out.shape[0]
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diagonal(x, offset, axis1=-2, axis2=-1)
+
+
+@register_op
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, offset)
+
+
+@register_op
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    if dim1 != -2 or dim2 != -1:
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@register_op
+def assign(x):
+    # jax arrays are immutable, so identity IS a copy semantically.
+    return jnp.asarray(x)
+
+
+@register_op
+def cast(x, dtype):
+    return x.astype(dtype_mod.to_np(dtype))
+
+
+@register_op
+def meshgrid(*xs):
+    if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+        xs = tuple(xs[0])
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+@register_op(nondiff=True)
+def one_hot(x, num_classes):
+    return jnp.eye(num_classes, dtype=jnp.float32)[x.astype(jnp.int32)]
+
+
+@register_op(nondiff=True)
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _np_dtype(dtype))
+
+
+@register_op(nondiff=True)
+def empty_like(x, dtype=None):
+    return jnp.zeros(x.shape, _np_dtype(dtype) if dtype else x.dtype)
+
+
+@register_op
+def complex(real, imag):
+    return jnp.asarray(real) + 1j * jnp.asarray(imag)
+
+
+@register_op(nondiff=True)
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, offset, col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def triu_indices(row, col, offset=0):
+    r, c = jnp.triu_indices(row, offset, col)
+    return jnp.stack([r, c]).astype(jnp.int64)

@@ -1,0 +1,229 @@
+"""Linear-algebra kernels.
+
+Analog of `paddle/phi/kernels/matmul_kernel.*` (+ `funcs/blas` cuBLAS
+wrappers) and the lapack-backed decompositions: matmuls lower straight to XLA
+`dot_general`, i.e. the TPU MXU — the entire BLAS wrapper layer of the
+reference disappears.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+@register_op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_op
+def dot(x, y):
+    # paddle.dot: 1-D (or batched 1-D) inner product
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register_op
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_op
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op
+def cross(x, y, axis=None):
+    if axis is None:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro" and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+@register_op
+def p_norm(x, porder=2.0, axis=-1, keepdim=False, epsilon=1e-12):
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis, keepdims=keepdim) + epsilon, 1.0 / porder)
+
+
+@register_op
+def cholesky(x, upper=False):
+    out = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(out, -1, -2).conj() if upper else out
+
+
+@register_op
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@register_op
+def svd(x, full_matrices=False):
+    # paddle.linalg.svd returns (U, S, VH) — X = U @ diag(S) @ VH
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@register_op
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_op(nondiff=True)
+def eig(x):
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register_op
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rcond=rcond, hermitian=hermitian)
+
+
+@register_op
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+@register_op
+def cholesky_solve(x, y, upper=False):
+    cho = (y, not upper)
+    return jax.scipy.linalg.cho_solve(cho, x)
+
+
+@register_op
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op(nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+@register_op
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@register_op
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+@register_op
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+@register_op
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+@register_op
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+@register_op
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng, weights=weight, density=density)
+    return hist

@@ -1,0 +1,327 @@
+"""Shape/layout manipulation kernels.
+
+Analog of `paddle/phi/kernels/{reshape,transpose,concat,split,...}_kernel.*`
+and the `stride/` view kernels — on XLA these are metadata-only or fused
+copies; gradient rules come from `jax.vjp` of the forward.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import register_op
+
+
+@register_op
+def reshape(x, shape):
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+@register_op
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+@register_op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op
+def concat(xs, axis=0):
+    axis = int(axis) if not isinstance(axis, int) else axis
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+@register_op
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+@register_op
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    # paddle allows one -1 section
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+@register_op
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+@register_op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis) if axis else x
+
+
+@register_op
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted([a if a >= 0 else a + out.ndim + 1 for a in axis]):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@register_op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape([1])
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1 :])
+    return x.reshape(new_shape)
+
+
+@register_op
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@register_op
+def expand(x, shape):
+    shape = list(shape)
+    # paddle allows -1 meaning "keep this dim"
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - offset]
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+@register_op
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+@register_op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k, axes)
+
+
+@register_op
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+@register_op
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x[idx]
+
+
+@register_op
+def scatter(x, index, updates, overwrite=True):
+    index = index.astype(jnp.int32)
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle semantics: overwrite=False means accumulate after zeroing
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@register_op
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+@register_op
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index.astype(jnp.int32)]
+
+
+@register_op
+def index_add(x, index, axis, value):
+    index = index.astype(jnp.int32)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@register_op
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=axis)
+
+
+@register_op
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    indices = indices.astype(jnp.int32)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce in ("add", "sum"):
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)]) for d, s in enumerate(indices.shape)]
+        idx[axis] = indices
+        return x.at[tuple(jnp.broadcast_arrays(*idx))].add(values)
+    raise ValueError(f"Unsupported reduce mode {reduce}")
+
+
+@register_op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+@register_op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # paddle pad: list like [left, right] per trailing dims or full 2*ndim
+    if len(pad) == 2 * x.ndim:
+        # full-length pad: first dimension to last (reference: F.pad docstring)
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # partial pad applies to spatial dims from the LAST dim backwards:
+        # pad[0:2] -> W, pad[2:4] -> H, ... (reference: nn/functional/common.py pad)
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * x.ndim
+        if data_format.endswith("C"):  # NHWC/NLC: last spatial dim is ndim-2
+            spatial_axes = list(range(1, x.ndim - 1))
+        else:  # NCHW/NCL: spatial dims are 2..ndim-1
+            spatial_axes = list(range(2, x.ndim))
+        for i in range(n_spatial):
+            axis = spatial_axes[len(spatial_axes) - 1 - i]
+            widths[axis] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=mode_map[mode])
+
+
+@register_op
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@register_op(nondiff=True)
+def masked_select(x, mask):
+    # dynamic output shape: eager-only (not jittable), like reference CPU kernel
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+@register_op
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@register_op
+def getitem(x, idx):
+    def fix(i):
+        if isinstance(i, jnp.ndarray) and i.dtype == jnp.int64:
+            return i.astype(jnp.int32)
+        return i
+
+    if isinstance(idx, tuple):
+        idx = tuple(fix(i) for i in idx)
+    else:
+        idx = fix(idx)
+    return x[idx]
+
+
+@register_op
+def setitem(x, value, idx):
+    if not hasattr(value, "dtype"):
+        value = jnp.asarray(value, x.dtype)
+    return x.at[idx].set(value.astype(x.dtype))
+
+
+@register_op
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op(nondiff=True)
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op(nondiff=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    xs = np.asarray(x)
+    res = np.unique(xs, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@register_op(nondiff=True)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(np.asarray(x), weights=weights, minlength=minlength)
+
+
+@register_op
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    # im2col (reference: unfold_kernel); x: [N, C, H, W]
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+    out_h = (xp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+    out_w = (xp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+    cols = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            patch = xp[:, :, i * dl[0] : i * dl[0] + out_h * st[0] : st[0], j * dl[1] : j * dl[1] + out_w * st[1] : st[1]]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return out.reshape(N, C * ks[0] * ks[1], out_h * out_w)

@@ -1,0 +1,417 @@
+"""Elementwise & scalar math kernels.
+
+Analog of the reference's elementwise phi kernels
+(`paddle/phi/kernels/elementwise_*`, `activation_kernel.cc`): each op is a
+JAX-traceable function lowered to XLA HLO, which fuses chains of these into
+single TPU kernels (replacing the reference's hand-fused CUDA functors).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+@register_op
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@register_op
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register_op
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register_op
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@register_op
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register_op
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+@register_op
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@register_op
+def elementwise_rpow(x, y):
+    return jnp.power(y, x)
+
+
+@register_op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op
+def abs(x):
+    return jnp.abs(x)
+
+
+@register_op
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_op
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_op
+def square(x):
+    return jnp.square(x)
+
+
+@register_op
+def reciprocal(x):
+    return 1.0 / x
+
+
+@register_op
+def exp(x):
+    return jnp.exp(x)
+
+
+@register_op
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register_op
+def log(x):
+    return jnp.log(x)
+
+
+@register_op
+def log2(x):
+    return jnp.log2(x)
+
+
+@register_op
+def log10(x):
+    return jnp.log10(x)
+
+
+@register_op
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register_op
+def sin(x):
+    return jnp.sin(x)
+
+
+@register_op
+def cos(x):
+    return jnp.cos(x)
+
+
+@register_op
+def tan(x):
+    return jnp.tan(x)
+
+
+@register_op
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register_op
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register_op
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register_op
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register_op
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register_op
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register_op
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_op
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register_op
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register_op
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register_op
+def floor(x):
+    return jnp.floor(x)
+
+
+@register_op
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register_op
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+@register_op
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register_op
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register_op
+def sign(x):
+    return jnp.sign(x)
+
+
+@register_op
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_op
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@register_op
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@register_op
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op(nondiff=True)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op(nondiff=True)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register_op(nondiff=True)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register_op
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register_op
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register_op
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register_op
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@register_op
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@register_op
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register_op
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_op
+def cumprod(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+@register_op
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    v = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return v
+
+
+@register_op
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@register_op
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op
+def multiply_add(x, y, z):
+    return x * y + z
+
+
+@register_op
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_op
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op
+def angle(x):
+    return jnp.angle(x)
+
+
+@register_op
+def conj(x):
+    return jnp.conj(x)
+
+
+@register_op
+def real(x):
+    return jnp.real(x)
+
+
+@register_op
+def imag(x):
+    return jnp.imag(x)
+
+
+@register_op
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register_op
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register_op
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register_op
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@register_op
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register_op
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+@register_op
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_op
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@register_op
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@register_op
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@register_op
+def i1e(x):
+    return jax.scipy.special.i1e(x)

@@ -1,0 +1,655 @@
+"""Neural-net op kernels.
+
+Analog of the reference's NN phi kernels: activations
+(`paddle/phi/kernels/activation_kernel.*`), softmax
+(`gpudnn/softmax_kernel.*`), conv (`conv_kernel.*` / cudnn), pooling
+(`pool_kernel.*`), normalization (`batch_norm_kernel.*`,
+`layer_norm_kernel.*`), embedding (`embedding_kernel.*`), losses
+(`cross_entropy_kernel.*`). Convs/matmuls lower to XLA ops that hit the TPU
+MXU; XLA fuses the elementwise epilogues (the role of the reference's fused
+CUDA kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng
+from ..dispatch import register_op
+
+
+# ---- activations -----------------------------------------------------------
+@register_op
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register_op
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@register_op
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_op
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@register_op
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@register_op
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@register_op
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register_op
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register_op
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_op
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_op
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register_op
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+@register_op
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+@register_op
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_op
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    g = -jnp.log(-jnp.log(jax.random.uniform(rng.next_key(), x.shape, x.dtype, 1e-20, 1.0)))
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        y_hard = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+@register_op
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@register_op
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op
+def swiglu(x, y=None):
+    """Fused swiglu (reference: `paddle/phi/kernels/fusion/gpu/swiglu...`,
+    incubate/nn/functional/swiglu.py): silu(x) * y; single input splits in half."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+# ---- dropout ---------------------------------------------------------------
+@register_op
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", seed=0):
+    if not training or p == 0.0:
+        return x
+    key = jax.random.key(seed) if seed else rng.next_key()
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# ---- embedding -------------------------------------------------------------
+@register_op
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = weight.shape[0] + padding_idx
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+# ---- linear ----------------------------------------------------------------
+@register_op
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---- conv ------------------------------------------------------------------
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        dn_in = "NC" + "DHW"[3 - nd :]
+        x_spec = dn_in
+    else:
+        x_spec = "N" + "DHW"[3 - nd :] + "C"
+    w_spec = "OI" + "DHW"[3 - nd :]
+    out_spec = x_spec
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    else:
+        p = _pair(padding, nd)
+        if len(p) == nd:
+            pad = [(int(v), int(v)) for v in p]
+        else:  # explicit per-side
+            pad = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(nd)]
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape, (x_spec, w_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        if x_spec.endswith("C"):
+            out = out + bias.reshape((1,) * (nd + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+@register_op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+@register_op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+@register_op
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCHW"
+):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    p = _pair(padding, nd)
+    opad = _pair(output_padding, nd)
+    if data_format == "NCHW":
+        specs = ("NCHW", "OIHW", "NCHW")
+    else:
+        specs = ("NHWC", "OIHW", "NHWC")
+    pad = [
+        (dilation[i] * (weight.shape[2 + i] - 1) - p[i], dilation[i] * (weight.shape[2 + i] - 1) - p[i] + opad[i])
+        for i in range(nd)
+    ]
+
+    def _one_group(xi, wi):
+        # wi: [in_c, out_c, kh, kw] -> flip spatial, swap io -> [out_c, in_c, kh, kw]
+        wt = jnp.transpose(jnp.flip(wi, axis=(2, 3)), (1, 0, 2, 3))
+        dn = jax.lax.conv_dimension_numbers(xi.shape, wt.shape, specs)
+        return jax.lax.conv_general_dilated(
+            xi, wt, window_strides=(1, 1), padding=pad, lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn,
+        )
+
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1 if data_format == "NCHW" else -1)
+        ws = jnp.split(weight, groups, axis=0)
+        out = jnp.concatenate(
+            [_one_group(xi, wi) for xi, wi in zip(xs, ws)], axis=1 if data_format == "NCHW" else -1
+        )
+    else:
+        out = _one_group(x, weight)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias.reshape(1, 1, 1, -1))
+    return out
+
+
+# ---- pooling ---------------------------------------------------------------
+def _pool(x, kernel, stride, padding, data_format, reducer, init, nd, ceil_mode=False):
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    p = _pair(padding, nd)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial_sizes = x.shape[1 : 1 + nd] if channel_last else x.shape[2 : 2 + nd]
+    spatial_pads = []
+    for i in range(nd):
+        hi = p[i]
+        if ceil_mode:
+            # extra high-side padding so the last partial window is included
+            size = spatial_sizes[i] + 2 * p[i]
+            out_floor = (size - kernel[i]) // stride[i] + 1
+            out_ceil = -(-(size - kernel[i]) // stride[i]) + 1
+            hi += (out_ceil - out_floor) * stride[i]
+        spatial_pads.append((p[i], hi))
+    if channel_last:
+        window = (1, *kernel, 1)
+        strides = (1, *stride, 1)
+        pads = [(0, 0)] + spatial_pads + [(0, 0)]
+    else:
+        window = (1, 1, *kernel)
+        strides = (1, 1, *stride)
+        pads = [(0, 0), (0, 0)] + spatial_pads
+    return jax.lax.reduce_window(x, init, reducer, window, strides, pads)
+
+
+@register_op
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, data_format, jax.lax.max, -jnp.inf, 2, ceil_mode)
+
+
+@register_op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    kernel = _pair(kernel_size, 2)
+    summed = _pool(x, kernel_size, stride, padding, data_format, jax.lax.add, 0.0, 2, ceil_mode)
+    if exclusive and _pair(padding, 2) != [0, 0]:
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, kernel_size, stride, padding, data_format, jax.lax.add, 0.0, 2, ceil_mode)
+        return summed / counts
+    return summed / (kernel[0] * kernel[1])
+
+
+@register_op
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, data_format, jax.lax.max, -jnp.inf, 1, ceil_mode)
+
+
+@register_op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCL"):
+    kernel = _pair(kernel_size, 1)
+    summed = _pool(x, kernel_size, stride, padding, data_format, jax.lax.add, 0.0, 1, ceil_mode)
+    return summed / kernel[0]
+
+
+@register_op
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out = _pair(output_size, 2)
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
+        return x5.mean(axis=(3, 5))
+    N, H, W, C = x.shape
+    x5 = x.reshape(N, out[0], H // out[0], out[1], W // out[1], C)
+    return x5.mean(axis=(2, 4))
+
+
+@register_op
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    out = _pair(output_size, 2)
+    N, C, H, W = x.shape
+    x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
+    return x5.max(axis=(3, 5))
+
+
+# ---- normalization ---------------------------------------------------------
+@register_op
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) if begin_norm_axis != -1 else (-1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6):
+    """Fused RMSNorm (reference: incubate fused_rms_norm)."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op
+def batch_norm_infer(x, mean, variance, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    if data_format in ("NCHW", "NCL", "NCDHW") and x.ndim > 2:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(variance.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var) — the layer updates running stats."""
+    if data_format in ("NCHW", "NCL", "NCDHW") and x.ndim > 2:
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@register_op
+def group_norm(x, weight=None, bias=None, epsilon=1e-5, groups=1, data_format="NCHW"):
+    if data_format == "NCHW":
+        N, C = x.shape[:2]
+        xg = x.reshape((N, groups, C // groups) + x.shape[2:])
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        N, C = x.shape[0], x.shape[-1]
+        xg = x.reshape((N,) + x.shape[1:-1] + (groups, C // groups))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    c_axis = 1 if data_format == "NCHW" else -1
+    pads = [(0, 0)] * x.ndim
+    pads[c_axis] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pads)
+    win = [1] * x.ndim
+    win[c_axis] = size
+    s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(win), (1,) * x.ndim, [(0, 0)] * x.ndim)
+    return x / jnp.power(k + alpha * s, beta)
+
+
+# ---- losses ----------------------------------------------------------------
+@register_op
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lbl = label.astype(jnp.int32)
+    squeeze = lbl.ndim == logits.ndim and lbl.shape[axis] == 1
+    if squeeze:
+        lbl = jnp.squeeze(lbl, axis)
+    nll = -jnp.take_along_axis(logp, jnp.expand_dims(jnp.clip(lbl, 0, None), axis), axis=axis)
+    mask = jnp.expand_dims(lbl != ignore_index, axis)
+    return jnp.where(mask, nll, 0.0)
+
+
+@register_op
+def nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, jnp.clip(lbl, 0, None)[:, None], axis=1)[:, 0]
+    mask = lbl != ignore_index
+    if weight is not None:
+        w = jnp.take(weight, jnp.clip(lbl, 0, None))
+        nll = nll * w
+    nll = jnp.where(mask, nll, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(w * mask) if weight is not None else jnp.sum(mask)
+        return jnp.sum(nll) / jnp.maximum(denom, 1)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+@register_op
+def bce_with_logits(logit, label, weight=None, pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1.0 - label) * logit + max_val + jnp.log1p(jnp.exp(-jnp.abs(logit)) )
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+@register_op
+def kl_div(x, target, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(target) * (target - x)
+    else:
+        loss = target * (jnp.log(jnp.clip(target, 1e-30, None)) - x)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op
+def huber_loss(input, label, delta=1.0):
+    d = input - label
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+# ---- attention -------------------------------------------------------------
+@register_op
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, scale=None):
+    """Reference analog: flash_attn kernel (`paddle/phi/kernels/gpu/flash_attn_kernel`).
+
+    Layout [batch, seq, heads, head_dim] (paddle convention). XLA fuses this
+    well; the Pallas flash-attention path (paddle_tpu.ops.pallas) is used by
+    nn.functional when shapes allow.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = scale or (1.0 / np.sqrt(D))
+    qh = jnp.moveaxis(q, 2, 1)  # B H S D
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        keep = 1.0 - dropout_p
+        probs = probs * jax.random.bernoulli(rng.next_key(), keep, probs.shape) / keep
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.moveaxis(out, 1, 2)
+
+
+# ---- vision ----------------------------------------------------------------
+@register_op
+def interpolate_nearest(x, out_hw, data_format="NCHW"):
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        rows = (jnp.arange(out_hw[0]) * H // out_hw[0]).astype(jnp.int32)
+        cols = (jnp.arange(out_hw[1]) * W // out_hw[1]).astype(jnp.int32)
+        return x[:, :, rows[:, None], cols[None, :]]
+    N, H, W, C = x.shape
+    rows = (jnp.arange(out_hw[0]) * H // out_hw[0]).astype(jnp.int32)
+    cols = (jnp.arange(out_hw[1]) * W // out_hw[1]).astype(jnp.int32)
+    return x[:, rows[:, None], cols[None, :], :]
+
+
+@register_op
+def interpolate_bilinear(x, out_hw, align_corners=False, data_format="NCHW"):
+    chan_first = data_format == "NCHW"
+    if chan_first:
+        x = jnp.moveaxis(x, 1, -1)
+    N, H, W, C = x.shape
+    oh, ow = out_hw
+    if align_corners and oh > 1:
+        ys = jnp.linspace(0, H - 1, oh)
+    else:
+        ys = (jnp.arange(oh) + 0.5) * H / oh - 0.5
+    if align_corners and ow > 1:
+        xs = jnp.linspace(0, W - 1, ow)
+    else:
+        xs = (jnp.arange(ow) + 0.5) * W / ow - 0.5
+    ys = jnp.clip(ys, 0, H - 1)
+    xs = jnp.clip(xs, 0, W - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    p00 = x[:, y0[:, None], x0[None, :], :]
+    p01 = x[:, y0[:, None], x1[None, :], :]
+    p10 = x[:, y1[:, None], x0[None, :], :]
+    p11 = x[:, y1[:, None], x1[None, :], :]
+    out = (
+        p00 * (1 - wy) * (1 - wx)
+        + p01 * (1 - wy) * wx
+        + p10 * wy * (1 - wx)
+        + p11 * wy * wx
+    )
+    if chan_first:
+        out = jnp.moveaxis(out, -1, 1)
+    return out.astype(x.dtype)
+
+
+@register_op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        N, C, H, W = x.shape
+        x = x.reshape(N, C // (r * r), r, r, H, W)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(N, C // (r * r), H * r, W * r)
+    N, H, W, C = x.shape
+    x = x.reshape(N, H, W, r, r, C // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(N, H * r, W * r, C // (r * r))

@@ -1,0 +1,86 @@
+"""Random sampling kernels.
+
+Analog of `paddle/phi/kernels/gpu/{uniform,gaussian,randint,...}_kernel.*`
+built on the splittable JAX PRNG (keys come from the global Generator,
+`paddle_tpu.core.rng` — the phi::Generator analog)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as dtype_mod, rng
+from ..dispatch import register_op
+
+
+def _dt(dtype):
+    return dtype_mod.to_np(dtype or dtype_mod.get_default_dtype())
+
+
+@register_op(nondiff=True)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.uniform(key, shape, _dt(dtype), min, max)
+
+
+@register_op(nondiff=True)
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return mean + std * jax.random.normal(key, shape, _dt(dtype))
+
+
+@register_op(nondiff=True)
+def randint(low=0, high=None, shape=(1,), dtype=None, seed=0):
+    if high is None:
+        low, high = 0, low
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.randint(key, shape, low, high, dtype_mod.to_np(dtype or "int64"))
+
+
+@register_op(nondiff=True)
+def randperm(n, dtype=None, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.permutation(key, n).astype(dtype_mod.to_np(dtype or "int64"))
+
+
+@register_op(nondiff=True)
+def bernoulli(x, p=None, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    probs = x if p is None else p
+    return jax.random.bernoulli(key, probs, x.shape).astype(x.dtype)
+
+
+@register_op(nondiff=True)
+def multinomial(x, num_samples=1, replacement=False, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(num_samples,) + x.shape[:-1])
+        return jnp.moveaxis(out, 0, -1).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape, logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def poisson(x, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+@register_op(nondiff=True)
+def exponential_(x, lam=1.0, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+@register_op(nondiff=True)
+def normal_like(x, mean=0.0, std=1.0, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return mean + std * jax.random.normal(key, x.shape, x.dtype)
+
+
+@register_op(nondiff=True)
+def uniform_random_like(x, min=-1.0, max=1.0, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)

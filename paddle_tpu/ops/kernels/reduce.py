@@ -1,0 +1,123 @@
+"""Reduction kernels (analog of `paddle/phi/kernels/reduce_*_kernel.*` and the
+shared reduce functors in `kernels/funcs/reduce_function.h` — XLA emits the
+tiled TPU reductions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@register_op
+def sum(x, axis=None, dtype=None, keepdim=False):
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from ...core import dtype as dtype_mod
+
+        out = out.astype(dtype_mod.to_np(dtype))
+    return out
+
+
+@register_op
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(nondiff=True)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(nondiff=True)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ...core import dtype as dtype_mod
+
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.to_np(dtype))
+
+
+@register_op(nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ...core import dtype as dtype_mod
+
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype_mod.to_np(dtype))
+
+
+@register_op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)

@@ -1,0 +1,78 @@
+"""Search/sort kernels (analog of `paddle/phi/kernels/{top_k,argsort,where,...}_kernel.*`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import register_op
+
+
+@register_op
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k = int(k)
+    if axis != -1 and axis != x.ndim - 1:
+        xt = jnp.moveaxis(x, axis, -1)
+        vals, idx = topk._kernel(xt, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    if largest:
+        vals, idx = jax.lax.top_k(x, k)
+    else:
+        vals, idx = jax.lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@register_op(nondiff=True)
+def argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=axis, stable=stable)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op(nondiff=True)
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i[:, None] if i.ndim == 1 else i) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1).astype(np.int64))
+
+
+@register_op
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idx_sorted = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idx_sorted, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def mode(x, axis=-1, keepdim=False):
+    import scipy.stats
+
+    xs = np.asarray(x)
+    val, _ = scipy.stats.mode(xs, axis=axis, keepdims=True)
+    idx = np.argmax(xs == val, axis=axis)
+    val = np.squeeze(val, axis=axis)
+    if keepdim:
+        val = np.expand_dims(val, axis)
+        idx = np.expand_dims(idx, axis)
+    return jnp.asarray(val), jnp.asarray(idx.astype(np.int64))

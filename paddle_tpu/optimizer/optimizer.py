@@ -1,0 +1,313 @@
+"""Optimizer base + built-ins.
+
+Analog of the reference's `python/paddle/optimizer/optimizer.py:127` Optimizer
+and its 16 subclasses. Updates are pure jnp expressions over the param/grad
+arrays (XLA fuses each param update into one kernel); accumulators are plain
+jax arrays keyed by parameter name, so the whole optimizer state is a pytree
+ready for jitted/sharded training steps and for checkpointing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from ..ops.dispatch import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        self._learning_rate = learning_rate
+        self._parameter_list: Optional[List[Parameter]] = (
+            list(parameters) if parameters is not None else None
+        )
+        self._weight_decay = weight_decay
+        self._grad_clip: Optional[ClipGradBase] = grad_clip
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _acc(self, param: Parameter, name: str, init=None):
+        store = self._accumulators.setdefault(param.name, {})
+        if name not in store:
+            store[name] = jnp.zeros_like(param._data) if init is None else init
+        return store[name]
+
+    def _set_acc(self, param: Parameter, name: str, value):
+        self._accumulators.setdefault(param.name, {})[name] = value
+
+    def state_dict(self):
+        out = {}
+        for pname, accs in self._accumulators.items():
+            for aname, arr in accs.items():
+                out[f"{pname}.{aname}"] = Tensor._from_data(arr)
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        for key, val in state.items():
+            if key == "@step":
+                self._step_count = int(val)
+            elif key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(val)
+            elif "." in key:
+                pname, aname = key.rsplit(".", 1)
+                arr = val._data if isinstance(val, Tensor) else jnp.asarray(np.asarray(val))
+                self._accumulators.setdefault(pname, {})[aname] = arr
+
+    load_state_dict = set_state_dict
+
+    # -- stepping ------------------------------------------------------------
+    def _collect_params_grads(self):
+        params = self._parameter_list or []
+        pg = []
+        for p in params:
+            if not p.trainable:
+                continue
+            pg.append((p, p._grad))
+        return pg
+
+    def _apply_decay(self, param, grad, lr):
+        """L2 regularization folded into the gradient (reference: optimizer
+        regularization append). AdamW overrides with decoupled decay."""
+        wd = self._weight_decay
+        if wd is None or isinstance(wd, str):
+            return grad
+        coeff = float(wd)
+        return grad + coeff * param._data
+
+    @no_grad()
+    def step(self):
+        pg = self._collect_params_grads()
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        lr = self.get_lr()
+        for p, g in pg:
+            if g is None:
+                continue
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            g = self._apply_decay(p, g, plr)
+            p._data = self._update(p, g, plr)
+        self._step_count += 1
+
+    def _update(self, param, grad, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class SGD(Optimizer):
+    """Reference: python/paddle/optimizer/sgd.py."""
+
+    def _update(self, param, grad, lr):
+        return param._data - lr * grad.astype(param._data.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, param, grad, lr):
+        v = self._acc(param, "velocity")
+        v = self._momentum * v + grad
+        self._set_acc(param, "velocity", v)
+        if self._nesterov:
+            return param._data - lr * (grad + self._momentum * v)
+        return param._data - lr * v
+
+
+class Adam(Optimizer):
+    """Reference: python/paddle/optimizer/adam.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update(self, param, grad, lr):
+        t = self._step_count + 1
+        g32 = grad.astype(jnp.float32)
+        m = self._acc(param, "moment1", jnp.zeros(param._data.shape, jnp.float32))
+        v = self._acc(param, "moment2", jnp.zeros(param._data.shape, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        self._set_acc(param, "moment1", m)
+        self._set_acc(param, "moment2", v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return (param._data.astype(jnp.float32) - upd).astype(param._data.dtype)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decay(self, param, grad, lr):
+        return grad  # decay applied decoupled in _update
+
+    def _update(self, param, grad, lr):
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(param.name):
+            decay = 0.0
+        out = super()._update(param, grad, lr)
+        if decay:
+            out = out - (lr * decay) * param._data.astype(out.dtype)
+        return out
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update(self, param, grad, lr):
+        acc = self._acc(param, "moment", jnp.full(param._data.shape, self._init_acc, jnp.float32))
+        acc = acc + jnp.square(grad.astype(jnp.float32))
+        self._set_acc(param, "moment", acc)
+        return (param._data.astype(jnp.float32) - lr * grad.astype(jnp.float32) / (jnp.sqrt(acc) + self._epsilon)).astype(param._data.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update(self, param, grad, lr):
+        g32 = grad.astype(jnp.float32)
+        ms = self._acc(param, "mean_square", jnp.zeros(param._data.shape, jnp.float32))
+        ms = self._rho * ms + (1 - self._rho) * jnp.square(g32)
+        self._set_acc(param, "mean_square", ms)
+        if self._centered:
+            mg = self._acc(param, "mean_grad", jnp.zeros(param._data.shape, jnp.float32))
+            mg = self._rho * mg + (1 - self._rho) * g32
+            self._set_acc(param, "mean_grad", mg)
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._acc(param, "momentum", jnp.zeros(param._data.shape, jnp.float32))
+        mom = self._momentum * mom + lr * g32 / denom
+        self._set_acc(param, "momentum", mom)
+        return (param._data.astype(jnp.float32) - mom).astype(param._data.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, param, grad, lr):
+        g32 = grad.astype(jnp.float32)
+        avg_sq = self._acc(param, "avg_squared_grad", jnp.zeros(param._data.shape, jnp.float32))
+        avg_upd = self._acc(param, "avg_squared_update", jnp.zeros(param._data.shape, jnp.float32))
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * jnp.square(g32)
+        update = -jnp.sqrt(avg_upd + self._epsilon) / jnp.sqrt(avg_sq + self._epsilon) * g32
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * jnp.square(update)
+        self._set_acc(param, "avg_squared_grad", avg_sq)
+        self._set_acc(param, "avg_squared_update", avg_upd)
+        return (param._data.astype(jnp.float32) + lr * update).astype(param._data.dtype)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, param, grad, lr):
+        t = self._step_count + 1
+        g32 = grad.astype(jnp.float32)
+        m = self._acc(param, "moment", jnp.zeros(param._data.shape, jnp.float32))
+        u = self._acc(param, "inf_norm", jnp.zeros(param._data.shape, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g32))
+        self._set_acc(param, "moment", m)
+        self._set_acc(param, "inf_norm", u)
+        return (param._data.astype(jnp.float32) - lr / (1 - self._beta1**t) * m / (u + self._epsilon)).astype(param._data.dtype)
+
+
+class Lamb(Optimizer):
+    """Reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, param, grad, lr):
+        t = self._step_count + 1
+        g32 = grad.astype(jnp.float32)
+        p32 = param._data.astype(jnp.float32)
+        m = self._acc(param, "moment1", jnp.zeros(param._data.shape, jnp.float32))
+        v = self._acc(param, "moment2", jnp.zeros(param._data.shape, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        self._set_acc(param, "moment1", m)
+        self._set_acc(param, "moment2", v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(param)) else self._lamb_wd
+        r = r + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(param._data.dtype)

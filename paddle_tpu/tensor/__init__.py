@@ -1,0 +1,130 @@
+"""Tensor method library.
+
+Analog of the reference's `python/paddle/tensor/*` (36k LoC of methods
+patched onto the pybind Tensor type): here each registered op whose first
+argument is a tensor is attached as a method, plus the in-place `op_`
+variants (functional rebinds under the hood — XLA arrays are immutable, so
+"in-place" means adopting the new buffer, with donation doing the real
+in-place optimization under jit).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, register_tensor_method
+from ..ops.dispatch import OPS
+
+# Ops that are NOT tensor methods (first arg isn't a tensor).
+_NON_METHODS = {
+    "zeros",
+    "ones",
+    "full",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "empty",
+    "meshgrid",
+    "tril_indices",
+    "triu_indices",
+    "randint",
+    "randperm",
+    "uniform",
+    "gaussian",
+    "complex",
+    "multi_dot",
+    "getitem",
+    "setitem",
+}
+
+# Paddle method-name aliases onto op names.
+_ALIASES = {
+    "mod": "remainder",
+    "floor_mod": "remainder",
+    "pow": "pow",
+    "matmul": "matmul",
+    "tolist": None,
+}
+
+
+def _install():
+    for name, api in OPS.items():
+        if name in _NON_METHODS or name.endswith("_"):
+            continue  # '_'-suffixed names are reserved for in-place rebinds below
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, api)
+    for alias, opname in _ALIASES.items():
+        if opname and not hasattr(Tensor, alias):
+            setattr(Tensor, alias, OPS[opname])
+
+    # In-place variants: value rebind (reference: inplace op variants x.add_()).
+    inplace_bases = [
+        "add",
+        "subtract",
+        "multiply",
+        "divide",
+        "remainder",
+        "pow",
+        "scale",
+        "clip",
+        "exp",
+        "sqrt",
+        "rsqrt",
+        "reciprocal",
+        "floor",
+        "ceil",
+        "round",
+        "abs",
+        "tanh",
+        "sigmoid",
+        "relu",
+        "erfinv",
+        "lerp",
+        "cast",
+        "flatten",
+        "squeeze",
+        "unsqueeze",
+        "reshape",
+        "masked_fill",
+        "index_add",
+    ]
+    for base in inplace_bases:
+        if base not in OPS:
+            continue
+
+        def _make(op):
+            def method(self, *args, **kwargs):
+                return self._rebind(op(self, *args, **kwargs))
+
+            return method
+
+        iname = base + "_"
+        if not hasattr(Tensor, iname):
+            setattr(Tensor, iname, _make(OPS[base]))
+
+    def zero_(self):
+        return self._rebind(OPS["zeros_like"](self))
+
+    def fill_(self, value):
+        return self._rebind(OPS["full_like"](self, value))
+
+    def normal_(self, mean=0.0, std=1.0):
+        return self._rebind(OPS["normal_like"](self, mean, std))
+
+    def uniform_(self, min=-1.0, max=1.0):
+        return self._rebind(OPS["uniform_random_like"](self, min, max))
+
+    def exponential_(self, lam=1.0):
+        return self._rebind(OPS["exponential_"](self, lam))
+
+    register_tensor_method("zero_", zero_)
+    register_tensor_method("fill_", fill_)
+    register_tensor_method("normal_", normal_)
+    register_tensor_method("uniform_", uniform_)
+    register_tensor_method("exponential_", exponential_)
+
+    # common paddle spellings
+    register_tensor_method("mm", OPS["matmul"])
+    register_tensor_method("t", lambda self: OPS["transpose"](self, list(range(self.ndim))[::-1]))
+    register_tensor_method("unsqueeze_", lambda self, axis: self._rebind(OPS["unsqueeze"](self, axis)))
+
+
+_install()

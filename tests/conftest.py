@@ -1,0 +1,21 @@
+"""Test config: force XLA-CPU with 8 virtual devices.
+
+This mirrors the reference's fake-backend strategy (SURVEY.md §4: the
+`custom_cpu` plugin lets the whole stack run without the accelerator): all
+tests run against XLA-CPU, with 8 virtual devices so multi-chip sharding
+paths are exercised on one host.
+
+NOTE: the axon sitecustomize imports jax at interpreter startup, so
+JAX_PLATFORMS env assignments made here are too late — jax.config.update is
+the reliable mechanism (XLA_FLAGS is still read lazily at CPU-client
+creation, so the env assignment works for the device count).
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,212 @@
+"""Autograd engine tests, modeled on the reference's gradient-check strategy
+(SURVEY.md §4: analytic vs numeric gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences, like op_test.py get_numeric_gradient."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 18.0 * x.numpy())
+
+
+def test_matmul_grad_numeric():
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((3, 4)).astype(np.float32)
+    b_np = rng.standard_normal((4, 2)).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    loss = paddle.matmul(a, b).sum()
+    loss.backward()
+    ng_a = numeric_grad(lambda v: float((v @ b_np).sum()), a_np)
+    np.testing.assert_allclose(a.grad.numpy(), ng_a, rtol=1e-2, atol=1e-2)
+    ng_b = numeric_grad(lambda v: float((a_np @ v).sum()), b_np)
+    np.testing.assert_allclose(b.grad.numpy(), ng_b, rtol=1e-2, atol=1e-2)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    (y1 + y2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])  # accumulated twice
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_blocks():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only direct path
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x * x).sum()
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2)
+    # .grad not populated by paddle.grad (only_inputs)
+    assert x.grad is None
+
+
+def test_grad_nonleaf_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = (y * y).sum()
+    (gy,) = paddle.grad([z], [y])
+    np.testing.assert_allclose(gy.numpy(), [4.0])
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad([y], [u])
+    y = (x * x).sum()  # graph was consumed by the failed call, rebuild
+    gx, gu = paddle.grad([y], [x, u], allow_unused=True)
+    assert gu is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_hook_on_leaf():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # hook doubled
+
+
+def test_hook_on_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    captured = []
+    y.register_hook(lambda g: captured.append(g.numpy()))
+    (y * 5).sum().backward()
+    assert captured and captured[0][0] == 5.0
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[4.0, 1.0], [2.0, 3.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, 1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    ((x + b) * 2).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [4.0, 4.0])  # reduced over broadcast
+
+
+def test_softmax_ce_grad():
+    logits = paddle.to_tensor(np.random.default_rng(1).standard_normal((4, 5)).astype(np.float32),
+                              stop_gradient=False)
+    labels = paddle.to_tensor([0, 1, 2, 3])
+    loss = paddle.nn.functional.cross_entropy(logits, labels)
+    loss.backward()
+    g = logits.grad.numpy()
+    assert g.shape == (4, 5)
+    np.testing.assert_allclose(g.sum(), 0.0, atol=1e-5)
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 1.5])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    assert x.grad is not None
+    x.clear_grad()
+    assert x.grad is None

@@ -1,0 +1,138 @@
+"""nn.Layer machinery + layer forward/backward smoke tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_forward_shape():
+    layer = nn.Linear(4, 8)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 8]
+    assert len(layer.parameters()) == 2
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    assert len(net.sublayers()) == 2
+    y = net(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    net = nn.Linear(3, 3)
+    sd = net.state_dict()
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_train_eval_mode():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    assert net.training
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([4, 2])
+    y1 = net(x)
+    y2 = net(x)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())  # dropout off in eval
+
+
+def test_conv_pool_shapes():
+    x = paddle.randn([2, 3, 16, 16])
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    pool = nn.MaxPool2D(2, 2)
+    assert pool(y).shape == [2, 8, 8, 8]
+    ap = nn.AdaptiveAvgPool2D(1)
+    assert ap(y).shape == [2, 8, 1, 1]
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5]) * 2.0 + 3.0
+    before = bn._mean.numpy().copy()
+    bn(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    y = ln(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([[1, 2], [0, 3]])
+    y = emb(idx)
+    assert y.shape == [2, 2, 4]
+    np.testing.assert_allclose(y.numpy()[1, 0], np.zeros(4))
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+    assert len(seq) == 3
+    y = seq(paddle.randn([5, 2]))
+    assert y.shape == [5, 1]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll.parameters()) == 8
+
+
+def test_losses():
+    x = paddle.randn([4, 3])
+    lbl = paddle.to_tensor([0, 1, 2, 0])
+    loss = nn.CrossEntropyLoss()(x, lbl)
+    assert loss.shape == []
+    mse = nn.MSELoss()(paddle.ones([3]), paddle.zeros([3]))
+    np.testing.assert_allclose(mse.numpy(), 1.0)
+    l1 = nn.L1Loss()(paddle.ones([3]), paddle.zeros([3]))
+    np.testing.assert_allclose(l1.numpy(), 1.0)
+
+
+def test_layer_to_dtype():
+    net = nn.Linear(2, 2)
+    net.to(dtype="bfloat16")
+    assert net.weight.dtype == "bfloat16"
+
+
+def test_forward_hooks():
+    net = nn.Linear(2, 2)
+    calls = []
+    h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    net(paddle.ones([1, 2]))
+    assert calls
+    h.remove()
+    net(paddle.ones([1, 2]))
+    assert len(calls) == 1
+
+
+def test_grad_flows_through_layer():
+    net = nn.Linear(3, 1)
+    x = paddle.randn([4, 3])
+    loss = net(x).sum()
+    loss.backward()
+    assert net.weight.grad is not None
+    assert net.weight.grad.shape == [3, 1]

@@ -1,0 +1,129 @@
+"""Optimizer + LR scheduler tests, incl. a LeNet end-to-end convergence run
+(BASELINE config 1 slice: MNIST-style dygraph training on synthetic data)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _quadratic_step(opt_cls, **kw):
+    w = paddle.to_tensor([5.0], stop_gradient=False)
+    w.name = "w0"
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(50):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return abs(float(w.numpy()[0]))
+
+
+def test_sgd_converges():
+    assert _quadratic_step(optimizer.SGD, learning_rate=0.1) < 0.1
+
+
+def test_momentum_converges():
+    assert _quadratic_step(optimizer.Momentum, learning_rate=0.05, momentum=0.9) < 0.5
+
+
+def test_adam_converges():
+    assert _quadratic_step(optimizer.Adam, learning_rate=0.3) < 0.5
+
+
+def test_adamw_decay():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w1"
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=[w], weight_decay=0.5)
+    loss = (w * 0.0).sum()
+    loss.backward()
+    opt.step()
+    assert float(w.numpy()[0]) < 1.0  # decayed even with zero grad
+
+
+def test_grad_clip_global_norm():
+    w = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    w.name = "w2"
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    (w * w).sum().backward()  # grad = [6, 8], norm 10
+    opt.step()
+    # clipped grad = [0.6, 0.8]
+    np.testing.assert_allclose(w.numpy(), [3.0 - 0.6, 4.0 - 0.8], rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w3"
+    opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_cosine_schedule():
+    sched = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    vals = []
+    for _ in range(10):
+        vals.append(sched())
+        sched.step()
+    assert vals[0] == 1.0
+    assert vals[-1] < 0.1
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "p"
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        opt2._accumulators["p"]["moment1"], opt._accumulators["p"]["moment1"]
+    )
+
+
+class LeNet(nn.Layer):
+    """BASELINE config 1 model (reference: python/paddle/vision/models/lenet.py)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+        )
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.Linear(120, 84), nn.Linear(84, num_classes)
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = paddle.flatten(x, 1)
+        return self.fc(x)
+
+
+def test_lenet_training_loss_decreases():
+    paddle.seed(0)
+    net = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, 16))
+    losses = []
+    for _ in range(8):
+        out = net(x)
+        loss = loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses

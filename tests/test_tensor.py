@@ -1,0 +1,157 @@
+"""Tensor basics: creation, properties, arithmetic, indexing, conversion."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.dtype == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_rules():
+    assert paddle.to_tensor(1).dtype == "int64"
+    assert paddle.to_tensor(1.5).dtype == "float32"
+    assert paddle.to_tensor(True).dtype == "bool"
+    assert paddle.to_tensor(np.zeros(3, np.float64)).dtype == "float32"  # default dtype coercion
+    assert paddle.to_tensor([1], dtype="float64").dtype == "float64"
+
+
+def test_arithmetic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * 2).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x**2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_comparison_returns_tensor():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([2.0, 2.0])
+    eq = x == y
+    assert eq.dtype == "bool"
+    np.testing.assert_array_equal(eq.numpy(), [False, True])
+    assert bool((x < y)[0])
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = a @ b
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(x[0].numpy(), np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(x[:, 1].numpy(), x.numpy()[:, 1])
+    np.testing.assert_allclose(x[0, 1, 2].numpy(), 6.0)
+    np.testing.assert_allclose(x[..., -1].numpy(), x.numpy()[..., -1])
+    np.testing.assert_allclose(x[None].shape, [1, 2, 3, 4])
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 7.0
+    assert x.numpy()[0, 0] == 7
+
+
+def test_methods():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.reshape([3, 2]).shape == [3, 2]
+    assert x.transpose([1, 0]).shape == [3, 2]
+    assert x.T.shape == [3, 2]
+    assert x.sum().item() == 15.0
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 5.0
+    assert x.astype("int32").dtype == "int32"
+    assert x.numel() == 6
+    assert x.ndim == 2
+
+
+def test_inplace_methods():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0, 0])
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient  # clone tracks grad
+
+
+def test_cast_item_repr():
+    x = paddle.to_tensor([1.5])
+    assert isinstance(repr(x), str)
+    assert x.item() == 1.5
+    assert int(paddle.to_tensor([3])) == 3
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2]).dtype == "float32"
+    assert paddle.full([2], 7).dtype == "int64"
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.arange(5).dtype == "int64"
+    assert paddle.eye(3).shape == [3, 3]
+    assert paddle.rand([4]).shape == [4]
+    assert paddle.randn([4]).dtype == "float32"
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    assert paddle.linspace(0, 1, 5).shape == [5]
+
+
+def test_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([8]).numpy()
+    paddle.seed(42)
+    b = paddle.rand([8]).numpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_concat_split_stack():
+    x = paddle.ones([2, 3])
+    y = paddle.zeros([2, 3])
+    c = paddle.concat([x, y], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([x, y])
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    parts = paddle.split(c, [1, 3], axis=0)
+    assert parts[1].shape == [3, 3]
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_array_equal(i.numpy(), [0, 2])
+    s = paddle.sort(x)
+    np.testing.assert_allclose(s.numpy(), [1, 2, 3])
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [3, 0, 2])
+
+
+def test_bool_scalar_errors():
+    x = paddle.ones([2])
+    with pytest.raises(ValueError):
+        bool(x)
