@@ -142,5 +142,16 @@ def synchronize():
 from . import autograd  # noqa: F401, E402
 from . import nn  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402
+from . import jit  # noqa: F401, E402
+from . import amp  # noqa: F401, E402
+from . import io  # noqa: F401, E402
+from . import metric  # noqa: F401, E402
+from . import static  # noqa: F401, E402
+from . import vision  # noqa: F401, E402
+from . import distributed  # noqa: F401, E402
+from . import framework  # noqa: F401, E402
+from .framework.io_api import load, save  # noqa: F401, E402
+from .hapi.model import Model  # noqa: F401, E402
+from . import hapi  # noqa: F401, E402
 
 __version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""Automatic mixed precision.
+
+Reference analog: python/paddle/amp (auto_cast :1029, GradScaler
+grad_scaler.py:657, O1/O2 lists amp_lists.py) + the eager autocast insertion
+(`paddle/fluid/eager/amp_auto_cast.h`). TPU-first policy (SURVEY.md §7):
+bf16 by default — no loss scaling needed — with the GradScaler API kept
+fully compatible (it scales for float16, passes through for bfloat16).
+The cast insertion hooks the eager dispatcher exactly where the reference
+generates AMP casts into `*_ad_func`.
+"""
+from .auto_cast import amp_guard, amp_pre_dispatch, auto_cast, black_list, decorate, white_list  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from ..ops import dispatch as _dispatch
+
+_dispatch.set_amp_hook(amp_pre_dispatch)

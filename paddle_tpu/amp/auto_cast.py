@@ -1,0 +1,134 @@
+"""auto_cast: per-op dtype policy applied in the eager dispatcher.
+
+Reference: python/paddle/amp/auto_cast.py:1029 + amp_lists.py (O1 white/black
+lists) + the generated cast insertion in eager `*_ad_func` (amp_auto_cast.h).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from ..ops import dispatch
+
+# O1 lists (reference: python/paddle/amp/amp_lists.py WHITE_LIST/BLACK_LIST,
+# adapted to this framework's op names). White → run in low precision;
+# black → force float32; everything else runs in whatever dtype arrives.
+white_list = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "addmm", "scaled_dot_product_attention",
+}
+black_list = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "reciprocal", "rsqrt", "softmax_with_cross_entropy", "nll_loss",
+    "bce_with_logits", "kl_div", "cross_entropy", "logsumexp", "log_softmax",
+    "cumsum", "cumprod", "norm", "p_norm", "var", "std",
+    "sum" , "mean",
+    "layer_norm", "rms_norm", "batch_norm_train", "batch_norm_infer",
+    "group_norm", "instance_norm", "softmax",
+}
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def _current():
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def amp_state():
+    return _current()
+
+
+def _cast_tensors_in(args, kwargs, to_np_dtype):
+    import jax
+
+    def cast(x):
+        if isinstance(x, Tensor) and dtype_mod.is_floating_dtype(x._data.dtype):
+            if x._data.dtype != to_np_dtype:
+                return dispatch.OPS["cast"](x, dtype_mod.from_jax(to_np_dtype))
+        return x
+
+    args2 = jax.tree.map(cast, args, is_leaf=lambda v: isinstance(v, Tensor))
+    kwargs2 = jax.tree.map(cast, kwargs, is_leaf=lambda v: isinstance(v, Tensor))
+    return args2, kwargs2
+
+
+_EXEMPT = {"cast", "assign", "getitem", "setitem", "zeros_like", "ones_like", "full_like"}
+
+
+def amp_pre_dispatch(op_name, args, kwargs):
+    """Called by the dispatcher before running an op (the AMP cast hook)."""
+    st = _current()
+    if st is None or not st.enable or op_name in _EXEMPT:
+        return args, kwargs
+    if op_name in st.white:
+        return _cast_tensors_in(args, kwargs, dtype_mod.to_np(st.dtype))
+    if op_name in st.black:
+        return _cast_tensors_in(args, kwargs, np.dtype(np.float32))
+    if st.level == "O2":
+        return _cast_tensors_in(args, kwargs, dtype_mod.to_np(st.dtype))
+    return args, kwargs
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level should be O0/O1/O2, got {level}")
+    if dtype not in ("float16", "bfloat16"):
+        raise ValueError(f"amp dtype must be float16 or bfloat16, got {dtype}")
+    white = set(white_list) | set(custom_white_list or ())
+    black = (set(black_list) | set(custom_black_list or ())) - set(custom_white_list or ())
+    st = _AmpState(enable and level != "O0", dtype, level, white, black)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(st)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """paddle.amp.decorate parity (reference: auto_cast.py:1114): cast model
+    params to the amp dtype for O2 (pure low-precision) training."""
+    from ..nn.layer.layers import Layer
+
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        excluded = tuple(excluded_layers or ())
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+        keep_fp32 = (_BatchNormBase, LayerNorm) + excluded
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, keep_fp32):
+                    continue
+                for _, p in layer._parameters.items():
+                    if p is not None and dtype_mod.is_floating_dtype(p._data.dtype):
+                        p._data = p._data.astype(dtype_mod.to_np(dtype))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

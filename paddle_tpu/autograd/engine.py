@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+from ..core.dtype import is_inexact_dtype
 
 _node_counter = itertools.count()
 
@@ -27,7 +28,7 @@ def _is_float0(g) -> bool:
 
 def _zeros_like_aval(aval):
     shape, dtype = aval
-    if np.issubdtype(np.dtype(dtype), np.inexact):
+    if is_inexact_dtype(dtype):
         import jax.numpy as jnp
 
         return jnp.zeros(shape, dtype)
@@ -122,12 +123,16 @@ def run_backward(
     for t, g in zip(tensors, grad_tensors):
         garr = g._data if hasattr(g, "_data") else g
         if garr is None:
-            if not np.issubdtype(np.dtype(t._data.dtype), np.inexact) or t._data.size != 1:
-                if t._data.size != 1:
-                    raise RuntimeError(
-                        "grad can be implicitly created only for scalar outputs; "
-                        f"got shape {t.shape}"
-                    )
+            if not is_inexact_dtype(t._data.dtype):
+                raise RuntimeError(
+                    "grad can be implicitly created only for floating-point scalar "
+                    f"outputs; got dtype {t._data.dtype}"
+                )
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
             garr = jnp.ones(t._data.shape, t._data.dtype)
         node = t._grad_node
         if node is None:

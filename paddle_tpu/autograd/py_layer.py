@@ -7,6 +7,7 @@ user's static backward method.
 from __future__ import annotations
 
 import jax
+from ..core.dtype import is_inexact_dtype
 
 from ..core.tensor import Tensor
 from ..ops import dispatch
@@ -100,7 +101,7 @@ class PyLayer(metaclass=PyLayerMeta):
             import numpy as np
 
             for i, t in enumerate(out_list):
-                if np.issubdtype(np.dtype(t._data.dtype), np.inexact):
+                if is_inexact_dtype(t._data.dtype):
                     t._grad_node = node
                     t._out_index = i
                     t.stop_gradient = False

@@ -148,5 +148,20 @@ def to_np(d) -> np.dtype:
     return DType(d).np_dtype
 
 
+def is_floating_dtype(dt) -> bool:
+    """True for float dtypes INCLUDING bfloat16 (np.issubdtype says False for
+    ml_dtypes.bfloat16 — use this helper everywhere instead)."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(np.dtype(dt), jnp.floating))
+
+
+def is_inexact_dtype(dt) -> bool:
+    """Float or complex, bfloat16-aware."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(np.dtype(dt), jnp.inexact))
+
+
 def from_jax(jd) -> DType:
     return DType(np.dtype(jd).name if np.dtype(jd).name != "bfloat16" else "bfloat16")

@@ -1,0 +1,7 @@
+"""paddle.distributed parity — TPU-native (SURVEY.md §2.5).
+
+Collectives become XLA HLO ops over ICI/DCN; the ProcessGroup/fleet surface
+is a mesh/axis registry (M5-M6 build-out; env discovery lands first).
+"""
+from . import env  # noqa: F401
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

@@ -1,0 +1,56 @@
+"""Distributed environment: rank/world discovery.
+
+Reference analog: ParallelEnv (python/paddle/distributed/parallel.py) reading
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launcher. On TPU the same
+variables are honored, and under a multi-host PJRT runtime jax.process_index
+is the ground truth.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank

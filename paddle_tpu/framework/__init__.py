@@ -1,0 +1,3 @@
+"""Framework-level utilities (reference: python/paddle/framework)."""
+from ..core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .io_api import load, save  # noqa: F401

@@ -1,0 +1,57 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:773 (save) /:1020 (load) — pickle of
+state_dict-like nested containers with tensors converted to numpy. Same
+format idea here: portable numpy payloads, Tensors restored on load.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_SENTINEL = "__paddle_tpu_tensor__"
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return {_SENTINEL: True, "data": np.asarray(obj._data), "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _unpack(payload, return_numpy=return_numpy)

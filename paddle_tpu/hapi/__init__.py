@@ -1,0 +1,3 @@
+"""High-level API (reference: python/paddle/hapi)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,166 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """Prints loss/metrics/ips per log_freq steps (reference: callbacks.py
+    ProgBarLogger; ips/batch_cost instrumentation per profiler/timer.py)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+        self._samples = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._samples += logs.get("batch_size", 0)
+        if self.verbose and step % self.log_freq == 0:
+            dt = time.time() - self._t0
+            ips = self._samples / dt if dt > 0 else 0.0
+            items = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, (int, float)) else f"{k}: {v}"
+                for k, v in logs.items()
+                if k not in ("batch_size",)
+            )
+            print(f"Epoch {self.epoch} step {step}/{self.steps}: {items} - ips: {ips:.1f}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            items = " - ".join(
+                f"{k}: {v}" for k, v in logs.items() if k != "batch_size"
+            )
+            print(f"Eval: {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1, min_delta=0,
+                 baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.wait = 0
+        self.best = None
+        self.stopped_epoch = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.asarray(cur).reshape(-1)[0])
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if not self.by_step:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        from ..optimizer.lr import LRScheduler
+
+        if opt is not None and isinstance(opt._learning_rate, LRScheduler):
+            opt._learning_rate.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.by_epoch:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        from ..optimizer.lr import LRScheduler
+
+        if opt is not None and isinstance(opt._learning_rate, LRScheduler):
+            opt._learning_rate.step()
+
+
+LRScheduler = LRSchedulerCallback

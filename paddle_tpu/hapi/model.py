@@ -1,0 +1,279 @@
+"""paddle.Model — the high-level training API.
+
+Reference: python/paddle/hapi/model.py:1472 (`Model.fit/evaluate/predict`).
+The network runs through `paddle.jit.to_static` so every train step is one
+cached XLA executable pair; ips/batch_cost instrumentation matches the
+reference's timer (profiler/timer.py) for BASELINE measurement.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        return self
+
+    # -- single-batch ops -----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        losses = self._compute_loss(outputs, labels)
+        losses.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(losses)], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..ops.dispatch import no_grad
+
+        with no_grad():
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+            losses = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [float(losses)], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..ops.dispatch import no_grad
+
+        with no_grad():
+            out = self.network(*[_as_tensor(x) for x in inputs])
+        return out
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs if isinstance(outputs, Tensor) else outputs[0]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        labels = [_as_tensor(l) for l in labels if l is not None]
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*outs, *labels)
+        return loss
+
+    def _update_metrics(self, outputs, labels):
+        results = {}
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        labels = [l for l in labels if l is not None]
+        for m in self._metrics:
+            computed = m.compute(*outs, *labels)
+            if not isinstance(computed, (list, tuple)):
+                computed = [computed]
+            r = m.update(*computed)
+            names = m.name()
+            names = names if isinstance(names, list) else [names]
+            vals = r if isinstance(r, list) else [r]
+            for n, v in zip(names, vals):
+                results[n] = v
+        return results
+
+    # -- loops ----------------------------------------------------------------
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size=1,
+        epochs=1,
+        eval_freq=1,
+        log_freq=10,
+        save_dir=None,
+        save_freq=1,
+        verbose=2,
+        drop_last=False,
+        shuffle=True,
+        num_workers=0,
+        callbacks=None,
+        **kwargs,
+    ):
+        train_loader = _as_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False, num_workers) if eval_data is not None else None
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(cb_mod.ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            cbs.append(cb_mod.ModelCheckpoint(save_freq, save_dir))
+        for c in cbs:
+            c.set_model(self)
+            c.set_params({"epochs": epochs, "steps": len(train_loader), "verbose": verbose})
+        self.stop_training = False
+        for c in cbs:
+            c.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            t0 = time.time()
+            for step, batch in enumerate(train_loader):
+                for c in cbs:
+                    c.on_train_batch_begin(step)
+                inputs, labels = _split_batch(batch)
+                losses, metrics = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0], **metrics,
+                        "batch_size": _batch_len(inputs),
+                        "batch_cost": (time.time() - t0) / (step + 1)}
+                for c in cbs:
+                    c.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if eval_loader is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                self._run_eval(eval_loader, cbs)
+            if self.stop_training:
+                break
+        for c in cbs:
+            c.on_train_end(logs)
+        return history
+
+    def _run_eval(self, loader, cbs):
+        for c in cbs:
+            c.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            for c in cbs:
+                c.on_eval_batch_begin(step)
+            inputs, labels = _split_batch(batch)
+            losses, metrics = self.eval_batch(inputs, labels)
+            total_loss += losses[0]
+            n += 1
+            for c in cbs:
+                c.on_eval_batch_end(step, {"loss": losses[0], **metrics})
+        logs = {"loss": total_loss / max(n, 1)}
+        for m in self._metrics:
+            names = m.name()
+            names = names if isinstance(names, list) else [names]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            logs.update(dict(zip(names, vals)))
+        for c in cbs:
+            c.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, **kwargs):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(cb_mod.ProgBarLogger(log_freq, verbose))
+        for c in cbs:
+            c.set_model(self)
+            c.set_params({"steps": len(loader), "verbose": verbose})
+        return self._run_eval(loader, cbs)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None, **kwargs):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            # when a loss was prepared, datasets yield (inputs..., label): drop it
+            inputs, _ = _split_batch(batch, has_labels=self._loss is not None)
+            out = self.predict_batch(inputs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            outputs.append([np.asarray(o._data) for o in outs])
+        n_out = len(outputs[0])
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_api import save as fw_save
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fw_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fw_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_api import load as fw_load
+
+        state = fw_load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fw_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [repr(self.network)]
+        n_params = sum(p.size for p in self.network.parameters())
+        lines.append(f"Total params: {n_params}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x)
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if has_labels and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), None
+    return [batch], None
+
+
+def _batch_len(inputs):
+    try:
+        first = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        return len(first)
+    except Exception:
+        return 0

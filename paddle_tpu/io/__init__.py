@@ -1,0 +1,18 @@
+"""paddle.io-compatible API (reference: python/paddle/io)."""
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataset import (  # noqa: F401
+    BatchSampler,
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    Subset,
+    TensorDataset,
+    WeightedRandomSampler,
+    random_split,
+)

@@ -1,0 +1,301 @@
+"""DataLoader: multiprocess input pipeline with device prefetch.
+
+Reference analog: `python/paddle/io/reader.py:262` DataLoader +
+`dataloader_iter.py` single/multi-process iterators (worker procs, blocking
+queue, pinned-buffer double-buffering into the device). The TPU-native
+version keeps the worker-pool design but stages batches into HBM with async
+PJRT host→device transfers, double-buffered by a background thread
+(SURVEY.md §7 table: "same worker-pool design, staging into HBM").
+Workers produce numpy (no device context in children); the parent does the
+device placement.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import os
+import queue
+import threading
+import traceback
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import BatchSampler, Dataset, IterableDataset
+
+
+def default_collate_fn(batch):
+    """Reference: python/paddle/io/dataloader/collate.py."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items)) for items in zip(*batch))
+    return np.asarray(batch)
+
+
+def _to_device(collated):
+    if isinstance(collated, np.ndarray):
+        return Tensor(collated)
+    if isinstance(collated, dict):
+        return {k: _to_device(v) for k, v in collated.items()}
+    if isinstance(collated, (list, tuple)):
+        return type(collated)(_to_device(v) for v in collated)
+    return collated
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn, worker_id):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((batch_id, collate_fn(samples), None))
+        except Exception:
+            data_queue.put((batch_id, None, traceback.format_exc()))
+
+
+class _MultiProcessIter:
+    """Reference analog: _DataLoaderIterMultiProcess (dataloader_iter.py:~400)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._batches = list(loader.batch_sampler)
+        self._num_workers = loader.num_workers
+        self._collate = loader.collate_fn or default_collate_fn
+        # spawn, not fork: the parent holds the multithreaded JAX/PJRT runtime
+        # and fork() of a thread-holding process can deadlock in the child
+        ctx = mp.get_context("spawn")
+        self._index_queues = [ctx.SimpleQueue() for _ in range(self._num_workers)]
+        self._data_queue = ctx.Queue()
+        self._workers = []
+        # Workers are numpy-only: force XLA-CPU and strip accelerator-plugin env
+        # so child interpreters never touch the device/tunnel at startup.
+        scrubbed = {"JAX_PLATFORMS": "cpu"}
+        removed = [k for k in os.environ if k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))]
+        saved = {k: os.environ.get(k) for k in list(scrubbed) + removed}
+        try:
+            os.environ.update(scrubbed)
+            for k in removed:
+                os.environ.pop(k, None)
+            for wid in range(self._num_workers):
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(loader.dataset, self._index_queues[wid], self._data_queue,
+                          self._collate, loader.worker_init_fn, wid),
+                    daemon=True,
+                )
+                w.start()
+                self._workers.append(w)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        self._send_idx = 0
+        self._rcv_buffer = {}
+        self._next_batch = 0
+        self._prefetch_depth = max(2 * self._num_workers, 2)
+        for _ in range(min(self._prefetch_depth, len(self._batches))):
+            self._dispatch()
+        self._shutdown = False
+
+    def _dispatch(self):
+        if self._send_idx < len(self._batches):
+            wid = self._send_idx % self._num_workers
+            self._index_queues[wid].put((self._send_idx, self._batches[self._send_idx]))
+            self._send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_batch >= len(self._batches):
+            self._teardown()
+            raise StopIteration
+        while self._next_batch not in self._rcv_buffer:
+            try:
+                batch_id, data, err = self._data_queue.get(timeout=5.0)
+            except queue.Empty:
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._teardown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) exited unexpectedly (exitcodes "
+                        f"{[w.exitcode for w in dead]})"
+                    )
+                continue
+            if err is not None:
+                self._teardown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self._rcv_buffer[batch_id] = data
+        data = self._rcv_buffer.pop(self._next_batch)
+        self._next_batch += 1
+        self._dispatch()
+        out = _to_device(data)
+        return out
+
+    def _teardown(self):
+        if getattr(self, "_shutdown", False):
+            return
+        self._shutdown = True
+        for q in self._index_queues:
+            q.put(None)
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        try:
+            self._teardown()
+        except Exception:
+            pass
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._collate = loader.collate_fn or default_collate_fn
+        self._batch_iter = iter(loader.batch_sampler)
+        # double-buffer: prefetch the next device batch while the current one
+        # is being consumed (the reference's create_py_reader double buffering)
+        self._buffer: "queue.Queue" = queue.Queue(maxsize=loader.prefetch_factor)
+        self._done = object()
+        self._stop = False
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop:
+            try:
+                self._buffer.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            for indices in self._batch_iter:
+                if self._stop:
+                    return
+                samples = [self._loader.dataset[i] for i in indices]
+                if not self._put(_to_device(self._collate(samples))):
+                    return
+            self._put(self._done)
+        except Exception:
+            self._put(RuntimeError(traceback.format_exc()))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._buffer.get()
+        if item is self._done:
+            raise StopIteration
+        if isinstance(item, RuntimeError):
+            raise item
+        return item
+
+    def close(self):
+        # unblock the producer so abandoned iterators don't pin device batches
+        self._stop = True
+        try:
+            while True:
+                self._buffer.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._collate = loader.collate_fn or default_collate_fn
+        self._it = iter(loader.dataset)
+        self._batch_size = loader.batch_size
+        self._drop_last = loader.drop_last
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = list(itertools.islice(self._it, self._batch_size))
+        if not batch or (self._drop_last and len(batch) < self._batch_size):
+            raise StopIteration
+        return _to_device(self._collate(batch))
+
+
+class DataLoader:
+    """Reference: python/paddle/io/reader.py:262."""
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        if self._is_iterable:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __iter__(self):
+        if self._is_iterable:
+            return _IterableDatasetIter(self)
+        if self.num_workers > 0:
+            return _MultiProcessIter(self)
+        return _SingleProcessIter(self)
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,291 @@
+"""paddle.jit.to_static — dynamic-to-static graph capture.
+
+TPU-native redesign of the reference's dy2static stack (SURVEY.md CS4:
+SOT bytecode capture → PIR partial program → PirInterpreter). Here the
+capture is trace-based: the decorated function runs once under `jax.jit`
+tracing (our eager ops are jax-traceable), producing ONE cached XLA
+executable per input signature — the role the reference splits across
+`pir_partial_program.py`, `PdOpLowerToKernelPass` and CINN is played
+entirely by XLA. Backward is a second cached executable computing the
+whole-program vjp (reference analog: the appended-backward program), and
+the pair plugs into the eager tape as a single GradNode, so
+``loss.backward()`` after a to_static forward works unchanged.
+
+Mutable layer state (BatchNorm running stats) is functionalized: buffers
+are inputs and their updated values are extra outputs, written back after
+each call. Randomness is threaded as an explicit PRNG-key input
+(`rng.scoped_rng_key`), so dropout masks differ per step under jit.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod, rng
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+from ..ops import dispatch
+from ..autograd.engine import GradNode
+
+_tls = threading.local()
+
+
+def in_to_static_trace() -> bool:
+    return getattr(_tls, "tracing", 0) > 0
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype_mod.DType(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class _CacheEntry:
+    __slots__ = ("fwd", "bwd", "out_meta")
+
+    def __init__(self, fwd, bwd):
+        self.fwd = fwd
+        self.bwd = bwd
+
+
+class StaticFunction:
+    """The compiled wrapper (reference analog: dy2static StaticFunction,
+    python/paddle/jit/dy2static/program_translator.py)."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Any, _CacheEntry] = {}
+        functools.update_wrapper(self, fn)
+
+    # descriptor protocol: @to_static on a class method
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction.__new__(StaticFunction)
+        bound._fn = self._fn.__get__(instance, owner)
+        bound._layer = instance if isinstance(instance, Layer) else self._layer
+        bound._input_spec = self._input_spec
+        bound._cache = self._cache  # share across binds of same instance? keyed by id below
+        return bound
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _named_state(layer):
+        if layer is None:
+            return [], []
+        params = list(layer.named_parameters())
+        buffers = [(n, b) for n, b in layer.named_buffers() if b is not None]
+        return params, buffers
+
+    @staticmethod
+    def _training_sig(layer):
+        if layer is None:
+            return True
+        return tuple(l.training for l in layer.sublayers(include_self=True))
+
+    def _signature(self, flat_in, treedef, layer):
+        avals = tuple(
+            (tuple(l._data.shape), str(l._data.dtype), not l.stop_gradient)
+            if isinstance(l, Tensor)
+            else ("py", repr(l))
+            for l in flat_in
+        )
+        return (treedef, avals, self._training_sig(layer), id(layer))
+
+    def _build(self, treedef, const_leaves, tensor_slots, layer):
+        params, buffers = self._named_state(layer)
+        param_objs = [p for _, p in params]
+        buffer_objs = [b for _, b in buffers]
+        fn = self._fn
+        if layer is not None and getattr(fn, "__self__", None) is None:
+            # unbound Layer.forward used with an explicit layer argument
+            fn = self._fn.__get__(layer, type(layer))
+
+        def kernel(key_data, param_arrays, buffer_arrays, input_arrays):
+            # Swap tracer arrays into the layer state for the duration of the
+            # trace, run the python fn, and functionalize buffer mutations.
+            snap_p = [p._data for p in param_objs]
+            snap_b = [b._data for b in buffer_objs]
+            snap_sg = [p.stop_gradient for p in param_objs]
+            _tls.tracing = getattr(_tls, "tracing", 0) + 1
+            try:
+                for p, arr in zip(param_objs, param_arrays):
+                    p._data = arr
+                for b, arr in zip(buffer_objs, buffer_arrays):
+                    b._data = arr
+                leaves = list(const_leaves)
+                ti = 0
+                for slot in tensor_slots:
+                    leaves[slot] = Tensor._from_data(input_arrays[ti])
+                    ti += 1
+                args2, kw2 = jax.tree.unflatten(treedef, leaves)
+                with rng.scoped_rng_key(key_data), dispatch.no_grad():
+                    out = fn(*args2, **kw2)
+                out_arrays = jax.tree.map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=_is_tensor,
+                )
+                new_buffers = [b._data for b in buffer_objs]
+                return out_arrays, new_buffers
+            finally:
+                _tls.tracing -= 1
+                for p, arr, sg in zip(param_objs, snap_p, snap_sg):
+                    p._data = arr
+                    p.stop_gradient = sg
+                for b, arr in zip(buffer_objs, snap_b):
+                    b._data = arr
+
+        fwd = jax.jit(kernel)
+
+        def bwd(cots, key_data, param_arrays, buffer_arrays, input_arrays):
+            def fwd_only(pa, ia):
+                out, _ = kernel(key_data, pa, buffer_arrays, ia)
+                return out
+
+            _, vjp_fn = jax.vjp(fwd_only, param_arrays, input_arrays)
+            return vjp_fn(cots)
+
+        return _CacheEntry(fwd, jax.jit(bwd))
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        if layer is None and args and isinstance(args[0], Layer):
+            # to_static applied to an unbound Layer.forward: the layer is
+            # call-scoped (NOT bound permanently — each instance gets its own
+            # cached programs via id(layer) in the signature)
+            layer = args[0]
+            args = args[1:]
+        if in_to_static_trace():
+            return self._fn(*args, **kwargs)
+
+        # kwargs participate in the trace like args: Tensor kwargs become real
+        # executable inputs, python-value kwargs become baked consts in the key
+        flat_in, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+        tensor_slots = [i for i, l in enumerate(flat_in) if isinstance(l, Tensor)]
+        input_tensors = [flat_in[i] for i in tensor_slots]
+        const_leaves = [None if i in tensor_slots else l for i, l in enumerate(flat_in)]
+        sig = self._signature(flat_in, treedef, layer)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build(treedef, const_leaves, tensor_slots, layer)
+            self._cache[sig] = entry
+
+        params, buffers = self._named_state(layer)
+        param_objs = [p for _, p in params]
+        buffer_objs = [b for _, b in buffers]
+        param_arrays = [p._data for p in param_objs]
+        buffer_arrays = [b._data for b in buffer_objs]
+        input_arrays = [t._data for t in input_tensors]
+        key_data = jax.random.key_data(rng.next_key())
+
+        out_arrays, new_buffers = entry.fwd(key_data, param_arrays, buffer_arrays, input_arrays)
+        # write back functionalized buffer updates (BN running stats etc.)
+        for b, arr in zip(buffer_objs, new_buffers):
+            b._data = arr
+
+        out_leaves, out_treedef = jax.tree.flatten(out_arrays)
+        needs_grad = dispatch.is_grad_enabled() and (
+            any(p.trainable and not p.stop_gradient for p in param_objs)
+            or any(not t.stop_gradient for t in input_tensors)
+        )
+        if not needs_grad:
+            outs = [Tensor._from_data(a) for a in out_leaves]
+            return jax.tree.unflatten(out_treedef, outs)
+
+        edges = []
+        for p in param_objs:
+            if p.trainable and not p.stop_gradient:
+                if p._grad_node is not None:
+                    edges.append(("node", p._grad_node, p._out_index))
+                else:
+                    edges.append(("leaf", p))
+            else:
+                edges.append(None)
+        for t in input_tensors:
+            if not t.stop_gradient or t._grad_node is not None:
+                if t._grad_node is not None:
+                    edges.append(("node", t._grad_node, t._out_index))
+                else:
+                    edges.append(("leaf", t))
+            else:
+                edges.append(None)
+
+        bwd_exec = entry.bwd
+
+        def vjp_fn(cot_tree):
+            gp, gi = bwd_exec(cot_tree, key_data, param_arrays, buffer_arrays, input_arrays)
+            return list(gp) + list(gi)
+
+        node = GradNode(
+            f"to_static[{getattr(self._fn, '__name__', 'fn')}]",
+            vjp_fn,
+            [(tuple(o.shape), o.dtype) for o in out_leaves],
+            out_treedef,
+            edges,
+        )
+        outs = []
+        for i, a in enumerate(out_leaves):
+            t = Tensor._from_data(a)
+            if dtype_mod.is_inexact_dtype(a.dtype):
+                t._grad_node = node
+                t._out_index = i
+                t.stop_gradient = False
+            outs.append(t)
+        return jax.tree.unflatten(out_treedef, outs)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def concrete_programs(self):
+        return list(self._cache)
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """``paddle.jit.to_static`` parity (reference: python/paddle/jit/api.py:197)."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, layer=obj, input_spec=input_spec)
+            obj.forward = static
+            return obj
+        if isinstance(obj, StaticFunction):
+            return obj
+        layer = getattr(obj, "__self__", None)
+        return StaticFunction(obj, layer=layer if isinstance(layer, Layer) else None,
+                              input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    """Marker: run this function eagerly inside to_static regions. With
+    trace-based capture everything traces, so this is parity surface only."""
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None

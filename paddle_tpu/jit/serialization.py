@@ -1,0 +1,64 @@
+"""jit.save / jit.load — deployable model serialization.
+
+Reference analog: `paddle.jit.save` → TranslatedLayer (python/paddle/jit/api.py,
+translated_layer.py). Here a saved model is the layer's state_dict plus a
+pickled reconstruction spec; inference loading rebuilds a callable that runs
+through the cached-executable path. (The exported-StableHLO format lands with
+the inference Predictor.)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Save layer params (+ class pickle when possible) under `path`."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    target = layer
+    if isinstance(layer, Layer):
+        for name, p in layer.state_dict().items():
+            state[name] = np.asarray(p._data if isinstance(p, Tensor) else p)
+    payload = {"state": state, "input_spec": input_spec}
+    try:
+        payload["layer"] = pickle.dumps(target)
+    except Exception:
+        payload["layer"] = None
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+class TranslatedLayer(Layer):
+    """Reference: python/paddle/jit/translated_layer.py."""
+
+    def __init__(self, inner: Layer):
+        super().__init__()
+        self._inner = inner
+
+    def forward(self, *args, **kwargs):
+        return self._inner(*args, **kwargs)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("layer") is not None:
+        inner = pickle.loads(payload["layer"])
+        if isinstance(inner, Layer):
+            sd = {k: Tensor(v) for k, v in payload["state"].items()}
+            inner.set_state_dict(sd)
+            t = TranslatedLayer(inner)
+            t.eval()
+            return t
+    raise RuntimeError(
+        f"Cannot reconstruct layer from {path}: class not picklable; "
+        "load the state via paddle.load and rebuild the Layer in code"
+    )

@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict
 import jax
 import numpy as np
 
-from ..core import flags
+from ..core import dtype as dtype_mod, flags
 from ..core.tensor import Tensor
 
 
@@ -71,14 +71,25 @@ def _is_tensor(x):
 
 def _wrap_out(arr, node=None, idx=0):
     t = Tensor._from_data(arr)
-    if node is not None and np.issubdtype(np.dtype(arr.dtype), np.inexact):
+    if node is not None and dtype_mod.is_inexact_dtype(arr.dtype):
         t._grad_node = node
         t._out_index = idx
         t.stop_gradient = False
     return t
 
 
+_amp_hook = None
+
+
+def set_amp_hook(fn):
+    """Installed by paddle_tpu.amp: (op_name, args, kwargs) -> (args, kwargs)."""
+    global _amp_hook
+    _amp_hook = fn
+
+
 def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
+    if _amp_hook is not None:
+        args, kwargs = _amp_hook(name, args, kwargs)
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
     t_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     in_tensors = [leaves[i] for i in t_slots]
@@ -89,7 +100,7 @@ def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
         and is_grad_enabled()
         and any(
             (not t.stop_gradient or t._grad_node is not None)
-            and np.issubdtype(np.dtype(t._data.dtype), np.inexact)
+            and dtype_mod.is_inexact_dtype(t._data.dtype)
             for t in in_tensors
         )
     )
@@ -107,9 +118,7 @@ def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
         out_leaves, out_treedef = jax.tree.flatten(out)
         edges = []
         for t in in_tensors:
-            if (not t.stop_gradient or t._grad_node is not None) and np.issubdtype(
-                np.dtype(t._data.dtype), np.inexact
-            ):
+            if (not t.stop_gradient or t._grad_node is not None) and dtype_mod.is_inexact_dtype(t._data.dtype):
                 if t._grad_node is not None:
                     edges.append(("node", t._grad_node, t._out_index))
                 else:
@@ -143,7 +152,7 @@ def _check_nan_inf(name, result):
     import jax.numpy as jnp
 
     for t in jax.tree.leaves(result, is_leaf=_is_tensor):
-        if isinstance(t, Tensor) and np.issubdtype(np.dtype(t._data.dtype), np.floating):
+        if isinstance(t, Tensor) and dtype_mod.is_floating_dtype(t._data.dtype):
             arr = t._data
             if hasattr(arr, "aval") and not hasattr(arr, "devices"):
                 continue  # tracer: skip eager check inside traces

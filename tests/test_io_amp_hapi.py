@@ -1,0 +1,193 @@
+"""M4 slice: DataLoader (single+multiproc), AMP autocast/GradScaler,
+paddle.save/load, hapi Model.fit on FakeData, metrics."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset
+from paddle_tpu.vision.datasets import FakeData
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, np.float32), np.int64(i % 2)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_single_process():
+    dl = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 3]
+    assert y.dtype == "int64"
+    np.testing.assert_allclose(x.numpy()[:, 0], [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_drop_last():
+    dl = DataLoader(RangeDataset(10), batch_size=4, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    seen = np.concatenate([b[0].numpy()[:, 0] for b in batches])
+    assert len(np.unique(seen)) == 8
+
+
+def test_dataloader_multiprocess():
+    dl = DataLoader(RangeDataset(16), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    # order preserved across workers
+    np.testing.assert_allclose(batches[0][0].numpy()[:, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(batches[3][0].numpy()[:, 0], [12, 13, 14, 15])
+
+
+class _BadDataset(Dataset):
+    # module level: spawn workers must be able to pickle the dataset
+    def __getitem__(self, i):
+        raise ValueError("boom")
+
+    def __len__(self):
+        return 4
+
+
+def test_dataloader_worker_error_propagates():
+    dl = DataLoader(_BadDataset(), batch_size=2, num_workers=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+def test_tensor_dataset_random_split():
+    xs = paddle.arange(12).reshape([12, 1]).astype("float32")
+    ys = paddle.arange(12)
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 12
+    a, b = paddle.io.random_split(ds, [8, 4])
+    assert len(a) == 8 and len(b) == 4
+
+
+def test_auto_cast_white_black():
+    x = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        y = paddle.matmul(x, x)
+        assert y.dtype == "bfloat16"  # white list op
+        z = paddle.exp(y)
+        assert z.dtype == "float32"  # black list forces f32
+        w = paddle.add(x, x)
+        assert w.dtype == "float32"  # O1: untouched
+    y2 = paddle.matmul(x, x)
+    assert y2.dtype == "float32"  # outside context
+
+
+def test_auto_cast_O2():
+    x = paddle.randn([4, 4])
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O2"):
+        w = paddle.add(x, x)
+        assert w.dtype == "bfloat16"
+        z = paddle.softmax(w)
+        assert z.dtype == "float32"
+
+
+def test_grad_scaler_fp16_dynamics():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "gsw"
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    loss = (w * 2).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 2.0], rtol=1e-6)  # unscaled grad
+
+
+def test_grad_scaler_skips_inf():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "gsw2"
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    loss = (w * float("inf")).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)  # must skip update
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])
+    assert scaler._scale == 32.0  # halved
+
+
+def test_paddle_save_load(tmp_path):
+    net = nn.Linear(3, 3)
+    path = str(tmp_path / "ckpt" / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    state = paddle.load(path)
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(state)
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+    opt = optimizer.Adam(learning_rate=0.1, parameters=net.parameters())
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    opt.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+
+
+def test_hapi_model_fit(capsys):
+    paddle.seed(3)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 8 * 8, 10))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer.Adam(learning_rate=1e-3, parameters=net.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy(),
+    )
+    data = FakeData(size=32, image_shape=(3, 8, 8), num_classes=10)
+    history = model.fit(data, epochs=2, batch_size=8, verbose=0)
+    assert len(history) == 2
+    result = model.evaluate(data, batch_size=8, verbose=0)
+    assert "acc" in result and "loss" in result
+    preds = model.predict(data, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+
+def test_hapi_save_load(tmp_path):
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(optimizer.SGD(learning_rate=0.1, parameters=net.parameters()), nn.MSELoss())
+    p = str(tmp_path / "m")
+    model.save(p)
+    model2 = paddle.Model(nn.Linear(4, 2))
+    model2.prepare(optimizer.SGD(learning_rate=0.1, parameters=model2.network.parameters()), nn.MSELoss())
+    model2.load(p)
+    np.testing.assert_allclose(model2.network.weight.numpy(), net.weight.numpy())
+
+
+def test_metrics():
+    acc = paddle.metric.Accuracy()
+    pred = paddle.to_tensor([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = paddle.to_tensor([[0], [1], [1]])
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    assert abs(acc.accumulate() - 2 / 3) < 1e-6
+    p = paddle.metric.Precision()
+    p.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+
+
+def test_static_executor_compat():
+    import paddle_tpu.static as static
+
+    net = nn.Linear(4, 2)
+    net.eval()
+    prog = static.build_program(lambda feed: [net(feed["x"])])
+    exe = static.Executor(paddle.CPUPlace())
+    out = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)})
+    assert out[0].shape == (3, 2)
+
+
+def test_resnet_forward():
+    net = paddle.vision.models.resnet18(num_classes=10)
+    net.eval()
+    y = net(paddle.randn([2, 3, 32, 32]))
+    assert y.shape == [2, 10]

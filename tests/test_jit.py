@@ -1,0 +1,144 @@
+"""to_static: jit capture correctness vs eager, caching, buffers, rng, backward."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    net = MLP()
+    x = paddle.randn([8, 4])
+    eager_out = net(x).numpy()
+    paddle.jit.to_static(net)
+    static_out = net(x).numpy()
+    np.testing.assert_allclose(eager_out, static_out, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function_decorator():
+    @paddle.jit.to_static
+    def f(a, b):
+        return paddle.matmul(a, b) + 1.0
+
+    a = paddle.randn([2, 3])
+    b = paddle.randn([3, 2])
+    np.testing.assert_allclose(
+        f(a, b).numpy(), a.numpy() @ b.numpy() + 1.0, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_to_static_cache_hit():
+    net = MLP()
+    paddle.jit.to_static(net)
+    x = paddle.randn([8, 4])
+    net(x)
+    sf = net.forward
+    assert len(sf._cache) == 1
+    net(paddle.randn([8, 4]))
+    assert len(sf._cache) == 1  # same signature
+    net(paddle.randn([16, 4]))
+    assert len(sf._cache) == 2  # new shape recompiles
+
+
+def test_to_static_backward():
+    paddle.seed(1)
+    net_e = MLP()
+    net_s = MLP()
+    net_s.set_state_dict(net_e.state_dict())
+    paddle.jit.to_static(net_s)
+    x = paddle.randn([8, 4])
+    y = paddle.randn([8, 3])
+
+    loss_e = nn.MSELoss()(net_e(x), y)
+    loss_e.backward()
+    loss_s = nn.MSELoss()(net_s(x), y)
+    loss_s.backward()
+    np.testing.assert_allclose(loss_e.numpy(), loss_s.numpy(), rtol=1e-5)
+    for (n1, p1), (n2, p2) in zip(net_e.named_parameters(), net_s.named_parameters()):
+        assert p2.grad is not None, n2
+        np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_training_loop_converges():
+    paddle.seed(2)
+    net = MLP()
+    paddle.jit.to_static(net)
+    opt = optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    x = paddle.randn([32, 4])
+    y = paddle.randn([32, 3])
+    losses = []
+    for _ in range(20):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+    assert len(net.forward._cache) == 1  # one compile for the whole loop
+
+
+def test_to_static_batchnorm_buffers_update():
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.BatchNorm2D(4))
+    paddle.jit.to_static(net)
+    bn = net[1]
+    before = bn._mean.numpy().copy()
+    x = paddle.randn([4, 1, 8, 8]) + 2.0
+    net(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)  # functionalized buffer written back
+    # buffers must be real arrays, not tracers
+    assert hasattr(bn._mean._data, "devices")
+
+
+def test_to_static_dropout_rng_varies():
+    drop = nn.Dropout(0.5)
+    paddle.jit.to_static(drop)
+    x = paddle.ones([100])
+    a = drop(x).numpy()
+    b = drop(x).numpy()
+    assert not np.allclose(a, b)  # different masks per call under jit
+    drop.eval()
+    c = drop(x).numpy()
+    np.testing.assert_allclose(c, np.ones(100))
+
+
+def test_to_static_eval_vs_train_signatures():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    paddle.jit.to_static(net)
+    x = paddle.randn([2, 4])
+    net(x)
+    net.eval()
+    net(x)
+    assert len(net.forward._cache) == 2  # train and eval programs
+
+
+def test_to_static_input_stop_gradient_flows():
+    @paddle.jit.to_static
+    def f(a):
+        return (a * a).sum()
+
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    out = f(a)
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0, 4.0])
+
+
+def test_jit_save_load(tmp_path):
+    net = MLP()
+    net.eval()
+    x = paddle.randn([2, 4])
+    ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path)
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5)
