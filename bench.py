@@ -1,0 +1,103 @@
+"""Benchmark: flagship LLaMA training throughput on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no in-tree numbers (BASELINE.md — `"published": {}`),
+so the baseline is self-measured: if BENCH_BASELINE.json exists (written the
+first time this runs on real hardware), vs_baseline is the ratio against it;
+otherwise vs_baseline is 1.0. MFU is reported alongside so absolute hardware
+efficiency is visible regardless of the self-baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def pick_config():
+    from paddle_tpu.models import llama as L
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        cfg = L.LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                            num_layers=2, num_heads=4, num_kv_heads=4,
+                            max_seq_len=128, dtype=jnp.float32)
+        B, T, M = 4, 128, 2
+        steps, warmup = 3, 1
+    else:
+        # ~440M-param LLaMA slice sized for one chip's HBM (f32 master params
+        # + AdamW m/v ≈ 5.3G of the ~16G budget); bf16 compute.
+        cfg = L.LlamaConfig(vocab_size=32000, hidden_size=1536,
+                            intermediate_size=4096, num_layers=12,
+                            num_heads=12, num_kv_heads=12, max_seq_len=2048)
+        B, T, M = 4, 2048, 1
+        steps, warmup = 5, 2
+    return cfg, B, T, M, steps, warmup
+
+
+def main():
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.distributed import hybrid as H
+
+    cfg, B, T, M, steps, warmup = pick_config()
+    mesh = H.build_mesh(dp=1, pp=1, tp=1)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    sp = H.shard_params(params, mesh, cfg)
+    opt = H.init_opt_state(sp)
+    step = H.make_train_step(cfg, mesh, num_microbatches=M,
+                             hp=H.AdamWConfig(lr=1e-4))
+    k = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(k, (B, T), 0, cfg.vocab_size, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    for _ in range(warmup):
+        sp, opt, loss = step(sp, opt, tokens, targets)
+    float(loss)  # D2H forces completion (block_until_ready can return early
+    # through the axon tunnel's async remote execution)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sp, opt, loss = step(sp, opt, tokens, targets)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * T * steps / dt
+    flops = cfg.flops_per_token() * tokens_per_sec
+    platform = jax.devices()[0].platform
+    peak = {"tpu": 459e12, "cpu": 1e12}.get(platform, 100e12)  # v5p bf16 ≈459 TFLOP/s
+    mfu = flops / peak
+
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as f:
+                base = json.load(f)
+            if base.get("platform") == platform and base.get("value"):
+                vs = tokens_per_sec / float(base["value"])
+        except (OSError, ValueError, KeyError):
+            pass
+    elif platform != "cpu":
+        try:
+            with open(base_path, "w") as f:
+                json.dump({"platform": platform, "value": tokens_per_sec,
+                           "unit": "tokens/s/chip"}, f)
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+        "details": {"platform": platform, "mfu": round(mfu, 4),
+                    "step_time_s": round(dt / steps, 4), "loss": float(loss),
+                    "params": cfg.num_params(), "batch": B, "seq": T},
+    }))
+
+
+if __name__ == "__main__":
+    main()

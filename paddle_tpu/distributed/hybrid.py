@@ -1,0 +1,448 @@
+"""TPU-native hybrid-parallel training engine (the fleet analog).
+
+Reference design (SURVEY.md §2.5/CS5): fleet composes DP / TP (Megatron
+mp_layers) / PP (1F1B over NCCL p2p) / sequence-parallel / expert-parallel as
+Python wrappers firing NCCL collectives per bucket/microbatch
+(python/paddle/distributed/fleet/meta_parallel/*, pipeline_parallel.py:575,
+mpu/mp_layers.py:49,336,543, moe/moe_layer.py:263).
+
+TPU-native redesign: ONE compiled XLA program per train step. A
+`jax.sharding.Mesh` with axes ('dp','pp','tp') replaces the
+HybridCommunicateGroup topology; the whole step (all microbatches, forward,
+backward, grad sync, optimizer) runs inside a single `jax.shard_map`ped,
+jitted function where:
+
+- **TP + SP (Megatron sequence parallel)**: activations stay sequence-sharded
+  over 'tp' between layers; `all_gather(seq)` before column-parallel matmuls,
+  `psum_scatter(seq)` after row-parallel matmuls — the exact
+  ScatterOp/AllGatherOp/ReduceScatterOp pattern of
+  fleet/utils/sequence_parallel_utils.py, but compiled to ICI collectives.
+- **PP**: GPipe microbatch rotation via `lax.ppermute` inside a `lax.scan` —
+  the schedule is differentiated through (ppermute transposes to the inverse
+  permutation), so one `jax.grad` covers the whole pipeline instead of the
+  reference's hand-built forward_backward_pipeline (pipeline_parallel.py:575).
+- **EP (MoE)**: GShard-style capacity dispatch + `all_to_all` over the 'dp'
+  axis (expert parallelism rides the data-parallel axis, as in the reference's
+  global_scatter/global_gather design, moe_layer.py:263).
+- **DP**: gradient psum over 'dp' — the EagerReducer (reducer.h:88) collapses
+  to one fused collective XLA schedules during the backward.
+- **ZeRO-ish**: optimizer states live sharded exactly like the params (tp/pp
+  sharded states come for free; the 'sharding'-axis stage-1/2 variants are the
+  fleet API layer's job).
+
+Gradient-sync rule (spec-driven): a param leaf's gradient is psum-ed over every
+mesh axis NOT appearing in its PartitionSpec (replicated axes), while sharded
+axes need nothing — collective transposes already routed cross-shard
+contributions. Loss is pre-scaled by 1/dp so the psum yields the global-batch
+mean.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama as L
+
+MESH_AXES = ("dp", "pp", "tp")
+
+
+# --------------------------------------------------------------------------
+# Mesh + sharding layout
+# --------------------------------------------------------------------------
+
+def build_mesh(dp: int = 1, pp: int = 1, tp: int = 1, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = dp * pp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, pp, tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def stack_pipeline(params: Dict[str, Any], pp: int) -> Dict[str, Any]:
+    """Reshape block leaves [L, ...] → [pp, L//pp, ...] (stage-major)."""
+    def f(x):
+        Lg = x.shape[0]
+        assert Lg % pp == 0, f"num_layers {Lg} not divisible by pp {pp}"
+        return x.reshape(pp, Lg // pp, *x.shape[1:])
+    out = dict(params)
+    out["blocks"] = jax.tree.map(f, params["blocks"])
+    return out
+
+
+def unstack_pipeline(params: Dict[str, Any]) -> Dict[str, Any]:
+    def f(x):
+        return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+    out = dict(params)
+    out["blocks"] = jax.tree.map(f, params["blocks"])
+    return out
+
+
+def param_specs(cfg: L.LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpecs for the stage-stacked param pytree.
+
+    Layout: blocks leaves carry a leading 'pp' stage axis; projections are
+    tp-sharded Megatron-style (wq/wk/wv/w1/w3 on the output dim, wo/w2 on the
+    input dim); embed/lm_head are vocab-parallel; MoE experts are sharded over
+    'dp' (= the ep axis).
+    """
+    blocks = {
+        "wq": P("pp", None, None, "tp"),
+        "wk": P("pp", None, None, "tp"),
+        "wv": P("pp", None, None, "tp"),
+        "wo": P("pp", None, "tp", None),
+        "attn_norm": P("pp", None, None),
+        "mlp_norm": P("pp", None, None),
+    }
+    if cfg.num_experts:
+        blocks["router"] = P("pp", None, None, None)
+        blocks["w1"] = P("pp", None, "dp", None, "tp")
+        blocks["w3"] = P("pp", None, "dp", None, "tp")
+        blocks["w2"] = P("pp", None, "dp", "tp", None)
+    else:
+        blocks["w1"] = P("pp", None, None, "tp")
+        blocks["w3"] = P("pp", None, None, "tp")
+        blocks["w2"] = P("pp", None, "tp", None)
+    return {
+        "embed": P("tp", None),
+        "blocks": blocks,
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh, cfg: L.LlamaConfig):
+    """Stage-stack + device_put with NamedShardings (host → HBM, laid out)."""
+    pp = mesh.shape["pp"]
+    stacked = stack_pipeline(params, pp)
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), stacked, specs)
+
+
+# --------------------------------------------------------------------------
+# Optimizer (sharded AdamW — states shaped/sharded exactly like params)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AdamWConfig:
+    lr: float = 3e-4
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    grad_clip: Optional[float] = 1.0
+
+
+def init_opt_state(params):
+    zeros = lambda p: jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), p)
+    return {"m": zeros(params), "v": zeros(params), "step": jnp.zeros((), jnp.int32)}
+
+
+def _adamw_update(params, grads, opt, hp: AdamWConfig, global_sq_sum):
+    step = opt["step"] + 1
+    if hp.grad_clip is not None:
+        gnorm = jnp.sqrt(global_sq_sum)
+        scale = jnp.minimum(1.0, hp.grad_clip / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * scale, grads)
+    b1, b2 = hp.beta1, hp.beta2
+    bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+    bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        u = (m / bc1) / (jnp.sqrt(v / bc2) + hp.eps)
+        u = u + hp.weight_decay * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - hp.lr * u).astype(p.dtype), m, v
+
+    flat_p, tree = jax.tree.flatten(params)
+    flat_g = jax.tree.leaves(grads)
+    flat_m = jax.tree.leaves(opt["m"])
+    flat_v = jax.tree.leaves(opt["v"])
+    new_p, new_m, new_v = [], [], []
+    for p_, g_, m_, v_ in zip(flat_p, flat_g, flat_m, flat_v):
+        a, b, c = upd(p_, g_, m_, v_)
+        new_p.append(a); new_m.append(b); new_v.append(c)
+    return (jax.tree.unflatten(tree, new_p),
+            {"m": jax.tree.unflatten(tree, new_m),
+             "v": jax.tree.unflatten(tree, new_v), "step": step})
+
+
+# --------------------------------------------------------------------------
+# Per-shard building blocks (run inside shard_map)
+# --------------------------------------------------------------------------
+
+def _vp_embed_lookup(embed_local, tok, cfg: L.LlamaConfig):
+    """Vocab-parallel embedding with sequence-parallel output
+    (VocabParallelEmbedding, mp_layers.py:49, composed with the SP scatter of
+    sequence_parallel_utils.py): every tp rank looks up the FULL sequence
+    against its vocab shard (partial rows), and the vocab-psum is fused with
+    the SP seq-scatter into one reduce_scatter — which also transposes to the
+    correct all_gather in backward, so each embed shard's gradient collects
+    contributions from all sequence chunks.
+
+    tok [B, T] → [B, T/tp, D].
+    """
+    vloc = embed_local.shape[0]
+    start = lax.axis_index("tp") * vloc
+    local_ids = tok - start
+    in_range = (local_ids >= 0) & (local_ids < vloc)
+    safe = jnp.clip(local_ids, 0, vloc - 1)
+    emb = jnp.take(embed_local, safe, axis=0)
+    emb = jnp.where(in_range[..., None], emb, 0)
+    return lax.psum_scatter(emb, "tp", scatter_dimension=1, tiled=True)
+
+
+def _vp_cross_entropy(logits_local, targets, vloc):
+    """Vocab-parallel softmax CE (ParallelCrossEntropy, mp_layers.py:744):
+    logits_local [..., V/tp] over the FULL sequence; per-token loss via
+    psum-max / psum-sum over the tp (vocab) axis. The result is replicated
+    over tp."""
+    start = lax.axis_index("tp") * vloc
+    # cross-shard max via all_gather (lax.pmax has no differentiation rule);
+    # the shift is mathematically grad-free anyway (logsumexp invariance).
+    gmax = lax.all_gather(jnp.max(logits_local, axis=-1), "tp")
+    lmax = lax.stop_gradient(jnp.max(gmax, axis=0))
+    shifted = logits_local - lmax[..., None]
+    sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), "tp")
+    local_t = targets - start
+    in_range = (local_t >= 0) & (local_t < vloc)
+    safe = jnp.clip(local_t, 0, vloc - 1)
+    true_shift = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+    true_shift = lax.psum(jnp.where(in_range, true_shift, 0.0), "tp")
+    return jnp.log(sumexp) - true_shift
+
+
+def _moe_ffn(h_full, lp, cfg: L.LlamaConfig, ep_size: int):
+    """GShard top-k MoE with all_to_all expert dispatch over the 'dp' (=ep)
+    axis (reference: global_scatter/global_gather collectives feeding expert
+    FFNs, moe_layer.py:263). Expert FFN weights are additionally tp-sharded.
+
+    h_full: [B, T, D] (full sequence, after the SP all_gather).
+    lp['w1'] local: [E/ep, D, F/tp].
+    """
+    B, T, D = h_full.shape
+    N = B * T
+    E = cfg.num_experts
+    assert E % ep_size == 0, f"num_experts {E} not divisible by ep (dp) {ep_size}"
+    k = cfg.top_k
+    x = h_full.reshape(N, D)
+    gates = jax.nn.softmax(
+        x.astype(jnp.float32) @ lp["router"].astype(jnp.float32), axis=-1)
+    C = max(1, (N * k) // E) * 2  # capacity factor 2.0, static
+    C = min(C, N)
+    topw, topi = lax.top_k(gates, k)
+    topw = topw / (jnp.sum(topw, axis=-1, keepdims=True) + 1e-9)
+    disp = jnp.zeros((N, E, C), jnp.float32)
+    comb = jnp.zeros((N, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)
+    for c in range(k):
+        e_idx = topi[:, c]
+        maski = jax.nn.one_hot(e_idx, E, dtype=jnp.int32)
+        pos = jnp.cumsum(maski, axis=0) - 1 + counts[None, :]
+        counts = counts + jnp.sum(maski, axis=0)
+        p = jnp.take_along_axis(pos, e_idx[:, None], axis=1)[:, 0]
+        ok = (p < C)
+        oh = (jax.nn.one_hot(e_idx, E, dtype=jnp.float32)[:, :, None]
+              * jax.nn.one_hot(jnp.clip(p, 0, C - 1), C, dtype=jnp.float32)[:, None, :])
+        oh = oh * ok[:, None, None]
+        disp = disp + oh
+        comb = comb + oh * topw[:, c][:, None, None]
+    xe = jnp.einsum("nd,nec->ecd", x.astype(jnp.float32), disp).astype(x.dtype)  # [E, C, D]
+    # all_to_all: experts → owner dp rank; tokens from every dp rank concat on C
+    xe = lax.all_to_all(xe, "dp", split_axis=0, concat_axis=1, tiled=True)  # [E/ep, C*ep, D]
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w1"].astype(xe.dtype)))
+    h = h * jnp.einsum("ecd,edf->ecf", xe, lp["w3"].astype(xe.dtype))
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w2"].astype(h.dtype))
+    # NOTE: ye stays PARTIAL over tp (row-parallel w2 shards); the tp reduction
+    # happens at the caller's psum_scatter back into sequence shards, so the
+    # backward transposes to an all_gather and every tp rank's w2 shard sees
+    # gradient contributions from the whole sequence.
+    ye = lax.all_to_all(ye, "dp", split_axis=1, concat_axis=0, tiled=True)  # [E, C, D]
+    y = jnp.einsum("ecd,nec->nd", ye.astype(jnp.float32), comb)
+    return y.reshape(B, T, D).astype(h_full.dtype)
+
+
+def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int):
+    """One transformer block with Megatron TP + sequence parallelism.
+
+    x: [B, T/tp, D] sequence-sharded. lp: this layer's local weight shards.
+    """
+    Bm, Tloc, D = x.shape
+    hd = cfg.head_dim
+    h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    h_full = lax.all_gather(h, "tp", axis=1, tiled=True)          # SP gather [B, T, D]
+    T = h_full.shape[1]
+    nh_loc = lp["wq"].shape[-1] // hd
+    nkv_loc = lp["wk"].shape[-1] // hd
+    q = (h_full @ lp["wq"].astype(h_full.dtype)).reshape(Bm, T, nh_loc, hd)
+    kk = (h_full @ lp["wk"].astype(h_full.dtype)).reshape(Bm, T, nkv_loc, hd)
+    vv = (h_full @ lp["wv"].astype(h_full.dtype)).reshape(Bm, T, nkv_loc, hd)
+    q = L.apply_rope(q, cos, sin)
+    kk = L.apply_rope(kk, cos, sin)
+    o = L.attention(q, kk, vv, impl="xla").reshape(Bm, T, nh_loc * hd)
+    partial = o @ lp["wo"].astype(o.dtype)                         # row-parallel partial
+    x = x + lax.psum_scatter(partial, "tp", scatter_dimension=1, tiled=True)
+    h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+    h_full = lax.all_gather(h, "tp", axis=1, tiled=True)
+    if cfg.num_experts:
+        y_partial = _moe_ffn(h_full, lp, cfg, ep_size)  # partial over tp
+        x = x + lax.psum_scatter(y_partial, "tp", scatter_dimension=1, tiled=True)
+    else:
+        g = jax.nn.silu(h_full @ lp["w1"].astype(h_full.dtype))
+        g = g * (h_full @ lp["w3"].astype(h_full.dtype))
+        partial = g @ lp["w2"].astype(g.dtype)
+        x = x + lax.psum_scatter(partial, "tp", scatter_dimension=1, tiled=True)
+    return x
+
+
+def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
+                     dp: int, pp: int, tp: int, remat: bool = True):
+    """Build the per-shard loss(params, tokens, targets) -> scalar function.
+
+    Inside: GPipe pipeline over `num_microbatches`, TP/SP per block,
+    vocab-parallel CE on the last stage, loss pre-scaled by 1/dp.
+    """
+    M = num_microbatches
+
+    def stage_fn(x, blocks_local, cos, sin):
+        body = lambda carry, lp: (_block_sp(carry, lp, cfg, cos, sin, dp), None)
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = lax.scan(body, x, blocks_local)
+        return x
+
+    def shard_loss(params, tokens, targets):
+        # local shapes: tokens [B/dp, T]; blocks leaves [1, L/pp, ...]
+        blocks_local = jax.tree.map(lambda x: x[0], params["blocks"])
+        Bloc, T = tokens.shape
+        assert Bloc % M == 0, f"local batch {Bloc} not divisible by microbatches {M}"
+        Bm = Bloc // M
+        Tloc = T // tp
+        D = cfg.hidden_size
+        tok_mb = tokens.reshape(M, Bm, T)
+        tgt_mb = targets.reshape(M, Bm, T)
+        stage = lax.axis_index("pp")
+        cos, sin = L.rope_cos_sin(jnp.arange(T), cfg.head_dim, cfg.rope_theta)
+        vloc = params["lm_head"].shape[1]
+
+        def embed_mb(m):
+            x = _vp_embed_lookup(params["embed"], tok_mb[m], cfg)  # [Bm, T/tp, D]
+            return x.astype(cfg.dtype)
+
+        def mb_loss(y, m):
+            # y [Bm, T/tp, D]: exit the SP region (all_gather seq), then
+            # vocab-parallel head + CE over the full sequence. per_tok is
+            # replicated over tp; SUM over the microbatch's tokens.
+            h = L.rms_norm(y, params["final_norm"], cfg.rms_eps)
+            h_full = lax.all_gather(h, "tp", axis=1, tiled=True)   # [Bm, T, D]
+            logits = (h_full @ params["lm_head"].astype(h_full.dtype)).astype(jnp.float32)
+            per_tok = _vp_cross_entropy(logits, tgt_mb[m], vloc)
+            return jnp.sum(per_tok)
+
+        def pipe_step(carry, t):
+            x_in, loss_acc = carry
+            m = jnp.clip(t - stage, 0, M - 1)
+            active = (t - stage >= 0) & (t - stage < M)
+            x0 = embed_mb(m)
+            x = jnp.where(stage == 0, x0, x_in)
+            y = stage_fn(x, blocks_local, cos, sin)
+            lmb = mb_loss(y, m)
+            take = active & (stage == pp - 1)
+            loss_acc = loss_acc + jnp.where(take, lmb, 0.0)
+            y_send = lax.ppermute(y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return (y_send, loss_acc), None
+
+        x_init = jnp.zeros((Bm, Tloc, D), cfg.dtype)
+        (_, loss_sum), _ = lax.scan(
+            pipe_step, (x_init, jnp.zeros((), jnp.float32)), jnp.arange(M + pp - 1))
+        # collect from the last stage (pp); already replicated over tp.
+        # Normalize to the GLOBAL batch mean: local token count is M*Bm*T, and
+        # the extra 1/dp makes the implicit sum over dp ranks a global mean.
+        loss_sum = lax.psum(loss_sum, "pp")
+        return loss_sum / (M * Bm * T * dp)
+
+    return shard_loss
+
+
+def _sync_axes(spec: P) -> Tuple[str, ...]:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in MESH_AXES if a not in used)
+
+
+def sync_grads(grads, specs):
+    """psum each grad leaf over the mesh axes its param is replicated on."""
+    def f(g, s):
+        axes = _sync_axes(s)
+        return lax.psum(g, axes) if axes else g
+    return jax.tree.map(f, grads, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+# --------------------------------------------------------------------------
+# Public train step factory
+# --------------------------------------------------------------------------
+
+def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
+                    hp: Optional[AdamWConfig] = None, remat: bool = True):
+    """Returns jitted step(params, opt_state, tokens, targets) →
+    (params, opt_state, loss). params must be stage-stacked + sharded
+    (see shard_params); tokens/targets are [B_global, T] int32 sharded P('dp',None).
+    """
+    hp = hp or AdamWConfig()
+    dp, pp, tp = (mesh.shape[a] for a in MESH_AXES)
+    specs = param_specs(cfg)
+    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, remat)
+    opt_specs = {"m": specs, "v": specs, "step": P()}
+
+    def per_shard_step(params, opt, tokens, targets):
+        loss, grads = jax.value_and_grad(shard_loss)(params, tokens, targets)
+        grads = sync_grads(grads, specs)
+        loss = lax.psum(loss, "dp")  # replicate the global mean for reporting
+        # global grad-norm² for clipping: local shards' sq-sums + psum over the
+        # axes each leaf is sharded on (replicated leaves are already synced).
+        sq = 0.0
+        for g, s in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))):
+            loc = jnp.sum(g.astype(jnp.float32) ** 2)
+            shard_axes = tuple(a for a in MESH_AXES if a not in _sync_axes(s))
+            sq = sq + (lax.psum(loc, shard_axes) if shard_axes else loc)
+        new_params, new_opt = _adamw_update(params, grads, opt, hp, sq)
+        return new_params, new_opt, loss
+
+    step = jax.shard_map(
+        per_shard_step, mesh=mesh,
+        in_specs=(specs, opt_specs, P("dp", None), P("dp", None)),
+        out_specs=(specs, opt_specs, P()),
+        check_vma=False)
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_eval_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1):
+    """Jitted loss-only step (no grads) with the same sharding layout."""
+    dp, pp, tp = (mesh.shape[a] for a in MESH_AXES)
+    specs = param_specs(cfg)
+    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, remat=False)
+
+    def per_shard(params, tokens, targets):
+        return lax.psum(shard_loss(params, tokens, targets), "dp")
+
+    f = jax.shard_map(per_shard, mesh=mesh,
+                      in_specs=(specs, P("dp", None), P("dp", None)),
+                      out_specs=P(), check_vma=False)
+    return jax.jit(f)

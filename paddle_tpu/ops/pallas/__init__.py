@@ -1,0 +1,9 @@
+"""Hand-written TPU kernels (Pallas).
+
+The analog of the reference's `paddle/phi/kernels/primitive/` KPS layer +
+fused kernels (`kernels/fusion/gpu`, SURVEY.md §2.1): only the ~dozen ops XLA
+fuses poorly get hand kernels — flash/splash attention (+ ring attention for
+context parallelism), MoE dispatch, fused rotary/rmsnorm. Everything else
+stays on the XLA emission path.
+"""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,17 @@
+"""Flash attention for TPU (Pallas).
+
+Reference parity target: the fused/varlen flash-attention path
+(`paddle/phi/kernels/gpu/flash_attn_kernel.*` wrapping third_party/flashattn,
+SURVEY.md §5 long-context). Kernel implementation lands with the Pallas task;
+until then `available()` is False and callers (models.llama.attention with
+impl='auto') use the XLA einsum path.
+"""
+from __future__ import annotations
+
+
+def available() -> bool:
+    return False
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    raise NotImplementedError("Pallas flash attention kernel not yet built")

@@ -1,0 +1,114 @@
+"""Hybrid-parallel engine parity tests on an 8-virtual-device CPU mesh.
+
+The arbiter for all the collective/transpose reasoning in
+paddle_tpu/distributed/hybrid.py: a dp=2 × pp=2 × tp=2 sharded train step must
+reproduce the single-device loss AND the single-device AdamW update bit-for-
+close. This mirrors the reference's distributed test strategy (SURVEY.md §4:
+multi-process localhost runs compared against single-process losses,
+test_dist_base.py:957) — compiled single-process SPMD replaces the
+subprocesses.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.distributed import hybrid as H
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=16,
+                dtype=jnp.float32)
+    base.update(kw)
+    return L.LlamaConfig(**base)
+
+
+def _data(cfg, B=4, T=16, seed=1):
+    k = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(k, (B, T), 0, cfg.vocab_size, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+def _ref_step(cfg, params, tokens, targets, hp):
+    """Single-device reference: global-mean loss, AdamW with the same math."""
+    loss, grads = jax.value_and_grad(
+        lambda p: L.loss_fn(p, tokens, targets, cfg, attn_impl="xla"))(params)
+    sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in jax.tree.leaves(grads))
+    opt = H.init_opt_state(params)
+    new_p, _ = H._adamw_update(params, grads, opt, hp, sq)
+    return loss, new_p
+
+
+@pytest.mark.parametrize("moe", [False, True], ids=["dense", "moe"])
+def test_dp2_pp2_tp2_parity(moe):
+    cfg = _cfg(num_experts=4 if moe else 0, top_k=2)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    tokens, targets = _data(cfg)
+    hp = H.AdamWConfig(lr=1e-2, weight_decay=0.0, grad_clip=1.0)
+
+    ref_loss, ref_p = _ref_step(cfg, params, tokens, targets, hp)
+
+    mesh = H.build_mesh(dp=2, pp=2, tp=2)
+    sp = H.shard_params(params, mesh, cfg)
+    opt = H.init_opt_state(sp)
+    step = H.make_train_step(cfg, mesh, num_microbatches=2, hp=hp)
+    new_sp, _, loss = step(sp, opt, tokens, targets)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    got = H.unstack_pipeline(jax.device_get(new_sp))
+    want = jax.device_get(ref_p)
+    flat_got = {p: v for p, v in
+                jax.tree_util.tree_flatten_with_path(got)[0]}
+    for path, w in jax.tree_util.tree_flatten_with_path(want)[0]:
+        g = flat_got[path]
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-5,
+                                   err_msg=f"param mismatch at {path}")
+
+
+def test_eval_loss_matches_reference():
+    cfg = _cfg()
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    tokens, targets = _data(cfg)
+    ref = L.loss_fn(params, tokens, targets, cfg, attn_impl="xla")
+    mesh = H.build_mesh(dp=2, pp=2, tp=2)
+    sp = H.shard_params(params, mesh, cfg)
+    ev = H.make_eval_step(cfg, mesh, num_microbatches=2)
+    loss = ev(sp, tokens, targets)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-5)
+
+
+def test_loss_decreases_over_steps():
+    cfg = _cfg()
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    tokens, targets = _data(cfg)
+    mesh = H.build_mesh(dp=2, pp=2, tp=2)
+    sp = H.shard_params(params, mesh, cfg)
+    opt = H.init_opt_state(sp)
+    step = H.make_train_step(cfg, mesh, num_microbatches=2,
+                             hp=H.AdamWConfig(lr=5e-3, weight_decay=0.0))
+    losses = []
+    for _ in range(6):
+        sp, opt, loss = step(sp, opt, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_other_mesh_shapes():
+    """pp=4 (tall pipeline) and tp=4/8 layouts also compile and match.
+    Wide-head config so heads/kv-heads stay divisible by tp."""
+    cfg = _cfg(num_heads=8, num_kv_heads=8)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    tokens, targets = _data(cfg)
+    ref = L.loss_fn(params, tokens, targets, cfg, attn_impl="xla")
+    for dp, pp, tp in [(1, 4, 2), (2, 1, 4), (1, 1, 8)]:
+        mesh = H.build_mesh(dp=dp, pp=pp, tp=tp)
+        sp = H.shard_params(params, mesh, cfg)
+        ev = H.make_eval_step(cfg, mesh, num_microbatches=2)
+        loss = ev(sp, tokens, targets)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=3e-5,
+                                   err_msg=f"mesh {(dp, pp, tp)}")
