@@ -414,7 +414,8 @@ class Tensor:
 class Parameter(Tensor):
     """A trainable Tensor (reference: EagerParamBase, python/paddle/base/framework.py)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "sequence_parallel", "split_axis")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, name=name, stop_gradient=not trainable)
@@ -424,6 +425,9 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        self.is_distributed = False
+        self.sequence_parallel = False
+        self.split_axis = None
 
     @classmethod
     def from_tensor(cls, t: Tensor, name=None, trainable=True):
@@ -441,6 +445,9 @@ class Parameter(Tensor):
         p.optimize_attr = {"learning_rate": 1.0}
         p.regularizer = None
         p.need_clip = True
+        p.is_distributed = False
+        p.sequence_parallel = False
+        p.split_axis = None
         return p
 
 

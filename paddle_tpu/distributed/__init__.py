@@ -1,7 +1,53 @@
 """paddle.distributed parity — TPU-native (SURVEY.md §2.5).
 
 Collectives become XLA HLO ops over ICI/DCN; the ProcessGroup/fleet surface
-is a mesh/axis registry (M5-M6 build-out; env discovery lands first).
+is a mesh/axis registry. Reference: python/paddle/distributed/__init__.py.
 """
 from . import env  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    Task,
+    all_gather,
+    all_gather_into_tensor,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    get_backend,
+    get_global_rank,
+    get_group,
+    init_parallel_env,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+
+
+def __getattr__(name):
+    # Lazy submodule access: paddle.distributed.fleet / auto_parallel / etc.
+    import importlib
+
+    if name in ("fleet", "auto_parallel", "checkpoint", "launch", "sharding",
+                "parallel", "hybrid", "rpc", "utils", "communication"):
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ImportError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}") from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

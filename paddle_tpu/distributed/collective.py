@@ -1,0 +1,577 @@
+"""Process groups + collective communication — TPU-native.
+
+Reference design (SURVEY.md §2.5): `paddle.distributed.new_group` creates a
+`Group` backed by a `ProcessGroupNCCL` (process_group.h:48) doing NCCL rings
+on dedicated streams, bootstrapped by TCPStore. Python op wrappers live in
+python/paddle/distributed/communication/*.
+
+TPU-native redesign: a collective is an XLA HLO op compiled over ICI/DCN.
+A `Group` is a *mesh-axis binding*: it names a set of ranks and, when built
+from a device mesh, the 1-D sub-mesh axis the collective runs over. Execution
+has two modes:
+
+- **traced** (inside `shard_map`/`jit` with a bound axis name): the op emits
+  the `lax` collective (`psum`, `all_gather`, `psum_scatter`, `all_to_all`,
+  `ppermute`) directly — XLA schedules it on ICI. This is how fleet's hybrid
+  engine consumes groups.
+- **eager** (single-controller): the op jit-compiles a one-collective
+  `shard_map` over the group's device axis and applies it to the tensor's
+  global `jax.Array` — the "ProcessGroup dispatches single-collective XLA
+  executables" design recorded in SURVEY.md §5. Executables are cached per
+  (op, group, shape, dtype) — the KernelFactory analog for comms.
+
+Rank-local semantics (each rank holds its own shard) map onto global arrays:
+an eager tensor sharded over the group axis IS the tuple of per-rank tensors.
+On a single device / world_size 1, every collective degrades to its
+mathematically correct identity.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_rank, get_world_size
+
+
+class ReduceOp:
+    """Reference: paddle.distributed.ReduceOp (communication/reduce.py)."""
+
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_lock = threading.RLock()
+_group_registry: Dict[int, "Group"] = {}
+_next_gid = [0]
+_default_group: Optional["Group"] = None
+_initialized = [False]
+
+
+class Task:
+    """Async collective handle (reference: ProcessGroup::Task,
+    process_group.h:50). PJRT dispatch is already async — `wait` blocks on
+    the result buffer."""
+
+    def __init__(self, results: Sequence[jax.Array]):
+        self._results = list(results)
+
+    def is_completed(self) -> bool:
+        for r in self._results:
+            if hasattr(r, "is_ready") and not r.is_ready():
+                return False
+        return True
+
+    def wait(self, timeout=None):
+        for r in self._results:
+            if hasattr(r, "block_until_ready"):
+                r.block_until_ready()
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+class Group:
+    """A communication group: ordered ranks + (optionally) a device axis.
+
+    Reference: python/paddle/distributed/collective.py:194 `Group`; the NCCL
+    comm ring is replaced by a 1-D jax Mesh over the member devices (axis
+    name `_pg{gid}` unless bound to a hybrid-topology axis like 'dp'/'mp').
+    """
+
+    def __init__(self, ranks: List[int], gid: int, axis_name: Optional[str] = None,
+                 devices=None, mesh: Optional[Mesh] = None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis_name = axis_name or f"_pg{gid}"
+        self._mesh = mesh
+        if mesh is None and devices is not None and len(devices) == len(ranks):
+            self._mesh = Mesh(np.asarray(devices), (self.axis_name,))
+
+    # -- paddle.distributed.Group surface --------------------------------
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def rank(self) -> int:
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def mesh(self) -> Optional[Mesh]:
+        return self._mesh
+
+    def is_member(self) -> bool:
+        return get_rank() in self.ranks
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name!r}, ranks={self.ranks})")
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def init_parallel_env() -> Optional[Group]:
+    """Reference: parallel.py:978 init_parallel_env — TCPStore rendezvous +
+    default ProcessGroup. Here: (multi-host) jax.distributed is assumed
+    initialized by the launcher; the default group spans jax.devices()."""
+    global _default_group
+    with _lock:
+        if _initialized[0]:
+            return _default_group
+        world = get_world_size()
+        devices = jax.devices()
+        n = max(world, 1)
+        if len(devices) >= n > 0 and world > 1:
+            devs = devices[:n]
+        else:
+            devs = devices[: max(1, min(len(devices), n))]
+        ranks = list(range(n))
+        g = Group(ranks, gid=0, axis_name="world",
+                  devices=devs if len(devs) == n else None)
+        _group_registry[0] = g
+        _default_group = g
+        _initialized[0] = True
+        _next_gid[0] = 1
+        return g
+
+
+def _get_or_init_default() -> Group:
+    if not _initialized[0]:
+        init_parallel_env()
+    return _default_group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None, axis_name: Optional[str] = None,
+              devices=None, mesh: Optional[Mesh] = None) -> Group:
+    """Reference: python/paddle/distributed/collective.py:194."""
+    with _lock:
+        _get_or_init_default()
+        if ranks is None:
+            ranks = list(range(get_world_size()))
+        gid = _next_gid[0]
+        _next_gid[0] += 1
+        if mesh is None and devices is None:
+            all_dev = jax.devices()
+            if max(ranks, default=-1) < len(all_dev):
+                devices = [all_dev[r] for r in ranks]
+        g = Group(sorted(ranks), gid, axis_name=axis_name, devices=devices,
+                  mesh=mesh)
+        _group_registry[gid] = g
+        return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    _get_or_init_default()
+    return _group_registry.get(gid)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    with _lock:
+        if group is None:
+            _group_registry.clear()
+            _default_group = None
+            _initialized[0] = False
+            _next_gid[0] = 0
+        else:
+            _group_registry.pop(group.id, None)
+
+
+# ---------------------------------------------------------------------------
+# Execution plumbing
+# ---------------------------------------------------------------------------
+
+def _unwrap(t):
+    if isinstance(t, Tensor):
+        return t._data
+    return jnp.asarray(t)
+
+
+def _wrap_like(arr, like) -> Tensor:
+    if isinstance(like, Tensor):
+        out = Tensor(arr)
+        out.stop_gradient = like.stop_gradient
+        return out
+    return Tensor(arr)
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_in_scope(axis_name: str) -> bool:
+    """True if `axis_name` is a bound mapped axis in the current trace."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except (NameError, KeyError, ValueError, AssertionError):
+        return False
+
+
+@functools.lru_cache(maxsize=512)
+def _eager_collective(mesh, axis, fn_name, nranks, **kw):
+    """Cache of one-collective compiled executables (SURVEY.md §5 design)."""
+    fn = _SHARD_FNS[fn_name]
+
+    def per_shard(x):
+        return fn(x, axis, nranks, **kw)
+
+    sm = jax.shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                       out_specs=_OUT_SPEC[fn_name](axis), check_vma=False)
+    return jax.jit(sm)
+
+
+def _reduce_term(x, axis, op):
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(jnp.abs(x) + 1e-38), axis)) * jnp.prod(
+            jnp.sign(lax.all_gather(jnp.sign(x), axis)), axis=0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+_SHARD_FNS = {
+    "all_reduce": lambda x, ax, n, op: _reduce_term(x, ax, op),
+    "all_gather": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=False),
+    "all_gather_tiled": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=True),
+    "reduce_scatter": lambda x, ax, n: lax.psum_scatter(
+        x, ax, scatter_dimension=0, tiled=True),
+    "all_to_all": lambda x, ax, n: lax.all_to_all(
+        x, ax, split_axis=0, concat_axis=0, tiled=True),
+    "broadcast": lambda x, ax, n, src: jax.tree.map(
+        lambda v: lax.all_gather(v, ax)[src], x),
+    "reduce": lambda x, ax, n, op, dst: _reduce_term(x, ax, op),
+}
+_OUT_SPEC = {
+    "all_reduce": lambda ax: P(ax),
+    "all_gather": lambda ax: P(),            # gathered: replicated full copy
+    "all_gather_tiled": lambda ax: P(),
+    "reduce_scatter": lambda ax: P(ax),
+    "all_to_all": lambda ax: P(ax),
+    "broadcast": lambda ax: P(ax),
+    "reduce": lambda ax: P(ax),
+}
+
+
+_sim_rank_major = [False]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def simulate_rank_major():
+    """Test-mode interpretation (SURVEY.md §4 pattern B localhost tests):
+    an eager operand's leading dim is the stacked per-rank values — chunk i
+    is rank i's local tensor. Mirrors the reference's multi-process
+    collective tests on a single controller."""
+    _sim_rank_major[0] = True
+    try:
+        yield
+    finally:
+        _sim_rank_major[0] = False
+
+
+def _already_sharded(x, g: Group) -> bool:
+    sh = getattr(x, "sharding", None)
+    if sh is None or g._mesh is None:
+        return False
+    try:
+        if len(x.sharding.device_set) <= 1:
+            return False
+        return not sh.is_fully_replicated and \
+            x.sharding.device_set <= set(g._mesh.devices.flat)
+    except Exception:
+        return False
+
+
+def _shardable(x, g: Group) -> bool:
+    """Run the per-shard executable if the operand is genuinely laid out over
+    the group's devices, or (simulation mode) rank-major stacked on dim 0."""
+    if g._mesh is None or g.nranks <= 1:
+        return False
+    if _already_sharded(x, g):
+        return True
+    shape = getattr(x, "shape", ())
+    return (_sim_rank_major[0] and bool(shape)
+            and shape[0] % g.nranks == 0)
+
+
+def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
+    """Dispatch a collective: traced → lax op; eager → cached executable."""
+    g = group or _get_or_init_default()
+    x = _unwrap(tensor)
+    if _is_traced(x) and _axis_in_scope(g.axis_name):
+        out = _SHARD_FNS[fn_name](x, g.axis_name, g.nranks, **kw)
+        return out, None
+    if not _shardable(x, g):
+        out = _replicated(fn_name, x, g, **kw)
+        return out, None
+    # Lay the operand out over the group's device axis (rank-major on dim 0).
+    # Already-sharded arrays are a no-op move.
+    x = jax.device_put(x, NamedSharding(g._mesh, P(g.axis_name)))
+    exe = _eager_collective(g._mesh, g.axis_name, fn_name, g.nranks,
+                            **{k: v for k, v in kw.items()})
+    out = exe(x)
+    return out, Task([out])
+
+
+def _replicated(fn_name, x, g, **kw):
+    """Replicated-operand semantics: the tensor is one global value every
+    rank holds identically (e.g. a synced gradient). Mathematically exact
+    for n identical contributions."""
+    n = g.nranks
+    op = kw.get("op", ReduceOp.SUM)
+    if fn_name in ("all_reduce", "reduce"):
+        if op == ReduceOp.SUM:
+            return x * n
+        if op == ReduceOp.PROD:
+            return x ** n
+        return x  # max/min/avg of identical copies
+    if fn_name in ("broadcast", "all_to_all", "all_gather_tiled",
+                   "reduce_scatter"):
+        if fn_name == "reduce_scatter" and n > 1:
+            return x * n  # sum of n identical shards... caller keeps full
+        return x
+    if fn_name == "all_gather":
+        return jnp.stack([x] * n, axis=0) if n > 1 else x[None]
+    raise ValueError(fn_name)
+
+
+# ---------------------------------------------------------------------------
+# Public collective API (reference: python/paddle/distributed/communication/*)
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """In-place-style allreduce. Returns a Task (async handle)."""
+    out, task = _run(group, "all_reduce", tensor, op=op)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        if sync_op and task is not None:
+            task.wait()
+        return task
+    return _wrap_like(out, tensor)
+
+
+def all_gather(tensor_list, tensor, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """Gathers `tensor` from all ranks into `tensor_list` (stacked order).
+
+    Traced mode: returns the stacked [nranks, ...] array (append to list)."""
+    g = group or _get_or_init_default()
+    out, task = _run(group, "all_gather", tensor)
+    arr = out
+    if tensor_list is not None:
+        del tensor_list[:]
+        n = g.nranks
+        for i in range(n):
+            tensor_list.append(_wrap_like(arr[i] if arr.shape[0] == n else arr,
+                                          tensor))
+    if sync_op and task is not None:
+        task.wait()
+    return task
+
+
+def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True,
+                           tiled=True):
+    out, task = _run(group, "all_gather_tiled" if tiled else "all_gather",
+                     tensor)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = out.reshape(out_tensor.shape) if hasattr(
+            out_tensor, "shape") and out_tensor.shape else out
+    return task
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Reduce + scatter along dim 0. `tensor` receives this rank's shard."""
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = Tensor(jnp.concatenate([_unwrap(t) for t in src], axis=0))
+    out, task = _run(group, "reduce_scatter", src)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return task
+    return _wrap_like(out, src)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = group or _get_or_init_default()
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+    else:
+        x = _unwrap(in_tensor_list)
+    out, task = _run(group, "all_to_all", Tensor(x))
+    if out_tensor_list is not None and isinstance(out_tensor_list, list):
+        del out_tensor_list[:]
+        n = g.nranks
+        chunk = out.shape[0] // n if out.shape[0] % n == 0 else out.shape[0]
+        if chunk and out.shape[0] == n * chunk:
+            for i in range(n):
+                out_tensor_list.append(Tensor(out[i * chunk:(i + 1) * chunk]))
+        else:
+            out_tensor_list.append(Tensor(out))
+    return task
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    out, task = _run(group, "all_to_all", in_tensor)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = out
+        return task
+    return _wrap_like(out, in_tensor)
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    g = group or _get_or_init_default()
+    src_local = g.get_group_rank(src) if src in g.ranks else src
+    out, task = _run(group, "broadcast", tensor, src=max(src_local, 0))
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return task
+    return _wrap_like(out, tensor)
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, group=None,
+           sync_op=True):
+    g = group or _get_or_init_default()
+    out, task = _run(group, "reduce", tensor, op=op,
+                     dst=g.get_group_rank(dst) if dst in g.ranks else 0)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return task
+    return _wrap_like(out, tensor)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    """Scatter list from src. Single-controller: rank r takes tensor_list[r]."""
+    g = group or _get_or_init_default()
+    if tensor_list:
+        r = max(g.rank, 0)
+        if isinstance(tensor, Tensor):
+            tensor._data = _unwrap(tensor_list[r])
+    return None
+
+
+def barrier(group: Optional[Group] = None):
+    """All outstanding PJRT work flushed = barrier on a single controller;
+    multi-host adds a tiny psum over the group."""
+    g = group or _get_or_init_default()
+    if g._mesh is not None and g.nranks > 1:
+        out, task = _run(g, "all_reduce", Tensor(jnp.zeros((g.nranks,))),
+                         op=ReduceOp.SUM)
+        if task:
+            task.wait()
+    else:
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# -- p2p --------------------------------------------------------------------
+
+_p2p_mailbox: Dict[tuple, list] = {}
+
+
+def send(tensor, dst: int = 0, group=None, sync_op=True):
+    """P2P send. Traced: `lax.ppermute` is the TPU-native path (used by the
+    PP engine). Eager single-controller: mailbox delivery (the two "ranks"
+    are views of one program; cross-host eager p2p goes through
+    jax.device_put between processes' addressable devices)."""
+    g = group or _get_or_init_default()
+    key = (g.id, max(g.rank, 0), g.get_group_rank(dst) if dst in g.ranks else dst)
+    _p2p_mailbox.setdefault(key, []).append(_unwrap(tensor))
+
+
+def recv(tensor, src: int = 0, group=None, sync_op=True):
+    g = group or _get_or_init_default()
+    key = (g.id, g.get_group_rank(src) if src in g.ranks else src, max(g.rank, 0))
+    box = _p2p_mailbox.get(key)
+    if box:
+        arr = box.pop(0)
+        if isinstance(tensor, Tensor):
+            tensor._data = arr
+        return None
+    return None
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return Task([])
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return Task([])
+
+
+class P2POp:
+    """Reference: batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[Task]:
+    tasks = []
+    for p in p2p_op_list:
+        tasks.append(p.op(p.tensor, p.peer, p.group) or Task([]))
+    return tasks
+
+
+# -- object collectives -----------------------------------------------------
+
+def all_gather_object(object_list: list, obj, group=None):
+    """Single-controller: every rank's object is the same python object."""
+    g = group or _get_or_init_default()
+    del object_list[:]
+    object_list.extend([obj] * g.nranks)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def get_global_rank(group: Group, group_rank: int) -> int:
+    return group.ranks[group_rank]
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    return "xla"

@@ -1,0 +1,162 @@
+"""Low-level model-parallel ops.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_ops.py —
+`_c_identity` (fwd identity / bwd allreduce), `_mp_allreduce` (fwd allreduce /
+bwd identity), `_c_split`, `_c_concat`: the autograd-paired collectives that
+make Megatron TP correct.
+
+TPU-native: inside a shard_map trace they emit `lax` collectives whose
+transposes ARE the paired backward ops (psum ↔ identity is exactly what
+jax.grad derives); in GSPMD (global-array) mode they are sharding-constraint
+annotations and XLA inserts the collectives. Both paths share the Group/axis
+binding from `collective.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....core.tensor import Tensor
+from .... import collective as coll
+
+
+def _axis_of(group):
+    g = group or coll.get_group(0)
+    return g.axis_name if g is not None else None
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _rewrap(arr, like):
+    if isinstance(like, Tensor):
+        out = Tensor(arr)
+        out.stop_gradient = like.stop_gradient
+        return out
+    return arr
+
+
+def _in_axis_trace(x, axis):
+    return (isinstance(x, jax.core.Tracer) and axis is not None
+            and coll._axis_in_scope(axis))
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _identity_fwd_allreduce_bwd(x, axis):
+    return x
+
+
+def _ifab_fwd(x, axis):
+    return x, None
+
+
+def _ifab_bwd(axis, _res, g):
+    return (lax.psum(g, axis),)
+
+
+_identity_fwd_allreduce_bwd.defvjp(_ifab_fwd, _ifab_bwd)
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity, backward allreduce over the mp group (column-parallel
+    input). Reference: mp_ops.py _c_identity."""
+    axis = _axis_of(group)
+    x = _unwrap(tensor)
+    if _in_axis_trace(x, axis):
+        return _rewrap(_identity_fwd_allreduce_bwd(x, axis), tensor)
+    return tensor  # GSPMD/eager: XLA derives the transpose itself
+
+
+def _mp_allreduce(tensor, op=coll.ReduceOp.SUM, group=None,
+                  use_calc_stream=True, use_model_parallel=True):
+    """Forward allreduce, backward identity (row-parallel output)."""
+    axis = _axis_of(group)
+    x = _unwrap(tensor)
+    if _in_axis_trace(x, axis):
+        return _rewrap(lax.psum(x, axis), tensor)
+    # GSPMD/global-array mode: the sharded matmul already produced the full
+    # contraction (XLA inserted the all-reduce); a second reduction would be
+    # wrong math. Identity here, psum only on per-shard traces.
+    return tensor
+
+
+def _c_split(tensor, group=None):
+    """Split the last dim, keep this rank's chunk (per-shard traces only;
+    in global-array mode tensors are logically full → identity)."""
+    axis = _axis_of(group)
+    x = _unwrap(tensor)
+    if _in_axis_trace(x, axis):
+        n = lax.axis_size(axis)
+        i = lax.axis_index(axis)
+        size = x.shape[-1] // n
+        return _rewrap(lax.dynamic_slice_in_dim(x, i * size, size, -1), tensor)
+    return tensor
+
+
+def _c_concat(tensor, group=None):
+    """All-gather chunks along the last dim."""
+    axis = _axis_of(group)
+    x = _unwrap(tensor)
+    if _in_axis_trace(x, axis):
+        return _rewrap(lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True),
+                       tensor)
+    return tensor
+
+
+def _c_lookup_table(table, index, start_index=0, vocab_size=-1):
+    """Vocab-shard-local embedding lookup with masked out-of-range rows."""
+    t = _unwrap(table)
+    idx = _unwrap(index)
+    vloc = t.shape[0]
+    local = idx - start_index
+    ok = (local >= 0) & (local < vloc)
+    safe = jnp.clip(local, 0, vloc - 1)
+    emb = jnp.take(t, safe, axis=0)
+    return _rewrap(jnp.where(ok[..., None], emb, 0), table)
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False,
+                                  ignore_index: int = -100):
+    """Vocab-parallel softmax CE (ParallelCrossEntropy's kernel).
+
+    In-trace with the mp axis bound: the distributed max/sum reduction runs
+    over the vocab shards (mirrors _vp_cross_entropy in distributed.hybrid).
+    GSPMD mode: plain CE; XLA partitions the softmax over the sharded dim.
+    """
+    axis = _axis_of(group)
+    x = _unwrap(logits)
+    y = _unwrap(label)
+    if y.ndim == x.ndim:
+        y = y[..., 0]
+    if _in_axis_trace(x, axis):
+        vloc = x.shape[-1]
+        start = lax.axis_index(axis) * vloc
+        gmax = lax.all_gather(jnp.max(x, axis=-1), axis)
+        lmax = lax.stop_gradient(jnp.max(gmax, axis=0))
+        shifted = x - lmax[..., None]
+        sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+        local_t = y - start
+        ok = (local_t >= 0) & (local_t < vloc)
+        safe = jnp.clip(local_t, 0, vloc - 1)
+        true_shift = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+        true_shift = lax.psum(jnp.where(ok, true_shift, 0.0), axis)
+        loss = jnp.log(sumexp) - true_shift
+    else:
+        lmax = jax.lax.stop_gradient(jnp.max(x, axis=-1, keepdims=True))
+        shifted = x - lmax
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        true = jnp.take_along_axis(shifted, y[..., None], axis=-1)[..., 0]
+        loss = lse - true
+    loss = jnp.where(y == ignore_index, 0.0, loss)[..., None]
+    out = _rewrap(loss, logits)
+    if return_softmax:
+        sm = jax.nn.softmax(x, axis=-1)
+        return out, _rewrap(sm, logits)
+    return out

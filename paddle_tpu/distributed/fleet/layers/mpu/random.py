@@ -1,0 +1,81 @@
+"""Model-parallel RNG state tracking.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/random.py
+`RNGStatesTracker` — keeps named RNG states so dropout inside TP regions is
+DIFFERENT per mp rank (activation dropout on sharded dims) while regular
+dropout stays identical across ranks.
+
+TPU-native: states are jax PRNG seeds; `rng_state(name)` swaps the default
+Generator for the scope. Per-mp-rank decorrelation folds the mp rank into
+the seed (`jax.random.fold_in` semantics).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from .....core import rng as rng_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, rng_mod.Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self.states_.setdefault(n, rng_mod.Generator(0)).set_state(s)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = rng_mod.Generator(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = rng_mod.default_generator
+        rng_mod.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            rng_mod.default_generator = orig
+
+
+RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """Reference: random.py model_parallel_random_seed — decorrelate the
+    model-parallel state by folding in the mp rank."""
+    from ...base.topology import get_hcg
+
+    hcg = get_hcg()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    RNG_STATE_TRACKER.reset()
+    rng_mod.seed(global_seed)
+    RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(name: str) -> int:
+    g = RNG_STATE_TRACKER.states_.get(name)
+    return g.initial_seed() if g else 0

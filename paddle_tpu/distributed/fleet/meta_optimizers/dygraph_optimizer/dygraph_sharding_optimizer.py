@@ -1,0 +1,88 @@
+"""Sharding (ZeRO stage-1) optimizer.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py:54 — params are bucketed round-robin over the
+sharding group by size; each rank runs the inner optimizer only on its
+bucket, then broadcasts updated params to the group (V2 :586 does param-unit
+reduce-scatter instead).
+
+TPU-native: optimizer *states* are the memory hog, and XLA shards them for
+free when their arrays are laid out over the mesh (states inherit param
+sharding in the compiled engine). This class provides the fleet-API tier:
+the rank→param assignment (`_rank2params`), local-shard stepping, and the
+post-step broadcast, which on global arrays becomes a sharding-constraint
+re-layout (weight-update sharding, cf. PAPERS.md#1 "ZeRO on XLA").
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .....core.tensor import Parameter
+from .... import collective as coll
+
+
+class DygraphShardingOptimizer:
+    """Reference: dygraph_sharding_optimizer.py:54."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        group = (hcg.get_sharding_parallel_group() if hcg is not None else None)
+        self._group = group
+        self._nranks = group.nranks if group else 1
+        self._rank = max(group.rank, 0) if group else 0
+        params = list(getattr(optimizer, "_parameter_list", None)
+                      or getattr(optimizer, "_params", []))
+        self._origin_parameter_list = params
+        self._rank2params = self._partition_parameters(params)
+        # inner optimizer only steps this rank's shard
+        local = self._rank2params[self._rank]
+        if hasattr(optimizer, "_params"):
+            optimizer._params = local
+        if hasattr(optimizer, "_parameter_list"):
+            optimizer._parameter_list = local
+
+    def _partition_parameters(self, params) -> Dict[int, List[Parameter]]:
+        """Greedy smallest-bucket assignment (reference's size balancing)."""
+        mapping = {i: [] for i in range(self._nranks)}
+        sizes = np.zeros(self._nranks)
+        for p in sorted(params, key=lambda p: -int(np.prod(p.shape) if p.shape else 1)):
+            i = int(np.argmin(sizes))
+            mapping[i].append(p)
+            sizes[i] += int(np.prod(p.shape) if p.shape else 1)
+        return mapping
+
+    def step(self):
+        self._inner_opt.step()
+        self._broadcast_params()
+
+    def _broadcast_params(self):
+        """Each rank broadcasts its updated shard to the group
+        (reference: _sharding_sync_parameters)."""
+        g = self._group
+        if g is None or g.nranks <= 1:
+            return
+        for rank, params in self._rank2params.items():
+            for p in params:
+                coll.broadcast(p, src=g.ranks[rank], group=g)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        # clear ALL original params' grads, not just the local shard
+        for p in self._origin_parameter_list:
+            p._grad = None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, s):
+        return self._inner_opt.set_state_dict(s)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

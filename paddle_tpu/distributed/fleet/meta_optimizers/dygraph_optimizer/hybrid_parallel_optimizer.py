@@ -1,0 +1,65 @@
+"""HybridParallelOptimizer — hybrid-topology-aware optimizer wrapper.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:266 — wraps the inner optimizer with (a) dp/
+sharding gradient synchronization, (b) a hybrid-aware ClipGradByGlobalNorm
+(norm contributions psum-ed over the axes each param is sharded on), then
+steps.
+
+TPU-native: on global arrays the grad is already the global gradient (XLA
+inserted the cross-shard reductions during backward), so (a) is a no-op
+except in per-rank eager multi-host mode, where it bucketed-allreduces over
+the dp group. (b) reduces to the plain global-norm clip — shards belong to
+one logical array, so the sum of squared locals IS the global norm.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._dp_group = (hcg.get_data_parallel_group()
+                          if hcg is not None else None)
+        self._sharding_group = (hcg.get_sharding_parallel_group()
+                                if hcg is not None else None)
+
+    # -- paddle Optimizer surface ---------------------------------------
+    @property
+    def _parameter_list(self):
+        return getattr(self._inner_opt, "_parameter_list", None) or \
+            getattr(self._inner_opt, "_params", [])
+
+    def _sync_grads(self):
+        from ....parallel import sync_param_grads
+
+        sync_param_grads(list(self._parameter_list or []), self._dp_group)
+
+    def step(self):
+        self._sync_grads()
+        self._inner_opt.step()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,22 @@
+"""Meta-parallel model wrappers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/ —
+`TensorParallel` (tensor_parallel.py:28), `PipelineParallel`
+(pipeline_parallel.py:255), `SegmentParallel` (segment_parallel.py:26).
+"""
+from .meta_parallel_base import MetaParallelBase  # noqa: F401
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SegmentLayers,
+    SharedLayerDesc,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .tensor_parallel import SegmentParallel, TensorParallel  # noqa: F401
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..layers.mpu.random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401

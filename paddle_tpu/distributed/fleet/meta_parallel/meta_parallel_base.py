@@ -1,0 +1,44 @@
+"""Base for meta-parallel wrappers.
+
+Reference: fleet/meta_parallel/meta_parallel_base.py — wraps a Layer,
+broadcasts/prepares params for its parallel dimension, forwards calls.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # Layer protocol passthrough
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, *a, **k):
+        return self._layers.set_state_dict(state_dict, *a, **k)
+
+    def train(self):
+        self._layers.train()
+        return super().train()
+
+    def eval(self):
+        self._layers.eval()
+        return super().eval()

@@ -1,0 +1,6 @@
+from .pp_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SegmentLayers,
+    SharedLayerDesc,
+)

@@ -1,0 +1,160 @@
+"""Pipeline layer description + segmentation.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py — `LayerDesc` (:57) defers construction, `SegmentLayers` (:93)
+splits the layer list into stages (uniform or by-flops), `PipelineLayer`
+(:258) instantiates only this stage's segment and wires shared embeddings.
+
+TPU-native: the whole logical model lives on every *controller* (JAX is
+single-controller SPMD); stages are realized as the leading 'pp' axis of
+stage-stacked weights inside the compiled train step (distributed.hybrid).
+`PipelineLayer` therefore instantiates ALL segments, tags each sublayer with
+its stage id, and exposes the per-stage slices for the engine. API parity —
+`get_stage_layers`, `segment`, shared-weight registration — is preserved.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    """Reference: pp_layers.py:57."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input(layer_func) should be a derived class of Layer.")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Reference: pp_layers.py SharedLayerDesc — layers shared across stages
+    (tied embeddings). On TPU the weight is one logical array replicated (or
+    sharded) over 'pp' by GSPMD, so 'sharing' is simply reusing the object."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Reference: pp_layers.py:93."""
+
+    def __init__(self, layers_desc, num_parts: int, method: str = "uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts, (
+            "layer number should be greater than number of segments")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # cut at instances of a named layer class
+            # (reference supports e.g. seg_method='layer:TransformerBlock')
+            name = self.method.split(":", 1)[1]
+            named_idx = [
+                i for i, d in enumerate(self._layers_desc)
+                if type(d).__name__ == name
+                or (isinstance(d, LayerDesc) and d.layer_func.__name__ == name)]
+            assert len(named_idx) >= self.num_parts
+            cuts = self.uniform(len(named_idx), self.num_parts)
+            return [0] + [named_idx[c] for c in cuts[1:-1]] + [self.num_items]
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py:258."""
+
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        from ...base.topology import get_hcg
+
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        hcg = get_hcg()
+        if num_stages is None:
+            num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
+        self._num_stages = max(1, num_stages)
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+
+        self._layers_desc = list(layers)
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # instantiate ALL stages (single-controller); record stage of each
+        self._shared = {}
+        built: List[Layer] = []
+        self._stage_of: List[int] = []
+        for stage in range(self._num_stages):
+            lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+            for i in range(lo, hi):
+                d = self._layers_desc[i]
+                if isinstance(d, SharedLayerDesc):
+                    if d.layer_name not in self._shared:
+                        self._shared[d.layer_name] = d.build_layer()
+                    layer = self._shared[d.layer_name]
+                elif isinstance(d, LayerDesc):
+                    layer = d.build_layer()
+                elif isinstance(d, Layer):
+                    layer = d
+                elif callable(d):
+                    layer = d
+                else:
+                    raise TypeError(f"bad layer desc {d!r}")
+                built.append(layer)
+                self._stage_of.append(stage)
+        self.run_function = built
+        self._sublayer_list = LayerList(
+            [l for l in built if isinstance(l, Layer)])
+
+    # ------------------------------------------------------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        return self._stage_of[layer_idx]
+
+    def get_stage_layers(self, stage: Optional[int] = None) -> List:
+        stage = self._stage_id if stage is None else stage
+        return [l for l, s in zip(self.run_function, self._stage_of)
+                if s == stage]
+
+    def forward(self, x, **kwargs):
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def loss(self, output, label):
+        return self._loss_fn(output, label) if self._loss_fn else output

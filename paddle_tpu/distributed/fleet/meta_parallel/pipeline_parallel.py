@@ -1,0 +1,115 @@
+"""PipelineParallel wrapper — microbatched train_batch.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py:255 —
+`train_batch` (:820) drives the 1F1B schedule (`forward_backward_pipeline`
+:575) with NCCL p2p sends between per-rank stage submodels.
+
+TPU-native: two execution tiers.
+- This wrapper (API parity tier): a host-driven microbatch loop — forward +
+  backward per microbatch with gradient accumulation, then one fused grad
+  sync. On a mesh, stage weights are pp-sharded by GSPMD and XLA pipelines
+  collectives with compute; there is no per-rank p2p to hand-schedule since
+  the controller sees global arrays (SURVEY.md §7 "hard parts" option (a)).
+- The performance tier is the fully-compiled 1F1B/GPipe rotation in
+  `distributed.hybrid.make_train_step` (ppermute inside scan — option (b));
+  `to_compiled_step()` hands a PipelineLayer model off to it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ....core.tensor import Tensor
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel(MetaParallelBase):
+    """Reference: pipeline_parallel.py:255."""
+
+    def _prepare_for_model(self):
+        self.micro_batch_size = int(
+            (self._strategy.pipeline_configs or {}).get("micro_batch_size", 1))
+        self.accumulate_steps = int(
+            (self._strategy.pipeline_configs or {}).get("accumulate_steps", 1))
+        self.total_loss = None
+        hcg = self._hcg
+        self.num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
+        self.stage_id = hcg.get_stage_id() if hcg else 0
+
+    def is_pipeline_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_pipeline_last_stage(self) -> bool:
+        return self.stage_id == self.num_stages - 1
+
+    def _split_micro(self, data):
+        if isinstance(data, (tuple, list)):
+            parts = [self._split_micro(d) for d in data]
+            return list(zip(*parts))
+        arr = data._data if isinstance(data, Tensor) else np.asarray(data)
+        n = self.accumulate_steps
+        b = arr.shape[0]
+        assert b % n == 0, f"batch {b} not divisible by accumulate_steps {n}"
+        mb = b // n
+        return [Tensor(arr[i * mb:(i + 1) * mb]) for i in range(n)]
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Microbatch loop with grad accumulation (reference :575)."""
+        inputs, labels = data
+        mb_inputs = self._split_micro(inputs)
+        mb_labels = self._split_micro(labels)
+        total = None
+        model = self._layers
+        loss_fn = getattr(model, "_loss_fn", None)
+        for x, y in zip(mb_inputs, mb_labels):
+            out = model(x)
+            if loss_fn is not None:
+                loss = loss_fn(out, y)
+            else:
+                loss = out
+            if hasattr(loss, "mean") and getattr(loss, "ndim", 0):
+                loss = loss.mean()
+            scaled = loss.scale(1.0 / self.accumulate_steps) \
+                if hasattr(loss, "scale") else loss / self.accumulate_steps
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            d = loss.detach() if hasattr(loss, "detach") else loss
+            total = d if total is None else total + d
+        self.total_loss = total
+        return total / self.accumulate_steps
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference: pipeline_parallel.py:820."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        self._layers.eval()
+        inputs, labels = data
+        from ....ops.dispatch import no_grad
+
+        with no_grad():
+            out = self._layers(inputs)
+            loss_fn = getattr(self._layers, "_loss_fn", None)
+            if compute_loss and loss_fn is not None:
+                return loss_fn(out, labels)
+        return out
+
+    def to_compiled_step(self, *args, **kwargs):
+        """Hand off to the compiled whole-step engine (distributed.hybrid)."""
+        from ... import hybrid
+
+        return hybrid.make_train_step(*args, **kwargs)

@@ -1,0 +1,48 @@
+"""TensorParallel / SegmentParallel wrappers.
+
+Reference: fleet/meta_parallel/tensor_parallel.py:28 — broadcasts the
+non-mp-sharded params across the mp group and input data across ranks;
+segment_parallel.py:26 — same for the sep dimension.
+
+TPU-native: single-controller global arrays are never rank-divergent, so the
+broadcast is only needed on true multi-host eager setups; the wrapper's real
+job here is laying params out over the mesh (is_distributed leaves stay
+sharded, the rest replicated) which GSPMD consumes.
+"""
+from __future__ import annotations
+
+from .meta_parallel_base import MetaParallelBase
+from ..layers.mpu import mp_layers  # ensures sharded-layer registry import
+from ...parallel import sync_params_buffers
+
+
+class TensorParallel(MetaParallelBase):
+    """Reference: tensor_parallel.py:28."""
+
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg is None:
+            return
+        mp_group = hcg.get_model_parallel_group()
+        if mp_group is not None and mp_group.nranks > 1:
+            # broadcast NON-distributed params over the mp group so replicas
+            # agree (reference: broadcast_mp_parameters)
+            for p in self._layers.parameters():
+                if not getattr(p, "is_distributed", False):
+                    from ... import collective as coll
+
+                    coll.broadcast(p, src=mp_group.ranks[0], group=mp_group)
+
+
+class SegmentParallel(MetaParallelBase):
+    """Reference: segment_parallel.py:26 — sep ranks hold identical params;
+    attention all-to-all over the sep axis is done by model code."""
+
+    def _prepare_for_model(self):
+        hcg = self._hcg
+        if hcg is None:
+            return
+        sep_group = hcg.get_sep_parallel_group()
+        if sep_group is not None and sep_group.nranks > 1:
+            sync_params_buffers(self._layers, sep_group,
+                                src_rank=sep_group.ranks[0])

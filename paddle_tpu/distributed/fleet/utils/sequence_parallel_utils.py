@@ -1,0 +1,190 @@
+"""Megatron sequence-parallel utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+`ScatterOp` (:85) / `GatherOp` (:97) / `AllGatherOp` (:111) /
+`ReduceScatterOp` (:127): autograd-paired collectives that shard/unshard the
+sequence dim around TP regions, plus
+`register_sequence_parallel_allreduce_hooks` (:192) for LN-param grads.
+
+TPU-native: each op is a `jax.custom_vjp` pair over the mp axis — inside a
+shard_map trace they emit the ICI collective; the vjp IS the reference's
+hand-written backward (scatter↔gather, all_gather↔reduce_scatter). In
+global-array (GSPMD) mode they become sharding-constraint annotations on the
+sequence dim, letting XLA place the same collectives. The compiled hybrid
+engine (distributed.hybrid `_block_sp`) uses the same pattern inline.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ... import collective as coll
+
+
+def _mp_axis(group=None):
+    if group is not None:
+        return group.axis_name
+    from ..base.topology import get_hcg
+
+    hcg = get_hcg()
+    if hcg is not None:
+        return hcg.get_model_parallel_group().axis_name
+    return "mp"
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _rewrap(arr, like):
+    if isinstance(like, Tensor):
+        t = Tensor(arr)
+        t.stop_gradient = like.stop_gradient
+        return t
+    return arr
+
+
+def _traced_on(x, axis):
+    return isinstance(x, jax.core.Tracer) and coll._axis_in_scope(axis)
+
+
+def _annotate_seq(x, axis, sharded: bool):
+    """GSPMD mode: constrain the sequence dim (dim 0, paddle SP convention
+    is [s, b, h]) to be sharded/replicated over the mp axis."""
+    from ..fleet import fleet as _f
+
+    mesh = getattr(_f, "mesh", None)
+    if mesh is None or axis not in mesh.axis_names:
+        return x
+    spec = [None] * x.ndim
+    if sharded:
+        spec[0] = axis
+    try:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    except Exception:
+        return x
+
+
+# -- scatter: fwd split seq dim, bwd all-gather ------------------------------
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scatter(x, axis):
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    size = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, i * size, size, 0)
+
+
+def _scatter_fwd(x, axis):
+    return _scatter(x, axis), None
+
+
+def _scatter_bwd(axis, _res, g):
+    return (lax.all_gather(g, axis, axis=0, tiled=True),)
+
+
+_scatter.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# -- gather: fwd all-gather seq dim, bwd scatter (slice) ---------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _gather(x, axis):
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def _gather_fwd(x, axis):
+    return _gather(x, axis), None
+
+
+def _gather_bwd(axis, _res, g):
+    n = lax.axis_size(axis)
+    i = lax.axis_index(axis)
+    size = g.shape[0] // n
+    return (lax.dynamic_slice_in_dim(g, i * size, size, 0),)
+
+
+_gather.defvjp(_gather_fwd, _gather_bwd)
+
+
+def ScatterOp(input, group=None):  # noqa: N802 (reference API name)
+    """Reference: sequence_parallel_utils.py:85 — seq full → seq/mp."""
+    axis = _mp_axis(group)
+    x = _unwrap(input)
+    if _traced_on(x, axis):
+        return _rewrap(_scatter(x, axis), input)
+    return _rewrap(_annotate_seq(x, axis, sharded=True), input)
+
+
+def GatherOp(input, group=None):  # noqa: N802
+    """Reference: sequence_parallel_utils.py:97 — seq/mp → seq full."""
+    axis = _mp_axis(group)
+    x = _unwrap(input)
+    if _traced_on(x, axis):
+        return _rewrap(_gather(x, axis), input)
+    return _rewrap(_annotate_seq(x, axis, sharded=False), input)
+
+
+def AllGatherOp(input, group=None):  # noqa: N802
+    """Reference: :111 — fwd all_gather, bwd reduce_scatter (for column-
+    parallel matmul inputs; the bwd differs from GatherOp!)."""
+    axis = _mp_axis(group)
+    x = _unwrap(input)
+    if _traced_on(x, axis):
+        return _rewrap(_all_gather_rs(x, axis), input)
+    return _rewrap(_annotate_seq(x, axis, sharded=False), input)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _all_gather_rs(x, axis):
+    return lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def _agrs_fwd(x, axis):
+    return _all_gather_rs(x, axis), None
+
+
+def _agrs_bwd(axis, _res, g):
+    return (lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True),)
+
+
+_all_gather_rs.defvjp(_agrs_fwd, _agrs_bwd)
+
+
+def ReduceScatterOp(input, group=None):  # noqa: N802
+    """Reference: :127 — fwd reduce_scatter, bwd all_gather (row-parallel
+    matmul outputs)."""
+    axis = _mp_axis(group)
+    x = _unwrap(input)
+    if _traced_on(x, axis):
+        return _rewrap(lax.psum_scatter(x, axis, scatter_dimension=0,
+                                        tiled=True), input)
+    return _rewrap(_annotate_seq(x, axis, sharded=True), input)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Reference: :168 — tag params (LayerNorm w/b inside SP regions) whose
+    grads need an mp-group allreduce."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference: :192. On global arrays the LN grads are already complete
+    (no seq-sharded partial sums exist outside shard_map), so this registers
+    the sync only for the per-rank engine path, where the compiled step's
+    `sync_grads` (distributed.hybrid) psums replicated leaves — the hook
+    records which params need it."""
+    marked = [p for p in model.parameters()
+              if is_sequence_parallel_parameter(p)]
+    return marked

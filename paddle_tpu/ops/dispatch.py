@@ -87,13 +87,46 @@ def set_amp_hook(fn):
     _amp_hook = fn
 
 
+def _harmonize_devices(arrays):
+    """Mixed-placement operands: replicate single-device arrays onto the
+    widest committed device set (GSPMD eager mode — sharded params combine
+    with freshly-created host tensors). The analog of the reference's
+    data_transform place-transfer (paddle/phi/api/lib/data_transform.cc)."""
+    best = None
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if sh is not None:
+            try:
+                n = len(sh.device_set)
+            except Exception:
+                continue
+            if n > 1 and (best is None or n > len(best.device_set)):
+                best = sh
+    if best is None:
+        return arrays
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = getattr(best, "mesh", None)
+    if mesh is None:
+        return arrays
+    repl = NamedSharding(mesh, PartitionSpec())
+    out = []
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if (sh is not None and not isinstance(a, jax.core.Tracer)
+                and len(sh.device_set) == 1):
+            a = jax.device_put(a, repl)
+        out.append(a)
+    return out
+
+
 def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
     t_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
     in_tensors = [leaves[i] for i in t_slots]
-    arrays = [t._data for t in in_tensors]
+    arrays = _harmonize_devices([t._data for t in in_tensors])
 
     needs_grad = (
         not nondiff
