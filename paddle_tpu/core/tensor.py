@@ -49,6 +49,8 @@ class Tensor:
         "_backward_hooks",
         "is_parameter",
         "trainable",
+        "_dist_mesh",
+        "_dist_partials",
         "__weakref__",
     )
 
@@ -66,6 +68,8 @@ class Tensor:
         self.persistable = False
         self.is_parameter = False
         self.trainable = True
+        self._dist_mesh = None
+        self._dist_partials = ()
         self._backward_hooks: List = []
 
     # -- construction --------------------------------------------------------
@@ -81,6 +85,8 @@ class Tensor:
         t.persistable = False
         t.is_parameter = False
         t.trainable = True
+        t._dist_mesh = None
+        t._dist_partials = ()
         t._backward_hooks = []
         return t
 
@@ -94,6 +100,29 @@ class Tensor:
         return self._data.ndim
 
     ndimension = ndim
+
+    # -- DistTensor surface (reference: dist_tensor.h:39, dist_attr.h:81) ----
+    def is_dist(self) -> bool:
+        return self._dist_mesh is not None
+
+    @property
+    def process_mesh(self):
+        return self._dist_mesh
+
+    @property
+    def placements(self):
+        if self._dist_mesh is None:
+            return None
+        from ..distributed.auto_parallel.placement import spec_to_placements
+
+        sh = getattr(self._data, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            from ..distributed.auto_parallel.placement import Replicate
+
+            return [Replicate() for _ in self._dist_mesh.dim_names]
+        return spec_to_placements(spec, self._dist_mesh.dim_names,
+                                  self._dist_partials)
 
     @property
     def size(self) -> int:
@@ -169,6 +198,8 @@ class Tensor:
 
     def detach(self) -> "Tensor":
         t = Tensor._from_data(self._data, stop_gradient=True, name=self.name)
+        t._dist_mesh = self._dist_mesh
+        t._dist_partials = self._dist_partials
         return t
 
     def detach_(self):
@@ -212,6 +243,9 @@ class Tensor:
             p = device if isinstance(device, Place) else Place(device)
             data = jax.device_put(data, jax_device(p))
         out = Tensor._from_data(data, stop_gradient=self.stop_gradient, name=self.name)
+        if device is None:
+            out._dist_mesh = self._dist_mesh
+            out._dist_partials = self._dist_partials
         return out
 
     def cpu(self):
@@ -441,6 +475,8 @@ class Parameter(Tensor):
         p.persistable = True
         p.is_parameter = True
         p.trainable = trainable
+        p._dist_mesh = getattr(t, "_dist_mesh", None)
+        p._dist_partials = getattr(t, "_dist_partials", ())
         p._backward_hooks = []
         p.optimize_attr = {"learning_rate": 1.0}
         p.regularizer = None

@@ -35,6 +35,25 @@ from .collective import (  # noqa: F401
     scatter,
     send,
 )
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_fn,
+    get_mesh,
+    local_map,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
 
 
 def __getattr__(name):

@@ -1,0 +1,16 @@
+"""paddle.distributed.auto_parallel parity — TPU-native DistTensor over
+jax.sharding (SURVEY.md §2.5 auto-parallel row)."""
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_fn,
+    local_map,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)

@@ -1,0 +1,387 @@
+"""Auto-parallel dygraph API: shard_tensor / reshard / shard_layer / ...
+
+Reference surface: python/paddle/distributed/auto_parallel/api.py
+(`shard_tensor :220`, `reshard :733`, `shard_layer :844`, `shard_optimizer`,
+`dtensor_from_fn`, `unshard_dtensor`) over the C++ `DistTensor`
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39) + 115 SPMD
+propagation rule files (paddle/phi/infermeta/spmd_rules/).
+
+TPU-native redesign: a DistTensor IS a global `jax.Array` with a
+`NamedSharding` — placement propagation through ops (the reference's 115
+hand-written SPMD rules) is delegated to XLA's GSPMD sharding propagation,
+and `reshard` is `jax.device_put` with a new sharding (XLA emits the
+collective-permute / all-gather / slice sequence over ICI). The ProcessMesh
+compiles to a `jax.sharding.Mesh`; placements compile to `PartitionSpec`s
+(placement.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding
+
+from ...core.tensor import Parameter, Tensor
+from .placement import (Partial, Placement, Replicate, Shard,
+                        placements_to_spec, spec_to_placements)
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+
+
+def _as_process_mesh(mesh) -> ProcessMesh:
+    if isinstance(mesh, ProcessMesh):
+        return mesh
+    return ProcessMesh(mesh)
+
+
+def _clone_param(src: Parameter, arr) -> Parameter:
+    """New Parameter over `arr` carrying all of src's per-param attributes
+    (optimize_attr drives per-param LR in Optimizer.step)."""
+    out = Parameter(arr, name=src.name, trainable=src.trainable)
+    out.optimize_attr = dict(src.optimize_attr)
+    out.regularizer = src.regularizer
+    out.need_clip = src.need_clip
+    out.is_distributed = src.is_distributed
+    out.sequence_parallel = src.sequence_parallel
+    out.split_axis = src.split_axis
+    return out
+
+
+def _named_sharding(mesh: ProcessMesh, placements: Sequence[Placement],
+                    ndim: int):
+    spec, partials = placements_to_spec(placements, mesh.dim_names, ndim)
+    return NamedSharding(mesh.jax_mesh, spec), partials
+
+
+def shard_tensor(data, mesh, placements: Sequence[Placement], dtype=None,
+                 place=None, stop_gradient: Optional[bool] = None) -> Tensor:
+    """Create a DistTensor laid out over `mesh` per `placements`.
+
+    Reference: auto_parallel/api.py:220. The global value is `data`; each
+    device holds the shard selected by its mesh coordinates.
+    """
+    mesh = _as_process_mesh(mesh)
+    if isinstance(data, Tensor):
+        src = data
+        arr = data._data
+        if dtype is not None:
+            from ...core import dtype as dtype_mod
+
+            arr = arr.astype(dtype_mod.to_np(dtype))
+    else:
+        src = None
+        arr = Tensor(data, dtype=dtype)._data
+    # `place` is accepted for signature parity; the mesh decides placement.
+    sharding, partials = _named_sharding(mesh, placements, arr.ndim)
+    arr = jax.device_put(arr, sharding)
+    if src is not None and isinstance(src, Parameter):
+        out = _clone_param(src, arr)
+    else:
+        out = Tensor._from_data(arr)
+    if src is not None:
+        # differentiable identity (layout change only) — keep the tape edge
+        out._grad_node = src._grad_node
+        out._out_index = src._out_index
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    elif src is not None:
+        out.stop_gradient = src.stop_gradient
+    out._dist_mesh = mesh
+    out._dist_partials = partials
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh, placements: Sequence[Placement],
+                    *args, **kwargs) -> Tensor:
+    """Build a DistTensor from a creation fn (paddle.ones, ...). The fn runs
+    once; the result is laid out over the mesh (reference keeps only the
+    local shard — identical semantics on a single controller)."""
+    out = fn(*args, **kwargs)
+    if not isinstance(out, Tensor):
+        out = Tensor(out)
+    return shard_tensor(out, mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh, placements: Sequence[Placement]) -> Tensor:
+    """Change mesh/placements. Reference: api.py:733 + the C++/python reshard
+    function zoo (phi/core/distributed/auto_parallel/reshard/,
+    auto_parallel/static/reshard_funcs/) — p_to_r, r_to_s, s_to_r, nd-mesh
+    cross-mesh... all collapse to one `jax.device_put` here: XLA plans the
+    move (slice / all-gather / permute) from the (src, dst) sharding pair.
+    """
+    mesh = _as_process_mesh(mesh)
+    x = dist_tensor if isinstance(dist_tensor, Tensor) else Tensor(dist_tensor)
+    sharding, partials = _named_sharding(mesh, placements, x._data.ndim)
+    arr = jax.device_put(x._data, sharding)
+    if isinstance(x, Parameter):
+        out = _clone_param(x, arr)
+        out.stop_gradient = x.stop_gradient
+    else:
+        out = Tensor._from_data(arr, stop_gradient=x.stop_gradient)
+    out._grad_node = x._grad_node
+    out._out_index = x._out_index
+    out._dist_mesh = mesh
+    out._dist_partials = partials
+    return out
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """Back to a dense replicated tensor (reference: api.py unshard_dtensor)."""
+    x = dist_tensor
+    mesh = x._dist_mesh
+    if mesh is None:
+        return x
+    out = reshard(x, mesh, [Replicate() for _ in mesh.dim_names])
+    out._dist_mesh = None
+    out._dist_partials = ()
+    return out
+
+
+def shard_layer(layer, process_mesh, shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard a Layer's parameters in place (reference: api.py:844).
+
+    `shard_fn(sublayer_name, sublayer, process_mesh)` shards each sublayer's
+    params; default replicates everything over the mesh. `input_fn/output_fn`
+    are installed as forward pre/post hooks.
+    """
+    mesh = _as_process_mesh(process_mesh)
+
+    def _replicate_params(sub):
+        for name, p in list(sub._parameters.items()):
+            if p is not None and not p.is_dist():
+                sub._parameters[name] = _shard_param(
+                    p, mesh, [Replicate() for _ in mesh.dim_names])
+
+    if shard_fn is None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            _replicate_params(sub)
+    else:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, mesh)
+        # any param the shard_fn skipped is replicated
+        for name, sub in layer.named_sublayers(include_self=True):
+            _replicate_params(sub)
+
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, mesh))
+    return layer
+
+
+def _shard_param(p: Parameter, mesh: ProcessMesh,
+                 placements: Sequence[Placement]) -> Parameter:
+    out = shard_tensor(p, mesh, placements)
+    out.stop_gradient = p.stop_gradient
+    out.trainable = p.trainable
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shard_optimizer + sharding-stage plans (reference: api.py shard_optimizer,
+# ShardingStage1/2/3 in python/paddle/distributed/auto_parallel/api.py)
+# ---------------------------------------------------------------------------
+
+class _ShardingStageBase:
+    def __init__(self, sharding_mesh_dim: Optional[str] = None, mesh=None):
+        self.sharding_mesh_dim = sharding_mesh_dim
+        self._mesh = _as_process_mesh(mesh) if mesh is not None else None
+
+    @property
+    def mesh(self) -> Optional[ProcessMesh]:
+        """Explicit mesh, else the global mesh from `set_mesh` (reference
+        resolves stages against the default mesh the same way)."""
+        if self._mesh is not None:
+            return self._mesh
+        return get_mesh()
+
+
+class ShardingStage1(_ShardingStageBase):
+    """ZeRO-1: shard optimizer accumulators over the sharding mesh dim."""
+
+
+class ShardingStage2(_ShardingStageBase):
+    """ZeRO-2: + gradients reduce-scattered (on XLA the backward psum over
+    the sharding axis is re-associated to reduce-scatter by the compiler when
+    the consuming update is sharded — stage1 and stage2 share one plan)."""
+
+
+class ShardingStage3(_ShardingStageBase):
+    """ZeRO-3: + parameters sharded (gathered on use)."""
+
+
+def _stage_placements(mesh: ProcessMesh, dim: str, ndim: int, shape):
+    """Shard dim-0 over the sharding axis when divisible, else replicate."""
+    placements = [Replicate() for _ in mesh.dim_names]
+    if ndim > 0 and shape and shape[0] % mesh.get_dim_size(dim) == 0:
+        placements[mesh.dim_names.index(dim)] = Shard(0)
+    return placements
+
+
+class _ShardedOptimizer:
+    """Wraps an Optimizer so accumulators (and for stage3, params) are laid
+    out over the sharding axis as they are created/updated."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+        if isinstance(shard_fn, ShardingStage3) and shard_fn.mesh is not None:
+            dim = shard_fn.sharding_mesh_dim or shard_fn.mesh.dim_names[0]
+            params = optimizer._parameter_list or []
+            for p in params:
+                if isinstance(p, Parameter) and not p.is_dist():
+                    pl = _stage_placements(shard_fn.mesh, dim, p.ndim, p.shape)
+                    sharding, _ = _named_sharding(shard_fn.mesh, pl, p.ndim)
+                    p._data = jax.device_put(p._data, sharding)
+                    p._dist_mesh = shard_fn.mesh
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        fn = self._shard_fn
+        if isinstance(fn, _ShardingStageBase) and fn.mesh is not None:
+            dim = fn.sharding_mesh_dim or fn.mesh.dim_names[0]
+            for pname, accs in self._inner._accumulators.items():
+                for aname, arr in accs.items():
+                    if hasattr(arr, "ndim") and arr.ndim > 0:
+                        sharding, _ = _named_sharding(
+                            fn.mesh,
+                            _stage_placements(fn.mesh, dim, arr.ndim,
+                                              arr.shape),
+                            arr.ndim)
+                        accs[aname] = jax.device_put(arr, sharding)
+        elif callable(fn) and not isinstance(fn, _ShardingStageBase):
+            # paddle contract: shard_fn(accumulator_name, param, accumulator)
+            # -> (possibly resharded) accumulator tensor.
+            by_name = {p.name: p for p in (self._inner._parameter_list or [])
+                       if isinstance(p, Tensor)}
+            for pname, accs in self._inner._accumulators.items():
+                param = by_name.get(pname)
+                for aname, arr in accs.items():
+                    out = fn(aname, param, Tensor._from_data(arr))
+                    accs[aname] = out._data if isinstance(out, Tensor) else out
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference: paddle.distributed.shard_optimizer. With no shard_fn the
+    accumulators simply inherit each param's sharding (free on XLA: states
+    are computed from sharded params, GSPMD propagates)."""
+    if shard_fn is None:
+        return optimizer
+    return _ShardedOptimizer(optimizer, shard_fn)
+
+
+# ---------------------------------------------------------------------------
+# Misc parity helpers
+# ---------------------------------------------------------------------------
+
+def local_map(fn: Callable, out_placements, in_placements=None,
+              process_mesh=None, reshard_inputs: bool = False):
+    """Run `fn` on per-device local shards (reference: dist.local_map) —
+    implemented with shard_map over the mesh.
+
+    Eager semantics note: this framework's eager arrays never hold un-reduced
+    state, so `Partial` placements are materialized: a Partial *input* is
+    pre-scaled by 1/axis_size (the virtual partials sum to the true value —
+    exact for the linear fns partial values are meaningful for), and a
+    Partial *output* is reduced (psum) over that mesh axis inside the mapped
+    region before being returned.
+    """
+    def wrapper(*args):
+        import jax.numpy as jnp
+        from jax import lax
+
+        mesh = process_mesh
+        if mesh is None:
+            for a in args:
+                if isinstance(a, Tensor) and a.is_dist():
+                    mesh = a._dist_mesh
+                    break
+        if mesh is None:
+            return fn(*args)
+        pmesh = _as_process_mesh(mesh)
+        jmesh = pmesh.jax_mesh
+        arrs, in_specs = [], []
+        for i, a in enumerate(args):
+            x = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+            if in_placements is not None:
+                spec, in_parts = placements_to_spec(in_placements[i],
+                                                    pmesh.dim_names, x.ndim)
+                for ax in in_parts:
+                    x = x / pmesh.get_dim_size(ax)
+            else:
+                sh = getattr(x, "sharding", None)
+                spec = getattr(sh, "spec", None)
+                if spec is None:
+                    spec = jax.sharding.PartitionSpec()
+            cur = getattr(x, "sharding", None)
+            on_mesh = (getattr(cur, "mesh", None) == jmesh
+                       and getattr(cur, "spec", None) == spec)
+            if not on_mesh:
+                multi_dev = cur is not None and len(
+                    getattr(cur, "device_set", ())) > 1
+                if multi_dev and not reshard_inputs:
+                    raise ValueError(
+                        f"local_map input {i} is laid out differently from "
+                        f"in_placements; pass reshard_inputs=True to move it")
+                x = jax.device_put(x, NamedSharding(jmesh, spec))
+            arrs.append(x)
+            in_specs.append(spec)
+        single = not isinstance(out_placements[0], (list, tuple))
+        out_pls = [out_placements] if single else list(out_placements)
+
+        # resolve output ranks (negative Shard dims, validation) by abstract
+        # evaluation of fn over the local shard shapes
+        def _local_aval(x, spec):
+            shape = list(x.shape)
+            for d, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, (tuple, list)) else (entry,)
+                for nm in names:
+                    shape[d] //= pmesh.get_dim_size(nm)
+            return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
+
+        out_avals = jax.eval_shape(
+            fn, *[_local_aval(x, s) for x, s in zip(arrs, in_specs)])
+        aval_list = ([out_avals] if single
+                     else list(out_avals if isinstance(out_avals, (tuple, list))
+                               else [out_avals]))
+        out_specs, out_partials = [], []
+        for pl, av in zip(out_pls, aval_list):
+            spec, partials = placements_to_spec(pl, pmesh.dim_names,
+                                                len(av.shape))
+            out_specs.append(spec)
+            out_partials.append(partials)
+
+        def inner(*xs):
+            outs = fn(*xs)
+            outs_t = (outs,) if single else tuple(outs)
+            reduced = []
+            for o, partials in zip(outs_t, out_partials):
+                for ax in partials:
+                    o = lax.psum(o, ax)
+                reduced.append(o)
+            return reduced[0] if single else tuple(reduced)
+
+        sm = jax.shard_map(inner, mesh=jmesh, in_specs=tuple(in_specs),
+                           out_specs=out_specs[0] if single else tuple(out_specs),
+                           check_vma=False)
+        outs = sm(*arrs)
+
+        def wrap(o):
+            t = Tensor._from_data(o)
+            t._dist_mesh = pmesh
+            return t
+
+        if single:
+            return wrap(outs)
+        return tuple(wrap(o) for o in
+                     (outs if isinstance(outs, (tuple, list)) else [outs]))
+
+    return wrapper

@@ -1,0 +1,138 @@
+"""Tensor placements for auto-parallel (DistTensor) semantics.
+
+Reference: paddle's `Placement` hierarchy used by `shard_tensor`
+(python/paddle/distributed/auto_parallel/api.py:220) and the C++
+`TensorDistAttr` (paddle/phi/core/distributed/auto_parallel/dist_attr.h:81):
+`dims_mapping` + `partial_status` describe, per *mesh* dimension, whether the
+tensor is sharded along it (and on which tensor dim), replicated, or holds
+partial (pending-reduce) values.
+
+TPU-native mapping: a placements list is compiled to a
+`jax.sharding.PartitionSpec` — `Shard(d)` on mesh dim i puts that mesh axis
+name into spec entry d; `Replicate` contributes nothing; `Partial` is carried
+as metadata (XLA's GSPMD resolves partial sums at op boundaries, so an eager
+global `jax.Array` never stores un-reduced state — the flag exists for API
+parity and for sharding-hint propagation).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from jax.sharding import PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    """Pending-reduce placement (reference: ReduceType in dist_attr.h)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type!r})"
+
+
+def placements_to_spec(placements: Sequence[Placement], dim_names: Sequence[str],
+                       ndim: int) -> Tuple[PartitionSpec, Tuple[str, ...]]:
+    """Compile a per-mesh-dim placements list into (PartitionSpec, partial_axes).
+
+    Multiple mesh dims sharding the same tensor dim become a tuple entry
+    (mesh-dim order), matching GSPMD's multi-axis sharding.
+    """
+    if len(placements) != len(dim_names):
+        raise ValueError(
+            f"placements length {len(placements)} != mesh ndim {len(dim_names)}")
+    per_dim: List[List[str]] = [[] for _ in range(ndim)]
+    partial_axes: List[str] = []
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim if p.dim >= 0 else p.dim + ndim
+            if not (0 <= d < ndim):
+                raise ValueError(f"Shard dim {p.dim} out of range for ndim {ndim}")
+            per_dim[d].append(dim_names[mesh_dim])
+        elif isinstance(p, Partial):
+            partial_axes.append(dim_names[mesh_dim])
+        elif not isinstance(p, (Replicate, type(None))):
+            raise TypeError(f"unknown placement {p!r}")
+    entries = []
+    for names in per_dim:
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(tuple(names))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries), tuple(partial_axes)
+
+
+def spec_to_placements(spec: PartitionSpec, dim_names: Sequence[str],
+                       partial_axes: Sequence[str] = ()) -> List[Placement]:
+    """Inverse of placements_to_spec (lossy only for exotic specs)."""
+    placements: List[Placement] = [Replicate() for _ in dim_names]
+    name_to_mesh_dim = {n: i for i, n in enumerate(dim_names)}
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for n in names:
+            if n in name_to_mesh_dim:
+                placements[name_to_mesh_dim[n]] = Shard(tensor_dim)
+    for n in partial_axes:
+        if n in name_to_mesh_dim:
+            placements[name_to_mesh_dim[n]] = Partial()
+    return placements

@@ -1,0 +1,164 @@
+"""ProcessMesh: the logical N-D device topology for auto-parallel.
+
+Reference: `ProcessMesh` (paddle/phi/core/distributed/auto_parallel/
+process_mesh.h:34; python surface python/paddle/distributed/auto_parallel/
+process_mesh.py) — an N-D array of process ranks with named dims.
+
+TPU-native: the mesh compiles to a `jax.sharding.Mesh` over the PJRT device
+list; mesh dim names double as the collective axis names used by shard_map
+and by the fleet hybrid topology ('dp'/'mp'/'pp'/...).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_lock = threading.RLock()
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if isinstance(mesh, ProcessMesh):
+            self._mesh = mesh._mesh.copy()
+            dim_names = dim_names or mesh._dim_names
+        elif mesh is None:
+            if process_ids is None:
+                raise ValueError("either mesh or process_ids is required")
+            self._mesh = np.asarray(process_ids, dtype=np.int64)
+            if shape is not None:
+                self._mesh = self._mesh.reshape(shape)
+        else:
+            self._mesh = np.asarray(mesh, dtype=np.int64)
+            if process_ids is not None and sorted(process_ids) != sorted(
+                    int(x) for x in self._mesh.flatten()):
+                raise ValueError(
+                    f"process_ids {process_ids} inconsistent with mesh "
+                    f"{self._mesh.flatten().tolist()}")
+        if self._mesh.ndim == 0:
+            self._mesh = self._mesh.reshape(1)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        if len(dim_names) != self._mesh.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} rank != mesh ndim {self._mesh.ndim}")
+        if len(set(dim_names)) != len(dim_names):
+            raise ValueError(f"duplicate dim names: {dim_names}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- paddle.distributed.ProcessMesh surface ---------------------------
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(x) for x in self._mesh.flatten()]
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name: str, process_id: int) -> int:
+        idx = np.argwhere(self._mesh == process_id)
+        if idx.size == 0:
+            return -1
+        return int(idx[0][self._dim_names.index(dim_name)])
+
+    def get_submesh_with_dim(self, dim_name: str) -> "ProcessMesh":
+        """The 1-D sub-mesh along `dim_name` containing the current rank."""
+        from ..env import get_rank
+
+        axis = self._dim_names.index(dim_name)
+        r = get_rank()
+        idx = np.argwhere(self._mesh == r)
+        coord = list(idx[0]) if idx.size else [0] * self._mesh.ndim
+        slicer = tuple(slice(None) if i == axis else coord[i]
+                       for i in range(self._mesh.ndim))
+        return ProcessMesh(self._mesh[slicer], [dim_name])
+
+    def get_group(self, dim_name: Optional[str] = None):
+        """Communication Group over this mesh (or a 1-D sub-mesh axis)."""
+        from ..collective import new_group
+
+        if dim_name is None:
+            if self._mesh.ndim != 1:
+                raise ValueError("dim_name required for an N-D mesh")
+            sub = self
+            dim_name = self._dim_names[0]
+        else:
+            sub = self.get_submesh_with_dim(dim_name)
+        devs = _devices_for(sub.process_ids)
+        return new_group(sub.process_ids, axis_name=dim_name, devices=devs)
+
+    # -- TPU-native -------------------------------------------------------
+    @property
+    def jax_mesh(self) -> Mesh:
+        """Compile to a jax Mesh (device objects in process-id order)."""
+        if self._jax_mesh is None:
+            devs = _devices_for(self.process_ids)
+            arr = np.asarray(devs).reshape(self._mesh.shape)
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names},"
+                f" process_ids={self.process_ids})")
+
+
+def _devices_for(process_ids: Sequence[int]):
+    """Map logical process ids to PJRT devices. A jax Mesh must hold distinct
+    devices, so an over-subscribed mesh is a hard error (tests use
+    --xla_force_host_platform_device_count to widen the virtual device set)."""
+    devs = jax.devices()
+    if max(process_ids, default=-1) >= len(devs):
+        raise ValueError(
+            f"ProcessMesh needs process ids {sorted(set(process_ids))} but only "
+            f"{len(devs)} devices are visible; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU tests")
+    return [devs[i] for i in process_ids]
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    """The global mesh set by `set_mesh` (reference: auto_parallel/api.py)."""
+    return _global_mesh
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    with _lock:
+        if not isinstance(mesh, ProcessMesh):
+            mesh = ProcessMesh(mesh)
+        _global_mesh = mesh
+    return _global_mesh
