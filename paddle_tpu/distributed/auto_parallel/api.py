@@ -215,8 +215,10 @@ class ShardingStage3(_ShardingStageBase):
 
 def _stage_placements(mesh: ProcessMesh, dim: str, ndim: int, shape):
     """Shard dim-0 over the sharding axis when divisible, else replicate."""
+    from .placement import dim0_shardable
+
     placements = [Replicate() for _ in mesh.dim_names]
-    if ndim > 0 and shape and shape[0] % mesh.get_dim_size(dim) == 0:
+    if ndim > 0 and dim0_shardable(shape, mesh.get_dim_size(dim)):
         placements[mesh.dim_names.index(dim)] = Shard(0)
     return placements
 
@@ -240,6 +242,15 @@ class _ShardedOptimizer:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+    def minimize(self, loss, *a, **k):
+        # must route through OUR step so the stage sharding applies
+        loss.backward()
+        self.step()
+        self._inner.clear_grad()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
 
     def step(self):
         self._inner.step()

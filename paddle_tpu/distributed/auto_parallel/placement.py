@@ -120,6 +120,14 @@ def placements_to_spec(placements: Sequence[Placement], dim_names: Sequence[str]
     return PartitionSpec(*entries), tuple(partial_axes)
 
 
+def dim0_shardable(shape, nranks: int) -> bool:
+    """The shared ZeRO layout rule: a state/param/grad is laid out Shard(0)
+    over the sharding axis iff dim 0 divides the axis size (else replicated).
+    Single source of truth for the stage1/2/3 plans here and the
+    GroupSharded wrappers (distributed/sharding/group_sharded.py)."""
+    return bool(shape) and shape[0] % nranks == 0
+
+
 def spec_to_placements(spec: PartitionSpec, dim_names: Sequence[str],
                        partial_axes: Sequence[str] = ()) -> List[Placement]:
     """Inverse of placements_to_spec (lossy only for exotic specs)."""

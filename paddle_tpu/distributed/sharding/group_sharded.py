@@ -1,0 +1,286 @@
+"""GroupSharded (ZeRO-2/3) — TPU-native.
+
+Reference design (SURVEY.md §2.5): `GroupShardedStage2`
+(fleet/meta_parallel/sharding/group_sharded_stage2.py:46) registers backward
+hooks that reduce-scatter gradient slices to their owner rank and shards
+optimizer states; `GroupShardedStage3` (group_sharded_stage3.py:85) also
+shards parameter storage, all-gathering each param before use and releasing
+it after, with optional CPU offload.
+
+TPU-native redesign: sharded storage is a *layout*, not a rank-local buffer.
+A param/grad/accumulator "owned by rank r" is a global `jax.Array` laid out
+`Shard(0)` over the sharding group's mesh axis — each device's HBM holds only
+its slice, which IS the ZeRO memory saving. The hook machinery collapses
+into GSPMD data movement:
+
+- stage2: gradients + optimizer states are re-laid-out sharded after
+  backward/step; XLA turns the grad psum feeding a sharded consumer into a
+  reduce-scatter (the EagerReducer/FusedCommBuffer fast path, compiled).
+- stage3: parameter storage itself is sharded; an op consuming the param
+  makes XLA emit the all-gather just-in-time, and dropping the gathered copy
+  after use is automatic (it was a temporary). The reference's manual
+  pre-forward allgather + post-forward release becomes compiler-scheduled.
+- offload: `jax.device_put(..., TransferToMemoryKind("pinned_host"))` analog
+  is exposed via the `offload` flag — states are kept on host memory and
+  streamed in for the update.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Parameter
+from ...nn.layer.layers import Layer
+from .. import collective as coll
+
+
+def _group_sharding(group: coll.Group, ndim: int, shape) -> Optional[NamedSharding]:
+    """Shard(0) over the group axis when dim-0 divides; else replicated
+    (rule shared with the auto-parallel stage plans via dim0_shardable)."""
+    from ..auto_parallel.placement import dim0_shardable
+
+    if group is None or group.mesh is None or group.nranks <= 1:
+        return None
+    if ndim > 0 and dim0_shardable(shape, group.nranks):
+        return NamedSharding(group.mesh, P(group.axis_name))
+    return NamedSharding(group.mesh, P())
+
+
+def _to_host(arr):
+    """Offload: host-backed storage (pinned_host memory kind when the backend
+    supports it; falls back to committed device storage otherwise)."""
+    try:
+        sh = arr.sharding.with_memory_kind("pinned_host")
+        return jax.device_put(arr, sh)
+    except Exception:
+        return arr
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer wrapper sharding states (and grads pre-step) over the group.
+
+    Reference: GroupShardedOptimizerStage2
+    (fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py).
+    """
+
+    def __init__(self, params: List[Parameter], optim, group: Optional[coll.Group] = None,
+                 offload: bool = False, device: str = "tpu", **kw):
+        self._optim = optim
+        self._group = group or coll._get_or_init_default()
+        self._offload = offload
+        self._params = list(params)
+        # params must live on the group's device set so the raw-array
+        # optimizer math can combine them with mesh-sharded grads/states;
+        # params already laid out there (e.g. stage3-sharded) are left alone
+        if self._group.mesh is not None and self._group.nranks > 1:
+            repl = NamedSharding(self._group.mesh, P())
+            for p in self._params:
+                if len(getattr(p._data.sharding, "device_set", ())) <= 1:
+                    p._data = jax.device_put(p._data, repl)
+
+    def __getattr__(self, name):
+        return getattr(self._optim, name)
+
+    def _shard_grads(self):
+        """Reduce-scatter analog: lay grads out over the sharding axis so the
+        optimizer update reads only local slices."""
+        for p in self._params:
+            if p._grad is None:
+                continue
+            sh = _group_sharding(self._group, getattr(p._grad, "ndim", 0),
+                                 getattr(p._grad, "shape", ()))
+            if sh is not None and sh.spec != P():
+                p._grad = jax.device_put(p._grad, sh)
+
+    def _shard_states(self):
+        accs = getattr(self._optim, "_accumulators", None)
+        if accs is None:
+            return
+        for pname, d in accs.items():
+            for aname, arr in d.items():
+                sh = _group_sharding(self._group, getattr(arr, "ndim", 0),
+                                     getattr(arr, "shape", ()))
+                if sh is not None and sh.spec != P():
+                    arr = jax.device_put(arr, sh)
+                if self._offload:
+                    arr = _to_host(arr)
+                d[aname] = arr
+
+    def _restore_states(self):
+        """Stream offloaded accumulators back to device HBM for the update."""
+        accs = getattr(self._optim, "_accumulators", None)
+        if accs is None:
+            return
+        for d in accs.values():
+            for aname, arr in d.items():
+                try:
+                    if arr.sharding.memory_kind not in (None, "device"):
+                        d[aname] = jax.device_put(
+                            arr, arr.sharding.with_memory_kind("device"))
+                except Exception:
+                    pass
+
+    def step(self):
+        self._shard_grads()
+        if self._offload:
+            self._restore_states()
+        self._optim.step()
+        self._shard_states()
+
+    def clear_grad(self, *a, **k):
+        self._optim.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class GroupShardedStage2(Layer):
+    """ZeRO-2 model wrapper (reference: group_sharded_stage2.py:46)."""
+
+    def __init__(self, layer: Layer, sharding_optimizer, group: Optional[coll.Group] = None,
+                 sync_buffers: bool = False, buffer_max_size: int = 2 ** 23,
+                 auto_refresh_trainable: bool = True, device: str = "tpu",
+                 dp_group=None, **kw):
+        super().__init__()
+        self._layers = layer
+        self._group = group or coll._get_or_init_default()
+        self._sharding_optimizers = (
+            sharding_optimizer if isinstance(sharding_optimizer, (list, tuple))
+            else [sharding_optimizer])
+        if sync_buffers and self._group.nranks > 1:
+            for b in layer.buffers():
+                coll.broadcast(b, src=self._group.ranks[0], group=self._group)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def train(self):
+        self._layers.train()
+        return super().train()
+
+    def eval(self):
+        self._layers.eval()
+        return super().eval()
+
+    def to(self, *a, **k):
+        self._layers.to(*a, **k)
+        return self
+
+    def grad_scale(self):
+        """Reference scales grads by 1/world after accumulation; with the
+        global-array design gradients are already globally correct."""
+        return
+
+
+class GroupShardedStage3(Layer):
+    """ZeRO-3 model wrapper (reference: group_sharded_stage3.py:85): param
+    STORAGE is sharded over the group. On XLA the just-in-time all-gather and
+    post-use release are compiler-scheduled; here we (re)lay out every param
+    Shard(0) over the group axis and keep optimizer states in the same
+    layout."""
+
+    def __init__(self, layer: Layer, optimizer=None, group: Optional[coll.Group] = None,
+                 sync_buffers: bool = False, device: str = "tpu",
+                 segment_size: int = 2 ** 20, pretrain_sync_models: bool = True,
+                 offload: bool = False, sync_comm: bool = False,
+                 dp_group=None, exclude_layer=None, param2buffer_size=None, **kw):
+        super().__init__()
+        self._layers = layer
+        self._group = group or coll._get_or_init_default()
+        self._offload = offload
+        self._optim = optimizer
+        self._shard_parameters()
+        if sync_buffers and self._group.nranks > 1:
+            for b in layer.buffers():
+                coll.broadcast(b, src=self._group.ranks[0], group=self._group)
+
+    def _shard_parameters(self):
+        for p in self._layers.parameters():
+            sh = _group_sharding(self._group, p.ndim, p.shape)
+            if sh is not None:
+                p._data = jax.device_put(p._data, sh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        out = self._layers.set_state_dict(sd, *a, **k)
+        self._shard_parameters()
+        return out
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def train(self):
+        self._layers.train()
+        return super().train()
+
+    def eval(self):
+        self._layers.eval()
+        return super().eval()
+
+    def get_all_parameters(self, convert2cpu: bool = False):
+        """Gather full (replicated) params (reference: stage3
+        get_all_parameters — the pre-save gather)."""
+        for p in self._layers.parameters():
+            if self._group.mesh is not None and self._group.nranks > 1:
+                p._data = jax.device_put(
+                    p._data, NamedSharding(self._group.mesh, P()))
+        return self._layers.parameters()
+
+
+class GroupShardedScaler:
+    """AMP loss-scaler wrapper for group-sharded models (reference:
+    group_sharded_utils.py GroupShardedScaler). bf16-first TPU training
+    rarely needs it; kept for fp16 parity — found_inf is implicitly global
+    because gradients are global arrays."""
+
+    def __init__(self, scaler):
+        self._scaler = scaler
+
+    def __getattr__(self, name):
+        return getattr(self._scaler, name)
+
+    def scale(self, loss):
+        return self._scaler.scale(loss)
+
+    def step(self, optimizer):
+        self._scaler.step(optimizer)
+
+    def unscale_(self, optimizer):
+        return self._scaler.unscale_(optimizer)
+
+    def minimize(self, optimizer, scaled_loss):
+        return self._scaler.minimize(optimizer, scaled_loss)
+
+    def update(self):
+        if hasattr(self._scaler, "update"):
+            self._scaler.update()

@@ -1,0 +1,3 @@
+"""paddle.incubate parity — fused ops, MoE, experimental APIs."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed.models.moe parity (SURVEY.md §2.5 EP/MoE)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer, dispatch_onehots  # noqa: F401

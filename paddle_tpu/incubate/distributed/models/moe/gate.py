@@ -1,0 +1,107 @@
+"""MoE gates.
+
+Reference: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, gshard_gate.py, switch_gate.py) — a gate maps token features
+to (top-k expert indices, combine weights) and records a load-balancing
+auxiliary loss.
+
+TPU-native notes: everything is dense top-k over [N, E] score matrices (MXU
+matmul + lax.top_k) — no host-side index math, so gates run inside jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.layer.layers import Layer
+from ..... import ops
+
+
+class BaseGate(Layer):
+    def __init__(self, num_expert: int, world_size: int = 1):
+        super().__init__()
+        self.world_size = max(world_size, 1)
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * self.world_size
+        self.loss = None
+
+    def get_loss(self, clear: bool = True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def _balance_loss(self, probs: Tensor, topi) -> Tensor:
+        """E * sum(me * ce): me (mean router prob per expert) stays on the
+        tape so the balance term trains the router; ce (top-1 assignment
+        fraction) is a grad-constant, as in the reference/GShard."""
+        p = probs._data if isinstance(probs, Tensor) else probs
+        i1 = (topi._data if isinstance(topi, Tensor) else topi)[..., 0]
+        ce = jnp.mean(jax.nn.one_hot(i1, self.tot_expert, dtype=p.dtype),
+                      axis=0)
+        me = ops.get_op("mean")(probs, 0)
+        weighted = me * Tensor._from_data(ce)
+        return ops.get_op("sum")(weighted) * float(self.tot_expert)
+
+
+class NaiveGate(BaseGate):
+    """Linear scores + top-k (reference: naive_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        from .....nn.layer.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp: Tensor):
+        gate_score = self.gate(inp)
+        topv, topi = ops.get_op("topk")(gate_score, self.top_k)
+        gate_val = ops.get_op("softmax")(topv, -1)
+        return topi, gate_val
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balance aux loss + capacity (reference:
+    gshard_gate.py; GShard paper §2.2)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), group=None,
+                 random_routing: bool = True):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity = capacity
+
+    def forward(self, inp: Tensor):
+        gate_score = self.gate(inp)
+        probs = ops.get_op("softmax")(gate_score, -1)
+        topv, topi = ops.get_op("topk")(probs, self.top_k)
+        self.loss = self._balance_loss(probs, topi)
+        denom = ops.get_op("sum")(topv, -1, keepdim=True) + 1e-9
+        return topi, topv / denom
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch-transformer gate (reference: switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(num_expert, world_size)
+        from .....nn.layer.common import Linear
+
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = 1
+        self.switch_eps = switch_eps
+
+    def forward(self, inp: Tensor):
+        score = self.gate(inp)
+        if self.training:
+            noise = ops.get_op("uniform")(
+                score.shape, "float32", -self.switch_eps, self.switch_eps)
+            score = score + noise
+        probs = ops.get_op("softmax")(score, -1)
+        topv, topi = ops.get_op("topk")(probs, 1)
+        self.loss = self._balance_loss(probs, topi)
+        return topi, topv

@@ -1,0 +1,195 @@
+"""paddle.incubate.nn.functional parity — fused ops.
+
+Reference: python/paddle/incubate/nn/functional/* backed by hand-written CUDA
+fusion kernels (paddle/phi/kernels/fusion/gpu). TPU-native: these are
+expressed as compact jax compositions — XLA fuses them into single kernels
+on TPU (the whole point of the reference's fused_* zoo is to do manually
+what XLA does automatically); Pallas variants take over where XLA's fusion
+is insufficient (attention — see paddle_tpu/ops/pallas/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....ops.dispatch import register_op
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@register_op(name="fused_rms_norm")
+def _fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                    begin_norm_axis=-1):
+    """Reference: incubate/nn/functional/fused_rms_norm.py (fusion kernel
+    fused_rms_norm_kernel.cu) — normalizes over axes [begin_norm_axis, ndim)."""
+    bna = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    axes = tuple(range(bna, x.ndim))
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes,
+                   keepdims=True)
+    out = x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+    out = out * norm_weight.astype(jnp.float32).reshape(x.shape[bna:])
+    if norm_bias is not None:
+        out = out + norm_bias.astype(jnp.float32).reshape(x.shape[bna:])
+    return out.astype(x.dtype)
+
+
+@register_op(name="swiglu")
+def _swiglu(x, y=None):
+    """Reference: incubate/nn/functional/swiglu.py: silu(x) * y (y defaults
+    to the second half of x split on the last dim)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@register_op(name="fused_rotary_position_embedding")
+def _fused_rope(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                use_neox_rotary_style=True, time_major=False,
+                rotary_emb_base=10000.0):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+    q/k/v: [B, T, H, D]."""
+    def rope(x):
+        if x is None:
+            return None
+        B, T, H, D = x.shape
+        if sin is None or cos is None:
+            pos = jnp.arange(T)
+            inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2,
+                                                        dtype=jnp.float32) / D))
+            ang = pos[:, None] * inv[None, :]
+            s = jnp.sin(ang)
+            c = jnp.cos(ang)
+        else:
+            # sin/cos given as [1, T, 1, D] (interleaved pairs) or [T, D/2]
+            s = jnp.squeeze(jnp.asarray(sin))
+            c = jnp.squeeze(jnp.asarray(cos))
+            if s.shape[-1] == D:
+                s = s[..., ::2]
+                c = c[..., ::2]
+            if s.ndim == 1:
+                s = s[None, :]
+                c = c[None, :]
+        if position_ids is not None:
+            pid = jnp.asarray(position_ids)  # [B, T]
+            s = jnp.take(s, pid, axis=0)     # [B, T, D/2]
+            c = jnp.take(c, pid, axis=0)
+            s = s[:, :, None, :]
+            c = c[:, :, None, :]
+        else:
+            s = s[None, :, None, :]
+            c = c[None, :, None, :]
+        if use_neox_rotary_style:
+            x1 = x[..., : D // 2]
+            x2 = x[..., D // 2:]
+            o1 = x1 * c - x2 * s
+            o2 = x2 * c + x1 * s
+            return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+    outs = tuple(rope(t) for t in (q, k, v))
+    return tuple(o for o in outs if o is not None) if (k is not None or
+                                                       v is not None) else outs[0]
+
+
+@register_op(name="fused_bias_dropout_residual_layer_norm")
+def _fused_bias_dropout_residual_ln(x, residual, bias=None, ln_scale=None,
+                                    ln_bias=None, dropout_rate=0.0,
+                                    ln_epsilon=1e-5, training=False, seed=0):
+    """Reference: incubate/nn/functional/fused_layer_norm.py family."""
+    y = x if bias is None else x + bias
+    if training and dropout_rate > 0.0:
+        from ....core.rng import next_key
+
+        key = jax.random.PRNGKey(seed) if seed else next_key()
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, y.shape)
+        y = jnp.where(keep, y / (1.0 - dropout_rate), 0.0)
+    y = y + residual
+    mean = jnp.mean(y, axis=-1, keepdims=True)
+    var = jnp.var(y, axis=-1, keepdims=True)
+    out = (y - mean) * jax.lax.rsqrt(var + ln_epsilon)
+    if ln_scale is not None:
+        out = out * ln_scale
+    if ln_bias is not None:
+        out = out + ln_bias
+    return out.astype(x.dtype)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method: str = "None", moe_topk: int = 2,
+              norm_topk_prob: bool = True, group_moe: bool = False):
+    """Fused gated MoE FFN (reference: incubate/nn/functional/fused_moe.py
+    → phi fused_moe_kernel). Dense einsum dispatch; expert FFNs batched over
+    the expert dim so the MXU sees one big [E,C,·]×[E,·,·] batched matmul.
+
+    x: [B, T, D]; gate_weight: [D, E];
+    ffn1_weight: [E, D, 2F] (gate+up packed, swiglu) or [E, D, F];
+    ffn2_weight: [E, F, D].
+    """
+    xd = _arr(x)
+    gw = _arr(gate_weight)
+    w1 = _arr(ffn1_weight)
+    w2 = _arr(ffn2_weight)
+    B, T, D = xd.shape
+    N = B * T
+    E = gw.shape[-1]
+    xf = xd.reshape(N, D)
+
+    def kernel(x3, gw, w1, w2, b1, b2):
+        from ...distributed.models.moe.moe_layer import (
+            _capacity, dispatch_onehots)
+
+        xf = x3.reshape(N, D)
+        probs = jax.nn.softmax(xf.astype(jnp.float32) @ gw.astype(jnp.float32),
+                               axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+        C = _capacity(N, E, moe_topk, 2.0)
+        ohs = dispatch_onehots(topi, E, C)
+        disp = sum(ohs[1:], ohs[0])
+        comb = sum(oh * topv[:, k][:, None, None] for k, oh in enumerate(ohs))
+        xe = jnp.einsum("nd,nec->ecd", xf.astype(jnp.float32), disp)
+        xe = xe.astype(xd.dtype)
+        h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(xe.dtype))
+        if b1 is not None:
+            h = h + b1[:, None, :]
+        if w1.shape[-1] == 2 * w2.shape[1]:  # packed swiglu
+            g, u = jnp.split(h, 2, axis=-1)
+            h = jax.nn.silu(g) * u
+        else:
+            h = jax.nn.silu(h)
+        ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(h.dtype))
+        if b2 is not None:
+            ye = ye + b2[:, None, :]
+        y = jnp.einsum("ecd,nec->nd", ye.astype(jnp.float32), comb)
+        return y.reshape(B, T, D).astype(xf.dtype)
+
+    from ....ops.dispatch import call_op
+
+    return call_op("fused_moe", kernel,
+                   (x if isinstance(x, Tensor) else Tensor._from_data(xd),
+                    _as_t(gate_weight), _as_t(ffn1_weight), _as_t(ffn2_weight),
+                    _as_t(ffn1_bias), _as_t(ffn2_bias)), {})
+
+
+def _as_t(x):
+    if x is None or isinstance(x, Tensor):
+        return x
+    return Tensor._from_data(jnp.asarray(x))
+
+
+# Public names (reference: incubate/nn/functional/__init__.py)
+from ....ops.dispatch import OPS as _OPS
+
+fused_rms_norm = _OPS["fused_rms_norm"]
+swiglu = _OPS["swiglu"]
+fused_rotary_position_embedding = _OPS["fused_rotary_position_embedding"]
+fused_bias_dropout_residual_layer_norm = _OPS[
+    "fused_bias_dropout_residual_layer_norm"]

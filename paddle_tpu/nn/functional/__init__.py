@@ -245,3 +245,11 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return _C_ops.flatten(x, start_axis, stop_axis)
+
+
+# Context-parallel attention (long-context first-class; SURVEY.md §7)
+from ...ops.ring_attention import (  # noqa: E402, F401
+    ring_attention,
+    ring_attention_shard,
+    sep_attention_shard,
+)

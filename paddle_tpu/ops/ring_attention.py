@@ -1,0 +1,177 @@
+"""Ring attention — blockwise context parallelism over a mesh axis.
+
+The reference has NO ring/blockwise attention (SURVEY.md §2.5 CP row: grep
+confirms none; PaddleNLP builds Ulysses-style attention on the `sep` process
+groups). This module is the capability-parity-PLUS deliverable recorded in
+SURVEY.md §7: long-context as first-class.
+
+Design (Ring Attention, Liu et al. 2023; PAPERS.md): Q stays resident,
+K/V blocks rotate around the ring via `lax.ppermute` (compiled to
+collective-permute riding ICI neighbor links — bandwidth-optimal, overlaps
+with the block attention compute); softmax is accumulated online
+(flash-attention style running max/sum), so the full [T, T] score matrix
+never materializes and sequence length scales linearly with ring size.
+
+Two entry points:
+- `ring_attention_shard(q, k, v, axis_name, causal)`: traced form, call
+  inside `shard_map`/`pjit` where `axis_name` is a bound mesh axis and
+  q/k/v hold this shard's sequence block [B, T_local, H, D].
+- `ring_attention(q, k, v, group, causal)`: eager form over a
+  `paddle_tpu.distributed` Group — lays the global tensors out over the
+  group's mesh axis (seq dim) and runs the compiled shard_map.
+
+Ulysses/sep alternative (`sep_attention_shard`): all-to-all converts
+sequence sharding into head sharding around a dense attention — the design
+the reference's `sep` topology dimension exists to serve
+(fleet/base/topology.py:189).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One [B,Tq,H,D]x[B,Tk,H,D] attention block → (pv, row_max, row_sum)
+    with the running-softmax statistics (never materializes softmax)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                       # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # rows with every key masked: exp(NEG_INF - NEG_INF) = 1 → zero them
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                       # [B,H,Tq]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return pv, m, l
+
+
+def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
+                         scale=None):
+    """Blockwise ring attention on sequence-sharded q/k/v [B, Tl, H, D].
+
+    Must run inside a mapped context binding `axis_name`. Returns [B,Tl,H,D].
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32)
+    q_pos = me * Tl + jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry):
+        kb, vb, acc, m_run, l_run = carry
+        kv_rank = (me - s) % n
+        if causal:
+            k_pos = kv_rank * Tl + jnp.arange(Tl)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+        else:
+            mask = None
+        pv, m_blk, l_blk = _block_attend(qf, kb.astype(jnp.float32),
+                                         vb, scale, mask)
+        m_new = jnp.maximum(m_run, m_blk)
+        corr = jnp.exp(m_run - m_new)
+        blk = jnp.exp(m_blk - m_new)
+        acc = (acc * corr[..., None].transpose(0, 2, 1, 3)
+               + pv * blk[..., None].transpose(0, 2, 1, 3))
+        l_run = l_run * corr + l_blk * blk
+        m_run = m_new
+        # rotate K/V to the next neighbor (skipped after the last block)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return kb, vb, acc, m_run, l_run
+
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    carry = (k, v, acc0, m0, l0)
+    # python loop: n is static; XLA overlaps each ppermute with the next
+    # block's attention math
+    for s in range(n):
+        carry = step(s, carry)
+    _, _, acc, m_run, l_run = carry
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = acc / l_safe[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def sep_attention_shard(q, k, v, axis_name: str, causal: bool = True,
+                        scale=None):
+    """Ulysses-style attention: all-to-all seq↔heads, dense attention on the
+    full sequence with H/n local heads, all-to-all back. q/k/v [B,Tl,H,D],
+    H divisible by the axis size."""
+    n = lax.axis_size(axis_name)
+
+    def seq2head(x):  # [B,Tl,H,D] -> [B,T,H/n,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):  # [B,T,H/n,D] -> [B,Tl,H,D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    B, T, Hl, D = qg.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    mask = (jnp.tril(jnp.ones((T, T), bool))[None, None] if causal else None)
+    pv, m, l = _block_attend(qg.astype(jnp.float32), kg.astype(jnp.float32),
+                             vg, scale, mask)
+    out = pv / jnp.where(l == 0, 1.0, l)[..., None].transpose(0, 2, 1, 3)
+    return head2seq(out.astype(q.dtype))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_ring(mesh, axis, causal, impl):
+    fn = ring_attention_shard if impl == "ring" else sep_attention_shard
+
+    def per_shard(q, k, v):
+        return fn(q, k, v, axis, causal=causal)
+
+    sm = jax.shard_map(per_shard, mesh=mesh,
+                       in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                       out_specs=P(None, axis), check_vma=False)
+    return jax.jit(sm)
+
+
+def ring_attention(q, k, v, group=None, causal: bool = True,
+                   impl: str = "ring"):
+    """Eager context-parallel attention over a Group's mesh axis.
+
+    q/k/v: [B, T, H, D] global tensors; T divisible by group size. The seq
+    dim is laid out over the group axis and each device computes its block's
+    ring schedule. Differentiable (routed through the op tape)."""
+    from ..distributed import collective as coll
+    from .dispatch import call_op
+
+    g = group or coll._get_or_init_default()
+
+    def kernel(qa, ka, va):
+        if g.mesh is None or g.nranks <= 1:
+            # degenerate ring of 1: plain flash-style attention
+            B, T, H, D = qa.shape
+            mask = (jnp.tril(jnp.ones((T, T), bool))[None, None]
+                    if causal else None)
+            pv, _, l = _block_attend(qa.astype(jnp.float32),
+                                     ka.astype(jnp.float32), va,
+                                     1.0 / (D ** 0.5), mask)
+            out = pv / jnp.where(l == 0, 1.0, l)[..., None].transpose(
+                0, 2, 1, 3)
+            return out.astype(qa.dtype)
+        sharding = NamedSharding(g.mesh, P(None, g.axis_name))
+        qa, ka, va2 = (jax.device_put(a, sharding) for a in (qa, ka, va))
+        exe = _compiled_ring(g.mesh, g.axis_name, causal, impl)
+        return exe(qa, ka, va2)
+
+    return call_op("ring_attention", kernel, (q, k, v), {})
