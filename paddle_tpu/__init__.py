@@ -150,6 +150,7 @@ from . import static  # noqa: F401, E402
 from . import vision  # noqa: F401, E402
 from . import distributed  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
+from . import profiler  # noqa: F401, E402
 from . import framework  # noqa: F401, E402
 from .framework.io_api import load, save  # noqa: F401, E402
 from .hapi.model import Model  # noqa: F401, E402

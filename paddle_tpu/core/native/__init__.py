@@ -1,0 +1,215 @@
+"""Native runtime bindings (ctypes over the C ABI in src/native.cc).
+
+The .so is built lazily on first import with g++ (cached by source hash in
+~/.cache/paddle_tpu). Every consumer has a pure-Python fallback, so an
+environment without a toolchain still works — `available()` reports which
+path is live (mirrors how the reference gates native fast paths behind
+build flags).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "native.cc")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_and_load():
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get(
+        "PADDLE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+    os.makedirs(cache, exist_ok=True)
+    so = os.path.join(cache, f"libpaddle_tpu_native_{digest}.so")
+    if not os.path.exists(so):
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               _SRC, "-o", tmp, "-lrt"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, so)
+    lib = ctypes.CDLL(so)
+    # signatures
+    lib.pts_server_start.restype = ctypes.c_void_p
+    lib.pts_server_start.argtypes = [ctypes.c_int]
+    lib.pts_server_stop.argtypes = [ctypes.c_void_p]
+    lib.pts_client_connect.restype = ctypes.c_void_p
+    lib.pts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+    lib.pts_client_close.argtypes = [ctypes.c_void_p]
+    lib.pts_set.restype = ctypes.c_int
+    lib.pts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                            ctypes.c_uint64]
+    lib.pts_get.restype = ctypes.c_int64
+    lib.pts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                            ctypes.c_uint64]
+    lib.pts_add.restype = ctypes.c_int64
+    lib.pts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.pts_check.restype = ctypes.c_int
+    lib.pts_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pts_delete.restype = ctypes.c_int
+    lib.pts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shmring_create.restype = ctypes.c_void_p
+    lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmring_attach.restype = ctypes.c_void_p
+    lib.shmring_attach.argtypes = [ctypes.c_char_p]
+    lib.shmring_push.restype = ctypes.c_int
+    lib.shmring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64]
+    lib.shmring_pop.restype = ctypes.c_int64
+    lib.shmring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_uint64]
+    lib.shmring_close.argtypes = [ctypes.c_void_p]
+    lib.shmring_free.argtypes = [ctypes.c_void_p]
+    lib.trace_enable.argtypes = [ctypes.c_int]
+    lib.trace_enabled.restype = ctypes.c_int
+    lib.trace_now_ns.restype = ctypes.c_uint64
+    lib.trace_record.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint64, ctypes.c_uint64]
+    lib.trace_span_count.restype = ctypes.c_uint64
+    lib.trace_dump_json.restype = ctypes.c_int
+    lib.trace_dump_json.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    return lib
+
+
+def get_lib():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            _tried = True
+            try:
+                _lib = _build_and_load()
+            except Exception:
+                _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# Pythonic wrappers
+# ---------------------------------------------------------------------------
+
+class NativeStoreServer:
+    def __init__(self, port: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.pts_server_start(port)
+        if not self._h:
+            raise OSError(f"TCPStore server failed to bind port {port}")
+        self.port = port
+
+    def stop(self):
+        if self._h:
+            self._lib.pts_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class NativeStoreClient:
+    def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.pts_client_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise ConnectionError(f"cannot connect TCPStore {host}:{port}")
+
+    def set(self, key: str, value: bytes):
+        if self._lib.pts_set(self._h, key.encode(), value, len(value)) != 0:
+            raise IOError("TCPStore set failed")
+
+    def get(self, key: str, max_len: int = 1 << 20) -> bytes:
+        buf = ctypes.create_string_buffer(max_len)
+        n = self._lib.pts_get(self._h, key.encode(), buf, max_len)
+        if n < 0:
+            raise IOError("TCPStore get failed")
+        if n > max_len:
+            buf = ctypes.create_string_buffer(n)
+            n = self._lib.pts_get(self._h, key.encode(), buf, n)
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int) -> int:
+        v = self._lib.pts_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise IOError("TCPStore add failed")
+        return v
+
+    def check(self, key: str) -> bool:
+        return self._lib.pts_check(self._h, key.encode()) == 1
+
+    def delete(self, key: str):
+        self._lib.pts_delete(self._h, key.encode())
+
+    def close(self):
+        if self._h:
+            self._lib.pts_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """SPSC shared-memory message ring (DataLoader worker→parent channel)."""
+
+    def __init__(self, name: str, capacity: int = 1 << 24, create: bool = True):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.shmring_create(name.encode(), capacity)
+        else:
+            self._h = lib.shmring_attach(name.encode())
+        if not self._h:
+            raise OSError(f"shm ring {'create' if create else 'attach'} "
+                          f"failed for {name}")
+        self._owner = create
+
+    def push(self, data: bytes):
+        rc = self._lib.shmring_push(self._h, data, len(data))
+        if rc == -1:
+            raise EOFError("ring closed")
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+
+    def pop(self, max_len: int = 1 << 24) -> bytes:
+        buf = ctypes.create_string_buffer(max_len)
+        n = self._lib.shmring_pop(self._h, buf, max_len)
+        if n == -1:
+            raise EOFError("ring closed")
+        if n == -2:
+            raise ValueError("pop buffer too small")
+        return buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.shmring_close(self._h)
+
+    def free(self):
+        if self._h:
+            self._lib.shmring_free(self._h)
+            self._h = None

@@ -1,0 +1,533 @@
+// paddle_tpu native runtime: TCPStore, shared-memory ring buffer, tracer.
+//
+// TPU-native re-implementation of the reference's native runtime services
+// (not a translation):
+//  - TCPStore: rendezvous KV store w/ blocking wait + counters (reference:
+//    paddle/phi/core/distributed/store/tcp_store.h:121 — master socket
+//    server + clients; used by launch/init_parallel_env bootstrap).
+//  - ShmRing: POSIX shared-memory SPSC byte ring for DataLoader
+//    worker→parent batch transfer (reference: the mmap'd shared memory of
+//    python/paddle/io/dataloader_iter.py worker pool + data_feed.cc).
+//  - Tracer: host RecordEvent span collector exported as chrome-trace
+//    (reference: paddle/fluid/platform/profiler/ HostTracer +
+//    chrometracing_logger.cc).
+//
+// Plain C ABI for ctypes binding (no pybind11 in this image).
+//
+// Build: g++ -O2 -fPIC -shared -pthread -lrt native.cc -o libpaddle_tpu_native.so
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ===========================================================================
+// TCPStore
+// ===========================================================================
+// Wire protocol: [1 byte op][u32 keylen][key][u64 vallen][val]
+//   op: 0=SET 1=GET(blocking til present, 2s poll) 2=ADD(i64 delta)
+//       3=WAIT(present?) 4=DELETE 5=PING
+// Reply: [u64 vallen][val] (ADD replies the new counter as i64; WAIT replies
+// 1 byte 0/1)
+
+namespace {
+
+struct StoreServer {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::vector<std::thread> workers;
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void serve_client(StoreServer* s, int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_all(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_all(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_all(fd, &key[0], klen)) break;
+    uint64_t vlen;
+    if (!read_all(fd, &vlen, 8)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_all(fd, &val[0], vlen)) break;
+
+    if (op == 0) {  // SET
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv[key] = val;
+      }
+      s->cv.notify_all();
+      uint64_t zero = 0;
+      if (!write_all(fd, &zero, 8)) break;
+    } else if (op == 1) {  // GET (blocking)
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [&] { return s->stop.load() || s->kv.count(key); });
+      if (s->stop.load()) break;
+      const std::string& v = s->kv[key];
+      uint64_t n = v.size();
+      lk.unlock();
+      if (!write_all(fd, &n, 8) || !write_all(fd, v.data(), v.size())) break;
+    } else if (op == 2) {  // ADD
+      int64_t delta = 0;
+      memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        int64_t cur = 0;
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() >= 8)
+          memcpy(&cur, it->second.data(), 8);
+        now = cur + delta;
+        std::string nv(8, '\0');
+        memcpy(&nv[0], &now, 8);
+        s->kv[key] = nv;
+      }
+      s->cv.notify_all();
+      uint64_t n = 8;
+      if (!write_all(fd, &n, 8) || !write_all(fd, &now, 8)) break;
+    } else if (op == 3) {  // WAIT/check
+      uint8_t present;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        present = s->kv.count(key) ? 1 : 0;
+      }
+      uint64_t n = 1;
+      if (!write_all(fd, &n, 8) || !write_all(fd, &present, 1)) break;
+    } else if (op == 4) {  // DELETE
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv.erase(key);
+      }
+      uint64_t zero = 0;
+      if (!write_all(fd, &zero, 8)) break;
+    } else if (op == 5) {  // PING
+      uint64_t zero = 0;
+      if (!write_all(fd, &zero, 8)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+void* pts_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new StoreServer();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] {
+    for (;;) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (s->stop.load()) return;
+        continue;
+      }
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      s->workers.emplace_back(serve_client, s, cfd);
+    }
+  });
+  return s;
+}
+
+void pts_server_stop(void* handle) {
+  auto* s = static_cast<StoreServer*>(handle);
+  if (!s) return;
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.detach();  // blocked GETs die with process
+  delete s;
+}
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;
+};
+
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new StoreClient();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+static bool request(StoreClient* c, uint8_t op, const char* key,
+                    const void* val, uint64_t vlen, std::string* reply) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_all(c->fd, &op, 1) || !write_all(c->fd, &klen, 4) ||
+      !write_all(c->fd, key, klen) || !write_all(c->fd, &vlen, 8))
+    return false;
+  if (vlen && !write_all(c->fd, val, vlen)) return false;
+  uint64_t rlen;
+  if (!read_all(c->fd, &rlen, 8)) return false;
+  reply->resize(rlen);
+  if (rlen && !read_all(c->fd, &(*reply)[0], rlen)) return false;
+  return true;
+}
+
+int pts_set(void* handle, const char* key, const void* data, uint64_t len) {
+  std::string r;
+  return request(static_cast<StoreClient*>(handle), 0, key, data, len, &r)
+             ? 0 : -1;
+}
+
+// Blocking get; returns value length or -1. Caller passes a buffer.
+int64_t pts_get(void* handle, const char* key, void* buf, uint64_t maxlen) {
+  std::string r;
+  if (!request(static_cast<StoreClient*>(handle), 1, key, nullptr, 0, &r))
+    return -1;
+  uint64_t n = std::min<uint64_t>(r.size(), maxlen);
+  memcpy(buf, r.data(), n);
+  return static_cast<int64_t>(r.size());
+}
+
+int64_t pts_add(void* handle, const char* key, int64_t delta) {
+  std::string r;
+  if (!request(static_cast<StoreClient*>(handle), 2, key, &delta, 8, &r) ||
+      r.size() < 8)
+    return INT64_MIN;
+  int64_t v;
+  memcpy(&v, r.data(), 8);
+  return v;
+}
+
+int pts_check(void* handle, const char* key) {
+  std::string r;
+  if (!request(static_cast<StoreClient*>(handle), 3, key, nullptr, 0, &r) ||
+      r.empty())
+    return -1;
+  return r[0] ? 1 : 0;
+}
+
+int pts_delete(void* handle, const char* key) {
+  std::string r;
+  return request(static_cast<StoreClient*>(handle), 4, key, nullptr, 0, &r)
+             ? 0 : -1;
+}
+
+void pts_client_close(void* handle) {
+  auto* c = static_cast<StoreClient*>(handle);
+  if (!c) return;
+  ::close(c->fd);
+  delete c;
+}
+
+// ===========================================================================
+// ShmRing: SPSC byte ring in POSIX shared memory (process-shared mutex+cv)
+// ===========================================================================
+
+struct ShmHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint64_t capacity;   // data bytes
+  uint64_t head;       // write offset
+  uint64_t tail;       // read offset
+  uint64_t used;       // bytes in ring
+  uint32_t closed;
+};
+
+struct ShmRing {
+  ShmHeader* h = nullptr;
+  char* data = nullptr;
+  size_t total = 0;
+  std::string name;
+  bool owner = false;
+};
+
+void* shmring_create(const char* name, uint64_t capacity) {
+  size_t total = sizeof(ShmHeader) + capacity;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<ShmHeader*>(mem);
+  memset(h, 0, sizeof(ShmHeader));
+  h->capacity = capacity;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_full, &ca);
+  pthread_cond_init(&h->not_empty, &ca);
+  auto* r = new ShmRing();
+  r->h = h;
+  r->data = static_cast<char*>(mem) + sizeof(ShmHeader);
+  r->total = total;
+  r->name = name;
+  r->owner = true;
+  return r;
+}
+
+void* shmring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* r = new ShmRing();
+  r->h = static_cast<ShmHeader*>(mem);
+  r->data = static_cast<char*>(mem) + sizeof(ShmHeader);
+  r->total = static_cast<size_t>(st.st_size);
+  r->name = name;
+  return r;
+}
+
+static void ring_write(ShmRing* r, const char* p, uint64_t n) {
+  uint64_t cap = r->h->capacity;
+  uint64_t head = r->h->head;
+  uint64_t first = std::min(n, cap - head);
+  memcpy(r->data + head, p, first);
+  if (n > first) memcpy(r->data, p + first, n - first);
+  r->h->head = (head + n) % cap;
+  r->h->used += n;
+}
+
+static void ring_read(ShmRing* r, char* p, uint64_t n) {
+  uint64_t cap = r->h->capacity;
+  uint64_t tail = r->h->tail;
+  uint64_t first = std::min(n, cap - tail);
+  memcpy(p, r->data + tail, first);
+  if (n > first) memcpy(p + first, r->data, n - first);
+  r->h->tail = (tail + n) % cap;
+  r->h->used -= n;
+}
+
+// Push one message [u64 len][payload]; blocks while full. 0 ok, -1 closed.
+int shmring_push(void* handle, const void* data, uint64_t len) {
+  auto* r = static_cast<ShmRing*>(handle);
+  uint64_t need = len + 8;
+  if (need > r->h->capacity) return -2;
+  pthread_mutex_lock(&r->h->mu);
+  while (r->h->capacity - r->h->used < need && !r->h->closed)
+    pthread_cond_wait(&r->h->not_full, &r->h->mu);
+  if (r->h->closed) {
+    pthread_mutex_unlock(&r->h->mu);
+    return -1;
+  }
+  ring_write(r, reinterpret_cast<const char*>(&len), 8);
+  ring_write(r, static_cast<const char*>(data), len);
+  pthread_cond_signal(&r->h->not_empty);
+  pthread_mutex_unlock(&r->h->mu);
+  return 0;
+}
+
+// Pop one message into buf; returns payload length, -1 closed+empty,
+// -2 buffer too small (message left in place).
+int64_t shmring_pop(void* handle, void* buf, uint64_t maxlen) {
+  auto* r = static_cast<ShmRing*>(handle);
+  pthread_mutex_lock(&r->h->mu);
+  while (r->h->used == 0 && !r->h->closed)
+    pthread_cond_wait(&r->h->not_empty, &r->h->mu);
+  if (r->h->used == 0 && r->h->closed) {
+    pthread_mutex_unlock(&r->h->mu);
+    return -1;
+  }
+  uint64_t len;
+  uint64_t save_tail = r->h->tail;
+  uint64_t save_used = r->h->used;
+  ring_read(r, reinterpret_cast<char*>(&len), 8);
+  if (len > maxlen) {
+    r->h->tail = save_tail;
+    r->h->used = save_used;
+    pthread_mutex_unlock(&r->h->mu);
+    return -2;
+  }
+  ring_read(r, static_cast<char*>(buf), len);
+  pthread_cond_signal(&r->h->not_full);
+  pthread_mutex_unlock(&r->h->mu);
+  return static_cast<int64_t>(len);
+}
+
+void shmring_close(void* handle) {
+  auto* r = static_cast<ShmRing*>(handle);
+  if (!r) return;
+  pthread_mutex_lock(&r->h->mu);
+  r->h->closed = 1;
+  pthread_cond_broadcast(&r->h->not_empty);
+  pthread_cond_broadcast(&r->h->not_full);
+  pthread_mutex_unlock(&r->h->mu);
+}
+
+void shmring_free(void* handle) {
+  auto* r = static_cast<ShmRing*>(handle);
+  if (!r) return;
+  bool owner = r->owner;
+  std::string name = r->name;
+  munmap(r->h, r->total);
+  if (owner) shm_unlink(name.c_str());
+  delete r;
+}
+
+// ===========================================================================
+// Tracer: RecordEvent spans → chrome trace JSON
+// ===========================================================================
+
+namespace {
+
+struct Span {
+  std::string name;
+  uint64_t tid;
+  uint64_t start_ns;
+  uint64_t end_ns;
+};
+
+std::mutex g_trace_mu;
+std::vector<Span> g_spans;
+std::atomic<bool> g_trace_on{false};
+
+uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+}  // namespace
+
+void trace_enable(int on) { g_trace_on.store(on != 0); }
+int trace_enabled() { return g_trace_on.load() ? 1 : 0; }
+uint64_t trace_now_ns() { return now_ns(); }
+
+void trace_record(const char* name, uint64_t tid, uint64_t start_ns,
+                  uint64_t end_ns) {
+  if (!g_trace_on.load()) return;
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_spans.push_back(Span{name, tid, start_ns, end_ns});
+}
+
+uint64_t trace_span_count() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  return g_spans.size();
+}
+
+void trace_clear() {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  g_spans.clear();
+}
+
+// Chrome-trace JSON (reference: chrometracing_logger.cc output format)
+int trace_dump_json(const char* path, int pid) {
+  std::lock_guard<std::mutex> lk(g_trace_mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[");
+  for (size_t i = 0; i < g_spans.size(); ++i) {
+    const Span& s = g_spans[i];
+    std::string esc;
+    esc.reserve(s.name.size());
+    for (char c : s.name) {
+      if (c == '"' || c == '\\') esc.push_back('\\');
+      if (static_cast<unsigned char>(c) >= 0x20) esc.push_back(c);
+    }
+    fprintf(f,
+            "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%llu,"
+            "\"ts\":%.3f,\"dur\":%.3f}",
+            i ? "," : "", esc.c_str(), pid,
+            static_cast<unsigned long long>(s.tid), s.start_ns / 1000.0,
+            (s.end_ns - s.start_ns) / 1000.0);
+  }
+  fprintf(f, "]}");
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -35,6 +35,8 @@ from .collective import (  # noqa: F401
     scatter,
     send,
 )
+from .store import TCPStore  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

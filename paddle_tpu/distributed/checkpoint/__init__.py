@@ -1,0 +1,185 @@
+"""Distributed checkpoint with reshard-on-load.
+
+Reference: `paddle.distributed.checkpoint` — `save_state_dict`
+(save_state_dict.py:145): each rank writes its local (possibly sharded
+DistTensor) shards to a flat file plus ONE global metadata file of
+shard→offset mappings; `load_state_dict` (load_state_dict.py:467) computes
+the overlap between saved shards and the *current* sharding and reshards on
+load, so checkpoints survive changed parallel configs.
+
+TPU-native: a sharded tensor is a global `jax.Array`; its shards are the
+`addressable_shards` (device slices). Save walks them (deduplicating
+replicas), writes raw bytes + metadata; load assembles the target's needed
+regions from whatever shard layout was saved (the overlap computation) and
+lays the result out with `jax.device_put` onto the live sharding — the
+reference's point-to-point reshard collapses into XLA data movement.
+Multi-host: each process saves only shards it owns (`process_index` match)
+into its own file; load reads all files through the shared directory.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata", "LocalTensorIndex"]
+
+
+def _flatten(sd, prefix="") -> Dict[str, object]:
+    flat = {}
+    for k, v in sd.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def _unflatten_into(sd, flat_updates: Dict[str, object], prefix=""):
+    for k, v in sd.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            _unflatten_into(v, flat_updates, key)
+        elif key in flat_updates:
+            new = flat_updates[key]
+            if isinstance(v, Tensor):
+                v._data = new
+            else:
+                sd[k] = new
+
+
+def _shards_of(arr) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
+    """(global_offset, data) for each distinct shard this process owns."""
+    out = []
+    seen = set()
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return [((0,) * max(arr.ndim, 0), np.asarray(arr))]
+    for s in shards:
+        offset = []
+        for d, sl in enumerate(s.index):
+            start = sl.start if isinstance(sl, slice) and sl.start else 0
+            offset.append(int(start))
+        key = tuple(offset)
+        if key in seen:
+            continue  # replicated copy of a shard we already saved
+        seen.add(key)
+        out.append((key, np.asarray(s.data)))
+    return out
+
+
+def save_state_dict(state_dict: dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    async_save: bool = False):
+    """Write shard files + global metadata (reference: save_state_dict.py:145)."""
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    flat = _flatten(state_dict)
+    meta = Metadata()
+    data_file = f"{rank}_0.distcp"
+    offset = 0
+    with open(os.path.join(path, data_file), "wb") as f:
+        for key, val in flat.items():
+            if val is None:
+                continue
+            arr = val._data if isinstance(val, Tensor) else val
+            if not hasattr(arr, "ndim"):
+                arr = np.asarray(arr)
+            shards = _shards_of(arr)
+            metas = []
+            for goff, data in shards:
+                data = np.ascontiguousarray(data)
+                raw = data.tobytes()
+                metas.append(LocalTensorMetadata(
+                    goff, tuple(int(x) for x in data.shape), str(data.dtype)))
+                meta.storage_metadata[
+                    LocalTensorIndex(key, goff)] = (data_file, offset)
+                f.write(raw)
+                offset += len(raw)
+            meta.state_dict_metadata[key] = metas
+    # every tensor also records its GLOBAL (shape, dtype) for load-time checks
+    meta.flat_mapping = {
+        k: (tuple(int(x) for x in
+                  (v._data if isinstance(v, Tensor) else np.asarray(v)).shape),
+            str((v._data if isinstance(v, Tensor) else np.asarray(v)).dtype))
+        for k, v in flat.items() if v is not None
+    }
+    # every rank writes its own metadata (covering the shards IT owns);
+    # load merges all .metadata files, so multi-host checkpoints assemble
+    with open(os.path.join(path, f"{rank}.metadata"), "wb") as f:
+        pickle.dump(meta, f)
+
+
+def _read_shard(path, file, byte_off, shape, dtype) -> np.ndarray:
+    n = int(np.prod(shape)) if shape else 1
+    with open(os.path.join(path, file), "rb") as f:
+        f.seek(byte_off)
+        buf = f.read(n * np.dtype(dtype).itemsize)
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def load_state_dict(state_dict: dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    offload: bool = False):
+    """Assemble each target tensor from saved shards, then reshard onto the
+    target's live layout (reference: load_state_dict.py:467)."""
+    metas = [fn for fn in os.listdir(path) if fn.endswith(".metadata")]
+    if not metas:
+        raise FileNotFoundError(f"no .metadata file under {path}")
+    meta = Metadata()
+    for fn in sorted(metas):
+        with open(os.path.join(path, fn), "rb") as f:
+            m = pickle.load(f)
+        meta.state_dict_metadata.update(m.state_dict_metadata)
+        meta.storage_metadata.update(m.storage_metadata)
+        meta.flat_mapping.update(m.flat_mapping)
+
+    flat = _flatten(state_dict)
+    updates = {}
+    for key, val in flat.items():
+        if key not in meta.state_dict_metadata:
+            continue
+        shards = meta.state_dict_metadata[key]
+        # reconstruct the global value region-by-region (overlap computation:
+        # every saved shard lands at its global_offset)
+        gshape, _ = meta.flat_mapping.get(key, (None, None))
+        if gshape is None:
+            ends = np.zeros(len(shards[0].global_offset), dtype=int)
+            for sm in shards:
+                ends = np.maximum(
+                    ends, np.asarray(sm.global_offset)
+                    + np.asarray(sm.local_shape))
+            gshape = tuple(int(x) for x in ends)
+        out = np.zeros(gshape, dtype=shards[0].dtype)
+        for sm in shards:
+            file, boff = meta.storage_metadata[
+                LocalTensorIndex(key, sm.global_offset)]
+            data = _read_shard(path, file, boff, sm.local_shape, sm.dtype)
+            if sm.local_shape == () or not gshape:
+                out = data.reshape(gshape)
+                continue
+            idx = tuple(slice(o, o + l) for o, l in
+                        zip(sm.global_offset, sm.local_shape))
+            out[idx] = data
+        cur = val._data if isinstance(val, Tensor) else val
+        if hasattr(cur, "shape") and tuple(cur.shape) != tuple(out.shape):
+            raise ValueError(
+                f"checkpoint shape {out.shape} != target shape "
+                f"{tuple(cur.shape)} for {key!r}")
+        target_dtype = getattr(cur, "dtype", out.dtype)
+        arr = out.astype(target_dtype) if str(out.dtype) != str(
+            target_dtype) else out
+        sharding = getattr(cur, "sharding", None)
+        new = jax.device_put(arr, sharding) if sharding is not None else \
+            jax.numpy.asarray(arr)
+        updates[key] = new
+    _unflatten_into(state_dict, updates)
+    return state_dict

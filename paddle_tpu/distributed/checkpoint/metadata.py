@@ -1,0 +1,40 @@
+"""Checkpoint metadata schema.
+
+Reference: python/paddle/distributed/checkpoint/metadata.py —
+`LocalTensorMetadata` (global_offset, local_shape) + `Metadata` mapping each
+state-dict key to its saved shards and each shard to its storage location.
+The same design carries over unchanged: the metadata file is the global
+shard→offset map that makes reshard-on-load possible.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved shard of one tensor."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of a shard (key + where it sits in the global tensor)."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # key -> list of shards saved for it
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # shard -> (file_name, byte_offset) in the checkpoint dir
+    storage_metadata: Dict[LocalTensorIndex, Tuple[str, int]] = field(
+        default_factory=dict)
+    flat_mapping: Dict[str, List[str]] = field(default_factory=dict)

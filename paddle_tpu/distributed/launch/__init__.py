@@ -1,0 +1,1 @@
+from .main import Pod, launch, main, parse_args  # noqa: F401

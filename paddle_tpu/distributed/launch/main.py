@@ -1,0 +1,168 @@
+"""Distributed launcher — `python -m paddle_tpu.distributed.launch`.
+
+Reference: python/paddle/distributed/launch/main.py:23 +
+CollectiveController.build_pod (launch/controllers/collective.py:22,:37):
+build a Pod of per-device worker procs with rank env
+(PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/endpoints), a master KV for
+rendezvous (HTTP or etcd there), per-rank log files, a watcher that monitors
+children and restarts the pod up to --max_restart times (elastic manager:
+fleet/elastic/manager.py:125).
+
+TPU-native: one worker per HOST (PJRT owns all local chips; JAX's
+distributed runtime is process-per-host), not per device. The master KV is
+our native TCPStore (core/native/src/native.cc). Worker env carries
+PADDLE_TRAINER_ID + PADDLE_MASTER, which init_parallel_env and
+jax.distributed.initialize consume.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training (process-per-host on TPU)")
+    p.add_argument("--master", type=str, default=None,
+                   help="master endpoint ip:port for rendezvous")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count, or min:max for elastic")
+    p.add_argument("--rank", type=int, default=-1, help="node rank")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="procs per node (default 1: PJRT owns local chips)")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="visible device ids for this node")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Pod:
+    """A node's worker processes (reference: launch/job/pod.py)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs: List[subprocess.Popen] = []
+        self.logs = []
+
+    def spawn(self, node_rank: int, nnodes: int, store_port: int):
+        nproc = self.args.nproc_per_node or 1
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        world = nnodes * nproc
+        master_host = (self.args.master.split(":")[0]
+                       if self.args.master else "127.0.0.1")
+        for lr in range(nproc):
+            rank = node_rank * nproc + lr
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(lr),
+                "PADDLE_MASTER": f"{master_host}:{store_port}",
+                "PADDLE_JOB_ID": self.args.job_id,
+                # jax.distributed.initialize() picks these up
+                "JAX_COORDINATOR_ADDRESS": f"{master_host}:{store_port + 1}",
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+            })
+            if self.args.devices:
+                env["CUDA_VISIBLE_DEVICES"] = self.args.devices
+                env["TPU_VISIBLE_DEVICES"] = self.args.devices
+            log_path = os.path.join(self.args.log_dir,
+                                    f"workerlog.{rank}")
+            logf = open(log_path, "a")
+            cmd = [sys.executable, "-u", self.args.training_script,
+                   *self.args.training_script_args]
+            proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+            self.procs.append(proc)
+            self.logs.append(logf)
+
+    def watch(self) -> int:
+        """Block until all exit ok (0) or any fails (its code)."""
+        while True:
+            alive = False
+            for p in self.procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.5)
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self.logs:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self.procs.clear()
+        self.logs.clear()
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    node_rank = args.rank if args.rank >= 0 else int(
+        os.environ.get("PADDLE_NODE_RANK", "0"))
+    # master KV server lives on node 0 (reference: controllers/master.py)
+    store = None
+    if args.master:
+        port = int(args.master.split(":")[1])
+    else:
+        port = int(os.environ.get("PADDLE_MASTER_PORT", "29750"))
+    if node_rank == 0:
+        from ..store import TCPStore
+
+        try:
+            store = TCPStore("127.0.0.1", port, is_master=True,
+                             world_size=nnodes)
+        except OSError:
+            store = None  # external master already running
+
+    restarts = 0
+    try:
+        while True:
+            pod = Pod(args)
+            pod.spawn(node_rank, nnodes, port)
+            rc = pod.watch()
+            if rc == 0:
+                print(f"[launch] job {args.job_id} finished OK")
+                return 0
+            pod.terminate()
+            restarts += 1
+            if restarts > args.max_restart:
+                print(f"[launch] worker failed (exit {rc}); restart budget "
+                      f"exhausted after {restarts - 1} retries",
+                      file=sys.stderr)
+                return rc
+            print(f"[launch] worker failed (exit {rc}); restart "
+                  f"{restarts}/{args.max_restart}", file=sys.stderr)
+            time.sleep(1.0)
+    finally:
+        if store is not None:
+            store.stop()
+
+
+def main():  # pragma: no cover - thin CLI shim
+    sys.exit(launch())

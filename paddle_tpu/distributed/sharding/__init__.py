@@ -31,14 +31,12 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None,
     params = list(model.parameters())
     if level in ("os", "os_g"):
         optimizer = GroupShardedOptimizerStage2(
-            params, optimizer, group=group, offload=offload)
+            params, optimizer, group=group, offload=offload,
+            shard_grads=(level == "os_g"))
         model = GroupShardedStage2(model, optimizer, group=group,
                                    sync_buffers=sync_buffers,
                                    buffer_max_size=buffer_max_size,
                                    dp_group=dp_group)
-        if level == "os":
-            # stage1 shards only states; skip the grad re-layout
-            optimizer._shard_grads = lambda: None
     else:
         model = GroupShardedStage3(model, optimizer=optimizer, group=group,
                                    sync_buffers=sync_buffers,

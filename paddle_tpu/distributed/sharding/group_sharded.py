@@ -66,10 +66,13 @@ class GroupShardedOptimizerStage2:
     """
 
     def __init__(self, params: List[Parameter], optim, group: Optional[coll.Group] = None,
-                 offload: bool = False, device: str = "tpu", **kw):
+                 offload: bool = False, device: str = "tpu",
+                 shard_grads: bool = True, **kw):
         self._optim = optim
         self._group = group or coll._get_or_init_default()
         self._offload = offload
+        # stage1 ('os') shards only optimizer states; stage2 also grads
+        self._do_shard_grads = shard_grads
         self._params = list(params)
         # params must live on the group's device set so the raw-array
         # optimizer math can combine them with mesh-sharded grads/states;
@@ -86,6 +89,8 @@ class GroupShardedOptimizerStage2:
     def _shard_grads(self):
         """Reduce-scatter analog: lay grads out over the sharding axis so the
         optimizer update reads only local slices."""
+        if not self._do_shard_grads:
+            return
         for p in self._params:
             if p._grad is None:
                 continue

@@ -1,0 +1,75 @@
+"""paddle.distributed.spawn parity — in-python multiprocess launch.
+
+Reference: python/paddle/distributed/spawn.py — forks `nprocs` workers
+running `func(*args)` with rank env set, joins them, propagates failures.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Tuple
+
+
+def _worker(func, args, rank, nprocs, port, q):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    try:
+        func(*args)
+        q.put((rank, None))
+    except Exception:
+        q.put((rank, traceback.format_exc()))
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    q = ctx.Queue()
+    port = int(options.get("master_port", 29770))
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, args, rank, nprocs, port, q),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    import queue as _queue
+
+    errs = []
+    got = 0
+    while got < nprocs:
+        try:
+            rank, err = q.get(timeout=1.0)
+            got += 1
+            if err is not None:
+                errs.append((rank, err))
+            continue
+        except _queue.Empty:
+            pass
+        # a worker killed by signal/OOM never reports — catch it by exitcode
+        for rank, p in enumerate(procs):
+            if p.exitcode is not None and p.exitcode != 0:
+                drained = True
+                while drained:
+                    try:
+                        r2, e2 = q.get_nowait()
+                        got += 1
+                        if e2 is not None:
+                            errs.append((r2, e2))
+                    except _queue.Empty:
+                        drained = False
+                for pp in procs:
+                    if pp.is_alive():
+                        pp.terminate()
+                raise RuntimeError(
+                    f"spawned rank {rank} died with exitcode {p.exitcode}"
+                    + (f"; first error:\n{errs[0][1]}" if errs else ""))
+    for p in procs:
+        p.join()
+    if errs:
+        rank, err = errs[0]
+        raise RuntimeError(f"spawned rank {rank} failed:\n{err}")
+    return procs

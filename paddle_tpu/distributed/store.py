@@ -1,0 +1,242 @@
+"""TCPStore — rendezvous KV store for multi-process bootstrap.
+
+Reference: `paddle/phi/core/distributed/store/tcp_store.h:121` (master socket
+server + clients) exposed as `paddle.distributed.TCPStore`. The native C++
+server/client lives in paddle_tpu/core/native/src/native.cc; a pure-Python
+socket implementation with the same wire protocol is the fallback when the
+toolchain is unavailable.
+
+Used by the launcher (paddle_tpu.distributed.launch) for rank assignment and
+by `init_parallel_env` multi-host bootstrap alongside the PJRT coordination
+service.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core.native import (NativeStoreClient, NativeStoreServer,
+                           available as _native_available)
+
+
+class _PyStoreServer:
+    """Pure-Python server speaking the native wire protocol."""
+
+    def __init__(self, port: int):
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _read(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("eof")
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op = self._read(conn, 1)[0]
+                klen = struct.unpack("<I", self._read(conn, 4))[0]
+                key = self._read(conn, klen).decode()
+                vlen = struct.unpack("<Q", self._read(conn, 8))[0]
+                val = self._read(conn, vlen) if vlen else b""
+                if op == 0:  # SET
+                    with self._cv:
+                        self._kv[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<Q", 0))
+                elif op == 1:  # GET blocking
+                    with self._cv:
+                        while key not in self._kv and not self._stop:
+                            self._cv.wait(0.1)
+                        v = self._kv.get(key, b"")
+                    conn.sendall(struct.pack("<Q", len(v)) + v)
+                elif op == 2:  # ADD
+                    delta = struct.unpack("<q", val[:8])[0]
+                    with self._cv:
+                        cur = struct.unpack(
+                            "<q", self._kv.get(key, b"\0" * 8)[:8])[0]
+                        now = cur + delta
+                        self._kv[key] = struct.pack("<q", now)
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<Q", 8) + struct.pack("<q", now))
+                elif op == 3:  # CHECK
+                    with self._cv:
+                        p = b"\x01" if key in self._kv else b"\x00"
+                    conn.sendall(struct.pack("<Q", 1) + p)
+                elif op == 4:  # DELETE
+                    with self._cv:
+                        self._kv.pop(key, None)
+                    conn.sendall(struct.pack("<Q", 0))
+                elif op == 5:  # PING
+                    conn.sendall(struct.pack("<Q", 0))
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyStoreClient:
+    def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+        deadline = time.time() + timeout_ms / 1000.0
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock.settimeout(None)
+                self._lock = threading.Lock()
+                return
+            except OSError as e:
+                last = e
+                if time.time() > deadline:
+                    raise ConnectionError(
+                        f"cannot connect TCPStore {host}:{port}") from last
+                time.sleep(0.05)
+
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("eof")
+            buf += chunk
+        return buf
+
+    def _req(self, op: int, key: str, val: bytes = b"") -> bytes:
+        with self._lock:
+            k = key.encode()
+            self._sock.sendall(bytes([op]) + struct.pack("<I", len(k)) + k
+                               + struct.pack("<Q", len(val)) + val)
+            rlen = struct.unpack("<Q", self._read(8))[0]
+            return self._read(rlen) if rlen else b""
+
+    def set(self, key, value):
+        self._req(0, key, value)
+
+    def get(self, key, max_len=1 << 20):
+        return self._req(1, key)
+
+    def add(self, key, delta):
+        return struct.unpack("<q", self._req(2, key,
+                                             struct.pack("<q", delta)))[0]
+
+    def check(self, key):
+        return self._req(3, key) == b"\x01"
+
+    def delete(self, key):
+        self._req(4, key)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """paddle.distributed.TCPStore parity: master hosts the server; every
+    process is a client. `wait`/`barrier` build on blocking get + counters."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 900.0,
+                 use_native: Optional[bool] = None):
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size
+        native = _native_available() if use_native is None else use_native
+        self._server = None
+        if is_master:
+            if native:
+                try:
+                    self._server = NativeStoreServer(port)
+                except OSError:
+                    native = False
+                    self._server = _PyStoreServer(port)
+            else:
+                self._server = _PyStoreServer(port)
+        if native:
+            try:
+                self._client = NativeStoreClient(host, port,
+                                                 int(timeout * 1000))
+            except (RuntimeError, ConnectionError):
+                self._client = _PyStoreClient(host, port, int(timeout * 1000))
+        else:
+            self._client = _PyStoreClient(host, port, int(timeout * 1000))
+        self.native = isinstance(self._client, NativeStoreClient)
+        self._barrier_gen = 0
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._client.set(key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        return self._client.get(key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._client.add(key, amount)
+
+    def check(self, key: str) -> bool:
+        return self._client.check(key)
+
+    def delete_key(self, key: str):
+        self._client.delete(key)
+
+    def wait(self, key: str, timeout: float = 300.0):
+        deadline = time.time() + timeout
+        while not self.check(key):
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+            time.sleep(0.02)
+
+    def barrier(self, key: str = "_barrier", timeout: float = 300.0):
+        # per-generation keys make the barrier reusable (every rank calls
+        # barrier the same number of times, so generations stay aligned)
+        gen = self._barrier_gen
+        self._barrier_gen += 1
+        n = self.add(f"{key}/{gen}/count", 1)
+        if n == self.world_size:
+            self.set(f"{key}/{gen}/done", b"1")
+        self.wait(f"{key}/{gen}/done", timeout)
+
+    def stop(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

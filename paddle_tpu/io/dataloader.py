@@ -248,6 +248,30 @@ class _IterableDatasetIter:
         return _to_device(self._collate(batch))
 
 
+class _TimedIter:
+    """Feeds reader_cost into the profiler throughput timer (reference:
+    dataloader_iter.py:298 hooks into paddle.profiler.utils.benchmark)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from ..profiler import benchmark
+
+        hub = benchmark()
+        hub.before_reader()
+        try:
+            return next(self._inner)
+        finally:
+            hub.after_reader()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class DataLoader:
     """Reference: python/paddle/io/reader.py:262."""
 
@@ -290,10 +314,10 @@ class DataLoader:
 
     def __iter__(self):
         if self._is_iterable:
-            return _IterableDatasetIter(self)
+            return _TimedIter(_IterableDatasetIter(self))
         if self.num_workers > 0:
-            return _MultiProcessIter(self)
-        return _SingleProcessIter(self)
+            return _TimedIter(_MultiProcessIter(self))
+        return _TimedIter(_SingleProcessIter(self))
 
     def __len__(self):
         if self._is_iterable:

@@ -87,6 +87,16 @@ def set_amp_hook(fn):
     _amp_hook = fn
 
 
+_op_profiling = [False]
+
+
+def set_op_profiling(on: bool):
+    """Installed by paddle_tpu.profiler: per-op RecordEvent spans around
+    dispatch (the HostTracer instrumentation points of the reference's
+    executor/phi-API hot paths)."""
+    _op_profiling[0] = bool(on)
+
+
 def _harmonize_devices(arrays):
     """Mixed-placement operands: replicate single-device arrays onto the
     widest committed device set (GSPMD eager mode — sharded params combine
@@ -121,6 +131,16 @@ def _harmonize_devices(arrays):
 
 
 def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
+    if _op_profiling[0]:
+        from ..profiler import RecordEvent
+
+        with RecordEvent(f"op::{name}"):
+            return _call_op_impl(name, kernel, args, kwargs, nondiff)
+    return _call_op_impl(name, kernel, args, kwargs, nondiff)
+
+
+def _call_op_impl(name: str, kernel: Callable, args, kwargs,
+                  nondiff: bool = False):
     if _amp_hook is not None:
         args, kwargs = _amp_hook(name, args, kwargs)
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)

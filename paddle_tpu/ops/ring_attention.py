@@ -88,9 +88,11 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
                + pv * blk[..., None].transpose(0, 2, 1, 3))
         l_run = l_run * corr + l_blk * blk
         m_run = m_new
-        # rotate K/V to the next neighbor (skipped after the last block)
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
+        if s != n - 1:
+            # rotate K/V to the next neighbor (last block's rotation would
+            # only be discarded — skip the two collective-permutes)
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
         return kb, vb, acc, m_run, l_run
 
     acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)

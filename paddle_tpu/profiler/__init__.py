@@ -1,0 +1,390 @@
+"""paddle.profiler parity — host spans + device (XLA) profiling.
+
+Reference (SURVEY.md §5): python `Profiler`
+(python/paddle/profiler/profiler.py:358) with scheduler states
+(CLOSED/READY/RECORD) driving C++ HostTracer `RecordEvent` spans + CUPTI GPU
+timelines, merged and exported as chrome-trace JSON
+(chrometracing_logger.cc) and summary tables (profiler_statistic.py);
+throughput timer `paddle.profiler.utils.benchmark()`.
+
+TPU-native: host spans go through the native C++ collector
+(core/native/src/native.cc trace_*) with a pure-Python fallback; device-side
+profiling delegates to `jax.profiler` (XLA xplane → TensorBoard/perfetto),
+started/stopped in lockstep. Chrome-trace export and the summary table are
+produced from the host spans.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+from ..core import native as _native
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "benchmark"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+# ---------------------------------------------------------------------------
+# Host span collection (native first, python fallback)
+# ---------------------------------------------------------------------------
+
+_py_spans = []
+_py_lock = threading.Lock()
+_enabled = [False]
+
+
+def _now_ns() -> int:
+    lib = _native.get_lib()
+    if lib is not None:
+        return int(lib.trace_now_ns())
+    return time.perf_counter_ns()
+
+
+def _record(name: str, tid: int, start_ns: int, end_ns: int):
+    lib = _native.get_lib()
+    if lib is not None:
+        lib.trace_record(name.encode(), tid, start_ns, end_ns)
+    else:
+        with _py_lock:
+            _py_spans.append((name, tid, start_ns, end_ns))
+
+
+def _set_enabled(on: bool):
+    _enabled[0] = on
+    lib = _native.get_lib()
+    if lib is not None:
+        lib.trace_enable(1 if on else 0)
+    from ..ops.dispatch import set_op_profiling
+
+    set_op_profiling(on)
+
+
+def _clear():
+    lib = _native.get_lib()
+    if lib is not None:
+        lib.trace_clear()
+    with _py_lock:
+        _py_spans.clear()
+
+
+def _collect_spans(path_json: Optional[str] = None):
+    """Returns [(name, tid, start_ns, end_ns)]; also dumps JSON if asked."""
+    lib = _native.get_lib()
+    if lib is not None:
+        import tempfile
+
+        tmp = path_json
+        if tmp is None:
+            fd, tmp = tempfile.mkstemp(suffix=".json")
+            os.close(fd)
+        lib.trace_dump_json(tmp.encode(), os.getpid())
+        with open(tmp) as f:
+            doc = json.load(f)
+        if path_json is None:
+            os.unlink(tmp)
+        return [(e["name"], e["tid"], e["ts"] * 1000.0,
+                 (e["ts"] + e["dur"]) * 1000.0) for e in doc["traceEvents"]]
+    with _py_lock:
+        spans = list(_py_spans)
+    if path_json is not None:
+        doc = {"traceEvents": [
+            {"name": n, "ph": "X", "pid": os.getpid(), "tid": t,
+             "ts": s / 1000.0, "dur": (e - s) / 1000.0}
+            for n, t, s, e in spans]}
+        with open(path_json, "w") as f:
+            json.dump(doc, f)
+    return spans
+
+
+class RecordEvent:
+    """User-code span (reference: paddle.profiler.RecordEvent; C++
+    platform::RecordEvent instrumentation)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = _now_ns()
+
+    def end(self):
+        if self._start is not None and _enabled[0]:
+            _record(self.name, threading.get_ident() % (1 << 32),
+                    self._start, _now_ns())
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference: profiler.py make_scheduler — step→state function."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback factory (reference API)."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      f".paddle_trace.json")
+        prof.export(path)
+    return handler
+
+
+class Profiler:
+    """Reference: python/paddle/profiler/profiler.py:358."""
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=end - start, repeat=1)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._spans = None
+        self._jax_profiling = False
+        self._jax_logdir = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        self.current_state = (self.scheduler(self.step_num)
+                              if self.scheduler else ProfilerState.RECORD)
+        if not self.timer_only:
+            self._maybe_toggle(prev=ProfilerState.CLOSED)
+        benchmark().begin()
+        return self
+
+    def stop(self):
+        if not self.timer_only and self.current_state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._finish_record()
+        _set_enabled(False)
+        benchmark().end()
+
+    def step(self, num_samples: Optional[int] = None):
+        benchmark().step(num_samples)
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = (self.scheduler(self.step_num)
+                              if self.scheduler else ProfilerState.RECORD)
+        if not self.timer_only:
+            rec = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+            was_recording = prev in rec
+            if prev == ProfilerState.RECORD_AND_RETURN:
+                # cycle boundary: the record window ends here regardless of
+                # the next state
+                self._finish_record()
+                was_recording = False
+            if self.current_state in rec and not was_recording:
+                _clear()
+                _set_enabled(True)
+                self._start_jax()
+            elif self.current_state not in rec and was_recording:
+                self._finish_record()
+
+    def _maybe_toggle(self, prev):
+        rec = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if self.current_state in rec and prev not in rec:
+            _clear()
+            _set_enabled(True)
+            self._start_jax()
+        elif self.current_state not in rec and prev in rec:
+            self._finish_record()
+
+    def _start_jax(self):
+        if ProfilerTarget.TPU in self.targets and not self._jax_profiling:
+            try:
+                import jax
+
+                self._jax_logdir = os.environ.get(
+                    "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_xplane")
+                jax.profiler.start_trace(self._jax_logdir)
+                self._jax_profiling = True
+            except Exception:
+                self._jax_profiling = False
+
+    def _finish_record(self):
+        _set_enabled(False)
+        if self._jax_profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_profiling = False
+        self._spans = _collect_spans()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results ---------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        spans = self._spans if self._spans is not None else _collect_spans()
+        doc = {"traceEvents": [
+            {"name": n, "ph": "X", "pid": os.getpid(), "tid": t,
+             "ts": s / 1000.0, "dur": (e - s) / 1000.0}
+            for n, t, s, e in spans]}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        """Aggregated table (reference: profiler_statistic.py)."""
+        spans = self._spans if self._spans is not None else _collect_spans()
+        agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+        for n, t, s, e in spans:
+            dur = (e - s) / 1e6  # ms
+            a = agg[n]
+            a[0] += 1
+            a[1] += dur
+            a[2] = min(a[2], dur)
+            a[3] = max(a[3], dur)
+        unit = {"ms": 1.0, "us": 1000.0, "s": 1e-3}[time_unit]
+        lines = [f"{'Name':<40} {'Calls':>6} {'Total':>10} {'Min':>10} "
+                 f"{'Max':>10} {'Avg':>10}  ({time_unit})"]
+        for name, (cnt, tot, mn, mx) in sorted(agg.items(),
+                                               key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {cnt:>6} {tot * unit:>10.3f} "
+                         f"{mn * unit:>10.3f} {mx * unit:>10.3f} "
+                         f"{tot / max(cnt, 1) * unit:>10.3f}")
+        return "\n".join(lines)
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Throughput timer (reference: python/paddle/profiler/timer.py benchmark())
+# ---------------------------------------------------------------------------
+
+class _TimerHub:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._step_start = None
+        self._reader_cost = 0.0
+        self._batch_costs = []
+        self._reader_costs = []
+        self._samples = 0
+        self._steps = 0
+        self._running = False
+
+    def begin(self):
+        self._running = True
+        self._step_start = time.perf_counter()
+
+    def end(self):
+        self._running = False
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._running and getattr(self, "_reader_t0", None) is not None:
+            self._reader_cost += time.perf_counter() - self._reader_t0
+
+    def step(self, num_samples: Optional[int] = None):
+        if not self._running or self._step_start is None:
+            return
+        now = time.perf_counter()
+        self._batch_costs.append(now - self._step_start)
+        self._reader_costs.append(self._reader_cost)
+        self._reader_cost = 0.0
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+        self._step_start = now
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._batch_costs:
+            return ""
+        avg_batch = sum(self._batch_costs) / len(self._batch_costs)
+        avg_reader = sum(self._reader_costs) / len(self._reader_costs)
+        ips = (self._samples / sum(self._batch_costs)
+               if self._samples and sum(self._batch_costs) > 0 else
+               1.0 / avg_batch)
+        info = (f"reader_cost: {avg_reader:.5f} s, batch_cost: "
+                f"{avg_batch:.5f} s, ips: {ips:.5f} {unit}/s")
+        self._batch_costs.clear()
+        self._reader_costs.clear()
+        self._samples = 0
+        return info
+
+    @property
+    def ips(self) -> float:
+        total = sum(self._batch_costs)
+        if total <= 0:
+            return 0.0
+        return (self._samples / total if self._samples
+                else self._steps / total)
+
+
+_hub = _TimerHub()
+
+
+def benchmark() -> _TimerHub:
+    """Reference: paddle.profiler.utils.benchmark() — the ips/reader_cost
+    throughput timer hooked into DataLoader and hapi callbacks."""
+    return _hub

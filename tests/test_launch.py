@@ -1,0 +1,99 @@
+"""Launcher tests: multi-proc pod spawn, rank env, restart budget, spawn API.
+
+Reference pattern: test/collective launch tests spawn localhost pods
+(SURVEY.md §4 pattern C)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import launch, parse_args
+
+
+def test_parse_args():
+    a = parse_args(["--nnodes", "2", "--rank", "1", "--log_dir", "/tmp/x",
+                    "train.py", "--lr", "0.1"])
+    assert a.nnodes == "2" and a.rank == 1
+    assert a.training_script == "train.py"
+    assert a.training_script_args == ["--lr", "0.1"]
+
+
+def test_launch_two_procs_rendezvous(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, os.environ["REPO"])
+        from paddle_tpu.distributed.store import TCPStore
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        host, port = os.environ["PADDLE_MASTER"].split(":")
+        store = TCPStore(host, int(port), is_master=False, world_size=world)
+        store.set(f"hello/{rank}", str(rank))
+        store.barrier("b", timeout=60)
+        vals = sorted(int(store.get(f"hello/{r}")) for r in range(world))
+        assert vals == list(range(world)), vals
+        with open(os.path.join(os.environ["OUT"], f"ok.{rank}"), "w") as f:
+            f.write("done")
+        store.stop()
+    """))
+    env = dict(os.environ)
+    env["REPO"] = "/root/repo"
+    env["OUT"] = str(tmp_path)
+    env["PADDLE_MASTER_PORT"] = "29753"
+    log_dir = str(tmp_path / "logs")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir,
+         str(script)],
+        cwd="/root/repo", env=env, timeout=120).returncode
+    assert rc == 0
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+    assert os.path.exists(os.path.join(log_dir, "workerlog.0"))
+
+
+def test_launch_restart_budget(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    env = dict(os.environ)
+    env["PADDLE_MASTER_PORT"] = "29754"
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "1", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        cwd="/root/repo", env=env, timeout=120).returncode
+    assert rc == 3
+
+
+def _spawn_target(tag):
+    import os
+
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    open(f"/tmp/spawn_test_{tag}_{os.environ['PADDLE_TRAINER_ID']}", "w").close()
+
+
+def test_spawn_api():
+    import glob
+
+    from paddle_tpu.distributed import spawn
+
+    tag = str(os.getpid())
+    for f in glob.glob(f"/tmp/spawn_test_{tag}_*"):
+        os.unlink(f)
+    spawn(_spawn_target, args=(tag,), nprocs=2)
+    assert len(glob.glob(f"/tmp/spawn_test_{tag}_*")) == 2
+    for f in glob.glob(f"/tmp/spawn_test_{tag}_*"):
+        os.unlink(f)
+
+
+def _spawn_fail(tag):
+    raise ValueError("boom")
+
+
+def test_spawn_propagates_failure():
+    from paddle_tpu.distributed import spawn
+
+    with pytest.raises(RuntimeError, match="boom"):
+        spawn(_spawn_fail, args=("x",), nprocs=2,
+              master_port=29771)

@@ -1,0 +1,95 @@
+"""Profiler tests: scheduler states, RecordEvent spans, chrome trace,
+summary, throughput timer (reference: test/legacy_test profiler suites)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+
+
+def test_make_scheduler():
+    sch = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sch(i) for i in range(6)]
+    assert states[0] == prof.ProfilerState.CLOSED
+    assert states[1] == prof.ProfilerState.READY
+    assert states[2] == prof.ProfilerState.RECORD
+    assert states[3] == prof.ProfilerState.RECORD_AND_RETURN
+    assert states[4] == prof.ProfilerState.CLOSED
+
+
+def test_profiler_records_op_spans(tmp_path):
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    p.start()
+    with prof.RecordEvent("user_region"):
+        x = paddle.rand([32, 32])
+        y = paddle.matmul(x, x)
+        _ = y.sum().numpy()
+    p.stop()
+    out = str(tmp_path / "trace.json")
+    p.export(out)
+    with open(out) as f:
+        doc = json.load(f)
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "user_region" in names
+    assert any(n.startswith("op::matmul") for n in names)
+    table = p.summary()
+    assert "op::matmul" in table and "Calls" in table
+
+
+def test_profiler_scheduler_gating(tmp_path):
+    sch = prof.make_scheduler(closed=2, ready=0, record=1, repeat=1)
+    traces = []
+    p = prof.Profiler(scheduler=sch,
+                      on_trace_ready=lambda pr: traces.append(pr._spans))
+    p.start()
+    for i in range(4):
+        x = paddle.rand([8, 8])
+        _ = paddle.matmul(x, x)
+        p.step()
+    p.stop()
+    assert len(traces) >= 1
+    # spans only from the RECORD window
+    assert any(any(n.startswith("op::") for n, *_ in t) for t in traces)
+
+
+def test_op_profiling_off_after_stop():
+    from paddle_tpu.ops.dispatch import _op_profiling
+
+    p = prof.Profiler()
+    p.start()
+    p.stop()
+    assert _op_profiling[0] is False
+
+
+def test_benchmark_timer_ips():
+    hub = prof.benchmark()
+    hub.reset()
+    hub.begin()
+    for _ in range(3):
+        hub.step(num_samples=16)
+    info = hub.step_info()
+    assert "ips" in info and "reader_cost" in info
+    hub.end()
+
+
+def test_dataloader_feeds_reader_cost():
+    import paddle_tpu.io as io
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32)
+
+    hub = prof.benchmark()
+    hub.reset()
+    hub.begin()
+    dl = io.DataLoader(DS(), batch_size=4, num_workers=0)
+    for batch in dl:
+        hub.step(num_samples=4)
+    info = hub.step_info()
+    assert "ips" in info
+    hub.end()
