@@ -151,6 +151,13 @@ from . import vision  # noqa: F401, E402
 from . import distributed  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from . import profiler  # noqa: F401, E402
+from . import linalg  # noqa: F401, E402
+from . import fft  # noqa: F401, E402
+from . import signal  # noqa: F401, E402
+from . import distribution  # noqa: F401, E402
+from . import sparse  # noqa: F401, E402
+from . import pir  # noqa: F401, E402
+from . import inference  # noqa: F401, E402
 from . import framework  # noqa: F401, E402
 from .framework.io_api import load, save  # noqa: F401, E402
 from .hapi.model import Model  # noqa: F401, E402

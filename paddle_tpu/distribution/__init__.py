@@ -1,0 +1,640 @@
+"""paddle.distribution parity.
+
+Reference: python/paddle/distribution/ (~20 distribution classes +
+kl_divergence registry + transforms). TPU-native: densities/samplers are
+jnp compositions on the op tape; sampling draws keys from the framework
+Generator (core/rng.py) so seeding is reproducible and trace-friendly.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import next_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "Poisson", "Cauchy", "Binomial", "StudentT",
+    "kl_divergence", "register_kl",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, dtype=jnp.float32) if not hasattr(x, "dtype") else \
+        jnp.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._from_data(jnp.asarray(x))
+
+
+def _shape(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    return tuple(sample_shape) + base
+
+
+class Distribution:
+    """Reference: distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.loc, self.scale)
+        eps = jax.random.normal(next_key(), shp)
+        return _wrap(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        return _wrap(jax.scipy.stats.norm.cdf(_arr(value), self.loc,
+                                              self.scale))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low).astype(jnp.float32)
+        self.high = _arr(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.low, self.high)
+        u = jax.random.uniform(next_key(), shp)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return _wrap(lp)
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low)
+                     + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _arr(probs).astype(jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _arr(logits).astype(jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.probs)
+        return _wrap(jax.random.bernoulli(next_key(), self.probs,
+                                          shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jax.nn.log_sigmoid(self.logits)
+                     + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(p * jnp.log(p + 1e-12)
+                       + (1 - p) * jnp.log1p(-p + 1e-12)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            lg = _arr(logits).astype(jnp.float32)
+            self.logits = lg - jax.scipy.special.logsumexp(
+                lg, axis=-1, keepdims=True)
+        elif probs is not None:
+            p = _arr(probs).astype(jnp.float32)
+            p = p / jnp.sum(p, axis=-1, keepdims=True)
+            self.logits = jnp.log(p + 1e-38)
+        else:
+            raise ValueError("pass logits or probs")
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.logits.shape[:-1]
+        return _wrap(jax.random.categorical(next_key(), self.logits,
+                                            shape=shp).astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        if self.logits.ndim == 1:
+            # scalar-batch: value is a list of category ids
+            return _wrap(jnp.take(self.logits, v))
+        return _wrap(jnp.take_along_axis(self.logits, v[..., None],
+                                         axis=-1)[..., 0])
+
+    def probs_of(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        return _wrap(-jnp.sum(self.probs * self.logits, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha).astype(jnp.float32)
+        self.beta = _arr(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.alpha, self.beta)
+        return _wrap(jax.random.beta(next_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lb = (jax.scipy.special.gammaln(self.alpha)
+              + jax.scipy.special.gammaln(self.beta)
+              - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v) - lb)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lb = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+              - jax.scipy.special.gammaln(a + b))
+        return _wrap(lb - (a - 1) * dg(a) - (b - 1) * dg(b)
+                     + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / jnp.sum(c, axis=-1, keepdims=True))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.concentration.shape[:-1]
+        return _wrap(jax.random.dirichlet(next_key(), self.concentration,
+                                          shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        lb = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
+              - jax.scipy.special.gammaln(jnp.sum(c, axis=-1)))
+        return _wrap(jnp.sum((c - 1) * jnp.log(v), axis=-1) - lb)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate ** 2)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.rate)
+        return _wrap(jax.random.exponential(next_key(), shp) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+        self.rate = _arr(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.concentration, self.rate)
+        return _wrap(jax.random.gamma(next_key(), self.concentration, shp)
+                     / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c, r = self.concentration, self.rate
+        return _wrap(c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                     - jax.scipy.special.gammaln(c))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.probs)
+        u = jax.random.uniform(next_key(), shp, minval=1e-7, maxval=1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * 0.5772156649015329)
+
+    @property
+    def variance(self):
+        return _wrap((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.gumbel(next_key(),
+                                                               shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.scale) + 1.5772156649015329)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(2 * self.scale ** 2)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.laplace(next_key(),
+                                                                shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=(), seed=0):
+        return _wrap(jnp.exp(_arr(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(_arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _arr(probs).astype(jnp.float32)
+        self.probs = p / jnp.sum(p, axis=-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    def sample(self, shape=(), seed=0):
+        logits = jnp.log(self.probs + 1e-38)
+        draws = jax.random.categorical(
+            next_key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self.probs.shape[:-1])
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        gl = jax.scipy.special.gammaln
+        return _wrap(gl(jnp.asarray(self.total_count + 1.0))
+                     - jnp.sum(gl(v + 1.0), axis=-1)
+                     + jnp.sum(v * jnp.log(self.probs + 1e-38), axis=-1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.rate)
+        return _wrap(jax.random.poisson(next_key(), self.rate,
+                                        shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate
+                     - jax.scipy.special.gammaln(v + 1.0))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.cauchy(next_key(),
+                                                               shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        return _wrap(jnp.log(4 * math.pi * self.scale))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), seed=0):
+        shp = (self.total_count,) + _shape(shape, self.probs)
+        draws = jax.random.bernoulli(next_key(), self.probs, shp)
+        return _wrap(draws.sum(axis=0).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n = float(self.total_count)
+        gl = jax.scipy.special.gammaln
+        return _wrap(gl(n + 1) - gl(v + 1) - gl(n - v + 1)
+                     + v * jnp.log(self.probs + 1e-38)
+                     + (n - v) * jnp.log1p(-self.probs + 1e-38))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df).astype(jnp.float32)
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.df, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.t(next_key(),
+                                                          self.df, shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        df = self.df
+        gl = jax.scipy.special.gammaln
+        return _wrap(gl((df + 1) / 2) - gl(df / 2)
+                     - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                     - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distribution/kl.py register_kl)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p: Categorical, q: Categorical):
+    return _wrap(jnp.sum(p.probs * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p: Bernoulli, q: Bernoulli):
+    a, b = p.probs, q.probs
+    return _wrap(a * (jnp.log(a + 1e-12) - jnp.log(b + 1e-12))
+                 + (1 - a) * (jnp.log1p(-a + 1e-12) - jnp.log1p(-b + 1e-12)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p: Exponential, q: Exponential):
+    r = p.rate / q.rate
+    return _wrap(jnp.log(r) + 1 / r - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p: Beta, q: Beta):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    sp = p.alpha + p.beta
+    return _wrap(gl(sp) - gl(p.alpha) - gl(p.beta)
+                 - (gl(q.alpha + q.beta) - gl(q.alpha) - gl(q.beta))
+                 + (p.alpha - q.alpha) * (dg(p.alpha) - dg(sp))
+                 + (p.beta - q.beta) * (dg(p.beta) - dg(sp)))

@@ -1,0 +1,125 @@
+"""paddle.fft parity over jnp.fft (XLA FFT HLO).
+
+Reference: python/paddle/fft.py (~30 functions over phi fft kernels backed
+by pocketfft/cuFFT — third_party/pocketfft). XLA provides the FFT op
+natively, so each function is a thin jnp.fft lowering registered on the op
+tape (complex grads flow through jax's fft JVP rules).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import call_op
+
+_NORMS = {"backward": "backward", "forward": "forward", "ortho": "ortho"}
+
+
+def _op(name, kernel, *tensors, **kw):
+    return call_op(name, kernel, tensors, kw)
+
+
+def _norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {list(_NORMS)}, got {norm!r}")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("fft", lambda a: jnp.fft.fft(a, n=n, axis=axis,
+                                            norm=_norm(norm)), x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("ifft", lambda a: jnp.fft.ifft(a, n=n, axis=axis,
+                                              norm=_norm(norm)), x)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("rfft", lambda a: jnp.fft.rfft(a, n=n, axis=axis,
+                                              norm=_norm(norm)), x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("irfft", lambda a: jnp.fft.irfft(a, n=n, axis=axis,
+                                                norm=_norm(norm)), x)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("hfft", lambda a: jnp.fft.hfft(a, n=n, axis=axis,
+                                              norm=_norm(norm)), x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op("ihfft", lambda a: jnp.fft.ihfft(a, n=n, axis=axis,
+                                                norm=_norm(norm)), x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _op("fft2", lambda a: jnp.fft.fft2(a, s=s, axes=axes,
+                                              norm=_norm(norm)), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _op("ifft2", lambda a: jnp.fft.ifft2(a, s=s, axes=axes,
+                                                norm=_norm(norm)), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _op("rfft2", lambda a: jnp.fft.rfft2(a, s=s, axes=axes,
+                                                norm=_norm(norm)), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _op("irfft2", lambda a: jnp.fft.irfft2(a, s=s, axes=axes,
+                                                  norm=_norm(norm)), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def kernel(a):
+        return jnp.fft.hfft(jnp.fft.ifft(a, axis=axes[0]), n=None if s is None
+                            else s[-1], axis=axes[1], norm=_norm(norm))
+    return _op("hfft2", kernel, x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    def kernel(a):
+        return jnp.fft.ihfft(jnp.fft.fft(a, axis=axes[0]), axis=axes[1],
+                             norm=_norm(norm))
+    return _op("ihfft2", kernel, x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _op("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes,
+                                              norm=_norm(norm)), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _op("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes,
+                                                norm=_norm(norm)), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _op("rfftn", lambda a: jnp.fft.rfftn(a, s=s, axes=axes,
+                                                norm=_norm(norm)), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _op("irfftn", lambda a: jnp.fft.irfftn(a, s=s, axes=axes,
+                                                  norm=_norm(norm)), x)
+
+
+def fftshift(x, axes=None, name=None):
+    return _op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))

@@ -1,10 +1,11 @@
 """jit.save / jit.load — deployable model serialization.
 
 Reference analog: `paddle.jit.save` → TranslatedLayer (python/paddle/jit/api.py,
-translated_layer.py). Here a saved model is the layer's state_dict plus a
-pickled reconstruction spec; inference loading rebuilds a callable that runs
-through the cached-executable path. (The exported-StableHLO format lands with
-the inference Predictor.)
+translated_layer.py). A saved model is the layer's state_dict plus, when
+`input_spec` is given, the traced program serialized as StableHLO
+(pir.Program.serialize) — the source-free deployable artifact the inference
+Predictor AOT-compiles. Dynamic dims (None/-1) in the spec become jax.export
+symbolic dimensions, so the exported program serves any size along them.
 """
 from __future__ import annotations
 
@@ -17,23 +18,72 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 
+def _write_artifact(path_prefix: str, payload: dict, state: dict):
+    """Single writer for the .pdmodel/.pdiparams pair (shared with
+    static.save_inference_model so the format cannot drift)."""
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def _spec_avals(input_spec):
+    """InputSpecs → avals; None/-1 dims become shared symbolic dims."""
+    import jax
+    from jax import export as jexport
+
+    scope = jexport.SymbolicScope()
+    avals = []
+    sym_i = 0
+    for spec in input_spec:
+        dims = []
+        for d in spec.shape:
+            if d is None or (isinstance(d, int) and d < 0):
+                dims.append(f"dyn{sym_i}")
+                sym_i += 1
+            else:
+                dims.append(str(int(d)))
+        shape = jexport.symbolic_shape(",".join(dims) or "", scope=scope) \
+            if dims else ()
+        avals.append(jax.ShapeDtypeStruct(tuple(shape), str(spec.dtype)))
+    return avals
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Save layer params (+ class pickle when possible) under `path`."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {}
     target = layer
     if isinstance(layer, Layer):
         for name, p in layer.state_dict().items():
             state[name] = np.asarray(p._data if isinstance(p, Tensor) else p)
-    payload = {"state": state, "input_spec": input_spec}
+    spec_doc = None
+    if input_spec is not None:
+        spec_doc = [
+            {"shape": list(s.shape), "dtype": str(s.dtype),
+             "name": getattr(s, "name", None)}
+            for s in input_spec
+        ]
+    payload = {"state": state, "input_spec": spec_doc}
+    if input_spec is not None and isinstance(layer, Layer):
+        from ..pir import trace_program
+
+        modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        layer.eval()
+        try:
+            feed_names = [
+                s.name or f"feed_{i}" for i, s in enumerate(input_spec)]
+            program = trace_program(lambda *xs: layer(*xs),
+                                    *_spec_avals(input_spec),
+                                    feed_names=feed_names)
+            payload["stablehlo_program"] = program.serialize()
+        finally:
+            for l, was_training in modes:
+                l.training = was_training
     try:
         payload["layer"] = pickle.dumps(target)
     except Exception:
         payload["layer"] = None
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f)
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f)
+    _write_artifact(path, payload, state)
 
 
 class TranslatedLayer(Layer):
@@ -47,6 +97,21 @@ class TranslatedLayer(Layer):
         return self._inner(*args, **kwargs)
 
 
+class _ExportedLayer(Layer):
+    """TranslatedLayer over a deserialized StableHLO program (no python
+    class needed — the deployment path)."""
+
+    def __init__(self, exported_program):
+        super().__init__()
+        self._program = exported_program
+
+    def forward(self, *args):
+        feed = dict(zip(self._program.feed_names, args))
+        outs = self._program.run(feed)
+        outs = [Tensor._from_data(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
         payload = pickle.load(f)
@@ -58,7 +123,14 @@ def load(path, **configs):
             t = TranslatedLayer(inner)
             t.eval()
             return t
+    if payload.get("stablehlo_program"):
+        from ..pir import Program
+
+        t = _ExportedLayer(Program.deserialize(payload["stablehlo_program"]))
+        t.eval()
+        return t
     raise RuntimeError(
-        f"Cannot reconstruct layer from {path}: class not picklable; "
-        "load the state via paddle.load and rebuild the Layer in code"
+        f"Cannot reconstruct layer from {path}: class not picklable and no "
+        "exported program; load the state via paddle.load and rebuild the "
+        "Layer in code"
     )

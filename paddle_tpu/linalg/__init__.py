@@ -1,0 +1,92 @@
+"""paddle.linalg namespace parity.
+
+Reference: python/paddle/linalg.py re-exporting tensor/linalg.py — here the
+ops live in the registry (ops/kernels/linalg.py, XLA-lowered) and this
+module provides the namespace with paddle argument conventions.
+"""
+from __future__ import annotations
+
+from ..ops.dispatch import OPS as _OPS
+
+cholesky = _OPS["cholesky"]
+cholesky_solve = _OPS["cholesky_solve"]
+cond = _OPS["cond"]
+corrcoef = _OPS["corrcoef"]
+cov = _OPS["cov"]
+det = _OPS["det"]
+eig = _OPS["eig"]
+eigh = _OPS["eigh"]
+eigvalsh = _OPS["eigvalsh"]
+householder_product = _OPS["householder_product"]
+inv = _OPS["inverse"]
+lstsq = _OPS["lstsq"]
+lu = _OPS["lu"]
+matrix_power = _OPS["matrix_power"]
+matrix_rank = _OPS["matrix_rank"]
+multi_dot = _OPS["multi_dot"]
+norm = _OPS["norm"]
+pinv = _OPS["pinv"]
+qr = _OPS["qr"]
+slogdet = _OPS["slogdet"]
+solve = _OPS["solve"]
+svd = _OPS["svd"]
+triangular_solve = _OPS["triangular_solve"]
+
+
+def eigvals(x):
+    vals, _ = eig(x)
+    return vals
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    return _OPS["matmul"](x, y, transpose_x, transpose_y)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    return _OPS["p_norm"](x, p, -1 if axis is None else axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import call_op
+
+    def kernel(x):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+    return call_op("matrix_norm", kernel, (x,), {})
+
+
+def svdvals(x):
+    _, s, _ = svd(x)
+    return s
+
+
+def matrix_transpose(x):
+    return _OPS["transpose"](
+        x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+
+
+def matrix_exp(x):
+    import jax.scipy.linalg as jsl
+
+    from ..ops.dispatch import call_op
+
+    return call_op("matrix_exp", lambda a: jsl.expm(a), (x,), {})
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import call_op
+
+    def kernel(a):
+        m, n = a.shape[-2:]
+        k = q if q is not None else min(6, m, n)
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -2, -1)[..., :k]
+
+    return call_op("pca_lowrank", kernel, (x,), {})

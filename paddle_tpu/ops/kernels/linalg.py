@@ -227,3 +227,10 @@ def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
     rng = None if (min == 0 and max == 0) else (min, max)
     hist, _ = jnp.histogram(x, bins=bins, range=rng, weights=weight, density=density)
     return hist
+
+
+@register_op
+def einsum(equation, *operands):
+    """paddle.einsum (reference: python/paddle/tensor/einsum.py) — direct
+    XLA dot-general lowering via jnp.einsum (MXU-friendly)."""
+    return jnp.einsum(equation, *operands)

@@ -1,0 +1,524 @@
+"""Program IR — the SSA graph layer (PIR's good 20%, SURVEY.md §7 M3).
+
+Reference: `paddle/pir` (Operation/Value/Block/Program, uniqued types,
+PassManager + rewrite patterns, ~21k LoC C++) + the PirInterpreter
+(new_executor). TPU-native redesign: the IR *is* the jaxpr — jax's tracing
+already produces a typed SSA program with regions (nested jaxprs in
+cond/scan/while). This module gives it Paddle's program-level surface:
+
+- `Program` wraps a ClosedJaxpr with named feeds/fetches; `Operation`/
+  `Value`/`Block` are structured views (op_name, operands, results, attrs,
+  nested blocks) used by passes and by program introspection.
+- `PassManager` runs jaxpr→jaxpr rewrites. Shipped passes: DCE (delegates
+  to jax's dce_jaxpr), constant folding (evaluates literal-only eqns on
+  host), CSE (dedups structurally identical pure eqns) — the general/
+  transforms of fluid/pir (`constant_folding_pass.cc`, CSE, DCE) without
+  the 87k LoC dialect machinery.
+- `Interpreter` replays the program eqn-by-eqn (the PirInterpreter trace-run
+  analog, useful for debugging/instrumentation); `Program.compile()` hands
+  the whole program to XLA — the production path.
+- `Program.serialize()/deserialize()` round-trips through jax.export
+  (StableHLO bytes) — the deployable artifact format the inference
+  Predictor consumes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import core as jcore
+from jax.extend import core as jex_core
+
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "Operation", "Value", "Block", "PassManager", "Pass",
+           "DeadCodeEliminationPass", "ConstantFoldingPass",
+           "CommonSubexpressionEliminationPass", "Interpreter",
+           "trace_program"]
+
+
+class Value:
+    """SSA value view (reference: pir::Value, value.h:35)."""
+
+    def __init__(self, var, defining_op: Optional["Operation"] = None):
+        self._var = var
+        self._defining_op = defining_op
+
+    @property
+    def shape(self) -> List[int]:
+        aval = getattr(self._var, "aval", None)
+        return list(getattr(aval, "shape", ()))
+
+    @property
+    def dtype(self) -> str:
+        aval = getattr(self._var, "aval", None)
+        return str(getattr(aval, "dtype", "?"))
+
+    @property
+    def is_constant(self) -> bool:
+        return isinstance(self._var, jex_core.Literal)
+
+    def get_defining_op(self) -> Optional["Operation"]:
+        return self._defining_op
+
+    def __repr__(self):
+        return f"Value(shape={self.shape}, dtype={self.dtype})"
+
+
+class Operation:
+    """One primitive application (reference: pir::Operation, operation.h:66)."""
+
+    def __init__(self, eqn, block: "Block"):
+        self._eqn = eqn
+        self._block = block
+
+    @property
+    def name(self) -> str:
+        return self._eqn.primitive.name
+
+    op_name = name
+
+    @property
+    def operands(self) -> List[Value]:
+        return [self._block._value_of(v) for v in self._eqn.invars]
+
+    @property
+    def results(self) -> List[Value]:
+        return [Value(v, self) for v in self._eqn.outvars]
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return dict(self._eqn.params)
+
+    @property
+    def blocks(self) -> List["Block"]:
+        """Nested regions (cond/scan/while bodies)."""
+        out = []
+        for k, v in self._eqn.params.items():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                if isinstance(item, jex_core.ClosedJaxpr):
+                    out.append(Block(item.jaxpr))
+                elif isinstance(item, jex_core.Jaxpr):
+                    out.append(Block(item))
+        return out
+
+    def num_operands(self) -> int:
+        return len(self._eqn.invars)
+
+    def num_results(self) -> int:
+        return len(self._eqn.outvars)
+
+    def __repr__(self):
+        return (f"Operation({self.name}, in={self.num_operands()}, "
+                f"out={self.num_results()})")
+
+
+class Block:
+    """Straight-line op list + args (reference: pir::Block)."""
+
+    def __init__(self, jaxpr):
+        self._jaxpr = jaxpr
+
+    @property
+    def ops(self) -> List[Operation]:
+        return [Operation(eqn, self) for eqn in self._jaxpr.eqns]
+
+    @property
+    def args(self) -> List[Value]:
+        return [Value(v) for v in self._jaxpr.invars]
+
+    def _value_of(self, var) -> Value:
+        if isinstance(var, jex_core.Literal):
+            return Value(var)
+        for eqn in self._jaxpr.eqns:
+            if var in eqn.outvars:
+                return Value(var, Operation(eqn, self))
+        return Value(var)
+
+    def __len__(self):
+        return len(self._jaxpr.eqns)
+
+
+class Program:
+    """A traced computation with named feeds/fetches (reference:
+    pir::Program + the Program of python/paddle/base/framework.py:5893)."""
+
+    def __init__(self, closed_jaxpr: jex_core.ClosedJaxpr,
+                 feed_names: Sequence[str], fetch_names: Sequence[str],
+                 in_avals: Sequence[jax.ShapeDtypeStruct],
+                 out_tree=None):
+        self._closed = closed_jaxpr
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self._in_avals = list(in_avals)
+        self._out_tree = out_tree
+        self._compiled = None
+
+    # -- structure -------------------------------------------------------
+    @property
+    def jaxpr(self) -> jex_core.ClosedJaxpr:
+        return self._closed
+
+    def global_block(self) -> Block:
+        return Block(self._closed.jaxpr)
+
+    @property
+    def blocks(self) -> List[Block]:
+        return [self.global_block()]
+
+    @property
+    def ops(self) -> List[Operation]:
+        return self.global_block().ops
+
+    def num_ops(self) -> int:
+        return len(self._closed.jaxpr.eqns)
+
+    def __str__(self):
+        return str(self._closed)
+
+    def __repr__(self):
+        return (f"Program(feeds={self.feed_names}, fetches={self.fetch_names},"
+                f" ops={self.num_ops()})")
+
+    # -- execution -------------------------------------------------------
+    def _fn(self):
+        closed = self._closed
+
+        def fn(*args):
+            return jcore.eval_jaxpr(closed.jaxpr, closed.consts, *args)
+
+        return fn
+
+    def compile(self):
+        """One XLA executable for the whole program (the production path —
+        reference analog: PdOpLowerToKernelPass + executable caching)."""
+        if self._compiled is None:
+            self._compiled = (jax.jit(self._fn())
+                              .lower(*self._in_avals)
+                              .compile())
+        return self._compiled
+
+    def run(self, feed: Dict[str, Any]) -> List[Any]:
+        args = [jnp.asarray(feed[n]._data if isinstance(feed[n], Tensor)
+                            else feed[n]) for n in self.feed_names]
+        return list(self.compile()(*args))
+
+    def freeze(self, bindings: Dict[str, Any]) -> "Program":
+        """Bind feeds to fixed values (weights → constants), the inference
+        'freeze program' step (reference analog: load params into the
+        program before the analysis passes). Constant folding afterwards
+        collapses any weight-only subgraphs."""
+        jaxpr = self._closed.jaxpr
+        keep_invars, keep_names, keep_avals = [], [], []
+        new_constvars, new_consts = [], []
+        for var, name, aval in zip(jaxpr.invars, self.feed_names,
+                                   self._in_avals):
+            if name in bindings:
+                val = bindings[name]
+                arr = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+                new_constvars.append(var)
+                new_consts.append(arr)
+            else:
+                keep_invars.append(var)
+                keep_names.append(name)
+                keep_avals.append(aval)
+        new_jaxpr = jaxpr.replace(
+            invars=keep_invars,
+            constvars=list(jaxpr.constvars) + new_constvars)
+        closed = jex_core.ClosedJaxpr(new_jaxpr,
+                                      list(self._closed.consts) + new_consts)
+        return Program(closed, keep_names, self.fetch_names, keep_avals,
+                       self._out_tree)
+
+    # -- serialization ---------------------------------------------------
+    def serialize(self) -> bytes:
+        """StableHLO bytes via jax.export (versioned, forward-compatible)."""
+        import pickle
+
+        from jax import export as jexport
+
+        exported = jexport.export(jax.jit(self._fn()))(*self._in_avals)
+        return pickle.dumps({
+            "stablehlo": exported.serialize(),
+            "feed_names": self.feed_names,
+            "fetch_names": self.fetch_names,
+            "in_avals": [(tuple(str(d) for d in a.shape), str(a.dtype))
+                         for a in self._in_avals],
+        })
+
+    @staticmethod
+    def deserialize(data: bytes) -> "_ExportedProgram":
+        import pickle
+
+        from jax import export as jexport
+
+        doc = pickle.loads(data)
+        exported = jexport.deserialize(doc["stablehlo"])
+        return _ExportedProgram(exported, doc["feed_names"],
+                                doc["fetch_names"], doc["in_avals"])
+
+
+class _ExportedProgram:
+    """A deserialized StableHLO program: callable, no python source needed
+    (reference analog: the inference Program loaded by AnalysisPredictor)."""
+
+    def __init__(self, exported, feed_names, fetch_names, in_avals):
+        self._exported = exported
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.in_avals = in_avals
+        self._call = None
+
+    def run(self, feed: Dict[str, Any]) -> List[Any]:
+        if self._call is None:
+            self._call = jax.jit(self._exported.call)
+        args = [jnp.asarray(feed[n]._data if isinstance(feed[n], Tensor)
+                            else feed[n]) for n in self.feed_names]
+        out = self._call(*args)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def trace_program(fn: Callable, *example_args, feed_names=None,
+                  fetch_names=None) -> Program:
+    """Capture fn into a Program (reference analog: static.program_guard
+    region building / dy2static capture)."""
+    avals = []
+    for a in example_args:
+        if isinstance(a, jax.ShapeDtypeStruct):
+            avals.append(a)  # may carry jax.export symbolic dims
+            continue
+        arr = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+        avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+    def pure(*args):
+        wrapped = [Tensor._from_data(x) for x in args]
+        out = fn(*wrapped)
+        return jax.tree.map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    closed, out_shape = jax.make_jaxpr(pure, return_shape=True)(*avals)
+    out_leaves, out_tree = jax.tree.flatten(out_shape)
+    feed_names = feed_names or [f"feed_{i}" for i in range(len(avals))]
+    fetch_names = fetch_names or [f"fetch_{i}"
+                                  for i in range(len(out_leaves))]
+    return Program(closed, feed_names, fetch_names, avals, out_tree)
+
+
+# ---------------------------------------------------------------------------
+# Interpreter: eqn-by-eqn replay (PirInterpreter trace-run analog)
+# ---------------------------------------------------------------------------
+
+class Interpreter:
+    """Walks the program one instruction at a time (reference:
+    PirInterpreter::TraceRunImpl, pir_interpreter.cc:1511). Use for
+    debugging/instrumentation; `Program.compile()` is the fast path."""
+
+    def __init__(self, program: Program, instrument: Optional[Callable] = None):
+        self.program = program
+        self.instrument = instrument
+
+    def run(self, feed: Dict[str, Any]) -> List[Any]:
+        closed = self.program.jaxpr
+        jaxpr = closed.jaxpr
+        env: Dict[Any, Any] = {}
+
+        def read(var):
+            if isinstance(var, jex_core.Literal):
+                return var.val
+            return env[var]
+
+        for var, const in zip(jaxpr.constvars, closed.consts):
+            env[var] = const
+        for var, name in zip(jaxpr.invars, self.program.feed_names):
+            val = feed[name]
+            env[var] = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+        for eqn in jaxpr.eqns:
+            invals = [read(v) for v in eqn.invars]
+            subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+            outs = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+            if self.instrument is not None:
+                self.instrument(eqn.primitive.name, invals, outs)
+            for var, val in zip(eqn.outvars, outs):
+                env[var] = val
+        return [read(v) for v in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# Pass infrastructure (reference: pir/pass + fluid/pir/transforms/general)
+# ---------------------------------------------------------------------------
+
+class Pass:
+    name = "pass"
+
+    def run(self, program: Program) -> Program:
+        raise NotImplementedError
+
+
+def _rebuild(program: Program, jaxpr, consts) -> Program:
+    closed = jex_core.ClosedJaxpr(jaxpr, consts)
+    out = Program(closed, program.feed_names, program.fetch_names,
+                  program._in_avals, program._out_tree)
+    return out
+
+
+class DeadCodeEliminationPass(Pass):
+    """reference: dead_code_elimination_pass.cc — delegates to jax dce."""
+
+    name = "dead_code_elimination_pass"
+
+    def run(self, program: Program) -> Program:
+        from jax.interpreters.partial_eval import dce_jaxpr
+
+        jaxpr = program.jaxpr.jaxpr
+        new_jaxpr, used_inputs = dce_jaxpr(
+            jaxpr, [True] * len(jaxpr.outvars), instantiate=True)
+        return _rebuild(program, new_jaxpr, program.jaxpr.consts)
+
+
+class ConstantFoldingPass(Pass):
+    """reference: constant_folding_pass.cc — evaluates literal-only eqns."""
+
+    name = "constant_folding_pass"
+    _FOLDABLE_SIZE = 1 << 16  # don't materialize huge constants
+
+    def run(self, program: Program) -> Program:
+        jaxpr = program.jaxpr.jaxpr
+        const_env: Dict[Any, Any] = dict(zip(jaxpr.constvars,
+                                             program.jaxpr.consts))
+        new_eqns = []
+        for eqn in jaxpr.eqns:
+            if (eqn.primitive.name not in _IMPURE
+                    and all(isinstance(v, jex_core.Literal) or v in const_env
+                            for v in eqn.invars)
+                    and all(np.prod(o.aval.shape or (1,)) <=
+                            self._FOLDABLE_SIZE for o in eqn.outvars)):
+                invals = [v.val if isinstance(v, jex_core.Literal)
+                          else const_env[v] for v in eqn.invars]
+                subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+                try:
+                    outs = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+                except Exception:
+                    new_eqns.append(eqn)
+                    continue
+                if not eqn.primitive.multiple_results:
+                    outs = [outs]
+                for var, val in zip(eqn.outvars, outs):
+                    const_env[var] = val
+            else:
+                new_eqns.append(eqn)
+        # outvars that became consts must stay producible: keep their eqns
+        live = set()
+        for v in jaxpr.outvars:
+            if not isinstance(v, jex_core.Literal):
+                live.add(v)
+        needed_eqns = list(new_eqns)
+        produced = set()
+        for eqn in needed_eqns:
+            produced.update(eqn.outvars)
+        extra_constvars = []
+        extra_consts = []
+        seen = set()
+        for var in list(const_env):
+            if var in jaxpr.constvars:
+                continue
+            # newly folded value: if still referenced, promote to constvar
+            referenced = any(var in eqn.invars for eqn in needed_eqns) or \
+                var in jaxpr.outvars
+            if referenced and var not in seen:
+                seen.add(var)
+                extra_constvars.append(var)
+                extra_consts.append(const_env[var])
+        new_jaxpr = jaxpr.replace(
+            eqns=needed_eqns,
+            constvars=list(jaxpr.constvars) + extra_constvars)
+        return _rebuild(program, new_jaxpr,
+                        list(program.jaxpr.consts) + extra_consts)
+
+
+_IMPURE = {"random_seed", "random_bits", "random_fold_in", "random_wrap",
+           "threefry2x32", "pjit", "custom_jvp_call", "custom_vjp_call",
+           "cond", "scan", "while", "named_call", "core_call", "closed_call",
+           "psum", "all_gather", "ppermute", "all_to_all", "infeed",
+           "outfeed", "sharding_constraint", "device_put"}
+
+
+class CommonSubexpressionEliminationPass(Pass):
+    """reference: common_subexpression_elimination_pass.cc — dedups pure
+    eqns with identical (primitive, inputs, params)."""
+
+    name = "common_subexpression_elimination_pass"
+
+    def run(self, program: Program) -> Program:
+        jaxpr = program.jaxpr.jaxpr
+
+        def var_key(v, remap):
+            if isinstance(v, jex_core.Literal):
+                arr = np.asarray(v.val)
+                return ("lit", str(arr.dtype), arr.shape,
+                        arr.tobytes() if arr.size < 1024 else id(v))
+            return ("var", id(remap.get(v, v)))
+
+        def params_key(params):
+            try:
+                return repr(sorted(params.items()))
+            except Exception:
+                return str(id(params))
+
+        remap: Dict[Any, Any] = {}
+        seen: Dict[Any, List] = {}
+        new_eqns = []
+        for eqn in jaxpr.eqns:
+            invars = [remap.get(v, v) if not isinstance(v, jex_core.Literal)
+                      else v for v in eqn.invars]
+            if eqn.primitive.name in _IMPURE:
+                new_eqns.append(eqn.replace(invars=invars))
+                continue
+            key = (eqn.primitive.name,
+                   tuple(var_key(v, remap) for v in invars),
+                   params_key(eqn.params))
+            prev = seen.get(key)
+            if prev is not None:
+                for old, new in zip(eqn.outvars, prev):
+                    remap[old] = new
+                continue
+            new_eqn = eqn.replace(invars=invars)
+            new_eqns.append(new_eqn)
+            seen[key] = list(new_eqn.outvars)
+        new_outvars = [remap.get(v, v) if not isinstance(v, jex_core.Literal)
+                       else v for v in jaxpr.outvars]
+        new_jaxpr = jaxpr.replace(eqns=new_eqns, outvars=new_outvars)
+        return _rebuild(program, new_jaxpr, program.jaxpr.consts)
+
+
+class PassManager:
+    """reference: pir::PassManager (pir/include/pass)."""
+
+    def __init__(self, passes: Optional[List[Pass]] = None, opt_level: int = 2):
+        self.passes: List[Pass] = list(passes or [])
+        self.opt_level = opt_level
+
+    def add_pass(self, p) -> "PassManager":
+        if isinstance(p, str):
+            p = _PASS_REGISTRY[p]()
+        self.passes.append(p)
+        return self
+
+    def run(self, program: Program) -> Program:
+        for p in self.passes:
+            program = p.run(program)
+        return program
+
+
+_PASS_REGISTRY = {
+    "dead_code_elimination_pass": DeadCodeEliminationPass,
+    "constant_folding_pass": ConstantFoldingPass,
+    "common_subexpression_elimination_pass":
+        CommonSubexpressionEliminationPass,
+}

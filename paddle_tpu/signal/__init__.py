@@ -1,0 +1,117 @@
+"""paddle.signal parity: stft/istft over the XLA FFT.
+
+Reference: python/paddle/signal.py (frame/overlap_add phi kernels + fft).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import call_op
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slide a window over the last axis → [..., frame_length, num_frames]."""
+    def kernel(a):
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(num)[None, :])
+        return jnp.take(a, idx, axis=-1)
+
+    return call_op("frame", kernel, (x,), {})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, num_frames] → [..., n]."""
+    def kernel(a):
+        fl, num = a.shape[-2], a.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for f in range(num):  # static unroll: num_frames is static
+            out = out.at[..., f * hop_length:f * hop_length + fl].add(
+                a[..., f])
+        return out
+
+    return call_op("overlap_add", kernel, (x,), {})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Reference: paddle.signal.stft — output [..., n_fft//2+1, num_frames]
+    (onesided) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    w = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def kernel(a, w):
+        if w is None:
+            w = jnp.ones((win_length,), a.dtype)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(num)[None, :])
+        frames = jnp.take(a, idx, axis=-1)          # [..., n_fft, num]
+        frames = frames * w[:, None]
+        spec = (jnp.fft.rfft(frames, axis=-2) if onesided
+                else jnp.fft.fft(frames, axis=-2))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    args = (x,) if w is None else (x, Tensor._from_data(w))
+    if w is None:
+        return call_op("stft", lambda a: kernel(a, None), (x,), {})
+    return call_op("stft", kernel, args, {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    w = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def kernel(spec, w):
+        if w is None:
+            w = jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided
+                  else jnp.fft.ifft(spec, axis=-2).real)
+        frames = frames * w[:, None]
+        num = frames.shape[-1]
+        n = n_fft + hop_length * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        den = jnp.zeros((n,), frames.dtype)
+        for f in range(num):
+            sl = slice(f * hop_length, f * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., f])
+            den = den.at[sl].add(w * w)
+        out = out / jnp.maximum(den, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:n - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    if w is None:
+        return call_op("istft", lambda a: kernel(a, None), (x,), {})
+    return call_op("istft", kernel, (x, Tensor._from_data(w)), {})

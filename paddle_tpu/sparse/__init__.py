@@ -1,0 +1,253 @@
+"""paddle.sparse parity — COO/CSR tensors over jax.experimental.sparse.
+
+Reference: python/paddle/sparse/ (SparseCooTensor/SparseCsrTensor phi types,
+`paddle/phi/kernels/sparse/`). TPU-native: BCOO is XLA's sparse format
+(gather/scatter + segment-sum lowering); CSR is kept as an index-format view
+that converts through COO. Dense fallbacks keep the long tail correct —
+sparse on TPU is bandwidth-bound gather math either way.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import call_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "add", "multiply", "matmul",
+           "masked_matmul", "relu", "transpose", "coalesce", "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (indices [ndim, nnz], values [nnz])."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return str(self._bcoo.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor._from_data(self._bcoo.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor._from_data(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor._from_data(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor.from_coo(self)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view (crows [m+1], cols [nnz], values [nnz]); 2-D only."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor) -> "SparseCsrTensor":
+        b = coo._bcoo.sum_duplicates()
+        rows = b.indices[:, 0]
+        cols = b.indices[:, 1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], b.data[order]
+        m = coo.shape[0]
+        crows = jnp.zeros((m + 1,), jnp.int32).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return cls(crows, cols, vals, coo.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return str(self._values.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor._from_data(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor._from_data(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor._from_data(self._values)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """Reference: paddle.sparse.sparse_coo_tensor — indices [sparse_dim, nnz]."""
+    idx = jnp.asarray(_unwrap(indices), jnp.int32).T  # BCOO wants [nnz, ndim]
+    vals = _unwrap(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+
+        vals = vals.astype(dtype_mod.to_np(dtype))
+    if shape is None:
+        shape = tuple(int(x) for x in (idx.max(axis=0) + 1))
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=tuple(int(s) for s in shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    vals = _unwrap(values)
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+
+        vals = vals.astype(dtype_mod.to_np(dtype))
+    return SparseCsrTensor(_unwrap(crows), _unwrap(cols), vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _as_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y):
+    x, y = _as_coo(x), _as_coo(y)
+    if isinstance(y, SparseCooTensor):
+        out = (x._bcoo + y._bcoo).sum_duplicates()
+        return SparseCooTensor(out)
+    return Tensor._from_data(x._bcoo.todense() + _unwrap(y))
+
+
+def multiply(x, y):
+    x = _as_coo(x)
+    if isinstance(y, SparseCooTensor):
+        # elementwise on matching sparsity: multiply dense of one with other
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            x._bcoo.todense() * y._bcoo.todense()))
+    yv = _unwrap(y)
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data * yv, x._bcoo.indices),
+                                        shape=x._bcoo.shape)
+                           if jnp.ndim(yv) == 0 else
+                           jsparse.BCOO.fromdense(x._bcoo.todense() * yv))
+
+
+def matmul(x, y):
+    """sparse @ dense (SpMM — XLA lowers BCOO dot_general to gather+segsum)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xs = _as_coo(x)
+        yv = _unwrap(y)
+        out = xs._bcoo @ yv
+        return Tensor._from_data(out)
+    xv = _unwrap(x)
+    ys = _as_coo(y)
+    return Tensor._from_data((ys._bcoo.T @ xv.T).T)
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) sampled at mask's sparsity (SDDMM)."""
+    xv, yv = _unwrap(x), _unwrap(y)
+    m = _as_coo(mask)
+    idx = m._bcoo.indices
+    vals = jnp.einsum("nk,nk->n", xv[idx[:, 0], :], yv[:, idx[:, 1]].T)
+    return SparseCooTensor(jsparse.BCOO((vals.astype(xv.dtype), idx),
+                                        shape=m._bcoo.shape))
+
+
+def relu(x):
+    x = _as_coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def transpose(x, perm):
+    x = _as_coo(x)
+    return SparseCooTensor(x._bcoo.transpose(tuple(perm)))
+
+
+def coalesce(x):
+    return _as_coo(x).coalesce()
+
+
+class _SparseNN:
+    """paddle.sparse.nn namespace stub with ReLU."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+nn = _SparseNN()

@@ -143,15 +143,27 @@ from ..jit.serialization import load as load_inference_model_impl  # noqa: E402
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, program=None, **kw):
-    from ..jit.serialization import save as jit_save
+    """Reference: python/paddle/static/io.py save_inference_model. Accepts
+    either `layer=` (traced via jit.save with the feed specs) or a
+    pir.Program via `program=` (serialized StableHLO)."""
+    from ..jit.serialization import _write_artifact, save as jit_save
 
+    from ..pir import Program as PirProgram
+
+    if isinstance(program, PirProgram):
+        _write_artifact(path_prefix,
+                        {"stablehlo_program": program.serialize(),
+                         "state": {}, "input_spec": None, "layer": None},
+                        {})
+        return
     layer = kw.get("layer")
     if layer is None:
         raise NotImplementedError(
-            "save_inference_model requires layer= kwarg in this framework "
-            "(trace-based export); use paddle.jit.save(layer, path) directly"
+            "save_inference_model requires layer= (trace-based export) or "
+            "program= (pir.Program); or use paddle.jit.save(layer, path)"
         )
-    jit_save(layer, path_prefix)
+    spec = feed_vars if feed_vars else None
+    jit_save(layer, path_prefix, input_spec=spec)
 
 
 def load_inference_model(path_prefix, executor=None, **kw):

@@ -1,0 +1,230 @@
+"""linalg / fft / signal / distribution / sparse / einsum namespace tests
+(reference suites: test/fft, test/distribution, legacy_test linalg ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestLinalg:
+    def test_svd_qr_eigh_det(self):
+        rng = np.random.RandomState(0)
+        a = rng.normal(size=(6, 4)).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), a,
+            rtol=1e-4, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4,
+                                   atol=1e-4)
+        sym = a.T @ a
+        w, v2 = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(
+            v2.numpy() @ np.diag(w.numpy()) @ v2.numpy().T, sym,
+            rtol=1e-3, atol=1e-3)
+        d = paddle.linalg.det(paddle.to_tensor(sym))
+        np.testing.assert_allclose(d.numpy(), np.linalg.det(sym), rtol=1e-3)
+
+    def test_solve_inv_norms(self):
+        rng = np.random.RandomState(1)
+        a = rng.normal(size=(4, 4)).astype(np.float32) + 4 * np.eye(
+            4, dtype=np.float32)
+        b = rng.normal(size=(4, 2)).astype(np.float32)
+        x = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(a @ x.numpy(), b, rtol=1e-4, atol=1e-4)
+        inv = paddle.linalg.inv(paddle.to_tensor(a))
+        np.testing.assert_allclose(inv.numpy() @ a, np.eye(4), rtol=1e-3,
+                                   atol=1e-3)
+        vn = paddle.linalg.vector_norm(paddle.to_tensor(b.ravel()))
+        np.testing.assert_allclose(vn.numpy(), np.linalg.norm(b.ravel()),
+                                   rtol=1e-5)
+        mn = paddle.linalg.matrix_norm(paddle.to_tensor(a))
+        np.testing.assert_allclose(mn.numpy(), np.linalg.norm(a), rtol=1e-5)
+
+    def test_svd_grad(self):
+        a = paddle.rand([4, 4])
+        a.stop_gradient = False
+        u, s, v = paddle.linalg.svd(a)
+        s.sum().backward()
+        assert a.grad is not None
+
+
+def test_einsum():
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+    t = paddle.to_tensor(rng.normal(size=(2, 3, 4)).astype(np.float32))
+    out = paddle.einsum("bij->bji", t)
+    np.testing.assert_allclose(out.numpy(), t.numpy().transpose(0, 2, 1))
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(8, 16)).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).normal(size=(16,)).astype(np.float32)
+        X = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-4)
+        back = paddle.fft.irfft(X)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-4)
+
+    def test_fft2_shift_freq(self):
+        x = np.random.RandomState(2).normal(size=(4, 8)).astype(np.float32)
+        X = paddle.fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.fft2(x), rtol=1e-4,
+                                   atol=1e-4)
+        sh = paddle.fft.fftshift(X)
+        np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(np.fft.fft2(x)),
+                                   rtol=1e-4, atol=1e-4)
+        f = paddle.fft.fftfreq(8, d=0.5)
+        np.testing.assert_allclose(f.numpy(), np.fft.fftfreq(8, d=0.5))
+
+    def test_norm_validation(self):
+        with pytest.raises(ValueError, match="norm"):
+            paddle.fft.fft(paddle.rand([4]), norm="bogus")
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        window = np.hanning(128).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128,
+                                  hop_length=32,
+                                  window=paddle.to_tensor(window))
+        assert spec.shape[-2] == 65  # onesided bins
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                                   window=paddle.to_tensor(window),
+                                   length=512)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_overlap_add(self):
+        x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+        f = paddle.signal.frame(x, frame_length=4, hop_length=2)
+        assert f.shape == [4, 4]
+        np.testing.assert_array_equal(f.numpy()[:, 0], [0, 1, 2, 3])
+        back = paddle.signal.overlap_add(f, hop_length=4)
+        assert back.shape[0] == 16
+
+
+class TestDistribution:
+    def test_normal(self):
+        d = paddle.distribution.Normal(0.0, 1.0)
+        paddle.seed(7)
+        s = d.sample([2000])
+        assert abs(float(s.numpy().mean())) < 0.1
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        np.testing.assert_allclose(lp.numpy(), -0.9189385, rtol=1e-5)
+        ent = d.entropy()
+        np.testing.assert_allclose(ent.numpy(), 1.4189385, rtol=1e-5)
+
+    def test_uniform_categorical_bernoulli(self):
+        u = paddle.distribution.Uniform(0.0, 2.0)
+        assert abs(float(u.mean.numpy()) - 1.0) < 1e-6
+        np.testing.assert_allclose(
+            u.log_prob(paddle.to_tensor(0.5)).numpy(), np.log(0.5))
+        c = paddle.distribution.Categorical(
+            logits=paddle.to_tensor(np.log([0.2, 0.3, 0.5]).astype(np.float32)))
+        np.testing.assert_allclose(
+            c.log_prob(paddle.to_tensor([2])).numpy(), [np.log(0.5)],
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            float(c.entropy().numpy()),
+            -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)),
+            rtol=1e-5)
+        b = paddle.distribution.Bernoulli(probs=0.3)
+        np.testing.assert_allclose(b.log_prob(paddle.to_tensor(1.0)).numpy(),
+                                   np.log(0.3), rtol=1e-5)
+
+    def test_more_distributions_moments(self):
+        paddle.seed(11)
+        D = paddle.distribution
+        checks = [
+            (D.Exponential(2.0), 0.5),
+            (D.Gamma(3.0, 2.0), 1.5),
+            (D.Laplace(1.0, 0.5), 1.0),
+            (D.Gumbel(0.0, 1.0), 0.5772),
+            (D.LogNormal(0.0, 0.5), np.exp(0.125)),
+            (D.Poisson(4.0), 4.0),
+            (D.Beta(2.0, 2.0), 0.5),
+        ]
+        for dist, expected_mean in checks:
+            s = dist.sample([4000])
+            got = float(np.mean(s.numpy()))
+            assert abs(got - expected_mean) < 0.25, (type(dist).__name__, got)
+
+    def test_kl_registry(self):
+        D = paddle.distribution
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = D.kl_divergence(p, q)
+        expected = np.log(2.0) + (1 + 1) / 8 - 0.5
+        np.testing.assert_allclose(kl.numpy(), expected, rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(p, D.Poisson(1.0))
+
+
+class TestSparse:
+    def test_coo_create_dense_roundtrip(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        s = paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+        assert s.nnz == 3
+        dense = s.to_dense().numpy()
+        expected = np.zeros((3, 3), np.float32)
+        expected[0, 1], expected[1, 2], expected[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(dense, expected)
+
+    def test_csr_conversion(self):
+        indices = [[0, 0, 1], [0, 2, 1]]
+        s = paddle.sparse.sparse_coo_tensor(indices, [1.0, 2.0, 3.0],
+                                            shape=[2, 3])
+        csr = s.to_sparse_csr()
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3])
+        np.testing.assert_array_equal(csr.cols().numpy(), [0, 2, 1])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(),
+                                      s.to_dense().numpy())
+
+    def test_spmm_and_ops(self):
+        rng = np.random.RandomState(0)
+        dense = np.zeros((4, 4), np.float32)
+        dense[0, 1], dense[2, 3], dense[3, 0] = 1.5, -2.0, 0.5
+        idx = np.nonzero(dense)
+        s = paddle.sparse.sparse_coo_tensor(
+            np.stack(idx), dense[idx], shape=[4, 4])
+        y = rng.normal(size=(4, 3)).astype(np.float32)
+        out = paddle.sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-5)
+        r = paddle.sparse.relu(s)
+        assert (r.to_dense().numpy() >= 0).all()
+        summed = paddle.sparse.add(s, s)
+        np.testing.assert_allclose(summed.to_dense().numpy(), 2 * dense)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y = rng.normal(size=(5, 4)).astype(np.float32)
+        mask_dense = np.zeros((4, 4), np.float32)
+        mask_dense[0, 0] = mask_dense[1, 3] = 1
+        idx = np.nonzero(mask_dense)
+        mask = paddle.sparse.sparse_coo_tensor(
+            np.stack(idx), mask_dense[idx], shape=[4, 4])
+        out = paddle.sparse.masked_matmul(
+            paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        np.testing.assert_allclose(
+            out.to_dense().numpy(), full * mask_dense.astype(bool),
+            rtol=1e-4, atol=1e-4)

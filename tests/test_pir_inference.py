@@ -1,0 +1,243 @@
+"""Program IR + inference Predictor tests (reference suites: test/pir,
+test/inference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import pir
+
+
+def _f(x):
+    y = x * 2.0
+    z = y + y  # CSE candidate after folding? no — y used twice, fine
+    w = paddle.to_tensor(np.float32(3.0)) * paddle.to_tensor(np.float32(4.0))
+    return z.sum() + w
+
+
+class TestProgram:
+    def test_trace_and_structure(self):
+        x = paddle.rand([4, 4])
+        prog = pir.trace_program(lambda a: (a * 2.0).sum(), x)
+        assert prog.num_ops() >= 2
+        ops = prog.ops
+        names = [o.name for o in ops]
+        assert any("mul" in n for n in names)
+        assert any("reduce_sum" in n or "sum" in n for n in names)
+        op = ops[0]
+        assert op.num_results() >= 1
+        assert isinstance(op.results[0].shape, list)
+        assert len(prog.global_block()) == prog.num_ops()
+
+    def test_program_run_and_compile(self):
+        x = paddle.rand([3, 3])
+        prog = pir.trace_program(lambda a: a @ a + 1.0, x)
+        out = prog.run({"feed_0": x})
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   x.numpy() @ x.numpy() + 1.0, rtol=1e-5)
+
+    def test_interpreter_matches_compiled(self):
+        x = paddle.rand([3, 3])
+        prog = pir.trace_program(lambda a: (a * a).sum(), x)
+        seen = []
+        interp = pir.Interpreter(
+            prog, instrument=lambda name, i, o: seen.append(name))
+        out_i = interp.run({"feed_0": x})
+        out_c = prog.run({"feed_0": x})
+        np.testing.assert_allclose(np.asarray(out_i[0]),
+                                   np.asarray(out_c[0]), rtol=1e-6)
+        assert seen  # instrumentation fired per instruction
+
+    def test_serialize_roundtrip(self):
+        x = paddle.rand([2, 8])
+        prog = pir.trace_program(lambda a: paddle.nn.functional.relu(a @ a.T),
+                                 x)
+        data = prog.serialize()
+        assert isinstance(data, bytes) and len(data) > 100
+        back = pir.Program.deserialize(data)
+        out = back.run({"feed_0": x})
+        ref = np.maximum(x.numpy() @ x.numpy().T, 0)
+        np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestPasses:
+    def test_dce(self):
+        x = paddle.rand([4])
+
+        def f(a):
+            unused = (a * 3.0).sum()  # dead
+            return (a + 1.0).sum()
+
+        prog = pir.trace_program(f, x)
+        n0 = prog.num_ops()
+        out = pir.DeadCodeEliminationPass().run(prog)
+        assert out.num_ops() < n0
+        np.testing.assert_allclose(np.asarray(out.run({"feed_0": x})[0]),
+                                   x.numpy().sum() + 4.0, rtol=1e-5)
+
+    def test_freeze_then_constant_fold(self):
+        """Inference freeze: bind a weight feed, fold its subgraph away."""
+        x = paddle.rand([4])
+        c = paddle.to_tensor(np.float32(3.0))
+
+        def f(a, w):
+            return a * (w * 4.0)
+
+        prog = pir.trace_program(f, x, c)
+        frozen = prog.freeze({"feed_1": c})
+        assert frozen.feed_names == ["feed_0"]
+        folded = pir.ConstantFoldingPass().run(frozen)
+        assert folded.num_ops() < frozen.num_ops()
+        np.testing.assert_allclose(np.asarray(folded.run({"feed_0": x})[0]),
+                                   x.numpy() * 12.0, rtol=1e-5)
+
+    def test_cse(self):
+        x = paddle.rand([4, 4])
+
+        def f(a):
+            return (a @ a) + (a @ a)  # identical matmuls
+
+        prog = pir.trace_program(f, x)
+        before = sum(1 for o in prog.ops if "dot" in o.name)
+        out = pir.CommonSubexpressionEliminationPass().run(prog)
+        after = sum(1 for o in out.ops if "dot" in o.name)
+        assert after < before
+        np.testing.assert_allclose(np.asarray(out.run({"feed_0": x})[0]),
+                                   2 * (x.numpy() @ x.numpy()), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_pass_manager_pipeline(self):
+        x = paddle.rand([4, 4])
+
+        def f(a):
+            dead = (a * 9.0).sum()
+            return (a @ a) + (a @ a)
+
+        prog = pir.trace_program(f, x)
+        pm = pir.PassManager()
+        pm.add_pass("dead_code_elimination_pass")
+        pm.add_pass("common_subexpression_elimination_pass")
+        pm.add_pass("constant_folding_pass")
+        out = pm.run(prog)
+        assert out.num_ops() < prog.num_ops()
+        np.testing.assert_allclose(
+            np.asarray(out.run({"feed_0": x})[0]),
+            2 * (x.numpy() @ x.numpy()), rtol=1e-4, atol=1e-5)
+
+
+class TestPredictor:
+    def _save_model(self, tmp_path):
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m.eval()
+        path = str(tmp_path / "inference" / "model")
+        paddle.jit.save(m, path,
+                        input_spec=[paddle.static.InputSpec([1, 8],
+                                                            "float32")])
+        return m, path
+
+    def test_predictor_zero_copy_flow(self, tmp_path):
+        m, path = self._save_model(tmp_path)
+        from paddle_tpu import inference as infer
+
+        config = infer.Config(path)
+        pred = infer.create_predictor(config)
+        x = np.random.RandomState(0).normal(size=(1, 8)).astype(np.float32)
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        got = out_h.copy_to_cpu()
+        ref = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_predictor_list_api_and_clone(self, tmp_path):
+        m, path = self._save_model(tmp_path)
+        from paddle_tpu import inference as infer
+
+        pred = infer.create_predictor(infer.Config(path))
+        x = np.ones((1, 8), np.float32)
+        outs = pred.run([paddle.to_tensor(x)])
+        ref = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(outs[0].numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+        pred2 = pred.clone()
+        outs2 = pred2.run([paddle.to_tensor(x)])
+        np.testing.assert_allclose(outs2[0].numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_dynamic_batch_dim(self, tmp_path):
+        """None dims export as symbolic — any batch size serves."""
+        m = nn.Linear(4, 2)
+        m.eval()
+        path = str(tmp_path / "dyn" / "model")
+        paddle.jit.save(m, path,
+                        input_spec=[paddle.static.InputSpec([None, 4],
+                                                            "float32",
+                                                            name="x")])
+        from paddle_tpu import inference as infer
+
+        pred = infer.create_predictor(infer.Config(path))
+        assert pred.get_input_names() == ["x"]  # spec names preserved
+        for bs in (1, 8, 3):
+            x = np.random.RandomState(bs).normal(size=(bs, 4)).astype(
+                np.float32)
+            outs = pred.run([paddle.to_tensor(x)])
+            ref = m(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(outs[0].numpy(), ref, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_save_preserves_training_mode(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        m.train()
+        paddle.jit.save(m, str(tmp_path / "m" / "model"),
+                        input_spec=[paddle.static.InputSpec([1, 4],
+                                                            "float32")])
+        assert m.training is True
+        assert all(l.training for l in m.sublayers(include_self=True))
+
+    def test_jit_load_from_stablehlo_only(self, tmp_path):
+        """load() works from the exported program when the class pickle is
+        unavailable (source-free deployment)."""
+        import pickle
+
+        m = nn.Linear(4, 2)
+        m.eval()
+        path = str(tmp_path / "shlo" / "model")
+        paddle.jit.save(m, path,
+                        input_spec=[paddle.static.InputSpec([1, 4],
+                                                            "float32")])
+        with open(path + ".pdmodel", "rb") as f:
+            payload = pickle.load(f)
+        payload["layer"] = None
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(payload, f)
+        t = paddle.jit.load(path)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(t(x).numpy(), m(x).numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_static_program_roundtrip(self, tmp_path):
+        x = paddle.rand([2, 3])
+        prog = pir.trace_program(lambda a: a * 2.0 + 1.0, x)
+        prefix = str(tmp_path / "prog" / "model")
+        paddle.static.save_inference_model(prefix, [], [], program=prog)
+        from paddle_tpu import inference as infer
+
+        pred = infer.create_predictor(infer.Config(prefix))
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0].numpy(), x.numpy() * 2 + 1,
+                                   rtol=1e-5)
+        # the same-module loader also reads it
+        t = paddle.jit.load(prefix)
+        np.testing.assert_allclose(t(x).numpy(), x.numpy() * 2 + 1,
+                                   rtol=1e-5)
+
+    def test_predictor_missing_input_raises(self, tmp_path):
+        _, path = self._save_model(tmp_path)
+        from paddle_tpu import inference as infer
+
+        pred = infer.create_predictor(infer.Config(path))
+        with pytest.raises(ValueError, match="inputs not set"):
+            pred.run()
