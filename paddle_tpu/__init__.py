@@ -158,6 +158,8 @@ from . import distribution  # noqa: F401, E402
 from . import sparse  # noqa: F401, E402
 from . import pir  # noqa: F401, E402
 from . import inference  # noqa: F401, E402
+from . import device  # noqa: F401, E402
+from . import quantization  # noqa: F401, E402
 from . import framework  # noqa: F401, E402
 from .framework.io_api import load, save  # noqa: F401, E402
 from .hapi.model import Model  # noqa: F401, E402

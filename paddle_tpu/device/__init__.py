@@ -1,0 +1,111 @@
+"""paddle.device parity — device control + memory introspection.
+
+Reference: python/paddle/device/ (set_device, cuda.* memory stats backed by
+phi/core/memory/stats.cc). TPU-native: memory numbers come from PJRT
+`Device.memory_stats()`.
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    import jax
+
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def _stats(device_id: int = 0) -> dict:
+    import jax
+
+    devs = jax.devices()
+    d = devs[device_id % len(devs)]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+class _MemNamespace:
+    """Memory APIs shared by paddle.device.cuda and the tpu equivalent
+    (reference: device/cuda/__init__.py max_memory_allocated etc.)."""
+
+    @staticmethod
+    def max_memory_allocated(device=None) -> int:
+        return int(_stats(_dev_id(device)).get("peak_bytes_in_use", 0))
+
+    @staticmethod
+    def max_memory_reserved(device=None) -> int:
+        s = _stats(_dev_id(device))
+        return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+    @staticmethod
+    def memory_allocated(device=None) -> int:
+        return int(_stats(_dev_id(device)).get("bytes_in_use", 0))
+
+    @staticmethod
+    def memory_reserved(device=None) -> int:
+        s = _stats(_dev_id(device))
+        return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+    @staticmethod
+    def device_count() -> int:
+        import jax
+
+        return len([d for d in jax.devices() if d.platform != "cpu"]) or \
+            jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        import gc
+
+        gc.collect()
+
+
+def _dev_id(device) -> int:
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    s = str(device)
+    return int(s.split(":")[-1]) if ":" in s else 0
+
+
+cuda = _MemNamespace()
+tpu = _MemNamespace()
+xpu = _MemNamespace()

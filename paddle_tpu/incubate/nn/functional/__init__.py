@@ -193,3 +193,122 @@ swiglu = _OPS["swiglu"]
 fused_rotary_position_embedding = _OPS["fused_rotary_position_embedding"]
 fused_bias_dropout_residual_layer_norm = _OPS[
     "fused_bias_dropout_residual_layer_norm"]
+
+
+@register_op(name="fused_attention")
+def _fused_attention(x, qkv_weight, linear_weight, qkv_bias=None,
+                     linear_bias=None, pre_ln_scale=None, pre_ln_bias=None,
+                     ln_scale=None, ln_bias=None, num_heads=None,
+                     pre_layer_norm=False, epsilon=1e-5, attn_dropout_rate=0.0,
+                     dropout_rate=0.0, attn_mask=None, training=False):
+    """Fused MHA block (reference: incubate/nn/functional/fused_attention
+    → fused_attention kernel, kernels/fusion/gpu/fused_attention): optional
+    pre-LN → packed-QKV projection → SDPA → out projection → residual →
+    optional post-LN. XLA fuses the chain into a handful of kernels.
+
+    x: [B, T, D]; qkv_weight: [3, H, Dh, D] (paddle layout);
+    linear_weight: [D, D].
+    """
+    def ln(y, scale, bias):
+        mean = jnp.mean(y, axis=-1, keepdims=True)
+        var = jnp.var(y, axis=-1, keepdims=True)
+        out = (y - mean) * jax.lax.rsqrt(var + epsilon)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+    residual = x
+    h = ln(x, pre_ln_scale, pre_ln_bias) if pre_layer_norm else x
+    three, H, Dh, D = qkv_weight.shape
+    qkv = jnp.einsum("btd,khnd->btkhn", h, qkv_weight)  # [B,T,3,H,Dh]
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,Dh]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if attn_mask is not None:
+        logits = (jnp.where(attn_mask, logits, -1e30)
+                  if attn_mask.dtype == jnp.bool_ else logits + attn_mask)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if training and attn_dropout_rate > 0.0:
+        from ....core.rng import next_key
+
+        keep = 1.0 - attn_dropout_rate
+        probs = probs * jax.random.bernoulli(next_key(), keep,
+                                             probs.shape) / keep
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(h.shape[0],
+                                                        h.shape[1], H * Dh)
+    out = o @ linear_weight
+    if linear_bias is not None:
+        out = out + linear_bias
+    if training and dropout_rate > 0.0:
+        from ....core.rng import next_key
+
+        keep = 1.0 - dropout_rate
+        out = out * jax.random.bernoulli(next_key(), keep, out.shape) / keep
+    out = residual + out
+    if not pre_layer_norm:
+        out = ln(out, ln_scale, ln_bias)
+    return out.astype(x.dtype)
+
+
+@register_op(name="fused_feedforward")
+def _fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                       linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                       ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                       dropout2_rate=0.5, activation="relu",
+                       ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                       pre_layer_norm=False, training=False):
+    """Fused transformer FFN block (reference: fused_feedforward op)."""
+    def ln(y, scale, bias, eps):
+        mean = jnp.mean(y, axis=-1, keepdims=True)
+        var = jnp.var(y, axis=-1, keepdims=True)
+        out = (y - mean) * jax.lax.rsqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+    def drop(y, rate):
+        if training and rate > 0.0:
+            from ....core.rng import next_key
+
+            keep = 1.0 - rate
+            return y * jax.random.bernoulli(next_key(), keep, y.shape) / keep
+        return y
+
+    residual = x
+    h = ln(x, ln1_scale, ln1_bias, ln1_epsilon) if pre_layer_norm else x
+    h = h @ linear1_weight
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+           "silu": jax.nn.silu}[activation]
+    h = drop(act(h), dropout1_rate)
+    h = h @ linear2_weight
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    out = residual + drop(h, dropout2_rate)
+    if not pre_layer_norm:
+        out = ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out.astype(x.dtype)
+
+
+@register_op(name="fused_linear")
+def _fused_linear(x, weight, bias=None, transpose_weight=False):
+    """Reference: incubate/nn/functional/fused_linear (cublasLt epilogue
+    fusion) — on TPU the bias add fuses into the matmul automatically."""
+    w = weight.T if transpose_weight else weight
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+fused_attention = _OPS["fused_attention"]
+fused_feedforward = _OPS["fused_feedforward"]
+fused_linear = _OPS["fused_linear"]
+fused_matmul_bias = _OPS["fused_linear"]

@@ -1,0 +1,121 @@
+"""incubate.nn fused layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :121, FusedFeedForward, FusedLinear) over the
+fused_* functionals.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from . import functional as F
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, transpose_weight: bool = False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape)
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              self.transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: fused_transformer.py FusedMultiHeadAttention — packed QKV
+    + SDPA + out-proj + residual + LN in one functional call."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim])
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], is_bias=True)
+        self.linear_weight = self.create_parameter([embed_dim, embed_dim])
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        ones = lambda: Tensor(np.ones(embed_dim, np.float32))
+        zeros = lambda: Tensor(np.zeros(embed_dim, np.float32))
+        from ...core.tensor import Parameter
+
+        self.pre_ln_scale = Parameter.from_tensor(ones())
+        self.pre_ln_bias = Parameter.from_tensor(zeros())
+        self.ln_scale = Parameter.from_tensor(ones())
+        self.ln_bias = Parameter.from_tensor(zeros())
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return F.fused_attention(
+            query, self.qkv_weight, self.linear_weight,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            num_heads=self.num_heads, pre_layer_norm=self.normalize_before,
+            epsilon=self.epsilon, attn_dropout_rate=self.attn_dropout_rate,
+            dropout_rate=self.dropout_rate, attn_mask=attn_mask,
+            training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """Reference: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model: int, dim_feedforward: int, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter([d_model,
+                                                     dim_feedforward])
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter([dim_feedforward,
+                                                     d_model])
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        from ...core.tensor import Parameter
+
+        ones = lambda: Tensor(np.ones(d_model, np.float32))
+        zeros = lambda: Tensor(np.zeros(d_model, np.float32))
+        self.ln1_scale = Parameter.from_tensor(ones())
+        self.ln1_bias = Parameter.from_tensor(zeros())
+        self.ln2_scale = Parameter.from_tensor(ones())
+        self.ln2_bias = Parameter.from_tensor(zeros())
+
+    def forward(self, x):
+        return F.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)

@@ -1,0 +1,219 @@
+"""paddle.quantization parity — QAT (fake-quant) + PTQ (observer) flows.
+
+Reference: python/paddle/quantization/{config,qat,ptq}.py + imperative
+quant-aware layers. TPU-native: fake-quant is a quant-dequant composition
+with a straight-through estimator (the round sits behind stop_gradient, so
+backward sees identity) — XLA fuses it into the surrounding matmul; int8
+inference itself rides XLA's native int8 dot support when converted.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import register_op
+from .. import ops
+
+
+@register_op(name="fake_quantize_dequantize_abs_max")
+def _fake_qdq(x, scale, bit_length=8):
+    """Quant-dequant with straight-through gradient (reference:
+    fake_quantize_dequantize kernels)."""
+    import jax
+
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax observer + fake quant (reference:
+    quantization/quanters/abs_max.py)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("_scale", Tensor(np.ones((), np.float32)))
+        self._initialized = False
+
+    def forward(self, x):
+        absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
+        if self.training:
+            if not self._initialized:
+                new = absmax
+                self._initialized = True
+            else:
+                cur = float(self._scale.numpy())
+                new = self.moving_rate * cur + (1 - self.moving_rate) * absmax
+            self._scale._data = jnp.asarray(np.float32(new))
+        return ops.get_op("fake_quantize_dequantize_abs_max")(
+            x, self._scale, self.bit_length)
+
+    def scales(self):
+        return self._scale
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ calibration observer: records running absmax, passes through."""
+
+    def __init__(self, quant_bits: int = 8, **kw):
+        super().__init__()
+        self.bit_length = quant_bits
+        self.register_buffer("_scale", Tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
+        self._scale._data = jnp.maximum(self._scale._data,
+                                        jnp.asarray(np.float32(absmax)))
+        return x
+
+    def scales(self):
+        return self._scale
+
+
+class QuantConfig:
+    """Reference: quantization/config.py."""
+
+    def __init__(self, activation: Optional[BaseQuanter] = None,
+                 weight: Optional[BaseQuanter] = None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._layer_configs: Dict[Type, Dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._layer_configs[t] = {"activation": activation,
+                                      "weight": weight}
+
+    def _for_layer(self, layer):
+        cfg = self._layer_configs.get(type(layer))
+        if cfg:
+            return cfg["activation"], cfg["weight"]
+        return self._global_activation, self._global_weight
+
+
+def _clone_quanter(q):
+    if q is None:
+        return None
+    return type(q)(**{k: v for k, v in {
+        "moving_rate": getattr(q, "moving_rate", None),
+        "bit_length": getattr(q, "bit_length", None),
+        "quant_bits": getattr(q, "bit_length", None),
+    }.items() if v is not None and k in type(q).__init__.__code__.co_varnames})
+
+
+class QuantedLayer(Layer):
+    """Wraps a Linear/Conv2D with activation+weight fake quant."""
+
+    def __init__(self, inner: Layer, act_quanter, weight_quanter):
+        super().__init__()
+        self._inner = inner
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            saved = w._data
+            wq = self.weight_quanter(
+                Tensor._from_data(w._data))
+            self._inner.weight._data = wq._data
+            try:
+                return self._inner(x)
+            finally:
+                self._inner.weight._data = saved
+        return self._inner(x)
+
+
+_DEFAULT_QUANTABLE = None
+
+
+def _quantable_types():
+    global _DEFAULT_QUANTABLE
+    if _DEFAULT_QUANTABLE is None:
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        _DEFAULT_QUANTABLE = (Linear, Conv2D)
+    return _DEFAULT_QUANTABLE
+
+
+def _swap_quantable(model: Layer, config: QuantConfig):
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, _quantable_types()):
+            act, wt = config._for_layer(child)
+            model._sub_layers[name] = QuantedLayer(
+                child, _clone_quanter(act), _clone_quanter(wt))
+        else:
+            _swap_quantable(child, config)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (reference: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        model.train()
+        return _swap_quantable(model, self._config)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        model.eval()
+        return model
+
+
+class PTQ:
+    """Post-training quantization (reference: quantization/ptq.py):
+    instrument with observers, run calibration batches, then freeze."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._config = config or QuantConfig(
+            activation=AbsmaxObserver(), weight=AbsmaxObserver())
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        model.eval()
+        return _swap_quantable(model, self._config)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Replace observers with fixed fake-quant at observed scales."""
+        def freeze(m: Layer):
+            for name, child in list(m._sub_layers.items()):
+                if isinstance(child, QuantedLayer):
+                    for qname in ("act_quanter", "weight_quanter"):
+                        q = getattr(child, qname)
+                        if isinstance(q, AbsmaxObserver):
+                            fixed = FakeQuanterWithAbsMaxObserver(
+                                bit_length=q.bit_length)
+                            fixed._scale._data = q._scale._data
+                            fixed._initialized = True
+                            fixed.eval()
+                            setattr(child, qname, fixed)
+                else:
+                    freeze(child)
+        freeze(model)
+        model.eval()
+        return model
+
+
+def quanter(name):  # decorator registry parity
+    def deco(cls):
+        return cls
+    return deco

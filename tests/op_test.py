@@ -1,0 +1,155 @@
+"""OpTest — the op-unit-test workhorse.
+
+Reference: `test/legacy_test/op_test.py:418` (1189 test files build on it):
+run the kernel, compare against a NumPy reference (`check_output`), and
+compare analytic gradients against numeric finite differences
+(`check_grad`, `get_numeric_gradient` op_test.py:148), across a dtype
+matrix with per-op thresholds (the white_list system,
+test/white_list/op_accuracy_white_list.py).
+
+TPU-native adaptation: ops are positional-signature registry entries
+(paddle_tpu.ops.dispatch.OPS); gradients flow through the eager tape, and
+the numeric gradient perturbs inputs through the SAME op call, so the check
+covers dispatch + autograd end-to-end.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import OPS
+
+# per-dtype default thresholds (reference: op_threshold_white_list.py)
+DTYPE_THRESHOLDS = {
+    "float32": dict(rtol=1e-5, atol=1e-6, grad_rtol=5e-3),
+    "float64": dict(rtol=1e-7, atol=1e-8, grad_rtol=1e-5),
+    "float16": dict(rtol=1e-2, atol=1e-3, grad_rtol=5e-2),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2, grad_rtol=1e-1),
+}
+
+
+class OpTest:
+    """Subclass contract:
+      op_type: registry name
+      def setup(self): set self.inputs (list of np arrays), optional
+          self.kwargs (dict), and self.np_ref (callable over np arrays).
+      optional: dtypes (list), thresholds overrides, grad_inputs (indices).
+    """
+
+    op_type: str = ""
+    dtypes: Sequence[str] = ("float32",)
+    kwargs: Dict = {}
+    grad_inputs: Optional[Sequence[int]] = None
+    thresholds: Dict[str, Dict] = {}
+
+    def setup(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- machinery -------------------------------------------------------
+    def _thr(self, dtype):
+        thr = dict(DTYPE_THRESHOLDS[dtype])
+        thr.update(self.thresholds.get(dtype, {}))
+        return thr
+
+    def _run_op(self, arrays, dtype):
+        op = OPS[self.op_type]
+        tensors = [paddle.to_tensor(a.astype(dtype)) for a in arrays]
+        for t in tensors:
+            t.stop_gradient = False
+        out = op(*tensors, **self.kwargs)
+        return tensors, out
+
+    @staticmethod
+    def _leaves(out) -> List[Tensor]:
+        import jax
+
+        return [t for t in jax.tree.leaves(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+            if isinstance(t, Tensor)]
+
+    def check_output(self, dtype: Optional[str] = None):
+        self.setup()
+        for dt in ([dtype] if dtype else self.dtypes):
+            thr = self._thr(dt)
+            _, out = self._run_op(self.inputs, dt)
+            ref = self.np_ref(*[a.astype(dt if dt != "bfloat16"
+                                         else "float32")
+                                for a in self.inputs])
+            refs = ref if isinstance(ref, (tuple, list)) else [ref]
+            outs = self._leaves(out)
+            assert len(outs) == len(refs), (
+                f"{self.op_type}: {len(outs)} outputs vs {len(refs)} refs")
+            for o, r in zip(outs, refs):
+                np.testing.assert_allclose(
+                    np.asarray(o._data, dtype=np.float32),
+                    np.asarray(r, dtype=np.float32),
+                    rtol=thr["rtol"], atol=thr["atol"],
+                    err_msg=f"{self.op_type}[{dt}] output mismatch")
+
+    def check_grad(self, dtype: str = "float32", eps: float = 1e-3):
+        """Analytic (tape) vs central-difference numeric gradients of
+        sum(outputs) — reference: get_numeric_gradient (op_test.py:148)."""
+        self.setup()
+        thr = self._thr(dtype)
+        which = (self.grad_inputs if self.grad_inputs is not None
+                 else range(len(self.inputs)))
+
+        # weighted loss sum(out * W): a plain sum degenerates for ops whose
+        # outputs have an invariant (softmax rows sum to 1 → zero gradient)
+        import paddle_tpu.core.dtype as dtype_mod
+
+        def _weights(out):
+            ws = []
+            r = np.random.RandomState(123)
+            for o in self._leaves(out):
+                if dtype_mod.is_inexact_dtype(o._data.dtype):
+                    ws.append(r.uniform(0.5, 1.5,
+                                        np.asarray(o._data).shape))
+                else:
+                    ws.append(None)
+            return ws
+
+        tensors, out = self._run_op(self.inputs, dtype)
+        weights = _weights(out)
+        loss = None
+        for o, w in zip(self._leaves(out), weights):
+            if w is None:
+                continue
+            s = (o * paddle.to_tensor(w.astype(np.float32))).sum()
+            loss = s if loss is None else loss + s
+        assert loss is not None, f"{self.op_type}: no differentiable output"
+        loss.backward()
+
+        def fwd_sum(arrays):
+            _, out = self._run_op(arrays, dtype)
+            total = 0.0
+            for o, w in zip(self._leaves(out), weights):
+                if w is not None:
+                    total += float((np.asarray(o._data, np.float64)
+                                    * w).sum())
+            return total
+
+        for i in which:
+            analytic = tensors[i].grad
+            assert analytic is not None, (
+                f"{self.op_type}: no grad for input {i}")
+            a = np.asarray(analytic._data, np.float64)
+            numeric = np.zeros_like(self.inputs[i], dtype=np.float64)
+            flat = self.inputs[i].reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for j in range(flat.size):
+                orig = flat[j]
+                arrays_p = [x.copy() for x in self.inputs]
+                arrays_p[i].reshape(-1)[j] = orig + eps
+                arrays_m = [x.copy() for x in self.inputs]
+                arrays_m[i].reshape(-1)[j] = orig - eps
+                num_flat[j] = (fwd_sum(arrays_p) - fwd_sum(arrays_m)) / (
+                    2 * eps)
+            scale = max(np.abs(numeric).max(), np.abs(a).max(), 1e-3)
+            np.testing.assert_allclose(
+                a, numeric, rtol=thr["grad_rtol"],
+                atol=thr["grad_rtol"] * scale,
+                err_msg=f"{self.op_type}[{dtype}] grad mismatch input {i}")

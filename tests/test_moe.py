@@ -129,6 +129,53 @@ def test_fused_moe_grad():
     assert x.grad is not None and w1.grad is not None and gw.grad is not None
 
 
+def test_fused_attention_matches_unfused():
+    """fused_attention (post-LN) == manual qkv/sdpa/proj/residual/LN."""
+    rng = np.random.RandomState(0)
+    B, T, D, H = 1, 5, 8, 2
+    Dh = D // H
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    qkvw = rng.normal(scale=0.2, size=(3, H, Dh, D)).astype(np.float32)
+    lw = rng.normal(scale=0.2, size=(D, D)).astype(np.float32)
+    out = F_inc.fused_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkvw), paddle.to_tensor(lw),
+        num_heads=H, pre_layer_norm=False)
+    # manual reference
+    qkv = np.einsum("btd,khnd->btkhn", x, qkvw)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Dh)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, D)
+    y = x + o @ lw
+    mean = y.mean(-1, keepdims=True)
+    var = y.var(-1, keepdims=True)
+    ref = (y - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_layers_train():
+    import paddle_tpu.incubate.nn as inn
+
+    attn = inn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+    ffn = inn.FusedFeedForward(16, 32, dropout_rate=0.0)
+    lin = inn.FusedLinear(16, 16)
+    x = paddle.rand([2, 6, 16])
+    y = ffn(attn(lin(x)))
+    assert y.shape == [2, 6, 16]
+    loss = (y ** 2).mean()
+    loss.backward()
+    assert attn.qkv_weight.grad is not None
+    assert ffn.linear1_weight.grad is not None
+    assert lin.weight.grad is not None
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.01,
+        parameters=(list(attn.parameters()) + list(ffn.parameters())
+                    + list(lin.parameters())))
+    opt.step()
+
+
 def test_fused_rms_norm_and_swiglu():
     rng = np.random.RandomState(0)
     x = rng.normal(size=(2, 8)).astype(np.float32)

@@ -1,0 +1,71 @@
+"""Op schema consistency: the ops.yaml manifest pins the public op surface
+(reference analog: op_compat.yaml + the YAML-driven op system, SURVEY.md
+§2.1 'Op YAML')."""
+import inspect
+import os
+import re
+
+from paddle_tpu.ops.dispatch import OPS
+
+YAML = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu", "ops",
+                    "ops.yaml")
+
+
+def _parse_manifest():
+    ops = {}
+    name = None
+    for line in open(YAML):
+        m = re.match(r"- op: (\w+)", line)
+        if m:
+            name = m.group(1)
+        m = re.match(r"\s+args: \((.*)\)", line)
+        if m and name:
+            ops[name] = m.group(1)
+            name = None
+    return ops
+
+
+def _sig_string(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return "..."
+    args = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            args.append("*" + p.name)
+        elif p.kind == p.VAR_KEYWORD:
+            args.append("**" + p.name)
+        elif p.default is inspect._empty:
+            args.append(p.name)
+        else:
+            args.append(f"{p.name}={p.default!r}")
+    return ", ".join(args)
+
+
+def test_every_manifest_op_registered():
+    manifest = _parse_manifest()
+    assert len(manifest) > 250
+    missing = sorted(set(manifest) - set(OPS))
+    assert not missing, f"ops removed from registry but pinned: {missing}"
+
+
+def test_signatures_match_manifest():
+    manifest = _parse_manifest()
+    broken = []
+    for name, args in manifest.items():
+        if name not in OPS or args == "...":
+            continue
+        live = _sig_string(OPS[name]._kernel)
+        if live != args:
+            broken.append(f"{name}: manifest ({args}) != live ({live})")
+    assert not broken, "signature drift:\n" + "\n".join(broken)
+
+
+def test_new_ops_are_manifested():
+    """Every registered op appears in the manifest (regenerate it via the
+    snippet in its header when adding ops)."""
+    manifest = _parse_manifest()
+    unmanifested = sorted(set(OPS) - set(manifest))
+    assert not unmanifested, (
+        f"ops missing from ops.yaml: {unmanifested} — regenerate manifest")

@@ -1,0 +1,89 @@
+"""Quantization (QAT/PTQ) + paddle.device tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    PTQ, QAT, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    QuantedLayer)
+
+
+def _model():
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    return m
+
+
+def test_fake_quant_op_roundtrip_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32))
+    x.stop_gradient = False
+    scale = paddle.to_tensor(np.float32(1.0))
+    from paddle_tpu import ops
+
+    q = ops.get_op("fake_quantize_dequantize_abs_max")(x, scale, 8)
+    # 8-bit quantization error bounded by scale/127
+    assert float(np.abs(q.numpy() - x.numpy()).max()) <= 1.0 / 127 + 1e-6
+    # straight-through: gradient of sum is all-ones
+    q.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    m = QAT(cfg).quantize(_model())
+    assert any(isinstance(l, QuantedLayer)
+               for l in m.sublayers(include_self=False))
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    x = paddle.rand([4, 8])
+    y = paddle.rand([4, 4])
+    losses = []
+    for _ in range(5):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    out = QAT(cfg).convert(m)
+    assert not out.training
+
+
+def test_qat_output_close_to_float():
+    m = _model()
+    x = paddle.rand([4, 8])
+    ref = m(x).numpy()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    qm = QAT(cfg).quantize(m)
+    got = qm(x).numpy()
+    # int8 fake-quant keeps outputs close
+    assert np.abs(got - ref).max() < 0.2 * (np.abs(ref).max() + 1)
+
+
+def test_ptq_calibrate_convert():
+    m = _model()
+    x = paddle.rand([16, 8])
+    ref = m(x).numpy()
+    ptq = PTQ()
+    qm = ptq.quantize(m)
+    for _ in range(3):  # calibration passes
+        qm(x)
+    inf = ptq.convert(qm)
+    # observers replaced by fixed fake-quanters with recorded scales
+    for l in inf.sublayers(include_self=False):
+        if isinstance(l, QuantedLayer):
+            assert isinstance(l.act_quanter, FakeQuanterWithAbsMaxObserver)
+            assert float(l.act_quanter._scale.numpy()) > 0
+    got = inf(x).numpy()
+    assert np.abs(got - ref).max() < 0.2 * (np.abs(ref).max() + 1)
+
+
+def test_device_namespace():
+    assert paddle.device.device_count() >= 1
+    assert isinstance(paddle.device.get_available_device(), list)
+    paddle.device.synchronize()
+    # memory stats: present (ints) on any backend, zeros when unsupported
+    assert isinstance(paddle.device.cuda.max_memory_allocated(), int)
+    assert isinstance(paddle.device.tpu.memory_allocated("tpu:0"), int)
+    paddle.device.cuda.empty_cache()

@@ -1,0 +1,56 @@
+"""Regenerate paddle_tpu/ops/ops.yaml from the live op registry.
+
+Run after adding/changing ops: python tools/gen_op_manifest.py
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import paddle_tpu  # noqa: F401  (registers all ops)
+from paddle_tpu.ops.dispatch import OPS
+
+HEADER = [
+    "# Op schema manifest — the single-source op inventory (reference:",
+    "#   paddle/phi/ops/yaml/ops.yaml, 470 ops driving 6 codegens).",
+    "# In this framework the python registry (ops/kernels/*) is the live",
+    "# source; this manifest pins the public op surface + signatures so",
+    "# removals/signature breaks fail tests/test_op_schema.py.",
+    "# Regenerate: python tools/gen_op_manifest.py",
+    "",
+]
+
+
+def sig_args(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return ["..."]
+    args = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            args.append("*" + p.name)
+        elif p.kind == p.VAR_KEYWORD:
+            args.append("**" + p.name)
+        elif p.default is inspect.Parameter.empty:
+            args.append(p.name)
+        else:
+            args.append(f"{p.name}={p.default!r}")
+    return args
+
+
+def main():
+    lines = list(HEADER)
+    for name in sorted(OPS):
+        lines.append(f"- op: {name}")
+        lines.append(f"  args: ({', '.join(sig_args(OPS[name]._kernel))})")
+    out = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu", "ops",
+                       "ops.yaml")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"{len(OPS)} ops -> {os.path.normpath(out)}")
+
+
+if __name__ == "__main__":
+    main()
