@@ -6,7 +6,20 @@ while the implementation is an idiomatic XLA/PJRT/Pallas stack.
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
+
+# An explicit JAX_PLATFORMS env must win over any platform a sitecustomize
+# pinned via jax.config.update (config beats env in jax). Spawned worker
+# processes (DataLoader, launch, multi-process tests) rely on inheriting
+# JAX_PLATFORMS=cpu to avoid touching the real TPU tunnel.
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        if _jax.config.jax_platforms != _os.environ["JAX_PLATFORMS"]:
+            _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
 
 # Paddle dtype semantics need int64 (default integer dtype). float64 stays out
 # of the compute path via default-dtype coercion in to_tensor, so TPU (no f64)

@@ -108,9 +108,11 @@ void serve_client(StoreServer* s, int fd) {
       std::unique_lock<std::mutex> lk(s->mu);
       s->cv.wait(lk, [&] { return s->stop.load() || s->kv.count(key); });
       if (s->stop.load()) break;
-      const std::string& v = s->kv[key];
-      uint64_t n = v.size();
+      // Copy while holding the lock: a concurrent SET/ADD/DELETE on this key
+      // would invalidate a reference's buffer once we unlock.
+      std::string v = s->kv[key];
       lk.unlock();
+      uint64_t n = v.size();
       if (!write_all(fd, &n, 8) || !write_all(fd, v.data(), v.size())) break;
     } else if (op == 2) {  // ADD
       int64_t delta = 0;

@@ -138,7 +138,17 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
     for fn in sorted(metas):
         with open(os.path.join(path, fn), "rb") as f:
             m = pickle.load(f)
-        meta.state_dict_metadata.update(m.state_dict_metadata)
+        # Each rank's metadata covers only the shards IT owns: extend the
+        # per-key shard lists (dedup replicas by global_offset) — a plain
+        # dict.update would keep only the last rank's shards and silently
+        # zero-fill the rest of the tensor.
+        for k, v in m.state_dict_metadata.items():
+            cur = meta.state_dict_metadata.setdefault(k, [])
+            seen = {tuple(sm.global_offset) for sm in cur}
+            for sm in v:
+                if tuple(sm.global_offset) not in seen:
+                    cur.append(sm)
+                    seen.add(tuple(sm.global_offset))
         meta.storage_metadata.update(m.storage_metadata)
         meta.flat_mapping.update(m.flat_mapping)
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Type
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -49,8 +50,18 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self._initialized = False
 
     def forward(self, x):
-        absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
+        # Observe only in eager training: the host-side absmax concretizes the
+        # value, which would break tracing/export (jit.save, to_static) of an
+        # eval/converted model, where the scale is frozen anyway. Training
+        # under a trace cannot observe — fail loudly rather than silently
+        # freezing the scale at its init value.
         if self.training:
+            if isinstance(x._data, jax.core.Tracer):
+                raise RuntimeError(
+                    "FakeQuanterWithAbsMaxObserver cannot observe scales "
+                    "inside jit/to_static while in train mode; run QAT "
+                    "training eagerly or call .eval() before tracing")
+            absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
             if not self._initialized:
                 new = absmax
                 self._initialized = True
@@ -74,9 +85,12 @@ class AbsmaxObserver(BaseQuanter):
         self.register_buffer("_scale", Tensor(np.zeros((), np.float32)))
 
     def forward(self, x):
-        absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
-        self._scale._data = jnp.maximum(self._scale._data,
-                                        jnp.asarray(np.float32(absmax)))
+        # Calibration is an eager pass (PTQ runs eval-mode batches through the
+        # observers); under tracing just pass through with the frozen scale.
+        if not isinstance(x._data, jax.core.Tracer):
+            absmax = float(np.asarray(jnp.max(jnp.abs(x._data))))
+            self._scale._data = jnp.maximum(self._scale._data,
+                                            jnp.asarray(np.float32(absmax)))
         return x
 
     def scales(self):

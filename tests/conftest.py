@@ -15,6 +15,11 @@ import os
 prev = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+# For THIS process the config.update below is what counts (sitecustomize
+# already imported jax); the env assignment is for SPAWNED SUBPROCESSES
+# (multi-process store/collective/launch tests), which must not touch the
+# real TPU tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 

@@ -93,6 +93,32 @@ def test_nested_state_dict_and_optimizer(tmp_path):
                                        err_msg=k)
 
 
+def test_multihost_metadata_merge(tmp_path):
+    """Simulate a 2-host save: each rank file holds one half of a tensor and a
+    .metadata covering ONLY that half. Load must union the shard lists across
+    metadata files (a dict.update merge keeps just the last rank's half and
+    silently zero-fills the rest)."""
+    import pickle
+
+    full = np.arange(64, dtype=np.float32).reshape(8, 8)
+    halves = {0: (full[:4], (0, 0)), 1: (full[4:], (4, 0))}
+    for rank, (data, goff) in halves.items():
+        fn = f"{rank}_0.distcp"
+        with open(tmp_path / fn, "wb") as f:
+            f.write(np.ascontiguousarray(data).tobytes())
+        meta = dckpt.Metadata()
+        meta.state_dict_metadata["w"] = [
+            dckpt.LocalTensorMetadata(goff, data.shape, "float32")]
+        meta.storage_metadata[dckpt.LocalTensorIndex("w", goff)] = (fn, 0)
+        meta.flat_mapping["w"] = ((8, 8), "float32")
+        with open(tmp_path / f"{rank}.metadata", "wb") as f:
+            pickle.dump(meta, f)
+
+    sd = {"w": paddle.zeros([8, 8])}
+    dckpt.load_state_dict(sd, str(tmp_path))
+    np.testing.assert_allclose(sd["w"].numpy(), full)
+
+
 def test_shape_mismatch_raises(tmp_path):
     dckpt.save_state_dict({"w": paddle.ones([4, 4])}, str(tmp_path))
     with pytest.raises(ValueError, match="shape"):

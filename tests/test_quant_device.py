@@ -79,6 +79,31 @@ def test_ptq_calibrate_convert():
     assert np.abs(got - ref).max() < 0.2 * (np.abs(ref).max() + 1)
 
 
+def test_converted_model_traces_under_jit():
+    """A QAT/PTQ-converted model must be traceable (jit/to_static/export):
+    the observer's host-side absmax would otherwise concretize a tracer."""
+    m = _model()
+    x = paddle.rand([4, 8])
+    ptq = PTQ()
+    qm = ptq.quantize(m)
+    qm(x)  # calibrate
+    inf = ptq.convert(qm)
+    ref = inf(x).numpy()
+    static = paddle.jit.to_static(inf)
+    got = static(x).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # QAT model in eval mode traces too (frozen scales)
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    qat_m = QAT(cfg).quantize(_model())
+    qat_m(x)  # one observed step
+    out = QAT(cfg).convert(qat_m)
+    static2 = paddle.jit.to_static(out)
+    np.testing.assert_allclose(static2(x).numpy(), out(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_device_namespace():
     assert paddle.device.device_count() >= 1
     assert isinstance(paddle.device.get_available_device(), list)
