@@ -18,6 +18,27 @@ import jax
 import jax.numpy as jnp
 
 
+def chip_peak_flops(dev) -> float:
+    """Per-chip bf16 peak from the device kind (NOT hard-coded to one
+    generation — the chip behind the tunnel is e.g. a 'TPU v5 lite')."""
+    kind = getattr(dev, "device_kind", "") or ""
+    kind_l = kind.lower()
+    table = [
+        ("v6", 918e12),           # Trillium
+        ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+        ("v5p", 459e12), ("v5", 459e12),
+        ("v4", 275e12),
+        ("v3", 123e12),
+        ("v2", 46e12),
+    ]
+    if dev.platform == "cpu":
+        return 1e12
+    for pat, peak in table:
+        if pat in kind_l:
+            return peak
+    return 197e12  # conservative default for unknown TPU kinds
+
+
 def pick_config():
     from paddle_tpu.models import llama as L
 
@@ -66,9 +87,9 @@ def main():
 
     tokens_per_sec = B * T * steps / dt
     flops = cfg.flops_per_token() * tokens_per_sec
-    platform = jax.devices()[0].platform
-    peak = {"tpu": 459e12, "cpu": 1e12}.get(platform, 100e12)  # v5p bf16 ≈459 TFLOP/s
-    mfu = flops / peak
+    dev = jax.devices()[0]
+    platform = dev.platform
+    mfu = flops / chip_peak_flops(dev)
 
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0

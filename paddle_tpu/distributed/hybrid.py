@@ -289,7 +289,7 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int):
     vv = (h_full @ lp["wv"].astype(h_full.dtype)).reshape(Bm, T, nkv_loc, hd)
     q = L.apply_rope(q, cos, sin)
     kk = L.apply_rope(kk, cos, sin)
-    o = L.attention(q, kk, vv, impl="xla").reshape(Bm, T, nh_loc * hd)
+    o = L.attention(q, kk, vv, impl="auto").reshape(Bm, T, nh_loc * hd)
     partial = o @ lp["wo"].astype(o.dtype)                         # row-parallel partial
     x = x + lax.psum_scatter(partial, "tp", scatter_dimension=1, tiled=True)
     h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)

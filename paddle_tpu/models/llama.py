@@ -147,11 +147,17 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "auto") -> j
     impl: 'auto' uses the Pallas flash kernel on TPU when available, else the
     XLA einsum path (which XLA fuses well on its own).
     """
+    if impl == "flash":
+        # explicit request: no silent fallback — unsupported shapes raise
+        from ..ops.pallas import flash_attention as _fa
+
+        return _fa.flash_attention(q, k, v, causal=True)
     if impl == "auto":
         try:
             from ..ops.pallas import flash_attention as _fa
 
-            if _fa.available():
+            if (_fa.available() and q.shape[1] == k.shape[1]
+                    and _fa.supported(q.shape, k.shape)):
                 return _fa.flash_attention(q, k, v, causal=True)
         except ImportError:
             pass

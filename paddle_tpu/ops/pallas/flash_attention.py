@@ -1,17 +1,349 @@
 """Flash attention for TPU (Pallas).
 
-Reference parity target: the fused/varlen flash-attention path
-(`paddle/phi/kernels/gpu/flash_attn_kernel.*` wrapping third_party/flashattn,
-SURVEY.md §5 long-context). Kernel implementation lands with the Pallas task;
-until then `available()` is False and callers (models.llama.attention with
-impl='auto') use the XLA einsum path.
+Reference parity target: the fused flash-attention path
+(`paddle/phi/kernels/gpu/flash_attn_kernel.h:1` wrapping third_party/flashattn;
+SURVEY.md §5 long-context, §7 M8). This is NOT a port of the CUDA kernel — it
+is the standard online-softmax tiling written for the TPU memory hierarchy:
+
+- grid (batch, q_head, q_block, kv_block) with the kv dimension innermost, so
+  the (m, l, acc) running statistics live in VMEM scratch across kv steps;
+- blocks sized so q/k/v tiles + the p = exp(s) intermediate stay well inside
+  VMEM, with the MXU doing the two matmuls per tile in f32 accumulation;
+- causal skipping via predicated iterations (`pl.when`): blocks strictly above
+  the diagonal are never computed;
+- GQA handled with BlockSpec index maps (q head h reads kv head h // group) —
+  no materialized jnp.repeat of K/V;
+- backward = recomputation kernels (dq; dk/dv) from the saved logsumexp, the
+  flash-attention-2 formulation: ds = p * (dp - delta), delta = rowsum(dO*O).
+
+Layout contract: q [B, T, H, hd], k/v [B, S, KV, hd] (the model's natural
+layout); kernels run in [B, H, T, hd] — the transposes at the boundary are
+fused by XLA into the surrounding projections.
 """
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
 
 def available() -> bool:
-    return False
+    """True when the Pallas TPU kernel path can run on the default backend."""
+    if pltpu is None:
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
 
 
-def flash_attention(q, k, v, causal: bool = True):
-    raise NotImplementedError("Pallas flash attention kernel not yet built")
+def _pick_block(n: int) -> Optional[int]:
+    for b in (256, 128, 64, 32, 16, 8):
+        if n % b == 0 and b <= n:
+            return b
+    return None
+
+
+def supported(q_shape, k_shape) -> bool:
+    """Static-shape gate: fall back to the XLA path when tiling doesn't fit."""
+    if pltpu is None:
+        return False
+    B, T, H, hd = q_shape
+    S, KV = k_shape[1], k_shape[2]
+    if H % KV != 0:
+        return False
+    if _pick_block(T) is None or _pick_block(S) is None:
+        return False
+    return hd >= 8
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[:] = jnp.zeros_like(acc)
+
+    # causal: kv block j is needed iff its first col <= last row of q block i
+    needed = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)          # [Bq, hd]
+        k = k_ref[0, 0].astype(jnp.float32)          # [Bk, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_sc[:, :1]                          # [Bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                        # [Bq, Bk]
+        alpha = jnp.exp(m_prev - m_new)               # [Bq, 1]
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)           # [Bk, hd]
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+
+    @pl.when(j == nj - 1)
+    def _():
+        l = l_sc[:, :1]
+        o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_sc[:, :1] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
+    """q [B, H, T, hd]; k/v [B, KV, S, hd] → (o [B, H, T, hd], lse [B, H, T])."""
+    B, H, T, hd = q.shape
+    KV, S = k.shape[1], k.shape[2]
+    G = H // KV
+    bq, bk = _pick_block(T), _pick_block(S)
+    grid = (B, H, T // bq, S // bk)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    mem = {"memory_space": pltpu.VMEM}
+    scratch = [
+        pltpu.VMEM((bq, hd), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+        pltpu.VMEM((bq, 128), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-attention-2 recomputation form)
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+               *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+    i, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (not causal) or (j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]                  # [Bq, 1]
+        delta = delta_ref[0, 0][:, None]              # [Bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [Bq, Bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                group: int):
+    # grid: (B, KV, kv_block, g, q_block)
+    jk = pl.program_id(2)
+    g = pl.program_id(3)
+    iq = pl.program_id(4)
+    nq = pl.num_programs(4)
+
+    @pl.when((g == 0) & (iq == 0))
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q block iq contributes iff its last row >= kv block's first col
+    needed = (not causal) or (iq * block_q + block_q - 1 >= jk * block_k)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)           # [Bq, hd]
+        k = k_ref[0, 0].astype(jnp.float32)           # [Bk, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + jk * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [Bq, Bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale              # [Bq, Bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when((g == group - 1) & (iq == nq - 1))
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, interpret, res, do):
+    q, k, v, o, lse = res
+    B, H, T, hd = q.shape
+    KV, S = k.shape[1], k.shape[2]
+    G = H // KV
+    bq, bk = _pick_block(T), _pick_block(S)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    mem = {"memory_space": pltpu.VMEM}
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B, H, T // bq, S // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0),
+                               **mem),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, group=G),
+        grid=(B, KV, S // bk, G, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd),
+                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
+            pl.BlockSpec((1, 1, bq, hd),
+                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq, 0), **mem),
+            pl.BlockSpec((1, 1, bq),
+                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq)),
+            pl.BlockSpec((1, 1, bq),
+                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KV, S, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, KV, S, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, hd), jnp.float32),
+            pltpu.VMEM((bk, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API (custom_vjp over the BHTD kernels, BTHD at the boundary)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhtd(q, k, v, sm_scale, causal, interpret):
+    o, _ = _fwd(q, k, v, sm_scale, causal, interpret)
+    return o
+
+
+def _flash_bhtd_fwd(q, k, v, sm_scale, causal, interpret):
+    o, lse = _fwd(q, k, v, sm_scale, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Fused attention. q [B, T, H, hd], k/v [B, S, KV, hd] → [B, T, H, hd].
+
+    GQA when H > KV (H % KV == 0). `interpret` forces the Pallas interpreter
+    (CPU testing); default: interpret on non-TPU backends.
+    """
+    B, T, H, hd = q.shape
+    S, KV = k.shape[1], k.shape[2]
+    if causal and T != S:
+        raise ValueError(f"causal flash attention needs T == S, got {T} vs {S}")
+    if not supported(q.shape, k.shape):
+        raise ValueError(f"unsupported shapes q={q.shape} k={k.shape}; "
+                         "use the XLA attention path")
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+    if interpret is None:
+        interpret = not available()
+    qt = jnp.swapaxes(q, 1, 2)       # [B, H, T, hd]
+    kt = jnp.swapaxes(k, 1, 2)       # [B, KV, S, hd]
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_bhtd(qt, kt, vt, float(sm_scale), bool(causal), bool(interpret))
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,150 @@
+"""Pallas flash-attention kernel vs the XLA reference path.
+
+Runs the kernel in Pallas interpreter mode on CPU (the fake-backend strategy
+of SURVEY.md §4); the same code compiles with Mosaic on a real chip.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def ref_attention(q, k, v, causal=True):
+    """Plain einsum attention (the model's XLA path), f32."""
+    B, T, H, hd = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    scale = 1.0 / (hd ** 0.5)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    B, T, H, hd = 2, 128, 4, 64
+    q = _rand((B, T, H, hd), 0)
+    k = _rand((B, T, H, hd), 1)
+    v = _rand((B, T, H, hd), 2)
+    out = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_gqa():
+    B, T, H, KV, hd = 2, 64, 8, 2, 32
+    q = _rand((B, T, H, hd), 0)
+    k = _rand((B, T, KV, hd), 1)
+    v = _rand((B, T, KV, hd), 2)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_block_seq():
+    """T spans several kv blocks so the online-softmax rescaling is exercised."""
+    B, T, H, hd = 1, 512, 2, 64
+    q = _rand((B, T, H, hd), 3)
+    k = _rand((B, T, H, hd), 4)
+    v = _rand((B, T, H, hd), 5)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    B, T, H, hd = 1, 128, 2, 32
+    q = _rand((B, T, H, hd), 6)
+    k = _rand((B, T, H, hd), 7)
+    v = _rand((B, T, H, hd), 8)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(o))  # non-trivial cotangent
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(ref_attention(q, k, v, causal=causal)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-5, err_msg=name)
+
+
+def test_gradients_gqa():
+    B, T, H, KV, hd = 1, 64, 4, 2, 32
+    q = _rand((B, T, H, hd), 9)
+    k = _rand((B, T, KV, hd), 10)
+    v = _rand((B, T, KV, hd), 11)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-5, err_msg=name)
+
+
+def test_bf16_inputs():
+    B, T, H, hd = 1, 128, 2, 64
+    q = _rand((B, T, H, hd), 12, jnp.bfloat16)
+    k = _rand((B, T, H, hd), 13, jnp.bfloat16)
+    v = _rand((B, T, H, hd), 14, jnp.bfloat16)
+    out = fa.flash_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = ref_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_unsupported_shapes_raise():
+    q = jnp.zeros((1, 100, 3, 16))  # T=100 not tileable; H=3 not mult of KV=2
+    k = jnp.zeros((1, 100, 2, 16))
+    with pytest.raises(ValueError):
+        fa.flash_attention(q, k, jnp.zeros_like(k), interpret=True)
+
+
+def test_inside_jit_and_scan():
+    """Kernel must be traceable inside jit + scan (the model's usage)."""
+    B, T, H, hd = 1, 64, 2, 32
+    q = _rand((B, T, H, hd), 15)
+    k = _rand((B, T, H, hd), 16)
+    v = _rand((B, T, H, hd), 17)
+
+    @jax.jit
+    def f(q, k, v):
+        def body(carry, _):
+            o = fa.flash_attention(carry, k, v, interpret=True)
+            return o, None
+        out, _ = jax.lax.scan(body, q, None, length=2)
+        return out
+
+    out = f(q, k, v)
+    ref = ref_attention(ref_attention(q, k, v), k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
