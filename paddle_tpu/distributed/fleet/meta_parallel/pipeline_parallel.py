@@ -1,18 +1,17 @@
-"""PipelineParallel wrapper — microbatched train_batch.
+"""PipelineParallel wrapper — staged 1F1B/GPipe execution of PipelineLayer.
 
 Reference: fleet/meta_parallel/pipeline_parallel.py:255 —
 `train_batch` (:820) drives the 1F1B schedule (`forward_backward_pipeline`
 :575) with NCCL p2p sends between per-rank stage submodels.
 
-TPU-native: two execution tiers.
-- This wrapper (API parity tier): a host-driven microbatch loop — forward +
-  backward per microbatch with gradient accumulation, then one fused grad
-  sync. On a mesh, stage weights are pp-sharded by GSPMD and XLA pipelines
-  collectives with compute; there is no per-rank p2p to hand-schedule since
-  the controller sees global arrays (SURVEY.md §7 "hard parts" option (a)).
-- The performance tier is the fully-compiled 1F1B/GPipe rotation in
-  `distributed.hybrid.make_train_step` (ppermute inside scan — option (b));
-  `to_compiled_step()` hands a PipelineLayer model off to it.
+TPU-native: `PipelineEngine` (pp_schedule.py) consumes the SegmentLayers
+partition, commits each stage's weights to that stage's devices, and drives
+per-stage compiled executables in 1F1B (default) or GPipe order with
+device-to-device activation transfer — see pp_schedule.py for the design.
+With one stage (pp=1) the schedule degenerates to plain microbatch gradient
+accumulation, which is run directly. The fully-compiled whole-step engine
+(distributed.hybrid) remains the perf tier for homogeneous stacks
+(`to_compiled_step`).
 """
 from __future__ import annotations
 
@@ -29,20 +28,81 @@ class PipelineParallel(MetaParallelBase):
     """Reference: pipeline_parallel.py:255."""
 
     def _prepare_for_model(self):
-        self.micro_batch_size = int(
-            (self._strategy.pipeline_configs or {}).get("micro_batch_size", 1))
-        self.accumulate_steps = int(
-            (self._strategy.pipeline_configs or {}).get("accumulate_steps", 1))
+        cfgs = self._strategy.pipeline_configs or {}
+        self.micro_batch_size = int(cfgs.get("micro_batch_size", 1))
+        self.accumulate_steps = int(cfgs.get("accumulate_steps", 1))
+        self.schedule = str(cfgs.get("schedule_mode", "1F1B"))
         self.total_loss = None
         hcg = self._hcg
         self.num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
         self.stage_id = hcg.get_stage_id() if hcg else 0
+        self._engine = None
 
     def is_pipeline_first_stage(self) -> bool:
         return self.stage_id == 0
 
     def is_pipeline_last_stage(self) -> bool:
         return self.stage_id == self.num_stages - 1
+
+    # ------------------------------------------------------------------
+    def _stage_devices(self):
+        """Map pipeline stages to device groups. With an hcg topology, stage
+        s gets the devices of every rank whose 'pipe' coordinate is s (their
+        other axes form the stage's dp submesh); without one, an even split
+        of the local devices."""
+        import jax
+
+        if self._hcg is None:
+            return None  # engine default: even split of local devices
+        devs = jax.devices()
+        topo = self._hcg.topology()
+        if topo.world_size() > len(devs):
+            raise RuntimeError(
+                f"hybrid topology world size {topo.world_size()} exceeds the "
+                f"{len(devs)} available devices; shrink the parallel degrees")
+        groups = {s: [] for s in range(self.num_stages)}
+        for r in range(topo.world_size()):
+            coord = topo.get_coord(r)  # dict keyed by axis name
+            stage = coord.get("pp", coord.get("pipe", 0))
+            groups[stage].append(devs[r])
+        return [groups[s] for s in range(self.num_stages)]
+
+    def _get_engine(self):
+        if self._engine is None:
+            from .pp_schedule import PipelineEngine
+
+            if not isinstance(self._layers, PipelineLayer):
+                raise TypeError(
+                    "pipeline parallelism (pp>1) requires a PipelineLayer "
+                    f"model, got {type(self._layers).__name__}")
+            self._engine = PipelineEngine(
+                self._layers, self.accumulate_steps,
+                stage_devices=self._stage_devices(),
+                schedule=self.schedule)
+        return self._engine
+
+    # ------------------------------------------------------------------
+    def _accumulate_only(self, data, scaler=None):
+        """pp=1 degenerate schedule: microbatch loop with grad accumulation."""
+        inputs, labels = data
+        mb_inputs = self._split_micro(inputs)
+        mb_labels = self._split_micro(labels)
+        total = None
+        model = self._layers
+        loss_fn = getattr(model, "_loss_fn", None)
+        for x, y in zip(mb_inputs, mb_labels):
+            out = model(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            if hasattr(loss, "mean") and getattr(loss, "ndim", 0):
+                loss = loss.mean()
+            scaled = loss / self.accumulate_steps
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            d = loss.detach() if hasattr(loss, "detach") else loss
+            total = d if total is None else total + d
+        return total / self.accumulate_steps
 
     def _split_micro(self, data):
         if isinstance(data, (tuple, list)):
@@ -56,31 +116,22 @@ class PipelineParallel(MetaParallelBase):
         return [Tensor(arr[i * mb:(i + 1) * mb]) for i in range(n)]
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """Microbatch loop with grad accumulation (reference :575)."""
+        """1F1B/GPipe staged schedule over the pp device groups (reference
+        :575); grad accumulation only in the pp=1 degenerate case."""
         inputs, labels = data
-        mb_inputs = self._split_micro(inputs)
-        mb_labels = self._split_micro(labels)
-        total = None
-        model = self._layers
-        loss_fn = getattr(model, "_loss_fn", None)
-        for x, y in zip(mb_inputs, mb_labels):
-            out = model(x)
-            if loss_fn is not None:
-                loss = loss_fn(out, y)
-            else:
-                loss = out
-            if hasattr(loss, "mean") and getattr(loss, "ndim", 0):
-                loss = loss.mean()
-            scaled = loss.scale(1.0 / self.accumulate_steps) \
-                if hasattr(loss, "scale") else loss / self.accumulate_steps
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            d = loss.detach() if hasattr(loss, "detach") else loss
-            total = d if total is None else total + d
-        self.total_loss = total
-        return total / self.accumulate_steps
+        if self.num_stages <= 1:
+            loss = self._accumulate_only(data, scaler)
+            self.total_loss = loss
+            return loss
+        scale = 1.0
+        if (scaler is not None and hasattr(scaler, "_scale")
+                and getattr(scaler, "is_enable", lambda: True)()):
+            s = scaler._scale
+            scale = float(s.numpy()) if hasattr(s, "numpy") else float(s)
+        loss = self._get_engine().run(inputs, labels, train=True,
+                                      loss_scale=scale)
+        self.total_loss = loss
+        return loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Reference: pipeline_parallel.py:820."""

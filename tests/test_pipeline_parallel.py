@@ -1,0 +1,217 @@
+"""Generic pipeline parallelism: a non-LLaMA MLP PipelineLayer staged over
+pp=2/4 device groups must match single-device training bit-close.
+
+Reference test analog: test/collective/fleet pipeline parity runs
+(SURVEY.md §4 pattern C); schedules per pipeline_parallel.py:575 (1F1B) and
+F-then-B.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_schedule import (
+    PipelineEngine, _stage_op_sequence)
+
+
+D_IN, D_HID, D_OUT = 16, 32, 4
+
+
+def _descs():
+    return [
+        LayerDesc(nn.Linear, D_IN, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_OUT),
+    ]
+
+
+def _mse(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _seed_params(model):
+    rs = np.random.RandomState(0)
+    for p in model.parameters():
+        p.set_value(paddle.to_tensor(
+            rs.normal(scale=0.3, size=p.shape).astype(np.float32)))
+
+
+def _data(batch=8):
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.normal(size=(batch, D_IN)).astype(np.float32))
+    y = paddle.to_tensor(rs.normal(size=(batch, D_OUT)).astype(np.float32))
+    return x, y
+
+
+def _reference_run(steps=3):
+    """Single-device: full-batch loss, SGD step. For equal-size microbatches,
+    mean-loss full-batch grads ≡ accumulated 1/M-scaled microbatch grads, so
+    this is the parity target for ANY accumulate_steps."""
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=1)
+    _seed_params(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        loss = _mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses, [p.numpy().copy() for p in model.parameters()]
+
+
+@pytest.fixture
+def ref():
+    return _reference_run()
+
+
+@pytest.mark.parametrize("pp,schedule", [(2, "1F1B"), (4, "1F1B"),
+                                         (2, "gpipe")])
+def test_pipeline_parity_vs_single_device(ref, pp, schedule):
+    ref_losses, ref_params = ref
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "pp_degree": pp, "mp_degree": 1,
+    }
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=pp)
+    _seed_params(model)
+    pp_model = fleet.distributed_model(model)
+    assert isinstance(pp_model, PipelineParallel)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = _data()
+    losses = []
+    for _ in range(len(ref_losses)):
+        loss = pp_model.train_batch([x, y], opt)
+        losses.append(float(loss.numpy()))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for p, rp in zip(model.parameters(), ref_params):
+        np.testing.assert_allclose(p.numpy(), rp, rtol=1e-5, atol=1e-6)
+
+
+def test_stage_weights_live_on_stage_devices():
+    """pp partitioning is real: each stage's params are committed to that
+    stage's device group, not the default device."""
+    import jax
+
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+    devs = jax.devices()
+    engine = PipelineEngine(model, accumulate_steps=2,
+                            stage_devices=[[devs[0]], [devs[1]]])
+    s0 = set()
+    for p in engine.stages[0].params:
+        s0.update(d.id for d in p._data.sharding.device_set)
+    s1 = set()
+    for p in engine.stages[1].params:
+        s1.update(d.id for d in p._data.sharding.device_set)
+    assert s0 == {devs[0].id} and s1 == {devs[1].id}
+    # activations transferred between the groups during a run
+    x, y = _data()
+    loss = engine.run(x, y, train=True)
+    assert np.isfinite(float(np.asarray(loss._data)))
+
+
+def test_engine_direct_parity_single_device_stages():
+    """Engine with one device per stage (the pure-pp layout) matches the
+    reference losses."""
+    import jax
+
+    ref_losses, ref_params = _reference_run(steps=2)
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+    _seed_params(model)
+    devs = jax.devices()
+    engine = PipelineEngine(model, accumulate_steps=4,
+                            stage_devices=[[devs[0]], [devs[1]]])
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = _data()
+    losses = []
+    for _ in range(2):
+        loss = engine.run(x, y, train=True)
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for p, rp in zip(model.parameters(), ref_params):
+        np.testing.assert_allclose(p.numpy(), rp, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_schedule_structure():
+    """1F1B op order per stage: warmup fwds then strict alternation
+    (pipeline_parallel.py:575 semantics)."""
+    P_, M = 4, 8
+    for s in range(P_):
+        seq = _stage_op_sequence("1f1b", s, P_, M)
+        w = min(M, P_ - s - 1)
+        assert seq[:w] == [("F", m) for m in range(w)]
+        fs = [i for i, (k, _) in enumerate(seq) if k == "F"]
+        bs = [i for i, (k, _) in enumerate(seq) if k == "B"]
+        assert len(fs) == len(bs) == M
+        # in-flight microbatches never exceed warmup+1 (1F1B memory bound)
+        inflight = peak = 0
+        for k, _ in seq:
+            inflight += 1 if k == "F" else -1
+            peak = max(peak, inflight)
+        assert peak <= w + 1
+    # last stage alternates F B F B from the start
+    assert _stage_op_sequence("1f1b", P_ - 1, P_, 3) == [
+        ("F", 0), ("B", 0), ("F", 1), ("B", 1), ("F", 2), ("B", 2)]
+
+
+def test_gpipe_schedule_structure():
+    seq = _stage_op_sequence("gpipe", 0, 2, 3)
+    assert seq == [("F", 0), ("F", 1), ("F", 2),
+                   ("B", 0), ("B", 1), ("B", 2)]
+
+
+def test_disabled_scaler_does_not_scale_grads():
+    """GradScaler(enable=False) must be a pass-through: grads unscaled."""
+    import jax
+
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+    _seed_params(model)
+    ref = _reference_run(steps=1)[1]
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2, "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    pp_model = fleet.distributed_model(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(enable=False)
+    x, y = _data()
+    pp_model.train_batch([x, y], opt, scaler=scaler)
+    for p, rp in zip(model.parameters(), ref):
+        np.testing.assert_allclose(p.numpy(), rp, rtol=1e-5, atol=1e-6)
+
+
+def test_missing_loss_fn_raises():
+    model = PipelineLayer(layers=_descs(), loss_fn=None, num_stages=2)
+    with pytest.raises(ValueError, match="loss_fn"):
+        PipelineEngine(model, accumulate_steps=2)
+
+
+def test_non_pipelinelayer_raises():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    plain = nn.Sequential(nn.Linear(4, 4))
+    wrapped = fleet.distributed_model(plain)
+    if isinstance(wrapped, PipelineParallel):
+        with pytest.raises(TypeError, match="PipelineLayer"):
+            wrapped.train_batch(
+                [paddle.rand([4, 4]), paddle.rand([4, 4])],
+                paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=plain.parameters()))
