@@ -60,25 +60,60 @@ def pick_config():
     return cfg, B, T, M, steps, warmup
 
 
-def main():
+def build_and_warm(cfg, B, T, M, warmup, attn_impl, remat):
+    """Build + compile + warm the train step. Raises on any compile/run
+    failure so the caller can rebuild with a safer configuration."""
     from paddle_tpu.models import llama as L
     from paddle_tpu.distributed import hybrid as H
 
-    cfg, B, T, M, steps, warmup = pick_config()
     mesh = H.build_mesh(dp=1, pp=1, tp=1)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     sp = H.shard_params(params, mesh, cfg)
     opt = H.init_opt_state(sp)
     step = H.make_train_step(cfg, mesh, num_microbatches=M,
-                             hp=H.AdamWConfig(lr=1e-4))
+                             hp=H.AdamWConfig(lr=1e-4), attn_impl=attn_impl,
+                             remat=remat)
     k = jax.random.PRNGKey(1)
     tokens = jax.random.randint(k, (B, T), 0, cfg.vocab_size, jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
-
+    # The first warmup call below is the lowering smoke: it compiles (Mosaic
+    # included) before any timing starts, inside the caller's try/except.
+    # (An explicit step.lower().compile() would pay a second full compile —
+    # the AOT executable is not reused by the step() fastpath.)
+    loss = None
     for _ in range(warmup):
         sp, opt, loss = step(sp, opt, tokens, targets)
     float(loss)  # D2H forces completion (block_until_ready can return early
     # through the axon tunnel's async remote execution)
+    return step, sp, opt, tokens, targets
+
+
+def main():
+    cfg, B, T, M, steps, warmup = pick_config()
+    # A kernel bug must cost MFU, never the whole artifact (BENCH_r02 shipped
+    # rc=1 because a Mosaic lowering failure had no fallback): walk a ladder
+    # of configs from fastest to safest; any compile/run failure moves one
+    # rung down. Measured on the v5e-class chip: flash+dots-remat = 0.353 MFU,
+    # flash+full-remat = 0.291, xla attention = ~0.20.
+    ladder = [
+        ("auto", "dots", "on (dots remat)"),
+        ("auto", True, "on (full remat)"),
+        ("xla", True, "off (fallback)"),
+    ]
+    errors = []
+    step = None
+    for attn_impl, remat, label in ladder:
+        try:
+            step, sp, opt, tokens, targets = build_and_warm(
+                cfg, B, T, M, warmup, attn_impl=attn_impl, remat=remat)
+            flash = label
+            if errors:
+                flash += f" after {len(errors)} fallback(s): {errors[-1][:160]}"
+            break
+        except Exception as e:  # noqa: BLE001 — harness must degrade, not die
+            errors.append(f"{type(e).__name__}: {str(e)[:200]}")
+    if step is None:
+        raise RuntimeError("all bench configs failed: " + " | ".join(errors))
     t0 = time.perf_counter()
     for _ in range(steps):
         sp, opt, loss = step(sp, opt, tokens, targets)
@@ -116,9 +151,17 @@ def main():
         "vs_baseline": round(vs, 4),
         "details": {"platform": platform, "mfu": round(mfu, 4),
                     "step_time_s": round(dt / steps, 4), "loss": float(loss),
-                    "params": cfg.num_params(), "batch": B, "seq": T},
+                    "params": cfg.num_params(), "batch": B, "seq": T,
+                    "flash": flash},
     }))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit the JSON artifact
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "details": {"error": f"{type(e).__name__}: {str(e)[:500]}"},
+        }))

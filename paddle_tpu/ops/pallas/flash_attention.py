@@ -25,6 +25,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -34,7 +36,42 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
 except ImportError:  # pragma: no cover
     pltpu = None
 
-NEG_INF = -1e30
+# All scalar constants entering kernel bodies must be concrete np.float32:
+# under jax_enable_x64 a bare python float is a weak f64, and the resulting
+# f64->f32 convert inside the kernel fails Mosaic legalization (tpu.truncf).
+NEG_INF = np.float32(-1e30)
+
+
+def _i32(x):
+    """Index-map constants must match the int32 grid indices (a python int
+    promotes to int64 under jax_enable_x64, and jnp floor-divide's signed
+    decomposition does not lower through Mosaic — use lax.div on int32)."""
+    return np.int32(x)
+
+# lse/delta are carried as [B, H, T, LANES] with the value broadcast across a
+# small trailing lane dim. Mosaic requires the last two dims of every block to
+# be divisible by the (8, 128) native tile or EQUAL the array dims; a rank-3
+# [B, H, T] block (1, 1, bq) puts a size-1 second-minor dim against H and
+# fails lowering on real TPU (this killed BENCH_r02). With the trailing dim,
+# the block's last dim equals the array dim (legal for any LANES) and the
+# second-minor bq is 8-divisible. LANES=8 keeps the residual small (vs the
+# 128-lane variant of jax's reference kernel, 16x the HBM for the same math).
+LANES = 8
+
+
+def _assert_mosaic_tileable(block_shape, array_shape, what: str) -> None:
+    """Static mirror of Mosaic's block-mapping rule so CPU CI catches illegal
+    BlockSpecs without TPU hardware (interpret=True skips the real check)."""
+    if len(block_shape) < 2:
+        return
+    b2, b1 = block_shape[-2], block_shape[-1]
+    a2, a1 = array_shape[-2], array_shape[-1]
+    if not (b1 % 128 == 0 or b1 == a1) or not (b2 % 8 == 0 or b2 == a2):
+        raise ValueError(
+            f"flash attention {what}: block {tuple(block_shape)} vs array "
+            f"{tuple(array_shape)} violates Mosaic's (8, 128) tiling rule — "
+            "the last two block dims must be divisible by (8, 128) or equal "
+            "the array dims")
 
 
 def available() -> bool:
@@ -47,8 +84,15 @@ def available() -> bool:
         return False
 
 
+# Tunable caps, measured on a v5e-class chip (B=16 T=2048 H=12 hd=128,
+# fwd+bwd, interleaved steady-state): 512 -> 22.6ms, 1024 -> 24.7ms,
+# 256 -> 30.5ms. 512 amortizes the MXU well while p = exp(s) (512x512 f32,
+# 1MB) and the kv tiles stay comfortably inside VMEM.
+_BLOCK_CANDIDATES = (512, 256, 128, 64, 32, 16, 8)
+
+
 def _pick_block(n: int) -> Optional[int]:
-    for b in (256, 128, 64, 32, 16, 8):
+    for b in _BLOCK_CANDIDATES:
         if n % b == 0 and b <= n:
             return b
     return None
@@ -110,17 +154,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
     def _():
         l = l_sc[:, :1]
         o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_sc[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = jnp.broadcast_to(m_sc[:, :1] + jnp.log(l),
+                                         (block_q, LANES))
 
 
 def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
-    """q [B, H, T, hd]; k/v [B, KV, S, hd] → (o [B, H, T, hd], lse [B, H, T])."""
+    """q [B, H, T, hd]; k/v [B, KV, S, hd] →
+    (o [B, H, T, hd], lse [B, H, T, LANES] lane-broadcast)."""
     B, H, T, hd = q.shape
     KV, S = k.shape[1], k.shape[2]
     G = H // KV
     bq, bk = _pick_block(T), _pick_block(S)
     grid = (B, H, T // bq, S // bk)
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, sm_scale=np.float32(sm_scale), causal=causal,
                                block_q=bq, block_k=bk)
     mem = {"memory_space": pltpu.VMEM}
     scratch = [
@@ -128,22 +174,29 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
         pltpu.VMEM((bq, 128), jnp.float32),
         pltpu.VMEM((bq, 128), jnp.float32),
     ]
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
+        jax.ShapeDtypeStruct((B, H, T, LANES), jnp.float32),
+    ]
+    for spec, arr in zip(in_specs, [q, k, v]):
+        _assert_mosaic_tileable(spec.block_shape, arr.shape, "fwd input")
+    for spec, sds in zip(out_specs, out_shape):
+        _assert_mosaic_tileable(spec.block_shape, sds.shape, "fwd output")
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
@@ -171,8 +224,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                  # [Bq, 1]
-        delta = delta_ref[0, 0][:, None]              # [Bq, 1]
+        lse = lse_ref[0, 0][:, :1]                    # [Bq, 1] (lanes equal)
+        delta = delta_ref[0, 0][:, :1]                # [Bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -216,8 +269,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)           # [Bk, hd]
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
@@ -241,57 +294,69 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(sm_scale, causal, interpret, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse = res                             # lse [B, H, T, LANES]
     B, H, T, hd = q.shape
     KV, S = k.shape[1], k.shape[2]
     G = H // KV
     bq, bk = _pick_block(T), _pick_block(S)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B, H, T, LANES))
     mem = {"memory_space": pltpu.VMEM}
 
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
+    ]
+    dq_out_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)),
+                               **mem)
+    for spec, arr in zip(dq_in_specs, [q, k, v, do, lse, delta]):
+        _assert_mosaic_tileable(spec.block_shape, arr.shape, "dq input")
+    _assert_mosaic_tileable(dq_out_spec.block_shape, q.shape, "dq output")
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_dq_kernel, sm_scale=np.float32(sm_scale), causal=causal,
                           block_q=bq, block_k=bk),
         grid=(B, H, T // bq, S // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, h // G, j, 0), **mem),
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0), **mem),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, 0),
-                               **mem),
+        in_specs=dq_in_specs,
+        out_specs=dq_out_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, hd),
+                     lambda b, kv, jk, g, iq: (b, kv * G + g, iq, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, hd),
+                     lambda b, kv, jk, g, iq: (b, kv * G + g, iq, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, LANES),
+                     lambda b, kv, jk, g, iq: (b, kv * G + g, iq, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bq, LANES),
+                     lambda b, kv, jk, g, iq: (b, kv * G + g, iq, _i32(0)), **mem),
+    ]
+    dkv_out_specs = [
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
+    ]
+    for spec, arr in zip(dkv_in_specs, [q, k, v, do, lse, delta]):
+        _assert_mosaic_tileable(spec.block_shape, arr.shape, "dkv input")
+    for spec in dkv_out_specs:
+        _assert_mosaic_tileable(spec.block_shape, k.shape, "dkv output")
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_dkv_kernel, sm_scale=np.float32(sm_scale), causal=causal,
                           block_q=bq, block_k=bk, group=G),
         grid=(B, KV, S // bk, G, T // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd),
-                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
-            pl.BlockSpec((1, 1, bq, hd),
-                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq, 0), **mem),
-            pl.BlockSpec((1, 1, bq),
-                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq)),
-            pl.BlockSpec((1, 1, bq),
-                         lambda b, kv, jk, g, iq: (b, kv * G + g, iq)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, kv, jk, g, iq: (b, kv, jk, 0), **mem),
-        ],
+        in_specs=dkv_in_specs,
+        out_specs=dkv_out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((B, KV, S, hd), k.dtype),
             jax.ShapeDtypeStruct((B, KV, S, hd), v.dtype),

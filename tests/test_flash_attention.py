@@ -1,7 +1,12 @@
 """Pallas flash-attention kernel vs the XLA reference path.
 
 Runs the kernel in Pallas interpreter mode on CPU (the fake-backend strategy
-of SURVEY.md §4); the same code compiles with Mosaic on a real chip.
+of SURVEY.md §4). Interpret mode skips Mosaic's block-mapping validation
+(which is what let the round-2 lse BlockSpec bug reach the chip), so the
+kernel mirrors that rule statically (`fa._assert_mosaic_tileable`, exercised
+at every trace) and `test_mosaic_tiling_rule*` below pins the regression.
+The kernel was verified end-to-end (lower+compile+run, fwd+bwd, GQA) on a
+real TPU v5e chip on 2026-07-29; bench.py re-checks lowering every run.
 """
 import functools
 
@@ -148,3 +153,24 @@ def test_inside_jit_and_scan():
     ref = ref_attention(ref_attention(q, k, v), k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_mosaic_tiling_rule_rejects_rank3_lse():
+    # The exact BENCH_r02 failure: lse [B, H, T] with block (1, 1, bq) puts a
+    # size-1 second-minor dim against H != 1. Must be rejected statically.
+    with pytest.raises(ValueError, match="8, 128"):
+        fa._assert_mosaic_tileable((1, 1, 256), (4, 12, 2048), "lse")
+
+
+def test_mosaic_tiling_rule_accepts_current_layouts():
+    # o block: last dim == array dim; second-minor divisible by 8
+    fa._assert_mosaic_tileable((1, 1, 256, 128), (4, 12, 2048, 128), "o")
+    # lse lane-broadcast block: last dim == array dim (LANES)
+    fa._assert_mosaic_tileable((1, 1, 256, fa.LANES), (4, 12, 2048, fa.LANES),
+                               "lse")
+
+
+def test_kernel_constants_are_f32():
+    # Under jax_enable_x64 a bare python float is weak f64 and the resulting
+    # f64->f32 convert fails Mosaic legalization (tpu.truncf). Pin the dtype.
+    assert np.asarray(fa.NEG_INF).dtype == np.float32
