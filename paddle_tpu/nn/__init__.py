@@ -72,6 +72,25 @@ from .layer.norm import (  # noqa: F401
     RMSNorm,
     SyncBatchNorm,
 )
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .layer.rnn import (  # noqa: F401
+    RNN,
+    BiRNN,
+    GRU,
+    GRUCell,
+    LSTM,
+    LSTMCell,
+    RNNCellBase,
+    SimpleRNN,
+    SimpleRNNCell,
+)
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool2D,
     AdaptiveMaxPool2D,

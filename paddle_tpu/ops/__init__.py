@@ -27,5 +27,6 @@ from .kernels import (  # noqa: F401
     nn_ops,
     random,
     reduce,
+    rnn_ops,
     search,
 )

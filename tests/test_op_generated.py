@@ -525,8 +525,11 @@ add_specs({
 
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
-    # statistical-output ops whose result shape/order is data-dependent under
-    # jit or whose semantics are exercised in dedicated suites
+    # pytree-structured inputs (flat weight list + optional masks) don't fit
+    # the generic single-array harness; numerics are covered by the dedicated
+    # suite tests/test_rnn.py (torch cross-checks incl. bidirectional/
+    # multi-layer/seq_lens, fused-vs-cell-loop parity, finite-difference grad)
+    "rnn": "dedicated suite tests/test_rnn.py",
 }
 
 

@@ -1,0 +1,215 @@
+"""RNN layer family: torch cross-checks + fused-vs-loop parity + training.
+
+The fused `rnn` op (ops/kernels/rnn_ops.py) is the XLA analog of the
+reference's cudnn kernel (`python/paddle/nn/layer/rnn.py:1730`); torch's
+cudnn-compatible CPU implementation shares the same math and weight layout,
+so torch.nn.LSTM/GRU/RNN are independent references here (the reference
+repo's own tests cross-check against numpy implementations of the same
+equations)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _copy_params(src_paddle, dst_torch):
+    for name, tp in dst_torch.named_parameters():
+        arr = getattr(src_paddle, name).numpy().astype(np.float32).copy()
+        assert arr.shape == tuple(tp.shape), (name, arr.shape, tuple(tp.shape))
+        tp.data = torch.from_numpy(arr)
+
+
+@pytest.mark.parametrize("mode,kwargs,torch_cls,torch_kwargs", [
+    ("LSTM", {}, torch.nn.LSTM, {}),
+    ("GRU", {}, torch.nn.GRU, {}),
+    ("SimpleRNN", {"activation": "tanh"}, torch.nn.RNN,
+     {"nonlinearity": "tanh"}),
+    ("SimpleRNN", {"activation": "relu"}, torch.nn.RNN,
+     {"nonlinearity": "relu"}),
+])
+@pytest.mark.parametrize("layers,direction", [
+    (1, "forward"), (2, "forward"), (2, "bidirectional"),
+])
+def test_parity_vs_torch(mode, kwargs, torch_cls, torch_kwargs, layers,
+                         direction):
+    B, T, In, H = 3, 7, 5, 6
+    cls = getattr(paddle.nn, mode)
+    pl = cls(In, H, num_layers=layers, direction=direction, **kwargs)
+    tl = torch_cls(In, H, num_layers=layers,
+                   bidirectional=(direction == "bidirectional"),
+                   batch_first=True, **torch_kwargs)
+    _copy_params(pl, tl)
+    x = np.random.RandomState(0).randn(B, T, In).astype(np.float32)
+    po, pstate = pl(paddle.to_tensor(x))
+    to, tstate = tl(torch.from_numpy(x))
+    np.testing.assert_allclose(po.numpy(), to.detach().numpy(),
+                               rtol=2e-5, atol=2e-5)
+    if mode == "LSTM":
+        np.testing.assert_allclose(pstate[0].numpy(),
+                                   tstate[0].detach().numpy(),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(pstate[1].numpy(),
+                                   tstate[1].detach().numpy(),
+                                   rtol=2e-5, atol=2e-5)
+    else:
+        np.testing.assert_allclose(pstate.numpy(), tstate.detach().numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_sequence_length_matches_torch_packed():
+    B, T, In, H = 3, 7, 5, 6
+    pl = paddle.nn.LSTM(In, H, num_layers=2, direction="bidirectional")
+    tl = torch.nn.LSTM(In, H, num_layers=2, bidirectional=True,
+                       batch_first=True)
+    _copy_params(pl, tl)
+    x = np.random.RandomState(1).randn(B, T, In).astype(np.float32)
+    seq = np.array([7, 3, 5])
+    _, (ph, pc) = pl(paddle.to_tensor(x), sequence_length=seq)
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x), torch.from_numpy(seq), batch_first=True,
+        enforce_sorted=False)
+    _, (th, tc) = tl(packed)
+    np.testing.assert_allclose(ph.numpy(), th.detach().numpy(),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(pc.numpy(), tc.detach().numpy(),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_matches_cell_loop():
+    """The fused scan path must equal the generic RNN(cell) python loop."""
+    B, T, In, H = 2, 5, 4, 3
+    for make_cell, make_fused, mode in [
+        (lambda: paddle.nn.LSTMCell(In, H), lambda: paddle.nn.LSTM(In, H),
+         "LSTM"),
+        (lambda: paddle.nn.GRUCell(In, H), lambda: paddle.nn.GRU(In, H),
+         "GRU"),
+        (lambda: paddle.nn.SimpleRNNCell(In, H),
+         lambda: paddle.nn.SimpleRNN(In, H), "RNN"),
+    ]:
+        cell = make_cell()
+        fused = make_fused()
+        fused.weight_ih_l0 = paddle.to_tensor(cell.weight_ih.numpy())
+        fused.weight_hh_l0 = paddle.to_tensor(cell.weight_hh.numpy())
+        fused.bias_ih_l0 = paddle.to_tensor(cell.bias_ih.numpy())
+        fused.bias_hh_l0 = paddle.to_tensor(cell.bias_hh.numpy())
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(B, T, In).astype(np.float32))
+        o1, _ = paddle.nn.RNN(cell)(x)
+        o2, _ = fused(x)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=mode)
+
+
+def test_birnn_wrapper():
+    B, T, In, H = 2, 4, 3, 5
+    bi = paddle.nn.BiRNN(paddle.nn.GRUCell(In, H), paddle.nn.GRUCell(In, H))
+    out, (sf, sb) = bi(paddle.to_tensor(
+        np.random.randn(B, T, In).astype(np.float32)))
+    assert list(out.shape) == [B, T, 2 * H]
+
+
+def test_time_major():
+    B, T, In, H = 2, 5, 4, 3
+    pl = paddle.nn.GRU(In, H, time_major=True)
+    x = np.random.RandomState(3).randn(T, B, In).astype(np.float32)
+    out_tm, _ = pl(paddle.to_tensor(x))
+    pl.time_major = False
+    out_bm, _ = pl(paddle.to_tensor(np.swapaxes(x, 0, 1)))
+    np.testing.assert_allclose(out_tm.numpy(),
+                               np.swapaxes(out_bm.numpy(), 0, 1),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_cell_proj_size():
+    cell = paddle.nn.LSTMCell(4, 8, proj_size=3)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    h, (h2, c) = cell(x)
+    assert list(h.shape) == [2, 3] and list(c.shape) == [2, 8]
+
+
+def test_rnn_gradients_numeric():
+    """Finite-difference check through the fused scan op."""
+    B, T, In, H = 2, 3, 3, 4
+    pl = paddle.nn.LSTM(In, H)
+    x0 = np.random.RandomState(4).randn(B, T, In).astype(np.float64)
+
+    def f(xnp):
+        out, _ = pl(paddle.to_tensor(xnp.astype(np.float32)))
+        return float(out.numpy().sum())
+
+    x = paddle.to_tensor(x0.astype(np.float32), stop_gradient=False)
+    out, _ = pl(x)
+    out.sum().backward()
+    g = x.grad.numpy()
+    eps = 1e-3
+    rs = np.random.RandomState(5)
+    for _ in range(5):
+        i = tuple(rs.randint(0, s) for s in x0.shape)
+        d = np.zeros_like(x0)
+        d[i] = eps
+        num = (f(x0 + d) - f(x0 - d)) / (2 * eps)
+        assert abs(num - g[i]) < 5e-2 * max(1.0, abs(num)), (i, num, g[i])
+
+
+def test_train_seq2seq_gru_converges():
+    """Tiny copy-task seq2seq: GRU encoder + GRU decoder + Linear."""
+    rs = np.random.RandomState(0)
+    V, B, T, H = 12, 8, 6, 32
+    emb = paddle.nn.Embedding(V, H)
+    enc = paddle.nn.GRU(H, H)
+    dec = paddle.nn.GRU(H, H)
+    head = paddle.nn.Linear(H, V)
+    params = (list(emb.parameters()) + list(enc.parameters())
+              + list(dec.parameters()) + list(head.parameters()))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+    tokens = rs.randint(1, V, (B, T))
+    losses = []
+    for step in range(30):
+        x = emb(paddle.to_tensor(tokens))
+        _, hT = enc(x)
+        dec_out, _ = dec(x, hT)
+        logits = head(dec_out)
+        loss = paddle.nn.functional.cross_entropy(
+            logits.reshape([-1, V]), paddle.to_tensor(tokens.reshape(-1)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_gru_single_bias_matches_cell_loop():
+    """Regression: with bias_ih_attr=False the remaining b_hh must stay in
+    the reset-gated slot (GRU applies b_hh inside r * (...), b_ih outside) —
+    a flat weight list once shifted b_hh into the b_ih position."""
+    B, T, In, H = 2, 6, 4, 5
+    for kw in ({"bias_ih_attr": False}, {"bias_hh_attr": False}):
+        cell = paddle.nn.GRUCell(In, H, **kw)
+        fused = paddle.nn.GRU(In, H, **kw)
+        fused.weight_ih_l0 = paddle.to_tensor(cell.weight_ih.numpy())
+        fused.weight_hh_l0 = paddle.to_tensor(cell.weight_hh.numpy())
+        if cell.bias_ih is not None:
+            fused.bias_ih_l0 = paddle.to_tensor(cell.bias_ih.numpy())
+        if cell.bias_hh is not None:
+            fused.bias_hh_l0 = paddle.to_tensor(cell.bias_hh.numpy())
+        x = paddle.to_tensor(
+            np.random.RandomState(7).randn(B, T, In).astype(np.float32))
+        o1, _ = paddle.nn.RNN(cell)(x)
+        o2, _ = fused(x)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=str(kw))
+
+
+def test_rnn_dropout_governed_by_seed():
+    lstm = paddle.nn.LSTM(4, 5, num_layers=2, dropout=0.5)
+    x = paddle.to_tensor(np.random.RandomState(8).randn(2, 6, 4)
+                         .astype(np.float32))
+    paddle.seed(42)
+    o1, _ = lstm(x)
+    paddle.seed(42)
+    o2, _ = lstm(x)
+    np.testing.assert_allclose(o1.numpy(), o2.numpy())
+    # and the mask must actually vary when the generator advances
+    o3, _ = lstm(x)
+    assert not np.allclose(o2.numpy(), o3.numpy())
