@@ -51,6 +51,7 @@ class GradNode:
         "edges",
         "out_grads",
         "out_hooks",
+        "saved_for_double",
     )
 
     def __init__(self, name, vjp_fn, out_avals, out_treedef, edges):
@@ -62,6 +63,9 @@ class GradNode:
         self.edges = edges  # per tensor-input: ("node", node, idx) | ("leaf", tensor) | None
         self.out_grads: List[Optional[Any]] = [None] * len(out_avals)
         self.out_hooks: Dict[int, list] = {}
+        # (pure_fn, input tensors) for create_graph re-dispatch; None for
+        # nodes without a re-derivable kernel (e.g. PyLayer)
+        self.saved_for_double = None
 
     def accumulate(self, idx: int, grad):
         if grad is None or _is_float0(grad):
@@ -71,6 +75,7 @@ class GradNode:
 
     def free(self):
         self.vjp_fn = None
+        self.saved_for_double = None
         self.out_grads = [None] * len(self.out_avals)
 
     def __repr__(self):
@@ -225,6 +230,160 @@ def _wrap_bare(g):
     return Tensor._from_data(g, stop_gradient=True)
 
 
+def _run_backward_tensor_mode(tensors, grad_tensors, capture):
+    """create_graph traversal: gradients flow as TENSORS and every node's
+    backward runs as a dispatched op (call_op) over (cotangents, primals), so
+    the grad computation itself records GradNodes — grad-of-grad composes.
+
+    The array-mode fast path (run_backward) calls the saved vjp closure,
+    which treats primals as constants; that is wrong for double grad (for
+    y = x**2 the first grad 2*x*cot depends on x). Re-deriving jax.vjp inside
+    the dispatched grad kernel recomputes the op's forward (checkpoint-style)
+    with primals as live inputs. Reference analog:
+    `paddle/fluid/eager/general_grad.h:1` + generated double-grad nodes.
+    """
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops import dispatch
+
+    grad_tensors = grad_tensors or [None] * len(tensors)
+
+    def as_tensor(g):
+        if g is None:
+            return None
+        if isinstance(g, Tensor):
+            return g
+        return Tensor._from_data(g, stop_gradient=True)
+
+    def leaf_acc(tensor, g):
+        if g is None:
+            return
+        for hook in tensor._backward_hooks:
+            res = hook(g)
+            if res is not None:
+                g = res if isinstance(res, Tensor) else as_tensor(res)
+        if id(tensor) in capture["leaf"]:
+            slot = capture["leaf"][id(tensor)]
+            cur = capture["got"][slot]
+            capture["got"][slot] = g if cur is None else cur + g
+        if capture.get("only_inputs", True):
+            return
+        if not tensor.stop_gradient:
+            cur = tensor._grad
+            garr = g._data if isinstance(g, Tensor) else g
+            tensor._grad = garr if cur is None else cur + garr
+
+    # seed
+    roots: List[GradNode] = []
+    seeded = set()
+    for t, g in zip(tensors, grad_tensors):
+        gt = as_tensor(g)
+        if gt is None:
+            if t._data.size != 1 or not is_inexact_dtype(t._data.dtype):
+                raise RuntimeError(
+                    "grad can be implicitly created only for floating-point "
+                    f"scalar outputs; got {t.shape} {t._data.dtype}")
+            gt = Tensor._from_data(jnp.ones(t._data.shape, t._data.dtype),
+                                   stop_gradient=True)
+        node = t._grad_node
+        if node is None:
+            leaf_acc(t, gt)
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time after it "
+                "was freed. Specify retain_graph=True on the first backward.")
+        node.accumulate(t._out_index, gt)
+        if id(node) not in seeded:
+            seeded.add(id(node))
+            roots.append(node)
+
+    # topology (same as run_backward)
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    for n in roots:
+        indeg.setdefault(id(n), 0)
+        nodes[id(n)] = n
+    while stack:
+        n = stack.pop()
+        for e in n.edges:
+            if e is not None and e[0] == "node":
+                tgt = e[1]
+                indeg[id(tgt)] = indeg.get(id(tgt), 0) + 1
+                if id(tgt) not in nodes:
+                    nodes[id(tgt)] = tgt
+                    stack.append(tgt)
+
+    ready = [n for n in nodes.values() if indeg[id(n)] == 0]
+    processed: List[GradNode] = []
+    while ready:
+        node = ready.pop()
+        processed.append(node)
+        # output hooks (parity with run_backward): fire on Tensor grads
+        for idx, hooks in node.out_hooks.items():
+            g = node.out_grads[idx]
+            if g is None:
+                g = Tensor._from_data(_zeros_like_aval(node.out_avals[idx]),
+                                      stop_gradient=True)
+            for hook in hooks:
+                res = hook(g)
+                if res is not None:
+                    g = res if isinstance(res, Tensor) else as_tensor(res)
+            node.out_grads[idx] = g
+        if capture is not None:
+            for idx in range(len(node.out_avals)):
+                key = (node.id, idx)
+                if key in capture["node"]:
+                    slot = capture["node"][key]
+                    g = node.out_grads[idx]
+                    if g is not None:
+                        cur = capture["got"][slot]
+                        capture["got"][slot] = g if cur is None else cur + g
+        cots = [
+            g if g is not None
+            else Tensor._from_data(_zeros_like_aval(av), stop_gradient=True)
+            for g, av in zip(node.out_grads, node.out_avals)
+        ]
+        cot_tree = jax.tree.unflatten(node.out_treedef, cots)
+        if node.saved_for_double is not None:
+            pure, in_ts = node.saved_for_double
+
+            def grad_kernel(cot, *primals, _pure=pure):
+                _, vjp_fn = jax.vjp(_pure, *primals)
+                return vjp_fn(cot)
+
+            in_grads = dispatch.call_op(
+                node.name + "_grad", grad_kernel,
+                (cot_tree,) + tuple(in_ts), {})
+        else:
+            # no re-derivable kernel (PyLayer etc.): constants w.r.t. primals
+            raw = node.vjp_fn(jax.tree.map(
+                lambda t: t._data, cot_tree,
+                is_leaf=lambda x: isinstance(x, Tensor)))
+            in_grads = tuple(as_tensor(g) for g in raw)
+        node.out_grads = [None] * len(node.out_avals)
+        for e, g in zip(node.edges, in_grads):
+            if g is not None and isinstance(g, Tensor) and _is_float0(g._data):
+                g = None
+            if e is None:
+                continue
+            if e[0] == "node":
+                # decrement UNCONDITIONALLY (a None grad still satisfies the
+                # dependency — run_backward does the same); only accumulate
+                # when there is a value
+                _, tgt, idx = e
+                if g is not None:
+                    tgt.accumulate(idx, g)
+                indeg[id(tgt)] -= 1
+                if indeg[id(tgt)] == 0:
+                    ready.append(tgt)
+            elif g is not None:
+                leaf_acc(e[1], g)
+    return processed
+
+
 def grad(
     outputs,
     inputs,
@@ -238,11 +397,6 @@ def grad(
     """``paddle.grad`` parity (reference: general_grad.h / api in eager)."""
     from ..core.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order via the tape) is not supported yet; "
-            "use paddle.incubate.autograd functional jacobian/hessian"
-        )
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if grad_outputs is not None and isinstance(grad_outputs, Tensor):
@@ -254,7 +408,27 @@ def grad(
         else:
             capture["leaf"][id(t)] = slot
     if retain_graph is None:
-        retain_graph = False
+        # paddle semantics: retain_graph defaults to create_graph
+        retain_graph = bool(create_graph)
+    if create_graph:
+        processed = _run_backward_tensor_mode(outputs, grad_outputs, capture)
+        if not retain_graph:
+            # explicit retain_graph=False with create_graph: free the
+            # traversed first-order nodes (the returned grads carry their own
+            # newly recorded graph; further grad-of-grad through the ORIGINAL
+            # graph then raises the freed-graph error, torch-compatible)
+            for n in processed:
+                n.free()
+        results = []
+        for slot, t in enumerate(inputs):
+            g = capture["got"][slot]
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    f"The {slot}-th input has no gradient path to outputs; "
+                    "set allow_unused=True to return None for it"
+                )
+            results.append(g)
+        return results
     run_backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
     results = []
     for slot, t in enumerate(inputs):

@@ -185,6 +185,12 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
             out_treedef,
             edges,
         )
+        # Higher-order support (reference: general_grad.h): keep the pure
+        # kernel + input tensors so a create_graph backward can re-derive the
+        # vjp as a DISPATCHED op with both cotangents and primals tracked —
+        # the plain vjp closure treats primals as constants, which would drop
+        # the d(grad)/d(primal) terms of the double grad.
+        node.saved_for_double = (pure, tuple(in_tensors))
         out_tensors = [_wrap_out(o, node, i) for i, o in enumerate(out_leaves)]
         result = jax.tree.unflatten(out_treedef, out_tensors)
     else:

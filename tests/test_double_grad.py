@@ -1,0 +1,131 @@
+"""Higher-order autograd: paddle.grad(..., create_graph=True).
+
+Reference analog: double-grad support in the eager engine
+(`paddle/fluid/eager/general_grad.h:1`, tests in `test/autograd/`). The
+TPU design re-dispatches each node's vjp as an op over (cotangents, primals)
+so the grad computation itself records on the tape (autograd/engine.py
+`_run_backward_tensor_mode`).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+def test_double_and_triple_grad_polynomial():
+    x = _t([1.0, 2.0, 3.0])
+    y = (x * x * x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([1, 4, 9], np.float32),
+                               rtol=1e-5)
+    (g2,) = paddle.grad(g1.sum(), x, create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([1, 2, 3], np.float32),
+                               rtol=1e-5)
+    (g3,) = paddle.grad(g2.sum(), x)
+    np.testing.assert_allclose(g3.numpy(), np.full(3, 6, np.float32),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("fn,d2", [
+    (lambda x: paddle.tanh(x),
+     lambda v: -2 * np.tanh(v) * (1 - np.tanh(v) ** 2)),
+    (lambda x: paddle.nn.functional.sigmoid(x),
+     lambda v: (lambda s: s * (1 - s) * (1 - 2 * s))(1 / (1 + np.exp(-v)))),
+    (lambda x: paddle.exp(x), lambda v: np.exp(v)),
+    (lambda x: paddle.log(x), lambda v: -1.0 / v ** 2),
+])
+def test_double_grad_unary(fn, d2):
+    v = np.array([0.3, 0.9, 1.4], np.float32)
+    x = _t(v)
+    y = fn(x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), d2(v), rtol=1e-4, atol=1e-5)
+
+
+def test_double_grad_multiply_cross_terms():
+    # y = sum(a * b): d/da = b, then d(sum(b))/db = ones
+    a = _t([1.0, 2.0])
+    b = _t([3.0, 4.0])
+    y = (a * b).sum()
+    (ga,) = paddle.grad(y, a, create_graph=True)
+    (gb,) = paddle.grad(ga.sum(), b)
+    np.testing.assert_allclose(gb.numpy(), np.ones(2, np.float32))
+
+
+def test_double_grad_matmul_cross():
+    rs = np.random.RandomState(0)
+    x = _t(rs.randn(2, 3))
+    w = _t(rs.randn(3, 4))
+    z = paddle.matmul(x, w).sum()
+    (gx,) = paddle.grad(z, x, create_graph=True)
+    (gw,) = paddle.grad(gx.sum(), w)
+    # gx[i, k] = sum_j w[k, j]  =>  d(sum gx)/dw = batch * ones
+    np.testing.assert_allclose(gw.numpy(), 2 * np.ones((3, 4), np.float32),
+                               rtol=1e-5)
+
+
+def test_double_grad_numeric_hessian_diag():
+    """Finite-difference validation of the full second derivative for a
+    composite expression y = sum(tanh(x)^2 * x)."""
+    v = np.array([0.2, -0.5, 0.8], np.float64)
+
+    def first_grad_np(vv):
+        x = _t(vv)
+        y = (paddle.tanh(x) * paddle.tanh(x) * x).sum()
+        (g,) = paddle.grad(y, x)
+        return g.numpy().astype(np.float64)
+
+    x = _t(v)
+    y = (paddle.tanh(x) * paddle.tanh(x) * x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), x)
+    eps = 1e-3
+    for i in range(3):
+        d = np.zeros(3)
+        d[i] = eps
+        num = (first_grad_np(v + d).sum() - first_grad_np(v - d).sum()) / (2 * eps)
+        assert abs(num - g2.numpy()[i]) < 1e-2, (i, num, g2.numpy()[i])
+
+
+def test_gradient_penalty_training_step():
+    """The canonical create_graph use: a loss containing a gradient norm
+    (WGAN-GP style) optimized end-to-end."""
+    rs = np.random.RandomState(0)
+    net = paddle.nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = _t(rs.randn(8, 3))
+    losses = []
+    for _ in range(25):
+        out = net(x).sum()
+        (gx,) = paddle.grad(out, x, create_graph=True)
+        penalty = ((gx * gx).mean() - 1.0) ** 2
+        penalty.backward()
+        assert net.weight.grad is not None  # second order reached the params
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(penalty.numpy()))
+    assert losses[-1] < losses[0] * 0.2, losses
+
+
+def test_create_graph_false_returns_detached():
+    x = _t([2.0])
+    y = (x * x).sum()
+    (g,) = paddle.grad(y, x)
+    assert g.stop_gradient
+    with pytest.raises(RuntimeError):
+        paddle.grad(g.sum(), x)
+
+
+def test_allow_unused_with_create_graph():
+    x = _t([1.0])
+    z = _t([1.0])
+    y = (x * x).sum()
+    gx, gz = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
