@@ -56,6 +56,15 @@ from .core.place import (  # noqa: F401
 )
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: paddle.DataParallel without importing distributed at package load
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+
+        return DataParallel
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
 from .ops.dispatch import (  # noqa: F401
     enable_grad,

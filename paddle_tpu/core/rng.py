@@ -16,14 +16,27 @@ import numpy as np
 
 
 class Generator:
+    """Lazy key materialization: creating a jax PRNG key initializes the XLA
+    backend, and that must NOT happen at `import paddle_tpu` time — a worker
+    has to be able to call jax.distributed.initialize() (multi-process
+    bootstrap) after importing the framework."""
+
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key = None
         self._lock = threading.Lock()
 
+    @property
+    def _key_live(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
     def manual_seed(self, seed: int):
+        # stays lazy: paddle.seed() before init_parallel_env() must not
+        # materialize the backend (it would block jax.distributed.initialize)
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         return self
 
     def initial_seed(self):
@@ -31,12 +44,12 @@ class Generator:
 
     def split(self, n: int = 1):
         with self._lock:
-            keys = jax.random.split(self._key, n + 1)
+            keys = jax.random.split(self._key_live, n + 1)
             self._key = keys[0]
             return keys[1] if n == 1 else keys[1:]
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        return jax.random.key_data(self._key_live)
 
     def set_state(self, state):
         self._key = jax.random.wrap_key_data(np.asarray(state))

@@ -35,6 +35,11 @@ from .collective import (  # noqa: F401
     scatter,
     send,
 )
+from .parallel import (  # noqa: F401
+    DataParallel,
+    sync_param_grads,
+    sync_params_buffers,
+)
 from .store import TCPStore  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401

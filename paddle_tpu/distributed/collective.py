@@ -136,18 +136,85 @@ def is_initialized() -> bool:
     return _initialized[0]
 
 
+def _maybe_init_jax_distributed(world: int) -> None:
+    """Bootstrap the PJRT coordination service (the TPU-native analog of the
+    reference's TCPStore+NCCL rendezvous, SURVEY.md §7) from the env the
+    launcher sets (launch/main.py: JAX_COORDINATOR_ADDRESS/_NUM_PROCESSES/
+    _PROCESS_ID). Must run before the first backend use in the worker."""
+    import os
+
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if world <= 1 or not coord:
+        return
+    try:
+        # probe WITHOUT touching the backend: jax.process_count() would
+        # materialize a single-process backend and make initialize() a no-op
+        from jax._src import distributed as _jd
+
+        if getattr(_jd.global_state, "client", None) is not None:
+            return  # already initialized
+    except ImportError:
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", world)),
+            process_id=int(os.environ.get("JAX_PROCESS_ID",
+                                          os.environ.get("PADDLE_TRAINER_ID",
+                                                         "0"))))
+    except RuntimeError as e:
+        if "before any JAX" in str(e) or "already initialized" in str(e):
+            import sys
+
+            print("[paddle_tpu] WARNING: multi-process env is set but the "
+                  "XLA backend was already initialized — staying "
+                  "single-process. Call init_parallel_env() before any "
+                  "jax/tensor work.", file=sys.stderr)
+        else:
+            raise  # unreachable coordinator etc. must not silently degrade
+
+
+def _store_client():
+    """Lazy per-process TCPStore client (PADDLE_MASTER from the launcher);
+    used for cross-process eager p2p and store barriers."""
+    import os
+
+    if _store[0] is None and os.environ.get("PADDLE_MASTER"):
+        from .store import TCPStore
+
+        host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+        _store[0] = TCPStore(host, int(port), is_master=False,
+                             world_size=get_world_size())
+    return _store[0]
+
+
+_store: list = [None]
+
+
 def init_parallel_env() -> Optional[Group]:
     """Reference: parallel.py:978 init_parallel_env — TCPStore rendezvous +
-    default ProcessGroup. Here: (multi-host) jax.distributed is assumed
-    initialized by the launcher; the default group spans jax.devices()."""
+    default ProcessGroup. Multi-process: bootstraps jax.distributed (PJRT
+    coordination service) from the launcher env, so jax.devices() spans all
+    processes and every eager collective runs as a real multi-controller
+    XLA program."""
     global _default_group
     with _lock:
         if _initialized[0]:
             return _default_group
         world = get_world_size()
+        _maybe_init_jax_distributed(world)
         devices = jax.devices()
         n = max(world, 1)
-        if len(devices) >= n > 0 and world > 1:
+        if jax.process_count() > 1:
+            # process-per-host semantics: rank r <-> ONE device of process r
+            # (multi-device-per-process meshes are the jit/shard_map path;
+            # the eager rank-major tiling needs a 1:1 rank:device map)
+            by_proc = {}
+            for d in devices:
+                by_proc.setdefault(d.process_index, d)
+            devs = [by_proc[i] for i in sorted(by_proc)]
+            n = len(devs)
+        elif len(devices) >= n > 0 and world > 1:
             devs = devices[:n]
         else:
             devs = devices[: max(1, min(len(devices), n))]
@@ -330,6 +397,45 @@ def _shardable(x, g: Group) -> bool:
             and shape[0] % g.nranks == 0)
 
 
+def _multiproc(g: Group) -> bool:
+    """True when the group's mesh spans devices of >1 OS process (real
+    multi-controller execution via the PJRT coordination service)."""
+    if g._mesh is None or jax.process_count() <= 1:
+        return False
+    return len({d.process_index for d in g._mesh.devices.flat}) > 1
+
+
+def _run_multiproc(g: Group, fn_name: str, x, **kw):
+    """Real multi-process eager collective: this process's local tensor is
+    one dim-0 tile of a global array laid out over the group mesh; the same
+    cached one-collective executable runs as a multi-controller program and
+    the local result is this rank's addressable shard.
+
+    Reference analog: ProcessGroupNCCL dispatching one collective on the
+    comm stream (process_group_nccl.h:37) — here the "comm stream" is an
+    XLA executable over the coordination-service mesh."""
+    squeeze = (getattr(x, "ndim", 0) == 0)
+    if squeeze:
+        x = jnp.reshape(x, (1,))
+    sh = NamedSharding(g._mesh, P(g.axis_name))
+    local = [d for d in g._mesh.devices.flat
+             if d.process_index == jax.process_index()]
+    if len(local) != 1:
+        raise NotImplementedError(
+            f"eager multi-process collectives need exactly one mesh device "
+            f"per process (got {len(local)} local devices); use the "
+            "jit/shard_map path for multi-device-per-process layouts")
+    arrs = [jax.device_put(x, d) for d in local]
+    gshape = (x.shape[0] * g.nranks,) + tuple(x.shape[1:])
+    gx = jax.make_array_from_single_device_arrays(gshape, sh, arrs)
+    exe = _eager_collective(g._mesh, g.axis_name, fn_name, g.nranks, **kw)
+    out = exe(gx)
+    res = out.addressable_shards[0].data
+    if squeeze and getattr(res, "ndim", 0) == 1 and res.shape[0] == 1:
+        res = jnp.reshape(res, ())
+    return res, Task([res])
+
+
 def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
     """Dispatch a collective: traced → lax op; eager → cached executable."""
     g = group or _get_or_init_default()
@@ -337,6 +443,8 @@ def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
     if _is_traced(x) and _axis_in_scope(g.axis_name):
         out = _SHARD_FNS[fn_name](x, g.axis_name, g.nranks, **kw)
         return out, None
+    if _multiproc(g):
+        return _run_multiproc(g, fn_name, x, **kw)
     if not _shardable(x, g):
         out = _replicated(fn_name, x, g, **kw)
         return out, None
@@ -505,21 +613,60 @@ def barrier(group: Optional[Group] = None):
 # -- p2p --------------------------------------------------------------------
 
 _p2p_mailbox: Dict[tuple, list] = {}
+_p2p_seq: Dict[tuple, int] = {}
+
+
+def _p2p_store_key(gid, src, dst, seq):
+    return f"__p2p/{gid}/{src}->{dst}/{seq}"
 
 
 def send(tensor, dst: int = 0, group=None, sync_op=True):
     """P2P send. Traced: `lax.ppermute` is the TPU-native path (used by the
-    PP engine). Eager single-controller: mailbox delivery (the two "ranks"
-    are views of one program; cross-host eager p2p goes through
-    jax.device_put between processes' addressable devices)."""
+    PP engine). Eager multi-process: serialized through the TCPStore (the
+    reference's rendezvous channel doubles as the CPU p2p transport, like
+    its Gloo path). Eager single-controller: mailbox delivery."""
+    import numpy as _np
+
     g = group or _get_or_init_default()
-    key = (g.id, max(g.rank, 0), g.get_group_rank(dst) if dst in g.ranks else dst)
+    me = max(g.get_group_rank(get_rank()), 0)  # group-local on BOTH sides
+    peer = g.get_group_rank(dst) if dst in g.ranks else dst
+    store = _store_client()
+    if store is not None and jax.process_count() > 1:
+        key = (g.id, me, peer)
+        seq = _p2p_seq.get(key, 0)
+        _p2p_seq[key] = seq + 1
+        arr = _np.asarray(_unwrap(tensor))
+        header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|".encode()
+        store.set(_p2p_store_key(g.id, me, peer, seq),
+                  header + arr.tobytes())
+        return None
+    key = (g.id, max(g.rank, 0), peer)
     _p2p_mailbox.setdefault(key, []).append(_unwrap(tensor))
 
 
 def recv(tensor, src: int = 0, group=None, sync_op=True):
+    import numpy as _np
+
     g = group or _get_or_init_default()
-    key = (g.id, g.get_group_rank(src) if src in g.ranks else src, max(g.rank, 0))
+    peer = g.get_group_rank(src) if src in g.ranks else src
+    store = _store_client()
+    if store is not None and jax.process_count() > 1:
+        me = max(g.get_group_rank(get_rank()), 0)
+        key = (g.id, peer, me)
+        seq = _p2p_seq.get(("r",) + key, 0)
+        _p2p_seq[("r",) + key] = seq + 1
+        skey = _p2p_store_key(g.id, peer, me, seq)
+        store.wait(skey)
+        raw = store.get(skey)
+        store.delete_key(skey)  # 5) consumed — don't grow the master KV
+        dt, shape, payload = raw.split(b"|", 2)
+        shape = tuple(int(v) for v in shape.decode().split(",") if v)
+        arr = _np.frombuffer(payload, dtype=_np.dtype(dt.decode()))
+        arr = arr.reshape(shape)
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(arr)
+        return None
+    key = (g.id, peer, max(g.rank, 0))
     box = _p2p_mailbox.get(key)
     if box:
         arr = box.pop(0)

@@ -1,0 +1,103 @@
+"""Worker script for tests/test_multiproc_collective.py.
+
+Runs under `paddle_tpu.distributed.launch` as a REAL OS process (pattern-B
+analog of the reference's `test/collective/collective_*_api.py` workers):
+bootstraps the PJRT coordination service via init_parallel_env, exercises
+each eager collective + store-backed p2p + a DP train step, and writes its
+results as JSON for the driver test to assert on.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))  # repo root (launcher runs us as a script)
+
+# one CPU device per process; must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    import jax
+
+    results = {"rank": rank, "world": world,
+               "process_count": jax.process_count(),
+               "device_count": len(jax.devices())}
+
+    # all_reduce: sum of rank+1 over ranks
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    results["all_reduce"] = t.numpy().tolist()
+
+    # all_gather
+    gathered = []
+    t = paddle.to_tensor(np.full((2,), float(rank * 10), np.float32))
+    dist.all_gather(gathered, t)
+    results["all_gather"] = [g.numpy().tolist() for g in gathered]
+
+    # broadcast from rank 1
+    t = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+    dist.broadcast(t, src=1)
+    results["broadcast"] = t.numpy().tolist()
+
+    # reduce_scatter: each rank contributes [world * 2] values
+    src = paddle.to_tensor(
+        np.arange(world * 2, dtype=np.float32) + 100 * rank)
+    out = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(out, src)
+    results["reduce_scatter"] = out.numpy().tolist()
+
+    # barrier must not deadlock
+    dist.barrier()
+    results["barrier"] = True
+
+    # p2p ring: rank r sends to (r+1) % world, receives from (r-1) % world
+    send_buf = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+    recv_buf = paddle.to_tensor(np.zeros((2,), np.float32))
+    if rank % 2 == 0:
+        dist.send(send_buf, dst=(rank + 1) % world)
+        dist.recv(recv_buf, src=(rank - 1) % world)
+    else:
+        dist.recv(recv_buf, src=(rank - 1) % world)
+        dist.send(send_buf, dst=(rank + 1) % world)
+    results["p2p_recv"] = recv_buf.numpy().tolist()
+
+    # DP train step: per-rank batch shard, grads allreduce-averaged by
+    # DataParallel; final params must be IDENTICAL across ranks and equal
+    # the single-process full-batch run (the driver test checks both).
+    paddle.seed(7)
+    net = paddle.nn.Linear(3, 2)
+    net = paddle.DataParallel(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    full_x = np.linspace(-1, 1, world * 4 * 3).reshape(world, 4, 3)
+    full_y = (full_x.sum(-1, keepdims=True) * np.ones((1, 1, 2))) * 0.5
+    x = paddle.to_tensor(full_x[rank].astype(np.float32))
+    y = paddle.to_tensor(full_y[rank].astype(np.float32))
+    for _ in range(3):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        net.sync_gradients()
+        opt.step()
+        opt.clear_grad()
+    results["dp_loss"] = float(loss.numpy())
+    results["dp_weight"] = net._layers.weight.numpy().tolist() \
+        if hasattr(net, "_layers") else net.weight.numpy().tolist()
+
+    with open(os.path.join(out_dir, f"result_{rank}.json"), "w") as f:
+        json.dump(results, f)
+    print(f"worker {rank} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""REAL multi-process distributed tests (VERDICT r2 task 4).
+
+Pattern-B analog of the reference's `test/collective/` suite
+(`test_collective_allreduce_api.py` + `test_dist_base.py:957`): the driver
+spawns N real OS processes through `paddle_tpu.distributed.launch` (which
+hosts the native TCPStore master and sets the coordination-service env),
+each worker runs eager collectives + store p2p + a DataParallel train step
+over the PJRT coordination service on localhost CPU, and the driver asserts
+on every rank's written results — including DP-vs-single-process parity.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multiproc", "collective_worker.py")
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_launch(world, out_dir, timeout=420):
+    env = dict(os.environ)
+    # force CPU for launcher AND workers: the launcher must never touch
+    # the TPU backend, and each worker needs one local CPU device
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PADDLE_MASTER_PORT"] = str(_free_port())
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nnodes", "1", "--nproc_per_node", str(world),
+           "--max_restart", "0",
+           "--log_dir", os.path.join(out_dir, "log"),
+           WORKER, out_dir]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        logs = ""
+        log_dir = os.path.join(out_dir, "log")
+        if os.path.isdir(log_dir):
+            for f in sorted(os.listdir(log_dir)):
+                with open(os.path.join(log_dir, f)) as fh:
+                    logs += f"\n--- {f} ---\n" + fh.read()[-3000:]
+        raise AssertionError(
+            f"launch failed rc={proc.returncode}\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}\nworker logs: {logs}")
+    results = {}
+    for r in range(world):
+        with open(os.path.join(out_dir, f"result_{r}.json")) as f:
+            results[r] = json.load(f)
+    return results
+
+
+@pytest.fixture(scope="module")
+def world2_results():
+    with tempfile.TemporaryDirectory() as d:
+        yield _run_launch(2, d)
+
+
+def test_coordination_service_spans_processes(world2_results):
+    for r, res in world2_results.items():
+        assert res["process_count"] == 2, res
+        assert res["device_count"] == 2, res
+
+
+def test_all_reduce_across_processes(world2_results):
+    # sum over ranks of (rank+1) = 1 + 2 = 3
+    for r, res in world2_results.items():
+        np.testing.assert_allclose(res["all_reduce"], [3.0] * 4)
+
+
+def test_all_gather_across_processes(world2_results):
+    for r, res in world2_results.items():
+        np.testing.assert_allclose(res["all_gather"],
+                                   [[0.0, 0.0], [10.0, 10.0]])
+
+
+def test_broadcast_across_processes(world2_results):
+    for r, res in world2_results.items():
+        np.testing.assert_allclose(res["broadcast"], [1.0] * 3)
+
+
+def test_reduce_scatter_across_processes(world2_results):
+    # rank contributions: arange(4) + 100*rank; sum = 2*arange(4) + 100
+    # rank r receives slice [2r:2r+2]
+    total = 2 * np.arange(4, dtype=np.float32) + 100
+    for r, res in world2_results.items():
+        np.testing.assert_allclose(res["reduce_scatter"],
+                                   total[2 * r:2 * r + 2])
+
+
+def test_barrier_and_p2p_ring(world2_results):
+    for r, res in world2_results.items():
+        assert res["barrier"] is True
+        # ring: rank r receives from (r-1) % 2, payload = sender's rank
+        np.testing.assert_allclose(res["p2p_recv"],
+                                   [float((r - 1) % 2)] * 2)
+
+
+def test_dp_training_matches_single_process(world2_results):
+    # all ranks end with identical weights...
+    w0 = np.asarray(world2_results[0]["dp_weight"])
+    w1 = np.asarray(world2_results[1]["dp_weight"])
+    np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-6)
+
+    # ...equal to a single-process full-batch run (grad of the mean loss
+    # over the concatenated batch == mean of per-rank mean-loss grads)
+    import paddle_tpu as paddle
+
+    paddle.seed(7)
+    net = paddle.nn.Linear(3, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    world = 2
+    full_x = np.linspace(-1, 1, world * 4 * 3).reshape(world, 4, 3)
+    full_y = (full_x.sum(-1, keepdims=True) * np.ones((1, 1, 2))) * 0.5
+    x = paddle.to_tensor(full_x.reshape(world * 4, 3).astype(np.float32))
+    y = paddle.to_tensor(full_y.reshape(world * 4, 2).astype(np.float32))
+    for _ in range(3):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(w0, net.weight.numpy(), rtol=1e-4, atol=1e-5)
