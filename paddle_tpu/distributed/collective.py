@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from .env import get_rank, get_world_size
+from .comm_watchdog import comm_task
 
 
 class ReduceOp:
@@ -429,8 +430,16 @@ def _run_multiproc(g: Group, fn_name: str, x, **kw):
     gshape = (x.shape[0] * g.nranks,) + tuple(x.shape[1:])
     gx = jax.make_array_from_single_device_arrays(gshape, sh, arrs)
     exe = _eager_collective(g._mesh, g.axis_name, fn_name, g.nranks, **kw)
-    out = exe(gx)
-    res = out.addressable_shards[0].data
+    with comm_task(fn_name, g.id, max(g.rank, 0), tuple(x.shape),
+                   str(x.dtype)):
+        out = exe(gx)
+        res = out.addressable_shards[0].data
+        # the executable dispatch is async even cross-process: block here so
+        # a peer that never shows up is caught by the watchdog, not later
+        try:
+            res.block_until_ready()
+        except AttributeError:
+            pass
     if squeeze and getattr(res, "ndim", 0) == 1 and res.shape[0] == 1:
         res = jnp.reshape(res, ())
     return res, Task([res])
@@ -656,6 +665,8 @@ def recv(tensor, src: int = 0, group=None, sync_op=True):
         seq = _p2p_seq.get(("r",) + key, 0)
         _p2p_seq[("r",) + key] = seq + 1
         skey = _p2p_store_key(g.id, peer, me, seq)
+        # store.wait registers its own comm_task; give it the p2p context
+        # via the key so a hang reports once with full metadata
         store.wait(skey)
         raw = store.get(skey)
         store.delete_key(skey)  # 5) consumed — don't grow the master KV

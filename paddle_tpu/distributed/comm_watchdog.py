@@ -1,0 +1,158 @@
+"""Communication watchdog: hang detection for eager collectives and store
+waits.
+
+Reference analog: `CommTaskManager` + `NCCLCommTask::IsTimeout`
+(`paddle/phi/core/distributed/comm_task_manager.h:37`,
+`nccl_comm_task.h:53`) — a background thread watches every in-flight
+collective; on timeout it dumps rank/op/shape/elapsed diagnostics and
+aborts the process so the launcher can restart the pod instead of the job
+hanging forever.
+
+TPU-native shape: collectives here are blocking XLA executables (or
+TCPStore waits), so the watchdog wraps the *dispatch sites* — the
+`comm_task(...)` context manager registers a task before the blocking call
+and retires it after. `FLAGS_comm_timeout` (seconds, 0 = disabled) governs
+expiry; `FLAGS_comm_watchdog_abort` chooses SIGABRT (production, lets the
+launcher restart) vs. a diagnostic-only report (tests observing output).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..core import flags
+
+flags.define_flag("comm_timeout", 0.0,
+                  "Seconds before an in-flight collective/store wait is "
+                  "declared hung (0 disables the comm watchdog)")
+flags.define_flag("comm_watchdog_abort", True,
+                  "On comm timeout: abort the process (SIGABRT) after "
+                  "dumping diagnostics; False = dump only")
+
+_counter = itertools.count()
+
+
+class CommTask:
+    __slots__ = ("id", "op", "group_id", "rank", "shape", "dtype", "start",
+                 "timeout", "extra")
+
+    def __init__(self, op, group_id, rank, shape, dtype, timeout, extra=""):
+        self.id = next(_counter)
+        self.op = op
+        self.group_id = group_id
+        self.rank = rank
+        self.shape = shape
+        self.dtype = dtype
+        self.start = time.monotonic()
+        self.timeout = timeout
+        self.extra = extra
+
+    def describe(self) -> str:
+        elapsed = time.monotonic() - self.start
+        return (f"op={self.op} group={self.group_id} rank={self.rank} "
+                f"shape={self.shape} dtype={self.dtype} "
+                f"elapsed={elapsed:.1f}s timeout={self.timeout:.1f}s"
+                + (f" {self.extra}" if self.extra else ""))
+
+
+class CommTaskManager:
+    """Singleton watchdog (reference comm_task_manager.h:37)."""
+
+    def __init__(self):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._fired = False
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="comm-watchdog")
+                self._thread.start()
+
+    def start_task(self, op, group_id, rank, shape, dtype,
+                   timeout=None, extra="") -> Optional[int]:
+        t = timeout if timeout is not None else float(
+            flags.flag_value("comm_timeout") or 0.0)
+        if t <= 0:
+            return None
+        task = CommTask(op, group_id, rank, shape, dtype, t, extra)
+        with self._lock:
+            self._tasks[task.id] = task
+        self._ensure_thread()
+        return task.id
+
+    def end_task(self, task_id: Optional[int]):
+        if task_id is None:
+            return
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def in_flight(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    def _loop(self):
+        idle_since = None
+        while True:
+            time.sleep(0.2)
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                if not self._tasks:
+                    # park the thread once nothing is in flight for a while
+                    # (_ensure_thread restarts it on the next start_task)
+                    idle_since = idle_since or now
+                    if now - idle_since > 5.0:
+                        self._thread = None
+                        return
+                    continue
+                idle_since = None
+                for task in self._tasks.values():
+                    if now - task.start > task.timeout:
+                        expired.append(task)
+                for task in expired:
+                    self._tasks.pop(task.id, None)
+            if expired:
+                # every expiry is reported; _fired only guards double-ABORT
+                self._report_and_maybe_abort(expired)
+
+    def _report_and_maybe_abort(self, expired):
+        lines = ["[comm watchdog] COLLECTIVE TIMEOUT — probable hang. "
+                 "In-flight communication exceeded FLAGS_comm_timeout:"]
+        for task in expired:
+            lines.append("  TIMED OUT: " + task.describe())
+        for task in self.in_flight():
+            lines.append("  also in flight: " + task.describe())
+        msg = "\n".join(lines)
+        print(msg, file=sys.stderr, flush=True)
+        if flags.flag_value("comm_watchdog_abort") and not self._fired:
+            self._fired = True
+            # SIGABRT, like the NCCL watchdog: the launcher's pod watcher
+            # sees the non-zero exit and applies its restart policy
+            os.kill(os.getpid(), signal.SIGABRT)
+
+
+_manager = CommTaskManager()
+
+
+def comm_task_manager() -> CommTaskManager:
+    return _manager
+
+
+@contextlib.contextmanager
+def comm_task(op: str, group_id=0, rank=0, shape=(), dtype="", extra=""):
+    """Wrap a blocking communication call site."""
+    tid = _manager.start_task(op, group_id, rank, shape, dtype, extra=extra)
+    try:
+        yield
+    finally:
+        _manager.end_task(tid)

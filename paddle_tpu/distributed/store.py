@@ -217,11 +217,14 @@ class TCPStore:
         self._client.delete(key)
 
     def wait(self, key: str, timeout: float = 300.0):
+        from .comm_watchdog import comm_task
+
         deadline = time.time() + timeout
-        while not self.check(key):
-            if time.time() > deadline:
-                raise TimeoutError(f"TCPStore wait({key!r}) timed out")
-            time.sleep(0.02)
+        with comm_task("store.wait", extra=f"key={key!r}"):
+            while not self.check(key):
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+                time.sleep(0.02)
 
     def barrier(self, key: str = "_barrier", timeout: float = 300.0):
         # per-generation keys make the barrier reusable (every rank calls

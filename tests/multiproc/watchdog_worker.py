@@ -1,0 +1,43 @@
+"""Worker for tests/test_comm_watchdog.py: rank 1 deliberately never joins
+the collective; rank 0's watchdog must dump diagnostics and abort.
+
+Reference pattern: the comm watchdog tests around
+`paddle/phi/core/distributed/comm_task_manager.h:37` (a hung NCCL collective
+is detected by timeout, diagnostics name the op, then the process aborts).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    paddle.set_flags({"FLAGS_comm_timeout": 5.0})
+    dist.init_parallel_env()
+
+    if rank == 1:
+        # never join the allreduce: simulate a dead/stuck peer, but exit 0
+        # eventually so the launcher's failure is attributable to rank 0's
+        # watchdog abort, not this sleep
+        time.sleep(25)
+        print("stalled rank exiting", flush=True)
+        return
+
+    t = paddle.to_tensor(np.ones((4,), np.float32))
+    dist.all_reduce(t)  # blocks forever -> watchdog must abort us
+    print("UNREACHABLE: all_reduce returned", flush=True)
+
+
+if __name__ == "__main__":
+    main()
