@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..core import flags
 from .env import get_rank, get_world_size
 from .comm_watchdog import comm_task
 
@@ -434,12 +435,14 @@ def _run_multiproc(g: Group, fn_name: str, x, **kw):
                    str(x.dtype)):
         out = exe(gx)
         res = out.addressable_shards[0].data
-        # the executable dispatch is async even cross-process: block here so
-        # a peer that never shows up is caught by the watchdog, not later
-        try:
-            res.block_until_ready()
-        except AttributeError:
-            pass
+        # only when the watchdog is armed: block so a peer that never shows
+        # up is caught here with op context (otherwise stay async — the Task
+        # handle preserves dispatch/compute overlap)
+        if float(flags.flag_value("comm_timeout") or 0.0) > 0:
+            try:
+                res.block_until_ready()
+            except AttributeError:
+                pass
     if squeeze and getattr(res, "ndim", 0) == 1 and res.shape[0] == 1:
         res = jnp.reshape(res, ())
     return res, Task([res])

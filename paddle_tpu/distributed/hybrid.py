@@ -50,19 +50,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama as L
 
-MESH_AXES = ("dp", "pp", "tp")
+MESH_AXES = ("dp", "pp", "cp", "tp")
 
 
 # --------------------------------------------------------------------------
 # Mesh + sharding layout
 # --------------------------------------------------------------------------
 
-def build_mesh(dp: int = 1, pp: int = 1, tp: int = 1, devices=None) -> Mesh:
+def build_mesh(dp: int = 1, pp: int = 1, tp: int = 1, cp: int = 1,
+               devices=None) -> Mesh:
+    """dp x pp x cp x tp device mesh. cp = context parallelism (sequence
+    sharding with ring attention) — a capability the reference LACKS
+    (SURVEY.md §2.5 CP row: 'not present in core repo'); here it is a
+    first-class mesh axis alongside the reference's dims."""
     devices = devices if devices is not None else jax.devices()
-    n = dp * pp * tp
+    n = dp * pp * cp * tp
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, pp, tp)
+    arr = np.asarray(devices[:n]).reshape(dp, pp, cp, tp)
     return Mesh(arr, MESH_AXES)
 
 
@@ -273,7 +278,7 @@ def _moe_ffn(h_full, lp, cfg: L.LlamaConfig, ep_size: int):
 
 
 def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
-              attn_impl: str = "auto"):
+              attn_impl: str = "auto", cp: int = 1):
     """One transformer block with Megatron TP + sequence parallelism.
 
     x: [B, T/tp, D] sequence-sharded. lp: this layer's local weight shards.
@@ -290,7 +295,24 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
     vv = (h_full @ lp["wv"].astype(h_full.dtype)).reshape(Bm, T, nkv_loc, hd)
     q = L.apply_rope(q, cos, sin)
     kk = L.apply_rope(kk, cos, sin)
-    o = L.attention(q, kk, vv, impl=attn_impl).reshape(Bm, T, nh_loc * hd)
+    if cp > 1:
+        # context parallelism: T here is the cp-LOCAL sequence; blockwise
+        # ring attention rotates k/v shards over the 'cp' axis (ICI ring)
+        from ..ops.ring_attention import ring_attention_shard
+
+        if attn_impl == "flash":
+            raise ValueError(
+                "attn_impl='flash' cannot be forced on a cp>1 mesh: context "
+                "parallelism uses ring attention over the cp axis (fusing "
+                "Pallas flash inside the ring blocks is a future "
+                "optimization); use attn_impl='auto'")
+        if nkv_loc != nh_loc:  # GQA: ring blocks need equal head counts
+            kk = jnp.repeat(kk, nh_loc // nkv_loc, axis=2)
+            vv = jnp.repeat(vv, nh_loc // nkv_loc, axis=2)
+        o = ring_attention_shard(q, kk, vv, "cp", causal=True)
+        o = o.astype(h_full.dtype).reshape(Bm, T, nh_loc * hd)
+    else:
+        o = L.attention(q, kk, vv, impl=attn_impl).reshape(Bm, T, nh_loc * hd)
     partial = o @ lp["wo"].astype(o.dtype)                         # row-parallel partial
     x = x + lax.psum_scatter(partial, "tp", scatter_dimension=1, tiled=True)
     h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
@@ -307,7 +329,7 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
 
 
 def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
-                     dp: int, pp: int, tp: int,
+                     dp: int, pp: int, tp: int, cp: int = 1,
                      remat: Union[bool, str] = True,
                      attn_impl: str = "auto"):
     """Build the per-shard loss(params, tokens, targets) -> scalar function.
@@ -319,7 +341,7 @@ def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
 
     def stage_fn(x, blocks_local, cos, sin):
         body = lambda carry, lp: (_block_sp(carry, lp, cfg, cos, sin, dp,
-                                            attn_impl), None)
+                                            attn_impl, cp), None)
         if remat not in (True, False, "dots"):
             raise ValueError(f"remat must be True, False or 'dots', got {remat!r}")
         if remat == "dots":
@@ -344,7 +366,10 @@ def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
         tok_mb = tokens.reshape(M, Bm, T)
         tgt_mb = targets.reshape(M, Bm, T)
         stage = lax.axis_index("pp")
-        cos, sin = L.rope_cos_sin(jnp.arange(T), cfg.head_dim, cfg.rope_theta)
+        # T is the cp-local sequence; rope positions offset by the cp shard
+        pos0 = lax.axis_index("cp") * T if cp > 1 else 0
+        cos, sin = L.rope_cos_sin(pos0 + jnp.arange(T), cfg.head_dim,
+                                  cfg.rope_theta)
         vloc = params["lm_head"].shape[1]
 
         def embed_mb(m):
@@ -380,8 +405,8 @@ def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
         # collect from the last stage (pp); already replicated over tp.
         # Normalize to the GLOBAL batch mean: local token count is M*Bm*T, and
         # the extra 1/dp makes the implicit sum over dp ranks a global mean.
-        loss_sum = lax.psum(loss_sum, "pp")
-        return loss_sum / (M * Bm * T * dp)
+        loss_sum = lax.psum(loss_sum, ("pp", "cp") if cp > 1 else "pp")
+        return loss_sum / (M * Bm * T * cp * dp)
 
     return shard_loss
 
@@ -427,10 +452,10 @@ def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
     anything else = plain XLA attention.
     """
     hp = hp or AdamWConfig()
-    dp, pp, tp = (mesh.shape[a] for a in MESH_AXES)
+    dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
-    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, remat,
-                                  attn_impl)
+    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, cp,
+                                  remat, attn_impl)
     opt_specs = {"m": specs, "v": specs, "step": P()}
 
     def per_shard_step(params, opt, tokens, targets):
@@ -450,7 +475,7 @@ def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
 
     step = jax.shard_map(
         per_shard_step, mesh=mesh,
-        in_specs=(specs, opt_specs, P("dp", None), P("dp", None)),
+        in_specs=(specs, opt_specs, P("dp", "cp"), P("dp", "cp")),
         out_specs=(specs, opt_specs, P()),
         check_vma=False)
     return jax.jit(step, donate_argnums=(0, 1))
@@ -458,14 +483,15 @@ def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
 
 def make_eval_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1):
     """Jitted loss-only step (no grads) with the same sharding layout."""
-    dp, pp, tp = (mesh.shape[a] for a in MESH_AXES)
+    dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
-    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, remat=False)
+    shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, cp,
+                                  remat=False)
 
     def per_shard(params, tokens, targets):
         return lax.psum(shard_loss(params, tokens, targets), "dp")
 
     f = jax.shard_map(per_shard, mesh=mesh,
-                      in_specs=(specs, P("dp", None), P("dp", None)),
+                      in_specs=(specs, P("dp", "cp"), P("dp", "cp")),
                       out_specs=P(), check_vma=False)
     return jax.jit(f)

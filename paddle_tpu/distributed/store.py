@@ -218,9 +218,10 @@ class TCPStore:
 
     def wait(self, key: str, timeout: float = 300.0):
         from .comm_watchdog import comm_task
+        from .env import get_rank
 
         deadline = time.time() + timeout
-        with comm_task("store.wait", extra=f"key={key!r}"):
+        with comm_task("store.wait", rank=get_rank(), extra=f"key={key!r}"):
             while not self.check(key):
                 if time.time() > deadline:
                     raise TimeoutError(f"TCPStore wait({key!r}) timed out")
