@@ -108,16 +108,21 @@ class PipelineLayer(Layer):
             num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
         self._num_stages = max(1, num_stages)
         self._stage_id = hcg.get_stage_id() if hcg else 0
+        # interleaved VPP (reference pipeline_parallel.py:1174): segment into
+        # num_stages * V chunks; chunk v of device d is GLOBAL stage
+        # v * num_stages + d, so each device group interleaves V chunks
+        self._num_virtual = max(1, int(num_virtual_pipeline_stages or 1))
 
         self._layers_desc = list(layers)
-        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        seg = SegmentLayers(self._layers_desc,
+                            self._num_stages * self._num_virtual, seg_method)
         self.segment_parts = seg.do_segment()
 
         # instantiate ALL stages (single-controller); record stage of each
         self._shared = {}
         built: List[Layer] = []
         self._stage_of: List[int] = []
-        for stage in range(self._num_stages):
+        for stage in range(self._num_stages * self._num_virtual):
             lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
             for i in range(lo, hi):
                 d = self._layers_desc[i]
@@ -141,7 +146,19 @@ class PipelineLayer(Layer):
 
     # ------------------------------------------------------------------
     def get_num_stages(self) -> int:
+        """Number of GLOBAL stages (physical stages x virtual chunks)."""
+        return self._num_stages * self._num_virtual
+
+    def get_num_physical_stages(self) -> int:
         return self._num_stages
+
+    def get_num_virtual_stages(self) -> int:
+        return self._num_virtual
+
+    def device_group_of_stage(self, global_stage: int) -> int:
+        """Interleave placement: global stage g lives on device group
+        g % num_physical (chunk g // num_physical of that group)."""
+        return global_stage % self._num_stages
 
     def get_stage_from_index(self, layer_idx: int) -> int:
         return self._stage_of[layer_idx]

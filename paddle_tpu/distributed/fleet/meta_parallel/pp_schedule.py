@@ -189,17 +189,36 @@ class PipelineEngine:
         assert isinstance(pipe_layer, PipelineLayer)
         self.model = pipe_layer
         self.M = int(accumulate_steps)
+        # P = GLOBAL stages; with interleaved VPP (V chunks per device
+        # group, reference pipeline_parallel.py:1174) the engine runs the
+        # same dependency schedule over P_phys*V stages, with global stage g
+        # placed on device group g % P_phys — chunk placement IS the
+        # interleave; the dependency-driven dispatcher then overlaps each
+        # group's chunks exactly like the reference's per-rank interleave.
         self.P = pipe_layer.get_num_stages()
+        self.P_phys = pipe_layer.get_num_physical_stages()
+        self.V = self.P // self.P_phys
         self.schedule = schedule.lower().replace("-", "")
-        if self.schedule not in ("1f1b", "gpipe", "fthenb"):
+        if self.schedule not in ("1f1b", "gpipe", "fthenb", "interleave"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         if self.schedule == "fthenb":
             self.schedule = "gpipe"
+        if self.schedule == "interleave" and self.V == 1:
+            raise ValueError(
+                "schedule='interleave' needs num_virtual_pipeline_stages > 1 "
+                "on the PipelineLayer")
+        if self.schedule == "interleave":
+            self.schedule = "1f1b"  # same per-stage order over global stages
         if stage_devices is None:
             devs = jax.devices()
-            per = max(1, len(devs) // self.P)
-            stage_devices = [devs[s * per:(s + 1) * per]
-                             for s in range(self.P)]
+            per = max(1, len(devs) // self.P_phys)
+            groups = [devs[d * per:(d + 1) * per]
+                      for d in range(self.P_phys)]
+            stage_devices = [groups[pipe_layer.device_group_of_stage(g)]
+                             for g in range(self.P)]
+        elif len(stage_devices) == self.P_phys and self.P != self.P_phys:
+            stage_devices = [stage_devices[pipe_layer.device_group_of_stage(g)]
+                             for g in range(self.P)]
         loss_fn = getattr(pipe_layer, "_loss_fn", None)
         if loss_fn is None:
             raise ValueError(

@@ -306,9 +306,6 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
                 "parallelism uses ring attention over the cp axis (fusing "
                 "Pallas flash inside the ring blocks is a future "
                 "optimization); use attn_impl='auto'")
-        if nkv_loc != nh_loc:  # GQA: ring blocks need equal head counts
-            kk = jnp.repeat(kk, nh_loc // nkv_loc, axis=2)
-            vv = jnp.repeat(vv, nh_loc // nkv_loc, axis=2)
         o = ring_attention_shard(q, kk, vv, "cp", causal=True)
         o = o.astype(h_full.dtype).reshape(Bm, T, nh_loc * hd)
     else:

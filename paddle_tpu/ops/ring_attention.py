@@ -58,13 +58,19 @@ def _block_attend(q, k, v, scale, mask):
 
 def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
                          scale=None):
-    """Blockwise ring attention on sequence-sharded q/k/v [B, Tl, H, D].
+    """Blockwise ring attention on sequence-sharded q [B, Tl, H, D] and
+    k/v [B, Tl, KV, D] (GQA when KV < H, H % KV == 0).
 
     Must run inside a mapped context binding `axis_name`. Returns [B,Tl,H,D].
+    GQA note: the ring rotates the UN-repeated K/V blocks (KV heads), so
+    ppermute traffic stays at the kv-head volume; the head expansion is a
+    local repeat inside each block step.
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
     q_pos = me * Tl + jnp.arange(Tl)
@@ -79,8 +85,12 @@ def ring_attention_shard(q, k, v, axis_name: str, causal: bool = True,
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
         else:
             mask = None
-        pv, m_blk, l_blk = _block_attend(qf, kb.astype(jnp.float32),
-                                         vb, scale, mask)
+        kb_f, vb_f = kb, vb
+        if G > 1:  # local head expansion AFTER the ring transfer
+            kb_f = jnp.repeat(kb, G, axis=2)
+            vb_f = jnp.repeat(vb, G, axis=2)
+        pv, m_blk, l_blk = _block_attend(qf, kb_f.astype(jnp.float32),
+                                         vb_f, scale, mask)
         m_new = jnp.maximum(m_run, m_blk)
         corr = jnp.exp(m_run - m_new)
         blk = jnp.exp(m_blk - m_new)
