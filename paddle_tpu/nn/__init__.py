@@ -57,6 +57,7 @@ from .layer.loss import (  # noqa: F401
     MSELoss,
     NLLLoss,
     SmoothL1Loss,
+    CTCLoss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm,

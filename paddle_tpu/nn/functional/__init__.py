@@ -253,3 +253,49 @@ from ...ops.ring_attention import (  # noqa: E402, F401
     ring_attention_shard,
     sep_attention_shard,
 )
+
+
+# ---- sampling / detection / sequence (vision_ops kernels) -----------------
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _C_ops.grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                              align_corners=align_corners)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return _C_ops.affine_grid(theta, tuple(int(v) for v in out_shape),
+                              align_corners=align_corners)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Reference: python/paddle/nn/functional/loss.py ctc_loss (warpctc)."""
+    nll = _C_ops.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=blank, norm_by_times=norm_by_times)
+    if reduction == "mean":
+        return (nll / label_lengths.astype("float32")).mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _C_ops.pixel_unshuffle(x, downscale_factor=downscale_factor,
+                                  data_format=data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _C_ops.channel_shuffle(x, groups=groups, data_format=data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    return _C_ops.temporal_shift(x, seg_num=seg_num, shift_ratio=shift_ratio,
+                                 data_format=data_format)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, name=None):
+    return _C_ops.max_pool2d_with_index(
+        x, kernel_size, stride=stride, padding=padding,
+        global_pooling=global_pooling)

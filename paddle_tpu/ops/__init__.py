@@ -29,4 +29,5 @@ from .kernels import (  # noqa: F401
     reduce,
     rnn_ops,
     search,
+    vision_ops,
 )

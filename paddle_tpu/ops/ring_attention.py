@@ -171,8 +171,13 @@ def ring_attention(q, k, v, group=None, causal: bool = True,
 
     def kernel(qa, ka, va):
         if g.mesh is None or g.nranks <= 1:
-            # degenerate ring of 1: plain flash-style attention
+            # degenerate ring of 1: plain flash-style attention (GQA heads
+            # expanded locally, same as the multi-rank ring path)
             B, T, H, D = qa.shape
+            KV = ka.shape[2]
+            if KV != H:
+                ka = jnp.repeat(ka, H // KV, axis=2)
+                va = jnp.repeat(va, H // KV, axis=2)
             mask = (jnp.tril(jnp.ones((T, T), bool))[None, None]
                     if causal else None)
             pv, _, l = _block_attend(qa.astype(jnp.float32),

@@ -1,3 +1,4 @@
 """paddle.vision parity (reference: python/paddle/vision)."""
 from . import datasets, models, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
+from . import ops  # noqa: F401

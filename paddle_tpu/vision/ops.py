@@ -1,0 +1,198 @@
+"""paddle.vision.ops parity — detection op surface.
+
+Reference: python/paddle/vision/ops.py (yolo_box, prior_box, box_coder,
+nms, roi_align, roi_pool, psroi_pool, deform_conv2d,
+distribute_fpn_proposals, generate_proposals, DeformConv2D).
+Kernels: paddle_tpu/ops/kernels/vision_ops.py.
+"""
+from __future__ import annotations
+
+from .. import _C_ops
+from ..nn.layer.layers import Layer
+from ..nn.param_attr import ParamAttr
+
+__all__ = [
+    "yolo_box", "prior_box", "box_coder", "nms", "matrix_nms",
+    "multiclass_nms3", "roi_align", "roi_pool", "psroi_pool",
+    "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+    "generate_proposals",
+]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    return _C_ops.yolo_box(x, img_size, anchors=tuple(anchors),
+                           class_num=class_num, conf_thresh=conf_thresh,
+                           downsample_ratio=downsample_ratio,
+                           clip_bbox=clip_bbox, scale_x_y=scale_x_y,
+                           iou_aware=iou_aware,
+                           iou_aware_factor=iou_aware_factor)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    return _C_ops.prior_box(
+        input, image, min_sizes=tuple(min_sizes),
+        max_sizes=tuple(max_sizes or ()), aspect_ratios=tuple(aspect_ratios),
+        variances=tuple(variance), flip=flip, clip=clip, steps=tuple(steps),
+        offset=offset,
+        min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    return _C_ops.box_coder(prior_box, prior_box_var, target_box,
+                            code_type=code_type,
+                            box_normalized=box_normalized, axis=axis)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    if category_idxs is None:
+        return _C_ops.nms(boxes, scores, iou_threshold=iou_threshold,
+                          top_k=top_k or -1)
+    # categorical: suppress within each category, merge by score
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    kept = []
+    cat = np.asarray(category_idxs._data if isinstance(category_idxs, Tensor)
+                     else category_idxs)
+    for c in categories:
+        (sel,) = np.nonzero(cat == c)
+        if sel.size == 0:
+            continue
+        k = _C_ops.nms(boxes[sel.tolist()],
+                       None if scores is None else scores[sel.tolist()],
+                       iou_threshold=iou_threshold)
+        kept.extend(sel[np.asarray(k._data)].tolist())
+    if scores is not None:
+        sc = np.asarray(scores._data if isinstance(scores, Tensor)
+                        else scores)
+        kept.sort(key=lambda i: -sc[i])
+    if top_k:
+        kept = kept[:top_k]
+    return Tensor._from_data(jnp.asarray(np.asarray(kept, np.int64)),
+                             stop_gradient=True)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    return _C_ops.matrix_nms(
+        bboxes, scores, score_threshold=score_threshold,
+        post_threshold=post_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, use_gaussian=use_gaussian,
+        gaussian_sigma=gaussian_sigma, background_label=background_label,
+        normalized=normalized)
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=1000, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    return_index=True, name=None):
+    return _C_ops.multiclass_nms3(
+        bboxes, scores, rois_num, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        nms_eta=nms_eta, background_label=background_label)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _C_ops.roi_align(x, boxes, boxes_num,
+                            pooled_height=output_size[0],
+                            pooled_width=output_size[1],
+                            spatial_scale=spatial_scale,
+                            sampling_ratio=sampling_ratio, aligned=aligned)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _C_ops.roi_pool(x, boxes, boxes_num,
+                           pooled_height=output_size[0],
+                           pooled_width=output_size[1],
+                           spatial_scale=spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+               name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    C = x.shape[1]
+    oc = C // (output_size[0] * output_size[1])
+    return _C_ops.psroi_pool(x, boxes, boxes_num, output_channels=oc,
+                             spatial_scale=spatial_scale,
+                             pooled_height=output_size[0],
+                             pooled_width=output_size[1])
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    out = _C_ops.deformable_conv(x, offset, weight, mask, stride=stride,
+                                 padding=padding, dilation=dilation,
+                                 deformable_groups=deformable_groups,
+                                 groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+class DeformConv2D(Layer):
+    """Reference: python/paddle/vision/ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *kernel_size],
+            ParamAttr._to_attr(weight_attr))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], ParamAttr._to_attr(bias_attr), is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    return _C_ops.distribute_fpn_proposals(
+        fpn_rois, min_level, max_level, refer_level, refer_scale,
+        rois_num, pixel_offset=pixel_offset)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    return _C_ops.generate_proposals(
+        scores, bbox_deltas, img_size, anchors, variances,
+        pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n,
+        nms_thresh=nms_thresh, min_size=min_size, eta=eta,
+        pixel_offset=pixel_offset)

@@ -523,6 +523,58 @@ add_specs({
                 ref=lambda x, y: x / (1 + np.exp(-x)) * y),
 })
 
+# --- detection / OCR tail (vision_ops) --------------------------------------
+add_specs({
+    "grid_sample": S([sym(1, 2, 5, 5), unit(1, 3, 4, 2)], grad=(0,)),
+    "affine_grid": S([sym(2, 2, 3)], kwargs={"out_shape": (2, 1, 3, 4)},
+                     grad=(0,)),
+    "depthwise_conv2d": S([sym(1, 4, 6, 6), sym(4, 1, 3, 3, seed=9)],
+                          kwargs={"padding": 1}, grad=(0, 1)),
+    "roi_align": S([sym(1, 2, 8, 8),
+                    np.array([[1.0, 1.0, 6.0, 6.0]], np.float32),
+                    np.array([1], np.int32)],
+                   kwargs={"pooled_height": 2, "pooled_width": 2},
+                   grad=(0,)),
+    "roi_pool": S([sym(1, 2, 8, 8),
+                   np.array([[0.0, 0.0, 4.0, 4.0]], np.float32),
+                   np.array([1], np.int32)],
+                  kwargs={"pooled_height": 2, "pooled_width": 2}),
+    "psroi_pool": S([sym(1, 8, 6, 6),
+                     np.array([[0.0, 0.0, 4.0, 4.0]], np.float32),
+                     np.array([1], np.int32)],
+                    kwargs={"output_channels": 2, "pooled_height": 2,
+                            "pooled_width": 2}),
+    "deformable_conv": S([sym(1, 2, 5, 5), sym(1, 18, 5, 5, seed=7) * 0.3,
+                          sym(3, 2, 3, 3, seed=9)],
+                         kwargs={"padding": 1}, grad=(0, 2)),
+    "yolo_box": S([sym(1, 12, 2, 2), np.array([[32, 32]], np.int32)],
+                  kwargs={"anchors": (8, 8, 16, 16), "class_num": 1,
+                          "conf_thresh": 0.0, "downsample_ratio": 16}),
+    "box_coder": S([pos(3, 4, lo=1.0, hi=4.0), np.ones((4,), np.float32),
+                    pos(3, 4, lo=1.0, hi=4.0)]),
+    "iou_similarity": S([pos(2, 4, lo=0.5, hi=4.0),
+                         pos(3, 4, lo=0.5, hi=4.0)]),
+    "matrix_nms": S([pos(1, 4, 4, lo=0.0, hi=8.0), frac01(1, 2, 4)],
+                    kwargs={"score_threshold": 0.01, "post_threshold": 0.0,
+                            "nms_top_k": 4, "keep_top_k": 4,
+                            "background_label": -1}, no_jit=True),
+    "bilinear_interp": S([sym(1, 2, 4, 4)],
+                         kwargs={"out_h": 7, "out_w": 6}, grad=(0,)),
+    "nearest_interp": S([sym(1, 2, 4, 4)], kwargs={"out_h": 7, "out_w": 6}),
+    "linear_interp": S([sym(1, 2, 5)], kwargs={"out_w": 9}, grad=(0,)),
+    "pixel_unshuffle": S([sym(1, 2, 4, 4)], kwargs={"downscale_factor": 2},
+                         grad=(0,)),
+    "channel_shuffle": S([sym(1, 4, 3, 3)], kwargs={"groups": 2}, grad=(0,)),
+    "temporal_shift": S([sym(4, 4, 2, 2)], kwargs={"seg_num": 2}, grad=(0,)),
+    "max_pool2d_with_index": S([sym(1, 2, 6, 6)], kwargs={"kernel_size": 2}),
+    "pool3d": S([sym(1, 2, 4, 4, 4)], kwargs={"kernel_size": 2}, grad=(0,)),
+    "ctc_loss": S([sym(6, 2, 5), np.array([[1, 2, 3], [2, 1, 0]], np.int32),
+                   np.array([6, 6], np.int32), np.array([3, 2], np.int32)],
+                  grad=(0,)),
+    "warpctc": S([sym(6, 2, 5), np.array([[1, 2, 3], [2, 1, 0]], np.int32),
+                  np.array([6, 6], np.int32), np.array([3, 2], np.int32)]),
+})
+
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
     # pytree-structured inputs (flat weight list + optional masks) don't fit
@@ -530,6 +582,15 @@ OPT_OUT = {
     # suite tests/test_rnn.py (torch cross-checks incl. bidirectional/
     # multi-layer/seq_lens, fused-vs-cell-loop parity, finite-difference grad)
     "rnn": "dedicated suite tests/test_rnn.py",
+    # data-dependent output sizes (EAGER host ops) + list/tuple outputs the
+    # generic harness cannot shape-check; all covered with references in
+    # tests/test_vision_ops.py
+    "nms": "dynamic output; dedicated suite tests/test_vision_ops.py",
+    "multiclass_nms3": "dynamic output; tests/test_vision_ops.py",
+    "bipartite_match": "host matching loop; tests/test_vision_ops.py",
+    "generate_proposals": "dynamic output; tests/test_vision_ops.py",
+    "distribute_fpn_proposals": "list output; tests/test_vision_ops.py",
+    "prior_box": "tuple-of-const outputs; tests/test_vision_ops.py",
 }
 
 

@@ -1,0 +1,479 @@
+"""Detection/OCR op tail (BASELINE config 5): torch cross-checks where torch
+ships the op, independent numpy references elsewhere, + end-to-end mini
+detection (conv backbone -> yolo_box -> multiclass_nms3) and OCR
+(CNN -> BiLSTM -> CTC, trained to convergence) models.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+from paddle_tpu.nn import functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---------------------------------------------------------------------------
+# sampling ops vs torch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("align", [True, False])
+@pytest.mark.parametrize("pad", ["zeros", "border"])
+def test_grid_sample_vs_torch(mode, align, pad):
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 5, 7).astype(np.float32)
+    grid = (rs.rand(2, 4, 6, 2).astype(np.float32) * 2.4 - 1.2)
+    got = F.grid_sample(_t(x), _t(grid), mode=mode, padding_mode=pad,
+                        align_corners=align).numpy()
+    want = tF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                          mode=mode, padding_mode=pad,
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_vs_torch(align):
+    theta = np.random.RandomState(1).randn(2, 2, 3).astype(np.float32)
+    got = F.affine_grid(_t(theta), (2, 3, 4, 5), align_corners=align).numpy()
+    want = tF.affine_grid(torch.from_numpy(theta), (2, 3, 4, 5),
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_depthwise_conv2d_vs_torch():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 6, 8, 8).astype(np.float32)
+    w = rs.randn(6, 1, 3, 3).astype(np.float32)
+    got = _C_ops.depthwise_conv2d(_t(x), _t(w), stride=1, padding=1).numpy()
+    want = tF.conv2d(torch.from_numpy(x), torch.from_numpy(w), stride=1,
+                     padding=1, groups=6).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 4, 6, 6).astype(np.float32)
+    w = rs.randn(5, 4, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    got = _C_ops.deformable_conv(_t(x), _t(off), _t(w), None,
+                                 stride=1, padding=1).numpy()
+    want = tF.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                     stride=1, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_v2_mask_scales():
+    rs = np.random.RandomState(4)
+    x = rs.randn(1, 2, 5, 5).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 5, 5), np.float32)
+    mask_half = np.full((1, 9, 5, 5), 0.5, np.float32)
+    full = _C_ops.deformable_conv(_t(x), _t(off), _t(w), None,
+                                  stride=1, padding=1).numpy()
+    half = _C_ops.deformable_conv(_t(x), _t(off), _t(w), _t(mask_half),
+                                  stride=1, padding=1).numpy()
+    np.testing.assert_allclose(half, full * 0.5, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_exact_box_average():
+    """A roi covering exactly one pixel center grid returns that region's
+    bilinear average; constant image -> constant output."""
+    x = np.ones((1, 2, 8, 8), np.float32) * 7.0
+    boxes = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = paddle.vision.ops.roi_align(_t(x), _t(boxes),
+                                      _t(np.array([1], np.int32)),
+                                      output_size=2).numpy()
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 2), 7.0), rtol=1e-6)
+
+
+def test_roi_align_matches_numpy_reference():
+    """Independent numpy implementation of aligned bilinear roi pooling."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(1, 1, 6, 6).astype(np.float32)
+    boxes = np.array([[0.7, 1.1, 4.3, 5.2]], np.float32)
+    ph = pw = 2
+    sr = 2
+    out = paddle.vision.ops.roi_align(
+        _t(x), _t(boxes), _t(np.array([1], np.int32)), output_size=2,
+        sampling_ratio=sr, aligned=True).numpy()
+
+    def bil(img, y, xq):
+        y = np.clip(y, 0, img.shape[0] - 1)
+        xq = np.clip(xq, 0, img.shape[1] - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        y1, x1 = min(y0 + 1, img.shape[0] - 1), min(x0 + 1, img.shape[1] - 1)
+        ly, lx = y - y0, xq - x0
+        return (img[y0, x0] * (1 - ly) * (1 - lx) + img[y0, x1] * (1 - ly) * lx
+                + img[y1, x0] * ly * (1 - lx) + img[y1, x1] * ly * lx)
+
+    b = boxes[0] - 0.5
+    rw, rh = b[2] - b[0], b[3] - b[1]
+    want = np.zeros((ph, pw), np.float32)
+    for i in range(ph):
+        for j in range(pw):
+            acc = 0.0
+            for si in range(sr):
+                for sj in range(sr):
+                    y = b[1] + (i + (si + 0.5) / sr) * rh / ph
+                    xq = b[0] + (j + (sj + 0.5) / sr) * rw / pw
+                    acc += bil(x[0, 0], y, xq)
+            want[i, j] = acc / (sr * sr)
+    np.testing.assert_allclose(out[0, 0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_max_of_region():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = paddle.vision.ops.roi_pool(_t(x), _t(boxes),
+                                     _t(np.array([1], np.int32)),
+                                     output_size=1).numpy()
+    assert out[0, 0, 0, 0] == x[0, 0, :3, :3].max()
+
+
+def test_psroi_pool_shapes_and_constant():
+    x = np.ones((1, 8, 6, 6), np.float32) * 3.0   # 2 out channels, 2x2 bins
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = paddle.vision.ops.psroi_pool(_t(x), _t(boxes),
+                                       _t(np.array([1], np.int32)),
+                                       output_size=2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0)
+
+
+# ---------------------------------------------------------------------------
+# interpolation / layout vs torch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("align", [True, False])
+def test_bilinear_interp_vs_torch(align):
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 3, 5, 6).astype(np.float32)
+    got = _C_ops.bilinear_interp(_t(x), 9, 11, align_corners=align,
+                                 align_mode=0).numpy()
+    want = tF.interpolate(torch.from_numpy(x), size=(9, 11), mode="bilinear",
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_nearest_interp_vs_torch():
+    rs = np.random.RandomState(7)
+    x = rs.randn(1, 2, 4, 4).astype(np.float32)
+    got = _C_ops.nearest_interp(_t(x), 7, 9, align_corners=False).numpy()
+    want = tF.interpolate(torch.from_numpy(x), size=(7, 9),
+                          mode="nearest").numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_pixel_unshuffle_channel_shuffle_vs_torch():
+    rs = np.random.RandomState(8)
+    x = rs.randn(2, 4, 6, 6).astype(np.float32)
+    got = F.pixel_unshuffle(_t(x), 2).numpy()
+    want = tF.pixel_unshuffle(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(got, want)
+    got = F.channel_shuffle(_t(x), 2).numpy()
+    want = torch.channel_shuffle(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_temporal_shift_shapes_and_content():
+    x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32).reshape(4, 4, 1, 1)
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+    assert out.shape == x.shape
+    # channel 0 shifted forward: position t takes value from t+1
+    xr = x.reshape(2, 2, 4, 1, 1)
+    np.testing.assert_allclose(out.reshape(2, 2, 4, 1, 1)[:, 0, 0],
+                               xr[:, 1, 0])
+
+
+def test_max_pool2d_with_index_vs_torch():
+    rs = np.random.RandomState(9)
+    x = rs.randn(2, 3, 6, 6).astype(np.float32)
+    out, idx = F.max_pool2d_with_index(_t(x), 2, stride=2)
+    want, widx = tF.max_pool2d(torch.from_numpy(x), 2, stride=2,
+                               return_indices=True)
+    np.testing.assert_allclose(out.numpy(), want.numpy())
+    np.testing.assert_array_equal(idx.numpy(), widx.numpy())
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d_vs_torch(ptype):
+    rs = np.random.RandomState(10)
+    x = rs.randn(1, 2, 4, 6, 6).astype(np.float32)
+    got = _C_ops.pool3d(_t(x), 2, stride=2, pooling_type=ptype).numpy()
+    tfn = tF.max_pool3d if ptype == "max" else tF.avg_pool3d
+    want = tfn(torch.from_numpy(x), 2, stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# box ops
+# ---------------------------------------------------------------------------
+
+def test_iou_similarity_vs_numpy():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    got = _C_ops.iou_similarity(_t(a), _t(b)).numpy()
+    np.testing.assert_allclose(got[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(got[1, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_nms_reference():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = paddle.vision.ops.nms(_t(boxes), 0.5, _t(scores)).numpy()
+    np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+
+def test_multiclass_nms3():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.85, 0.1], [0.2, 0.1, 0.8]]], np.float32)
+    out, index, num = paddle.vision.ops.multiclass_nms3(
+        _t(boxes), _t(scores), score_threshold=0.3, nms_threshold=0.5)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == o.shape[0] == 2
+    # class 0 keeps box 0 (0.9); class 1 keeps box 2 (0.8)
+    labels = sorted(o[:, 0].tolist())
+    assert labels == [0.0, 1.0]
+
+
+def test_matrix_nms_partial_overlap_reference():
+    """iou=0.6 pair: linear decay = (1-0.6)/(1-0) = 0.4 -> 0.8*0.4 = 0.32."""
+    boxes = np.array([[[0, 0, 10, 5], [0, 2, 10, 7], [20, 20, 30, 30]]],
+                     np.float32)
+    # iou(box0, box1) = 30/70 = 3/7
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out = paddle.vision.ops.matrix_nms(_t(boxes), _t(scores),
+                                       score_threshold=0.01,
+                                       post_threshold=0.0, nms_top_k=3,
+                                       keep_top_k=3,
+                                       background_label=-1).numpy()
+    sc = {round(v, 4) for v in out[0, :, 1].tolist()}
+    want2 = 0.8 * (1 - 3 / 7)  # decayed by its only higher-scored overlap
+    assert round(0.9, 4) in sc
+    assert round(0.7, 4) in sc
+    assert any(abs(v - want2) < 1e-3 for v in sc), (sc, want2)
+
+
+def test_max_pool_with_index_negative_input_padding():
+    """-inf padding semantics: all-negative input with padding must return
+    the true max, and indices must stay inside the image."""
+    x = -np.abs(np.random.RandomState(20).randn(1, 1, 4, 4)).astype(np.float32) - 1
+    out, idx = F.max_pool2d_with_index(_t(x), 3, stride=1, padding=1)
+    want, widx = tF.max_pool2d(torch.from_numpy(x), 3, stride=1, padding=1,
+                               return_indices=True)
+    np.testing.assert_allclose(out.numpy(), want.numpy())
+    np.testing.assert_array_equal(idx.numpy(), widx.numpy())
+    assert (idx.numpy() >= 0).all() and (idx.numpy() < 16).all()
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out = paddle.vision.ops.matrix_nms(_t(boxes), _t(scores),
+                                       score_threshold=0.05,
+                                       post_threshold=0.0, nms_top_k=3,
+                                       keep_top_k=3,
+                                       background_label=-1).numpy()
+    sc = out[0, :, 1]
+    assert sc[0] == pytest.approx(0.9, rel=1e-5)       # top box untouched
+    assert sc[-1] < 0.05                                # duplicate decayed to ~0
+
+
+def test_box_coder_roundtrip():
+    rs = np.random.RandomState(11)
+    priors = np.abs(rs.rand(4, 4).astype(np.float32)) + \
+        np.array([0, 0, 1, 1], np.float32)
+    gt = priors + rs.rand(4, 4).astype(np.float32) * 0.1
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    enc = paddle.vision.ops.box_coder(_t(priors), _t(var), _t(gt),
+                                      code_type="encode_center_size")
+    # decode the diagonal (each target against its own prior)
+    dec = paddle.vision.ops.box_coder(
+        _t(priors), _t(var),
+        _t(np.stack([enc.numpy()[i, i] for i in range(4)])),
+        code_type="decode_center_size").numpy()
+    np.testing.assert_allclose(np.stack([dec[i, i] for i in range(4)]), gt,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_box_reference():
+    """2x2 feature map, 1 anchor, 1 class — hand-computed decode."""
+    N, H, W, cls = 1, 2, 2, 1
+    x = np.zeros((N, 5 + cls, H, W), np.float32)
+    img_size = np.array([[64, 64]], np.int32)
+    boxes, scores = paddle.vision.ops.yolo_box(
+        _t(x), _t(img_size), anchors=[16, 16], class_num=cls,
+        conf_thresh=0.0, downsample_ratio=32)
+    b = boxes.numpy().reshape(H, W, 4)
+    # logits 0 -> sigmoid 0.5: center of cell (i+0.5)/2 * 64; w=h=16/64*64=16
+    c00 = (0 + 0.5) / 2 * 64
+    np.testing.assert_allclose(b[0, 0], [c00 - 8, c00 - 8, c00 + 8, c00 + 8],
+                               rtol=1e-5)
+    s = scores.numpy()
+    np.testing.assert_allclose(s, 0.25, rtol=1e-5)  # 0.5 (obj) * 0.5 (cls)
+
+
+def test_prior_box_basic():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, var = paddle.vision.ops.prior_box(
+        _t(feat), _t(img), min_sizes=[8.0], aspect_ratios=[1.0])
+    b = boxes.numpy()
+    assert b.shape == (4, 4, 1, 4)
+    # cell (0,0): center (0.5*8, 0.5*8)=(4,4), half-size 4 -> [0,0,8,8]/32
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    assert var.numpy().shape == (4, 4, 1, 4)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1], [0.8, 0.7]], np.float32)
+    idx, d = _C_ops.bipartite_match(_t(dist))
+    np.testing.assert_array_equal(idx.numpy(), [0, 1])
+    np.testing.assert_allclose(d.numpy(), [0.9, 0.7], rtol=1e-6)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 300, 300]],
+                    np.float32)
+    outs, restore = paddle.vision.ops.distribute_fpn_proposals(
+        _t(rois), 2, 4, 4, 224)
+    sizes = [o.shape[0] for o in outs]
+    assert sum(sizes) == 3 and len(outs) == 3
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([o.numpy() for o in outs if o.shape[0]], axis=0)
+    np.testing.assert_allclose(cat[restore.numpy()], rois)
+
+
+def test_generate_proposals_smoke():
+    rs = np.random.RandomState(12)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rs.rand(N, A, H, W).astype(np.float32)
+    deltas = (rs.randn(N, A * 4, H, W) * 0.1).astype(np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy, sz = j * 8 + 4, i * 8 + 4, 8 * (a + 1)
+                anchors[i, j, a] = [cx - sz / 2, cy - sz / 2,
+                                    cx + sz / 2, cy + sz / 2]
+    variances = np.ones_like(anchors)
+    im_shape = np.array([[32, 32]], np.float32)
+    rois, rscores, num = paddle.vision.ops.generate_proposals(
+        _t(scores), _t(deltas), _t(im_shape), _t(anchors), _t(variances),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7)
+    assert rois.numpy().shape[1] == 4
+    assert int(num.numpy()[0]) == rois.numpy().shape[0] <= 5
+    assert (rois.numpy() >= 0).all() and (rois.numpy() <= 32).all()
+
+
+# ---------------------------------------------------------------------------
+# CTC vs torch
+# ---------------------------------------------------------------------------
+
+def test_ctc_loss_vs_torch():
+    rs = np.random.RandomState(13)
+    T, B, C, L = 12, 3, 7, 5
+    logits = rs.randn(T, B, C).astype(np.float32)
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([12, 10, 8], np.int32)
+    lb_len = np.array([5, 3, 2], np.int32)
+    got = F.ctc_loss(_t(logits), _t(labels), _t(in_len), _t(lb_len),
+                     blank=0, reduction="none").numpy()
+    want = tF.ctc_loss(
+        torch.from_numpy(logits).log_softmax(-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(in_len.astype(np.int64)),
+        torch.from_numpy(lb_len.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_flows():
+    rs = np.random.RandomState(14)
+    logits = paddle.to_tensor(rs.randn(6, 2, 5).astype(np.float32),
+                              stop_gradient=False)
+    labels = _t(rs.randint(1, 5, (2, 3)).astype(np.int32))
+    loss = F.ctc_loss(logits, labels, _t(np.array([6, 6], np.int32)),
+                      _t(np.array([3, 2], np.int32)))
+    loss.backward()
+    g = logits.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# mini models (config 5 shapes)
+# ---------------------------------------------------------------------------
+
+def test_mini_detector_forward():
+    """Conv backbone -> YOLO head -> decode -> NMS: the PP-YOLOE pipeline
+    shape, end to end through the public API."""
+    paddle.seed(0)
+    cls, an = 3, 2
+    backbone = paddle.nn.Sequential(
+        paddle.nn.Conv2D(3, 8, 3, stride=2, padding=1), paddle.nn.ReLU(),
+        paddle.nn.Conv2D(8, an * (5 + cls), 3, stride=2, padding=1))
+    img = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, 32, 32).astype(np.float32))
+    feat = backbone(img)                                  # [1, an*8, 8, 8]
+    boxes, scores = paddle.vision.ops.yolo_box(
+        feat, _t(np.array([[32, 32]], np.int32)),
+        anchors=[8, 8, 16, 16], class_num=cls, conf_thresh=0.005,
+        downsample_ratio=4)
+    out, index, num = paddle.vision.ops.multiclass_nms3(
+        boxes, scores.transpose([0, 2, 1]), score_threshold=0.01,
+        nms_threshold=0.5, keep_top_k=10)
+    assert out.numpy().shape[1] == 6
+    assert int(num.numpy()[0]) <= 10
+
+
+class MiniCRNN(paddle.nn.Layer):
+    """PP-OCR rec shape: conv stem -> collapse height -> BiLSTM -> CTC."""
+
+    def __init__(self, num_classes):
+        super().__init__()
+        self.conv = paddle.nn.Sequential(
+            paddle.nn.Conv2D(1, 8, 3, stride=(2, 1), padding=1),
+            paddle.nn.ReLU(),
+            paddle.nn.Conv2D(8, 16, 3, stride=(2, 1), padding=1),
+            paddle.nn.ReLU())
+        self.rnn = paddle.nn.LSTM(16 * 2, 32, direction="bidirectional")
+        self.head = paddle.nn.Linear(64, num_classes)
+
+    def forward(self, x):                                  # [B, 1, 8, T]
+        f = self.conv(x)                                   # [B, 16, 2, T]
+        B, C, H, W = f.shape
+        f = f.transpose([0, 3, 1, 2]).reshape([B, W, C * H])
+        seq, _ = self.rnn(f)
+        return self.head(seq)                              # [B, T, cls]
+
+
+def test_mini_crnn_ocr_ctc_converges():
+    paddle.seed(1)
+    V = 6                                                  # 0 = blank
+    model = MiniCRNN(V)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    rs = np.random.RandomState(2)
+    B, T = 4, 12
+    x = paddle.to_tensor(rs.rand(B, 1, 8, T).astype(np.float32))
+    labels = _t(rs.randint(1, V, (B, 4)).astype(np.int32))
+    in_len = _t(np.full((B,), T, np.int32))
+    lb_len = _t(np.full((B,), 4, np.int32))
+    losses = []
+    for _ in range(60):
+        logits = model(x).transpose([1, 0, 2])             # [T, B, V]
+        loss = F.ctc_loss(logits, labels, in_len, lb_len)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.3, losses
