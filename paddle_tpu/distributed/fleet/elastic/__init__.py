@@ -1,0 +1,13 @@
+"""Elastic training: fault tolerance + scale in/out.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager over an etcd registry with TTL leases) and
+elastic/__init__.py (enable/launch glue). TPU-native analog: the registry
+is our own TCPStore (core/native/src/native.cc) instead of etcd — nodes
+register under a job prefix, heartbeat on a TTL, and every node
+deterministically recomputes the rank map from the same registry snapshot,
+so no consensus round is needed beyond the store itself.
+"""
+from .manager import ElasticManager, ElasticLevel, ElasticStatus
+
+__all__ = ["ElasticManager", "ElasticLevel", "ElasticStatus"]

@@ -1,0 +1,255 @@
+"""ElasticManager — store-backed node registry, heartbeats, rank reassignment.
+
+Reference design: python/paddle/distributed/fleet/elastic/manager.py:125.
+There, every node holds an etcd lease (TTL) on a key under
+``/paddle/{job}/nodes``; a lease-heartbeat thread refreshes it; watch
+callbacks fire when the node set changes; when the set is stable and within
+``[min_np, max_np]`` the launcher (re)builds the pod with freshly assigned
+ranks, and trainers resume from the last checkpoint.
+
+TPU-native translation (no etcd in the image, and none needed):
+
+* The registry is our TCPStore (``paddle_tpu/distributed/store.py``, native
+  C++ server in ``core/native/src/native.cc``). A TTL lease becomes a
+  heartbeat key ``{prefix}/beat/{node}`` carrying ``time.time()``; a node is
+  live iff its beat is younger than ``ttl``. Slots are allocated with the
+  store's atomic ``add`` so registration is race-free without a lock.
+* There are no watch callbacks: every node polls the same registry and runs
+  the same pure function ``live_nodes() -> rank map``, so all survivors
+  agree on the new world without a consensus round (the store is the single
+  source of truth, exactly like etcd was).
+* Rescale is checkpoint-based like the reference: on membership change the
+  local pod is torn down and respawned with the new (rank, world) env;
+  trainers are expected to resume from their last checkpoint
+  (``paddle_tpu.distributed.checkpoint`` reshards on load, so a different
+  world size is fine).
+
+States mirror the reference's ElasticStatus enum (manager.py:60).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class ElasticLevel:
+    """Reference manager.py:55 — fault tolerance vs true elastic."""
+
+    FAULT_TOLERANCE = 1   # fixed np: restart in place on failure
+    ELASTIC = 2           # min:max np: rescale on node loss/join
+
+
+class ElasticStatus:
+    """Reference manager.py:60 (COMPLETED/ERROR/HOLD/RESTART/EXIT)."""
+
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"          # waiting for the node set to stabilise
+    RESTART = "restart"    # membership changed -> respawn pod
+    EXIT = "exit"          # job done elsewhere, or below min past timeout
+
+
+def parse_nnodes(spec: str) -> Tuple[int, int]:
+    """'N' -> (N, N); 'min:max' -> (min, max). Reference manager.py:371."""
+    parts = str(spec).split(":")
+    lo = int(parts[0])
+    hi = int(parts[1]) if len(parts) > 1 else lo
+    if lo < 1 or hi < lo:
+        raise ValueError(f"bad nnodes spec {spec!r}: need 1 <= min <= max")
+    return lo, hi
+
+
+class ElasticManager:
+    """One instance per node; owns registration + heartbeat + world calc.
+
+    Parameters
+    ----------
+    store : TCPStore-like (set/get/add/check/delete_key)
+    job_id : registry namespace (reference: PADDLE_ELASTIC_JOB_ID)
+    nnodes : "N" or "min:max"
+    node_id : stable identity for this node (default host:pid)
+    ttl : seconds after which a silent node is declared dead
+          (reference: PADDLE_ELASTIC_TTL lease, manager.py:145)
+    settle : membership must be unchanged this long before (re)building the
+             pod — absorbs the join stampede at startup
+    timeout : max seconds to HOLD below min before giving up
+              (reference: PADDLE_ELASTIC_TIMEOUT, manager.py:142)
+    """
+
+    def __init__(self, store, job_id: str, nnodes: str = "1",
+                 node_id: Optional[str] = None, ttl: float = 6.0,
+                 settle: float = 1.0, timeout: float = 120.0):
+        self.store = store
+        self.min_np, self.max_np = parse_nnodes(nnodes)
+        self.level = (ElasticLevel.ELASTIC if self.max_np > self.min_np
+                      else ElasticLevel.FAULT_TOLERANCE)
+        self.node_id = node_id or f"{socket.gethostname()}:{os.getpid()}"
+        self.ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", ttl))
+        self.settle = settle
+        self.timeout = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", timeout))
+        self.prefix = f"elastic/{job_id}"
+        self._slot: Optional[int] = None
+        self._beat_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- registry ----------------------------------------------------------
+
+    def _key(self, *parts: str) -> str:
+        return "/".join((self.prefix,) + parts)
+
+    def register(self) -> int:
+        """Claim a slot and start heartbeating. Returns the slot index.
+
+        Reference: manager.py:288 (etcd.put(host_path, lease)) + the
+        lease_heartbeat thread at manager.py:254. ``add`` on the slot
+        counter is the atomic allocator; slot order doubles as the
+        registration order used for stable rank assignment.
+        """
+        self._slot = self.store.add(self._key("nslots"), 1) - 1
+        self.store.set(self._key("slot", str(self._slot)),
+                       self.node_id.encode())
+        self._beat()
+        self._stop.clear()
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="elastic-heartbeat", daemon=True)
+        self._beat_thread.start()
+        return self._slot
+
+    def _beat(self):
+        self.store.set(self._key("beat", self.node_id),
+                       repr(time.time()).encode())
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: the job is over
+
+    def live_nodes(self) -> List[Tuple[int, str]]:
+        """[(slot, node_id)] with a fresh heartbeat, slot-ascending.
+
+        A node that died and re-registered appears once, at its newest
+        slot (a rejoin is a new registration, like a fresh etcd lease).
+        """
+        try:
+            nslots = int(self.store.add(self._key("nslots"), 0))
+        except Exception:
+            return []
+        newest: Dict[str, int] = {}
+        now = time.time()
+        for s in range(nslots):
+            key = self._key("slot", str(s))
+            if not self.store.check(key):
+                continue
+            node = self.store.get(key).decode()
+            beat_key = self._key("beat", node)
+            if not self.store.check(beat_key):
+                continue
+            try:
+                beat = float(self.store.get(beat_key).decode())
+            except ValueError:
+                continue
+            if now - beat <= self.ttl:
+                newest[node] = s
+        return sorted((s, n) for n, s in newest.items())
+
+    # -- world agreement ---------------------------------------------------
+
+    def world(self) -> Tuple[int, int, List[str]]:
+        """(my_rank, world_size, ordered node ids) from the live set.
+
+        Rank = index in slot order, so surviving nodes keep their relative
+        order across a rescale (reference sorts hosts the same way before
+        writing PADDLE_TRAINERS, manager.py:460 _update_endpoint path).
+        Rank -1 means this node is not (yet) in the live set.
+        """
+        live = self.live_nodes()
+        nodes = [n for _, n in live]
+        rank = nodes.index(self.node_id) if self.node_id in nodes else -1
+        return rank, len(nodes), nodes
+
+    def wait_for_world(self) -> Tuple[str, int, int, List[str]]:
+        """Block until the node set is within [min, max] and stable.
+
+        Returns (status, rank, world_size, nodes): status RESTART when a
+        buildable world emerged, EXIT on done-flag or timeout below min.
+        Reference: _match + wait loop in manager.py:430.
+        """
+        deadline = time.time() + self.timeout
+        stable_since = None
+        prev: Optional[Tuple[str, ...]] = None
+        while True:
+            if self.store.check(self._key("done")):
+                return ElasticStatus.EXIT, -1, 0, []
+            rank, n, nodes = self.world()
+            sig = tuple(nodes)
+            if sig != prev:
+                prev, stable_since = sig, time.time()
+            ok = rank >= 0 and self.min_np <= n <= self.max_np
+            if ok and time.time() - stable_since >= self.settle:
+                return ElasticStatus.RESTART, rank, n, nodes
+            if time.time() > deadline:
+                return ElasticStatus.EXIT, rank, n, nodes
+            time.sleep(min(0.2, self.ttl / 6.0))
+
+    def watch(self, poll_pod) -> str:
+        """Supervise a running pod until something changes.
+
+        ``poll_pod() -> Optional[int]`` returns None while the local pod
+        runs, else its exit code. Returns an ElasticStatus:
+
+        * COMPLETED — local pod exited 0
+        * ERROR     — local pod failed (launcher decides restart budget)
+        * RESTART   — the live node set changed (peer died or joined):
+                      tear down and re-rendezvous
+        * EXIT      — job marked done by another node
+
+        Reference: manager.py watch() + launcher loop in elastic/__init__.py.
+        """
+        _, _, nodes0 = self.world()
+        baseline = tuple(nodes0)
+        while True:
+            rc = poll_pod()
+            if rc is not None:
+                return (ElasticStatus.COMPLETED if rc == 0
+                        else ElasticStatus.ERROR)
+            if self.store.check(self._key("done")):
+                return ElasticStatus.EXIT
+            _, _, nodes = self.world()
+            if tuple(nodes) != baseline:
+                return ElasticStatus.RESTART
+            time.sleep(min(0.2, self.ttl / 6.0))
+
+    # -- teardown ----------------------------------------------------------
+
+    def mark_done(self):
+        """Broadcast job completion so peers EXIT instead of rescaling."""
+        self.store.set(self._key("done"), b"1")
+
+    def is_done(self) -> bool:
+        return bool(self.store.check(self._key("done")))
+
+    def exit(self, completed: bool = False):
+        """Stop heartbeating; optionally mark the job done.
+
+        Reference: manager.py:335 (put done flag, delete host key).
+        The beat key is deleted so peers see this node leave immediately
+        instead of after a TTL.
+        """
+        if completed:
+            try:
+                self.mark_done()
+            except Exception:
+                pass
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+            self._beat_thread = None
+        try:
+            self.store.delete_key(self._key("beat", self.node_id))
+        except Exception:
+            pass

@@ -87,18 +87,23 @@ class Pod:
             self.procs.append(proc)
             self.logs.append(logf)
 
+    def poll(self) -> Optional[int]:
+        """None while any worker runs; else 0 or the first failure code."""
+        alive = False
+        for p in self.procs:
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                return rc
+        return None if alive else 0
+
     def watch(self) -> int:
         """Block until all exit ok (0) or any fails (its code)."""
         while True:
-            alive = False
-            for p in self.procs:
-                rc = p.poll()
-                if rc is None:
-                    alive = True
-                elif rc != 0:
-                    return rc
-            if not alive:
-                return 0
+            rc = self.poll()
+            if rc is not None:
+                return rc
             time.sleep(0.5)
 
     def terminate(self):
@@ -122,7 +127,7 @@ class Pod:
 
 def launch(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
-    nnodes = int(str(args.nnodes).split(":")[0])
+    min_n, max_n = [int(x) for x in (str(args.nnodes).split(":") * 2)[:2]]
     node_rank = args.rank if args.rank >= 0 else int(
         os.environ.get("PADDLE_NODE_RANK", "0"))
     # master KV server lives on node 0 (reference: controllers/master.py)
@@ -136,32 +141,98 @@ def launch(argv: Optional[List[str]] = None) -> int:
 
         try:
             store = TCPStore("127.0.0.1", port, is_master=True,
-                             world_size=nnodes)
+                             world_size=min_n)
         except OSError:
             store = None  # external master already running
 
-    restarts = 0
     try:
-        while True:
-            pod = Pod(args)
-            pod.spawn(node_rank, nnodes, port)
-            rc = pod.watch()
-            if rc == 0:
-                print(f"[launch] job {args.job_id} finished OK")
-                return 0
-            pod.terminate()
-            restarts += 1
-            if restarts > args.max_restart:
-                print(f"[launch] worker failed (exit {rc}); restart budget "
-                      f"exhausted after {restarts - 1} retries",
-                      file=sys.stderr)
-                return rc
-            print(f"[launch] worker failed (exit {rc}); restart "
-                  f"{restarts}/{args.max_restart}", file=sys.stderr)
-            time.sleep(1.0)
+        if max_n > min_n or os.environ.get("PADDLE_ELASTIC_JOB_ID"):
+            return _launch_elastic(args, min_n, max_n, port)
+        return _launch_fixed(args, node_rank, min_n, port)
     finally:
         if store is not None:
             store.stop()
+
+
+def _launch_fixed(args, node_rank: int, nnodes: int, port: int) -> int:
+    """Fixed-world mode: restart the pod in place up to --max_restart."""
+    restarts = 0
+    while True:
+        pod = Pod(args)
+        pod.spawn(node_rank, nnodes, port)
+        rc = pod.watch()
+        if rc == 0:
+            print(f"[launch] job {args.job_id} finished OK")
+            return 0
+        pod.terminate()
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"[launch] worker failed (exit {rc}); restart budget "
+                  f"exhausted after {restarts - 1} retries",
+                  file=sys.stderr)
+            return rc
+        print(f"[launch] worker failed (exit {rc}); restart "
+              f"{restarts}/{args.max_restart}", file=sys.stderr)
+        time.sleep(1.0)
+
+
+def _launch_elastic(args, min_n: int, max_n: int, port: int) -> int:
+    """Elastic mode (--nnodes min:max): store-backed registry, rescale on
+    node loss/join, ranks reassigned each generation.
+
+    Reference: fleet/elastic/manager.py:125 + the watch/launch loop in
+    elastic/__init__.py. Trainers see a fresh PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM each generation and are expected to resume from
+    their last checkpoint (distributed.checkpoint reshards on load).
+    """
+    from ..fleet.elastic import ElasticManager, ElasticStatus
+    from ..store import TCPStore
+
+    master_host = (args.master.split(":")[0] if args.master else "127.0.0.1")
+    client = TCPStore(master_host, port, is_master=False)
+    mgr = ElasticManager(client, args.job_id, nnodes=f"{min_n}:{max_n}")
+    mgr.register()
+    generation = 0   # every rebuild (rescale OR failure)
+    failures = 0     # only worker failures count against --max_restart
+    try:
+        while True:
+            status, rank, world, nodes = mgr.wait_for_world()
+            if status == ElasticStatus.EXIT:
+                done = mgr.is_done()
+                print(f"[launch][elastic] exiting "
+                      f"({'job done' if done else 'below min past timeout'})")
+                return 0 if done else 1
+            print(f"[launch][elastic] generation up: rank={rank} "
+                  f"world={world} nodes={nodes}")
+            pod = Pod(args)
+            os.environ["PADDLE_ELASTIC_GENERATION"] = str(generation)
+            pod.spawn(rank, world, port)
+            status = mgr.watch(pod.poll)
+            pod.terminate()
+            if status == ElasticStatus.COMPLETED:
+                mgr.exit(completed=True)
+                print(f"[launch] job {args.job_id} finished OK")
+                return 0
+            if status == ElasticStatus.EXIT:
+                print("[launch][elastic] peer finished the job; exiting")
+                return 0
+            # ERROR (local worker died, node stays registered) or RESTART
+            # (peer set changed): either way, re-rendezvous for a new world.
+            # Only FAILURES consume the --max_restart budget — legitimate
+            # rescale events are the point of elastic mode, not faults.
+            generation += 1
+            if status == ElasticStatus.ERROR:
+                failures += 1
+                if failures > args.max_restart:
+                    print(f"[launch][elastic] restart budget exhausted "
+                          f"after {failures - 1} retries", file=sys.stderr)
+                    return 1
+            print(f"[launch][elastic] {status}: re-rendezvous "
+                  f"(generation {generation}, failures {failures})",
+                  file=sys.stderr)
+    finally:
+        mgr.exit()
+        client.stop()
 
 
 def main():  # pragma: no cover - thin CLI shim

@@ -1,0 +1,243 @@
+"""Elastic manager: registry, heartbeats, rank reassignment, rescale.
+
+Reference behavior under test: fleet/elastic/manager.py:125 — nodes hold a
+TTL lease in a registry; when one dies the survivors re-rendezvous with
+freshly assigned dense ranks and the job continues at the smaller world
+(VERDICT r2 task 10: kill one of 3 launcher procs, observe a rescaled
+restart). Unit tests drive ElasticManager directly over an in-process
+store; the end-to-end test spawns three real launcher processes and
+SIGKILLs one whole process group to emulate a node loss.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.fleet.elastic.manager import parse_nnodes
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def store():
+    st = TCPStore("127.0.0.1", _free_port(), is_master=True, world_size=1)
+    yield st
+    st.stop()
+
+
+def _mgr(store, job, node, nnodes="1:4", ttl=1.2, settle=0.3, timeout=10.0):
+    return ElasticManager(store, job, nnodes=nnodes, node_id=node,
+                          ttl=ttl, settle=settle, timeout=timeout)
+
+
+def test_parse_nnodes():
+    assert parse_nnodes("3") == (3, 3)
+    assert parse_nnodes("2:5") == (2, 5)
+    with pytest.raises(ValueError):
+        parse_nnodes("3:1")
+    with pytest.raises(ValueError):
+        parse_nnodes("0")
+
+
+def test_register_and_world(store):
+    mgrs = [_mgr(store, "j1", f"node{i}") for i in range(3)]
+    for m in mgrs:
+        m.register()
+    for want_rank, m in enumerate(mgrs):
+        rank, world, nodes = m.world()
+        assert (rank, world) == (want_rank, 3)
+        assert nodes == ["node0", "node1", "node2"]
+    for m in mgrs:
+        m.exit()
+
+
+def test_dead_node_drops_out_and_ranks_stay_dense(store):
+    mgrs = [_mgr(store, "j2", f"node{i}") for i in range(3)]
+    for m in mgrs:
+        m.register()
+    # node1 dies silently: stop its heartbeat WITHOUT deleting the beat key
+    mgrs[1]._stop.set()
+    mgrs[1]._beat_thread.join()
+    time.sleep(mgrs[0].ttl + 0.5)
+    rank0, world0, nodes = mgrs[0].world()
+    rank2, world2, _ = mgrs[2].world()
+    assert nodes == ["node0", "node2"]
+    assert (rank0, world0) == (0, 2)
+    # node2 is reassigned the dense rank 1 (was 2)
+    assert (rank2, world2) == (1, 2)
+    for m in (mgrs[0], mgrs[2]):
+        m.exit()
+
+
+def test_explicit_exit_is_seen_immediately(store):
+    a, b = _mgr(store, "j3", "a"), _mgr(store, "j3", "b")
+    a.register()
+    b.register()
+    b.exit()  # deletes the beat key: no TTL wait needed
+    rank, world, nodes = a.world()
+    assert (rank, world, nodes) == (0, 1, ["a"])
+    a.exit()
+
+
+def test_rejoin_reregisters_once(store):
+    a, b = _mgr(store, "j4", "a"), _mgr(store, "j4", "b")
+    a.register()
+    b.register()
+    b.exit()
+    b2 = _mgr(store, "j4", "b")
+    b2.register()  # new slot, same identity -> appears once, after 'a'
+    rank, world, nodes = b2.world()
+    assert (rank, world, nodes) == (1, 2, ["a", "b"])
+    a.exit()
+    b2.exit()
+
+
+def test_wait_for_world_holds_below_min_then_builds(store):
+    a = _mgr(store, "j5", "a", nnodes="2:3", timeout=8.0)
+    a.register()
+    t0 = time.time()
+    b = _mgr(store, "j5", "b", nnodes="2:3", timeout=8.0)
+
+    import threading
+    threading.Timer(0.8, b.register).start()
+    status, rank, world, nodes = a.wait_for_world()
+    assert status == ElasticStatus.RESTART
+    assert (rank, world) == (0, 2)
+    assert time.time() - t0 >= 0.8  # actually held until b joined
+    a.exit()
+    b.exit()
+
+
+def test_wait_for_world_times_out_below_min(store):
+    a = _mgr(store, "j6", "a", nnodes="2:2", timeout=1.0)
+    a.register()
+    status, _, _, _ = a.wait_for_world()
+    assert status == ElasticStatus.EXIT
+    assert not a.is_done()
+    a.exit()
+
+
+def test_watch_reports_peer_loss_and_done(store):
+    a, b = _mgr(store, "j7", "a"), _mgr(store, "j7", "b")
+    a.register()
+    b.register()
+    import threading
+    threading.Timer(0.3, b.exit).start()
+    status = a.watch(lambda: None)  # local pod keeps running
+    assert status == ElasticStatus.RESTART
+
+    c = _mgr(store, "j8", "c")
+    c.register()
+    threading.Timer(0.3, c.mark_done).start()
+    assert c.watch(lambda: None) == ElasticStatus.EXIT
+    assert c.is_done()
+    a.exit()
+    c.exit()
+
+
+def test_watch_reports_local_pod_exit(store):
+    a = _mgr(store, "j9", "a")
+    a.register()
+    assert a.watch(lambda: 0) == ElasticStatus.COMPLETED
+    assert a.watch(lambda: 7) == ElasticStatus.ERROR
+    a.exit()
+
+
+WORKER = """
+import os, sys, time
+out = sys.argv[1]
+rec = "gen={} rank={} world={}".format(
+    os.environ.get("PADDLE_ELASTIC_GENERATION", "?"),
+    os.environ["PADDLE_TRAINER_ID"], os.environ["PADDLE_TRAINERS_NUM"])
+with open(os.path.join(out, "rec.%d" % os.getpid()), "w") as f:
+    f.write(rec + chr(10))
+time.sleep(120)
+"""
+
+
+def test_kill_one_of_three_launchers_rescales(tmp_path):
+    """The VERDICT acceptance test: 3 launcher procs, SIGKILL one node's
+    whole process group, survivors rebuild a world of 2 with dense ranks."""
+    port = _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    env = dict(os.environ)
+    env.update({"PADDLE_ELASTIC_TTL": "1.5", "PYTHONPATH": REPO,
+                "PADDLE_ELASTIC_TIMEOUT": "30"})
+    procs = []
+    try:
+        for node in range(3):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--master", f"127.0.0.1:{port}", "--nnodes", "2:3",
+                 "--rank", str(node), "--job_id", "elastic_e2e",
+                 "--log_dir", str(tmp_path / f"log{node}"),
+                 str(worker), str(outdir)],
+                env=env, start_new_session=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+
+        def records(after=0.0):
+            """[(rank, world)] from worker records written after `after`."""
+            recs = []
+            for f in sorted(outdir.glob("rec.*")):
+                if f.stat().st_mtime <= after:
+                    continue
+                parts = dict(p.split("=") for p in
+                             f.read_text().split())
+                recs.append((int(parts["rank"]), int(parts["world"])))
+            return recs
+
+        def wait_for(pred, timeout, what):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return
+                time.sleep(0.25)
+            raise AssertionError(
+                f"timeout waiting for {what}; records={records()}")
+
+        # a world of 3 forms: ranks 0,1,2 all report world=3 (possibly
+        # after a transient world of 2 if one node registered late —
+        # that join-triggered rescale is itself elastic behavior)
+        wait_for(lambda: {r for r, w in records() if w == 3} == {0, 1, 2},
+                 timeout=40, what="initial world of 3")
+
+        # node loss: SIGKILL launcher 2's whole process group (launcher +
+        # its worker die together, like a machine dropping off the network)
+        kill_t = time.time()
+        os.killpg(os.getpgid(procs[2].pid), signal.SIGKILL)
+        procs[2].wait()
+
+        # survivors detect the stale heartbeat, tear down, re-rendezvous:
+        # a NEW generation (records written after the kill) with world=2
+        # and dense ranks {0, 1}
+        wait_for(lambda: sorted(
+            (r, w) for r, w in records(after=kill_t) if w == 2) == [
+                (0, 2), (1, 2)],
+            timeout=30, what="rescaled world of 2")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            p.wait()
