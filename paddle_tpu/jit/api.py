@@ -6,7 +6,11 @@ capture is trace-based: the decorated function runs once under `jax.jit`
 tracing (our eager ops are jax-traceable), producing ONE cached XLA
 executable per input signature — the role the reference splits across
 `pir_partial_program.py`, `PdOpLowerToKernelPass` and CINN is played
-entirely by XLA. Backward is a second cached executable computing the
+entirely by XLA. Before tracing, the function is AST-converted by
+`jit/dy2static/` so data-dependent `if`/`while`/`for` lower to XLA
+select / `lax.while_loop`; anything capture can't swallow GRAPH-BREAKS
+to an eager rerun cached per signature (see `dy2static/__init__.py` for
+the SOT guards/graph-break mapping). Backward is a second cached executable computing the
 whole-program vjp (reference analog: the appended-backward program), and
 the pair plugs into the eager tape as a single GradNode, so
 ``loss.backward()`` after a to_static forward works unchanged.
@@ -31,6 +35,7 @@ from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
 from ..ops import dispatch
 from ..autograd.engine import GradNode
+from . import dy2static
 
 _tls = threading.local()
 
@@ -64,6 +69,19 @@ class _CacheEntry:
         self.bwd = bwd
 
 
+class _EagerEntry:
+    """A signature that graph-broke: run the original function eagerly.
+
+    Reference analog: an SOT graph break + eager resume
+    (python/paddle/jit/sot/translate.py:31) — ours breaks at function
+    granularity and remembers why."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
 class StaticFunction:
     """The compiled wrapper (reference analog: dy2static StaticFunction,
     python/paddle/jit/dy2static/program_translator.py)."""
@@ -72,7 +90,8 @@ class StaticFunction:
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
-        self._cache: Dict[Any, _CacheEntry] = {}
+        self._cache: Dict[Any, Any] = {}
+        self._graph_breaks: List[Tuple[Any, str]] = []
         functools.update_wrapper(self, fn)
 
     # descriptor protocol: @to_static on a class method
@@ -84,7 +103,13 @@ class StaticFunction:
         bound._layer = instance if isinstance(instance, Layer) else self._layer
         bound._input_spec = self._input_spec
         bound._cache = self._cache  # share across binds of same instance? keyed by id below
+        bound._graph_breaks = self._graph_breaks
         return bound
+
+    @property
+    def graph_breaks(self):
+        """[(signature, reason)] for every signature that fell back eager."""
+        return list(self._graph_breaks)
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
@@ -118,6 +143,13 @@ class StaticFunction:
         if layer is not None and getattr(fn, "__self__", None) is None:
             # unbound Layer.forward used with an explicit layer argument
             fn = self._fn.__get__(layer, type(layer))
+        try:
+            # dy2static AST conversion: data-dependent if/while/for lower to
+            # select / lax.while_loop instead of failing under the trace
+            fn = dy2static.transform_function(fn)
+        except dy2static.TransformError:
+            pass  # trace the original; a tracer in raw control flow will
+            #       surface as an exception and graph-break to eager
 
         def kernel(key_data, param_arrays, buffer_arrays, input_arrays):
             # Swap tracer arrays into the layer state for the duration of the
@@ -167,6 +199,7 @@ class StaticFunction:
 
     # -- call ----------------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        orig_args, orig_kwargs = args, kwargs
         layer = self._layer
         if layer is None and args and isinstance(args[0], Layer):
             # to_static applied to an unbound Layer.forward: the layer is
@@ -185,9 +218,8 @@ class StaticFunction:
         const_leaves = [None if i in tensor_slots else l for i, l in enumerate(flat_in)]
         sig = self._signature(flat_in, treedef, layer)
         entry = self._cache.get(sig)
-        if entry is None:
-            entry = self._build(treedef, const_leaves, tensor_slots, layer)
-            self._cache[sig] = entry
+        if isinstance(entry, _EagerEntry):
+            return self._fn(*orig_args, **orig_kwargs)
 
         params, buffers = self._named_state(layer)
         param_objs = [p for _, p in params]
@@ -197,7 +229,26 @@ class StaticFunction:
         input_arrays = [t._data for t in input_tensors]
         key_data = jax.random.key_data(rng.next_key())
 
-        out_arrays, new_buffers = entry.fwd(key_data, param_arrays, buffer_arrays, input_arrays)
+        if entry is None:
+            # build + first execution together: a capture failure anywhere
+            # (untransformable control flow, tracer leaking into python,
+            # branch-structure mismatch, unjittable output) is a GRAPH
+            # BREAK — fall back to running the original function eagerly
+            # (ops dispatch one by one, tape records, grads work) and cache
+            # that decision for this signature. A genuine user bug raises
+            # identically in the eager rerun, so nothing is masked.
+            try:
+                entry = self._build(treedef, const_leaves, tensor_slots, layer)
+                out_arrays, new_buffers = entry.fwd(
+                    key_data, param_arrays, buffer_arrays, input_arrays)
+            except Exception as e:  # noqa: BLE001 - see above
+                self._cache[sig] = _EagerEntry(f"{type(e).__name__}: {e}")
+                self._graph_breaks.append((sig, f"{type(e).__name__}: {e}"))
+                return self._fn(*orig_args, **orig_kwargs)
+            self._cache[sig] = entry
+        else:
+            out_arrays, new_buffers = entry.fwd(
+                key_data, param_arrays, buffer_arrays, input_arrays)
         # write back functionalized buffer updates (BN running stats etc.)
         for b, arr in zip(buffer_objs, new_buffers):
             b._data = arr
@@ -281,8 +332,9 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
 
 def not_to_static(fn):
-    """Marker: run this function eagerly inside to_static regions. With
-    trace-based capture everything traces, so this is parity surface only."""
+    """Marker: dy2static's convert_call leaves this function untransformed
+    (reference: paddle.jit.not_to_static). It still traces as straight-line
+    code; data-dependent control flow inside it graph-breaks to eager."""
     fn._not_to_static = True
     return fn
 

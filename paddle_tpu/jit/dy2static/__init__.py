@@ -1,0 +1,45 @@
+"""dy2static — dynamic-to-static capture of data-dependent control flow.
+
+Reference: python/paddle/jit/sot/translate.py:31 (bytecode capture with
+guards, graph breaks, resume functions) and python/paddle/jit/dy2static/
+(AST transforms lowering `if`/`while` to cond/while_loop ops, with
+convert_call recursing into user functions).
+
+TPU-native redesign — the same three capabilities, mapped onto XLA's
+compilation model instead of a bytecode VM:
+
+* **Control-flow conversion** (`transformers.py`): the decorated function's
+  AST is rewritten so every `if`, `while`, `for ... in range(...)`,
+  `and`/`or`/`not` and `assert` goes through a runtime converter
+  (`convert_ops.py`). Converters act only when the value is a live jax
+  tracer: concrete Python values take the ordinary Python path, traced
+  values lower to XLA select (conditionals) or `lax.while_loop` (loops).
+  This is the role the reference splits between SOT's opcode executor and
+  the AST `convert_ifelse`/`convert_while_loop` pair.
+* **Guards**: the reference guards captured graphs on tensor metadata and
+  Python constants (sot/opcode_translator/executor/guard.py). Here the
+  guard set IS StaticFunction's cache signature — shapes, dtypes,
+  stop_gradient, training flags, and the repr of every non-tensor input —
+  so a guard miss is simply a new cache entry.
+* **Graph breaks**: where SOT splits the function and resumes eagerly, we
+  break at function granularity: any capture failure (untransformable
+  source, tracer leaking into Python control flow, branch-structure
+  mismatch) falls back to running the original function eagerly — op by op
+  through the normal dispatch/autograd path — and the fallback decision is
+  cached per signature with its reason (`StaticFunction.graph_breaks`), so
+  later calls skip the failed recompile.
+"""
+from .convert_ops import (GraphBreak, UNDEF, convert_assert, convert_bool,
+                          convert_call, convert_ifelse, convert_ifexp,
+                          convert_logical_and, convert_logical_not,
+                          convert_logical_or, convert_print, convert_while,
+                          final_return, range_args, range_cond)
+from .transformers import TransformError, transform_function
+
+__all__ = [
+    "GraphBreak", "TransformError", "transform_function", "UNDEF",
+    "convert_assert", "convert_bool", "convert_call", "convert_ifelse",
+    "convert_ifexp", "convert_logical_and", "convert_logical_not",
+    "convert_logical_or", "convert_print", "convert_while", "final_return",
+    "range_args", "range_cond",
+]

@@ -1,0 +1,342 @@
+"""Runtime converters for transformed control flow.
+
+Reference: python/paddle/jit/dy2static/convert_operators.py (convert_ifelse
+/ convert_while_loop / convert_logical_*) — there each converter checks
+"is this a Variable?" and emits cond/while_loop ops into the static
+program. Here the check is "is this a live jax tracer?", and the lowering
+targets are XLA primitives:
+
+* conditionals lower to **select** (`jnp.where`): both branches are traced
+  into the surrounding jaxpr and merged leafwise. On TPU this is the
+  idiomatic shape — XLA executes both sides of small branches anyway, the
+  merged graph stays fusable, and reverse-mode autodiff works unchanged.
+  (The cost model caveat — both branches always execute — matches
+  `lax.cond` under vmap.)
+* data-dependent loops lower to **`lax.while_loop`** with the loop-carried
+  variables as the state tuple. Reverse-mode through an unbounded traced
+  while is undefined in XLA; grads through such a loop raise, matching the
+  reference's static while_loop limitation.
+
+Any rule violation raises :class:`GraphBreak`, which StaticFunction turns
+into an eager fallback for that signature.
+"""
+from __future__ import annotations
+
+import builtins
+import inspect
+import types
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GraphBreak(Exception):
+    """Capture cannot continue; the caller falls back to eager."""
+
+
+class _Undefined:
+    """Sentinel for 'name not bound yet' (reference: dy2static UndefinedVar,
+    python/paddle/jit/dy2static/utils.py)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def _tensor_cls():
+    from ...core.tensor import Tensor
+
+    return Tensor
+
+
+def _raw(x):
+    """Underlying array for Tensor, else x."""
+    if isinstance(x, _tensor_cls()):
+        return x._data
+    return x
+
+
+def is_traced(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def convert_bool(x) -> Any:
+    """`bool(x)` that stays symbolic for tracers.
+
+    Concrete values (python, numpy, committed jax arrays) return a python
+    bool; tracers return a scalar bool array for select/while lowering.
+    Multi-element tracers are a genuine ambiguity -> GraphBreak (the eager
+    rerun will surface Python's own ValueError if it is a real bug).
+    """
+    r = _raw(x)
+    if isinstance(r, jax.core.Tracer):
+        if getattr(r, "size", 1) != 1:
+            raise GraphBreak(
+                f"truth value of a traced array with shape {r.shape} is "
+                f"ambiguous")
+        return jnp.reshape(r.astype(bool), ())
+    return bool(x)
+
+
+def _merge_leaf(pred, a, b, lenient=False):
+    """Select between one pair of branch outputs.
+
+    `lenient` merging (used for the transformer's internal return
+    flag/value, reference UndefinedVar semantics) lets a one-sided UNDEF
+    resolve to the defined side: the guard structure guarantees the value
+    is only read on paths where it was assigned, so the phantom arm is
+    dynamically dead. User variables stay strict — an asymmetric
+    assignment graph-breaks to eager, where Python's own NameError
+    semantics apply.
+    """
+    if a is UNDEF and b is UNDEF:
+        return UNDEF
+    if a is UNDEF or b is UNDEF:
+        if lenient:
+            return b if a is UNDEF else a
+        raise GraphBreak(
+            "a variable is assigned in only one branch of a traced "
+            "conditional; bind it before the `if` so both branches define "
+            "it")
+    # containers merge recursively (e.g. a tuple-valued return)
+    if (type(a) is type(b) and isinstance(a, (tuple, list))
+            and len(a) == len(b)):
+        return type(a)(_merge_leaf(pred, x, y, lenient)
+                       for x, y in zip(a, b))
+    if (type(a) is type(b) and isinstance(a, dict)
+            and set(a) == set(b)):
+        return {k: _merge_leaf(pred, a[k], b[k], lenient) for k in a}
+    Tensor = _tensor_cls()
+    ra, rb = _raw(a), _raw(b)
+    arrayish = (jax.core.Tracer, jax.Array, np.ndarray, np.generic,
+                bool, int, float, complex)
+    if isinstance(ra, arrayish) and isinstance(rb, arrayish):
+        ra, rb = jnp.asarray(ra), jnp.asarray(rb)
+        if ra.shape != rb.shape:
+            raise GraphBreak(
+                f"traced conditional branches produce different shapes "
+                f"{ra.shape} vs {rb.shape}")
+        out = jnp.where(pred, ra, rb)
+        if isinstance(a, Tensor) or isinstance(b, Tensor):
+            return Tensor._from_data(out)
+        return out
+    # non-numeric leaves must agree between branches (strings, None, ...)
+    if a is b or a == b:
+        return a
+    raise GraphBreak(
+        f"traced conditional branches return different python values "
+        f"{a!r} vs {b!r}")
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   vals: Tuple, names: Tuple[str, ...] = ()) -> Tuple:
+    """`if pred: ... else: ...` over the assigned-variable tuple `vals`.
+
+    `names` labels each slot; transformer-internal `__jst*` slots merge
+    leniently (see `_merge_leaf`).
+    """
+    p = convert_bool(pred)
+    if isinstance(p, bool):
+        return tuple((true_fn if p else false_fn)(*vals))
+    t_out = tuple(true_fn(*vals))
+    f_out = tuple(false_fn(*vals))
+    if len(t_out) != len(f_out):  # pragma: no cover - transformer invariant
+        raise GraphBreak("branch output arity mismatch")
+    if not names:
+        names = ("",) * len(t_out)
+    return tuple(
+        _merge_leaf(p, a, b, lenient=n.startswith("__jst"))
+        for n, a, b in zip(names, t_out, f_out))
+
+
+def final_return(done, ret):
+    """Terminal return of a return-transformed function.
+
+    Concrete flag: Python semantics (value, or None on fall-through).
+    Traced flag: every return sits inside a traced conditional; `ret` is
+    the select-merged value across those paths. A function that can ALSO
+    fall through to an implicit None cannot be represented as one select
+    (None has no array arm) — we return the merged value, i.e. capture
+    assumes all dynamic paths return. Mixed return/fall-through under a
+    traced predicate should use an explicit `return None`.
+    """
+    c = convert_bool(done)
+    if isinstance(c, bool):
+        return ret if c else None
+    return None if ret is UNDEF else ret
+
+
+def convert_ifexp(pred, true_thunk: Callable, false_thunk: Callable):
+    """`a if pred else b`."""
+    p = convert_bool(pred)
+    if isinstance(p, bool):
+        return true_thunk() if p else false_thunk()
+    return _merge_leaf(p, true_thunk(), false_thunk())
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  vals: Tuple) -> Tuple:
+    """`while cond: body` over the loop-carried variable tuple.
+
+    Concrete condition: ordinary Python loop (re-checking each iteration,
+    so a condition that BECOMES traced mid-loop raises and graph-breaks).
+    Traced condition: `lax.while_loop` with every loop var tensorised.
+    """
+    c = convert_bool(cond_fn(*vals))
+    if isinstance(c, bool):
+        while c:
+            vals = tuple(body_fn(*vals))
+            c = convert_bool(cond_fn(*vals))
+        return vals
+
+    Tensor = _tensor_cls()
+    if any(v is UNDEF for v in vals):
+        raise GraphBreak(
+            "a loop variable may be undefined before a traced `while`; "
+            "initialise it before the loop")
+    tags = [isinstance(v, Tensor) for v in vals]
+
+    def wrap(arrs):
+        return tuple(Tensor._from_data(a) if t else a
+                     for t, a in zip(tags, arrs))
+
+    def unwrap(vs):
+        return tuple(jnp.asarray(_raw(v)) for v in vs)
+
+    def lax_cond(arrs):
+        c = convert_bool(cond_fn(*wrap(arrs)))
+        return c if not isinstance(c, bool) else jnp.asarray(c)
+
+    def lax_body(arrs):
+        return unwrap(body_fn(*wrap(arrs)))
+
+    try:
+        out = jax.lax.while_loop(lax_cond, lax_body, unwrap(vals))
+    except (TypeError, ValueError) as e:
+        raise GraphBreak(f"traced while loop does not lower: {e}") from e
+    return wrap(out)
+
+
+def range_args(*args):
+    """Normalise range(...) arguments to (start, stop, step)."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+def range_cond(i, stop, step):
+    """Continue-condition of a lowered `for ... in range(...)`."""
+    i, stop, step = _raw(i), _raw(stop), _raw(step)
+    if not is_traced(step):
+        return (i < stop) if step > 0 else (i > stop)
+    ri, rs, rt = (jnp.asarray(x) for x in (i, stop, step))
+    return jnp.where(rt > 0, ri < rs, ri > rs)
+
+
+def _convert_chain(thunks, combine, short_circuit_on):
+    """Shared body of and/or. Python short-circuit is preserved while every
+    operand stays concrete; the first traced operand switches the rest of
+    the chain to a combined boolean array (short-circuit is necessarily
+    lost under tracing, as in the reference's logical_and op lowering).
+    `a and tensor` keeps returning the tensor itself (Python returns the
+    last operand), so the value-idiom survives conversion."""
+    val = None
+    for i, th in enumerate(thunks):
+        val = th()
+        c = convert_bool(val)
+        if isinstance(c, bool):
+            if c is short_circuit_on:
+                return val
+            continue
+        # traced: last operand passes through as the value, otherwise
+        # fold the remaining operands into one traced bool
+        acc = c
+        for rest in thunks[i + 1:]:
+            rc = convert_bool(rest())
+            acc = combine(acc, rc)
+        return val if i == len(thunks) - 1 else acc
+    return val
+
+
+def convert_logical_and(*thunks: Callable):
+    """`a and b [and c ...]`."""
+    return _convert_chain(thunks, jnp.logical_and, False)
+
+
+def convert_logical_or(*thunks: Callable):
+    """`a or b [or c ...]`."""
+    return _convert_chain(thunks, jnp.logical_or, True)
+
+
+def convert_logical_not(x):
+    c = convert_bool(x)
+    if isinstance(c, bool):
+        return not c
+    return jnp.logical_not(c)
+
+
+def convert_assert(test_thunk: Callable, msg=None):
+    """Concrete asserts fire normally; traced asserts are dropped from the
+    compiled graph (the reference lowers them to an Assert op — XLA has no
+    host trap, and the eager path still checks them)."""
+    c = convert_bool(test_thunk())
+    if isinstance(c, bool):
+        assert c, msg if msg is not None else ""
+
+
+def convert_print(*args, **kwargs):
+    if any(is_traced(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *[_raw(a) for a in args])
+    else:
+        print(*args, **kwargs)
+
+
+_SKIP_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "flax", "optax",
+                         "builtins", "math", "functools", "itertools",
+                         "operator", "typing", "collections")
+
+
+def convert_call(f):
+    """Recursively transform user callees (reference:
+    python/paddle/jit/dy2static/convert_call_func.py:convert_call).
+
+    Framework/library callables pass through untouched; plain user
+    functions and methods are AST-transformed (cached) so control flow
+    inside helpers also converts. Untransformable callees pass through —
+    a tracer hitting Python control flow inside them surfaces as a trace
+    error and becomes a whole-function graph break upstream.
+    """
+    from .transformers import TransformError, transform_function
+
+    if isinstance(f, (types.BuiltinFunctionType, types.BuiltinMethodType,
+                      type)):
+        return f
+    mod = getattr(f, "__module__", None) or ""
+    if any(mod == p or mod.startswith(p + ".")
+           for p in _SKIP_MODULE_PREFIXES):
+        return f
+    if getattr(f, "_not_to_static", False):
+        return f
+    try:
+        if inspect.ismethod(f):
+            g = transform_function(f.__func__)
+            return g.__get__(f.__self__, type(f.__self__))
+        if inspect.isfunction(f):
+            return transform_function(f)
+    except TransformError:
+        return f
+    return f

@@ -1,0 +1,523 @@
+"""AST transformation of user functions for dynamic-to-static capture.
+
+Reference: python/paddle/jit/dy2static/transformers/ — a pipeline of
+NodeTransformers (IfElseTransformer, LoopTransformer, ReturnTransformer,
+LogicalTransformer, CallTransformer) that rewrite Python control flow into
+converter calls resolved at runtime. This module is the same idea in one
+pass, targeting the converters in ``convert_ops.py``.
+
+Shapes of the rewrites (``_jst`` is the injected converter namespace):
+
+``if t: A else: B`` ::
+
+    def __jst_true_1(x, y): A; return (x, y)
+    def __jst_false_1(x, y): B; return (x, y)
+    (x, y) = _jst.convert_ifelse(t, __jst_true_1, __jst_false_1,
+                                 (<capture x>, <capture y>))
+
+where (x, y) are the names assigned in either branch, and ``<capture v>``
+is ``v`` if bound else ``_jst.UNDEF`` (via try/except NameError).
+
+``while t: B`` ::
+
+    def __jst_cond_1(x): return t
+    def __jst_body_1(x): B; return (x,)
+    (x,) = _jst.convert_while(__jst_cond_1, __jst_body_1, (<capture x>,))
+
+``for i in range(n): B`` lowers to the while form through
+``range_args``/``range_cond``.
+
+``return`` statements are rewritten (ReturnTransformer analog) to set a
+flag + value so a return inside a converted branch merges through select;
+statements after a maybe-returning ``if`` are guarded by ``if not flag``.
+
+Out of scope -> :class:`TransformError` (the caller keeps the original
+function; a tracer reaching raw control flow then graph-breaks to eager):
+``global``/``nonlocal``, ``return``/``break``/``continue`` inside loops
+that need conversion, ``try`` around converted flow, generators.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import weakref
+from typing import List, Optional, Set
+
+from . import convert_ops as _jst_mod
+
+_JST = "_jst"
+_RET_FLAG = "__jst_done"
+_RET_VAL = "__jst_ret"
+
+
+class TransformError(Exception):
+    """This function cannot be AST-converted; use it as-is."""
+
+
+# -- analysis helpers ---------------------------------------------------------
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names assigned in a statement block, not descending into nested
+    function/class scopes (they have their own namespaces)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _skip(self, node):
+        pass
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _skip
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+
+def _stored_names(stmts: List[ast.stmt]) -> List[str]:
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    # transformer-internal temporaries/functions are not data flow — except
+    # the return flag/value pair, which must thread through branches
+    keep = {_RET_FLAG, _RET_VAL}
+    return sorted(n for n in c.names
+                  if n in keep or not n.startswith("__jst"))
+
+
+def _loops_with_return(stmts: List[ast.stmt]) -> bool:
+    """Any loop (outside nested defs) whose body contains a return?"""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, (ast.While, ast.For)) and _contains(
+                list(n.body) + list(n.orelse), ast.Return):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _contains(node_or_list, kinds, stop_at_loops=False) -> bool:
+    """Does any statement (not nested in an inner def) match `kinds`?"""
+    stack = list(node_or_list) if isinstance(node_or_list, list) else [node_or_list]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, kinds):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if stop_at_loops and isinstance(n, (ast.While, ast.For)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _capture(var: str, tmp: str) -> ast.Try:
+    """try: tmp = var \n except NameError: tmp = _jst.UNDEF"""
+    return ast.Try(
+        body=[ast.Assign(targets=[_name(tmp, ast.Store())],
+                         value=_name(var))],
+        handlers=[ast.ExceptHandler(
+            type=_name("NameError"), name=None,
+            body=[ast.Assign(targets=[_name(tmp, ast.Store())],
+                             value=_attr("UNDEF"))])],
+        orelse=[], finalbody=[])
+
+
+def _attr(name: str) -> ast.Attribute:
+    return ast.Attribute(value=_name(_JST), attr=name, ctx=ast.Load())
+
+
+def _call(fn_attr: str, args: List[ast.expr]) -> ast.Call:
+    return ast.Call(func=_attr(fn_attr), args=args, keywords=[])
+
+
+def _thunk(expr: ast.expr) -> ast.Lambda:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _tuple(elts, ctx=None):
+    return ast.Tuple(elts=elts, ctx=ctx or ast.Load())
+
+
+# -- the transformer ----------------------------------------------------------
+
+
+class _Dy2Static(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+        self._fn_depth = 0
+
+    def _next(self) -> int:
+        self._uid += 1
+        return self._uid
+
+    # nested defs keep their own control flow: convert_call handles them
+    # at their call sites, so don't rewrite their bodies here.
+    def visit_FunctionDef(self, node):
+        if self._fn_depth > 0:
+            return node
+        self._fn_depth += 1
+        try:
+            node.body = self._rewrite_returns(node.body)
+            node.body = [self.visit(s) for s in node.body]
+            node.body = [s for sub in node.body
+                         for s in (sub if isinstance(sub, list) else [sub])]
+            return node
+        finally:
+            self._fn_depth -= 1
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _skip_expr(self, node):
+        return node
+
+    visit_ListComp = visit_SetComp = visit_DictComp = _skip_expr
+    visit_GeneratorExp = _skip_expr
+
+    def visit_Global(self, node):
+        raise TransformError("global statement")
+
+    def visit_Nonlocal(self, node):
+        raise TransformError("nonlocal statement")
+
+    def visit_Yield(self, node):
+        raise TransformError("generator function")
+
+    visit_YieldFrom = visit_Yield
+
+    # -- returns --------------------------------------------------------------
+
+    def _rewrite_returns(self, body: List[ast.stmt]) -> List[ast.stmt]:
+        """ReturnTransformer analog (reference:
+        dy2static/transformers/return_transformer.py): rewrite `return X`
+        into flag+value assignments so returns inside converted branches
+        merge through select; guard trailing statements on the flag.
+
+        Fast path: returns only as the final top-level statement need no
+        rewriting. Returns inside loops are out of scope (the loop would
+        have to thread the flag through its carried state)."""
+        has_inner_return = any(
+            _contains(s, ast.Return) for s in body[:-1]) or (
+            body and not isinstance(body[-1], ast.Return)
+            and _contains(body[-1], ast.Return))
+        if not has_inner_return:
+            return body
+        if _loops_with_return(body):
+            raise TransformError("return inside loop")
+
+        prologue = [
+            ast.Assign(targets=[_name(_RET_FLAG, ast.Store())],
+                       value=ast.Constant(value=False)),
+            ast.Assign(targets=[_name(_RET_VAL, ast.Store())],
+                       value=_attr("UNDEF")),
+        ]
+        new_body = prologue + self._guard_block(body)
+        new_body.append(ast.Return(value=_call(
+            "final_return", [_name(_RET_FLAG), _name(_RET_VAL)])))
+        return new_body
+
+    def _replace_return(self, stmt: ast.stmt) -> List[ast.stmt]:
+        if isinstance(stmt, ast.Return):
+            val = stmt.value if stmt.value is not None else ast.Constant(
+                value=None)
+            return [
+                ast.Assign(targets=[_name(_RET_FLAG, ast.Store())],
+                           value=ast.Constant(value=True)),
+                ast.Assign(targets=[_name(_RET_VAL, ast.Store())],
+                           value=val),
+            ]
+        if isinstance(stmt, ast.If):
+            stmt.body = self._guard_block(stmt.body)
+            stmt.orelse = self._guard_block(stmt.orelse)
+            return [stmt]
+        if isinstance(stmt, ast.With):
+            stmt.body = self._guard_block(stmt.body)
+            return [stmt]
+        if isinstance(stmt, ast.Try):
+            stmt.body = self._guard_block(stmt.body)
+            for h in stmt.handlers:
+                h.body = self._guard_block(h.body)
+            if stmt.orelse:
+                stmt.orelse = self._guard_block(stmt.orelse)
+            if stmt.finalbody:
+                stmt.finalbody = self._guard_block(stmt.finalbody)
+            return [stmt]
+        return [stmt]
+
+    def _guard_block(self, body: List[ast.stmt]) -> List[ast.stmt]:
+        """Rewrite returns in a block; statements after a maybe-returning
+        `if` are wrapped in `if not __jst_done:` (dead code after a
+        certain top-level return is simply dropped)."""
+        out: List[ast.stmt] = []
+        for i, stmt in enumerate(body):
+            rest = body[i + 1:]
+            if isinstance(stmt, ast.Return):
+                out.extend(self._replace_return(stmt))
+                break  # anything after an unconditional return is dead
+            may_return = _contains(stmt, ast.Return)
+            out.extend(self._replace_return(stmt))
+            if may_return and rest:
+                out.append(ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=_name(_RET_FLAG)),
+                    body=self._guard_block(list(rest)), orelse=[]))
+                break
+        return out or [ast.Pass()]
+
+    # -- conditionals ---------------------------------------------------------
+
+    def visit_If(self, node: ast.If):
+        node = self.generic_visit(node)
+        uid = self._next()
+        out_vars = _stored_names(node.body + node.orelse)
+        if not out_vars:
+            out_vars = []
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=v) for v in out_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        ret = ast.Return(value=_tuple([_name(v) for v in out_vars]))
+        true_name, false_name = f"__jst_true_{uid}", f"__jst_false_{uid}"
+        true_fn = ast.FunctionDef(
+            name=true_name, args=args,
+            body=list(node.body) + [ret], decorator_list=[], returns=None)
+        false_fn = ast.FunctionDef(
+            name=false_name, args=args,
+            body=(list(node.orelse) or [ast.Pass()]) + [
+                ast.Return(value=_tuple([_name(v) for v in out_vars]))],
+            decorator_list=[], returns=None)
+        caps = []
+        cap_names = []
+        for v in out_vars:
+            tmp = f"__jst_cap_{uid}_{v}"
+            caps.append(_capture(v, tmp))
+            cap_names.append(tmp)
+        call = _call("convert_ifelse", [
+            node.test, _name(true_name), _name(false_name),
+            _tuple([_name(c) for c in cap_names]),
+            _tuple([ast.Constant(value=v) for v in out_vars])])
+        assign = ast.Assign(
+            targets=[_tuple([_name(v, ast.Store()) for v in out_vars],
+                            ast.Store())],
+            value=call) if out_vars else ast.Expr(value=call)
+        return caps + [true_fn, false_fn, assign]
+
+    def visit_IfExp(self, node: ast.IfExp):
+        node = self.generic_visit(node)
+        return _call("convert_ifexp",
+                     [node.test, _thunk(node.body), _thunk(node.orelse)])
+
+    # -- loops ----------------------------------------------------------------
+
+    def _loop_convertible(self, node) -> bool:
+        blockers = (ast.Break, ast.Continue, ast.Return)
+        return not (_contains(list(node.body), blockers,
+                              stop_at_loops=True) or node.orelse)
+
+    def visit_While(self, node: ast.While):
+        node = self.generic_visit(node)
+        if not self._loop_convertible(node):
+            return node  # python-level loop; traced cond -> graph break
+        uid = self._next()
+        loop_vars = _stored_names(node.body)
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cond_name, body_name = f"__jst_cond_{uid}", f"__jst_body_{uid}"
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=list(node.body) + [
+                ast.Return(value=_tuple([_name(v) for v in loop_vars]))],
+            decorator_list=[], returns=None)
+        caps, cap_names = [], []
+        for v in loop_vars:
+            tmp = f"__jst_cap_{uid}_{v}"
+            caps.append(_capture(v, tmp))
+            cap_names.append(tmp)
+        call = _call("convert_while", [
+            _name(cond_name), _name(body_name),
+            _tuple([_name(c) for c in cap_names])])
+        assign = ast.Assign(
+            targets=[_tuple([_name(v, ast.Store()) for v in loop_vars],
+                            ast.Store())],
+            value=call) if loop_vars else ast.Expr(value=call)
+        return caps + [cond_fn, body_fn, assign]
+
+    def visit_For(self, node: ast.For):
+        # only `for <name> in range(...)` lowers; other iterables stay
+        # python (concrete containers / static shapes trace fine unrolled)
+        if (not isinstance(node.target, ast.Name)
+                or node.orelse
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not self._loop_convertible(node)):
+            return self.generic_visit(node)
+        uid = self._next()
+        i = node.target.id
+        start, stop, step = (f"__jst_start_{uid}", f"__jst_stop_{uid}",
+                             f"__jst_step_{uid}")
+        norm = ast.Assign(
+            targets=[_tuple([_name(start, ast.Store()),
+                             _name(stop, ast.Store()),
+                             _name(step, ast.Store())], ast.Store())],
+            value=_call("range_args", list(node.iter.args)))
+        init = ast.Assign(targets=[_name(i, ast.Store())],
+                          value=_name(start))
+        while_node = ast.While(
+            test=_call("range_cond", [_name(i), _name(stop), _name(step)]),
+            body=list(node.body) + [
+                ast.Assign(targets=[_name(i, ast.Store())],
+                           value=ast.BinOp(left=_name(i), op=ast.Add(),
+                                           right=_name(step)))],
+            orelse=[])
+        rewritten = self.visit_While(while_node)
+        rewritten = rewritten if isinstance(rewritten, list) else [rewritten]
+        return [norm, init] + rewritten
+
+    # -- expressions ----------------------------------------------------------
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        node = self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        return _call(fn, [_thunk(v) for v in node.values])
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_Assert(self, node: ast.Assert):
+        node = self.generic_visit(node)
+        args = [_thunk(node.test)]
+        if node.msg is not None:
+            args.append(node.msg)
+        return ast.Expr(value=_call("convert_assert", args))
+
+    def visit_Call(self, node: ast.Call):
+        node = self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "super", "range", "print", "isinstance", "len", "locals",
+                "globals", "type"):
+            if node.func.id == "print":
+                node.func = _attr("convert_print")
+            return node
+        node.func = _call("convert_call", [node.func])
+        return node
+
+
+# -- entry point --------------------------------------------------------------
+
+_transform_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_FAILED = object()
+
+
+def transform_function(fn):
+    """AST-convert `fn` (plain function or bound method -> same kind).
+
+    The transformed function is compiled in a namespace of fn's globals +
+    the `_jst` converter module + fn's closure freevars dereferenced at
+    transform time (a freevar whose cell is reassigned later will be stale
+    — rebind or pass it as an argument). Results are cached per function
+    object; failures raise TransformError and are cached too.
+    """
+    if inspect.ismethod(fn):
+        g = transform_function(fn.__func__)
+        return g.__get__(fn.__self__, type(fn.__self__))
+    if not inspect.isfunction(fn):
+        raise TransformError(f"not a python function: {fn!r}")
+    if "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() needs the compiler-provided __class__ cell,
+        # which recompiled module-level code cannot reproduce
+        raise TransformError("uses zero-arg super()")
+
+    cached = _transform_cache.get(fn)
+    if cached is _FAILED:
+        raise TransformError("previously failed")
+    if cached is not None:
+        return cached
+    try:
+        out = _transform_uncached(fn)
+    except TransformError:
+        _transform_cache[fn] = _FAILED
+        raise
+    _transform_cache[fn] = out
+    return out
+
+
+def _transform_uncached(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise TransformError(f"source unavailable: {e}") from e
+    try:
+        tree = ast.parse(src)
+    except (SyntaxError, IndentationError) as e:
+        raise TransformError(f"unparsable source: {e}") from e
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        raise TransformError("not a plain def")
+    fdef = tree.body[0]
+    fdef.decorator_list = []  # avoid re-running to_static and friends
+    new_tree = ast.Module(body=[_Dy2Static().visit(fdef)], type_ignores=[])
+    ast.fix_missing_locations(new_tree)
+
+    namespace = dict(fn.__globals__)
+    namespace[_JST] = _jst_mod
+    if fn.__code__.co_freevars and fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                namespace[name] = cell.cell_contents
+            except ValueError:
+                pass  # empty cell (e.g. recursive def): leave unbound
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, namespace)
+    out = namespace[fdef.name]
+    out = types.FunctionType(out.__code__, namespace, fn.__name__,
+                             fn.__defaults__, out.__closure__)
+    out.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(out, fn)
+    out.__dy2static_source__ = ast.unparse(new_tree)
+    return out

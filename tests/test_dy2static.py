@@ -1,0 +1,297 @@
+"""dy2static: data-dependent control flow under to_static.
+
+Reference behavior modeled: python/paddle/jit/sot/translate.py:31 (capture
+with guards + graph breaks) and python/paddle/jit/dy2static/
+convert_operators.py (if/while/logical conversion). Each test checks BOTH
+numerics (static == eager) and the capture property itself (single cache
+entry across branch outcomes = genuinely compiled control flow; recorded
+graph_breaks = genuine fallback).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.api import StaticFunction, to_static
+from paddle_tpu.jit.dy2static import transform_function, TransformError
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype=dtype))
+
+
+def static_of(fn):
+    sf = to_static(fn)
+    assert isinstance(sf, StaticFunction)
+    return sf
+
+
+# -- conditionals -------------------------------------------------------------
+
+def test_if_on_traced_pred_compiles_once_and_matches():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = static_of(f)
+    pos, neg = T([1.0, 2.0]), T([-3.0, -4.0])
+    np.testing.assert_allclose(sf(pos).numpy(), f(pos).numpy())
+    np.testing.assert_allclose(sf(neg).numpy(), f(neg).numpy())
+    # both branch outcomes served by ONE compiled program: the conditional
+    # is inside the graph, not a retrace per branch
+    assert len(sf.concrete_programs) == 1
+    assert sf.graph_breaks == []
+
+
+def test_if_without_else_keeps_prior_binding():
+    def f(x, flag):
+        y = x + 1.0
+        if flag.sum() > 0:
+            y = y * 10.0
+        return y
+
+    sf = static_of(f)
+    x = T([1.0, 2.0])
+    np.testing.assert_allclose(sf(x, T([1.0])).numpy(), [20.0, 30.0])
+    np.testing.assert_allclose(sf(x, T([-1.0])).numpy(), [2.0, 3.0])
+    assert len(sf.concrete_programs) == 1
+
+
+def test_nested_if_and_ifexp():
+    def f(x):
+        s = x.sum()
+        if s > 0:
+            if s > 10:
+                y = x * 100.0
+            else:
+                y = x * 2.0
+        else:
+            y = -x
+        z = y + (x if s > 0 else x * 0.0)
+        return z
+
+    sf = static_of(f)
+    for data in ([20.0], [1.0], [-1.0]):
+        x = T(data)
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(),
+                                   rtol=1e-6)
+    assert len(sf.concrete_programs) == 1
+    assert sf.graph_breaks == []
+
+
+def test_early_return_in_traced_branches():
+    def f(x):
+        if x.sum() > 0:
+            return x * 3.0
+        return x - 5.0
+
+    sf = static_of(f)
+    np.testing.assert_allclose(sf(T([2.0])).numpy(), [6.0])
+    np.testing.assert_allclose(sf(T([-2.0])).numpy(), [-7.0])
+    assert len(sf.concrete_programs) == 1
+    assert sf.graph_breaks == []
+
+
+def test_python_pred_stays_python():
+    # concrete predicate: branch chosen at trace time, one entry per
+    # python-value guard (the non-tensor arg is part of the signature)
+    def f(x, mode):
+        if mode == "double":
+            return x * 2.0
+        return x + 1.0
+
+    sf = static_of(f)
+    x = T([1.0])
+    np.testing.assert_allclose(sf(x, "double").numpy(), [2.0])
+    np.testing.assert_allclose(sf(x, "add").numpy(), [2.0])
+    assert len(sf.concrete_programs) == 2  # guard on the python const
+
+
+# -- loops --------------------------------------------------------------------
+
+def test_while_with_traced_condition():
+    def f(x):
+        # data-dependent trip count: double until the sum crosses 100
+        while x.sum() < 100.0:
+            x = x * 2.0
+        return x
+
+    sf = static_of(f)
+    for v in (1.0, 3.0, 200.0):
+        x = T([v])
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
+    assert len(sf.concrete_programs) == 1
+    assert sf.graph_breaks == []
+
+
+def test_for_range_concrete_and_traced_bound():
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x * float(1.0) + i * 0.0
+        return acc
+
+    sf = static_of(f)
+    x = T([1.0, 2.0])
+    np.testing.assert_allclose(sf(x, 3).numpy(), [3.0, 6.0])
+
+    def g(x):
+        # trip count from DATA: n = round(sum) -> lax.while_loop
+        n = x.sum().astype("int32")
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    sg = static_of(g)
+    np.testing.assert_allclose(sg(T([1.0, 2.0])).numpy(), [3.0, 6.0])
+    np.testing.assert_allclose(sg(T([1.0, 1.0])).numpy(), [2.0, 2.0])
+    assert len(sg.concrete_programs) == 1
+    assert sg.graph_breaks == []
+
+
+def test_logical_ops_on_traced_values():
+    def f(x):
+        s = x.sum()
+        if (s > 0) and (s < 10) and not (s == 5):
+            return x * 1.0
+        return x * -1.0
+
+    sf = static_of(f)
+    for v in (2.0, 5.0, 20.0, -3.0):
+        x = T([v])
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
+    assert len(sf.concrete_programs) == 1
+
+
+# -- convert_call recursion ---------------------------------------------------
+
+def _helper_with_branch(x):
+    if x.sum() > 0:
+        return x * 7.0
+    return x / 2.0
+
+
+def test_convert_call_recurses_into_user_helpers():
+    def f(x):
+        return _helper_with_branch(x) + 1.0
+
+    sf = static_of(f)
+    np.testing.assert_allclose(sf(T([1.0])).numpy(), [8.0])
+    np.testing.assert_allclose(sf(T([-4.0])).numpy(), [-1.0])
+    assert len(sf.concrete_programs) == 1
+    assert sf.graph_breaks == []
+
+
+# -- graph breaks -------------------------------------------------------------
+
+def test_graph_break_falls_back_to_eager():
+    def f(x):
+        n = int(x.sum())  # concretization: cannot stay in the graph
+        out = x
+        for _ in range(n):
+            out = out + 1.0
+        return out
+
+    sf = static_of(f)
+    np.testing.assert_allclose(sf(T([2.0])).numpy(), [4.0])
+    assert len(sf.graph_breaks) == 1
+    _, reason = sf.graph_breaks[0]
+    assert "Concretization" in reason or "Tracer" in reason
+    # fallback decision is cached: same signature keeps working eagerly
+    np.testing.assert_allclose(sf(T([3.0])).numpy(), [6.0])
+    assert len(sf.graph_breaks) == 1
+
+
+def test_graph_break_preserves_autograd():
+    def f(x):
+        n = int((x * 0).sum()) + 2  # forces the eager fallback
+        y = x
+        for _ in range(n):
+            y = y * x
+        return y.sum()
+
+    sf = static_of(f)
+    x = T([3.0])
+    x.stop_gradient = False
+    loss = sf(x)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [27.0])  # d(x^3)/dx = 3x^2
+    assert len(sf.graph_breaks) == 1
+
+
+# -- gradients through converted control flow ---------------------------------
+
+def test_grad_through_traced_conditional():
+    def f(x):
+        if x.sum() > 0:
+            y = x * x
+        else:
+            y = x * 3.0
+        return y.sum()
+
+    sf = static_of(f)
+    x = T([2.0])
+    x.stop_gradient = False
+    sf(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # took the x^2 branch
+
+    x2 = T([-2.0])
+    x2.stop_gradient = False
+    sf(x2).backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [3.0])  # the *3 branch
+    assert len(sf.concrete_programs) == 1
+
+
+# -- transformer unit behavior ------------------------------------------------
+
+def test_transform_rejects_out_of_scope_constructs():
+    def uses_global(x):
+        global np
+        return x
+
+    def loop_return(x):
+        for i in range(3):
+            if i == 2:
+                return x
+        return x * 2
+
+    for fn in (uses_global, loop_return):
+        with pytest.raises(TransformError):
+            transform_function(fn)
+
+
+def test_transform_preserves_defaults_and_wrapping():
+    def f(x, scale=2.0):
+        if x.sum() > 0:
+            return x * scale
+        return x
+
+    g = transform_function(f)
+    assert g.__name__ == "f"
+    assert g.__defaults__ == (2.0,)
+    x = T([1.0])
+    np.testing.assert_allclose(g(x).numpy(), [2.0])
+
+
+def test_layer_forward_with_control_flow():
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.mean() > 0:
+                return h * 2.0
+            return h * 0.5
+
+    m = Gate()
+    sf = to_static(m.forward)
+    x = T(np.random.RandomState(0).randn(2, 4))
+    got = sf(x)
+    eager = Gate.forward(m, x)  # raw python forward
+    np.testing.assert_allclose(got.numpy(), eager.numpy(), rtol=1e-5)
+    assert len(sf.concrete_programs) == 1
