@@ -231,3 +231,9 @@ def quanter(name):  # decorator registry parity
     def deco(cls):
         return cls
     return deco
+
+
+from .observers import (AVGObserver, AbsMaxChannelWiseWeightObserver,  # noqa: E402
+                        BaseObserver, HistObserver, MSEObserver,
+                        PercentileObserver)
+from .int8 import Int8Conv2D, Int8Linear, convert_to_int8  # noqa: E402

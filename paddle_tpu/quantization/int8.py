@@ -1,0 +1,179 @@
+"""True int8 deployment: quantised weights + XLA int8 arithmetic.
+
+Reference: the static PTQ deploy path (python/paddle/quantization/
+quantize.py + paddle/fluid/contrib int8 passes) where calibrated models are
+rewritten with int8 weights and quantized kernels. TPU-native: the MXU
+multiplies int8 at double rate, and XLA reaches it through a plain
+`dot_general` with int8 operands and `preferred_element_type=int32` — no
+custom kernels needed. So conversion here is a layer swap:
+
+* ``Int8Linear`` — weights stored int8 (per-output-channel scales), the
+  activation statically quantised with the calibrated scale, int8×int8→
+  int32 matmul, one fused rescale, fp bias add.
+* ``Int8Conv2D`` — weight-only int8 (stored int8 + per-channel scales,
+  dequantised into the conv): conv arithmetic stays fp, memory/bandwidth
+  drops 4x. (Full int8 conv needs a quantised im2col layout decision XLA
+  makes differently per backend; weight-only is the robust cross-backend
+  win.)
+
+Layers are inference-only: outputs carry stop_gradient=True, and the int8
+buffers live in state_dict so `jit.save`/Predictor export them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn import functional as F
+
+QMAX = 127.0
+
+
+def _quantize_weight(w: np.ndarray, axis: Optional[int]):
+    """w (float) -> (w_q int8, scale float32 per-channel along `axis`
+    or scalar when axis is None)."""
+    if axis is None:
+        s = np.maximum(np.max(np.abs(w)), 1e-8).astype(np.float32)
+        wq = np.clip(np.round(w / s * QMAX), -QMAX, QMAX).astype(np.int8)
+        return wq, np.float32(s)
+    red = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    s = np.maximum(np.max(np.abs(w), axis=red), 1e-8).astype(np.float32)
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = -1
+    wq = np.clip(np.round(w / s.reshape(shape) * QMAX), -QMAX,
+                 QMAX).astype(np.int8)
+    return wq, s
+
+
+class Int8Linear(Layer):
+    """Deployed linear: int8 weight [in, out], per-out-channel scales,
+    statically quantised activation, int32-accumulated MXU matmul."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 act_scale: float, per_channel: bool = True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.per_channel = per_channel
+        self.register_buffer("weight_int8", Tensor(
+            np.zeros((in_features, out_features), np.int8)))
+        self.register_buffer("weight_scale", Tensor(
+            np.ones((out_features,) if per_channel else (), np.float32)))
+        self.register_buffer("act_scale", Tensor(
+            np.asarray(act_scale, np.float32)))
+        self.bias = None  # replaced at convert time if the source had one
+
+    @classmethod
+    def from_float(cls, lin, act_scale: float, per_channel: bool = True):
+        w = np.asarray(lin.weight._data, np.float32)
+        m = cls(w.shape[0], w.shape[1], act_scale, per_channel)
+        wq, s = _quantize_weight(w, 1 if per_channel else None)
+        m.weight_int8._data = jnp.asarray(wq)
+        m.weight_scale._data = jnp.asarray(s)
+        if lin.bias is not None:
+            m.register_buffer("bias_fp", Tensor(
+                np.asarray(lin.bias._data, np.float32)))
+            m.bias = m.bias_fp
+        return m
+
+    def forward(self, x):
+        xd = x._data
+        s_x = self.act_scale._data
+        xq = jnp.clip(jnp.round(xd / s_x * QMAX), -QMAX, QMAX).astype(
+            jnp.int8)
+        acc = jnp.matmul(xq, self.weight_int8._data,
+                         preferred_element_type=jnp.int32)
+        scale = (s_x * self.weight_scale._data) / (QMAX * QMAX)
+        y = acc.astype(jnp.float32) * scale
+        if self.bias is not None:
+            y = y + self.bias._data
+        out = Tensor._from_data(y.astype(xd.dtype))
+        out.stop_gradient = True
+        return out
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8, "
+                f"per_channel={self.per_channel}")
+
+
+class Int8Conv2D(Layer):
+    """Weight-only int8 conv: int8 storage + per-out-channel scales,
+    dequantised into a standard conv (XLA fuses the dequant multiply
+    into the convolution's filter read)."""
+
+    def __init__(self, src, per_channel: bool = True):
+        super().__init__()
+        self.per_channel = per_channel
+        self._stride = src._stride
+        self._padding = src._padding
+        self._dilation = src._dilation
+        self._groups = src._groups
+        self._data_format = src._data_format
+        w = np.asarray(src.weight._data, np.float32)
+        wq, s = _quantize_weight(w, 0 if per_channel else None)
+        self.register_buffer("weight_int8", Tensor(wq))
+        self.register_buffer("weight_scale", Tensor(np.asarray(s)))
+        if src.bias is not None:
+            self.register_buffer("bias_fp", Tensor(
+                np.asarray(src.bias._data, np.float32)))
+            self.bias = self.bias_fp
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        wq = self.weight_int8._data.astype(jnp.float32)
+        s = self.weight_scale._data
+        if self.per_channel:
+            s = s.reshape((-1,) + (1,) * (wq.ndim - 1))
+        w = Tensor._from_data((wq * (s / QMAX)).astype(x._data.dtype))
+        out = F.conv2d(x, w, self.bias, self._stride, self._padding,
+                       self._dilation, self._groups, self._data_format)
+        out.stop_gradient = True
+        return out
+
+
+def convert_to_int8(model: Layer, per_channel: bool = True) -> Layer:
+    """Swap calibrated QuantedLayer wrappers for int8 deploy layers.
+
+    Weight scales are recomputed from the weights themselves (per-channel
+    absmax — weights need no calibration data); ACTIVATION scales come
+    from the PTQ observers, so `PTQ.quantize` + calibration batches must
+    have run. A linear without an observed act scale raises; a conv is
+    weight-only and converts regardless.
+    """
+    from . import QuantedLayer
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    def swap(m: Layer):
+        for name, child in list(m._sub_layers.items()):
+            if isinstance(child, QuantedLayer):
+                inner = child._inner
+                if isinstance(inner, Linear):
+                    act_q = child.act_quanter
+                    s = float(np.asarray(act_q.scales()._data)) \
+                        if act_q is not None else 0.0
+                    if s <= 0.0:
+                        raise RuntimeError(
+                            f"layer {name!r}: no activation scale observed; "
+                            f"run calibration batches through the PTQ-"
+                            f"quantized model before convert_to_int8")
+                    m._sub_layers[name] = Int8Linear.from_float(
+                        inner, s, per_channel)
+                elif isinstance(inner, Conv2D):
+                    m._sub_layers[name] = Int8Conv2D(inner, per_channel)
+                else:
+                    swap(child)
+            else:
+                swap(child)
+        return m
+
+    out = swap(model)
+    out.eval()
+    return out

@@ -1,0 +1,209 @@
+"""PTQ calibration observers beyond plain absmax.
+
+Reference: python/paddle/quantization/observers/ — abs_max.py, avg.py,
+hist.py, kl.py, mse.py plus the channel-wise weight observer in
+quanters/channel_wise_abs_max.py. Each observer watches activations (or a
+weight) during eager calibration batches and produces a scale; under a
+trace it is a pass-through with whatever it has observed so far, so a
+converted model exports cleanly.
+
+TPU note: observers run on HOST during calibration (tiny reductions, a few
+batches), so numpy histograms are fine; only the resulting SCALE enters the
+compiled int8 graph.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+class BaseObserver(Layer):
+    """Shared machinery: collect per-batch stats eagerly, expose scales()."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.bit_length = quant_bits
+        self.register_buffer("_scale", Tensor(np.zeros((), np.float32)))
+
+    def _observe(self, arr: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def _finalize(self) -> Optional[float]:
+        """Optional deferred scale computation (hist/KL-style observers)."""
+        return None
+
+    def forward(self, x):
+        if not isinstance(x._data, jax.core.Tracer):
+            self._observe(np.asarray(x._data, dtype=np.float32))
+        return x
+
+    def scales(self):
+        fin = self._finalize()
+        if fin is not None:
+            self._scale._data = jnp.asarray(np.float32(fin))
+        return self._scale
+
+    def quant_axis(self):
+        return -1  # per-tensor
+
+
+class AVGObserver(BaseObserver):
+    """Scale = mean of per-batch absmax (reference: observers/avg.py)."""
+
+    def __init__(self, quant_bits: int = 8, **kw):
+        super().__init__(quant_bits)
+        self._sum = 0.0
+        self._n = 0
+
+    def _observe(self, arr):
+        self._sum += float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._n += 1
+
+    def _finalize(self):
+        return self._sum / self._n if self._n else None
+
+
+class PercentileObserver(BaseObserver):
+    """Scale = percentile of |x| over all calibration data (reference:
+    hist observer's percentile mode, observers/hist.py)."""
+
+    def __init__(self, quant_bits: int = 8, percentile: float = 99.99, **kw):
+        super().__init__(quant_bits)
+        self.percentile = percentile
+        self._samples = []
+
+    def _observe(self, arr):
+        a = np.abs(arr).ravel()
+        if a.size > 4096:  # bounded memory: per-batch subsample
+            a = np.partition(a, a.size - 4096)[-4096:]
+        self._samples.append(a)
+
+    def _finalize(self):
+        if not self._samples:
+            return None
+        allv = np.concatenate(self._samples)
+        return float(np.percentile(allv, self.percentile))
+
+
+class HistObserver(BaseObserver):
+    """Histogram observer (reference: observers/hist.py): accumulate an
+    |x| histogram across batches, pick the scale covering `percent` of
+    mass. The histogram range grows by rebinning when a batch exceeds it."""
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048,
+                 percent: float = 0.999, **kw):
+        super().__init__(quant_bits)
+        self.bins_count = bins_count
+        self.percent = percent
+        self._hist = None
+        self._hi = None
+
+    def _observe(self, arr):
+        a = np.abs(arr).ravel()
+        if a.size == 0:
+            return
+        mx = float(a.max())
+        if self._hist is None:
+            self._hi = max(mx, 1e-8)
+            self._hist, _ = np.histogram(a, bins=self.bins_count,
+                                         range=(0.0, self._hi))
+            return
+        if mx > self._hi:
+            # rebin the old histogram into the wider range (factor-of-2
+            # growth keeps old bin edges aligned with new ones)
+            new_hi = self._hi
+            while new_hi < mx:
+                new_hi *= 2.0
+            factor = int(round(new_hi / self._hi))
+            old = self._hist.astype(np.float64)
+            grouped = old.reshape(self.bins_count // factor, factor).sum(1) \
+                if self.bins_count % factor == 0 else None
+            fresh = np.zeros(self.bins_count, np.float64)
+            if grouped is not None:
+                fresh[: grouped.size] = grouped
+            else:  # non-divisible: linear redistribution
+                idx = (np.arange(self.bins_count) / factor).astype(int)
+                np.add.at(fresh, idx, old)
+            self._hist = fresh
+            self._hi = new_hi
+        h, _ = np.histogram(a, bins=self.bins_count, range=(0.0, self._hi))
+        self._hist = self._hist + h
+
+    def _finalize(self):
+        if self._hist is None:
+            return None
+        cdf = np.cumsum(self._hist)
+        total = cdf[-1]
+        if total == 0:
+            return None
+        k = int(np.searchsorted(cdf, self.percent * total))
+        k = min(k, self.bins_count - 1)
+        return (k + 0.5) * self._hi / self.bins_count
+
+
+class MSEObserver(BaseObserver):
+    """Scale minimising quantisation MSE over a shrink grid (reference:
+    observers/mse.py)."""
+
+    def __init__(self, quant_bits: int = 8, steps: int = 64, **kw):
+        super().__init__(quant_bits)
+        self.steps = steps
+        self._samples = []
+
+    def _observe(self, arr):
+        a = arr.ravel()
+        if a.size > 8192:
+            a = np.random.RandomState(0).choice(a, 8192, replace=False)
+        self._samples.append(a)
+
+    def _finalize(self):
+        if not self._samples:
+            return None
+        x = np.concatenate(self._samples)
+        absmax = float(np.max(np.abs(x)))
+        if absmax == 0.0:
+            return None
+        qmax = 2 ** (self.bit_length - 1) - 1
+        best_s, best_mse = absmax, np.inf
+        for i in range(1, self.steps + 1):
+            s = absmax * i / self.steps
+            q = np.clip(np.round(x / s * qmax), -qmax, qmax) * (s / qmax)
+            mse = float(np.mean((x - q) ** 2))
+            if mse < best_mse:
+                best_mse, best_s = mse, s
+        return best_s
+
+
+class AbsMaxChannelWiseWeightObserver(BaseObserver):
+    """Per-channel |w|max for WEIGHTS (reference:
+    quanters/channel_wise_abs_max.py). `quant_axis` is the output-channel
+    axis of the weight layout: 1 for Linear [in, out], 0 for Conv
+    [out, in, kh, kw]."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 1, **kw):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._absmax = None
+
+    def _observe(self, arr):
+        axes = tuple(i for i in range(arr.ndim) if i != self._axis % arr.ndim)
+        cur = np.max(np.abs(arr), axis=axes)
+        self._absmax = cur if self._absmax is None else np.maximum(
+            self._absmax, cur)
+
+    def _finalize(self):
+        return None  # scales() below returns the vector directly
+
+    def scales(self):
+        if self._absmax is not None:
+            self._scale._data = jnp.asarray(self._absmax.astype(np.float32))
+        return self._scale
+
+    def quant_axis(self):
+        return self._axis

@@ -1,0 +1,173 @@
+"""PTQ pipeline + int8 deployment (VERDICT r2 Missing #9).
+
+Reference behavior: python/paddle/quantization/ptq.py (observer
+calibration) + the static int8 deploy passes. Tests check the full flow —
+instrument, calibrate, convert — and the int8 numerics/types themselves.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (AVGObserver, AbsmaxObserver,
+                                     AbsMaxChannelWiseWeightObserver,
+                                     HistObserver, Int8Conv2D, Int8Linear,
+                                     MSEObserver, PercentileObserver, PTQ,
+                                     QuantConfig, convert_to_int8)
+from paddle_tpu.quantization.int8 import _quantize_weight
+
+RS = np.random.RandomState(0)
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class ConvNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, padding=1)
+        self.fc = nn.Linear(8 * 4 * 4, 10)
+
+    def forward(self, x):
+        h = self.conv(x)
+        return self.fc(h.reshape([h.shape[0], -1]))
+
+
+# -- observers ----------------------------------------------------------------
+
+def _drive(obs, batches):
+    for b in batches:
+        obs(T(b))
+    return float(np.asarray(obs.scales()._data))
+
+
+def test_observer_scales_are_sane():
+    data = [RS.randn(64).astype(np.float32) for _ in range(8)]
+    absmax = max(float(np.max(np.abs(b))) for b in data)
+    s_avg = _drive(AVGObserver(), data)
+    s_pct = _drive(PercentileObserver(percentile=99.0), data)
+    s_hist = _drive(HistObserver(bins_count=512, percent=0.999), data)
+    s_mse = _drive(MSEObserver(steps=32), data)
+    for s in (s_avg, s_pct, s_hist, s_mse):
+        assert 0.0 < s <= absmax * 1.01
+    # percentile/hist clip tails: strictly below the hard max for gaussians
+    assert s_pct < absmax
+    # avg-of-batch-maxima sits below the global max
+    assert s_avg < absmax
+
+
+def test_hist_observer_range_growth():
+    obs = HistObserver(bins_count=512, percent=1.0)
+    obs(T(np.ones(32) * 0.5))
+    obs(T(np.ones(32) * 7.0))  # exceeds initial range -> rebin
+    s = float(np.asarray(obs.scales()._data))
+    assert 6.5 < s <= 8.1
+
+
+def test_channelwise_weight_observer():
+    obs = AbsMaxChannelWiseWeightObserver(quant_axis=1)
+    w = RS.randn(16, 4).astype(np.float32)
+    w[:, 2] *= 10.0
+    obs(T(w))
+    s = np.asarray(obs.scales()._data)
+    assert s.shape == (4,)
+    np.testing.assert_allclose(s, np.max(np.abs(w), axis=0), rtol=1e-6)
+
+
+# -- weight quantization ------------------------------------------------------
+
+def test_quantize_weight_roundtrip_error_bounded():
+    w = RS.randn(16, 8).astype(np.float32)
+    wq, s = _quantize_weight(w, axis=1)
+    assert wq.dtype == np.int8 and s.shape == (8,)
+    deq = wq.astype(np.float32) * (s / 127.0)
+    assert float(np.max(np.abs(deq - w))) <= float(np.max(s / 127.0)) + 1e-6
+    # per-channel beats per-tensor when channel ranges differ
+    w2 = w.copy()
+    w2[:, 0] *= 50.0
+    wq_pc, s_pc = _quantize_weight(w2, axis=1)
+    wq_pt, s_pt = _quantize_weight(w2, axis=None)
+    err_pc = np.mean((wq_pc.astype(np.float32) * (s_pc / 127.0) - w2) ** 2)
+    err_pt = np.mean((wq_pt.astype(np.float32) * (s_pt / 127.0) - w2) ** 2)
+    assert err_pc < err_pt
+
+
+# -- the full PTQ -> int8 pipeline --------------------------------------------
+
+def _calibrated_int8_mlp():
+    model = MLP()
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                          weight=AbsmaxObserver()))
+    q = ptq.quantize(model)
+    calib = [RS.randn(8, 16).astype(np.float32) for _ in range(4)]
+    for b in calib:
+        q(T(b))
+    return convert_to_int8(q), model, calib
+
+
+def test_ptq_convert_to_int8_types_and_accuracy():
+    int8_model, float_model, calib = _calibrated_int8_mlp()
+    assert isinstance(int8_model.fc1, Int8Linear)
+    assert isinstance(int8_model.fc2, Int8Linear)
+    assert np.asarray(int8_model.fc1.weight_int8._data).dtype == np.int8
+    assert np.asarray(int8_model.fc1.weight_scale._data).shape == (32,)
+
+    x = T(RS.randn(8, 16).astype(np.float32))
+    y_fp = float_model(x).numpy()
+    y_q = int8_model(x).numpy()
+    # int8 path tracks fp32 within quantization noise
+    rel = np.linalg.norm(y_q - y_fp) / (np.linalg.norm(y_fp) + 1e-8)
+    assert rel < 0.1, f"int8 deviates {rel:.3f} from fp32"
+
+
+def test_int8_requires_calibration():
+    model = MLP()
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                          weight=AbsmaxObserver()))
+    q = ptq.quantize(model)
+    with pytest.raises(RuntimeError, match="calibration"):
+        convert_to_int8(q)
+
+
+def test_int8_model_traces_and_state_dict():
+    int8_model, _, _ = _calibrated_int8_mlp()
+    from paddle_tpu.jit import to_static
+
+    sf = to_static(int8_model.forward)
+    x = T(RS.randn(4, 16).astype(np.float32))
+    got = sf(x)
+    np.testing.assert_allclose(got.numpy(), int8_model(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert sf.graph_breaks == []  # int8 matmul compiles
+    sd = int8_model.state_dict()
+    assert any(np.asarray(v._data).dtype == np.int8 for v in sd.values())
+
+
+def test_conv_weight_only_int8():
+    net = ConvNet()
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                          weight=AbsmaxObserver()))
+    q = ptq.quantize(net)
+    calib = [RS.randn(2, 3, 4, 4).astype(np.float32) for _ in range(3)]
+    for b in calib:
+        q(T(b))
+    int8_net = convert_to_int8(q)
+    assert isinstance(int8_net.conv, Int8Conv2D)
+    assert np.asarray(int8_net.conv.weight_int8._data).dtype == np.int8
+    x = T(RS.randn(2, 3, 4, 4).astype(np.float32))
+    y_fp = net(x).numpy()
+    y_q = int8_net(x).numpy()
+    rel = np.linalg.norm(y_q - y_fp) / (np.linalg.norm(y_fp) + 1e-8)
+    assert rel < 0.1
