@@ -14,3 +14,12 @@ from .api import (  # noqa: F401
     shard_tensor,
     unshard_dtensor,
 )
+from .engine import (  # noqa: F401
+    Cluster,
+    CostModel,
+    Engine,
+    Planner,
+    PlanItem,
+    StepCost,
+    Strategy,
+)
