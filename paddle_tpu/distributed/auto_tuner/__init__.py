@@ -1,0 +1,19 @@
+"""distributed.auto_tuner parity — search the hybrid-parallel config space.
+
+Reference: python/paddle/distributed/auto_tuner/{tuner.py:21,prune.py,
+recorder.py} — AutoTuner.search_once() yields candidate configs from a
+registered prune chain; each is launched as a short trial job; a Recorder
+sorts history and reports the best.
+
+TPU-native: candidates come from the auto-parallel Planner's mesh
+factorizations crossed with micro-batch/sharding/remat axes; the
+CostModel pre-prunes (memory fit + analytic time bound) before any trial
+spends chip seconds; trials time a user-supplied step runner at each
+surviving config. A GSPMD trial is just re-jitting with different
+shardings — no process relaunch, so tuning is minutes, not hours.
+"""
+from .tuner import AutoTuner, TrialResult
+from .recorder import Recorder
+from . import prune
+
+__all__ = ["AutoTuner", "TrialResult", "Recorder", "prune"]

@@ -1,0 +1,76 @@
+"""Pruning rules over candidate configs.
+
+Reference: auto_tuner/prune.py — a registry of `prune_by_*` predicates
+(mp degree, pp degree, micro-batch divisibility, sharding stage, memory
+model) applied before a candidate is trialled. Same shape here: each rule
+takes (ctx, cfg) and returns a reason string to prune or None to keep;
+`register_prune` adds custom rules.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+_PRUNE_FUNCS: List[Callable] = []
+
+
+def register_prune(fn: Callable) -> Callable:
+    """Reference: prune.py:112 register_prune."""
+    _PRUNE_FUNCS.append(fn)
+    return fn
+
+
+def apply_all(ctx, cfg) -> Optional[str]:
+    for fn in _PRUNE_FUNCS:
+        reason = fn(ctx, cfg)
+        if reason:
+            return f"{fn.__name__}: {reason}"
+    return None
+
+
+@register_prune
+def prune_by_degree(ctx, cfg):
+    """dp*tp*pp must cover the cluster (reference prune_by_mp/_pp)."""
+    if cfg.plan.degree != ctx.cluster.n_devices:
+        return (f"degree {cfg.plan.degree} != cluster "
+                f"{ctx.cluster.n_devices}")
+    if ctx.max_tp and cfg.plan.tp > ctx.max_tp:
+        return f"tp {cfg.plan.tp} > limit {ctx.max_tp}"
+    if ctx.max_pp and cfg.plan.pp > ctx.max_pp:
+        return f"pp {cfg.plan.pp} > limit {ctx.max_pp}"
+    return None
+
+
+@register_prune
+def prune_by_mbs(ctx, cfg):
+    """Global batch must split evenly into dp x micro_batches
+    (reference prune.py:307 prune_by_mbs)."""
+    gb = ctx.global_batch
+    if gb and gb % (cfg.plan.dp * cfg.plan.micro_batches) != 0:
+        return (f"global batch {gb} not divisible by dp*mbs "
+                f"{cfg.plan.dp}x{cfg.plan.micro_batches}")
+    if cfg.plan.pp > 1 and cfg.plan.micro_batches < cfg.plan.pp:
+        return "fewer microbatches than pipeline stages"
+    return None
+
+
+@register_prune
+def prune_by_memory(ctx, cfg):
+    """Analytic HBM bound (reference: memory_cost_model.py)."""
+    if cfg.cost is not None and not cfg.cost.fits:
+        return (f"estimated {cfg.cost.memory_bytes / 1e9:.1f} GB > "
+                f"{ctx.cluster.hbm_bytes / 1e9:.1f} GB HBM")
+    return None
+
+
+@register_prune
+def prune_by_cost_bound(ctx, cfg):
+    """Skip candidates the analytic model puts far beyond the best
+    measured config's OWN analytic cost (reference: the history-based
+    prune_by_*_history chain — ours uses the cost model instead of rerun
+    history). Analytic is compared to analytic, so model bias cancels."""
+    ref = ctx.best_analytic_s
+    if (ref is not None and cfg.cost is not None
+            and cfg.cost.total_s > ctx.cost_margin * ref):
+        return (f"analytic {cfg.cost.total_s:.4f}s > "
+                f"{ctx.cost_margin:.1f}x best-config analytic {ref:.4f}s")
+    return None

@@ -1,0 +1,43 @@
+"""Trial history recorder.
+
+Reference: auto_tuner/recorder.py — History_recorder keeps per-trial
+metric rows, sorts by the tuning metric, stores best, and can dump csv.
+Ours records TrialResult rows, sorts by time/step, dumps jsonl.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+
+class Recorder:
+    def __init__(self):
+        self.history: List = []
+
+    def add(self, result) -> None:
+        self.history.append(result)
+
+    def sorted(self) -> List:
+        ok = [r for r in self.history if r.time_s is not None]
+        bad = [r for r in self.history if r.time_s is None]
+        return sorted(ok, key=lambda r: r.time_s) + bad
+
+    def best(self):
+        s = self.sorted()
+        return s[0] if s and s[0].time_s is not None else None
+
+    def store_history(self, path: str) -> None:
+        with open(path, "w") as f:
+            for r in self.sorted():
+                f.write(json.dumps(dataclasses.asdict(r), default=str)
+                        + "\n")
+
+    def load_history(self, path: str) -> None:
+        from .tuner import TrialResult
+
+        with open(path) as f:
+            for line in f:
+                d = json.loads(line)
+                d.pop("plan", None)
+                self.history.append(TrialResult(plan=None, **d))

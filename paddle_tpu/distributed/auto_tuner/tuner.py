@@ -1,0 +1,135 @@
+"""AutoTuner — cost-model-pruned trial search over parallel configs.
+
+Reference: auto_tuner/tuner.py:21 — AutoTuner holds a search algorithm,
+`search_once()` returns the next un-pruned candidate, the launcher runs a
+short trial job per candidate, and the recorder keeps the metric ordering.
+The reference relaunches whole jobs per trial; on TPU a config change is a
+re-jit with different shardings, so `tune()` runs the full loop in-process
+against a user trial function.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterator, List, Optional
+
+from ..auto_parallel.engine import (Cluster, CostModel, PlanItem, Planner,
+                                    Strategy)
+from . import prune
+from .recorder import Recorder
+
+
+@dataclasses.dataclass
+class TrialResult:
+    plan: Optional[PlanItem]
+    time_s: Optional[float] = None
+    error: Optional[str] = None
+    pruned: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Candidate:
+    plan: PlanItem
+    cost: object = None
+
+
+class _Ctx:
+    def __init__(self, cluster, global_batch, max_tp, max_pp, cost_margin):
+        self.cluster = cluster
+        self.global_batch = global_batch
+        self.max_tp = max_tp
+        self.max_pp = max_pp
+        self.cost_margin = cost_margin
+        self.best_trial_s: Optional[float] = None
+        self.best_analytic_s: Optional[float] = None
+
+
+class AutoTuner:
+    """Search dp x tp x pp x micro-batch x sharding-stage.
+
+    `trial_fn(plan) -> seconds_per_step` builds + times a real step at
+    that config (raising = invalid config, recorded as an error trial).
+    """
+
+    def __init__(self, cluster: Optional[Cluster] = None,
+                 global_batch: int = 0, max_tp: int = 0, max_pp: int = 0,
+                 micro_batch_candidates: Iterator[int] = (1, 2, 4, 8),
+                 sharding_stages: Iterator[int] = (0, 3),
+                 cost_margin: float = 3.0, max_trials: int = 0):
+        self.cluster = cluster or Cluster.auto()
+        self.planner = Planner(self.cluster)
+        self.recorder = Recorder()
+        self.micro_batch_candidates = tuple(micro_batch_candidates)
+        self.sharding_stages = tuple(sharding_stages)
+        self.max_trials = max_trials
+        self._ctx = _Ctx(self.cluster, global_batch, max_tp, max_pp,
+                         cost_margin)
+        self._pruned: List[TrialResult] = []
+
+    # -- search space ---------------------------------------------------------
+
+    def candidates(self, strategy: Optional[Strategy] = None,
+                   sizes: Optional[dict] = None) -> List[_Candidate]:
+        strategy = strategy or Strategy()
+        cost_model = self.planner.cost_model
+        out = []
+        for base in self.planner.candidates(strategy):
+            for mbs in self.micro_batch_candidates:
+                if mbs < base.pp:
+                    continue
+                for stage in self.sharding_stages:
+                    plan = PlanItem(dp=base.dp, tp=base.tp, pp=base.pp,
+                                    micro_batches=mbs, sharding_stage=stage)
+                    cost = cost_model.estimate(plan=plan, **sizes) \
+                        if sizes else None
+                    plan.cost = cost
+                    out.append(_Candidate(plan=plan, cost=cost))
+        # analytic best first, so the cost-bound prune bites early
+        out.sort(key=lambda c: c.cost.total_s if c.cost else 0.0)
+        return out
+
+    def search_once(self, cands: List[_Candidate]) -> Optional[_Candidate]:
+        """Next un-pruned candidate (reference: tuner.py:62)."""
+        while cands:
+            cand = cands.pop(0)
+            reason = prune.apply_all(self._ctx, cand)
+            if reason is None:
+                return cand
+            self._pruned.append(TrialResult(plan=cand.plan, pruned=reason))
+        return None
+
+    # -- the loop -------------------------------------------------------------
+
+    def tune(self, trial_fn: Callable[[PlanItem], float],
+             strategy: Optional[Strategy] = None,
+             sizes: Optional[dict] = None) -> Optional[PlanItem]:
+        cands = self.candidates(strategy, sizes)
+        trials = 0
+        while True:
+            if self.max_trials and trials >= self.max_trials:
+                break
+            cand = self.search_once(cands)
+            if cand is None:
+                break
+            trials += 1
+            try:
+                t = float(trial_fn(cand.plan))
+                self.recorder.add(TrialResult(plan=cand.plan, time_s=t))
+                if (self._ctx.best_trial_s is None
+                        or t < self._ctx.best_trial_s):
+                    self._ctx.best_trial_s = t
+                    self._ctx.best_analytic_s = (
+                        cand.cost.total_s if cand.cost else None)
+            except Exception as e:  # invalid config: record, keep searching
+                self.recorder.add(TrialResult(
+                    plan=cand.plan, error=f"{type(e).__name__}: {e}"))
+        best = self.recorder.best()
+        return best.plan if best else None
+
+    @property
+    def pruned(self) -> List[TrialResult]:
+        return list(self._pruned)
+
+    @property
+    def history(self) -> List[TrialResult]:
+        return self.recorder.sorted() + self._pruned
