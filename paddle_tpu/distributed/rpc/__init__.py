@@ -1,0 +1,270 @@
+"""paddle.distributed.rpc parity — remote procedure calls between workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py:85 (init_rpc over a brpc
+ProcessGroupRpc + barrier store), :160 rpc_sync, :206 rpc_async, plus
+WorkerInfo exchange (:65). TPU-native: no brpc in the image and none
+needed — an RPC here is host-side orchestration (TPU compute goes through
+collectives, not RPC), so the transport is a plain socket server per
+worker with pickled (fn, args, kwargs) frames, and worker discovery rides
+the same TCPStore used for rendezvous.
+
+The API contract matches the reference: functions must be importable on
+the callee (pickled by reference), results pickle back, `rpc_async`
+returns a future with .wait().
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..store import TCPStore
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    """Reference: rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcState:
+    def __init__(self):
+        self.server: Optional["_Server"] = None
+        self.store: Optional[TCPStore] = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.by_rank: Dict[int, WorkerInfo] = {}
+        self.self_info: Optional[WorkerInfo] = None
+        self.pool = _futures.ThreadPoolExecutor(max_workers=8)
+
+
+_state: Optional[_RpcState] = None
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(conn, payload: bytes) -> None:
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(conn) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+class _Server:
+    """Per-worker request loop: unpickle (fn, args, kwargs), run, reply
+    (ok, result) or (err, exception)."""
+
+    def __init__(self, port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept, daemon=True,
+                                        name="rpc-server")
+        self._thread.start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                fn, args, kwargs = pickle.loads(_recv_frame(conn))
+                try:
+                    result = fn(*args, **(kwargs or {}))
+                    _send_frame(conn, pickle.dumps((True, result)))
+                except Exception as e:  # travels back to the caller
+                    _send_frame(conn, pickle.dumps((False, e)))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _require_state() -> _RpcState:
+    if _state is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _state
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC service and exchange WorkerInfos.
+
+    Reference: rpc.py:85 — master_endpoint hosts the barrier store;
+    every worker publishes name:ip:port and blocks until all
+    `world_size` peers are registered.
+    """
+    global _state
+    if _state is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:29850")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    st = _RpcState()
+    st.server = _Server()
+    if rank == 0:
+        try:
+            store = TCPStore(host, int(port), is_master=True,
+                             world_size=world_size)
+        except OSError:  # master already running (tests, relaunch)
+            store = TCPStore(host, int(port), is_master=False,
+                             world_size=world_size)
+    else:
+        store = TCPStore(host, int(port), is_master=False,
+                         world_size=world_size)
+    st.store = store
+    ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") \
+        else socket.gethostbyname(socket.gethostname())
+    st.self_info = WorkerInfo(name, rank, ip, st.server.port)
+    store.set(f"rpc/worker/{rank}",
+              f"{name}|{ip}|{st.server.port}".encode())
+    # info exchange (reference _exchange_all_service_infos)
+    for r in range(world_size):
+        store.wait(f"rpc/worker/{r}", timeout=300.0)
+        wname, wip, wport = store.get(f"rpc/worker/{r}").decode().split("|")
+        info = WorkerInfo(wname, r, wip, int(wport))
+        st.workers[wname] = info
+        st.by_rank[r] = info
+    _state = st
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    st = _require_state()
+    if name not in st.workers:
+        raise ValueError(f"unknown rpc worker {name!r}; "
+                         f"known: {sorted(st.workers)}")
+    return st.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    st = _require_state()
+    return [st.by_rank[r] for r in sorted(st.by_rank)]
+
+
+class _Conn:
+    """One pooled connection per target worker (thread-locked frames)."""
+
+    _conns: Dict[Tuple[str, int], "_Conn"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, ip, port, timeout):
+        self.sock = socket.create_connection((ip, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+    @classmethod
+    def to(cls, info: WorkerInfo, timeout: float) -> "_Conn":
+        key = (info.ip, info.port)
+        with cls._lock:
+            c = cls._conns.get(key)
+            if c is None:
+                c = cls(info.ip, info.port, timeout)
+                cls._conns[key] = c
+            return c
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            for c in cls._conns.values():
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+            cls._conns.clear()
+
+
+def _invoke(to: str, fn, args, kwargs, timeout: float):
+    info = get_worker_info(to)
+    conn = _Conn.to(info, timeout)
+    payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
+    with conn.lock:
+        conn.sock.settimeout(timeout if timeout > 0 else None)
+        _send_frame(conn.sock, payload)
+        ok, result = pickle.loads(_recv_frame(conn.sock))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: float = 180.0):
+    """Blocking call on worker `to` (reference: rpc.py:160)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = 180.0):
+    """Non-blocking call; returns a future with .wait() (reference:
+    rpc.py:206 returns a FutureWrapper)."""
+    st = _require_state()
+    fut = st.pool.submit(_invoke, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle-style spelling
+    return fut
+
+
+def shutdown() -> None:
+    """Barrier, then stop the local service (reference: rpc.py shutdown
+    with _barrier_never_timeout so no worker exits early)."""
+    global _state
+    st = _state
+    if st is None:
+        return
+    try:
+        st.store.barrier("rpc/shutdown", timeout=300.0)
+    except Exception:
+        pass
+    _Conn.reset()
+    st.server.stop()
+    st.pool.shutdown(wait=False)
+    try:
+        st.store.stop()
+    except Exception:
+        pass
+    _state = None
