@@ -40,6 +40,12 @@ class Fleet:
              strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
         self._is_collective = is_collective
+        if not is_collective:
+            # parameter-server mode: roles come from the PS launch env
+            # (reference: the_one_ps role_maker); no collective init
+            self._init_ps_env()
+            self._initialized = True
+            return self
         coll.init_parallel_env()
 
         h = self._strategy.hybrid_configs
@@ -131,28 +137,66 @@ class Fleet:
         return HybridParallelOptimizer(optimizer, self._hcg,
                                        self._strategy or DistributedStrategy())
 
-    # PS-mode stubs (reference parameter-server path; sparse recsys PS is
-    # out of TPU scope — gated, not silently wrong)
+    # -- parameter-server mode ------------------------------------------
+    # Reference: fleet.py is_server/init_server/run_server/init_worker/
+    # stop_worker over the_one_ps; here over distributed/ps (host-side
+    # tables — see that module's docstring for the TPU scoping).
+
+    def _init_ps_env(self):
+        import os
+
+        self._ps_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._ps_endpoints = [e for e in eps.replace(";", ",").split(",")
+                              if e]
+        self._ps_port = int(os.environ.get("PADDLE_PORT", "0") or 0)
+        self._ps_n_workers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._ps_server = None
+        self._ps_client = None
+
     def is_server(self):
-        return False
+        return (not self._is_collective
+                and getattr(self, "_ps_role", "") == "PSERVER")
 
     def is_worker(self):
-        return True
+        return not self.is_server()
 
-    def init_worker(self):
-        pass
+    def init_server(self, *model_dirs, **kwargs):
+        from ..ps import PsServer
 
-    def init_server(self, *a, **k):
-        raise NotImplementedError(
-            "parameter-server mode is not supported by the TPU backend; "
-            "use collective mode (is_collective=True)")
+        if not self.is_server():
+            raise RuntimeError("init_server on a non-PSERVER role")
+        self._ps_server = PsServer(port=self._ps_port,
+                                   n_workers=self._ps_n_workers)
 
     def run_server(self):
-        raise NotImplementedError(
-            "parameter-server mode is not supported by the TPU backend")
+        if self._ps_server is None:
+            raise RuntimeError("call init_server() first")
+        self._ps_server.run()
+
+    def init_worker(self, scopes=None):
+        if self._is_collective:
+            return
+        from ..ps import PsClient
+
+        if not self._ps_endpoints:
+            raise RuntimeError(
+                "PS worker needs PADDLE_PSERVERS_IP_PORT_LIST")
+        self._ps_client = PsClient(self._ps_endpoints)
+
+    @property
+    def ps_client(self):
+        return getattr(self, "_ps_client", None)
 
     def stop_worker(self):
-        pass
+        client = getattr(self, "_ps_client", None)
+        if client is None:
+            return
+        client.barrier()  # all workers finished before teardown
+        if self.worker_index() == 0:
+            client.stop_servers()
+        client.close()
+        self._ps_client = None
 
 
 fleet = Fleet()

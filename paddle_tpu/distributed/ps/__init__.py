@@ -1,0 +1,317 @@
+"""Parameter-server mode — dense/sparse tables with server-side updates.
+
+Reference: paddle/fluid/distributed/ps/ (brpc_ps_server.h:1 BrpcPsServer,
+table/ dense+sparse accessors, the_one_ps.py orchestration): servers hold
+parameter tables, trainers pull params / push grads asynchronously, sparse
+embedding rows are created on demand and sharded by id across servers.
+
+TPU-native scoping: PS exists for recsys-scale sparse embeddings that live
+OUTSIDE accelerator memory by design — so the table store is host-side
+(numpy + dict), the transport is the same framed-socket layer the rpc
+module uses, and the dense training path on TPU stays collective. What is
+kept faithful: async push/pull semantics, server-side optimizers (SGD /
+adagrad per push), id-sharded sparse tables with on-demand row init,
+name-sharded dense tables, and the worker barrier.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PsServer", "PsClient", "Table"]
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("ps peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(conn, obj) -> None:
+    payload = pickle.dumps(obj)
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(conn):
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return pickle.loads(_recv_exact(conn, n))
+
+
+class Table:
+    """One named table (reference: ps/table/ — MemoryDenseTable /
+    MemorySparseTable with an accessor applying the optimizer)."""
+
+    def __init__(self, name: str, kind: str, dim: int,
+                 shape: Optional[Sequence[int]] = None,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 init_std: float = 0.01, seed: int = 0):
+        self.name = name
+        self.kind = kind  # "dense" | "sparse"
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.init_std = init_std
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+        if kind == "dense":
+            self.data = np.zeros(shape, np.float32) if shape is not None \
+                else np.zeros((dim,), np.float32)
+            self._g2 = np.zeros_like(self.data)  # adagrad accumulator
+        else:
+            self.rows: Dict[int, np.ndarray] = {}
+            self._row_g2: Dict[int, np.ndarray] = {}
+
+    # -- dense ---------------------------------------------------------------
+
+    def pull_dense(self) -> np.ndarray:
+        with self._lock:
+            return self.data.copy()
+
+    def push_dense(self, grad: np.ndarray) -> None:
+        grad = np.asarray(grad, np.float32)
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._g2 += grad * grad
+                self.data -= self.lr * grad / (np.sqrt(self._g2) + 1e-8)
+            elif self.optimizer == "sum":
+                self.data += grad
+            else:  # sgd
+                self.data -= self.lr * grad
+
+    # -- sparse --------------------------------------------------------------
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:  # on-demand init (reference: sparse accessor create)
+            r = self._rng.normal(0.0, self.init_std,
+                                 self.dim).astype(np.float32)
+            self.rows[i] = r
+        return r
+
+    def pull_sparse(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push_sparse(self, ids: Sequence[int], grads: np.ndarray) -> None:
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                r = self._row(i)
+                if self.optimizer == "adagrad":
+                    g2 = self._row_g2.setdefault(
+                        i, np.zeros(self.dim, np.float32))
+                    g2 += g * g
+                    r -= self.lr * g / (np.sqrt(g2) + 1e-8)
+                else:
+                    r -= self.lr * g
+
+
+class PsServer:
+    """One PS shard (reference: brpc_ps_server.h:1). Serves table RPCs on
+    a socket; runs until `stop` arrives."""
+
+    def __init__(self, port: int = 0, n_workers: int = 1):
+        self.tables: Dict[str, Table] = {}
+        self.n_workers = n_workers
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True,
+                                        name="ps-server")
+        self._thread.start()
+
+    def _accept(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg["op"]
+                if op == "create_table":
+                    t = msg["spec"]
+                    if t["name"] not in self.tables:
+                        self.tables[t["name"]] = Table(**t)
+                    _send_msg(conn, {"ok": True})
+                elif op == "pull_dense":
+                    _send_msg(conn, {"ok": True, "data":
+                                     self.tables[msg["name"]].pull_dense()})
+                elif op == "push_dense":
+                    self.tables[msg["name"]].push_dense(msg["grad"])
+                    _send_msg(conn, {"ok": True})
+                elif op == "pull_sparse":
+                    _send_msg(conn, {"ok": True, "data": self.tables[
+                        msg["name"]].pull_sparse(msg["ids"])})
+                elif op == "push_sparse":
+                    self.tables[msg["name"]].push_sparse(
+                        msg["ids"], msg["grads"])
+                    _send_msg(conn, {"ok": True})
+                elif op == "barrier":
+                    with self._cv:
+                        gen = self._barrier_gen
+                        self._barrier_count += 1
+                        if self._barrier_count >= self.n_workers:
+                            self._barrier_count = 0
+                            self._barrier_gen += 1
+                            self._cv.notify_all()
+                        else:
+                            while (self._barrier_gen == gen
+                                   and not self._stopped.is_set()):
+                                self._cv.wait(0.1)
+                    _send_msg(conn, {"ok": True})
+                elif op == "stop":
+                    _send_msg(conn, {"ok": True})
+                    self.stop()
+                    return
+                else:
+                    _send_msg(conn, {"ok": False,
+                                     "error": f"unknown op {op!r}"})
+        except (ConnectionError, OSError, EOFError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def run(self):
+        """Block until stopped (reference: run_server)."""
+        self._stopped.wait()
+
+    def stop(self):
+        self._stopped.set()
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Trainer-side handle to all PS shards (reference: brpc_ps_client.h).
+
+    Sharding: dense tables live whole on `hash(name) % n_servers`; sparse
+    rows scatter by `id % n_servers` (the reference's shard_num routing).
+    """
+
+    def __init__(self, endpoints: Sequence[str]):
+        self._eps = list(endpoints)
+        self._conns: List[Optional[socket.socket]] = [None] * len(self._eps)
+        self._locks = [threading.Lock() for _ in self._eps]
+        self._table_kind: Dict[str, str] = {}
+
+    def _conn(self, i: int) -> socket.socket:
+        if self._conns[i] is None:
+            host, port = self._eps[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=120)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[i] = s
+        return self._conns[i]
+
+    def _call(self, i: int, msg):
+        with self._locks[i]:
+            conn = self._conn(i)
+            _send_msg(conn, msg)
+            out = _recv_msg(conn)
+        if not out.get("ok"):
+            raise RuntimeError(out.get("error", "ps call failed"))
+        return out
+
+    def _dense_home(self, name: str) -> int:
+        return hash(name) % len(self._eps)
+
+    # -- API -----------------------------------------------------------------
+
+    def create_table(self, name: str, kind: str = "dense", dim: int = 0,
+                     shape=None, optimizer: str = "sgd", lr: float = 0.01,
+                     init_std: float = 0.01):
+        spec = dict(name=name, kind=kind, dim=dim, shape=shape,
+                    optimizer=optimizer, lr=lr, init_std=init_std)
+        self._table_kind[name] = kind
+        if kind == "dense":
+            self._call(self._dense_home(name),
+                       {"op": "create_table", "spec": spec})
+        else:  # every shard owns a slice of the id space
+            for i in range(len(self._eps)):
+                self._call(i, {"op": "create_table",
+                               "spec": dict(spec, seed=i)})
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._call(self._dense_home(name),
+                          {"op": "pull_dense", "name": name})["data"]
+
+    def push_dense(self, name: str, grad: np.ndarray) -> None:
+        self._call(self._dense_home(name),
+                   {"op": "push_dense", "name": name,
+                    "grad": np.asarray(grad, np.float32)})
+
+    def pull_sparse(self, name: str, ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        n = len(self._eps)
+        out = np.empty((len(ids), 0), np.float32) if len(ids) == 0 else None
+        parts = {}
+        for i in range(n):
+            mask = (ids % n) == i
+            if mask.any():
+                parts[i] = (np.nonzero(mask)[0], self._call(
+                    i, {"op": "pull_sparse", "name": name,
+                        "ids": (ids[mask] // n).tolist()})["data"])
+        dim = next(iter(parts.values()))[1].shape[1]
+        out = np.empty((len(ids), dim), np.float32)
+        for i, (pos, rows) in parts.items():
+            out[pos] = rows
+        return out
+
+    def push_sparse(self, name: str, ids: Sequence[int],
+                    grads: np.ndarray) -> None:
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        n = len(self._eps)
+        for i in range(n):
+            mask = (ids % n) == i
+            if mask.any():
+                self._call(i, {"op": "push_sparse", "name": name,
+                               "ids": (ids[mask] // n).tolist(),
+                               "grads": grads[mask]})
+
+    def barrier(self) -> None:
+        self._call(0, {"op": "barrier"})
+
+    def stop_servers(self) -> None:
+        for i in range(len(self._eps)):
+            try:
+                self._call(i, {"op": "stop"})
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self) -> None:
+        for c in self._conns:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._conns = [None] * len(self._eps)

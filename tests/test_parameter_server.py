@@ -1,0 +1,150 @@
+"""Parameter-server mode (VERDICT r2 Missing #10 / padded fleet stubs).
+
+Reference behavior: paddle/fluid/distributed/ps/ dense+sparse tables with
+server-side optimizers, id-sharded across servers, and the fleet
+is_server/init_server/run_server/init_worker/stop_worker lifecycle."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import PsClient, PsServer, Table
+
+RS = np.random.RandomState(0)
+
+
+@pytest.fixture()
+def two_servers():
+    servers = [PsServer(port=0, n_workers=1) for _ in range(2)]
+    client = PsClient([f"127.0.0.1:{s.port}" for s in servers])
+    yield servers, client
+    client.stop_servers()
+    client.close()
+
+
+def test_dense_pull_push_sgd(two_servers):
+    _, client = two_servers
+    client.create_table("w", kind="dense", shape=(4, 3), optimizer="sgd",
+                        lr=0.1)
+    w0 = client.pull_dense("w")
+    np.testing.assert_allclose(w0, np.zeros((4, 3)))
+    g = np.ones((4, 3), np.float32)
+    client.push_dense("w", g)
+    client.push_dense("w", g)
+    np.testing.assert_allclose(client.pull_dense("w"), -0.2 * np.ones((4, 3)),
+                               rtol=1e-6)
+
+
+def test_dense_adagrad(two_servers):
+    _, client = two_servers
+    client.create_table("a", kind="dense", shape=(2,), optimizer="adagrad",
+                        lr=1.0)
+    client.push_dense("a", np.array([1.0, 2.0], np.float32))
+    got = client.pull_dense("a")
+    # adagrad first step: -lr * g / (|g| + eps) = -1 elementwise
+    np.testing.assert_allclose(got, [-1.0, -1.0], rtol=1e-5)
+
+
+def test_sparse_rows_on_demand_and_update(two_servers):
+    _, client = two_servers
+    client.create_table("emb", kind="sparse", dim=8, optimizer="sgd",
+                        lr=0.5, init_std=0.01)
+    ids = [3, 10, 11, 3]
+    rows = client.pull_sparse("emb", ids)
+    assert rows.shape == (4, 8)
+    np.testing.assert_allclose(rows[0], rows[3])  # same id, same row
+    # push a grad only to id 10; others untouched
+    g = np.zeros((1, 8), np.float32)
+    g[0] = 1.0
+    client.push_sparse("emb", [10], g)
+    after = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(after[0], rows[0])
+    np.testing.assert_allclose(after[1], rows[1] - 0.5, rtol=1e-5)
+
+
+def test_sparse_ids_shard_across_servers(two_servers):
+    servers, client = two_servers
+    client.create_table("e2", kind="sparse", dim=4)
+    ids = list(range(10))
+    client.pull_sparse("e2", ids)
+    # even ids on server 0, odd on server 1 (id % n_servers routing)
+    assert set(servers[0].tables["e2"].rows) == {0, 1, 2, 3, 4}
+    assert set(servers[1].tables["e2"].rows) == {0, 1, 2, 3, 4}
+
+
+def test_training_loop_converges_via_ps(two_servers):
+    """A linear-regression worker that trains THROUGH the PS: pull dense
+    weights, compute grads locally, push; loss must drop."""
+    _, client = two_servers
+    client.create_table("lin", kind="dense", shape=(5,), optimizer="sgd",
+                        lr=0.1)
+    x = RS.randn(64, 5).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 0.5, 3.0, 0.0], np.float32)
+    y = x @ w_true
+
+    def loss_of(w):
+        return float(np.mean((x @ w - y) ** 2))
+
+    first = None
+    for _ in range(100):
+        w = client.pull_dense("lin")
+        if first is None:
+            first = loss_of(w)
+        g = 2.0 * x.T @ (x @ w - y) / len(x)
+        client.push_dense("lin", g)
+    final = loss_of(client.pull_dense("lin"))
+    assert final < first * 0.01
+
+
+def test_worker_barrier(two_servers):
+    servers, _ = two_servers
+    servers[0].n_workers = 2
+    c1 = PsClient([f"127.0.0.1:{servers[0].port}"])
+    c2 = PsClient([f"127.0.0.1:{servers[0].port}"])
+    order = []
+
+    def waiter(c, tag):
+        c.barrier()
+        order.append(tag)
+
+    t1 = threading.Thread(target=waiter, args=(c1, "a"))
+    t1.start()
+    import time
+    time.sleep(0.3)
+    assert order == []  # first worker parked until the second arrives
+    waiter(c2, "b")
+    t1.join(timeout=5)
+    assert sorted(order) == ["a", "b"]
+    c1.close()
+    c2.close()
+
+
+def test_fleet_ps_lifecycle(monkeypatch):
+    """fleet.init(is_collective=False) roles + end-to-end worker flow."""
+    from paddle_tpu.distributed.fleet.fleet import Fleet
+
+    server = PsServer(port=0, n_workers=1)
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       f"127.0.0.1:{server.port}")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    f = Fleet()
+    f.init(is_collective=False)
+    assert f.is_worker() and not f.is_server()
+    f.init_worker()
+    f.ps_client.create_table("t", kind="dense", shape=(2,), lr=0.5)
+    f.ps_client.push_dense("t", np.array([1.0, 1.0], np.float32))
+    np.testing.assert_allclose(f.ps_client.pull_dense("t"), [-0.5, -0.5])
+    f.stop_worker()  # barriers, stops the server (worker 0), closes
+    assert server._stopped.is_set()
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PORT", "0")
+    g = Fleet()
+    g.init(is_collective=False)
+    assert g.is_server()
+    g.init_server()
+    assert g._ps_server.port > 0
+    g._ps_server.stop()
