@@ -51,6 +51,13 @@ class Tensor:
         "trainable",
         "_dist_mesh",
         "_dist_partials",
+        # static-graph mode (paddle_tpu/static): placeholder marker, tape
+        # variable id, owning Program, layer keep-alives for static.nn
+        "_is_placeholder",
+        "_var_id",
+        "_program",
+        "_fc_layer",
+        "_emb_layer",
         "__weakref__",
     )
 

@@ -79,6 +79,17 @@ def _wrap_out(arr, node=None, idx=0):
 
 
 _amp_hook = None
+# static-graph recorder (paddle.enable_static + program_guard): records
+# every dispatched op into the active Program for Executor replay
+_static_recorder = [None]
+
+
+def set_static_recorder(rec):
+    _static_recorder[0] = rec
+
+
+def get_static_recorder():
+    return _static_recorder[0]
 
 
 def set_amp_hook(fn):
@@ -203,6 +214,9 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
 
     if flags.flag_value("check_nan_inf"):
         _check_nan_inf(name, result)
+    if _static_recorder[0] is not None:
+        _static_recorder[0].record(name, kernel, treedef, leaves, t_slots,
+                                   in_tensors, result)
     return result
 
 

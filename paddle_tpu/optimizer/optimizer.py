@@ -126,6 +126,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import in_static_mode
+
+        if in_static_mode() and getattr(loss, "_program", None) is not None:
+            # static graph: register the train objective on the program;
+            # the Executor compiles value_and_grad(replay)+update as one
+            # step (reference: append_backward + optimizer ops)
+            prog = loss._program
+            prog._loss_id = loss._var_id
+            prog._optimizer = self
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,11 +1,14 @@
 """paddle.static compatibility layer.
 
-Reference: python/paddle/static (Program/Executor/program_guard,
-save/load_inference_model). In this framework the "static graph" IS a traced
-XLA program (jit.StaticFunction); this module provides the user-facing
-Program/Executor shell over that machinery so static-graph training scripts
-keep working: `program_guard` records layer calls, `Executor.run` executes
-the captured callable with feeds.
+Reference: python/paddle/static (Program/Executor/program_guard/data/
+append_backward, save/load_inference_model). Real static-graph scripts run
+here via a recorded op tape: under `enable_static()`, every dispatched op
+appends an OpRecord to the active Program (see static/graph.py) while also
+executing on placeholder-shaped dummies (shape inference). `Executor.run`
+replays the tape as ONE jitted XLA function of (feeds, params); after
+`optimizer.minimize(loss)` the compiled step is value_and_grad(replayed
+loss) + a functional optimizer update — the appended-backward program, the
+XLA way.
 """
 from __future__ import annotations
 
@@ -22,30 +25,83 @@ data_spec_registry: Dict[str, InputSpec] = {}
 
 
 class Program:
-    """A deferred computation: feeds + a python callable traced at run time.
-
-    The reference's ProgramDesc/PIR Program (SURVEY.md §2.3) is replaced by
-    tracing: ops recorded between program_guard() enter/exit become a python
-    closure jitted by XLA on first Executor.run.
-    """
+    """A recorded op tape (the reference's ProgramDesc/PIR Program analog,
+    SURVEY.md §2.3). Ops dispatched while this program's guard is active
+    append OpRecords (static/graph.py); Executor.run replays the tape as
+    one jitted function of (feeds, params)."""
 
     def __init__(self):
-        self._build_fns = []  # list of (callable, feed names, fetch holder)
+        self.records: List = []
+        self.consts: List[np.ndarray] = []
+        self.feed_names: Dict[str, Tensor] = {}
+        self.params: Dict[str, "Parameter"] = {}
+        self._param_keys: Dict[int, str] = {}
+        self.next_id = 0
         self.random_seed = None
+        # training extension (append_backward / minimize)
+        self._loss_id: Optional[int] = None
+        self._optimizer = None
+
+    def register_param(self, p) -> str:
+        key = self._param_keys.get(id(p))
+        if key is None:
+            key = getattr(p, "name", None) or f"param_{len(self.params)}"
+            if key in self.params and self.params[key] is not p:
+                key = f"{key}_{len(self.params)}"
+            self._param_keys[id(p)] = key
+            self.params[key] = p
+        return key
 
     def global_block(self):
         return self
 
-    def clone(self, for_test=False):
-        return self
+    @property
+    def ops(self):
+        return self.records
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def clone(self, for_test: bool = False):
+        """Share the tape; a test clone drops the training extension
+        (reference: Program.clone(for_test=True) strips optimizer ops)."""
+        c = Program.__new__(Program)
+        c.__dict__.update(self.__dict__)
+        if for_test:
+            c._loss_id = None
+            c._optimizer = None
+        return c
 
     def __repr__(self):
-        return f"<Program with {len(self._build_fns)} build fns>"
+        return (f"<Program ops={len(self.records)} "
+                f"params={len(self.params)} feeds={list(self.feed_names)}>")
 
 
 _default_main = Program()
 _default_startup = Program()
 _guard_stack: List = []
+_static_mode = [False]
+
+
+def enable_static():
+    """Reference: paddle.enable_static — op calls start recording into the
+    default main program."""
+    from ..ops import dispatch
+    from .graph import GraphRecorder
+
+    _static_mode[0] = True
+    dispatch.set_static_recorder(GraphRecorder(default_main_program()))
+
+
+def disable_static():
+    from ..ops import dispatch
+
+    _static_mode[0] = False
+    dispatch.set_static_recorder(None)
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
 
 
 def default_main_program():
@@ -58,16 +114,25 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    from ..ops import dispatch
+    from .graph import GraphRecorder
+
     _guard_stack.append((main_program, startup_program or Program()))
+    prev = dispatch.get_static_recorder()
+    if _static_mode[0]:
+        dispatch.set_static_recorder(GraphRecorder(main_program))
     try:
         yield
     finally:
         _guard_stack.pop()
+        dispatch.set_static_recorder(prev)
 
 
 def data(name, shape, dtype="float32", lod_level=0):
     """Declare a graph input (reference: paddle.static.data). Returns a
-    placeholder Tensor; at Executor.run the feed dict binds real values."""
+    placeholder Tensor; at Executor.run the feed dict binds real values.
+    Dims given as None/-1 are batch-polymorphic: recording runs them at 1,
+    replay re-traces at the fed size."""
     spec = InputSpec(shape, dtype, name)
     data_spec_registry[name] = spec
     shape_concrete = [1 if (s is None or s < 0) else s for s in shape]
@@ -77,29 +142,121 @@ def data(name, shape, dtype="float32", lod_level=0):
     return t
 
 
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Reference: paddle/base/backward.py append_backward — mark the loss
+    whose gradients the executor's train step computes. The actual grad
+    program is jax.value_and_grad around the replayed tape."""
+    prog = getattr(loss, "_program", None) or default_main_program()
+    prog._loss_id = loss._var_id
+    return []
+
+
 class Executor:
-    """Reference: python/paddle/base/executor.py:1234. Here: run a python
-    callable (registered via set_program_fn or built from layer calls) with
-    feeds, under jit."""
+    """Replay executor (reference: python/paddle/base/executor.py:1234).
+
+    Forward runs jit the tape as a function of (feeds, params); training
+    programs (after optimizer.minimize/append_backward) jit ONE train step:
+    value_and_grad of the replayed loss + functional optimizer update, with
+    updated params written back to the Parameter objects after each run.
+    """
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place or TPUPlace()
-        self._compiled = {}
+        self._compiled: Dict = {}
+        self._opt_states: Dict = {}
 
-    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True, **kwargs):
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        import jax
+
         feed = feed or {}
+        program = program or default_main_program()
+        # legacy build_program path
         fn = getattr(program, "_run_callable", None)
-        if fn is None:
-            raise NotImplementedError(
-                "Executor.run requires a program built with paddle.static.build_program "
-                "(trace-based static mode); legacy op-by-op program construction is not "
-                "supported — use paddle.jit.to_static or build_program instead"
-            )
-        feed_tensors = {k: (v if isinstance(v, Tensor) else to_tensor(v)) for k, v in feed.items()}
-        outs = fn(feed_tensors, fetch_list)
+        if fn is not None:
+            feed_tensors = {k: (v if isinstance(v, Tensor) else to_tensor(v))
+                            for k, v in feed.items()}
+            outs = fn(feed_tensors, fetch_list)
+            if return_numpy:
+                return [np.asarray(o._data) if isinstance(o, Tensor) else o
+                        for o in outs]
+            return outs
+        if not getattr(program, "records", None):
+            return []  # startup program: params initialise eagerly
+
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for f in fetch_list:
+            vid = getattr(f, "_var_id", None)
+            if vid is None:
+                raise ValueError(
+                    f"fetch target {f!r} is not a recorded static variable")
+            fetch_ids.append(vid)
+
+        feeds = {k: np.asarray(v._data if isinstance(v, Tensor) else v)
+                 for k, v in feed.items()}
+        params = {k: p._data for k, p in program.params.items()}
+        training = (program._optimizer is not None
+                    and program._loss_id is not None)
+        key = (id(program), tuple(sorted(
+            (k, v.shape, str(v.dtype)) for k, v in feeds.items())),
+            tuple(fetch_ids), training)
+        step = self._compiled.get(key)
+        if step is None:
+            step = self._build_step(program, fetch_ids, training)
+            self._compiled[key] = step
+
+        if training:
+            state = self._opt_states.get(id(program))
+            new_params, state, fetches = step(params, state, feeds)
+            self._opt_states[id(program)] = state
+            for k, p in program.params.items():
+                p._data = new_params[k]
+        else:
+            fetches = step(params, feeds)
         if return_numpy:
-            return [np.asarray(o._data) if isinstance(o, Tensor) else o for o in outs]
-        return outs
+            return [np.asarray(jax.device_get(o)) for o in fetches]
+        return [Tensor._from_data(o) for o in fetches]
+
+    def _build_step(self, program, fetch_ids, training):
+        import jax
+
+        from .graph import replay
+
+        if not training:
+            def fwd(params, feeds):
+                return replay(program, feeds, params, fetch_ids)
+
+            return jax.jit(fwd)
+
+        from ..distributed.auto_parallel.engine import _functional_update
+
+        init_opt, update = _functional_update(program._optimizer)
+        loss_id = program._loss_id
+        trainable = {k for k, p in program.params.items()
+                     if getattr(p, "trainable", True)
+                     and not p.stop_gradient}
+
+        def train(params, opt_state, feeds):
+            if opt_state is None:
+                opt_state = init_opt({k: params[k] for k in trainable})
+
+            def loss_of(tp):
+                merged = dict(params)
+                merged.update(tp)
+                outs = replay(program, feeds, merged,
+                              [loss_id] + list(fetch_ids))
+                return outs[0].mean(), outs[1:]
+
+            tp = {k: params[k] for k in trainable}
+            (loss, fetches), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tp)
+            new_tp, opt_state = update(tp, grads, opt_state)
+            merged = dict(params)
+            merged.update(new_tp)
+            return merged, opt_state, fetches
+
+        return jax.jit(train)
 
 
 def build_program(build_fn):
@@ -138,6 +295,7 @@ def name_scope(prefix=None):
 
 
 # re-exports for API parity
+from . import nn  # noqa: E402
 from ..jit.api import InputSpec  # noqa: F401, E402
 from ..jit.serialization import load as load_inference_model_impl  # noqa: E402
 

@@ -1,0 +1,110 @@
+"""Deferred static graph: record ops at dispatch, replay under jit.
+
+Reference: the ProgramDesc/PIR program-building path (SURVEY.md §2.3) —
+under `paddle.enable_static()` every op API appends an OpDesc to the
+default main Program instead of computing, `append_backward` adds grad
+ops, and `Executor.run` feeds/fetches named variables.
+
+TPU-native: ops DO execute while recording — on placeholder-shaped dummy
+data — which is this framework's shape inference (the recorded python
+kernels are shape-polymorphic jnp closures, so replay works at real batch
+sizes). What the Program stores is the op tape: (kernel, arg tree,
+input refs, output var ids). `Executor.run` replays the tape as a pure
+function of (feeds, params) and jits it per feed signature; training
+scripts get the appended-backward semantics via `jax.value_and_grad`
+around the replayed loss plus a functional optimizer update — the whole
+train step is ONE XLA executable, which is exactly what the reference's
+executor+pass pipeline works to achieve.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+@dataclasses.dataclass
+class OpRecord:
+    name: str
+    kernel: Callable
+    treedef: Any                      # input (args, kwargs) treedef
+    const_leaves: List[Any]           # non-tensor leaves (python consts)
+    tensor_slots: List[int]
+    input_refs: List[Tuple[str, Any]]  # ("var",id)|("param",key)|("feed",name)|("const",k)
+    out_treedef: Any
+    out_ids: List[Optional[int]]      # var id per output tensor leaf
+
+
+class GraphRecorder:
+    """Attached to a Program while its program_guard is active."""
+
+    def __init__(self, program):
+        self.program = program
+
+    # dispatch calls this after executing each op eagerly
+    def record(self, name, kernel, treedef, leaves, t_slots, in_tensors,
+               result):
+        prog = self.program
+        refs = []
+        for t in in_tensors:
+            vid = getattr(t, "_var_id", None)
+            if vid is not None:
+                refs.append(("var", vid))
+            elif getattr(t, "_is_placeholder", False):
+                prog.feed_names.setdefault(t.name, t)
+                refs.append(("feed", t.name))
+            elif isinstance(t, Parameter):
+                key = prog.register_param(t)
+                refs.append(("param", key))
+            else:
+                prog.consts.append(np.asarray(t._data))
+                refs.append(("const", len(prog.consts) - 1))
+        const_leaves = [None if i in t_slots else l
+                        for i, l in enumerate(leaves)]
+        out_leaves, out_treedef = jax.tree.flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        out_ids: List[Optional[int]] = []
+        for o in out_leaves:
+            if isinstance(o, Tensor):
+                o._var_id = prog.next_id
+                o._program = prog
+                out_ids.append(prog.next_id)
+                prog.next_id += 1
+            else:
+                out_ids.append(None)
+        prog.records.append(OpRecord(name, kernel, treedef, const_leaves,
+                                     t_slots, refs, out_treedef, out_ids))
+
+
+def replay(program, feeds: Dict[str, Any], params: Dict[str, Any],
+           fetch_ids: List[int]) -> List[Any]:
+    """Pure function of (feeds, params): walk the tape, return fetches.
+    Traced under jit by the Executor — this IS the compiled Program."""
+    env: Dict[int, Any] = {}
+    for rec in program.records:
+        leaves = list(rec.const_leaves)
+        it = iter(rec.input_refs)
+        for slot in rec.tensor_slots:
+            kind, key = next(it)
+            if kind == "var":
+                arr = env[key]
+            elif kind == "feed":
+                arr = feeds[key]
+            elif kind == "param":
+                arr = params[key]
+            else:
+                arr = program.consts[key]
+            # kernels take raw arrays (dispatch unwraps Tensors the same way)
+            leaves[slot] = jnp.asarray(arr)
+        args, kwargs = jax.tree.unflatten(rec.treedef, leaves)
+        out = rec.kernel(*args, **kwargs)
+        out_leaves = jax.tree.flatten(out)[0]
+        for oid, o in zip(rec.out_ids, out_leaves):
+            if oid is not None:
+                env[oid] = o
+    return [env[i] for i in fetch_ids]

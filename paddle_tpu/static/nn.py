@@ -1,0 +1,50 @@
+"""paddle.static.nn — layer helpers for static-graph scripts.
+
+Reference: python/paddle/static/nn/common.py (fc at :28, batch_norm,
+embedding): functional builders that create parameters on the current
+program and append ops. Here the parameter creation is eager (parameters
+register on the Program the first time an op consumes them) and the ops
+record into the active tape like any dispatched op.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import nn as _nn
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, activation: Optional[str] = None,
+       name: Optional[str] = None, weight_attr=None, bias_attr=None):
+    """Fully-connected layer (reference: static/nn/common.py:28).
+
+    Creates a fresh Linear parameter pair per call-site (static scripts
+    build the program once) and records x @ W + b (+activation)."""
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    lin = _nn.Linear(in_features, size, weight_attr=weight_attr,
+                     bias_attr=bias_attr)
+    if name:
+        lin.weight.name = f"{name}.w_0"
+        if lin.bias is not None:
+            lin.bias.name = f"{name}.b_0"
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        h = x.reshape(list(x.shape[:num_flatten_dims]) + [in_features])
+    out = lin(h)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    # keep the layer alive: its params are referenced by the program
+    out._fc_layer = lin
+    return out
+
+
+def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """Reference: static/nn/common.py embedding."""
+    emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                        weight_attr=param_attr)
+    out = emb(input)
+    out._emb_layer = emb
+    return out

@@ -1,0 +1,113 @@
+"""Static-graph mode: program recording + Executor replay (VERDICT Weak #6).
+
+Reference behavior: the classic paddle.static script shape —
+enable_static; static.data placeholders; layers build the default main
+program; optimizer.minimize appends backward+update; Executor.run(feed,
+fetch_list) over named variables. (python/paddle/static + base/executor.py)
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+RS = np.random.RandomState(7)
+
+
+@pytest.fixture()
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_forward_program_records_and_replays(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3])
+        w = paddle.to_tensor(RS.randn(3, 2).astype(np.float32))
+        y = paddle.matmul(x, w)
+        z = y + 1.0
+    assert len(main.records) >= 2
+    exe = static.Executor()
+    exe.run(startup)
+    feed_x = RS.randn(5, 3).astype(np.float32)  # batch 5 != recorded 1
+    (got,) = exe.run(main, feed={"x": feed_x}, fetch_list=[z])
+    np.testing.assert_allclose(got, feed_x @ np.asarray(w._data) + 1.0,
+                               rtol=1e-5)
+
+
+def test_fc_and_multiple_fetches(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4])
+        h = static.nn.fc(x, size=8, activation="relu")
+        out = static.nn.fc(h, size=2)
+    assert len(main.params) == 4  # two fc layers x (weight, bias)
+    exe = static.Executor()
+    feed_x = RS.randn(6, 4).astype(np.float32)
+    h_v, out_v = exe.run(main, feed={"x": feed_x}, fetch_list=[h, out])
+    assert h_v.shape == (6, 8) and out_v.shape == (6, 2)
+    assert (h_v >= 0).all()  # relu applied
+
+
+def test_static_training_loop_converges(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 5])
+        y = static.data("y", [None, 1])
+        pred = static.nn.fc(x, size=1)
+        loss = ((pred - y) * (pred - y)).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    xs = RS.randn(128, 5).astype(np.float32)
+    w_true = RS.randn(5, 1).astype(np.float32)
+    ys = xs @ w_true
+
+    exe = static.Executor()
+    exe.run(startup)
+    first = None
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+    assert float(lv) < first * 0.05, f"loss {first} -> {float(lv)}"
+    # updated weights visible on the parameter objects themselves
+    w = main.all_parameters()[0]
+    assert np.linalg.norm(np.asarray(w._data) - 0.0) > 0.0
+
+
+def test_clone_for_test_drops_training(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2])
+        pred = static.nn.fc(x, size=1)
+        loss = (pred * pred).mean()
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert main._optimizer is not None
+    assert test_prog._optimizer is None
+    exe = static.Executor()
+    (p,) = exe.run(test_prog, feed={"x": np.ones((3, 2), np.float32)},
+                   fetch_list=[pred])
+    assert p.shape == (3, 1)
+
+
+def test_append_backward_marks_loss(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2])
+        out = static.nn.fc(x, size=1)
+        loss = out.mean()
+        static.append_backward(loss)
+    assert main._loss_id == loss._var_id
+
+
+def test_disable_static_restores_eager():
+    paddle.enable_static()
+    paddle.disable_static()
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = t + 1.0  # must not record anywhere / must execute eagerly
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 2)))
+    assert static.default_main_program() is not None
