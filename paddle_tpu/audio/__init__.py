@@ -1,0 +1,11 @@
+"""paddle.audio parity — features, functional, wav IO backends.
+
+Reference: python/paddle/audio/{features,functional,backends,datasets}.
+Datasets (TESS/ESC50) download from the network; with zero egress they
+raise with a local-files message (same policy as vision.datasets).
+"""
+from . import features, functional
+from .backends import load, save, backends_list as list_available_backends
+
+__all__ = ["features", "functional", "load", "save",
+           "list_available_backends"]

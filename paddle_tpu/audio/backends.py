@@ -1,0 +1,58 @@
+"""paddle.audio.backends parity — wav load/save.
+
+Reference: python/paddle/audio/backends/wave_backend.py (stdlib `wave`
+based PCM16 IO; soundfile optional). Same approach: stdlib only, PCM16.
+"""
+from __future__ import annotations
+
+import wave
+from typing import Tuple, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def backends_list():
+    return ["wave_backend"]
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Reference: wave_backend.load — returns (waveform, sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n_channels = f.getnchannels()
+        width = f.getsampwidth()
+        if width != 2:
+            raise ValueError(f"only PCM16 wav supported, got width {width}")
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, n_channels)
+    if normalize:
+        data = data.astype(np.float32) / 32768.0
+    wavef = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(wavef)), sr
+
+
+def save(filepath: str, src: Union[Tensor, np.ndarray], sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: int = 16) -> None:
+    """Reference: wave_backend.save."""
+    if bits_per_sample != 16 or encoding != "PCM_16":
+        raise ValueError("only PCM_16 wav supported")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if not channels_first:
+        arr = arr.T
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype("<i2")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[0])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(arr.T).tobytes())

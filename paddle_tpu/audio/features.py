@@ -1,0 +1,127 @@
+"""paddle.audio.features parity — Spectrogram / MelSpectrogram / MFCC.
+
+Reference: python/paddle/audio/features/layers.py (Spectrogram over
+signal.stft, MelSpectrogram = Spectrogram x fbank matmul,
+LogMelSpectrogram = power_to_db, MFCC = DCT matmul). TPU-native: the
+filterbank and DCT applications are plain matmuls over constants built at
+__init__ — after the framed STFT (itself a matmul against the DFT basis in
+signal.stft), the whole feature pipeline is MXU work XLA fuses end to end.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import signal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    """Reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, dtype=dtype)
+        self.register_buffer("fft_window", w)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.fft_window, center=self.center,
+                           pad_mode=self.pad_mode)
+        mag = jnp.abs(spec._data)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor._from_data(mag)
+
+
+class MelSpectrogram(Layer):
+    """Reference: layers.py MelSpectrogram — spectrogram x mel filterbank."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        fb = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                    norm, dtype)
+        self.register_buffer("fbank_matrix", fb)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)  # [..., freq, time]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank_matrix._data,
+                         spec._data)
+        return Tensor._from_data(mel)
+
+
+class LogMelSpectrogram(Layer):
+    """Reference: layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Reference: layers.py MFCC — log-mel x DCT basis."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x: Tensor) -> Tensor:
+        logmel = self._log_melspectrogram(x)  # [..., n_mels, time]
+        out = jnp.einsum("mk,...mt->...kt", self.dct_matrix._data,
+                         logmel._data)
+        return Tensor._from_data(out)

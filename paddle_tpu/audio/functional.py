@@ -1,0 +1,168 @@
+"""paddle.audio.functional parity — windows, mel filterbanks, dB, DCT.
+
+Reference: python/paddle/audio/functional/{window.py,functional.py}
+(get_window dispatch table; hz_to_mel/mel_to_hz with the HTK and Slaney
+variants; compute_fbank_matrix; power_to_db; create_dct). All closed-form
+jnp — these build CONSTANTS for the feature layers, so they run once at
+layer construction and the hot path stays matmul-shaped for the MXU.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _as_array(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- windows ------------------------------------------------------------------
+
+def _cosine_sum(coeffs, n_fft, sym):
+    n = n_fft if sym else n_fft + 1
+    k = jnp.arange(n)
+    w = jnp.zeros(n, jnp.float64)
+    for i, a in enumerate(coeffs):
+        w = w + ((-1) ** i) * a * jnp.cos(2.0 * math.pi * i * k / (n - 1))
+    return w[:n_fft]
+
+
+_WINDOWS = {
+    "hann": lambda n, sym, _: _cosine_sum([0.5, 0.5], n, sym),
+    "hamming": lambda n, sym, _: _cosine_sum([0.54, 0.46], n, sym),
+    "blackman": lambda n, sym, _: _cosine_sum([0.42, 0.5, 0.08], n, sym),
+    "rect": lambda n, sym, _: jnp.ones(n, jnp.float64),
+    "bartlett": lambda n, sym, _: (
+        1.0 - jnp.abs(2.0 * jnp.arange(n if sym else n + 1)
+                      / ((n if sym else n + 1) - 1) - 1.0))[:n],
+    "kaiser": lambda n, sym, beta: _kaiser(n, sym, 12.0 if beta is None
+                                           else beta),
+    "gaussian": lambda n, sym, std: _gaussian(n, sym, 7.0 if std is None
+                                              else std),
+}
+
+
+def _kaiser(n_fft, sym, beta):
+    n = n_fft if sym else n_fft + 1
+    k = jnp.arange(n)
+    alpha = (n - 1) / 2.0
+    arg = beta * jnp.sqrt(jnp.clip(1.0 - ((k - alpha) / alpha) ** 2, 0.0))
+    return (jnp.i0(arg) / jnp.i0(jnp.asarray(beta)))[:n_fft]
+
+
+def _gaussian(n_fft, sym, std):
+    n = n_fft if sym else n_fft + 1
+    k = jnp.arange(n) - (n - 1) / 2.0
+    return jnp.exp(-0.5 * (k / std) ** 2)[:n_fft]
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64") -> Tensor:
+    """Reference: audio/functional/window.py get_window."""
+    if isinstance(window, tuple):
+        name, param = window[0], (window[1] if len(window) > 1 else None)
+    else:
+        name, param = window, None
+    if name not in _WINDOWS:
+        raise ValueError(
+            f"unknown window {name!r}; supported: {sorted(_WINDOWS)}")
+    w = _WINDOWS[name](win_length, not fftbins, param)
+    return Tensor._from_data(w.astype(jnp.dtype(dtype)))
+
+
+# -- mel scale ----------------------------------------------------------------
+
+def hz_to_mel(freq, htk: bool = False):
+    """Reference: audio/functional/functional.py hz_to_mel (Slaney default)."""
+    f = _as_array(freq)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mels)
+    return Tensor._from_data(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _as_array(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return Tensor._from_data(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2] (reference:
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        fb = fb * enorm[:, None]
+    return Tensor._from_data(fb.astype(jnp.dtype(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Reference: functional.py power_to_db."""
+    x = _as_array(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return (Tensor._from_data(log_spec) if isinstance(spect, Tensor)
+            else log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II basis [n_mels, n_mfcc] (reference: functional.py create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return Tensor._from_data(dct.astype(jnp.dtype(dtype)))

@@ -1,0 +1,76 @@
+"""paddle.hub parity — hubconf.py model discovery and loading.
+
+Reference: python/paddle/hub.py (list/help/load over a github repo, a
+gitee repo, or a LOCAL directory; the dir must expose hubconf.py whose
+public callables are the models, with `dependencies` checked first).
+Zero egress: the local source works fully; github/gitee raise.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    deps = getattr(mod, "dependencies", [])
+    for d in deps:
+        if importlib.util.find_spec(d) is None:
+            raise RuntimeError(f"hub entry requires missing package {d!r}")
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, which this "
+            f"environment does not have; clone the repo and use "
+            f"source='local'")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False
+         ) -> List[str]:
+    """Entrypoint names in the repo's hubconf (reference: hub.py list)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return sorted(
+        name for name in dir(mod)
+        if callable(getattr(mod, name)) and not name.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False) -> Optional[str]:
+    """Docstring of one entrypoint (reference: hub.py help)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"no entry {model!r}; available: "
+                           f"{list(repo_dir, source)}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call the entrypoint and return its model (reference: hub.py load)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"no entry {model!r}; available: "
+                           f"{list(repo_dir, source)}")
+    return getattr(mod, model)(**kwargs)

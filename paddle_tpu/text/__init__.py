@@ -1,0 +1,124 @@
+"""paddle.text parity — viterbi decoding + dataset surface.
+
+Reference: python/paddle/text/{viterbi_decode.py,datasets/}. The decode is
+the capability (CRF inference); the datasets are thin downloaders over
+public corpora — with zero egress they raise with a local-files message
+(same policy as vision.datasets).
+
+TPU-native viterbi: the time recursion is a `lax.scan` whose carried state
+is the per-tag score row [B, T], so each step is one broadcasted add + max
+(VPU work, batch-parallel); the backtrace replays the argmax history with
+a second scan — no per-step host sync anywhere.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import call_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
+           "Conll05st", "Movielens"]
+
+
+def _viterbi_kernel(potentials, trans, lengths, include_bos_eos_tag):
+    B, L, N = potentials.shape
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 is BOS, N-1 is EOS
+        bos_idx, eos_idx = N - 2, N - 1
+        init = potentials[:, 0] + trans[bos_idx][None, :]
+    else:
+        init = potentials[:, 0]
+
+    def step(carry, t):
+        alpha = carry  # [B, N] best score ending in tag j at t-1
+        emit = potentials[:, t]  # [B, N]
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+        alpha_t = jnp.max(scores, axis=1) + emit        # [B, N]
+        # masked steps (t >= length) carry state through unchanged
+        active = (t < lengths)[:, None]
+        alpha_t = jnp.where(active, alpha_t, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(N)[None, :])
+        return alpha_t, best_prev
+
+    ts = jnp.arange(1, L)
+    alpha, history = jax.lax.scan(step, init, ts)  # history: [L-1, B, N]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos_idx][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+
+    def back(carry, hist_t):
+        tag = carry
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: ys[i] is the tag at time i+1, final carry is time 0
+    first_tag, path_tail = jax.lax.scan(back, last_tag, history,
+                                        reverse=True)
+    path = jnp.concatenate([first_tag[None, :], path_tail], axis=0)  # [L,B]
+    return scores, jnp.transpose(path, (1, 0)).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True,
+                   name=None) -> Tuple[Tensor, Tensor]:
+    """Reference: text/viterbi_decode.py viterbi_decode — returns
+    (scores [B], paths [B, L])."""
+    return call_op(
+        "viterbi_decode",
+        lambda p, t, l: _viterbi_kernel(p, t, l, include_bos_eos_tag),
+        (potentials, transition_params, lengths), {}, nondiff=True)
+
+
+class ViterbiDecoder(Layer):
+    """Reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _GatedDataset:
+    """Datasets needing downloads raise clearly under zero egress
+    (reference datasets: text/datasets/*.py)."""
+
+    _NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        if data_file is None:
+            raise RuntimeError(
+                f"{self._NAME} files not found locally and downloading is "
+                f"unavailable in this environment; pass data_file= with a "
+                f"local copy")
+        self.data_file = data_file
+        self.mode = mode
+
+
+class Imdb(_GatedDataset):
+    _NAME = "Imdb"
+
+
+class UCIHousing(_GatedDataset):
+    _NAME = "UCIHousing"
+
+
+class Conll05st(_GatedDataset):
+    _NAME = "Conll05st"
+
+
+class Movielens(_GatedDataset):
+    _NAME = "Movielens"
