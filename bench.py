@@ -341,6 +341,121 @@ def bench_bert_dp_sharding():
 
 
 # ---------------------------------------------------------------------------
+# Config 5: PP-YOLOE-style detector inference (BASELINE config 5 analog)
+# ---------------------------------------------------------------------------
+
+def bench_detection_infer():
+    """Single-chip detector inference ips: CSP-ish conv backbone + 3-scale
+    head + in-graph yolo_box decode, bf16 under to_static; the
+    data-dependent NMS runs on host AFTER the timed graph (reference deploy
+    pipelines post-process outside the engine too)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, profiler
+    import paddle_tpu.vision.ops as vops
+
+    B = 8 if _on_tpu() else 2
+    S = 640 if _on_tpu() else 320
+    steps, warmup = (5, 2) if _on_tpu() else (2, 1)
+
+    class ConvBN(nn.Layer):
+        def __init__(self, cin, cout, k=3, s=1):
+            super().__init__()
+            self.conv = nn.Conv2D(cin, cout, k, stride=s, padding=k // 2,
+                                  bias_attr=False)
+            self.bn = nn.BatchNorm2D(cout)
+            self.act = nn.Silu()
+
+        def forward(self, x):
+            return self.act(self.bn(self.conv(x)))
+
+    class Detector(nn.Layer):
+        """3 downsample stages -> P3/P4/P5 heads (na=1, 80 classes)."""
+
+        def __init__(self, nc=80, w=32):
+            super().__init__()
+            self.stem = ConvBN(3, w, 3, 2)
+            self.s1 = nn.Sequential(ConvBN(w, 2 * w, 3, 2),
+                                    ConvBN(2 * w, 2 * w))
+            self.s2 = nn.Sequential(ConvBN(2 * w, 4 * w, 3, 2),
+                                    ConvBN(4 * w, 4 * w))
+            self.s3 = nn.Sequential(ConvBN(4 * w, 8 * w, 3, 2),
+                                    ConvBN(8 * w, 8 * w))
+            self.s4 = nn.Sequential(ConvBN(8 * w, 16 * w, 3, 2),
+                                    ConvBN(16 * w, 16 * w))
+            out_c = 5 + nc
+            self.h3 = nn.Conv2D(4 * w, out_c, 1)
+            self.h4 = nn.Conv2D(8 * w, out_c, 1)
+            self.h5 = nn.Conv2D(16 * w, out_c, 1)
+            self.nc = nc
+
+        def forward(self, x):
+            x = self.stem(x)
+            p2 = self.s1(x)
+            p3 = self.s2(p2)
+            p4 = self.s3(p3)
+            p5 = self.s4(p4)
+            return self.h3(p3), self.h4(p4), self.h5(p5)
+
+    net = Detector()
+    net.eval()
+
+    class Infer(nn.Layer):
+        def __init__(self, m, img_size):
+            super().__init__()
+            self.m = m
+            self.img_size = img_size
+
+        def forward(self, x, img_shape):
+            with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+                heads = self.m(x)
+            outs = []
+            for hm, stride, anchor in zip(
+                    heads, (8, 16, 32), ([8, 8], [16, 16], [32, 32])):
+                boxes, scores = vops.yolo_box(
+                    hm.astype("float32"), img_shape, anchor, self.m.nc,
+                    conf_thresh=0.005,
+                    downsample_ratio=stride)
+                outs.append((boxes, scores))
+            return outs
+
+    infer = Infer(net, S)
+    paddle.jit.to_static(infer)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.rand(B, 3, S, S).astype(np.float32))
+    img_shape = paddle.to_tensor(
+        np.tile(np.asarray([[S, S]], np.int32), (B, 1)))
+
+    def one_pass():
+        outs = infer(x, img_shape)
+        # force completion of every head
+        return float(outs[-1][0].numpy().ravel()[0])
+
+    for _ in range(warmup):
+        one_pass()
+    tm = profiler.benchmark()
+    tm.reset()
+    tm.begin()
+    for _ in range(steps):
+        one_pass()
+        tm.step(num_samples=B)
+    ips = tm.ips
+    tm.end()
+    # validity: host-side NMS on the decoded boxes of one image
+    outs = infer(x, img_shape)
+    boxes = np.concatenate([np.asarray(b.numpy())[0] for b, _ in outs])
+    scores = np.concatenate(
+        [np.asarray(s.numpy())[0].max(-1) for _, s in outs])
+    keep = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores), top_k=100)
+    return {
+        "value": round(ips, 2), "unit": "images/s/chip",
+        "details": {"mode": "to_static bf16 + yolo_box in-graph",
+                    "batch": B, "img": S,
+                    "nms_kept": int(np.asarray(keep.numpy()).shape[0])},
+    }
+
+
+# ---------------------------------------------------------------------------
 # Harness
 # ---------------------------------------------------------------------------
 
@@ -349,6 +464,7 @@ CONFIGS = [
     ("mnist_lenet_dygraph", bench_mnist_lenet),
     ("resnet50_static_amp", bench_resnet50_amp),
     ("bert_dp_sharding", bench_bert_dp_sharding),
+    ("ppyoloe_style_detector_infer", bench_detection_infer),
 ]
 
 
