@@ -29,5 +29,7 @@ from .kernels import (  # noqa: F401
     reduce,
     rnn_ops,
     search,
+    tail_math,
+    tail_nn,
     vision_ops,
 )

@@ -1,0 +1,631 @@
+"""Op tail: shape/indexing, pooling/interp, sequence/graph kernels.
+
+Second half of the §1-row-4 op-gap tranche (see tail_math.py). Notes on
+the TPU mapping:
+
+* fold/unpool are scatter-adds expressed as k·k static `.at[].add` steps —
+  XLA turns each into one fused dynamic-update stream, no host loops.
+* fractional pooling precomputes its (static) index sequences at trace
+  time — pseudo-random but shape-static, so the gather stays jittable.
+* graph message passing (send_u_recv family) uses `.at[].add/max` scatter,
+  which XLA lowers to sorted-segment ops on TPU.
+* dynamic-output ops (unique_consecutive, edit_distance, ctc_align) are
+  host/eager ops like nms — the reference runs these outside the engine's
+  hot path too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dispatch import register_op
+
+# ---------------------------------------------------------------------------
+# shape / indexing
+# ---------------------------------------------------------------------------
+
+
+@register_op
+def fill(x, value=0.0):
+    return jnp.full_like(x, value)
+
+
+@register_op
+def fill_diagonal(x, value=0.0, offset=0, wrap=False):
+    """2-D diagonal fill (reference fill_diagonal_kernel)."""
+    H, W = x.shape[-2], x.shape[-1]
+    i = jnp.arange(H)[:, None]
+    j = jnp.arange(W)[None, :]
+    mask = (j - i) == offset
+    if wrap and x.ndim == 2 and H > W:
+        # numpy-style wrapped diagonal for tall matrices
+        mask = ((j - i) % (W + 1) == offset) & ((j - i) <= offset)
+        mask = (i % (W + 1)) == (j - offset) if offset >= 0 else mask
+        mask = ((i - offset) % (W + 1) == j) if offset <= 0 else mask
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@register_op
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Write tensor y along the (dim1, dim2) diagonal (reference
+    fill_diagonal_tensor_kernel)."""
+    x2 = jnp.moveaxis(x, (dim1, dim2), (-2, -1))
+    H, W = x2.shape[-2], x2.shape[-1]
+    n = min(H, W - offset) if offset >= 0 else min(H + offset, W)
+    i = jnp.arange(n) + max(-offset, 0)
+    j = jnp.arange(n) + max(offset, 0)
+    y2 = jnp.moveaxis(y, -1, 0) if y.ndim > 1 else y
+    upd = x2.at[..., i, j].set(jnp.moveaxis(jnp.atleast_1d(y2), 0, -1)
+                               if y.ndim > 1 else y)
+    return jnp.moveaxis(upd, (-2, -1), (dim1, dim2))
+
+
+@register_op
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices) if isinstance(indices, (list, tuple)) else (indices,)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@register_op
+def reverse(x, axis):
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.flip(x, axis=axes)
+
+
+@register_op
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+@register_op
+def broadcast_tensors(inputs):
+    shape = jnp.broadcast_shapes(*[i.shape for i in inputs])
+    return [jnp.broadcast_to(i, shape) for i in inputs]
+
+
+@register_op(nondiff=True)
+def sequence_mask(x, maxlen=None, out_dtype="int64"):
+    m = int(maxlen) if maxlen is not None else None
+    if m is None:
+        raise ValueError("sequence_mask needs a static maxlen under jit; "
+                         "pass maxlen explicitly")
+    return (jnp.arange(m)[None, :] < x[..., None]).astype(out_dtype)
+
+
+@register_op
+def strided_slice(x, axes, starts, ends, strides):
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = slice(s, e, st)
+    return x[tuple(sl)]
+
+
+@register_op
+def split_with_num(x, num, axis=0):
+    return jnp.split(x, num, axis=axis)
+
+
+@register_op
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[sl]
+
+
+@register_op
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    p = list(paddings)  # [l, r, t, b, front, back] (reference order)
+    if data_format == "NCDHW":
+        cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    else:
+        cfg = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@register_op(nondiff=True)
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64"):
+    """Host op: output size is data-dependent (reference
+    unique_consecutive_kernel; deploy pipelines run it post-process)."""
+    a = np.asarray(x).ravel() if axis is None else np.asarray(x)
+    if axis is None:
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        out = a[keep]
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.concatenate(
+            [np.nonzero(keep)[0], [a.size]]))
+    else:
+        raise NotImplementedError("axis-wise unique_consecutive")
+    res = [jnp.asarray(out)]
+    if return_inverse:
+        res.append(jnp.asarray(inv.astype(dtype)))
+    if return_counts:
+        res.append(jnp.asarray(counts.astype(dtype)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+@register_op(nondiff=True)
+def repeat_interleave_with_tensor_index(x, repeats, axis=0):
+    """Host op: output length depends on `repeats` values."""
+    return jnp.asarray(np.repeat(np.asarray(x), np.asarray(repeats),
+                                 axis=axis))
+
+
+@register_op(nondiff=True)
+def shuffle_channel(x, group=1):
+    N, C, H, W = x.shape
+    return x.reshape(N, group, C // group, H, W).swapaxes(1, 2).reshape(
+        N, C, H, W)
+
+
+@register_op(nondiff=True)
+def partial_sum(inputs, start_index=0, length=-1):
+    end = None if length < 0 else start_index + length
+    return sum(i[:, start_index:end] for i in inputs)
+
+
+@register_op(nondiff=True)
+def partial_concat(inputs, start_index=0, length=-1):
+    end = None if length < 0 else start_index + length
+    return jnp.concatenate([i[:, start_index:end] for i in inputs], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# pooling / interp / im2col
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+@register_op
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im (reference fold_kernel): x [N, C*kh*kw, L] -> [N, C, H, W].
+    Inverse of unfold via kh*kw static scatter-adds."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N, CKK, L = x.shape
+    C = CKK // (kh * kw)
+    lh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    lw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(N, C, kh, kw, lh, lw)
+    out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + lh * sh:sh,
+                         wj:wj + lw * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def _unpool_nd(x, indices, output_size, spatial_ndim):
+    N, C = x.shape[:2]
+    flat = int(np.prod(output_size))
+    xv = x.reshape(N, C, -1)
+    iv = indices.reshape(N, C, -1)
+    out = jnp.zeros((N, C, flat), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, v, i: o.at[i].set(v)))(out, xv, iv)
+    return out.reshape((N, C) + tuple(output_size))
+
+
+@register_op
+def unpool(x, indices, kernel_size=2, stride=None, padding=0,
+           output_size=None, data_format="NCHW"):
+    """Max-unpooling 2D from max_pool2d_with_index's flat indices
+    (reference unpool_kernel)."""
+    if output_size is None:
+        k = _pair(kernel_size)
+        s = _pair(stride or kernel_size)
+        H, W = x.shape[2], x.shape[3]
+        output_size = ((H - 1) * s[0] + k[0], (W - 1) * s[1] + k[1])
+    return _unpool_nd(x, indices, tuple(output_size)[-2:], 2)
+
+
+@register_op
+def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
+             output_size=None, data_format="NCDHW"):
+    if output_size is None:
+        k = _pair(kernel_size, 3)
+        s = _pair(stride or kernel_size, 3)
+        D, H, W = x.shape[2], x.shape[3], x.shape[4]
+        output_size = ((D - 1) * s[0] + k[0], (H - 1) * s[1] + k[1],
+                       (W - 1) * s[2] + k[2])
+    return _unpool_nd(x, indices, tuple(output_size)[-3:], 3)
+
+
+@register_op
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    """(sum |x|^p)^(1/p) over windows (reference lp_pool2d)."""
+    k = _pair(kernel_size)
+    s = _pair(stride or kernel_size)
+    p = _pair(padding)
+    xf = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    acc = lax.reduce_window(xf, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                            ((0, 0), (0, 0)) + tuple((q, q) for q in p))
+    return (acc ** (1.0 / norm_type)).astype(x.dtype)
+
+
+def _fractional_bounds(in_size, out_size, u=0.5):
+    """Static pseudo-random index sequence (reference/torch algorithm:
+    idx_i = ceil(alpha*(i+u)) - 1 with alpha = in/out)."""
+    alpha = in_size / out_size
+    idx = [int(np.ceil(alpha * (i + u))) - 1 for i in range(out_size + 1)]
+    idx[0] = 0
+    idx[-1] = in_size
+    return idx
+
+
+@register_op
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None):
+    oh, ow = _pair(output_size)
+    u = 0.5 if random_u is None else float(random_u)
+    hb = _fractional_bounds(x.shape[2], oh, u)
+    wb = _fractional_bounds(x.shape[3], ow, u)
+    rows = [jnp.max(x[:, :, hb[i]:max(hb[i + 1], hb[i] + 1)], axis=2)
+            for i in range(oh)]
+    stacked = jnp.stack(rows, axis=2)  # [N, C, oh, W]
+    cols = [jnp.max(stacked[:, :, :, wb[j]:max(wb[j + 1], wb[j] + 1)],
+                    axis=3) for j in range(ow)]
+    return jnp.stack(cols, axis=3)
+
+
+@register_op
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None):
+    od, oh, ow = _pair(output_size, 3)
+    u = 0.5 if random_u is None else float(random_u)
+    db = _fractional_bounds(x.shape[2], od, u)
+    planes = [jnp.max(x[:, :, db[i]:max(db[i + 1], db[i] + 1)], axis=2)
+              for i in range(od)]
+    stacked = jnp.stack(planes, axis=2)  # [N, C, od, H, W]
+    per_plane = [fractional_max_pool2d.__wrapped__(
+        stacked[:, :, i], (oh, ow), None, u) for i in range(od)]
+    return jnp.stack(per_plane, axis=2)
+
+
+@register_op
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False):
+    """3-D max pool with flat argmax (reference max_pool3d_with_index) —
+    same patch-extraction design as the 2-D op in vision_ops."""
+    k = _pair(kernel_size, 3)
+    s = _pair(stride or kernel_size, 3)
+    p = _pair(padding, 3)
+    N, C, D, H, W = x.shape
+    if global_pooling:
+        k, s, p = (D, H, W), (1, 1, 1), (0, 0, 0)
+    neg = jnp.finfo(jnp.float32).min / 4
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0)) + tuple((q, q) for q in p),
+                 constant_values=neg)
+    Do = (xp.shape[2] - k[0]) // s[0] + 1
+    Ho = (xp.shape[3] - k[1]) // s[1] + 1
+    Wo = (xp.shape[4] - k[2]) // s[2] + 1
+    patches = []
+    for dz in range(k[0]):
+        for dy in range(k[1]):
+            for dx in range(k[2]):
+                patches.append(lax.slice(
+                    xp, (0, 0, dz, dy, dx),
+                    (N, C, dz + (Do - 1) * s[0] + 1,
+                     dy + (Ho - 1) * s[1] + 1, dx + (Wo - 1) * s[2] + 1),
+                    (1, 1, s[0], s[1], s[2])))
+    stack = jnp.stack(patches, axis=2)  # [N, C, k3, Do, Ho, Wo]
+    out = stack.max(axis=2).astype(x.dtype)
+    arg = stack.argmax(axis=2)
+    kz = arg // (k[1] * k[2])
+    ky = (arg // k[2]) % k[1]
+    kx = arg % k[2]
+    dzi = jnp.arange(Do)[:, None, None] * s[0] + kz - p[0]
+    dyi = jnp.arange(Ho)[None, :, None] * s[1] + ky - p[1]
+    dxi = jnp.arange(Wo)[None, None, :] * s[2] + kx - p[2]
+    flat = (dzi * H + dyi) * W + dxi
+    return out, flat.astype(jnp.int64)
+
+
+def _cubic_w(t, a=-0.75):
+    t = jnp.abs(t)
+    w1 = ((a + 2) * t - (a + 3)) * t * t + 1
+    w2 = (((t - 5) * t + 8) * t - 4) * a
+    return jnp.where(t <= 1, w1, jnp.where(t < 2, w2, 0.0))
+
+
+@register_op
+def bicubic_interp(x, out_h, out_w, align_corners=True):
+    """Separable cubic-convolution resize (reference bicubic_interp_kernel,
+    a=-0.75)."""
+    N, C, H, W = x.shape
+
+    def positions(out_s, in_s):
+        if align_corners and out_s > 1:
+            return jnp.arange(out_s) * (in_s - 1) / (out_s - 1)
+        return (jnp.arange(out_s) + 0.5) * in_s / out_s - 0.5
+
+    ys = positions(out_h, H)
+    xs = positions(out_w, W)
+    xf = x.astype(jnp.float32)
+
+    def gather_axis(arr, pos, size, axis):
+        base = jnp.floor(pos).astype(jnp.int32)
+        total = None
+        for off in (-1, 0, 1, 2):
+            idx = jnp.clip(base + off, 0, size - 1)
+            w = _cubic_w(pos - (base + off))
+            piece = jnp.take(arr, idx, axis=axis)
+            shape = [1] * arr.ndim
+            shape[axis] = -1
+            piece = piece * w.reshape(shape)
+            total = piece if total is None else total + piece
+        return total
+
+    tmp = gather_axis(xf, ys, H, 2)
+    out = gather_axis(tmp, xs, W, 3)
+    return out.astype(x.dtype)
+
+
+@register_op
+def trilinear_interp(x, out_d, out_h, out_w, align_corners=True,
+                     align_mode=1):
+    """3-D linear resize, separable (reference trilinear_interp_kernel)."""
+    N, C, D, H, W = x.shape
+
+    def positions(out_s, in_s):
+        if align_corners and out_s > 1:
+            return jnp.arange(out_s) * (in_s - 1) / (out_s - 1)
+        if align_mode == 1:
+            return jnp.clip(jnp.arange(out_s) * in_s / out_s, 0, in_s - 1)
+        return jnp.clip((jnp.arange(out_s) + 0.5) * in_s / out_s - 0.5,
+                        0, in_s - 1)
+
+    def lerp_axis(arr, pos, size, axis):
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, size - 1)
+        w = pos - lo
+        shape = [1] * arr.ndim
+        shape[axis] = -1
+        return (jnp.take(arr, lo, axis=axis) * (1 - w).reshape(shape)
+                + jnp.take(arr, hi, axis=axis) * w.reshape(shape))
+
+    xf = x.astype(jnp.float32)
+    xf = lerp_axis(xf, positions(out_d, D), D, 2)
+    xf = lerp_axis(xf, positions(out_h, H), H, 3)
+    xf = lerp_axis(xf, positions(out_w, W), W, 4)
+    return xf.astype(x.dtype)
+
+
+@register_op
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Power-iteration spectral normalisation (reference
+    spectral_norm_kernel): returns weight / sigma."""
+    w = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(max(power_iters, 0)):
+        v = w.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w @ v
+    return weight / (sigma + eps)
+
+
+# ---------------------------------------------------------------------------
+# sequence / graph / decode
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree_kernel):
+    ids/parents [T, B, beam] -> full paths [T, B, beam]."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beam_idx = carry  # [B, beam]
+        out_t = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent, out_t
+
+    init = jnp.tile(jnp.arange(ids.shape[2])[None, :], (ids.shape[1], 1))
+    _, outs = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+@register_op(nondiff=True)
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized=True):
+    """Levenshtein DP (reference edit_distance_kernel). Host op: the DP
+    table is data-length-dependent."""
+    h = np.asarray(hyps)
+    r = np.asarray(refs)
+    B = h.shape[0]
+    hl = np.asarray(hyp_lengths) if hyp_lengths is not None \
+        else np.full(B, h.shape[1])
+    rl = np.asarray(ref_lengths) if ref_lengths is not None \
+        else np.full(B, r.shape[1])
+    out = np.zeros((B, 1), np.float32)
+    for b in range(B):
+        m, n = int(hl[b]), int(rl[b])
+        dp = np.arange(n + 1, dtype=np.int32)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if h[b, i - 1] == r[b, j - 1] else 1
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+        d = float(dp[n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    return jnp.asarray(out), jnp.asarray(np.int64(B))
+
+
+@register_op(nondiff=True)
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0):
+    """Collapse repeats + strip blanks (reference ctc_align_op). Host op:
+    output lengths are data-dependent; result is padded back to input
+    width with `padding_value`."""
+    a = np.asarray(input)
+    B, T = a.shape
+    lens = np.asarray(input_length).reshape(-1) if input_length is not None \
+        else np.full(B, T)
+    out = np.full((B, T), padding_value, a.dtype)
+    for b in range(B):
+        prev = None
+        k = 0
+        for t in range(int(lens[b])):
+            v = a[b, t]
+            if merge_repeated and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                out[b, k] = v
+                k += 1
+    return jnp.asarray(out)
+
+
+@register_op
+def sequence_pool(x, lengths, pool_type="SUM"):
+    """Masked pooling over time (reference sequence_pool kernel on padded
+    [B, T, D] layout — the LoD layout is a CPU-ism; TPU wants padded)."""
+    T = x.shape[1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])[..., None]
+    pt = pool_type.upper()
+    if pt == "SUM":
+        return jnp.sum(x * mask, axis=1)
+    if pt == "AVERAGE":
+        return jnp.sum(x * mask, axis=1) / jnp.maximum(
+            lengths[:, None], 1).astype(x.dtype)
+    if pt == "SQRT":
+        return jnp.sum(x * mask, axis=1) / jnp.sqrt(
+            jnp.maximum(lengths[:, None], 1).astype(x.dtype))
+    if pt == "MAX":
+        return jnp.max(jnp.where(mask, x, -jnp.inf), axis=1)
+    if pt == "FIRST":
+        return x[:, 0]
+    if pt == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+@register_op
+def segment_pool(x, segment_ids, pooltype="SUM", num_segments=None):
+    """Segment reduce (reference segment_pool_kernel). `num_segments`
+    must be static under jit (pass it explicitly; eager infers)."""
+    n = int(num_segments) if num_segments is not None \
+        else int(jnp.max(segment_ids)) + 1
+    pt = pooltype.upper()
+    if pt == "SUM":
+        return jax.ops.segment_sum(x, segment_ids, num_segments=n)
+    if pt in ("MEAN", "AVERAGE"):
+        s = jax.ops.segment_sum(x, segment_ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(x), segment_ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)
+    if pt == "MAX":
+        return jax.ops.segment_max(x, segment_ids, num_segments=n)
+    if pt == "MIN":
+        return jax.ops.segment_min(x, segment_ids, num_segments=n)
+    raise ValueError(f"unknown pooltype {pooltype!r}")
+
+
+def _message(x_src, y_edge, op):
+    if op == "ADD":
+        return x_src + y_edge
+    if op == "MUL":
+        return x_src * y_edge
+    raise ValueError(f"unknown message_op {op!r}")
+
+
+@register_op
+def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None):
+    """Graph gather-scatter (reference send_u_recv kernel): message =
+    x[src], reduced at dst."""
+    n = int(out_size) if out_size else x.shape[0]
+    msg = jnp.take(x, src_index, axis=0)
+    if reduce_op.upper() == "SUM":
+        return jax.ops.segment_sum(msg, dst_index, num_segments=n)
+    if reduce_op.upper() == "MEAN":
+        s = jax.ops.segment_sum(msg, dst_index, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0], 1)), dst_index,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)
+    if reduce_op.upper() == "MAX":
+        return jax.ops.segment_max(msg, dst_index, num_segments=n)
+    if reduce_op.upper() == "MIN":
+        return jax.ops.segment_min(msg, dst_index, num_segments=n)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+@register_op
+def send_ue_recv(x, y, src_index, dst_index, message_op="ADD",
+                 reduce_op="SUM", out_size=None):
+    n = int(out_size) if out_size else x.shape[0]
+    msg = _message(jnp.take(x, src_index, axis=0), y, message_op.upper())
+    return send_u_recv.__wrapped__(msg, jnp.arange(msg.shape[0]),
+                                   dst_index, reduce_op, n)
+
+
+@register_op
+def send_uv(x, y, src_index, dst_index, message_op="ADD"):
+    return _message(jnp.take(x, src_index, axis=0),
+                    jnp.take(y, dst_index, axis=0), message_op.upper())
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def top_p_sampling(x, ps, threshold=None, seed=0):
+    """Nucleus sampling -> (scores, ids) (reference top_p_sampling):
+    renormalise the smallest prefix of sorted probs reaching mass p."""
+    sorted_p = jnp.sort(x, axis=-1)[..., ::-1]
+    sorted_i = jnp.argsort(x, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    keep = cum - sorted_p < ps[..., None]
+    probs = jnp.where(keep, sorted_p, 0.0)
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    key = jax.random.PRNGKey(seed)
+    choice = jax.random.categorical(key, jnp.log(probs + 1e-12), axis=-1)
+    ids = jnp.take_along_axis(sorted_i, choice[..., None], axis=-1)
+    score = jnp.take_along_axis(sorted_p, choice[..., None], axis=-1)
+    return score, ids.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0,
+                              a=-2.0, b=2.0, dtype="float32"):
+    key = jax.random.PRNGKey(seed)
+    return (mean + std * jax.random.truncated_normal(
+        key, a, b, tuple(shape))).astype(dtype)
+
+
+@register_op(nondiff=True)
+def standard_gamma(x, seed=0):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.gamma(key, x)
+
+
+@register_op(nondiff=True)
+def binomial(count, prob, seed=0):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.binomial(key, count, prob).astype(jnp.int64)

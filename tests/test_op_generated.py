@@ -575,6 +575,243 @@ add_specs({
                   np.array([6, 6], np.int32), np.array([3, 2], np.int32)]),
 })
 
+# --- tail tranche: math / norms / losses (ops/kernels/tail_math.py) ---------
+add_specs({
+    "copysign": S([away0(2, 3), away0(2, 3, seed=9)], grad=(0,),
+                  ref=np.copysign),
+    "nextafter": S([sym(2, 3), sym(2, 3, seed=9)], ref=np.nextafter),
+    "gammaln": S([pos(2, 3)], grad=(0,)),
+    "gammaincc": S([pos(2, 3, lo=1.0, hi=3.0), pos(2, 3, seed=9)],
+                   grad=(1,)),
+    "logcumsumexp": S([sym(2, 3)], grad=(0,)),
+    "logsigmoid": S([sym(2, 3)], grad=(0,), bf16=True),
+    "tanh_shrink": S([sym(2, 3)], grad=(0,), bf16=True,
+                     ref=lambda x: x - np.tanh(x)),
+    "dist": S([sym(2, 3), sym(2, 3, seed=9)], grad=(0, 1),
+              ref=lambda x, y: np.sqrt(((x - y) ** 2).sum())),
+    "nanmedian": S([sym(2, 3)], ref=np.nanmedian),
+    "mean_all": S([sym(2, 3)], grad=(0,), bf16=True, ref=np.mean),
+    "frobenius_norm": S([sym(2, 3)], grad=(0,),
+                        ref=lambda x: np.sqrt((x * x).sum())),
+    "l1_norm": S([away0(2, 3)], grad=(0,),
+                 ref=lambda x: np.abs(x).sum()),
+    "squared_l2_norm": S([sym(2, 3)], grad=(0,),
+                         ref=lambda x: (x * x).sum()),
+    "clip_by_norm": S([sym(2, 3)], kwargs={"max_norm": 1.0}, grad=(0,)),
+    "renorm": S([sym(2, 3)], kwargs={"p": 2.0, "axis": 1, "max_norm": 0.5},
+                grad=(0,)),
+    "label_smooth": S([frac01(2, 4)], grad=(0,),
+                      ref=lambda x: 0.9 * x + 0.1 / 4),
+    "bitwise_left_shift": S([ints(2, 3), ints(2, 3, lo=0, hi=3, seed=9)],
+                            ref=np.left_shift),
+    "bitwise_right_shift": S([ints(2, 3, hi=64),
+                              ints(2, 3, lo=0, hi=3, seed=9)],
+                             ref=np.right_shift),
+    "numel": S([sym(2, 3)], ref=lambda x: np.int64(x.size)),
+    "increment": S([sym(2, 3)], kwargs={"value": 2.0}, grad=(0,),
+                   ref=lambda x: x + 2.0),
+    "rrelu": S([away0(2, 3)], kwargs={"is_test": True}, grad=(0,)),
+    "diagonal": S([sym(3, 3)], grad=(0,), ref=np.diagonal),
+    "fused_softmax_mask": S([sym(2, 2, 3, 4), sym(2, 2, 3, 4, seed=9)],
+                            grad=(0,)),
+    "fused_softmax_mask_upper_triangle": S([sym(2, 2, 4, 4)], grad=(0,)),
+    "apply_per_channel_scale": S([sym(2, 3), pos(3)], grad=(0, 1),
+                                 ref=lambda x, s: x * s),
+    "bce_loss": S([frac01(2, 3), frac01(2, 3, seed=9)], grad=(0,)),
+    "hinge_loss": S(
+        [sym(2, 3), ints(2, 3, lo=0, hi=2, dtype=np.float32)],
+        ref=lambda x, y: np.maximum(0.0, 1.0 - (2 * y - 1) * x)),
+    "log_loss": S([frac01(2, 3), frac01(2, 3, seed=9)], grad=(0,)),
+    "kldiv_loss": S([np.log(frac01(2, 3)), frac01(2, 3, seed=9)],
+                    kwargs={"reduction": "mean"}, grad=(0,)),
+    "sigmoid_cross_entropy_with_logits": S(
+        [sym(2, 3), frac01(2, 3, seed=9)], grad=(0,)),
+    "identity_loss": S([sym(2, 3)], kwargs={"reduction": 1}, grad=(0,),
+                       ref=np.mean),
+    "margin_cross_entropy": S([unit(2, 6), ints(2, lo=0, hi=6)],
+                              grad=(0,)),
+})
+
+# --- tail tranche: quantization family --------------------------------------
+add_specs({
+    "fake_quantize_abs_max": S([sym(2, 3)]),
+    "fake_dequantize_max_abs": S([sym(2, 3) * 100, np.asarray(0.8,
+                                                             np.float32)],
+                                 kwargs={"max_range": 127.0}),
+    "dequantize_abs_max": S([ints(2, 3, lo=-100, hi=100, dtype=np.int32),
+                             np.asarray(0.8, np.float32)],
+                            kwargs={"max_range": 127.0}),
+    "fake_channel_wise_quantize_abs_max": S([sym(4, 3)]),
+    "fake_channel_wise_dequantize_max_abs": S(
+        [sym(4, 3) * 100, pos(4)], kwargs={"quant_axis": 0}),
+    "fake_channel_wise_quantize_dequantize_abs_max": S([sym(4, 3)]),
+    "fake_quantize_moving_average_abs_max": S(
+        [sym(2, 3), np.asarray(0.5, np.float32)]),
+    "fake_quantize_dequantize_moving_average_abs_max": S(
+        [sym(2, 3), np.asarray(0.5, np.float32)]),
+    "fake_quantize_range_abs_max": S(
+        [sym(2, 3), np.asarray(0.5, np.float32)]),
+    "weight_quantize": S([sym(4, 3)]),
+    "weight_dequantize": S([ints(4, 3, lo=-127, hi=127, dtype=np.int8),
+                            pos(3)]),
+    "weight_only_linear": S([sym(2, 4),
+                             ints(4, 3, lo=-127, hi=127, dtype=np.int8),
+                             sym(3, seed=9), pos(3, seed=4)], grad=(0,)),
+    "llm_int8_linear": S([sym(2, 4),
+                          ints(4, 3, lo=-127, hi=127, dtype=np.int8)],
+                         kwargs={"weight_scale": pos(3),
+                                 "threshold": 6.0}),
+})
+
+# --- tail tranche: optimizer update ops -------------------------------------
+_lr = np.asarray(0.1, np.float32)
+_pw = np.asarray(0.9, np.float32)
+add_specs({
+    "sgd_": S([sym(4), _lr, sym(4, seed=9)],
+              ref=lambda p, lr, g: p - lr * g),
+    "momentum_": S([sym(4), sym(4, seed=9), sym(4, seed=5), _lr],
+                   kwargs={"mu": 0.9},
+                   ref=lambda p, g, v, lr: (p - lr * (0.9 * v + g),
+                                            0.9 * v + g)),
+    "adam_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                pos(4, seed=6) * 0.1, _pw, _pw]),
+    "adamw_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                 pos(4, seed=6) * 0.1, _pw, _pw]),
+    "adagrad_": S([sym(4), sym(4, seed=9), pos(4, seed=5), _lr],
+                  ref=lambda p, g, m, lr: (
+                      p - lr * g / (np.sqrt(m + g * g) + 1e-6),
+                      m + g * g)),
+    "adadelta_": S([sym(4), sym(4, seed=9), pos(4, seed=5),
+                    pos(4, seed=6)]),
+    "adamax_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                  pos(4, seed=6), _pw]),
+    "rmsprop_": S([sym(4), pos(4, seed=5), sym(4, seed=9),
+                   sym(4, seed=6) * 0.1, _lr]),
+    "lamb_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                pos(4, seed=6) * 0.1, _pw, _pw]),
+    "nadam_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                 pos(4, seed=6) * 0.1, _pw, _pw]),
+    "radam_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5) * 0.1,
+                 pos(4, seed=6) * 0.1, _pw, _pw]),
+    "asgd_": S([sym(4), sym(4, seed=9), _lr, sym(4, seed=5),
+                sym(4, seed=6), np.asarray(4.0, np.float32)]),
+    "ftrl_": S([sym(4), pos(4, seed=5), sym(4, seed=6), sym(4, seed=9),
+                _lr]),
+})
+
+# --- tail tranche: shape / pooling / sequence / graph -----------------------
+
+
+def _np_lp_pool(x):
+    w = (x.astype(np.float64) ** 2).reshape(1, 2, 2, 2, 2, 2)
+    return np.sqrt(w.sum(axis=(3, 5))).astype(np.float32)
+
+
+def _np_gather_tree(ids, parents):
+    T, B, K = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(K):
+            beam = k
+            for t in range(T - 1, -1, -1):
+                out[t, b, k] = ids[t, b, beam]
+                beam = parents[t, b, beam]
+    return out
+
+
+add_specs({
+    "fill": S([sym(2, 3)], kwargs={"value": 2.5},
+              ref=lambda x: np.full_like(x, 2.5)),
+    "fill_diagonal": S([sym(3, 4)], kwargs={"value": 9.0},
+                       ref=lambda x: (lambda c: (
+                           np.fill_diagonal(c, 9.0), c)[1])(x.copy())),
+    "fill_diagonal_tensor": S([sym(3, 3), sym(3, seed=9)]),
+    "index_put": S([sym(4, 3), [ints(2, lo=0, hi=4)], sym(2, 3, seed=9)],
+                   grad=(0,)),
+    "reverse": S([sym(2, 3)], kwargs={"axis": 1}, grad=(0,),
+                 ref=lambda x: np.flip(x, 1)),
+    "unstack": S([sym(3, 4)], grad=(0,),
+                 ref=lambda x: [x[i] for i in range(3)]),
+    "broadcast_tensors": S([[sym(2, 3), sym(1, 3, seed=9)]]),
+    "sequence_mask": S([ints(3, lo=1, hi=5)], kwargs={"maxlen": 6},
+                       ref=lambda l: (np.arange(6)[None, :]
+                                      < l[:, None]).astype(np.int64)),
+    "strided_slice": S([sym(4, 5)],
+                       kwargs={"axes": [0, 1], "starts": [1, 0],
+                               "ends": [4, 5], "strides": [2, 2]},
+                       grad=(0,), ref=lambda x: x[1:4:2, 0:5:2]),
+    "split_with_num": S([sym(2, 6)], kwargs={"num": 3, "axis": 1},
+                        grad=(0,)),
+    "crop": S([sym(4, 5)], kwargs={"shape": [2, 2], "offsets": [1, 1]},
+              grad=(0,), ref=lambda x: x[1:3, 1:3]),
+    "pad3d": S([sym(1, 2, 2, 3, 3)],
+               kwargs={"paddings": [1, 1, 0, 0, 1, 0]}, grad=(0,),
+               ref=lambda x: np.pad(x, [(0, 0), (0, 0), (1, 0), (0, 0),
+                                        (1, 1)])),
+    "unique_consecutive": S([np.array([1, 1, 2, 2, 3, 1], np.int64)],
+                            no_jit=True,
+                            ref=lambda x: np.array([1, 2, 3, 1])),
+    "repeat_interleave_with_tensor_index": S(
+        [sym(3, 2), ints(3, lo=1, hi=3)], no_jit=True),
+    "shuffle_channel": S([sym(2, 4, 2, 2)], kwargs={"group": 2}),
+    "partial_sum": S([[sym(2, 6), sym(2, 6, seed=9)]],
+                     kwargs={"start_index": 1, "length": 3}),
+    "partial_concat": S([[sym(2, 6), sym(2, 6, seed=9)]],
+                        kwargs={"start_index": 1, "length": 3}),
+    "fold": S([sym(1, 4, 4)], kwargs={"output_sizes": (3, 3),
+                                      "kernel_sizes": (2, 2)}, grad=(0,)),
+    "unpool": S([pos(1, 1, 2, 2),
+                 np.array([[[[0, 3], [12, 15]]]], np.int64)],
+                kwargs={"kernel_size": 2}),
+    "unpool3d": S([pos(1, 1, 1, 2, 2),
+                   np.array([[[[[0, 3], [12, 15]]]]], np.int64)],
+                  kwargs={"kernel_size": 2, "output_size": (2, 4, 4)}),
+    "lp_pool2d": S([pos(1, 2, 4, 4)],
+                   kwargs={"norm_type": 2.0, "kernel_size": 2},
+                   grad=(0,), ref=_np_lp_pool),
+    "fractional_max_pool2d": S([sym(1, 1, 6, 6)],
+                               kwargs={"output_size": 3}),
+    "fractional_max_pool3d": S([sym(1, 1, 4, 6, 6)],
+                               kwargs={"output_size": (2, 3, 3)}),
+    "max_pool3d_with_index": S([sym(1, 1, 4, 4, 4)],
+                               kwargs={"kernel_size": 2}),
+    "bicubic_interp": S([sym(1, 2, 4, 4)],
+                        kwargs={"out_h": 8, "out_w": 8}, grad=(0,)),
+    "trilinear_interp": S([sym(1, 1, 2, 4, 4)],
+                          kwargs={"out_d": 4, "out_h": 8, "out_w": 8},
+                          grad=(0,)),
+    "spectral_norm": S([sym(4, 3), pos(4), pos(3)],
+                       kwargs={"power_iters": 2}),
+    "gather_tree": S([ints(3, 2, 2, lo=0, hi=5),
+                      ints(3, 2, 2, lo=0, hi=2, seed=9)],
+                     ref=_np_gather_tree),
+    "edit_distance": S([np.array([[1, 2, 3]], np.int64),
+                        np.array([[1, 3, 3]], np.int64)], no_jit=True,
+                       ref=lambda h, r: np.array([[1.0 / 3.0]],
+                                                 np.float32)),
+    "ctc_align": S([np.array([[0, 1, 1, 0, 2, 2]], np.int64)],
+                   no_jit=True,
+                   ref=lambda x: np.array([[1, 2, 0, 0, 0, 0]], np.int64)),
+    "sequence_pool": S([sym(2, 4, 3), ints(2, lo=1, hi=5)],
+                       kwargs={"pool_type": "SUM"}, grad=(0,)),
+    "segment_pool": S([sym(6, 3), np.array([0, 0, 1, 1, 2, 2], np.int32)],
+                      kwargs={"pooltype": "SUM", "num_segments": 3},
+                      grad=(0,)),
+    "send_u_recv": S([sym(4, 3), ints(5, lo=0, hi=4),
+                      ints(5, lo=0, hi=4, seed=9)],
+                     kwargs={"out_size": 4}, grad=(0,)),
+    "send_ue_recv": S([sym(4, 3), sym(5, 3, seed=9), ints(5, lo=0, hi=4),
+                       ints(5, lo=0, hi=4, seed=7)],
+                      kwargs={"out_size": 4}, grad=(0,)),
+    "send_uv": S([sym(4, 3), sym(4, 3, seed=9), ints(5, lo=0, hi=4),
+                  ints(5, lo=0, hi=4, seed=7)], grad=(0, 1)),
+    "top_p_sampling": S([frac01(2, 5), frac01(2, seed=9)], rand=True),
+    "truncated_gaussian_random": S([[3, 4]], rand=True),
+    "standard_gamma": S([pos(2, 3)], rand=True),
+    "binomial": S([pos(2, 3, lo=1.0, hi=10.0), frac01(2, 3, seed=9)],
+                  rand=True),
+})
+
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
     # pytree-structured inputs (flat weight list + optional masks) don't fit
