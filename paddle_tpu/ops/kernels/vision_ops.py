@@ -347,7 +347,14 @@ def yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
     anchors = list(anchors)
     an = len(anchors) // 2
     N, _, H, W = x.shape
-    xr = x.astype(jnp.float32).reshape(N, an, 5 + class_num, H, W)
+    xf = x.astype(jnp.float32)
+    if iou_aware:
+        # channel layout with iou_aware (reference GetIoUIndex/GetEntryIndex,
+        # funcs/yolo_box_util.h:57): channels [0, an) are the per-anchor IoU
+        # predictions, the remaining an*(5+cls) are the standard entries
+        ioup = jax.nn.sigmoid(xf[:, :an])                # [N, an, H, W]
+        xf = xf[:, an:]
+    xr = xf.reshape(N, an, 5 + class_num, H, W)
     gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
     gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
     alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
@@ -359,6 +366,9 @@ def yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
     bw = jnp.exp(xr[:, :, 2]) * aw / in_w
     bh = jnp.exp(xr[:, :, 3]) * ah / in_h
     obj = jax.nn.sigmoid(xr[:, :, 4])
+    if iou_aware:
+        # conf = obj^(1-f) * iou^f (reference yolo_box kernel iou_aware path)
+        obj = (obj ** (1.0 - iou_aware_factor)) * (ioup ** iou_aware_factor)
     keep_mask = obj >= conf_thresh
     obj = jnp.where(keep_mask, obj, 0.0)
     cls = jax.nn.sigmoid(xr[:, :, 5:])
@@ -458,14 +468,21 @@ def box_coder(prior_box, prior_box_var, target_box,
         ow = jnp.log(jnp.abs(tw[:, None] / pw[None])) / pv[None, :, 2]
         oh = jnp.log(jnp.abs(th[:, None] / ph[None])) / pv[None, :, 3]
         return jnp.stack([ox, oy, ow, oh], axis=-1)
-    # decode_center_size: target [K, P, 4] deltas (or [K,4] with axis)
+    # decode_center_size: target [R, C, 4] deltas; `axis` picks which
+    # target dim the priors pair with (reference impl/box_coder.h:123:
+    # prior_box_offset = axis == 0 ? j * len : i * len)
     if tb.ndim == 2:
         tb = tb[:, None, :]
-    d = tb * pv[None] if prior_box_var is not None else tb
-    dcx = d[..., 0] * pw[None] + pcx[None]
-    dcy = d[..., 1] * ph[None] + pcy[None]
-    dw = jnp.exp(d[..., 2]) * pw[None]
-    dh = jnp.exp(d[..., 3]) * ph[None]
+
+    def along(v):
+        # axis=0: priors run along dim 1 (columns); axis=1: along dim 0
+        return v[None, :] if axis == 0 else v[:, None]
+
+    d = tb * along(pv) if prior_box_var is not None else tb
+    dcx = d[..., 0] * along(pw) + along(pcx)
+    dcy = d[..., 1] * along(ph) + along(pcy)
+    dw = jnp.exp(d[..., 2]) * along(pw)
+    dh = jnp.exp(d[..., 3]) * along(ph)
     return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
                       dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], axis=-1)
 
@@ -498,46 +515,52 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     box's score by its overlap with higher-scored same-class boxes — fully
     static shapes (jit-able), unlike hard NMS."""
     B, C, M = scores.shape[0], scores.shape[1], scores.shape[2]
-    out_all = []
-    for b in range(B):
-        per_img = []
-        for c in range(C):
-            if c == background_label:
-                continue
-            sc = scores[b, c]
-            k = min(nms_top_k if nms_top_k > 0 else M, M)
-            idx = jnp.argsort(-sc)[:k]
-            sc_s = sc[idx]
-            bx = bboxes[b][idx]
-            iou = _iou_matrix(bx, bx)
-            iou = jnp.triu(iou, k=1)                    # pairs (i < j)
-            # decay_j = min_{i<j} f(iou_ij) / f(comp_i), comp_i = suppressor
-            # i's own max overlap with anything scored above IT
-            comp = iou.max(axis=0)                      # [k], by box index
-            if use_gaussian:
-                decay = jnp.exp(-(iou ** 2 - comp[:, None] ** 2)
-                                / gaussian_sigma).min(axis=0)
-            else:
-                decay = ((1 - iou) / jnp.maximum(1 - comp[:, None], 1e-10)
-                         ).min(axis=0)
-            dec = sc_s * decay
-            dec = jnp.where(sc_s > score_threshold, dec, 0.0)
-            per_img.append((jnp.full_like(dec, c), dec, bx))
-        if not per_img:
-            out_all.append(jnp.zeros((max(keep_top_k, 0), 6), jnp.float32))
-            continue
-        labels = jnp.concatenate([p[0] for p in per_img])
-        decs = jnp.concatenate([p[1] for p in per_img])
-        boxes = jnp.concatenate([p[2] for p in per_img], axis=0)
+    k = min(nms_top_k if nms_top_k > 0 else M, M)
+    offset = 0.0 if normalized else 1.0
+
+    def per_class(sc, bx_img):
+        """sc [M], bx_img [M,4] -> (decayed [k], boxes [k,4], idx [k])."""
+        idx = jnp.argsort(-sc)[:k]
+        sc_s = sc[idx]
+        bx = bx_img[idx]
+        iou = jnp.triu(_iou_matrix(bx, bx, offset=offset), k=1)  # i < j
+        # decay_j = min_{i<j} f(iou_ij) / f(comp_i), comp_i = suppressor
+        # i's own max overlap with anything scored above IT
+        comp = iou.max(axis=0)
+        if use_gaussian:
+            decay = jnp.exp(-(iou ** 2 - comp[:, None] ** 2)
+                            / gaussian_sigma).min(axis=0)
+        else:
+            decay = ((1 - iou) / jnp.maximum(1 - comp[:, None], 1e-10)
+                     ).min(axis=0)
+        dec = jnp.where(sc_s > score_threshold, sc_s * decay, 0.0)
+        return dec, bx, idx
+
+    def per_image(sc_img, bx_img):
+        """sc_img [C, M], bx_img [M, 4] -> (out [keep, 6], idx [keep])."""
+        decs, bxs, idxs = jax.vmap(
+            lambda s: per_class(s, bx_img))(sc_img)      # [C,k],[C,k,4],[C,k]
+        labels = jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.float32)[:, None], (C, k))
+        if 0 <= background_label < C:
+            decs = decs.at[background_label].set(0.0)
+        decs = decs.reshape(-1)
+        labels = labels.reshape(-1)
+        bxs = bxs.reshape(-1, 4)
+        idxs = idxs.reshape(-1)
         if post_threshold > 0:
             decs = jnp.where(decs >= post_threshold, decs, 0.0)
         keep = min(keep_top_k if keep_top_k > 0 else decs.shape[0],
                    decs.shape[0])
         order = jnp.argsort(-decs)[:keep]
-        out = jnp.concatenate([labels[order][:, None], decs[order][:, None],
-                               boxes[order]], axis=1)
-        out_all.append(out)
-    return jnp.stack(out_all)                            # [B, keep, 6]
+        out = jnp.concatenate(
+            [labels[order][:, None], decs[order][:, None], bxs[order]],
+            axis=1)
+        return out, idxs[order].astype(jnp.int64)
+
+    out, idx = jax.vmap(per_image)(scores.astype(jnp.float32),
+                                   bboxes.astype(jnp.float32))
+    return out, idx                     # [B, keep, 6], [B, keep]
 
 
 @register_op(nondiff=True)
@@ -862,14 +885,35 @@ def pool3d(x, kernel_size, stride=None, padding=0, pooling_type="max",
         padding = (padding,) * 3
     dims = (1, 1) + tuple(kernel_size)
     strides = (1, 1) + tuple(stride)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    # ceil_mode: extend the high-side padding so the trailing partial
+    # window produces an output element (reference pool3d ceil semantics);
+    # the extension never counts toward averages
+    extra = [0, 0, 0]
+    if ceil_mode:
+        for i in range(3):
+            span = x.shape[2 + i] + 2 * padding[i] - kernel_size[i]
+            floor_out = span // stride[i] + 1
+            ceil_out = -(-span // stride[i]) + 1
+            extra[i] = (ceil_out - 1) * stride[i] - span if \
+                ceil_out > floor_out else 0
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(padding, extra))
     xf = x.astype(jnp.float32)
     if pooling_type == "max":
         out = lax.reduce_window(xf, -jnp.inf, lax.max, dims, strides, pads)
     else:
         s = lax.reduce_window(xf, 0.0, lax.add, dims, strides, pads)
         if count_include_pad:
-            out = s / np.prod(kernel_size)
+            # symmetric padding counts; the ceil extension does not
+            ones = jnp.pad(jnp.ones_like(xf),
+                           ((0, 0), (0, 0)) + tuple(
+                               (p, p) for p in padding),
+                           constant_values=1.0)
+            ones = jnp.pad(ones, ((0, 0), (0, 0)) + tuple(
+                (0, e) for e in extra), constant_values=0.0)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                    ((0, 0),) * 5)
+            out = s / jnp.maximum(cnt, 1.0)
         else:
             ones = jnp.ones_like(xf)
             cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)

@@ -87,12 +87,33 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
                keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
                background_label=0, normalized=True, return_index=False,
                return_rois_num=True, name=None):
-    return _C_ops.matrix_nms(
+    """Reference return contract (vision/ops.py matrix_nms): Out
+    [total, 6] concatenated over the batch, RoisNum [B] per-image counts
+    (return_rois_num), Index [total, 1] original box indices
+    (return_index). The kernel's static [B, keep, 6] grid is compacted on
+    host — rows decayed to score 0 are padding, not detections."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    out, idx = _C_ops.matrix_nms(
         bboxes, scores, score_threshold=score_threshold,
         post_threshold=post_threshold, nms_top_k=nms_top_k,
         keep_top_k=keep_top_k, use_gaussian=use_gaussian,
         gaussian_sigma=gaussian_sigma, background_label=background_label,
         normalized=normalized)
+    o = np.asarray(out._data)
+    ix = np.asarray(idx._data)
+    valid = o[:, :, 1] > 0.0
+    rois = valid.sum(axis=1).astype(np.int32)
+    flat = o[valid]
+    flat_idx = ix[valid][:, None].astype(np.int64)
+    result = [Tensor(flat)]
+    if return_rois_num:
+        result.append(Tensor(rois))
+    if return_index:
+        result.append(Tensor(flat_idx))
+    return result[0] if len(result) == 1 else tuple(result)
 
 
 def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,

@@ -250,12 +250,13 @@ def test_matrix_nms_partial_overlap_reference():
                      np.float32)
     # iou(box0, box1) = 30/70 = 3/7
     scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
-    out = paddle.vision.ops.matrix_nms(_t(boxes), _t(scores),
-                                       score_threshold=0.01,
-                                       post_threshold=0.0, nms_top_k=3,
-                                       keep_top_k=3,
-                                       background_label=-1).numpy()
-    sc = {round(v, 4) for v in out[0, :, 1].tolist()}
+    out, rois = paddle.vision.ops.matrix_nms(_t(boxes), _t(scores),
+                                             score_threshold=0.01,
+                                             post_threshold=0.0,
+                                             nms_top_k=3, keep_top_k=3,
+                                             background_label=-1)
+    assert rois.numpy().tolist() == [3]
+    sc = {round(v, 4) for v in out.numpy()[:, 1].tolist()}
     want2 = 0.8 * (1 - 3 / 7)  # decayed by its only higher-scored overlap
     assert round(0.9, 4) in sc
     assert round(0.7, 4) in sc
@@ -278,14 +279,14 @@ def test_matrix_nms_decays_overlaps():
     boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10], [20, 20, 30, 30]]],
                      np.float32)
     scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
-    out = paddle.vision.ops.matrix_nms(_t(boxes), _t(scores),
-                                       score_threshold=0.05,
-                                       post_threshold=0.0, nms_top_k=3,
-                                       keep_top_k=3,
-                                       background_label=-1).numpy()
-    sc = out[0, :, 1]
+    out, rois, index = paddle.vision.ops.matrix_nms(
+        _t(boxes), _t(scores), score_threshold=0.05, post_threshold=0.0,
+        nms_top_k=3, keep_top_k=3, background_label=-1, return_index=True)
+    sc = out.numpy()[:, 1]
     assert sc[0] == pytest.approx(0.9, rel=1e-5)       # top box untouched
-    assert sc[-1] < 0.05                                # duplicate decayed to ~0
+    # the exact duplicate decays to score 0 and is compacted away
+    assert rois.numpy().tolist() == [2]
+    assert index.numpy()[:, 0].tolist() == [0, 2]       # original box ids
 
 
 def test_box_coder_roundtrip():
@@ -477,3 +478,86 @@ def test_mini_crnn_ocr_ctc_converges():
         opt.clear_grad()
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0] * 0.3, losses
+
+
+# --- review fixes: iou_aware yolo_box, pool3d ceil_mode, box_coder axis ------
+
+def test_yolo_box_iou_aware_conf_rescale():
+    """iou_aware layout: channels [0, an) are IoU preds; conf =
+    obj^(1-f) * sigmoid(iou)^f (reference funcs/yolo_box_util.h:57)."""
+    rs = np.random.RandomState(3)
+    an, cls, H, W = 2, 3, 2, 2
+    x_std = rs.randn(1, an * (5 + cls), H, W).astype(np.float32)
+    iou_pred = rs.randn(1, an, H, W).astype(np.float32)
+    x_aware = np.concatenate([iou_pred, x_std], axis=1)
+    img = np.array([[64, 64]], np.int32)
+    anchors = [10, 13, 16, 30]
+    f = 0.4
+    boxes_a, scores_a = paddle.vision.ops.yolo_box(
+        _t(x_aware), _t(img), anchors, cls, conf_thresh=0.0,
+        downsample_ratio=32, iou_aware=True, iou_aware_factor=f)
+    boxes_s, scores_s = paddle.vision.ops.yolo_box(
+        _t(x_std), _t(img), anchors, cls, conf_thresh=0.0,
+        downsample_ratio=32)
+    # boxes identical (iou only rescales confidence)
+    np.testing.assert_allclose(boxes_a.numpy(), boxes_s.numpy(), rtol=1e-5)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    obj = sig(x_std.reshape(1, an, 5 + cls, H, W)[:, :, 4])
+    conf_scale = (obj ** (1 - f)) * (sig(iou_pred) ** f) / obj
+    ratio = (scores_a.numpy().reshape(1, an, H * W, cls)
+             / scores_s.numpy().reshape(1, an, H * W, cls))
+    np.testing.assert_allclose(
+        ratio, np.broadcast_to(conf_scale.reshape(1, an, H * W, 1),
+                               ratio.shape), rtol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d_ceil_mode_vs_torch(ptype):
+    import torch
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(1, 2, 7, 7, 7).astype(np.float32)
+    got = paddle.vision.ops  # noqa: F841 - namespacing
+    from paddle_tpu.ops.dispatch import OPS
+
+    out = OPS["pool3d"](_t(x), kernel_size=3, stride=2, padding=0,
+                        pooling_type=ptype, ceil_mode=True)
+    tx = torch.tensor(x)
+    if ptype == "max":
+        want = torch.nn.functional.max_pool3d(tx, 3, 2, 0, ceil_mode=True)
+    else:
+        want = torch.nn.functional.avg_pool3d(tx, 3, 2, 0, ceil_mode=True,
+                                              count_include_pad=False)
+    assert tuple(out.shape) == tuple(want.shape), (out.shape, want.shape)
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_box_coder_decode_axis1():
+    """axis=1 pairs priors with dim 0 of the target deltas (reference
+    impl/box_coder.h:123)."""
+    rs = np.random.RandomState(5)
+    R, C_ = 3, 2
+    priors = np.abs(rs.rand(R, 4).astype(np.float32))
+    priors[:, 2:] += priors[:, :2] + 0.5
+    deltas = rs.randn(R, C_, 4).astype(np.float32) * 0.1
+    out = paddle.vision.ops.box_coder(
+        _t(priors), None, _t(deltas), code_type="decode_center_size",
+        box_normalized=True, axis=1).numpy()
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = priors[:, 0] + pw / 2
+    pcy = priors[:, 1] + ph / 2
+    for i in range(R):        # prior i pairs with ROW i for every column j
+        for j in range(C_):
+            d = deltas[i, j]
+            cx = d[0] * pw[i] + pcx[i]
+            cy = d[1] * ph[i] + pcy[i]
+            w = np.exp(d[2]) * pw[i]
+            h = np.exp(d[3]) * ph[i]
+            np.testing.assert_allclose(
+                out[i, j], [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                rtol=1e-4, atol=1e-5)
