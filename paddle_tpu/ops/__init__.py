@@ -21,6 +21,7 @@ from .dispatch import (  # noqa: F401
 from .kernels import (  # noqa: F401
     comparison,
     creation,
+    fused_ops,
     linalg,
     manipulation,
     math,

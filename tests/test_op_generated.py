@@ -812,6 +812,93 @@ add_specs({
                   rand=True),
 })
 
+# --- fused tranche (ops/kernels/fused_ops.py) -------------------------------
+add_specs({
+    "fc": S([sym(3, 4), sym(4, 5, seed=9), sym(5, seed=5)],
+            kwargs={"activation_type": "relu"}, grad=(0, 1),
+            ref=lambda x, w, b: np.maximum(x @ w + b, 0.0)),
+    "gemm_epilogue": S([sym(3, 4), sym(4, 5, seed=9), sym(5, seed=5)],
+                       kwargs={"activation": "gelu"}, grad=(0, 1)),
+    "fused_linear_param_grad_add": S(
+        [sym(3, 4), sym(3, 5, seed=9), sym(4, 5, seed=5), sym(5, seed=6)],
+        ref=lambda x, d, dw, db: (dw + x.T @ d, db + d.sum(0))),
+    "fused_bias_act": S([sym(2, 6), sym(6, seed=9)],
+                        kwargs={"act_method": "swiglu"}, grad=(0, 1)),
+    "fused_elementwise_add": S([sym(2, 3), sym(2, 3, seed=9)],
+                               kwargs={"fused_unary_fn": "relu"},
+                               ref=lambda x, y: np.maximum(x + y, 0.0)),
+    "fused_elementwise_sub": S([sym(2, 3), sym(2, 3, seed=9)], grad=(0, 1)),
+    "fused_elementwise_mul": S([sym(2, 3), sym(2, 3, seed=9)], grad=(0, 1)),
+    "fused_elementwise_div": S([sym(2, 3), pos(2, 3, seed=9)], grad=(0, 1)),
+    "fused_elemwise_add_activation": S(
+        [sym(2, 3), sym(2, 3, seed=9)],
+        ref=lambda x, y: np.maximum(x + y, 0.0)),
+    "fused_dropout_add": S([sym(2, 3), sym(2, 3, seed=9)],
+                           kwargs={"is_test": True, "p": 0.25,
+                                   "mode": "downscale_in_infer"},
+                           grad=(0, 1),
+                           ref=lambda x, y: 0.75 * x + y),
+    "fused_scale_bias_add_relu": S(
+        [sym(2, 3), pos(2, 3, seed=9), sym(2, 3, seed=5),
+         sym(2, 3, seed=6)],
+        ref=lambda x1, s1, b1, x2: np.maximum(x1 * s1 + b1 + x2, 0.0)),
+    "skip_layernorm": S([sym(2, 6), sym(2, 6, seed=9), pos(6, seed=5),
+                         sym(6, seed=6)], grad=(0, 1)),
+    "fused_bias_residual_layernorm": S(
+        [sym(2, 6), sym(6, seed=9), sym(2, 6, seed=5), pos(6, seed=6),
+         sym(6, seed=7)], grad=(0,)),
+    "fused_fc_elementwise_layernorm": S(
+        [sym(2, 4), sym(4, 6, seed=9), sym(2, 6, seed=5),
+         sym(6, seed=6), pos(6, seed=7), sym(6, seed=8)], grad=(0, 1)),
+    "fused_embedding_eltwise_layernorm": S(
+        [[ints(2, 3, lo=0, hi=7), ints(2, 3, lo=0, hi=5, seed=9)],
+         [sym(7, 6), sym(5, 6, seed=5)]]),
+    "add_group_norm_silu": S([sym(1, 4, 3, 3), sym(1, 4, 3, 3, seed=9),
+                              pos(4, seed=5), sym(4, seed=6)],
+                             kwargs={"groups": 2}, grad=(0,)),
+    "fused_dot_product_attention": S(
+        [sym(2, 5, 2, 4), sym(2, 5, 2, 4, seed=9),
+         sym(2, 5, 2, 4, seed=5)],
+        kwargs={"is_causal_masking": True}, grad=(0, 1, 2)),
+    "self_dp_attention": S([sym(2, 5, 3, 2, 4)], grad=(0,)),
+    "multihead_matmul": S([sym(2, 5, 8), sym(8, 24, seed=9)],
+                          kwargs={"head_number": 2}, grad=(0, 1)),
+    "fused_token_prune": S([sym(2, 2, 6, 6), sym(2, 6, 4, seed=9),
+                            pos(2, 2, 6, 6, seed=5),
+                            pos(2, 2, 3, 3, seed=6)]),
+    "fused_conv2d_add_act": S([sym(1, 2, 5, 5), sym(3, 2, 3, 3, seed=9),
+                               sym(3, seed=5)],
+                              kwargs={"paddings": (1, 1)}, grad=(0, 1)),
+    "resnet_unit": S([sym(1, 2, 5, 5), sym(4, 2, 3, 3, seed=9),
+                      pos(4, seed=5), sym(4, seed=6), sym(4, seed=7) * 0.1,
+                      pos(4, seed=8)]),
+    "resnet_basic_block": S(
+        [sym(1, 2, 5, 5), sym(2, 2, 3, 3, seed=9), pos(2, seed=5),
+         sym(2, seed=6), sym(2, seed=7) * 0.1, pos(2, seed=8),
+         sym(2, 2, 3, 3, seed=10), pos(2, seed=11), sym(2, seed=12),
+         sym(2, seed=13) * 0.1, pos(2, seed=14)]),
+    "squeeze_excitation_block": S([sym(1, 4, 5, 5), sym(2, 4, 1, 1, seed=9),
+                                   sym(4, 2, 1, 1, seed=5)], grad=(0,)),
+    "max_pool2d_v2": S([sym(1, 2, 7, 7)],
+                       kwargs={"kernel_size": 3, "stride": 2,
+                               "ceil_mode": True}),
+    "fusion_repeated_fc_relu": S(
+        [sym(3, 4), [sym(4, 5, seed=9), sym(5, 2, seed=5)],
+         [sym(5, seed=6), sym(2, seed=7)]]),
+    "fusion_squared_mat_sub": S([sym(3, 4), sym(4, 5, seed=9)],
+                                kwargs={"scalar": 0.5}, grad=(0, 1),
+                                ref=lambda x, y: 0.5 * (
+                                    (x @ y) ** 2 - (x * x) @ (y * y))),
+    "fusion_transpose_flatten_concat": S(
+        [[sym(2, 3, 4), sym(2, 3, 4, seed=9)]],
+        kwargs={"trans_axis": (0, 2, 1), "flatten_axis": 1,
+                "concat_axis": 1}),
+    "fusion_gru": S([sym(2, 4, 3), sym(3, 12, seed=9),
+                     sym(4, 12, seed=5) * 0.3], grad=(0, 1)),
+    "fusion_lstm": S([sym(2, 4, 3), sym(3, 16, seed=9),
+                      sym(4, 16, seed=5) * 0.3], grad=(0, 1)),
+})
+
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
     # pytree-structured inputs (flat weight list + optional masks) don't fit
