@@ -1,3 +1,4 @@
 """paddle.jit-compatible API (reference: python/paddle/jit)."""
 from .api import InputSpec, StaticFunction, ignore_module, in_to_static_trace, not_to_static, to_static  # noqa: F401
 from .serialization import load, save  # noqa: F401
+from . import dy2static, sot  # noqa: F401, E402

@@ -1,0 +1,36 @@
+"""paddle.jit.sot parity surface.
+
+Reference: python/paddle/jit/sot/translate.py:31 — `symbolic_translate`
+wraps a function so its execution is captured opcode-by-opcode with
+guards and graph breaks. Here the same contract is served by the
+dy2static AST converter (jit/dy2static): data-dependent control flow
+compiles, anything else graph-breaks to eager. This module maps the SOT
+entry points onto that machinery so SOT-style callers work unchanged.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..dy2static import TransformError, transform_function
+
+__all__ = ["symbolic_translate"]
+
+
+def symbolic_translate(fn, training: bool = False, **kwargs):
+    """Reference: sot/translate.py symbolic_translate(fn) -> callable.
+
+    Returns the AST-converted function (control flow lowered to XLA
+    select / lax.while_loop when traced); untransformable functions run
+    unchanged — the graph-break behavior then lives at the to_static
+    layer that traces them.
+    """
+    try:
+        out = transform_function(fn)
+    except TransformError:
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kw):
+        return out(*args, **kw)
+
+    return wrapper

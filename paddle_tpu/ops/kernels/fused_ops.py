@@ -115,25 +115,47 @@ def fused_bias_act(x, bias=None, act_method="gelu"):
 # ---------------------------------------------------------------------------
 
 
+def _bcast_axis(x, y, axis):
+    """Paddle's legacy axis-broadcast: align y's dims with x starting at
+    `axis` (trailing dims of size 1 appended)."""
+    if axis in (-1, None) or jnp.ndim(y) in (0, jnp.ndim(x)):
+        return y
+    pad = jnp.ndim(x) - axis - jnp.ndim(y)
+    return y.reshape(y.shape + (1,) * pad)
+
+
+def _fused_unary(name, alpha):
+    if (name or "").lower() == "leaky_relu":
+        return lambda v: jax.nn.leaky_relu(v, alpha if alpha else 0.01)
+    return _act(name)
+
+
 @register_op
-def fused_elementwise_add(x, y, axis=-1, fuse_alpha=1.0, fuse_beta=0.0,
+def fused_elementwise_add(x, y, axis=-1, fuse_alpha=0.0, fuse_beta=0.0,
                           fused_unary_fn="identity"):
-    return _act(fused_unary_fn)(x + y)
+    return _fused_unary(fused_unary_fn, fuse_alpha)(
+        x + _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_sub(x, y, axis=-1, fused_unary_fn="identity"):
-    return _act(fused_unary_fn)(x - y)
+def fused_elementwise_sub(x, y, axis=-1, fuse_alpha=0.0,
+                          fused_unary_fn="identity"):
+    return _fused_unary(fused_unary_fn, fuse_alpha)(
+        x - _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_mul(x, y, axis=-1, fused_unary_fn="identity"):
-    return _act(fused_unary_fn)(x * y)
+def fused_elementwise_mul(x, y, axis=-1, fuse_alpha=0.0,
+                          fused_unary_fn="identity"):
+    return _fused_unary(fused_unary_fn, fuse_alpha)(
+        x * _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_div(x, y, axis=-1, fused_unary_fn="identity"):
-    return _act(fused_unary_fn)(x / y)
+def fused_elementwise_div(x, y, axis=-1, fuse_alpha=0.0,
+                          fused_unary_fn="identity"):
+    return _fused_unary(fused_unary_fn, fuse_alpha)(
+        x / _bcast_axis(x, y, axis))
 
 
 @register_op
@@ -162,7 +184,9 @@ def fused_dropout_add(x, y, p=0.5, is_test=False, mode="upscale_in_train",
     if is_test or p == 0.0:
         scale = (1.0 - p) if mode == "downscale_in_infer" else 1.0
         return x * scale + y
-    key = jax.random.PRNGKey(seed if fix_seed else seed + 1)
+    from ...core import rng
+
+    key = jax.random.key(seed) if fix_seed else rng.next_key()
     mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / (1.0 - p), 0.0) + y
@@ -273,6 +297,20 @@ def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
         m = jnp.where(jnp.tril(jnp.ones((T, S), bool)), 0.0, -1e9)
     if mask is not None:
         m = mask if m is None else m + mask
+    if is_training and dropout_probability > 0.0:
+        from ...core import rng
+
+        s = scaling_factor if scaling_factor is not None \
+            else 1.0 / math.sqrt(qt.shape[-1])
+        logits = jnp.einsum("bhtd,bhsd->bhts", qt, kt) * s
+        if m is not None:
+            logits = logits + m
+        probs = jax.nn.softmax(logits, -1)
+        keep = jax.random.bernoulli(rng.next_key(),
+                                    1.0 - dropout_probability, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_probability), 0.0)
+        out = jnp.einsum("bhts,bhsd->bhtd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
     out = _sdpa(qt, kt, vt, m, scaling_factor)
     return jnp.swapaxes(out, 1, 2)
 

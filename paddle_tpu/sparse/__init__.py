@@ -19,7 +19,13 @@ from ..ops.dispatch import call_op
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_same_shape", "add", "multiply", "matmul",
-           "masked_matmul", "relu", "transpose", "coalesce", "nn"]
+           "masked_matmul", "relu", "transpose", "coalesce", "nn",
+           # unary value-space ops
+           "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+           "square", "sqrt", "log1p", "expm1", "abs", "neg", "rad2deg",
+           "deg2rad", "isnan", "pow", "cast", "sum", "reshape", "slice",
+           # binary
+           "subtract", "divide", "mv", "mask_as", "functional"]
 
 
 class SparseCooTensor:
@@ -226,11 +232,111 @@ def masked_matmul(x, y, mask):
                                         shape=m._bcoo.shape))
 
 
+def _valuewise(fn):
+    """Lift a value-space function to COO/CSR (reference sparse/unary.py:
+    unary ops act on stored values, preserving sparsity)."""
+
+    def op(x, *args, **kwargs):
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols,
+                                   fn(x._values, *args, **kwargs), x._shape)
+        x = _as_coo(x)
+        return SparseCooTensor(jsparse.BCOO(
+            (fn(x._bcoo.data, *args, **kwargs), x._bcoo.indices),
+            shape=x._bcoo.shape))
+
+    return op
+
+
+# reference sparse/unary.py surface: zero-preserving value maps
+sin = _valuewise(jnp.sin)
+tan = _valuewise(jnp.tan)
+asin = _valuewise(jnp.arcsin)
+atan = _valuewise(jnp.arctan)
+sinh = _valuewise(jnp.sinh)
+tanh = _valuewise(jnp.tanh)
+asinh = _valuewise(jnp.arcsinh)
+atanh = _valuewise(jnp.arctanh)
+square = _valuewise(jnp.square)
+sqrt = _valuewise(jnp.sqrt)
+log1p = _valuewise(jnp.log1p)
+expm1 = _valuewise(jnp.expm1)
+abs = _valuewise(jnp.abs)  # noqa: A001 - paddle API name
+neg = _valuewise(jnp.negative)
+rad2deg = _valuewise(jnp.rad2deg)
+deg2rad = _valuewise(jnp.deg2rad)
+isnan = _valuewise(jnp.isnan)
+
+
+def pow(x, factor):  # noqa: A001 - paddle API name
+    return _valuewise(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as dtype_mod
+
+    def conv(v):
+        return v.astype(dtype_mod.to_np(value_dtype)) \
+            if value_dtype is not None else v
+
+    return _valuewise(conv)(x)
+
+
 def relu(x):
+    return _valuewise(lambda v: jnp.maximum(v, 0))(x)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
     x = _as_coo(x)
-    return SparseCooTensor(jsparse.BCOO(
-        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
-        shape=x._bcoo.shape))
+    dense = x._bcoo.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if axis is None:
+        return Tensor._from_data(out)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def reshape(x, shape):
+    x = _as_coo(x)
+    return SparseCooTensor(x._bcoo.reshape(tuple(int(s) for s in shape)))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    import builtins
+
+    x = _as_coo(x)
+    dense = x._bcoo.todense()
+    sl = [builtins.slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(s, e)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense[tuple(sl)]))
+
+
+def subtract(x, y):
+    return add(x, multiply(y, -1.0))
+
+
+def divide(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        xd = _as_coo(x)._bcoo.todense()
+        yd = _as_coo(y)._bcoo.todense()
+        return SparseCooTensor(jsparse.BCOO.fromdense(xd / yd))
+    return multiply(x, 1.0 / _unwrap(y))
+
+
+def mv(x, vec):
+    """sparse [M, N] @ dense [N] -> dense [M] (reference sparse/binary.py
+    mv)."""
+    out = _as_coo(x)._bcoo @ _unwrap(vec)
+    return Tensor._from_data(out)
+
+
+def mask_as(x, mask):
+    """Sample dense x at mask's sparsity (reference sparse mask_as)."""
+    m = _as_coo(mask)
+    xv = _unwrap(x)
+    idx = m._bcoo.indices
+    vals = xv[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=m._bcoo.shape))
 
 
 def transpose(x, perm):
@@ -242,12 +348,4 @@ def coalesce(x):
     return _as_coo(x).coalesce()
 
 
-class _SparseNN:
-    """paddle.sparse.nn namespace stub with ReLU."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-
-nn = _SparseNN()
+from . import nn  # noqa: E402  (sparse.nn: activations, conv, norm layers)

@@ -1,0 +1,132 @@
+"""paddle.sparse.nn.functional — functional forms of the sparse nn ops.
+
+Reference: python/paddle/sparse/nn/functional/{activation,conv,pooling}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+def _coo(x):
+    from . import _as_coo
+
+    return _as_coo(x)
+
+
+def _valuewise(fn):
+    from . import _valuewise as vw
+
+    return vw(fn)
+
+
+def relu(x, name=None):
+    return _valuewise(lambda v: jnp.maximum(v, 0))(x)
+
+
+def relu6(x, name=None):
+    return _valuewise(lambda v: jnp.clip(v, 0, 6))(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _valuewise(lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over each row's NONZEROS (reference
+    sparse/nn/functional/activation.py softmax — CSR semantics: the
+    normalisation runs over stored entries only, zeros stay zero).
+    Segment-reduction formulation: O(1) ops, traceable under jit."""
+    from . import SparseCsrTensor, sparse_csr_tensor
+
+    csr = x if isinstance(x, SparseCsrTensor) else x.to_sparse_csr()
+    crows = jnp.asarray(csr._crows)
+    vals = jnp.asarray(csr._values)
+    nrows = int(csr._shape[-2])
+    nnz = vals.shape[0]
+    # row id per entry: +1 at each row boundary, cumulative sum
+    row_ids = jnp.zeros(nnz, jnp.int32).at[crows[1:-1]].add(1).cumsum() \
+        if nnz else jnp.zeros(0, jnp.int32)
+    m = jax.ops.segment_max(vals, row_ids, num_segments=nrows)
+    e = jnp.exp(vals - m[row_ids])
+    s = jax.ops.segment_sum(e, row_ids, num_segments=nrows)
+    out = e / s[row_ids]
+    res = sparse_csr_tensor(csr._crows, csr._cols, out, csr._shape)
+    return res if isinstance(x, SparseCsrTensor) else res.to_sparse_coo()
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Functional sparse conv3d (see sparse/nn.py for the TPU
+    dense-lowering rationale). weight [kd, kh, kw, in, out]."""
+    return _conv_nd_fn(x, weight, bias, stride, padding, dilation, groups,
+                       3, subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _conv_nd_fn(x, weight, bias, stride, padding, dilation, groups,
+                       3, subm=True)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    return _conv_nd_fn(x, weight, bias, stride, padding, dilation, groups,
+                       2, subm=False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_nd_fn(x, weight, bias, stride, padding, dilation, groups,
+                       2, subm=True)
+
+
+def _conv_nd_fn(x, weight, bias, stride, padding, dilation, groups, nd,
+                subm):
+    from jax import lax
+
+    from . import SparseCooTensor
+    from ..core.tensor import Tensor
+
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    dense = _coo(x)._bcoo.todense()
+    perm_in = (0, nd + 1) + tuple(range(1, nd + 1))
+    xcf = jnp.transpose(dense, perm_in)
+    wk = jnp.transpose(w, (nd + 1, nd) + tuple(range(nd)))
+    s = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    d = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
+    spec = "NC" + "DHW"[3 - nd:]
+    dn = lax.conv_dimension_numbers(xcf.shape, wk.shape,
+                                    (spec, "OI" + "DHW"[3 - nd:], spec))
+    out = lax.conv_general_dilated(xcf, wk, s, [(q, q) for q in p],
+                                   rhs_dilation=d, dimension_numbers=dn,
+                                   feature_group_count=groups)
+    if bias is not None:
+        b = bias._data if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    out = jnp.transpose(out, (0,) + tuple(range(2, nd + 2)) + (1,))
+    if subm:
+        mask = (jnp.abs(dense).sum(axis=-1, keepdims=True) > 0)
+        out = jnp.where(mask, out, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    from jax import lax
+
+    from . import SparseCooTensor
+
+    dense = _coo(x)._bcoo.todense()
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                  else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    out = lax.reduce_window(dense, -jnp.inf, lax.max, (1,) + k + (1,),
+                            (1,) + s + (1,),
+                            ((0, 0),) + tuple((q, q) for q in p) + ((0, 0),))
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
