@@ -32,5 +32,6 @@ from .kernels import (  # noqa: F401
     search,
     tail_math,
     tail_nn,
+    tail_seq,
     vision_ops,
 )

@@ -125,34 +125,37 @@ def _bcast_axis(x, y, axis):
 
 
 def _fused_unary(name, alpha):
+    # alpha=None selects the activation's default; an explicit 0.0 is
+    # honored (zero-slope leaky_relu == relu)
     if (name or "").lower() == "leaky_relu":
-        return lambda v: jax.nn.leaky_relu(v, alpha if alpha else 0.01)
+        slope = 0.01 if alpha is None else alpha
+        return lambda v: jax.nn.leaky_relu(v, slope)
     return _act(name)
 
 
 @register_op
-def fused_elementwise_add(x, y, axis=-1, fuse_alpha=0.0, fuse_beta=0.0,
+def fused_elementwise_add(x, y, axis=-1, fuse_alpha=None, fuse_beta=None,
                           fused_unary_fn="identity"):
     return _fused_unary(fused_unary_fn, fuse_alpha)(
         x + _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_sub(x, y, axis=-1, fuse_alpha=0.0,
+def fused_elementwise_sub(x, y, axis=-1, fuse_alpha=None,
                           fused_unary_fn="identity"):
     return _fused_unary(fused_unary_fn, fuse_alpha)(
         x - _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_mul(x, y, axis=-1, fuse_alpha=0.0,
+def fused_elementwise_mul(x, y, axis=-1, fuse_alpha=None,
                           fused_unary_fn="identity"):
     return _fused_unary(fused_unary_fn, fuse_alpha)(
         x * _bcast_axis(x, y, axis))
 
 
 @register_op
-def fused_elementwise_div(x, y, axis=-1, fuse_alpha=0.0,
+def fused_elementwise_div(x, y, axis=-1, fuse_alpha=None,
                           fused_unary_fn="identity"):
     return _fused_unary(fused_unary_fn, fuse_alpha)(
         x / _bcast_axis(x, y, axis))
@@ -273,13 +276,20 @@ def add_group_norm_silu(x, residual=None, scale=None, bias=None,
 # ---------------------------------------------------------------------------
 
 
-def _sdpa(q, k, v, mask=None, scale=None):
-    """[B, H, T, D] scaled dot-product attention."""
+def _sdpa(q, k, v, mask=None, scale=None, dropout_p=0.0):
+    """[B, H, T, D] scaled dot-product attention (+ attention dropout)."""
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhtd,bhsd->bhts", q, k) * s
     if mask is not None:
         logits = logits + mask
-    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(logits, -1), v)
+    probs = jax.nn.softmax(logits, -1)
+    if dropout_p > 0.0:
+        from ...core import rng
+
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v)
 
 
 @register_op
@@ -297,21 +307,8 @@ def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
         m = jnp.where(jnp.tril(jnp.ones((T, S), bool)), 0.0, -1e9)
     if mask is not None:
         m = mask if m is None else m + mask
-    if is_training and dropout_probability > 0.0:
-        from ...core import rng
-
-        s = scaling_factor if scaling_factor is not None \
-            else 1.0 / math.sqrt(qt.shape[-1])
-        logits = jnp.einsum("bhtd,bhsd->bhts", qt, kt) * s
-        if m is not None:
-            logits = logits + m
-        probs = jax.nn.softmax(logits, -1)
-        keep = jax.random.bernoulli(rng.next_key(),
-                                    1.0 - dropout_probability, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_probability), 0.0)
-        out = jnp.einsum("bhts,bhsd->bhtd", probs, vt)
-        return jnp.swapaxes(out, 1, 2)
-    out = _sdpa(qt, kt, vt, m, scaling_factor)
+    p = dropout_probability if is_training else 0.0
+    out = _sdpa(qt, kt, vt, m, scaling_factor, dropout_p=p)
     return jnp.swapaxes(out, 1, 2)
 
 

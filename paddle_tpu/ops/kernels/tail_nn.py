@@ -594,6 +594,12 @@ def send_uv(x, y, src_index, dst_index, message_op="ADD"):
 # ---------------------------------------------------------------------------
 
 
+def _key(seed):
+    from ...core import rng
+
+    return jax.random.key(seed) if seed else rng.next_key()
+
+
 @register_op(nondiff=True)
 def top_p_sampling(x, ps, threshold=None, seed=0):
     """Nucleus sampling -> (scores, ids) (reference top_p_sampling):
@@ -604,7 +610,7 @@ def top_p_sampling(x, ps, threshold=None, seed=0):
     keep = cum - sorted_p < ps[..., None]
     probs = jnp.where(keep, sorted_p, 0.0)
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
-    key = jax.random.PRNGKey(seed)
+    key = _key(seed)
     choice = jax.random.categorical(key, jnp.log(probs + 1e-12), axis=-1)
     ids = jnp.take_along_axis(sorted_i, choice[..., None], axis=-1)
     score = jnp.take_along_axis(sorted_p, choice[..., None], axis=-1)
@@ -614,18 +620,18 @@ def top_p_sampling(x, ps, threshold=None, seed=0):
 @register_op(nondiff=True)
 def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0,
                               a=-2.0, b=2.0, dtype="float32"):
-    key = jax.random.PRNGKey(seed)
+    key = _key(seed)
     return (mean + std * jax.random.truncated_normal(
         key, a, b, tuple(shape))).astype(dtype)
 
 
 @register_op(nondiff=True)
 def standard_gamma(x, seed=0):
-    key = jax.random.PRNGKey(seed)
+    key = _key(seed)
     return jax.random.gamma(key, x)
 
 
 @register_op(nondiff=True)
 def binomial(count, prob, seed=0):
-    key = jax.random.PRNGKey(seed)
+    key = _key(seed)
     return jax.random.binomial(key, count, prob).astype(jnp.int64)

@@ -44,30 +44,23 @@ def _rewrap(bcoo):
     return SparseCooTensor(bcoo)
 
 
-class _ValueAct(Layer):
-    def __init__(self, fn):
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
         super().__init__()
-        self._fn = fn
+        self._slope = negative_slope
 
     def forward(self, x):
-        from . import _valuewise
-
-        return _valuewise(self._fn)(x)
-
-
-class ReLU(_ValueAct):
-    def __init__(self):
-        super().__init__(lambda v: jnp.maximum(v, 0))
-
-
-class ReLU6(_ValueAct):
-    def __init__(self):
-        super().__init__(lambda v: jnp.clip(v, 0, 6))
-
-
-class LeakyReLU(_ValueAct):
-    def __init__(self, negative_slope=0.01):
-        super().__init__(lambda v: jax.nn.leaky_relu(v, negative_slope))
+        return functional.leaky_relu(x, self._slope)
 
 
 class Softmax(Layer):

@@ -899,6 +899,39 @@ add_specs({
                       sym(4, 16, seed=5) * 0.3], grad=(0, 1)),
 })
 
+# --- tail tranche 3: seq losses / metrics / linalg remainder ----------------
+add_specs({
+    "warprnnt": S([sym(1, 2, 2, 4), ints(1, 1, lo=1, hi=4),
+                   np.array([2], np.int32), np.array([1], np.int32)]),
+    "crf_decoding": S([sym(2, 5, 4), sym(6, 4, seed=9),
+                       None, np.array([5, 3], np.int64)], no_jit=True),
+    "accuracy": S([frac01(6, 3), ints(6, 2, lo=0, hi=3),
+                   ints(6, 1, lo=0, hi=3, seed=9)]),
+    "auc": S([frac01(16, 2), ints(16, lo=0, hi=2, seed=9)]),
+    "eigvals": S([wellcond(4)]),
+    "lu_unpack": S([wellcond(3), np.array([2, 3, 3], np.int32)]),
+    "matrix_rank_tol": S([wellcond(4)],
+                         ref=lambda x: np.int64(
+                             np.linalg.matrix_rank(x))),
+    "matrix_rank_atol_rtol": S([wellcond(4)],
+                               ref=lambda x: np.int64(
+                                   np.linalg.matrix_rank(x))),
+    "dirichlet": S([pos(2, 4)], rand=True),
+    "class_center_sample": S([ints(8, lo=0, hi=10)],
+                             kwargs={"num_classes": 20, "num_samples": 6,
+                                     "fix_seed": True, "seed": 3},
+                             no_jit=True),
+    "im2sequence": S([sym(1, 2, 4, 4)], kwargs={"kernels": (2, 2),
+                                                "strides": (2, 2)},
+                     grad=(0,)),
+    "full_batch_size_like": S([sym(3, 2)], kwargs={"shape": [1, 5],
+                                                   "value": 2.0},
+                              ref=lambda x: np.full((3, 5), 2.0)),
+    "uniform_random_batch_size_like": S([sym(3, 2)],
+                                        kwargs={"shape": [1, 4]},
+                                        rand=True),
+})
+
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
     # pytree-structured inputs (flat weight list + optional masks) don't fit
