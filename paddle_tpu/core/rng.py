@@ -95,3 +95,12 @@ def get_rng_state():
 
 def set_rng_state(states):
     default_generator.set_state(states[0])
+
+
+def seed_or_next(op_seed: int):
+    """The op-level seeding rule shared by every random kernel: a nonzero
+    per-op seed gives a fixed key (reproducible op), seed=0 draws from the
+    global generator stream (paddle.seed-controlled)."""
+    import jax
+
+    return jax.random.key(op_seed) if op_seed else next_key()

@@ -30,6 +30,7 @@ from .kernels import (  # noqa: F401
     reduce,
     rnn_ops,
     search,
+    tail_alias,
     tail_math,
     tail_nn,
     tail_seq,

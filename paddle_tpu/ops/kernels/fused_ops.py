@@ -189,7 +189,7 @@ def fused_dropout_add(x, y, p=0.5, is_test=False, mode="upscale_in_train",
         return x * scale + y
     from ...core import rng
 
-    key = jax.random.key(seed) if fix_seed else rng.next_key()
+    key = jax.random.key(seed) if fix_seed else rng.seed_or_next(0)
     mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / (1.0 - p), 0.0) + y

@@ -1,0 +1,546 @@
+"""Op tail 4: phi-name registrations for capabilities living in other
+subsystems, plus the small remaining kernels.
+
+Two kinds of entries:
+
+* **canonical-name registrations** — the capability already exists under
+  this framework's name (signal.stft, text.viterbi_decode, the Pallas
+  flash kernel, softmax_with_cross_entropy, ...); the reference phi name
+  is registered as a real op so imported graphs and the op manifest
+  resolve it. Each delegation is one call, no logic drift.
+* **small kernels** — AMP loss-scaling ops, MoE auxiliary ops
+  (number_count/limit_by_capacity/assign_pos/...), view ops, recsys cvm,
+  image IO.
+
+Collective-op names (all_reduce, c_*, global_gather, memcpy_*) are NOT
+here: SURVEY §7 maps them onto distributed.collective / GSPMD by design.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dispatch import register_op
+from .nn_ops import _pool
+
+# ---------------------------------------------------------------------------
+# canonical-name registrations
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """phi viterbi_decode — the batched lax.scan decoder from
+    paddle_tpu.text (see text/__init__.py for the recursion design)."""
+    from ...text import _viterbi_kernel
+
+    return _viterbi_kernel(potentials, transition_params, lengths,
+                           include_bos_eos_tag)
+
+
+@register_op
+def fft_c2c(x, axes=(-1,), normalization="backward", forward=True):
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=tuple(axes), norm=normalization or "backward")
+
+
+@register_op
+def fft_r2c(x, axes=(-1,), normalization="backward", forward=True,
+            onesided=True):
+    if onesided:
+        return jnp.fft.rfftn(x, axes=tuple(axes),
+                             norm=normalization or "backward")
+    return jnp.fft.fftn(x.astype(jnp.complex64), axes=tuple(axes),
+                        norm=normalization or "backward")
+
+
+@register_op
+def fft_c2r(x, axes=(-1,), normalization="backward", forward=False,
+            last_dim_size=0):
+    n = None if not last_dim_size else last_dim_size
+    return jnp.fft.irfftn(x, s=None if n is None else [n],
+                          axes=tuple(axes), norm=normalization or "backward")
+
+
+@register_op
+def stft(x, window, n_fft, hop_length, normalized=False, onesided=True):
+    from ...signal import stft as _sig_stft
+    from ...core.tensor import Tensor
+
+    out = _sig_stft(Tensor._from_data(x), n_fft, hop_length,
+                    window=Tensor._from_data(window) if window is not None
+                    else None, normalized=normalized, onesided=onesided)
+    return out._data
+
+
+@register_op
+def frame(x, frame_length, hop_length, axis=-1):
+    from ...signal import frame as _sig_frame
+    from ...core.tensor import Tensor
+
+    return _sig_frame(Tensor._from_data(x), frame_length, hop_length,
+                      axis)._data
+
+
+@register_op
+def overlap_add(x, hop_length, axis=-1):
+    from ...signal import overlap_add as _sig_ola
+    from ...core.tensor import Tensor
+
+    return _sig_ola(Tensor._from_data(x), hop_length, axis)._data
+
+
+@register_op
+def cross_entropy_with_softmax(logits, label, soft_label=False,
+                               use_softmax=True, numeric_stable_mode=True,
+                               ignore_index=-100, axis=-1):
+    """phi cross_entropy_with_softmax. use_softmax=False means the input
+    already holds probabilities: the loss is -sum(label * log(p)) with no
+    second normalisation."""
+    from ..dispatch import OPS
+
+    if use_softmax:
+        return OPS["softmax_with_cross_entropy"]._kernel(
+            logits, label, soft_label=soft_label, axis=axis,
+            ignore_index=ignore_index)
+    logp = jnp.log(jnp.clip(logits, 1e-12))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab, axis=axis)
+    valid = lab != ignore_index
+    return jnp.where(valid, -picked, 0.0)
+
+
+def _xla_sdpa_btHD(q, k, v, attn_mask, causal, scale=None, dropout_p=0.0):
+    """[B, T, H, D] SDPA on the XLA path (shared by the flash_attn
+    fallback and memory_efficient_attention)."""
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    T, S = qt.shape[2], kt.shape[2]
+    m = None
+    if causal:
+        m = jnp.where(jnp.tril(jnp.ones((T, S), bool)), 0.0, -1e9)
+    if attn_mask is not None:
+        m = attn_mask if m is None else m + attn_mask
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhtd,bhsd->bhts", qt, kt) * s
+    if m is not None:
+        logits = logits + m
+    probs = jax.nn.softmax(logits, -1)
+    if dropout_p > 0.0:
+        from ...core import rng
+
+        keep = jax.random.bernoulli(rng.seed_or_next(0), 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhts,bhsd->bhtd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@register_op
+def flash_attn(q, k, v, fixed_seed_offset=None, attn_mask=None,
+               dropout=0.0, causal=False, return_softmax=False):
+    """phi flash_attn: [B, T, H, D] — routes to the Pallas flash kernel
+    when its tiling supports the shapes, else the fused XLA SDPA."""
+    from ..pallas import flash_attention as FA
+
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn return_softmax=True: the softmax matrix is never "
+            "materialized by the flash kernel")
+    if dropout > 0.0:
+        # attention dropout forces the XLA path (the Pallas kernel has no
+        # in-kernel RNG plumbed)
+        return _xla_sdpa_btHD(q, k, v, attn_mask, causal,
+                              dropout_p=dropout)
+    if FA.available() and FA.supported(q.shape, k.shape) \
+            and attn_mask is None:
+        return FA.flash_attention(q, k, v, causal=causal)
+    return _xla_sdpa_btHD(q, k, v, attn_mask, causal)
+
+
+@register_op
+def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
+                         dropout=0.0, causal=False, return_softmax=False):
+    """phi flash_attn_qkvpacked: qkv [B, T, 3, H, D]."""
+    return flash_attn.__wrapped__(qkv[:, :, 0], qkv[:, :, 1],
+                                  qkv[:, :, 2], fixed_seed_offset,
+                                  attn_mask, dropout, causal,
+                                  return_softmax)
+
+
+@register_op
+def memory_efficient_attention(query, key, value, bias=None,
+                               cu_seqlens_q=None, cu_seqlens_k=None,
+                               causal=False, dropout_p=0.0, scale=None):
+    """phi memory_efficient_attention: SDPA honoring the caller's softmax
+    scale and dropout; Pallas routing only when both are defaults."""
+    if scale is None and dropout_p == 0.0:
+        return flash_attn.__wrapped__(query, key, value, None, bias,
+                                      0.0, causal, False)
+    return _xla_sdpa_btHD(query, key, value, bias, causal, scale=scale,
+                          dropout_p=dropout_p)
+
+
+@register_op
+def pool2d(x, kernel_size, strides=None, paddings=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT"):
+    """phi pool2d (the generic pooling entry) over the shared _pool."""
+    ch_last = data_format == "NHWC"
+    if adaptive:
+        from ..dispatch import OPS
+
+        name = ("adaptive_max_pool2d" if pooling_type == "max"
+                else "adaptive_avg_pool2d")
+        return OPS[name]._kernel(x, kernel_size, data_format=data_format)
+    if padding_algorithm == "VALID":
+        paddings = 0
+    elif padding_algorithm == "SAME":
+        # pre-pad so every output keeps ceil(in/stride) positions; the
+        # (possibly asymmetric) SAME split goes through jnp.pad since
+        # _pool takes symmetric ints only
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = k if strides is None else (
+            (strides,) * 2 if isinstance(strides, int) else tuple(strides))
+        cfg = [(0, 0)] * x.ndim
+        for i in range(2):
+            ax = (1 if ch_last else 2) + i
+            in_s = x.shape[ax]
+            out_s = -(-in_s // st[i])
+            total = max((out_s - 1) * st[i] + k[i] - in_s, 0)
+            cfg[ax] = (total // 2, total - total // 2)
+        pad_val = (-jnp.inf if pooling_type == "max" else 0.0)
+        ones = jnp.pad(jnp.ones_like(x, jnp.float32), cfg,
+                       constant_values=0.0)
+        x = jnp.pad(x.astype(jnp.float32), cfg, constant_values=pad_val)
+        paddings = 0
+    else:
+        ones = jnp.ones_like(x, jnp.float32)
+    if global_pooling:
+        spatial = x.shape[1:3] if ch_last else x.shape[2:4]
+        kernel_size, strides, paddings = tuple(spatial), (1, 1), 0
+    if pooling_type == "max":
+        return _pool(x, kernel_size, strides, paddings, data_format,
+                     lax.max, -jnp.inf, 2, ceil_mode=ceil_mode).astype(
+                         x.dtype)
+    s = _pool(x, kernel_size, strides, paddings, data_format, lax.add,
+              0.0, 2, ceil_mode=ceil_mode)
+    cnt = _pool(ones, kernel_size, strides, paddings, data_format,
+                lax.add, 0.0, 2, ceil_mode=ceil_mode)
+    if not exclusive:
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        cnt = jnp.full_like(cnt, float(np.prod(k)))
+    return (s / jnp.maximum(cnt, 1.0)).astype(x.dtype)
+
+
+@register_op
+def sync_batch_norm_(x, mean, variance, scale, bias, is_test=False,
+                     momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                     use_global_stats=False, trainable_statistics=False):
+    """phi sync_batch_norm_: under GSPMD the batch axis is sharded and
+    XLA's reduction IS the cross-replica sync, so this is batch_norm with
+    global statistics semantics."""
+    axes = (0, 2, 3) if data_format == "NCHW" and x.ndim == 4 else \
+        tuple(i for i in range(x.ndim) if i != (1 if data_format
+                                                .startswith("NC") else
+                                                x.ndim - 1))
+    shape = [1] * x.ndim
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape[ch_axis] = -1
+    if is_test or use_global_stats:
+        mu, var = mean, variance
+    else:
+        mu = x.mean(axis=axes)
+        var = x.var(axis=axes)
+    out = ((x - mu.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+           * scale.reshape(shape) + bias.reshape(shape))
+    new_mean = momentum * mean + (1 - momentum) * mu
+    new_var = momentum * variance + (1 - momentum) * var
+    return out, new_mean, new_var
+
+
+# ---------------------------------------------------------------------------
+# AMP loss-scaling ops
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def check_finite_and_unscale_(xs, scale):
+    """phi check_finite_and_unscale_: unscale grads, report inf/nan.
+    Functional: returns (unscaled list, found_infinite)."""
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for g in (xs if isinstance(xs, (list, tuple)) else [xs]):
+        found = found | ~jnp.isfinite(g).all()
+        outs.append(g / scale)
+    return outs, found
+
+
+@register_op(nondiff=True)
+def update_loss_scaling_(xs, found_infinite, prev_loss_scaling,
+                         in_good_steps, in_bad_steps,
+                         incr_every_n_steps=1000,
+                         decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                         decr_ratio=0.5, stop_update=False):
+    """phi update_loss_scaling_: the dynamic loss-scale state machine."""
+    good = jnp.where(found_infinite, 0, in_good_steps + 1)
+    bad = jnp.where(found_infinite, in_bad_steps + 1, 0)
+    grow = good >= incr_every_n_steps
+    shrink = bad >= decr_every_n_nan_or_inf
+    scale = jnp.where(shrink, prev_loss_scaling * decr_ratio,
+                      jnp.where(grow, prev_loss_scaling * incr_ratio,
+                                prev_loss_scaling))
+    scale = jnp.maximum(scale, 1.0)
+    good = jnp.where(grow, 0, good)
+    bad = jnp.where(shrink, 0, bad)
+    outs = [jnp.where(found_infinite, jnp.zeros_like(g), g)
+            for g in (xs if isinstance(xs, (list, tuple)) else [xs])]
+    return outs, scale, good.astype(jnp.int32), bad.astype(jnp.int32)
+
+
+@register_op(name="merged_adam_", nondiff=True)
+def merged_adam_(params, grads, learning_rate, moments1, moments2,
+                 beta1_pows, beta2_pows, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+    from .tail_math import adam_
+
+    outs = [adam_.__wrapped__(p, g, learning_rate, m1, m2, b1, b2,
+                              beta1, beta2, epsilon)
+            for p, g, m1, m2, b1, b2 in zip(params, grads, moments1,
+                                            moments2, beta1_pows,
+                                            beta2_pows)]
+    return tuple(list(t) for t in zip(*outs))
+
+
+@register_op(name="merged_momentum_", nondiff=True)
+def merged_momentum_(params, grads, velocitys, learning_rate, mu=0.9,
+                     use_nesterov=False):
+    from .tail_math import momentum_
+
+    outs = [momentum_.__wrapped__(p, g, v, learning_rate, mu, use_nesterov)
+            for p, g, v in zip(params, grads, velocitys)]
+    return tuple(list(t) for t in zip(*outs))
+
+
+# ---------------------------------------------------------------------------
+# MoE auxiliary ops
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def number_count(numbers, upper_range):
+    """phi number_count (MoE): histogram of expert ids."""
+    return jax.ops.segment_sum(jnp.ones_like(numbers, jnp.int64),
+                               numbers.astype(jnp.int32),
+                               num_segments=int(upper_range))
+
+
+@register_op(nondiff=True)
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """phi limit_by_capacity: clip per-expert counts to capacity."""
+    cap = jnp.broadcast_to(jnp.asarray(capacity), expert_count.shape) \
+        if jnp.ndim(capacity) else capacity
+    return jnp.minimum(expert_count, cap)
+
+
+@register_op(nondiff=True)
+def assign_pos(x, cum_count, eff_num_len=None):
+    """phi assign_pos (MoE dispatch): token index per expert-sorted slot.
+    x: expert id per token; cum_count: cumulative counts per expert."""
+    order = jnp.argsort(x.astype(jnp.int32), stable=True)
+    return order.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1):
+    """phi prune_gate_by_capacity: tokens over an expert's capacity get
+    gate id -1."""
+    ids = gate_idx.astype(jnp.int32)
+    # rank of each token within its expert (stable order)
+    order = jnp.argsort(ids, stable=True)
+    ranks = jnp.zeros_like(ids)
+    seq = jnp.arange(ids.shape[0], dtype=jnp.int32)
+    sorted_ids = ids[order]
+    start_of_run = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum((sorted_ids[1:] != sorted_ids[:-1]).astype(jnp.int32))])
+    first_pos = jax.ops.segment_min(seq, start_of_run,
+                                    num_segments=ids.shape[0])
+    rank_sorted = seq - first_pos[start_of_run]
+    ranks = ranks.at[order].set(rank_sorted)
+    cap = expert_count[jnp.clip(ids, 0, expert_count.shape[0] - 1)]
+    return jnp.where(ranks < cap, gate_idx, -1)
+
+
+@register_op(nondiff=True)
+def random_routing(topk_idx, topk_value, prob):
+    """phi random_routing: drop second-choice experts with prob < 2*value
+    (GShard random dispatch)."""
+    keep = prob < (2.0 * topk_value)
+    return jnp.where(keep, topk_idx, -1)
+
+
+# ---------------------------------------------------------------------------
+# views / misc small kernels
+# ---------------------------------------------------------------------------
+
+
+@register_op
+def view_shape(input, dims):
+    return input.reshape(tuple(dims))
+
+
+@register_op(nondiff=True)
+def view_dtype(input, dtype):
+    return lax.bitcast_convert_type(input, jnp.dtype(dtype))
+
+
+@register_op
+def view_slice(input, begin_idx, end_idx):
+    return input[begin_idx:end_idx]
+
+
+@register_op(nondiff=True)
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+@register_op
+def multiplex(inputs, index):
+    """phi multiplex: out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs)                      # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+
+
+@register_op
+def bilinear(x, y, weight, bias=None):
+    """phi bilinear: out[b, k] = x[b] @ W[k] @ y[b] (+bias)."""
+    out = jnp.einsum("bi,kij,bj->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    shape = [1, -1] + [1] * (x.ndim - 2) if data_format == "NCHW" \
+        else [1] * (x.ndim - 1) + [-1]
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """phi add_position_encoding: sinusoidal PE added to [B, T, D]."""
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / D)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return alpha * x + beta * pe[None, :, :D]
+
+
+@register_op
+def box_clip(input, im_info):
+    """phi box_clip: clip boxes to image bounds (im_info [B, 3] h,w,scale)."""
+    h = im_info[:, 0] / im_info[:, 2] - 1.0
+    w = im_info[:, 1] / im_info[:, 2] - 1.0
+    if input.ndim == 2:
+        hh, ww = h[0], w[0]
+        return jnp.stack([jnp.clip(input[:, 0], 0, ww),
+                          jnp.clip(input[:, 1], 0, hh),
+                          jnp.clip(input[:, 2], 0, ww),
+                          jnp.clip(input[:, 3], 0, hh)], axis=-1)
+    return jnp.stack([jnp.clip(input[..., 0], 0, w[:, None]),
+                      jnp.clip(input[..., 1], 0, h[:, None]),
+                      jnp.clip(input[..., 2], 0, w[:, None]),
+                      jnp.clip(input[..., 3], 0, h[:, None])], axis=-1)
+
+
+@register_op(nondiff=True)
+def cvm(x, cvm_input, use_cvm=True):
+    """phi cvm (recsys continuous-value model): keep or strip the two
+    leading show/click columns."""
+    if use_cvm:
+        return x
+    return x[:, 2:]
+
+
+@register_op(nondiff=True)
+def shuffle_batch(x, seed=0):
+    from ...core import rng
+
+    key = rng.seed_or_next(seed)
+    perm = jax.random.permutation(key, x.shape[0])
+    return x[perm], perm.astype(jnp.int64)
+
+
+@register_op(nondiff=True)
+def reduce_as(x, target):
+    """phi reduce_as: sum x down to target's (broadcastable) shape."""
+    extra = x.ndim - target.ndim
+    out = x.sum(axis=tuple(range(extra))) if extra else x
+    axes = tuple(i for i, (a, b) in enumerate(zip(out.shape, target.shape))
+                 if a != b and b == 1)
+    if axes:
+        out = out.sum(axis=axes, keepdims=True)
+    return out
+
+
+@register_op(nondiff=True)
+def gaussian_inplace(x, mean=0.0, std=1.0, seed=0):
+    from ...core import rng
+
+    key = rng.seed_or_next(seed)
+    return mean + std * jax.random.normal(key, x.shape, x.dtype)
+
+
+@register_op(nondiff=True)
+def uniform_inplace(x, min=-1.0, max=1.0, seed=0, diag_num=0, diag_step=0,
+                    diag_val=1.0):
+    from ...core import rng
+
+    key = rng.seed_or_next(seed)
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)
+
+
+# ---------------------------------------------------------------------------
+# image IO
+# ---------------------------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def read_file(filename):
+    """phi read_file: raw bytes as a uint8 tensor (host op)."""
+    with open(filename, "rb") as f:
+        return jnp.asarray(np.frombuffer(f.read(), np.uint8))
+
+
+@register_op(nondiff=True)
+def decode_jpeg(x, mode="unchanged"):
+    """phi decode_jpeg (host op via PIL): uint8 bytes -> [C, H, W]."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

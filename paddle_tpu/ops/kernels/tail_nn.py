@@ -597,7 +597,7 @@ def send_uv(x, y, src_index, dst_index, message_op="ADD"):
 def _key(seed):
     from ...core import rng
 
-    return jax.random.key(seed) if seed else rng.next_key()
+    return rng.seed_or_next(seed)
 
 
 @register_op(nondiff=True)

@@ -219,10 +219,12 @@ def matrix_rank_tol(x, tol=None, use_default_tol=True, hermitian=False):
     """Reference matrix_rank with explicit tol tensor."""
     s = jnp.linalg.svd(x, compute_uv=False) if not hermitian else \
         jnp.abs(jnp.linalg.eigvalsh(x))
-    if tol is None or use_default_tol:
-        t = s.max(-1) * max(x.shape[-2:]) * jnp.finfo(x.dtype).eps
-    else:
+    # an explicitly passed tol always wins; use_default_tol only matters
+    # when no tol tensor was given (reference matrix_rank attribute pair)
+    if tol is not None:
         t = jnp.asarray(tol)
+    else:
+        t = s.max(-1) * max(x.shape[-2:]) * jnp.finfo(x.dtype).eps
     return (s > t[..., None] if jnp.ndim(t) else s > t).sum(-1).astype(
         jnp.int64)
 
@@ -235,9 +237,13 @@ def matrix_rank_atol_rtol(x, atol=None, rtol=None, hermitian=False):
         jnp.abs(jnp.linalg.eigvalsh(x))
     smax = s.max(-1)
     a = jnp.asarray(0.0 if atol is None else atol)
-    r = jnp.asarray(
-        max(x.shape[-2:]) * jnp.finfo(x.dtype).eps if rtol is None
-        else rtol)
+    # reference semantics: when atol is given and rtol is not, rtol
+    # defaults to 0 (the atol alone defines the threshold)
+    if rtol is None:
+        r = jnp.asarray(0.0 if atol is not None
+                        else max(x.shape[-2:]) * jnp.finfo(x.dtype).eps)
+    else:
+        r = jnp.asarray(rtol)
     t = jnp.maximum(a, r * smax)
     return (s > t[..., None] if jnp.ndim(t) else s > t).sum(-1).astype(
         jnp.int64)
@@ -251,7 +257,7 @@ def matrix_rank_atol_rtol(x, atol=None, rtol=None, hermitian=False):
 def _key(seed):
     from ...core import rng
 
-    return jax.random.key(seed) if seed else rng.next_key()
+    return rng.seed_or_next(seed)
 
 
 @register_op(nondiff=True)

@@ -932,6 +932,95 @@ add_specs({
                                         rand=True),
 })
 
+# --- tail tranche 4: phi-name registrations + small kernels -----------------
+_cx = (sym(2, 8) + 1j * sym(2, 8, seed=9)).astype(np.complex64)
+
+
+def _tiny_jpeg_bytes():
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(buf, format="JPEG")
+    return np.frombuffer(buf.getvalue(), np.uint8)
+add_specs({
+    "viterbi_decode": S([sym(2, 5, 4), sym(4, 4, seed=9),
+                         np.array([5, 5], np.int64)],
+                        kwargs={"include_bos_eos_tag": False}),
+    "fft_c2c": S([_cx], ref=lambda x: np.fft.fft(x)),
+    "fft_r2c": S([sym(2, 8)], ref=lambda x: np.fft.rfft(x)),
+    "fft_c2r": S([np.fft.rfft(sym(2, 8)).astype(np.complex64)],
+                 ref=lambda x: np.fft.irfft(x)),
+    "stft": S([sym(2, 128), np.hanning(32).astype(np.float32)],
+              kwargs={"n_fft": 32, "hop_length": 8}),
+    "frame": S([sym(2, 64)], kwargs={"frame_length": 16, "hop_length": 8},
+               grad=(0,)),
+    "overlap_add": S([sym(2, 16, 5)], kwargs={"hop_length": 8}, grad=(0,)),
+    "cross_entropy_with_softmax": S([sym(4, 5),
+                                     ints(4, 1, lo=0, hi=5, seed=9)],
+                                    grad=(0,)),
+    "flash_attn": S([sym(2, 8, 2, 8), sym(2, 8, 2, 8, seed=9),
+                     sym(2, 8, 2, 8, seed=5)], kwargs={"causal": True},
+                    grad=(0, 1, 2)),
+    "flash_attn_qkvpacked": S([sym(2, 8, 3, 2, 8)], grad=(0,)),
+    "memory_efficient_attention": S([sym(2, 8, 2, 8),
+                                     sym(2, 8, 2, 8, seed=9),
+                                     sym(2, 8, 2, 8, seed=5)]),
+    "pool2d": S([sym(1, 2, 6, 6)], kwargs={"kernel_size": 2,
+                                           "pooling_type": "avg"},
+                grad=(0,)),
+    "sync_batch_norm_": S([sym(2, 3, 4, 4), sym(3, seed=9) * 0.1,
+                           pos(3, seed=5), pos(3, seed=6),
+                           sym(3, seed=7)]),
+    "check_finite_and_unscale_": S(
+        [[sym(3, 2), sym(4, seed=9)], np.asarray(2.0, np.float32)]),
+    "update_loss_scaling_": S(
+        [[sym(3, 2)], np.asarray(False), np.asarray(1024.0, np.float32),
+         np.asarray(999, np.int32), np.asarray(0, np.int32)],
+        kwargs={"incr_every_n_steps": 1000}),
+    "merged_adam_": S([[sym(4)], [sym(4, seed=9)],
+                       np.asarray(0.1, np.float32), [sym(4, seed=5) * 0.1],
+                       [pos(4, seed=6) * 0.1],
+                       [np.asarray(0.9, np.float32)],
+                       [np.asarray(0.9, np.float32)]]),
+    "merged_momentum_": S([[sym(4)], [sym(4, seed=9)], [sym(4, seed=5)],
+                           np.asarray(0.1, np.float32)]),
+    "number_count": S([ints(12, lo=0, hi=4)], kwargs={"upper_range": 4},
+                      ref=lambda n: np.bincount(n, minlength=4)),
+    "limit_by_capacity": S([ints(4, lo=0, hi=10), np.asarray(5, np.int64)]),
+    "assign_pos": S([ints(8, lo=0, hi=3), ints(3, lo=1, hi=8, seed=9)]),
+    "prune_gate_by_capacity": S([ints(8, lo=0, hi=3),
+                                 np.array([2, 2, 2], np.int64)],
+                                kwargs={"n_expert": 3}),
+    "random_routing": S([ints(6, lo=0, hi=3), frac01(6, seed=9),
+                         frac01(6, seed=5)]),
+    "view_shape": S([sym(2, 6)], kwargs={"dims": (3, 4)}, grad=(0,),
+                    ref=lambda x: x.reshape(3, 4)),
+    "view_dtype": S([sym(2, 3)], kwargs={"dtype": "int32"}),
+    "view_slice": S([sym(6, 2)], kwargs={"begin_idx": 1, "end_idx": 4},
+                    grad=(0,), ref=lambda x: x[1:4]),
+    "is_empty": S([sym(2, 3)], ref=lambda x: np.bool_(False)),
+    "multiplex": S([[sym(4, 3), sym(4, 3, seed=9)],
+                    ints(4, 1, lo=0, hi=2, seed=5)]),
+    "bilinear": S([sym(3, 4), sym(3, 5, seed=9), sym(6, 4, 5, seed=5)],
+                  grad=(0, 1, 2),
+                  ref=lambda x, y, w: np.einsum("bi,kij,bj->bk", x, w, y)),
+    "affine_channel": S([sym(2, 3, 4, 4), pos(3, seed=9), sym(3, seed=5)],
+                        grad=(0,)),
+    "add_position_encoding": S([sym(2, 6, 8)], grad=(0,)),
+    "box_clip": S([pos(5, 4, lo=0.0, hi=30.0),
+                   np.array([[20.0, 20.0, 1.0]], np.float32)]),
+    "cvm": S([sym(4, 6), sym(4, 2, seed=9)], kwargs={"use_cvm": False},
+             ref=lambda x, c: x[:, 2:]),
+    "shuffle_batch": S([sym(6, 3)], rand=True),
+    "reduce_as": S([sym(3, 4), sym(1, 4, seed=9)],
+                   ref=lambda x, t: x.sum(0, keepdims=True)),
+    "gaussian_inplace": S([sym(3, 3)], rand=True),
+    "uniform_inplace": S([sym(3, 3)], rand=True),
+    "decode_jpeg": S([_tiny_jpeg_bytes()], no_jit=True),
+})
+
 # --- ops excluded from generation (reason each) -----------------------------
 OPT_OUT = {
     # pytree-structured inputs (flat weight list + optional masks) don't fit
@@ -948,6 +1037,9 @@ OPT_OUT = {
     "generate_proposals": "dynamic output; tests/test_vision_ops.py",
     "distribute_fpn_proposals": "list output; tests/test_vision_ops.py",
     "prior_box": "tuple-of-const outputs; tests/test_vision_ops.py",
+    # filesystem input (a path string, not an array); decode_jpeg covers
+    # the image-IO pair and read_file is one open().read()
+    "read_file": "host filesystem op; no array inputs to generate",
 }
 
 
@@ -1003,9 +1095,18 @@ def test_op_output(name):
         refs = refs if isinstance(refs, (tuple, list)) else [refs]
         assert len(refs) <= len(leaves)
         for got, want in zip(leaves, refs):
-            np.testing.assert_allclose(
-                np.asarray(got, np.float32), np.asarray(want, np.float32),
-                rtol=1e-4, atol=1e-5, err_msg=f"{name} vs numpy")
+            if np.iscomplexobj(want) or np.iscomplexobj(got):
+                # compare as complex: a conjugate/sign error in the
+                # imaginary half must fail, not be cast away
+                np.testing.assert_allclose(
+                    np.asarray(got, np.complex64),
+                    np.asarray(want, np.complex64),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{name} vs numpy")
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(got, np.float32),
+                    np.asarray(want, np.float32),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{name} vs numpy")
     if not spec.no_jit:
         arr_slots = [i for i, a in enumerate(spec.inputs)
                      if isinstance(a, np.ndarray)]
@@ -1023,8 +1124,10 @@ def test_op_output(name):
         with dispatch.no_grad():
             jit_out = jax.jit(f)(*[spec.inputs[i] for i in arr_slots])
         for e, j in zip(leaves, jit_out):
+            cdt = np.complex64 if (np.iscomplexobj(e)
+                                   or np.iscomplexobj(j)) else np.float32
             np.testing.assert_allclose(
-                np.asarray(e, np.float32), np.asarray(j, np.float32),
+                np.asarray(e, cdt), np.asarray(j, cdt),
                 rtol=1e-5, atol=1e-6,
                 err_msg=f"{name}: eager vs jit mismatch")
 
