@@ -110,7 +110,9 @@ def test_two_process_rpc(tmp_path):
             [sys.executable, str(script), str(tmp_path)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     for p in procs:
-        out, _ = p.communicate(timeout=120)
+        # generous budget: each worker imports jax (~30-60s on a loaded
+        # machine) before the rendezvous even starts
+        out, _ = p.communicate(timeout=420)
         assert p.returncode == 0, out.decode()
     content = (tmp_path / "rank0.txt").read_text()
     assert content == "42;[0, 2, 4, 6]"
