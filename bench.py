@@ -497,8 +497,25 @@ def _save_baselines(platform, configs):
         pass
 
 
+def _devices_with_retry(tries: int = 4, wait_s: float = 90.0):
+    """The axon tunnel can flap (UNAVAILABLE on init); a transient outage
+    should cost a delay, never the whole perf artifact."""
+    last = None
+    for attempt in range(tries):
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            last = e
+            print(f"[bench] backend init failed "
+                  f"(attempt {attempt + 1}/{tries}): {str(e)[:120]}",
+                  file=sys.stderr, flush=True)
+            if attempt < tries - 1:
+                time.sleep(wait_s)
+    raise last
+
+
 def main():
-    platform = jax.devices()[0].platform
+    platform = _devices_with_retry()[0].platform
     baselines = _load_baselines(platform)
     new_baselines = dict(baselines)
     results = {}
