@@ -1,0 +1,160 @@
+"""Op microbenchmark regression gate.
+
+Reference: tools/ci_op_benchmark.sh:128 — CI times a basket of ops on the
+PR branch and diffs against develop, failing on regressions. Here the
+baseline is a pinned JSON per platform (op_bench_baseline.json next to
+this script): run with --update to (re)pin, run bare to compare; exit 1
+when any op is slower than threshold x its pinned time.
+
+Usage:
+    python tools/ci_op_benchmark.py --update      # pin current timings
+    python tools/ci_op_benchmark.py               # gate (default 1.5x)
+    python tools/ci_op_benchmark.py --threshold 2.0
+
+The basket covers the op families whose regressions have bitten before:
+matmul epilogues, conv, norm/softmax fusions, attention, scatter/gather,
+reductions, and the dispatch overhead itself (a tiny elementwise op).
+Each entry times the JITTED op (steady-state, after warmup), so what is
+measured is the compiled kernel + dispatch, not tracing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import jax
+
+# the axon sitecustomize imports jax before env vars are read; the config
+# update is the reliable platform override (same pattern as tests/conftest)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+BASE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "op_bench_baseline.json")
+
+RS = np.random.RandomState(0)
+
+
+def _basket():
+    import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.ops.dispatch import OPS
+
+    a = jnp.asarray(RS.randn(256, 256).astype(np.float32))
+    b = jnp.asarray(RS.randn(256, 256).astype(np.float32))
+    img = jnp.asarray(RS.randn(8, 32, 32, 32).astype(np.float32))
+    nchw = jnp.asarray(RS.randn(8, 16, 32, 32).astype(np.float32))
+    w = jnp.asarray(RS.randn(16, 16, 3, 3).astype(np.float32))
+    qkv = jnp.asarray(RS.randn(4, 128, 4, 32).astype(np.float32))
+    tiny = jnp.asarray(RS.randn(32).astype(np.float32))
+    seg_x = jnp.asarray(RS.randn(1024, 64).astype(np.float32))
+    seg_id = jnp.asarray(RS.randint(0, 64, 1024).astype(np.int32))
+
+    K = {name: OPS[name]._kernel for name in OPS}
+    return {
+        "dispatch_tiny_add": lambda: K["add"](tiny, tiny),
+        "matmul_256": lambda: K["matmul"](a, b),
+        "fc_gelu": lambda: K["fc"](a, b, None, activation_type="gelu"),
+        "conv2d_3x3": lambda: K["conv2d"](nchw, w, None, 1, 1, 1, 1,
+                                          "NCHW"),
+        "layer_norm": lambda: K["layer_norm"](img, None, None, 1e-5, -1),
+        "softmax": lambda: K["softmax"](a, -1),
+        "flash_attn_or_sdpa": lambda: K["flash_attn"](qkv, qkv, qkv,
+                                                      causal=True),
+        "segment_sum": lambda: K["segment_pool"](seg_x, seg_id, "SUM", 64),
+        "reduce_sum": lambda: K["sum"](img),
+        "topk": lambda: K["topk"](a, 8),
+    }
+
+
+def measure(reps: int = 20, warmup: int = 3):
+    out = {}
+    for name, fn in _basket().items():
+        jfn = jax.jit(fn)
+        try:
+            for _ in range(warmup):
+                jax.tree.map(
+                    lambda x: x.block_until_ready() if hasattr(
+                        x, "block_until_ready") else x, jfn())
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.tree.map(
+                    lambda x: x.block_until_ready() if hasattr(
+                        x, "block_until_ready") else x, jfn())
+                times.append(time.perf_counter() - t0)
+            out[name] = statistics.median(times)
+        except Exception as e:  # basket op broken counts as a failure too
+            out[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--update", action="store_true",
+                   help="pin current timings as the baseline")
+    p.add_argument("--threshold", type=float, default=1.5,
+                   help="fail when median time > threshold * baseline")
+    p.add_argument("--reps", type=int, default=20)
+    args = p.parse_args()
+
+    platform = jax.devices()[0].platform
+    current = measure(args.reps)
+    print(json.dumps({"platform": platform, "timings": current}, indent=1))
+
+    if args.update:
+        data = {}
+        if os.path.exists(BASE_PATH):
+            with open(BASE_PATH) as f:
+                data = json.load(f)
+        data[platform] = current
+        with open(BASE_PATH, "w") as f:
+            json.dump(data, f, indent=1)
+        print(f"[op-bench] baseline pinned for {platform!r}",
+              file=sys.stderr)
+        return 0
+
+    if not os.path.exists(BASE_PATH):
+        print("[op-bench] no baseline; run with --update first",
+              file=sys.stderr)
+        return 0
+    with open(BASE_PATH) as f:
+        base = json.load(f).get(platform)
+    if not base:
+        print(f"[op-bench] no baseline for platform {platform!r}",
+              file=sys.stderr)
+        return 0
+
+    failures = []
+    for name, t in current.items():
+        pinned = base.get(name)
+        if isinstance(t, dict):
+            failures.append(f"{name}: {t['error']}")
+            continue
+        if not isinstance(pinned, (int, float)):
+            continue
+        ratio = t / pinned
+        flag = " <-- REGRESSION" if ratio > args.threshold else ""
+        print(f"[op-bench] {name}: {t * 1e6:.0f}us vs pinned "
+              f"{pinned * 1e6:.0f}us (x{ratio:.2f}){flag}",
+              file=sys.stderr)
+        if ratio > args.threshold:
+            failures.append(f"{name}: x{ratio:.2f} slower")
+    if failures:
+        print("[op-bench] FAILED: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print("[op-bench] all ops within threshold", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
