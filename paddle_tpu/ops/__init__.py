@@ -31,6 +31,7 @@ from .kernels import (  # noqa: F401
     rnn_ops,
     search,
     tail_alias,
+    tail_collective,
     tail_math,
     tail_nn,
     tail_seq,

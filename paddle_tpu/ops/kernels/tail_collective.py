@@ -1,0 +1,300 @@
+"""Op tail 5: collective op names + executor-plumbing ops.
+
+The reference's graph-level collective ops (all_reduce, c_allreduce_*,
+broadcast, ...) and executor plumbing (memcpy, share_data, depend, full_)
+exist as op names because its static graphs carry communication and
+memory movement as nodes. Here the communication RUNTIME is
+distributed.collective (eager multi-process + traced lax collectives) and
+memory movement is PJRT — these registrations give the phi names real
+behavior through those subsystems, so imported programs and the op
+manifest resolve them.
+
+Design note: collective kernels are EAGER ops — they wrap arrays into
+Tensors and call the collective layer, which picks the traced lax path
+inside shard_map/jit scopes and the multi-process eager path otherwise.
+With a single process and world=1 they are exact identities, matching the
+reference's degenerate-ring behavior.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+def _coll():
+    from ...distributed import collective as C
+
+    return C
+
+
+def _run_collective(fn_name, arr, **kw):
+    C = _coll()
+    from ...core.tensor import Tensor
+
+    t = Tensor._from_data(arr)
+    out = getattr(C, fn_name)(t, **kw)
+    # mutating collectives return a Task and update in place
+    return t._data if out is None or not isinstance(out, Tensor) else \
+        out._data
+
+
+# -- collective names ---------------------------------------------------------
+
+
+@register_op(nondiff=True)
+def all_reduce(x, reduce_type=0, ring_id=0):
+    ops = {0: "sum", 1: "max", 2: "min", 3: "prod", 4: "avg"}
+    return _run_collective("all_reduce", x, op=ops.get(reduce_type, "sum"))
+
+
+@register_op(name="c_allreduce_sum", nondiff=True)
+def c_allreduce_sum(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    return _run_collective("all_reduce", x, op="sum")
+
+
+@register_op(name="c_allreduce_max", nondiff=True)
+def c_allreduce_max(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    return _run_collective("all_reduce", x, op="max")
+
+
+@register_op(name="c_allreduce_min", nondiff=True)
+def c_allreduce_min(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    return _run_collective("all_reduce", x, op="min")
+
+
+@register_op(name="c_allreduce_prod", nondiff=True)
+def c_allreduce_prod(x, ring_id=0, use_calc_stream=True, use_model_parallel=False):
+    return _run_collective("all_reduce", x, op="prod")
+
+
+@register_op(name="mp_allreduce_sum", nondiff=True)
+def mp_allreduce_sum(x, ring_id=0):
+    return _run_collective("all_reduce", x, op="sum")
+
+
+@register_op(nondiff=True)
+def all_gather(x, ring_id=0, nranks=1):
+    C = _coll()
+    from ...core.tensor import Tensor
+
+    outs: list = []
+    C.all_gather(outs, Tensor._from_data(x))
+    return jnp.concatenate([o._data for o in outs], axis=0)
+
+
+@register_op(name="c_allgather", nondiff=True)
+def c_allgather(x, ring_id=0, nranks=1, use_calc_stream=True):
+    return all_gather.__wrapped__(x, ring_id, nranks)
+
+
+@register_op(name="c_concat", nondiff=True)
+def c_concat(x, rank=0, nranks=1, ring_id=0, use_calc_stream=True,
+             use_model_parallel=True):
+    """Gather along the LAST axis (TP row-parallel output concat)."""
+    C = _coll()
+    from ...core.tensor import Tensor
+
+    outs: list = []
+    C.all_gather(outs, Tensor._from_data(x))
+    return jnp.concatenate([o._data for o in outs], axis=-1)
+
+
+@register_op(nondiff=True)
+def broadcast(x, root=0, ring_id=0):
+    return _run_collective("broadcast", x, src=root)
+
+
+@register_op(name="c_broadcast", nondiff=True)
+def c_broadcast(x, root=0, ring_id=0, use_calc_stream=True):
+    return _run_collective("broadcast", x, src=root)
+
+
+@register_op(nondiff=True)
+def reduce(x, root_id=0, reduce_type=0, ring_id=0):
+    ops = {0: "sum", 1: "max", 2: "min", 3: "prod"}
+    return _run_collective("reduce", x, dst=root_id,
+                           op=ops.get(reduce_type, "sum"))
+
+
+@register_op(name="c_reduce_sum", nondiff=True)
+def c_reduce_sum(x, root_id=0, ring_id=0, use_calc_stream=True):
+    return _run_collective("reduce", x, dst=root_id, op="sum")
+
+
+@register_op(nondiff=True)
+def reduce_scatter(x, ring_id=0, nranks=1):
+    C = _coll()
+    from ...core.tensor import Tensor
+
+    # the collective REPLACES dst._data wholesale (tensor._data = out),
+    # so dst is just a placeholder to receive the result
+    dst = Tensor._from_data(x[:0])
+    C.reduce_scatter(dst, Tensor._from_data(x))
+    return dst._data
+
+
+@register_op(nondiff=True)
+def all_to_all(x, ring_id=0):
+    C = _coll()
+    from ...core.tensor import Tensor
+
+    outs: list = []
+    C.alltoall(outs, [Tensor._from_data(s) for s in jnp.split(
+        x, max(C._get_or_init_default().nranks, 1), axis=0)])
+    out = jnp.concatenate([o._data for o in outs], axis=0)
+    return out.reshape((-1,) + tuple(x.shape[1:]))
+
+
+@register_op(name="c_scatter", nondiff=True)
+def c_scatter(x, root=0, ring_id=0, nranks=1, use_calc_stream=True):
+    C = _coll()
+    from ...distributed.env import get_rank
+
+    g = C._get_or_init_default()
+    n = max(g.nranks, 1)
+    return jnp.split(x, n, axis=0)[min(get_rank(), n - 1)]
+
+
+@register_op(name="c_identity", nondiff=True)
+def c_identity(x, ring_id=0, use_calc_stream=True, use_model_parallel=True):
+    """Identity forward; the reference uses it to mark the TP boundary
+    (backward is allreduce — handled by our TP layers directly)."""
+    return x
+
+
+@register_op(name="sync_calc_stream", nondiff=True)
+def sync_calc_stream(x):
+    """Stream sync is a device fence; PJRT exposes it as blocking."""
+    if hasattr(x, "block_until_ready"):
+        x.block_until_ready()
+    return x
+
+
+# -- memory movement / executor plumbing --------------------------------------
+
+
+@register_op(nondiff=True)
+def memcpy_d2h(x, dst_place_type=0):
+    return jax.device_get(x)
+
+
+@register_op(nondiff=True)
+def memcpy_h2d(x, dst_place_type=1):
+    return jnp.asarray(x)
+
+
+@register_op(nondiff=True)
+def copy_to(x, place=None, blocking=True):
+    return jnp.asarray(x)
+
+
+@register_op(name="npu_identity", nondiff=True)
+def npu_identity(x, format=-1):
+    return x
+
+
+@register_op(nondiff=True)
+def share_data(x):
+    return x
+
+
+@register_op(nondiff=True)
+def depend(x, dep=None):
+    """Scheduling edge: value passes through, the dep only orders."""
+    return x
+
+
+@register_op(nondiff=True)
+def shape(input):
+    return jnp.asarray(input.shape, jnp.int32)
+
+
+@register_op(name="full_", nondiff=True)
+def full_(output, shape=None, value=0.0, dtype=None):
+    s = tuple(shape) if shape is not None else output.shape
+    dt = jnp.dtype(dtype) if dtype is not None else output.dtype
+    return jnp.full(s, value, dt)
+
+
+@register_op(nondiff=True)
+def full_int_array(value, dtype="int64"):
+    return jnp.asarray(value, jnp.dtype(dtype))
+
+
+@register_op(nondiff=True)
+def full_with_tensor(value, shape, dtype=None):
+    dt = jnp.dtype(dtype) if dtype is not None else jnp.asarray(value).dtype
+    return jnp.full(tuple(np.asarray(shape).tolist()),
+                    jnp.asarray(value), dt)
+
+
+@register_op(name="assign_value_", nondiff=True)
+def assign_value_(output, shape=None, dtype=None, values=()):
+    dt = jnp.dtype(dtype) if dtype is not None else output.dtype
+    s = tuple(shape) if shape is not None else output.shape
+    return jnp.asarray(list(values), dt).reshape(s)
+
+
+@register_op(name="assign_out_", nondiff=True)
+def assign_out_(x, output):
+    return x
+
+
+@register_op(name="set", nondiff=True)
+def set_(x, source):
+    return source
+
+
+@register_op
+def set_value_with_tensor(x, values, starts, ends, steps, axes,
+                          decrease_axes=(), none_axes=()):
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, steps):
+        sl[ax] = slice(s, e, st)
+    return x.at[tuple(sl)].set(values)
+
+
+@register_op(name="slice")
+def slice_(input, axes, starts, ends, infer_flags=(), decrease_axis=()):
+    sl = [slice(None)] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = slice(s, e)
+    out = input[tuple(sl)]
+    if decrease_axis:
+        out = out.reshape([d for i, d in enumerate(out.shape)
+                           if i not in set(decrease_axis)])
+    return out
+
+
+@register_op
+def trans_layout(x, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+@register_op(nondiff=True)
+def coalesce_tensor(input, dtype=None, copy_data=True, set_constant=False,
+                    constant=0.0, persist_output=False, align_size=-1):
+    """Fuse a list of tensors into one flat buffer + per-tensor views
+    (reference coalesce_tensor op — the bucketing primitive under fused
+    gradient allreduce). The fused buffer (and therefore the views) take
+    `dtype` when given (fp16 grads fused into an fp32 master buffer);
+    set_constant overrides copy_data like the reference."""
+    dt = jnp.dtype(dtype) if dtype is not None else (
+        input[0].dtype if input else jnp.float32)
+    flats = [t.reshape(-1).astype(dt) for t in input]
+    fused = jnp.concatenate(flats) if flats else jnp.zeros((0,), dt)
+    if set_constant:
+        fused = jnp.full_like(fused, constant)
+    elif not copy_data:
+        fused = jnp.zeros_like(fused)
+    outs = []
+    off = 0
+    for t in input:
+        n = int(np.prod(t.shape))
+        outs.append(fused[off:off + n].reshape(t.shape))
+        off += n
+    return outs, fused

@@ -1,0 +1,112 @@
+"""Collective op names + executor plumbing ops (tail tranche 5).
+
+World-size-1 semantics are exact (degenerate ring): all_reduce/broadcast
+are identities, all_gather concatenates one replica, reduce_scatter
+returns the whole buffer. Multi-rank behavior of the UNDERLYING layer is
+covered by tests/test_distributed.py and test_multiproc_collective.py —
+these tests pin the op-name plumbing on top of it.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+
+RS = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+X = RS.randn(4, 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("name", [
+    "all_reduce", "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "mp_allreduce_sum", "broadcast", "c_broadcast",
+    "c_identity", "npu_identity", "share_data", "depend", "copy_to",
+    "sync_calc_stream", "memcpy_h2d",
+])
+def test_identity_like_at_world1(name):
+    got = getattr(_C_ops, name)(_t(X))
+    np.testing.assert_allclose(np.asarray(got.numpy()), X, rtol=1e-6)
+
+
+def test_gather_scatter_world1():
+    np.testing.assert_allclose(_C_ops.all_gather(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.c_allgather(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.c_concat(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.reduce_scatter(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.all_to_all(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.c_scatter(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.reduce(_t(X)).numpy(), X)
+    np.testing.assert_allclose(_C_ops.c_reduce_sum(_t(X)).numpy(), X)
+
+
+def test_memcpy_roundtrip():
+    host = _C_ops.memcpy_d2h(_t(X))
+    np.testing.assert_allclose(np.asarray(host.numpy()), X)
+
+
+def test_plumbing_creation_ops():
+    out = _C_ops.full_(_t(np.zeros((2, 3), np.float32)), value=7.0)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 3), 7.0))
+    arr = _C_ops.full_int_array([2, 5, 9])
+    assert arr.numpy().tolist() == [2, 5, 9]
+    fwt = _C_ops.full_with_tensor(_t(np.float32(3.5)),
+                                  _t(np.array([2, 2], np.int64)))
+    np.testing.assert_allclose(fwt.numpy(), np.full((2, 2), 3.5))
+    av = _C_ops.assign_value_(_t(np.zeros((2, 2), np.float32)),
+                              shape=(2, 2), values=(1.0, 2.0, 3.0, 4.0))
+    np.testing.assert_allclose(av.numpy(), [[1, 2], [3, 4]])
+    np.testing.assert_allclose(
+        _C_ops.assign_out_(_t(X), _t(np.zeros_like(X))).numpy(), X)
+    np.testing.assert_allclose(
+        _C_ops.set(_t(np.zeros_like(X)), _t(X)).numpy(), X)
+
+
+def test_shape_slice_set_value_trans_layout():
+    assert _C_ops.shape(_t(X)).numpy().tolist() == [4, 3]
+    sl = _C_ops.slice(_t(X), axes=[0], starts=[1], ends=[3])
+    np.testing.assert_allclose(sl.numpy(), X[1:3])
+    sl2 = _C_ops.slice(_t(X), axes=[0, 1], starts=[0, 1], ends=[1, 2],
+                       decrease_axis=[0])
+    np.testing.assert_allclose(sl2.numpy(), X[0:1, 1:2].reshape(1))
+    sv = _C_ops.set_value_with_tensor(
+        _t(X), _t(np.zeros((2, 3), np.float32)), starts=[1], ends=[3],
+        steps=[1], axes=[0])
+    want = X.copy()
+    want[1:3] = 0.0
+    np.testing.assert_allclose(sv.numpy(), want)
+    tr = _C_ops.trans_layout(_t(X), perm=[1, 0])
+    np.testing.assert_allclose(tr.numpy(), X.T)
+
+
+def test_coalesce_tensor_views_and_buffer():
+    a = RS.randn(2, 2).astype(np.float32)
+    b = RS.randn(3).astype(np.float32)
+    views, fused = _C_ops.coalesce_tensor([_t(a), _t(b)])
+    assert np.asarray(fused.numpy()).shape == (7,)
+    np.testing.assert_allclose(views[0].numpy(), a)
+    np.testing.assert_allclose(views[1].numpy(), b)
+    np.testing.assert_allclose(fused.numpy(),
+                               np.concatenate([a.ravel(), b.ravel()]))
+    _, const = _C_ops.coalesce_tensor([_t(a)], set_constant=True,
+                                      constant=0.5)
+    np.testing.assert_allclose(const.numpy(), np.full(4, 0.5))
+
+
+def test_data_ops_carry_gradients():
+    """slice/trans_layout/set_value_with_tensor are data ops with real
+    grads (reference has slice_grad/transpose_grad/set_value_grad)."""
+    x = _t(X)
+    x.stop_gradient = False
+    _C_ops.slice(x, axes=[0], starts=[1], ends=[3]).sum().backward()
+    g = x.grad.numpy()
+    assert g[1:3].sum() == pytest.approx(6.0) and g[0].sum() == 0.0
+
+    y = _t(X)
+    y.stop_gradient = False
+    (_C_ops.trans_layout(y, perm=[1, 0]) * 2.0).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), np.full_like(X, 2.0))

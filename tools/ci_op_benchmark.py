@@ -13,9 +13,14 @@ Usage:
 
 The basket covers the op families whose regressions have bitten before:
 matmul epilogues, conv, norm/softmax fusions, attention, scatter/gather,
-reductions, and the dispatch overhead itself (a tiny elementwise op).
-Each entry times the JITTED op (steady-state, after warmup), so what is
-measured is the compiled kernel + dispatch, not tracing.
+reductions. Kernel entries time the JITTED raw kernel (steady-state,
+after warmup — compiled-code regressions); the eager_dispatch_* entries
+go through the PUBLIC op api on Tensors, so call_op / tape bookkeeping
+regressions (the eager hot path) are gated too.
+
+Baselines are keyed by platform + cpu count: absolute microsecond pins
+only gate the machine class that produced them; an unmatched key is
+reported and skipped, never failed.
 """
 from __future__ import annotations
 
@@ -47,6 +52,7 @@ RS = np.random.RandomState(0)
 
 def _basket():
     import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.ops.dispatch import OPS
 
     a = jnp.asarray(RS.randn(256, 256).astype(np.float32))
@@ -60,8 +66,17 @@ def _basket():
     seg_id = jnp.asarray(RS.randint(0, 64, 1024).astype(np.int32))
 
     K = {name: OPS[name]._kernel for name in OPS}
-    return {
-        "dispatch_tiny_add": lambda: K["add"](tiny, tiny),
+    t_tiny = Tensor._from_data(tiny)
+    t_tiny_g = Tensor._from_data(tiny)
+    t_tiny_g.stop_gradient = False
+    # eager entries run the PUBLIC api (dispatch + tape), not raw kernels;
+    # they are marked so measure() skips jitting them
+    eager = {
+        "eager_dispatch_add": lambda: OPS["add"](t_tiny, t_tiny)._data,
+        "eager_dispatch_add_grad": lambda: OPS["add"](
+            t_tiny_g, t_tiny_g)._data,
+    }
+    jitted = {
         "matmul_256": lambda: K["matmul"](a, b),
         "fc_gelu": lambda: K["fc"](a, b, None, activation_type="gelu"),
         "conv2d_3x3": lambda: K["conv2d"](nchw, w, None, 1, 1, 1, 1,
@@ -74,12 +89,16 @@ def _basket():
         "reduce_sum": lambda: K["sum"](img),
         "topk": lambda: K["topk"](a, 8),
     }
+    return eager, jitted
 
 
 def measure(reps: int = 20, warmup: int = 3):
     out = {}
-    for name, fn in _basket().items():
-        jfn = jax.jit(fn)
+    eager, jitted = _basket()
+    entries = [(n, f, False) for n, f in eager.items()] + \
+        [(n, f, True) for n, f in jitted.items()]
+    for name, fn, do_jit in entries:
+        jfn = jax.jit(fn) if do_jit else fn
         try:
             for _ in range(warmup):
                 jax.tree.map(
@@ -108,19 +127,25 @@ def main():
     args = p.parse_args()
 
     platform = jax.devices()[0].platform
+    # absolute-time pins only gate the machine class that produced them
+    key = f"{platform}/{os.cpu_count()}cpu"
     current = measure(args.reps)
-    print(json.dumps({"platform": platform, "timings": current}, indent=1))
+    print(json.dumps({"key": key, "timings": current}, indent=1))
 
     if args.update:
+        broken = {n: t for n, t in current.items() if isinstance(t, dict)}
+        if broken:
+            print(f"[op-bench] refusing to pin a broken baseline: "
+                  f"{sorted(broken)}", file=sys.stderr)
+            return 1
         data = {}
         if os.path.exists(BASE_PATH):
             with open(BASE_PATH) as f:
                 data = json.load(f)
-        data[platform] = current
+        data[key] = current
         with open(BASE_PATH, "w") as f:
             json.dump(data, f, indent=1)
-        print(f"[op-bench] baseline pinned for {platform!r}",
-              file=sys.stderr)
+        print(f"[op-bench] baseline pinned for {key!r}", file=sys.stderr)
         return 0
 
     if not os.path.exists(BASE_PATH):
@@ -128,10 +153,10 @@ def main():
               file=sys.stderr)
         return 0
     with open(BASE_PATH) as f:
-        base = json.load(f).get(platform)
+        base = json.load(f).get(key)
     if not base:
-        print(f"[op-bench] no baseline for platform {platform!r}",
-              file=sys.stderr)
+        print(f"[op-bench] no baseline for machine key {key!r}; "
+              f"run --update on this machine class first", file=sys.stderr)
         return 0
 
     failures = []
