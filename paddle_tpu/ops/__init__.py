@@ -36,4 +36,5 @@ from .kernels import (  # noqa: F401
     tail_nn,
     tail_seq,
     vision_ops,
+    yolo_loss,
 )

@@ -152,11 +152,14 @@ def all_to_all(x, ring_id=0):
 @register_op(name="c_scatter", nondiff=True)
 def c_scatter(x, root=0, ring_id=0, nranks=1, use_calc_stream=True):
     C = _coll()
-    from ...distributed.env import get_rank
+    from ...core.tensor import Tensor
 
     g = C._get_or_init_default()
     n = max(g.nranks, 1)
-    return jnp.split(x, n, axis=0)[min(get_rank(), n - 1)]
+    dst = Tensor._from_data(x[:0])
+    C.scatter(dst, [Tensor._from_data(s)
+                    for s in jnp.split(x, n, axis=0)], src=root)
+    return dst._data
 
 
 @register_op(name="c_identity", nondiff=True)
@@ -285,12 +288,14 @@ def coalesce_tensor(input, dtype=None, copy_data=True, set_constant=False,
     set_constant overrides copy_data like the reference."""
     dt = jnp.dtype(dtype) if dtype is not None else (
         input[0].dtype if input else jnp.float32)
-    flats = [t.reshape(-1).astype(dt) for t in input]
-    fused = jnp.concatenate(flats) if flats else jnp.zeros((0,), dt)
+    total = int(sum(np.prod(t.shape) for t in input))
     if set_constant:
-        fused = jnp.full_like(fused, constant)
+        fused = jnp.full((total,), constant, dt)
     elif not copy_data:
-        fused = jnp.zeros_like(fused)
+        fused = jnp.zeros((total,), dt)
+    else:
+        flats = [t.reshape(-1).astype(dt) for t in input]
+        fused = jnp.concatenate(flats) if flats else jnp.zeros((0,), dt)
     outs = []
     off = 0
     for t in input:

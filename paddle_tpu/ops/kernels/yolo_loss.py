@@ -1,0 +1,181 @@
+"""yolo_loss + hsigmoid_loss — the two remaining substantive loss kernels.
+
+yolo_loss (reference paddle/phi/kernels/cpu/yolo_loss_kernel.cc): the
+YOLOv3 training objective. TPU shape: everything is dense masked math —
+the ignore mask is a [mask, H, W] best-IoU reduction over the (static) gt
+slots, the per-gt assignment scatters location/class losses with `.at[]`
+adds, and the whole thing vmaps over the batch. No data-dependent shapes:
+the gt slot count B is the padded static dim, invalid slots (w/h <= 1e-6)
+are masked exactly like the reference's gt_valid_mask.
+
+hsigmoid_loss (reference phi/kernels/cpu/hsigmoid_loss_kernel.cc +
+funcs/matrix_bit_code.h SimpleCode): hierarchical sigmoid over the
+default complete binary tree — code(c) = c + num_classes, weight index
+per bit is the code prefix, the binary target is the code suffix bit.
+The per-bit gather is one embedding-style lookup, so the compute is a
+[N, L, D] x [D] batched dot — MXU work, not a tree walk.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+def _bce(x, label):
+    """SigmoidCrossEntropy (reference yolo_loss_kernel.cc:14)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _xywh_iou(b1, b2):
+    """IoU of center-format boxes (reference CalcBoxIoU)."""
+    lo = jnp.maximum(b1[..., :2] - b1[..., 2:] / 2,
+                     b2[..., :2] - b2[..., 2:] / 2)
+    hi = jnp.minimum(b1[..., :2] + b1[..., 2:] / 2,
+                     b2[..., :2] + b2[..., 2:] / 2)
+    wh = hi - lo
+    inter = jnp.where((wh > 0).all(-1), wh[..., 0] * wh[..., 1], 0.0)
+    union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter)
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+              anchor_mask=(), class_num=1, ignore_thresh=0.7,
+              downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0):
+    """-> (loss [N], objectness_mask [N, mask, H, W], gt_match_mask [N, B])."""
+    anchors = tuple(anchors)
+    anchor_mask = tuple(anchor_mask)
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    N, _, H, W = x.shape
+    B = gt_box.shape[1]
+    input_size = downsample_ratio * H
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sw, sw
+    else:
+        pos_l, neg_l = 1.0, 0.0
+    if gt_score is None:
+        gt_score = jnp.ones((N, B), jnp.float32)
+
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    mask_arr = jnp.asarray(anchor_mask, jnp.int32)
+
+    def per_sample(xi, gtb, gtl, gts):
+        xr = xi.astype(jnp.float32).reshape(mask_num, 5 + class_num, H, W)
+        valid = (gtb[:, 2] > 1e-6) & (gtb[:, 3] > 1e-6)
+
+        # --- ignore mask: best pred-gt IoU per cell --------------------------
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+        px = (gx + jax.nn.sigmoid(xr[:, 0]) * scale + bias) / W
+        py = (gy + jax.nn.sigmoid(xr[:, 1]) * scale + bias) / H
+        pw = jnp.exp(xr[:, 2]) * aw[mask_arr][:, None, None] / input_size
+        ph = jnp.exp(xr[:, 3]) * ah[mask_arr][:, None, None] / input_size
+        pred = jnp.stack([px, py, pw, ph], axis=-1)     # [mask, H, W, 4]
+        ious = _xywh_iou(pred[..., None, :], gtb[None, None, None])
+        ious = jnp.where(valid[None, None, None], ious, 0.0)
+        best_iou = ious.max(-1)                          # [mask, H, W]
+        obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+        # --- per-gt anchor assignment ---------------------------------------
+        an_boxes = jnp.stack([jnp.zeros(an_num), jnp.zeros(an_num),
+                              aw / input_size, ah / input_size], -1)
+        gt_shift = gtb.at[:, :2].set(0.0)
+        an_iou = _xywh_iou(gt_shift[:, None], an_boxes[None])  # [B, an]
+        best_n = jnp.argmax(an_iou, axis=-1)                   # [B]
+        mask_idx = jnp.argmax(
+            (mask_arr[None, :] == best_n[:, None]).astype(jnp.int32),
+            axis=-1)
+        in_mask = (mask_arr[None, :] == best_n[:, None]).any(-1)
+        match = jnp.where(valid, jnp.where(in_mask, mask_idx, -1), -1)
+
+        gi = jnp.clip((gtb[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        active = valid & in_mask
+        wgt = jnp.where(active, gts, 0.0)
+
+        # location loss at the assigned cell
+        cell = xr[mask_idx, :, gj, gi]                  # [B, 5+cls]
+        tx = gtb[:, 0] * W - gi
+        ty = gtb[:, 1] * H - gj
+        tw = jnp.log(jnp.maximum(gtb[:, 2] * input_size, 1e-9)
+                     / aw[best_n])
+        th = jnp.log(jnp.maximum(gtb[:, 3] * input_size, 1e-9)
+                     / ah[best_n])
+        loc_scale = (2.0 - gtb[:, 2] * gtb[:, 3]) * wgt
+        loc = (_bce(cell[:, 0], tx) + _bce(cell[:, 1], ty)
+               + jnp.abs(tw - cell[:, 2]) + jnp.abs(th - cell[:, 3]))
+        loss = jnp.sum(loc * loc_scale)
+
+        # class loss
+        onehot = jax.nn.one_hot(gtl, class_num)
+        targets = jnp.where(onehot > 0, pos_l, neg_l)
+        cls = _bce(cell[:, 5:], targets).sum(-1)
+        loss = loss + jnp.sum(cls * wgt)
+
+        # positive cells override the ignore mask with the gt score.
+        # Inactive slots must not touch the scatter at all (their
+        # mask_idx/gi/gj are garbage): accumulate positives with max so
+        # collisions are deterministic and stale values can't clobber.
+        written = jnp.zeros(obj_mask.shape, bool).at[
+            mask_idx, gj, gi].max(active)
+        score_map = jnp.zeros_like(obj_mask).at[mask_idx, gj, gi].max(
+            jnp.where(active, gts, 0.0))
+        obj_mask = jnp.where(written, score_map, obj_mask)
+
+        # objectness loss over every cell
+        obj_logit = xr[:, 4]
+        pos_term = _bce(obj_logit, 1.0) * obj_mask
+        neg_term = _bce(obj_logit, 0.0)
+        loss = loss + jnp.sum(jnp.where(obj_mask > 1e-5, pos_term,
+                                        jnp.where(obj_mask > -0.5,
+                                                  neg_term, 0.0)))
+        return loss, obj_mask, match
+
+    loss, objm, matchm = jax.vmap(per_sample)(
+        x, gt_box.astype(jnp.float32), gt_label.astype(jnp.int32),
+        gt_score.astype(jnp.float32))
+    return loss, objm, matchm.astype(jnp.int32)
+
+
+@register_op
+def hsigmoid_loss(x, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """-> (loss [N, 1], pre_out [N, L]) over the default complete binary
+    tree (SimpleCode, matrix_bit_code.h:100): code = label + num_classes,
+    weight row per bit = code prefix - 1, target bit = code suffix."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss custom tree (path_table/path_code) is not "
+            "implemented; the default SimpleCode tree is")
+    L = max(int(math.ceil(math.log2(max(num_classes, 2)))) + 1, 1)
+    code = label.astype(jnp.int32) + num_classes          # [N]
+    bit_pos = jnp.arange(L)                                # [L]
+    # get_length = floor(log2(code)), computed in INTEGER space (float32
+    # log2 mis-rounds near powers of two for large vocabularies):
+    # floor(log2(c)) = #{k >= 1 : 2^k <= c}
+    powers = jnp.left_shift(1, jnp.arange(1, L + 2))
+    length = jnp.sum((code[:, None] >= powers[None, :]).astype(jnp.int32),
+                     axis=-1)
+    active = bit_pos[None, :] < length[:, None]            # [N, L]
+    w_index = jnp.clip((code[:, None] >> (bit_pos[None, :] + 1)) - 1,
+                       0, num_classes - 2)                 # [N, L]
+    target = ((code[:, None] >> bit_pos[None, :]) & 1).astype(jnp.float32)
+    w_rows = jnp.take(weight, w_index, axis=0)             # [N, L, D]
+    pre = jnp.einsum("nld,nd->nl", w_rows, x)
+    if bias is not None:
+        pre = pre + jnp.take(bias.reshape(-1), w_index)
+    pre = jnp.clip(pre, -40.0, 40.0)
+    term = _bce(pre, target)
+    loss = jnp.sum(jnp.where(active, term, 0.0), axis=-1, keepdims=True)
+    return loss, jnp.where(active, pre, 0.0)

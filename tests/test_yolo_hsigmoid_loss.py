@@ -1,0 +1,220 @@
+"""yolo_loss and hsigmoid_loss vs independent numpy transcriptions.
+
+The numpy references below re-implement the reference algorithms
+(cpu/yolo_loss_kernel.cc and funcs/matrix_bit_code.h SimpleCode) directly
+from their scalar loops, so the dense/vmapped jnp kernels are checked
+against a structurally different implementation.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+
+RS = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _bce(x, l):
+    return max(x, 0.0) - x * l + math.log1p(math.exp(-abs(x)))
+
+
+def _iou_xywh(b1, b2):
+    lo = np.maximum(b1[:2] - b1[2:] / 2, b2[:2] - b2[2:] / 2)
+    hi = np.minimum(b1[:2] + b1[2:] / 2, b2[:2] + b2[2:] / 2)
+    wh = hi - lo
+    inter = wh[0] * wh[1] if (wh > 0).all() else 0.0
+    union = b1[2] * b1[3] + b2[2] * b2[3] - inter
+    return inter / max(union, 1e-10)
+
+
+def _np_yolo_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                  class_num, ignore_thresh, downsample_ratio,
+                  use_label_smooth=True, scale_x_y=1.0):
+    N, _, H, W = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    B = gt_box.shape[1]
+    input_size = downsample_ratio * H
+    sc, bi = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sw, sw
+    else:
+        pos_l, neg_l = 1.0, 0.0
+    sig = lambda v: 1.0 / (1.0 + math.exp(-v))
+    loss = np.zeros(N)
+    objm = np.zeros((N, mask_num, H, W))
+    match = -np.ones((N, B), np.int32)
+    for i in range(N):
+        xr = x[i].reshape(mask_num, 5 + class_num, H, W)
+        valid = [(gt_box[i, t, 2] > 1e-6 and gt_box[i, t, 3] > 1e-6)
+                 for t in range(B)]
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    an = anchor_mask[j]
+                    pred = np.array([
+                        (l + sig(xr[j, 0, k, l]) * sc + bi) / W,
+                        (k + sig(xr[j, 1, k, l]) * sc + bi) / H,
+                        math.exp(xr[j, 2, k, l]) * anchors[2 * an]
+                        / input_size,
+                        math.exp(xr[j, 3, k, l]) * anchors[2 * an + 1]
+                        / input_size])
+                    best = 0.0
+                    for t in range(B):
+                        if valid[t]:
+                            best = max(best, _iou_xywh(pred, gt_box[i, t]))
+                    if best > ignore_thresh:
+                        objm[i, j, k, l] = -1.0
+        for t in range(B):
+            if not valid[t]:
+                continue
+            gt = gt_box[i, t]
+            gi, gj = int(gt[0] * W), int(gt[1] * H)
+            best_iou, best_n = 0.0, 0
+            for an in range(an_num):
+                an_box = np.array([0, 0, anchors[2 * an] / input_size,
+                                   anchors[2 * an + 1] / input_size])
+                iou = _iou_xywh(an_box, np.array([0, 0, gt[2], gt[3]]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, an
+            mask_idx = anchor_mask.index(best_n) \
+                if best_n in anchor_mask else -1
+            match[i, t] = mask_idx
+            if mask_idx < 0:
+                continue
+            score = gt_score[i, t]
+            tx = gt[0] * W - gi
+            ty = gt[1] * H - gj
+            tw = math.log(gt[2] * input_size / anchors[2 * best_n])
+            th = math.log(gt[3] * input_size / anchors[2 * best_n + 1])
+            s = (2.0 - gt[2] * gt[3]) * score
+            loss[i] += _bce(xr[mask_idx, 0, gj, gi], tx) * s
+            loss[i] += _bce(xr[mask_idx, 1, gj, gi], ty) * s
+            loss[i] += abs(tw - xr[mask_idx, 2, gj, gi]) * s
+            loss[i] += abs(th - xr[mask_idx, 3, gj, gi]) * s
+            objm[i, mask_idx, gj, gi] = score
+            for c in range(class_num):
+                tgt = pos_l if c == gt_label[i, t] else neg_l
+                loss[i] += _bce(xr[mask_idx, 5 + c, gj, gi], tgt) * score
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    o = objm[i, j, k, l]
+                    if o > 1e-5:
+                        loss[i] += _bce(xr[j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _bce(xr[j, 4, k, l], 0.0)
+    return loss, objm, match
+
+
+def _yolo_case(seed=0):
+    rs = np.random.RandomState(seed)
+    N, H, W, C = 2, 4, 4, 3
+    anchors = [10, 14, 23, 27, 37, 58]
+    anchor_mask = [1, 2]
+    x = rs.randn(N, len(anchor_mask) * (5 + C), H, W).astype(np.float32)
+    gt = rs.uniform(0.2, 0.8, (N, 3, 4)).astype(np.float32) * \
+        np.array([1, 1, 0.4, 0.4], np.float32)
+    gt[0, 2] = 0.0  # invalid slot
+    lab = rs.randint(0, C, (N, 3)).astype(np.int32)
+    score = rs.uniform(0.5, 1.0, (N, 3)).astype(np.float32)
+    return x, gt, lab, score, anchors, anchor_mask, C
+
+
+def test_yolo_loss_matches_numpy_reference():
+    x, gt, lab, score, anchors, mask, C = _yolo_case()
+    loss, objm, match = _C_ops.yolo_loss(
+        _t(x), _t(gt), _t(lab), _t(score), anchors=anchors,
+        anchor_mask=mask, class_num=C, ignore_thresh=0.5,
+        downsample_ratio=32)
+    wl, wo, wm = _np_yolo_loss(x.astype(np.float64), gt, lab, score,
+                               anchors, mask, C, 0.5, 32)
+    np.testing.assert_allclose(loss.numpy(), wl, rtol=1e-4)
+    np.testing.assert_allclose(objm.numpy(), wo, atol=1e-6)
+    np.testing.assert_allclose(match.numpy(), wm)
+
+
+def test_yolo_loss_gradient_flows():
+    x, gt, lab, score, anchors, mask, C = _yolo_case(1)
+    xt = _t(x)
+    xt.stop_gradient = False
+    loss, _, _ = _C_ops.yolo_loss(xt, _t(gt), _t(lab), _t(score),
+                                  anchors=anchors, anchor_mask=mask,
+                                  class_num=C, ignore_thresh=0.5,
+                                  downsample_ratio=32)
+    loss.sum().backward()
+    g = xt.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def _np_hsigmoid(x, label, num_classes, weight, bias):
+    N = x.shape[0]
+    loss = np.zeros((N, 1))
+    for n in range(N):
+        c = int(label[n]) + num_classes
+        length = int(math.floor(math.log2(c)))
+        for bit in range(length):
+            idx = (c >> (bit + 1)) - 1
+            tgt = float((c >> bit) & 1)
+            pre = float(weight[idx] @ x[n] + (bias[idx] if bias is not None
+                                              else 0.0))
+            pre = max(-40.0, min(40.0, pre))
+            loss[n, 0] += _bce(pre, tgt)
+    return loss
+
+
+def test_hsigmoid_matches_numpy_reference():
+    N, D, C = 5, 8, 7
+    x = RS.randn(N, D).astype(np.float32)
+    lab = RS.randint(0, C, N).astype(np.int64)
+    w = RS.randn(C - 1, D).astype(np.float32) * 0.3
+    b = RS.randn(C - 1).astype(np.float32) * 0.1
+    loss, pre = _C_ops.hsigmoid_loss(_t(x), _t(lab), C, _t(w), _t(b))
+    want = _np_hsigmoid(x.astype(np.float64), lab, C, w, b)
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
+
+
+def test_hsigmoid_gradient_and_custom_tree_gate():
+    N, D, C = 4, 6, 10
+    x = _t(RS.randn(N, D).astype(np.float32))
+    x.stop_gradient = False
+    w = _t((RS.randn(C - 1, D) * 0.3).astype(np.float32))
+    w.stop_gradient = False
+    loss, _ = _C_ops.hsigmoid_loss(x, _t(RS.randint(0, C, N)), C, w)
+    loss.sum().backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
+    assert np.abs(w.grad.numpy()).sum() > 0
+    with pytest.raises(NotImplementedError, match="custom tree"):
+        _C_ops.hsigmoid_loss(x, _t(RS.randint(0, C, N)), C, w,
+                             path_table=_t(np.zeros((N, 2))))
+
+
+def test_yolo_loss_padded_slot_does_not_clobber_objectness():
+    """Review repro: an invalid (all-zero) gt slot's garbage assignment
+    indices must not overwrite a real gt's objectness score."""
+    C = 3
+    anchors = [10, 14, 23, 27]
+    mask = [0, 1]
+    x = np.zeros((1, 2 * (5 + C), 4, 4), np.float32)
+    gt = np.zeros((1, 2, 4), np.float32)
+    gt[0, 0] = [0.1, 0.1, 0.2, 0.2]     # valid: assigned near cell (0,0)
+    lab = np.zeros((1, 2), np.int32)
+    score = np.full((1, 2), 0.9, np.float32)
+    loss, objm, match = _C_ops.yolo_loss(
+        _t(x), _t(gt), _t(lab), _t(score), anchors=anchors,
+        anchor_mask=mask, class_num=C, ignore_thresh=0.5,
+        downsample_ratio=32)
+    m = int(match.numpy()[0, 0])
+    assert m >= 0 and int(match.numpy()[0, 1]) == -1
+    assert objm.numpy()[0, m, 0, 0] == pytest.approx(0.9)
+    wl, wo, wm = _np_yolo_loss(x.astype(np.float64), gt, lab, score,
+                               anchors, mask, C, 0.5, 32)
+    np.testing.assert_allclose(loss.numpy(), wl, rtol=1e-4)
+    np.testing.assert_allclose(objm.numpy(), wo, atol=1e-6)

@@ -127,8 +127,13 @@ def main():
     args = p.parse_args()
 
     platform = jax.devices()[0].platform
-    # absolute-time pins only gate the machine class that produced them
-    key = f"{platform}/{os.cpu_count()}cpu"
+    # absolute-time pins only gate the machine class that produced them;
+    # affinity-aware count so a cgroup-limited container keys correctly
+    try:
+        ncpu = len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        ncpu = os.cpu_count()
+    key = f"{platform}/{ncpu}cpu"
     current = measure(args.reps)
     print(json.dumps({"key": key, "timings": current}, indent=1))
 
