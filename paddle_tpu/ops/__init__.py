@@ -22,6 +22,7 @@ from .kernels import (  # noqa: F401
     comparison,
     creation,
     fused_ops,
+    graph_ops,
     linalg,
     manipulation,
     math,

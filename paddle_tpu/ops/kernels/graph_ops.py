@@ -1,0 +1,168 @@
+"""Graph sampling ops (GNN support).
+
+Reference: paddle/phi/kernels/cpu/graph_sample_neighbors_kernel.cc,
+weighted_sample_neighbors_kernel.cc, graph_reindex_kernel.cc. These are
+HOST/eager ops like nms: neighbor sampling has data-dependent output
+sizes by nature, and in a TPU pipeline it belongs on the input side (the
+sampled subgraph then feeds the send_u_recv message-passing ops, which
+are the on-device half of the GNN story).
+
+Graph layout is CSC like the reference: `colptr[v] .. colptr[v+1]` spans
+`row[]` entries holding the in-neighbors of node v.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..dispatch import register_op
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+@register_op(nondiff=True)
+def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, seed=0):
+    """-> (out_neighbors, out_count[, out_eids]): up to `sample_size`
+    in-neighbors per input node, concatenated in x order."""
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires the eids input")
+    rs = np.random.RandomState(seed if seed else None)
+    rowa, cp, xs = _np(row), _np(colptr), _np(x).reshape(-1)
+    ea = _np(eids) if eids is not None else None
+    neigh, counts, out_eids = [], [], []
+    for v in xs:
+        s, e = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(s, e)
+        if 0 < sample_size < idx.size:
+            idx = rs.choice(idx, sample_size, replace=False)
+        neigh.append(rowa[idx])
+        counts.append(idx.size)
+        if return_eids:
+            out_eids.append(ea[idx])
+    out = (jnp.asarray(np.concatenate(neigh) if neigh else
+                       np.zeros(0, rowa.dtype)),
+           jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return out + (jnp.asarray(
+            np.concatenate(out_eids) if out_eids else
+            np.zeros(0, np.int64)),)
+    return out
+
+
+@register_op(nondiff=True)
+def weighted_sample_neighbors(row, colptr, edge_weight, x, eids=None,
+                              sample_size=-1, return_eids=False, seed=0):
+    """Weighted variant: sampling probability proportional to the edge
+    weight (reference weighted_sample_neighbors_kernel). Zero-weight
+    edges are never sampled; a node with fewer positive-weight edges
+    than sample_size yields just those edges."""
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires the eids input")
+    rs = np.random.RandomState(seed if seed else None)
+    rowa, cp, xs = _np(row), _np(colptr), _np(x).reshape(-1)
+    wa = _np(edge_weight).astype(np.float64)
+    if (wa < 0).any():
+        raise ValueError("edge_weight must be non-negative")
+    ea = _np(eids) if eids is not None else None
+    neigh, counts, out_eids = [], [], []
+    for v in xs:
+        s, e = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(s, e)
+        w = wa[s:e]
+        pos = idx[w > 0]
+        if sample_size > 0:
+            if pos.size <= sample_size:
+                idx = pos
+            else:
+                p = w[w > 0] / w[w > 0].sum()
+                idx = rs.choice(pos, sample_size, replace=False, p=p)
+        neigh.append(rowa[idx])
+        counts.append(idx.size)
+        if return_eids:
+            out_eids.append(ea[idx])
+    out = (jnp.asarray(np.concatenate(neigh) if neigh else
+                       np.zeros(0, rowa.dtype)),
+           jnp.asarray(np.asarray(counts, np.int32)))
+    if return_eids:
+        return out + (jnp.asarray(
+            np.concatenate(out_eids) if out_eids else
+            np.zeros(0, np.int64)),)
+    return out
+
+
+@register_op(nondiff=True)
+def reindex_graph(x, neighbors, count, hashtable_value=None,
+                  hashtable_index=None):
+    """-> (reindex_src, reindex_dst, out_nodes): compact ids with the
+    input nodes first (reference graph_reindex_kernel: out_nodes = x ++
+    first-seen-order new neighbors; src = reindexed neighbors; dst[i]
+    repeats x's compact id count[i] times)."""
+    xs = _np(x).reshape(-1)
+    nb = _np(neighbors).reshape(-1)
+    cnt = _np(count).reshape(-1)
+    mapping = {}
+    order = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+    for v in nb.tolist():
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+    src = np.asarray([mapping[v] for v in nb.tolist()], np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64)[: cnt.size], cnt)
+    return (jnp.asarray(src), jnp.asarray(dst),
+            jnp.asarray(np.asarray(order, xs.dtype)))
+
+
+@register_op(nondiff=True)
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
+                       return_eids=False, seed=0):
+    """K-hop sampling: iterate sample+frontier-merge, then one reindex
+    over all gathered edges (reference graph_khop_sampler_kernel).
+    -> (edge_src, edge_dst, sample_index, reindex_x)."""
+    if return_eids or eids is not None:
+        raise NotImplementedError(
+            "graph_khop_sampler edge-id tracking (eids/return_eids) is "
+            "not implemented; use graph_sample_neighbors per hop for eids")
+    frontier = _np(x).reshape(-1)
+    all_src_nodes, all_dst_nodes = [], []
+    seen = list(frontier.tolist())
+    seen_set = set(seen)
+    cur = frontier
+    for hop, size in enumerate(tuple(sample_sizes)):
+        nb, cnt = graph_sample_neighbors.__wrapped__(
+            row, colptr, cur, sample_size=size,
+            seed=(seed + hop) if seed else 0)
+        nb = _np(nb)
+        cnt = _np(cnt)
+        all_src_nodes.append(nb)
+        all_dst_nodes.append(np.repeat(cur, cnt))
+        nxt = []
+        for v in nb.tolist():
+            if v not in seen_set:
+                seen_set.add(v)
+                seen.append(v)
+                nxt.append(v)
+        cur = np.asarray(nxt, frontier.dtype) if nxt else \
+            np.zeros(0, frontier.dtype)
+    src_nodes = np.concatenate(all_src_nodes) if all_src_nodes else \
+        np.zeros(0, np.int64)
+    dst_nodes = np.concatenate(all_dst_nodes) if all_dst_nodes else \
+        np.zeros(0, np.int64)
+    mapping = {v: i for i, v in enumerate(seen)}
+    edge_src = np.asarray([mapping[v] for v in src_nodes.tolist()],
+                          np.int64)
+    edge_dst = np.asarray([mapping[v] for v in dst_nodes.tolist()],
+                          np.int64)
+    reindex_x = np.asarray([mapping[v] for v in frontier.tolist()],
+                           np.int64)
+    return (jnp.asarray(edge_src), jnp.asarray(edge_dst),
+            jnp.asarray(np.asarray(seen, frontier.dtype)),
+            jnp.asarray(reindex_x))

@@ -1,0 +1,118 @@
+"""Graph sampling ops: CSC neighbor sampling, reindex, k-hop.
+
+Reference behavior: graph_sample_neighbors / weighted_sample_neighbors /
+graph_reindex / graph_khop_sampler kernels. Properties checked: sampled
+neighbors are genuine in-neighbors, counts/sample caps respected, weight
+bias shows in sampling frequency, reindex is a consistent compact
+renumbering, k-hop frontier ids stay consistent with the node list.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+
+# CSC graph with 5 nodes; in-neighbors of v are row[colptr[v]:colptr[v+1]]
+ROW = np.array([1, 2, 3, 0, 3, 4, 0, 1, 2, 4, 1, 2], np.int64)
+COLPTR = np.array([0, 3, 6, 8, 10, 12], np.int64)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _neigh(v):
+    return set(ROW[COLPTR[v]:COLPTR[v + 1]].tolist())
+
+
+def test_sample_neighbors_membership_and_counts():
+    x = np.array([0, 2, 4], np.int64)
+    nb, cnt = _C_ops.graph_sample_neighbors(_t(ROW), _t(COLPTR), _t(x),
+                                            sample_size=2, seed=7)
+    nb = np.asarray(nb.numpy())
+    cnt = np.asarray(cnt.numpy())
+    assert cnt.tolist() == [2, 2, 2]
+    off = 0
+    for v, c in zip(x, cnt):
+        got = set(nb[off:off + c].tolist())
+        assert got <= _neigh(int(v)) and len(got) == c
+        off += c
+    # sample_size=-1: full neighborhoods
+    nb_all, cnt_all = _C_ops.graph_sample_neighbors(
+        _t(ROW), _t(COLPTR), _t(x), sample_size=-1)
+    assert np.asarray(cnt_all.numpy()).tolist() == [3, 2, 2]
+
+
+def test_weighted_sampling_biases_toward_heavy_edges():
+    # node 0 has neighbors 1,2,3; put nearly all mass on edge to 3
+    w = np.ones(len(ROW), np.float32)
+    w[2] = 1000.0  # row index 2 is neighbor 3 of node 0
+    hits = 0
+    for seed in range(1, 21):
+        nb, cnt = _C_ops.weighted_sample_neighbors(
+            _t(ROW), _t(COLPTR), _t(w), _t(np.array([0], np.int64)),
+            sample_size=1, seed=seed)
+        if np.asarray(nb.numpy())[0] == 3:
+            hits += 1
+    assert hits >= 16  # ~1000/1002 probability per draw
+
+
+def test_reindex_graph_compact_and_consistent():
+    x = np.array([3, 0], np.int64)
+    nb = np.array([0, 4, 1, 2], np.int64)  # 2 neighbors each
+    cnt = np.array([2, 2], np.int32)
+    src, dst, nodes = _C_ops.reindex_graph(_t(x), _t(nb), _t(cnt))
+    nodes = np.asarray(nodes.numpy())
+    src = np.asarray(src.numpy())
+    dst = np.asarray(dst.numpy())
+    assert nodes[:2].tolist() == [3, 0]           # inputs first
+    assert sorted(nodes.tolist()) == [0, 1, 2, 3, 4]
+    # reindexed src maps back to the original neighbor ids
+    assert nodes[src].tolist() == nb.tolist()
+    assert dst.tolist() == [0, 0, 1, 1]
+
+
+def test_khop_sampler_two_hops():
+    x = np.array([0], np.int64)
+    esrc, edst, sample_index, reindex_x = _C_ops.graph_khop_sampler(
+        _t(ROW), _t(COLPTR), _t(x), sample_sizes=(2, 2), seed=3)
+    nodes = np.asarray(sample_index.numpy())
+    esrc = np.asarray(esrc.numpy())
+    edst = np.asarray(edst.numpy())
+    assert nodes[0] == 0 and np.asarray(reindex_x.numpy()).tolist() == [0]
+    # every edge endpoint is a valid compact id, and every dst's original
+    # node actually has the src's original node as an in-neighbor
+    for s, d in zip(esrc, edst):
+        assert 0 <= s < len(nodes) and 0 <= d < len(nodes)
+        assert int(nodes[s]) in _neigh(int(nodes[d]))
+
+
+def test_weighted_sampling_edge_cases_and_eids_contract():
+    # fewer positive-weight edges than sample_size: return just those
+    w = np.zeros(len(ROW), np.float32)
+    w[2] = 5.0  # only neighbor 3 of node 0 has weight
+    nb, cnt = _C_ops.weighted_sample_neighbors(
+        _t(ROW), _t(COLPTR), _t(w), _t(np.array([0], np.int64)),
+        sample_size=2, seed=1)
+    assert np.asarray(cnt.numpy()).tolist() == [1]
+    assert np.asarray(nb.numpy()).tolist() == [3]
+    with pytest.raises(ValueError, match="non-negative"):
+        _C_ops.weighted_sample_neighbors(
+            _t(ROW), _t(COLPTR), _t(-np.ones(len(ROW), np.float32)),
+            _t(np.array([0], np.int64)), sample_size=1)
+    with pytest.raises(ValueError, match="requires the eids"):
+        _C_ops.graph_sample_neighbors(_t(ROW), _t(COLPTR),
+                                      _t(np.array([0], np.int64)),
+                                      return_eids=True)
+    # eids thread through aligned with neighbors
+    eids = np.arange(len(ROW), dtype=np.int64) + 100
+    nb2, cnt2, out_eids = _C_ops.graph_sample_neighbors(
+        _t(ROW), _t(COLPTR), _t(np.array([1], np.int64)), eids=_t(eids),
+        sample_size=-1, return_eids=True)
+    got_nb = np.asarray(nb2.numpy())
+    got_e = np.asarray(out_eids.numpy())
+    assert (ROW[got_e - 100] == got_nb).all()
+    with pytest.raises(NotImplementedError, match="edge-id tracking"):
+        _C_ops.graph_khop_sampler(_t(ROW), _t(COLPTR),
+                                  _t(np.array([0], np.int64)),
+                                  sample_sizes=(1,), return_eids=True)
