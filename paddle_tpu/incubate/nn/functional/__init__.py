@@ -190,6 +190,18 @@ from ....ops.dispatch import OPS as _OPS
 
 fused_rms_norm = _OPS["fused_rms_norm"]
 swiglu = _OPS["swiglu"]
+
+# serving/decode attention family (ops/kernels/serving_attention.py;
+# reference: incubate/nn/functional/{masked,block}_multihead_attention.py,
+# fused_transformer.py:976)
+from ....ops.kernels import serving_attention as _serving  # noqa: E402,F401
+
+masked_multihead_attention = _OPS["masked_multihead_attention_"]
+block_multihead_attention = _OPS["block_multihead_attention_"]
+fused_multi_transformer = _OPS["fused_multi_transformer_"]
+variable_length_memory_efficient_attention = _OPS[
+    "variable_length_memory_efficient_attention"]
+flash_attn_unpadded = _OPS["flash_attn_unpadded"]
 fused_rotary_position_embedding = _OPS["fused_rotary_position_embedding"]
 fused_bias_dropout_residual_layer_norm = _OPS[
     "fused_bias_dropout_residual_layer_norm"]

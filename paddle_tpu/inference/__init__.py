@@ -22,7 +22,9 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "LLMPredictor", "init_cache"]
+
+from .llm import LLMPredictor, init_cache  # noqa: E402,F401
 
 
 class PrecisionType:

@@ -31,6 +31,7 @@ from .kernels import (  # noqa: F401
     reduce,
     rnn_ops,
     search,
+    serving_attention,
     tail_alias,
     tail_collective,
     tail_math,

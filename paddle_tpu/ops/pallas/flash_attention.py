@@ -58,6 +58,14 @@ def _i32(x):
 # 128-lane variant of jax's reference kernel, 16x the HBM for the same math).
 LANES = 8
 
+# Segment-id carrier layouts (varlen/unpadded attention): q ids are
+# lane-broadcast [B, T, SEG_LANES] so a [bq, SEG_LANES] tile can be jnp.tiled
+# across the kv lane dim; kv ids are sublane-broadcast [B, SEG_SUBLANES, S] so
+# a [1, bk] row slices out legally. Same layouts as jax's reference TPU flash
+# kernel (pallas/ops/tpu/flash_attention.py NUM_LANES/NUM_SUBLANES).
+SEG_LANES = 128
+SEG_SUBLANES = 8
+
 
 def _assert_mosaic_tileable(block_shape, array_shape, what: str) -> None:
     """Static mirror of Mosaic's block-mapping rule so CPU CI catches illegal
@@ -115,8 +123,22 @@ def supported(q_shape, k_shape) -> bool:
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
-                *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _seg_mask(qs_ref, ks_ref, block_k: int):
+    """[Bq, Bk] same-segment mask from the lane-/sublane-broadcast carriers.
+    Explicit jnp.tile of both operands (not a two-sided broadcast) is the
+    form Mosaic legalizes; requires block_k % SEG_LANES == 0."""
+    qs = jnp.tile(qs_ref[0], (1, block_k // SEG_LANES))   # [Bq, Bk]
+    ks = ks_ref[0, :1]                                    # [1, Bk]
+    return qs == ks
+
+
+def _fwd_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+                block_k: int, has_seg: bool):
+    if has_seg:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, acc, m_sc, l_sc = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc = refs
+        qs_ref = ks_ref = None
     i, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -140,6 +162,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            # With causal=True every row keeps its diagonal entry (a token is
+            # always in its own segment), so no all-NEG_INF row can poison
+            # the running max (exp(NEG_INF - NEG_INF) = 1 bug class).
+            s = jnp.where(_seg_mask(qs_ref, ks_ref, block_k), s, NEG_INF)
         m_prev = m_sc[:, :1]                          # [Bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                        # [Bq, Bk]
@@ -158,16 +185,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
                                          (block_q, LANES))
 
 
-def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
+def _seg_carriers(q_seg, kv_seg):
+    """[B, T] / [B, S] int32 → lane-broadcast [B, T, SEG_LANES] and
+    sublane-broadcast [B, SEG_SUBLANES, S]."""
+    qs = jnp.broadcast_to(q_seg.astype(jnp.int32)[:, :, None],
+                          (*q_seg.shape, SEG_LANES))
+    ks = jnp.broadcast_to(kv_seg.astype(jnp.int32)[:, None, :],
+                          (kv_seg.shape[0], SEG_SUBLANES, kv_seg.shape[1]))
+    return qs, ks
+
+
+def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool,
+         q_seg=None, kv_seg=None):
     """q [B, H, T, hd]; k/v [B, KV, S, hd] →
-    (o [B, H, T, hd], lse [B, H, T, LANES] lane-broadcast)."""
+    (o [B, H, T, hd], lse [B, H, T, LANES] lane-broadcast).
+    q_seg/kv_seg: optional [B, T] / [B, S] int32 segment ids (varlen)."""
     B, H, T, hd = q.shape
     KV, S = k.shape[1], k.shape[2]
     G = H // KV
     bq, bk = _pick_block(T), _pick_block(S)
+    has_seg = q_seg is not None
+    if has_seg and bk % SEG_LANES != 0:
+        raise ValueError(f"segment ids need block_k % {SEG_LANES} == 0; "
+                         f"got block_k={bk} (S={S})")
     grid = (B, H, T // bq, S // bk)
     kernel = functools.partial(_fwd_kernel, sm_scale=np.float32(sm_scale), causal=causal,
-                               block_q=bq, block_k=bk)
+                               block_q=bq, block_k=bk, has_seg=has_seg)
     mem = {"memory_space": pltpu.VMEM}
     scratch = [
         pltpu.VMEM((bq, hd), jnp.float32),
@@ -179,6 +222,14 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
         pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
         pl.BlockSpec((1, 1, bk, hd), lambda b, h, i, j: (b, jax.lax.div(h, _i32(G)), j, _i32(0)), **mem),
     ]
+    inputs = [q, k, v]
+    if has_seg:
+        qs, ks = _seg_carriers(q_seg, kv_seg)
+        in_specs += [
+            pl.BlockSpec((1, bq, SEG_LANES), lambda b, h, i, j: (b, i, _i32(0)), **mem),
+            pl.BlockSpec((1, SEG_SUBLANES, bk), lambda b, h, i, j: (b, _i32(0), j), **mem),
+        ]
+        inputs += [qs, ks]
     out_specs = [
         pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
         pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
@@ -187,7 +238,7 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
         jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
         jax.ShapeDtypeStruct((B, H, T, LANES), jnp.float32),
     ]
-    for spec, arr in zip(in_specs, [q, k, v]):
+    for spec, arr in zip(in_specs, inputs):
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "fwd input")
     for spec, sds in zip(out_specs, out_shape):
         _assert_mosaic_tileable(spec.block_shape, sds.shape, "fwd output")
@@ -199,7 +250,7 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse
 
 
@@ -207,8 +258,14 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool):
 # Backward kernels (flash-attention-2 recomputation form)
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-               *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _dq_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+               block_k: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        qs_ref = ks_ref = None
     i, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
 
@@ -233,6 +290,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            s = jnp.where(_seg_mask(qs_ref, ks_ref, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)                          # [Bq, Bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -245,10 +304,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc,
-                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
-                group: int):
+def _dkv_kernel(*refs, sm_scale: float, causal: bool, block_q: int,
+                block_k: int, group: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     # grid: (B, KV, kv_block, g, q_block)
     jk = pl.program_id(2)
     g = pl.program_id(3)
@@ -278,6 +342,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + jk * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            s = jnp.where(_seg_mask(qs_ref, ks_ref, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)                          # [Bq, Bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -294,11 +360,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(sm_scale, causal, interpret, res, do):
-    q, k, v, o, lse = res                             # lse [B, H, T, LANES]
+    q, k, v, o, lse, q_seg, kv_seg = res              # lse [B, H, T, LANES]
     B, H, T, hd = q.shape
     KV, S = k.shape[1], k.shape[2]
     G = H // KV
     bq, bk = _pick_block(T), _pick_block(S)
+    has_seg = q_seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (B, H, T, LANES))
     mem = {"memory_space": pltpu.VMEM}
@@ -311,21 +378,29 @@ def _bwd(sm_scale, causal, interpret, res, do):
         pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
         pl.BlockSpec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, _i32(0)), **mem),
     ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_seg:
+        qs, ks = _seg_carriers(q_seg, kv_seg)
+        dq_in_specs += [
+            pl.BlockSpec((1, bq, SEG_LANES), lambda b, h, i, j: (b, i, _i32(0)), **mem),
+            pl.BlockSpec((1, SEG_SUBLANES, bk), lambda b, h, i, j: (b, _i32(0), j), **mem),
+        ]
+        dq_inputs += [qs, ks]
     dq_out_spec = pl.BlockSpec((1, 1, bq, hd), lambda b, h, i, j: (b, h, i, _i32(0)),
                                **mem)
-    for spec, arr in zip(dq_in_specs, [q, k, v, do, lse, delta]):
+    for spec, arr in zip(dq_in_specs, dq_inputs):
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "dq input")
     _assert_mosaic_tileable(dq_out_spec.block_shape, q.shape, "dq output")
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=np.float32(sm_scale), causal=causal,
-                          block_q=bq, block_k=bk),
+                          block_q=bq, block_k=bk, has_seg=has_seg),
         grid=(B, H, T // bq, S // bk),
         in_specs=dq_in_specs,
         out_specs=dq_out_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, T, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     dkv_in_specs = [
         pl.BlockSpec((1, 1, bq, hd),
@@ -341,19 +416,28 @@ def _bwd(sm_scale, causal, interpret, res, do):
         pl.BlockSpec((1, 1, bq, LANES),
                      lambda b, kv, jk, g, iq: (b, kv * G + g, iq, _i32(0)), **mem),
     ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq, SEG_LANES),
+                         lambda b, kv, jk, g, iq: (b, iq, _i32(0)), **mem),
+            pl.BlockSpec((1, SEG_SUBLANES, bk),
+                         lambda b, kv, jk, g, iq: (b, _i32(0), jk), **mem),
+        ]
+        dkv_inputs += [qs, ks]
     dkv_out_specs = [
         pl.BlockSpec((1, 1, bk, hd),
                      lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
         pl.BlockSpec((1, 1, bk, hd),
                      lambda b, kv, jk, g, iq: (b, kv, jk, _i32(0)), **mem),
     ]
-    for spec, arr in zip(dkv_in_specs, [q, k, v, do, lse, delta]):
+    for spec, arr in zip(dkv_in_specs, dkv_inputs):
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "dkv input")
     for spec in dkv_out_specs:
         _assert_mosaic_tileable(spec.block_shape, k.shape, "dkv output")
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=np.float32(sm_scale), causal=causal,
-                          block_q=bq, block_k=bk, group=G),
+                          block_q=bq, block_k=bk, group=G, has_seg=has_seg),
         grid=(B, KV, S // bk, G, T // bq),
         in_specs=dkv_in_specs,
         out_specs=dkv_out_specs,
@@ -366,35 +450,47 @@ def _bwd(sm_scale, causal, interpret, res, do):
             pltpu.VMEM((bk, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(*dkv_inputs)
+    # segment-id inputs are int: no cotangents
+    return dq, dk, dv, None, None
 
 
 # ---------------------------------------------------------------------------
 # Public API (custom_vjp over the BHTD kernels, BTHD at the boundary)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhtd(q, k, v, sm_scale, causal, interpret):
-    o, _ = _fwd(q, k, v, sm_scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_bhtd_seg(q, k, v, q_seg, kv_seg, sm_scale, causal, interpret):
+    o, _ = _fwd(q, k, v, sm_scale, causal, interpret, q_seg, kv_seg)
     return o
 
 
-def _flash_bhtd_fwd(q, k, v, sm_scale, causal, interpret):
-    o, lse = _fwd(q, k, v, sm_scale, causal, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_bhtd_seg_fwd(q, k, v, q_seg, kv_seg, sm_scale, causal, interpret):
+    o, lse = _fwd(q, k, v, sm_scale, causal, interpret, q_seg, kv_seg)
+    return o, (q, k, v, o, lse, q_seg, kv_seg)
 
 
-_flash_bhtd.defvjp(_flash_bhtd_fwd, _bwd)
+_flash_bhtd_seg.defvjp(_flash_bhtd_seg_fwd, _bwd)
+
+
+def _flash_bhtd(q, k, v, sm_scale, causal, interpret):
+    """Segment-free entry (kept: the train step and AOT smoke target it)."""
+    return _flash_bhtd_seg(q, k, v, None, None, sm_scale, causal, interpret)
 
 
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    q_segment_ids=None, kv_segment_ids=None):
     """Fused attention. q [B, T, H, hd], k/v [B, S, KV, hd] → [B, T, H, hd].
 
     GQA when H > KV (H % KV == 0). `interpret` forces the Pallas interpreter
     (CPU testing); default: interpret on non-TPU backends.
+
+    q_segment_ids/kv_segment_ids [B, T] / [B, S] int32 restrict attention to
+    same-segment pairs (varlen/unpadded packing; the flash_attn_unpadded op).
+    Rows must be self-aligned (token t's kv t shares its segment) so every
+    row keeps >= 1 valid key — guaranteed for packed self-attention.
     """
     B, T, H, hd = q.shape
     S, KV = k.shape[1], k.shape[2]
@@ -403,6 +499,8 @@ def flash_attention(q, k, v, causal: bool = True,
     if not supported(q.shape, k.shape):
         raise ValueError(f"unsupported shapes q={q.shape} k={k.shape}; "
                          "use the XLA attention path")
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("pass both q_segment_ids and kv_segment_ids or neither")
     if sm_scale is None:
         sm_scale = 1.0 / (hd ** 0.5)
     if interpret is None:
@@ -410,5 +508,12 @@ def flash_attention(q, k, v, causal: bool = True,
     qt = jnp.swapaxes(q, 1, 2)       # [B, H, T, hd]
     kt = jnp.swapaxes(k, 1, 2)       # [B, KV, S, hd]
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash_bhtd(qt, kt, vt, float(sm_scale), bool(causal), bool(interpret))
+    o = _flash_bhtd_seg(qt, kt, vt, q_segment_ids, kv_segment_ids,
+                        float(sm_scale), bool(causal), bool(interpret))
     return jnp.swapaxes(o, 1, 2)
+
+
+def supports_segments(k_shape) -> bool:
+    """Varlen needs block_k % SEG_LANES == 0 (the q-seg lane tile)."""
+    bk = _pick_block(k_shape[1])
+    return bk is not None and bk % SEG_LANES == 0

@@ -1,0 +1,86 @@
+"""KV-cached decode parity: LLMPredictor greedy output must equal greedy
+decoding by full re-forward (no cache) at every step.
+
+This is the serving-path correctness contract (VERDICT r3 task #3): the
+cached decode program (inference/llm.py) and the training-path forward
+(models/llama.py) are independent implementations of the same math.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.inference.llm import LLMPredictor, init_cache
+
+
+@pytest.fixture(scope="module")
+def small():
+    cfg = L.LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                        num_layers=3, num_heads=4, num_kv_heads=2,
+                        max_seq_len=64, dtype=jnp.float32)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def greedy_by_full_forward(cfg, params, tokens, n_new):
+    """Reference decode: recompute the whole sequence each step."""
+    toks = np.asarray(tokens)
+    for _ in range(n_new):
+        logits = L.forward(params, jnp.asarray(toks), cfg, attn_impl="xla")
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))[:, None]
+        toks = np.concatenate([toks, nxt.astype(toks.dtype)], axis=1)
+    return toks
+
+
+def test_greedy_parity(small):
+    cfg, params = small
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+    pred = LLMPredictor(cfg, params, max_len=32)
+    got = np.asarray(pred.generate(prompt, max_new_tokens=10))
+    want = greedy_by_full_forward(cfg, params, prompt, 10)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gqa_and_moe_decode(small):
+    cfg0, _ = small
+    cfg = L.LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=48,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_seq_len=32, num_experts=4, top_k=2,
+                        dtype=jnp.float32)
+    params = L.init_params(cfg, jax.random.PRNGKey(1))
+    prompt = np.arange(6, dtype=np.int32)[None] % cfg.vocab_size
+    pred = LLMPredictor(cfg, params, max_len=24)
+    got = np.asarray(pred.generate(prompt, max_new_tokens=6))
+    want = greedy_by_full_forward(cfg, params, prompt, 6)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_eos_early_stop(small):
+    cfg, params = small
+    prompt = np.zeros((1, 4), np.int32)
+    pred = LLMPredictor(cfg, params, max_len=32)
+    full = np.asarray(pred.generate(prompt, max_new_tokens=8))
+    eos = int(full[0, 5])  # force the 2nd generated token to be "eos"
+    seq = np.asarray(pred.generate(prompt, max_new_tokens=8,
+                                   eos_token_id=eos))
+    assert seq.shape[1] <= full.shape[1]
+    assert eos in seq[0, 4:]
+
+
+def test_scores_shape(small):
+    cfg, params = small
+    prompt = np.zeros((2, 3), np.int32)
+    pred = LLMPredictor(cfg, params, max_len=16)
+    seq, scores = pred.generate(prompt, max_new_tokens=4, return_scores=True)
+    assert seq.shape == (2, 7)
+    assert scores.shape == (2, 4, cfg.vocab_size)
+
+
+def test_cache_is_bounded(small):
+    cfg, params = small
+    pred = LLMPredictor(cfg, params, max_len=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        pred.generate(np.zeros((1, 6), np.int32), max_new_tokens=4)

@@ -1,0 +1,112 @@
+"""Mosaic-lowering CI smoke: lower the Pallas flash kernels FOR TPU on CPU.
+
+VERDICT r3 Weak #8 / task #9: all flash tests run interpret=True, so a
+Mosaic legalization regression (like the r02 lse BlockSpec or the int64
+index-map bug) only surfaced at bench time on the chip. `jax.export` with
+platforms=['tpu'] runs the REAL Mosaic lowering pipeline
+(`pallas_call_tpu_lowering_rule` -> `lower_jaxpr_to_module`, including
+`_check_block_mappings`) without TPU hardware, so BlockSpec/legalization
+bugs fail here in CPU CI instead.
+
+Reference analog: the compile-only coverage the reference gets from
+`paddle/phi/kernels/gpu/flash_attn_kernel.cc` building in CI even on
+CUDA-less machines.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _export_tpu(fn, *args):
+    return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+def _sds(shape, dtype=jnp.bfloat16):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def test_flash_fwd_lowers_for_tpu():
+    q = _sds((2, 4, 256, 64))
+    fn = lambda q, k, v: fa._flash_bhtd(q, k, v, 0.125, True, False)
+    exported = _export_tpu(fn, q, q, q)
+    assert "tpu_custom_call" in exported.mlir_module()
+
+
+def test_flash_fwd_bwd_lowers_for_tpu():
+    """The full custom_vjp pair — fwd, dq, and dkv kernels — all legalize."""
+    q = _sds((2, 4, 256, 64))
+
+    def loss(q, k, v):
+        o = fa._flash_bhtd(q, k, v, 0.125, True, False)
+        return jnp.sum(o.astype(jnp.float32))
+
+    exported = _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    # fwd (re-run) + dq + dkv pallas calls all present
+    assert exported.mlir_module().count("tpu_custom_call") >= 3
+
+
+def test_flash_gqa_lowers_for_tpu():
+    """GQA index maps (h // group with lax.div on int32) legalize."""
+    q = _sds((2, 8, 256, 64))
+    kv = _sds((2, 2, 256, 64))
+
+    def loss(q, k, v):
+        o = fa._flash_bhtd(q, k, v, 0.125, True, False)
+        return jnp.sum(o.astype(jnp.float32))
+
+    _export_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, kv, kv)
+
+
+def test_flash_bench_shape_lowers_for_tpu():
+    """The flagship bench shape (block 512 path, bf16)."""
+    q = _sds((1, 12, 2048, 128))
+
+    def step(q, k, v):
+        o = fa._flash_bhtd(q, k, v, 0.088, True, False)
+        return jnp.sum(o.astype(jnp.float32))
+
+    _export_tpu(jax.value_and_grad(step, argnums=(0, 1, 2)), q, q, q)
+
+
+def test_r02_lse_blockspec_fails_tpu_lowering():
+    """Deliberately rebuild the r02 bug — a rank-3 lse output whose block
+    (1, 1, bq) puts a size-1 second-minor dim against H — and prove the
+    TPU export harness catches it WITHOUT hardware. This guards the guard:
+    if jax.export ever stops running Mosaic's block-mapping check, this
+    test fails and the smoke above is known to be toothless."""
+    B, H, T, bq = 2, 4, 512, 256
+
+    def kernel(x_ref, o_ref):
+        o_ref[0, 0] = jnp.max(x_ref[0, 0], axis=-1)
+
+    def bad(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(B, H, T // bq),
+            in_specs=[pl.BlockSpec((1, 1, bq, 128),
+                                   lambda b, h, i: (b, h, i, np.int32(0)))],
+            out_specs=pl.BlockSpec((1, 1, bq),
+                                   lambda b, h, i: (b, h, i)),
+            out_shape=jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        )(x)
+
+    x = _sds((B, H, T, 128), jnp.float32)
+    with pytest.raises(Exception, match="divisible|block shape"):
+        _export_tpu(bad, x)
+
+
+def test_static_mirror_agrees_with_mosaic():
+    """The CPU-side `_assert_mosaic_tileable` mirror rejects exactly the
+    r02 spec too, so interpret-mode tests fail fast as well."""
+    with pytest.raises(ValueError, match="tiling rule"):
+        fa._assert_mosaic_tileable((1, 1, 256), (2, 4, 512), "lse output")
+    # legal: trailing dim equals array dim
+    fa._assert_mosaic_tileable((1, 1, 256, fa.LANES), (2, 4, 512, fa.LANES),
+                               "lse output")

@@ -1044,6 +1044,15 @@ OPT_OUT = {
     # suite (multi-output, attribute-heavy signatures)
     "yolo_loss": "dedicated suite tests/test_yolo_hsigmoid_loss.py",
     "hsigmoid_loss": "dedicated suite tests/test_yolo_hsigmoid_loss.py",
+    # serving/decode attention: cache pytrees, cu_seqlen index tensors and
+    # weight-list inputs don't fit the single-array harness; all are
+    # cross-checked vs naive attention in the dedicated suite
+    "masked_multihead_attention_": "dedicated suite tests/test_serving_attention.py",
+    "block_multihead_attention_": "dedicated suite tests/test_serving_attention.py",
+    "flash_attn_unpadded": "dedicated suite tests/test_serving_attention.py",
+    "flash_attn_varlen_qkvpacked": "dedicated suite tests/test_serving_attention.py",
+    "variable_length_memory_efficient_attention": "dedicated suite tests/test_serving_attention.py",
+    "fused_multi_transformer_": "dedicated suite tests/test_serving_attention.py",
     # host sampling ops with data-dependent outputs
     "graph_sample_neighbors": "dedicated suite tests/test_graph_ops.py",
     "weighted_sample_neighbors": "dedicated suite tests/test_graph_ops.py",

@@ -1,0 +1,379 @@
+"""Serving/decode attention family vs naive reference implementations.
+
+Covers masked_multihead_attention_ (dense-cache decode),
+block_multihead_attention_ (paged cache, prefill + decode),
+flash_attn_unpadded (varlen packed, pallas segment path + XLA fallback),
+variable_length_memory_efficient_attention, fused_multi_transformer_
+(prefill/decode consistency). Reference semantics transcribed from the
+docstring example of
+python/paddle/incubate/nn/functional/block_multihead_attention.py
+(naive_attention_impl) — behavior, not code.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.kernels import serving_attention as SA
+
+
+def naive_sdpa(q, k, v, causal_from=None):
+    """q [B,H,T,hd] k/v [B,H,S,hd]; causal_from: col offset of row 0."""
+    hd = q.shape[-1]
+    s = np.einsum("bhtd,bhsd->bhts", q.astype(np.float64),
+                  k.astype(np.float64)) / np.sqrt(hd)
+    if causal_from is not None:
+        T, S = s.shape[2], s.shape[3]
+        rows = np.arange(T)[:, None] + causal_from
+        cols = np.arange(S)[None, :]
+        s = np.where((cols <= rows)[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhts,bhsd->bhtd", p, v.astype(np.float64))
+
+
+class TestMaskedMultiheadAttention:
+    def test_decode_step_matches_naive(self):
+        rs = np.random.RandomState(0)
+        B, H, S, hd = 2, 4, 16, 8
+        lens = np.array([5, 9], np.int32)
+        cache = np.zeros((2, B, H, S, hd), np.float32)
+        hist_k = rs.randn(B, H, S, hd).astype(np.float32)
+        hist_v = rs.randn(B, H, S, hd).astype(np.float32)
+        for b in range(B):
+            cache[0, b, :, :lens[b]] = hist_k[b, :, :lens[b]]
+            cache[1, b, :, :lens[b]] = hist_v[b, :, :lens[b]]
+        x = rs.randn(B, 3 * H * hd).astype(np.float32)
+        out, cache_out = SA.masked_multihead_attention_.__wrapped__(
+            jnp.asarray(x), jnp.asarray(cache),
+            sequence_lengths=jnp.asarray(lens))
+        out = np.asarray(out).reshape(B, H, hd)
+        cache_out = np.asarray(cache_out)
+        qkv = x.reshape(B, 3, H, hd)
+        for b in range(B):
+            L = lens[b]
+            # new k/v written at index L
+            np.testing.assert_allclose(cache_out[0, b, :, L], qkv[b, 1],
+                                       rtol=1e-6)
+            np.testing.assert_allclose(cache_out[1, b, :, L], qkv[b, 2],
+                                       rtol=1e-6)
+            # untouched history
+            np.testing.assert_allclose(cache_out[0, b, :, :L],
+                                       hist_k[b, :, :L], rtol=1e-6)
+            k_full = np.concatenate([hist_k[b, :, :L], qkv[b, 1][:, None]], 1)
+            v_full = np.concatenate([hist_v[b, :, :L], qkv[b, 2][:, None]], 1)
+            ref = naive_sdpa(qkv[b, 0][None, :, None], k_full[None],
+                             v_full[None])[0, :, 0]
+            np.testing.assert_allclose(out[b], ref, rtol=2e-5, atol=2e-5)
+
+    def test_rotary_and_bias(self):
+        rs = np.random.RandomState(1)
+        B, H, S, hd = 1, 2, 8, 8
+        cache = jnp.zeros((2, B, H, S, hd), jnp.float32)
+        x = rs.randn(B, 3 * H * hd).astype(np.float32)
+        bias = rs.randn(3, H, hd).astype(np.float32)
+        rot = rs.randn(B, 1, 1, S, hd).astype(np.float32)
+        out, _ = SA.masked_multihead_attention_.__wrapped__(
+            jnp.asarray(x), cache, bias=jnp.asarray(bias),
+            sequence_lengths=jnp.zeros((B,), jnp.int32),
+            rotary_tensor=jnp.asarray(rot), rotary_emb_dims=1)
+        # one token in cache -> softmax over a single position -> out == v+bv
+        v = (x.reshape(B, 3, H, hd) + bias[None])[:, 2]
+        np.testing.assert_allclose(np.asarray(out).reshape(B, H, hd), v,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_quant_args_raise(self):
+        with pytest.raises(NotImplementedError):
+            SA.masked_multihead_attention_.__wrapped__(
+                jnp.zeros((1, 24)), jnp.zeros((2, 1, 1, 4, 8)),
+                qkv_out_scale=jnp.ones((3,)))
+
+
+class TestFlashAttnUnpadded:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_packed_matches_per_sequence(self, causal):
+        rs = np.random.RandomState(2)
+        lens = [100, 156]           # total 256 -> pallas segment path
+        total, H, hd = sum(lens), 4, 64
+        q = rs.randn(total, H, hd).astype(np.float32)
+        k = rs.randn(total, H, hd).astype(np.float32)
+        v = rs.randn(total, H, hd).astype(np.float32)
+        cu = np.array([0, 100, 256], np.int32)
+        out, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(cu), jnp.asarray(cu), causal=causal)
+        out = np.asarray(out)
+        start = 0
+        for L in lens:
+            sl = slice(start, start + L)
+            ref = naive_sdpa(q[sl].transpose(1, 0, 2)[None],
+                             k[sl].transpose(1, 0, 2)[None],
+                             v[sl].transpose(1, 0, 2)[None],
+                             causal_from=0 if causal else None)
+            np.testing.assert_allclose(out[sl],
+                                       ref[0].transpose(1, 0, 2),
+                                       rtol=2e-4, atol=2e-4)
+            start += L
+
+    def test_xla_fallback_odd_total(self):
+        """total=37 defeats the pallas tiling -> masked XLA path."""
+        rs = np.random.RandomState(3)
+        total, H, hd = 37, 2, 16
+        q = rs.randn(total, H, hd).astype(np.float32)
+        cu = np.array([0, 20, 37], np.int32)
+        out, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q),
+            jnp.asarray(cu), jnp.asarray(cu), causal=True)
+        ref = naive_sdpa(q[:20].transpose(1, 0, 2)[None],
+                         q[:20].transpose(1, 0, 2)[None],
+                         q[:20].transpose(1, 0, 2)[None], causal_from=0)
+        np.testing.assert_allclose(np.asarray(out)[:20],
+                                   ref[0].transpose(1, 0, 2),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grad_flows(self):
+        rs = np.random.RandomState(4)
+        total, H, hd = 256, 2, 64
+        q = jnp.asarray(rs.randn(total, H, hd).astype(np.float32))
+        cu = jnp.asarray(np.array([0, 128, 256], np.int32))
+
+        def loss(q):
+            o, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+                q, q, q, cu, cu, causal=True)
+            return jnp.sum(o * o)
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).max()) > 0
+
+    def test_qkvpacked(self):
+        rs = np.random.RandomState(5)
+        total, KV, hd, G = 256, 2, 64, 2
+        qkv = rs.randn(total, G + 2, KV, hd).astype(np.float32)
+        cu = jnp.asarray(np.array([0, 256], np.int32))
+        out, _, _, _ = SA.flash_attn_varlen_qkvpacked.__wrapped__(
+            jnp.asarray(qkv), cu, cu, causal=True)
+        assert out.shape == (total, G * KV, hd)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestVariableLengthMEA:
+    def test_varlen_batch(self):
+        rs = np.random.RandomState(6)
+        B, H, T, hd = 2, 2, 8, 16
+        q = rs.randn(B, H, T, hd).astype(np.float32)
+        k = rs.randn(B, H, T, hd).astype(np.float32)
+        v = rs.randn(B, H, T, hd).astype(np.float32)
+        lens = np.array([5, 8], np.int32)
+        out = SA.variable_length_memory_efficient_attention.__wrapped__(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(lens), jnp.asarray(lens), causal=True)
+        out = np.asarray(out)
+        for b in range(B):
+            L = lens[b]
+            ref = naive_sdpa(q[b:b+1, :, :L], k[b:b+1, :, :L],
+                             v[b:b+1, :, :L], causal_from=0)
+            np.testing.assert_allclose(out[b, :, :L], ref[0], rtol=2e-5,
+                                       atol=2e-5)
+        # pad rows zeroed
+        assert np.abs(out[0, :, lens[0]:]).max() == 0.0
+
+
+class TestBlockMultiheadAttention:
+    def _setup(self, rs, B, lens_past, lens_now, H, KV, hd, bs, nblocks):
+        max_blocks = 4
+        bt = -np.ones((B, max_blocks), np.int32)
+        nxt = 0
+        for b in range(B):
+            need = -(-(lens_past[b] + lens_now[b]) // bs)
+            for j in range(need):
+                bt[b, j] = nxt
+                nxt += 1
+        kc = np.zeros((nblocks, KV, bs, hd), np.float32)
+        vc = np.zeros((nblocks, KV, bs, hd), np.float32)
+        hist_k = [rs.randn(lens_past[b], KV, hd).astype(np.float32)
+                  for b in range(B)]
+        hist_v = [rs.randn(lens_past[b], KV, hd).astype(np.float32)
+                  for b in range(B)]
+        for b in range(B):
+            for p in range(lens_past[b]):
+                kc[bt[b, p // bs], :, p % bs] = hist_k[b][p]
+                vc[bt[b, p // bs], :, p % bs] = hist_v[b][p]
+        total = sum(lens_now)
+        cu = np.zeros(B + 1, np.int32)
+        cu[1:] = np.cumsum(lens_now)
+        qkv = rs.randn(total, (H + 2 * KV) * hd).astype(np.float32)
+        return bt, kc, vc, hist_k, hist_v, cu, qkv
+
+    def test_prefill_matches_naive(self):
+        rs = np.random.RandomState(7)
+        B, H, KV, hd, bs = 2, 4, 2, 8, 4
+        lens_now = [6, 3]
+        bt, kc, vc, _, _, cu, qkv = self._setup(
+            rs, B, [0, 0], lens_now, H, KV, hd, bs, nblocks=8)
+        out, _, kco, vco = SA.block_multihead_attention_.__wrapped__(
+            jnp.asarray(qkv), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(np.array(lens_now, np.int32)),
+            jnp.asarray(np.zeros(B, np.int32)),
+            jnp.asarray(np.array(lens_now, np.int32)),
+            cu_seqlens_q=jnp.asarray(cu), cu_seqlens_k=jnp.asarray(cu),
+            block_tables=jnp.asarray(bt), block_size=bs)
+        out = np.asarray(out)
+        kco, vco = np.asarray(kco), np.asarray(vco)
+        start = 0
+        for b in range(B):
+            L = lens_now[b]
+            q3 = qkv[start:start + L, :H * hd].reshape(L, H, hd)
+            k3 = qkv[start:start + L, H * hd:(H + KV) * hd].reshape(L, KV, hd)
+            v3 = qkv[start:start + L, (H + KV) * hd:].reshape(L, KV, hd)
+            # cache pages carry the new k/v
+            for p in range(L):
+                np.testing.assert_allclose(kco[bt[b, p // bs], :, p % bs],
+                                           k3[p], rtol=1e-6)
+            kr = np.repeat(k3, H // KV, axis=1)
+            vr = np.repeat(v3, H // KV, axis=1)
+            ref = naive_sdpa(q3.transpose(1, 0, 2)[None],
+                             kr.transpose(1, 0, 2)[None],
+                             vr.transpose(1, 0, 2)[None], causal_from=0)
+            np.testing.assert_allclose(
+                out[start:start + L].reshape(L, H, hd),
+                ref[0].transpose(1, 0, 2), rtol=2e-5, atol=2e-5)
+            start += L
+
+    def test_decode_matches_naive(self):
+        rs = np.random.RandomState(8)
+        B, H, KV, hd, bs = 2, 2, 2, 8, 4
+        past = [5, 9]
+        bt, kc, vc, hist_k, hist_v, cu, qkv = self._setup(
+            rs, B, past, [1, 1], H, KV, hd, bs, nblocks=8)
+        out, _, kco, vco = SA.block_multihead_attention_.__wrapped__(
+            jnp.asarray(qkv), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(np.zeros(B, np.int32)),
+            jnp.asarray(np.array(past, np.int32)),
+            jnp.asarray(np.ones(B, np.int32)),
+            cu_seqlens_q=jnp.asarray(cu), cu_seqlens_k=jnp.asarray(cu),
+            block_tables=jnp.asarray(bt), block_size=bs)
+        out = np.asarray(out)
+        for b in range(B):
+            q3 = qkv[b, :H * hd].reshape(1, H, hd)
+            k_new = qkv[b, H * hd:(H + KV) * hd].reshape(KV, hd)
+            v_new = qkv[b, (H + KV) * hd:].reshape(KV, hd)
+            k_full = np.concatenate([hist_k[b], k_new[None]], 0)
+            v_full = np.concatenate([hist_v[b], v_new[None]], 0)
+            kr = np.repeat(k_full, H // KV, axis=1)
+            vr = np.repeat(v_full, H // KV, axis=1)
+            ref = naive_sdpa(q3.transpose(1, 0, 2)[None],
+                             kr.transpose(1, 0, 2)[None],
+                             vr.transpose(1, 0, 2)[None])
+            np.testing.assert_allclose(out[b].reshape(H, hd),
+                                       ref[0, :, 0], rtol=2e-5, atol=2e-5)
+
+    def test_jit_compiles(self):
+        rs = np.random.RandomState(9)
+        B, H, KV, hd, bs = 1, 2, 2, 8, 4
+        bt, kc, vc, _, _, cu, qkv = self._setup(
+            rs, B, [0], [4], H, KV, hd, bs, nblocks=4)
+
+        @jax.jit
+        def step(qkv, kc, vc):
+            return SA.block_multihead_attention_.__wrapped__(
+                qkv, kc, vc, jnp.asarray([4], jnp.int32),
+                jnp.asarray([0], jnp.int32), jnp.asarray([4], jnp.int32),
+                cu_seqlens_q=jnp.asarray(cu), cu_seqlens_k=jnp.asarray(cu),
+                block_tables=jnp.asarray(bt), block_size=bs)
+
+        out, _, _, _ = step(jnp.asarray(qkv), jnp.asarray(kc), jnp.asarray(vc))
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestFusedMultiTransformer:
+    def _weights(self, rs, L, D, H, hd, F):
+        mk = lambda *s: rs.randn(*s).astype(np.float32) * 0.05
+        return dict(
+            ln_scales=[jnp.asarray(np.ones(D, np.float32))] * L,
+            ln_biases=[jnp.asarray(np.zeros(D, np.float32))] * L,
+            qkv_weights=[jnp.asarray(mk(3, H, hd, D)) for _ in range(L)],
+            qkv_biases=[jnp.asarray(np.zeros((3, H, hd), np.float32))] * L,
+            linear_weights=[jnp.asarray(mk(H * hd, D)) for _ in range(L)],
+            linear_biases=[jnp.asarray(np.zeros(D, np.float32))] * L,
+            ffn_ln_scales=[jnp.asarray(np.ones(D, np.float32))] * L,
+            ffn_ln_biases=[jnp.asarray(np.zeros(D, np.float32))] * L,
+            ffn1_weights=[jnp.asarray(mk(D, F)) for _ in range(L)],
+            ffn1_biases=[jnp.asarray(np.zeros(F, np.float32))] * L,
+            ffn2_weights=[jnp.asarray(mk(F, D)) for _ in range(L)],
+            ffn2_biases=[jnp.asarray(np.zeros(D, np.float32))] * L,
+        )
+
+    def test_prefill_then_decode_consistency(self):
+        """Decoding token T through the cache must equal running prefill
+        over T+1 tokens — the core serving invariant."""
+        rs = np.random.RandomState(10)
+        L, D, H, hd, F, B, T, S = 2, 16, 2, 8, 32, 1, 4, 8
+        w = self._weights(rs, L, D, H, hd, F)
+        x_full = rs.randn(B, T + 1, D).astype(np.float32)
+        caches = [jnp.zeros((2, B, H, S, hd), jnp.float32) for _ in range(L)]
+        # prefill on first T tokens
+        out_pre, caches = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full[:, :T]), cache_kvs=caches, **w)
+        # decode token T
+        out_dec, _ = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full[:, T:T + 1]), cache_kvs=caches,
+            time_step=jnp.asarray(T), **w)
+        # full prefill over T+1 tokens
+        caches2 = [jnp.zeros((2, B, H, S, hd), jnp.float32) for _ in range(L)]
+        out_full, _ = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full), cache_kvs=caches2, **w)
+        np.testing.assert_allclose(np.asarray(out_dec)[:, 0],
+                                   np.asarray(out_full)[:, T],
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(out_pre),
+                                   np.asarray(out_full)[:, :T],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_post_ln_prefill_decode_consistency(self):
+        """post-LN mode (pre_layer_norm=False) keeps the serving invariant
+        and actually uses ffn_ln (code-review finding r4)."""
+        rs = np.random.RandomState(11)
+        L, D, H, hd, F, B, T, S = 2, 16, 2, 8, 32, 1, 3, 8
+        w = self._weights(rs, L, D, H, hd, F)
+        # distinct ffn_ln scales so ignoring them would show up
+        w["ffn_ln_scales"] = [jnp.asarray(np.full(D, 1.5, np.float32))] * L
+        x_full = rs.randn(B, T + 1, D).astype(np.float32)
+        caches = [jnp.zeros((2, B, H, S, hd), jnp.float32) for _ in range(L)]
+        _, caches = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full[:, :T]), cache_kvs=caches,
+            pre_layer_norm=False, **w)
+        out_dec, _ = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full[:, T:T + 1]), cache_kvs=caches,
+            time_step=jnp.asarray(T), pre_layer_norm=False, **w)
+        caches2 = [jnp.zeros((2, B, H, S, hd), jnp.float32) for _ in range(L)]
+        out_full, _ = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full), cache_kvs=caches2, pre_layer_norm=False, **w)
+        np.testing.assert_allclose(np.asarray(out_dec)[:, 0],
+                                   np.asarray(out_full)[:, T],
+                                   rtol=2e-4, atol=2e-4)
+        # ffn_ln with scale 1.5 must differ from scale 1.0
+        w2 = dict(w, ffn_ln_scales=[jnp.asarray(np.ones(D, np.float32))] * L)
+        caches3 = [jnp.zeros((2, B, H, S, hd), jnp.float32) for _ in range(L)]
+        out_other, _ = SA.fused_multi_transformer_.__wrapped__(
+            jnp.asarray(x_full), cache_kvs=caches3, pre_layer_norm=False, **w2)
+        assert np.abs(np.asarray(out_full) - np.asarray(out_other)).max() > 1e-3
+
+    def test_misaligned_packing_falls_back(self):
+        """flash_attn_unpadded with equal totals but different boundaries
+        must NOT take the fused aligned-segment path (finding r4 #5)."""
+        rs = np.random.RandomState(12)
+        total, H, hd = 256, 2, 64
+        q = jnp.asarray(rs.randn(total, H, hd).astype(np.float32))
+        cu_q = jnp.asarray(np.array([0, 100, 256], np.int32))
+        cu_k = jnp.asarray(np.array([0, 156, 256], np.int32))
+        out, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            q, q, q, cu_q, cu_k, causal=False)
+        # reference: q rows 0..99 attend k rows 0..155 (their "sequence 1")
+        ref = naive_sdpa(q[:100].transpose(1, 0, 2)[None],
+                         q[:156].transpose(1, 0, 2)[None],
+                         q[:156].transpose(1, 0, 2)[None])
+        np.testing.assert_allclose(np.asarray(out)[:100],
+                                   np.asarray(ref)[0].transpose(1, 0, 2),
+                                   rtol=2e-4, atol=2e-4)
