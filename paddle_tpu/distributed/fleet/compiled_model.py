@@ -1,0 +1,141 @@
+"""CompiledHybridModel — fleet wrapper over the generic compiled engine.
+
+Reference surface: meta_parallel/pipeline_parallel.py:255 `train_batch` /
+`eval_batch` on the wrapped model. TPU-native body: one jitted
+dp×pp×tp step from distributed/hybrid_generic.GenericHybridEngine instead
+of eager per-stage execution + NCCL collectives.
+
+Activation: `strategy.hybrid_configs = {"compiled": True}` before
+`fleet.distributed_model(model)`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..hybrid import AdamWConfig
+from ..hybrid_generic import GenericHybridEngine
+
+
+def _hp_from_optimizer(optimizer) -> AdamWConfig:
+    """Map a framework optimizer onto the engine's fused AdamW."""
+    name = type(optimizer).__name__ if optimizer is not None else "AdamW"
+    if name not in ("AdamW", "Adam"):
+        raise NotImplementedError(
+            f"compiled hybrid engine fuses AdamW into the step; optimizer "
+            f"{name} is not supported — drop hybrid_configs['compiled'] to "
+            "use the eager fleet wrappers")
+    def get(attr, default):
+        v = getattr(optimizer, attr, None)
+        return default if v is None else float(v)   # 0.0 is a real value
+
+    lr = getattr(optimizer, "_learning_rate", 1e-3)
+    if hasattr(lr, "get_lr"):
+        lr = lr.get_lr()
+    # AdamW keeps decoupled decay in _coeff; Adam's coupled decay (if any)
+    # sits in _weight_decay
+    wd = get("_coeff", get("_weight_decay", 0.0))
+    clip = getattr(optimizer, "_grad_clip", None)
+    clip_norm = getattr(clip, "clip_norm", None) if clip is not None else None
+    return AdamWConfig(lr=float(lr), beta1=get("_beta1", 0.9),
+                       beta2=get("_beta2", 0.999), eps=get("_epsilon", 1e-8),
+                       weight_decay=wd,
+                       grad_clip=float(clip_norm) if clip_norm else None)
+
+
+class CompiledHybridModel:
+    """Duck-types the PipelineParallel wrapper: train_batch / eval_batch /
+    forward / parameters / state_dict, backed by one compiled step."""
+
+    def __init__(self, model, fleet_obj, strategy):
+        self._layers = model
+        self._fleet = fleet_obj
+        self._strategy = strategy
+        self._engine: Optional[GenericHybridEngine] = None
+        h = strategy.hybrid_configs
+        self._num_microbatches = max(
+            1, int(h.get("accumulate_steps", 1) or 1))
+        self._loss_fn = getattr(model, "_loss_fn", None)
+
+    # -- engine lifecycle ------------------------------------------------
+    def _ensure_engine(self, optimizer=None, loss_fn=None):
+        if self._engine is None:
+            lf = loss_fn or self._loss_fn
+            if lf is None:
+                raise ValueError(
+                    "compiled hybrid needs a loss: pass loss_fn to "
+                    "train_batch or build the PipelineLayer with loss_fn=")
+            self._engine = GenericHybridEngine(
+                self._layers, self._fleet.mesh, lf,
+                hp=_hp_from_optimizer(optimizer),
+                num_microbatches=self._num_microbatches)
+        return self._engine
+
+    # -- reference API ----------------------------------------------------
+    def train_batch(self, data, optimizer=None, lr_scheduler=None,
+                    loss_fn=None):
+        x, labels = data
+        eng = self._ensure_engine(optimizer, loss_fn)
+        # the CURRENT scheduled lr feeds the compiled step each call (the
+        # engine's hp.lr is only the default) — reference train_batch
+        # applies the scheduled lr per step too
+        lr = None
+        sched = lr_scheduler
+        if sched is None and optimizer is not None:
+            maybe = getattr(optimizer, "_learning_rate", None)
+            if hasattr(maybe, "get_lr"):
+                sched = maybe
+        if sched is not None and hasattr(sched, "get_lr"):
+            lr = float(sched.get_lr())
+        loss = eng.train_batch(x, labels, lr=lr)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        return Tensor._from_data(jnp.float32(loss))
+
+    def eval_batch(self, data, compute_loss=True, loss_fn=None):
+        x, labels = data
+        eng = self._ensure_engine(None, loss_fn)
+        loss = eng.eval_batch(x, labels)
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        return Tensor._from_data(jnp.float32(loss))
+
+    def forward(self, *args, **kwargs):
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        return self._layers(*args, **kwargs)
+
+    __call__ = forward
+
+    def parameters(self, *a, **k):
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        out = self._layers.set_state_dict(sd, *a, **k)
+        if self._engine is not None:
+            # re-seed the engine's device copies from the layer
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            eng = self._engine
+            eng.params = {
+                n: jax.device_put(t._data,
+                                  NamedSharding(eng.mesh, eng._specs[n]))
+                for n, t in eng._param_ts.items()}
+            eng.buffers = {
+                n: jax.device_put(t._data, NamedSharding(eng.mesh, P()))
+                for n, t in eng._buffer_ts.items()}
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)

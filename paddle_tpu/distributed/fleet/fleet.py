@@ -103,7 +103,13 @@ class Fleet:
 
     # ------------------------------------------------------------------
     def distributed_model(self, model):
-        """Reference: fleet/model.py:32 (wrapper selection :143-162)."""
+        """Reference: fleet/model.py:32 (wrapper selection :143-162).
+
+        With `hybrid_configs={"compiled": True}` the model is wrapped in the
+        generic COMPILED hybrid engine (distributed/hybrid_generic.py): one
+        jitted dp×pp×tp train step — manual GPipe + dp, GSPMD tp — instead
+        of the eager per-stage wrappers. The wrapper keeps the reference
+        train_batch/eval_batch surface (pipeline_parallel.py:255)."""
         from .meta_parallel import (
             PipelineParallel,
             SegmentParallel,
@@ -114,6 +120,12 @@ class Fleet:
         hcg = self._hcg
         if hcg is None:
             return model
+        if (self._strategy is not None
+                and self._strategy.hybrid_configs.get("compiled")
+                and self._mesh is not None):
+            from .compiled_model import CompiledHybridModel
+
+            return CompiledHybridModel(model, self, self._strategy)
         if hcg.get_pipe_parallel_world_size() > 1:
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_model_parallel_world_size() > 1:

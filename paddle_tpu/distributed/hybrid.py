@@ -123,8 +123,10 @@ def param_specs(cfg: L.LlamaConfig) -> Dict[str, Any]:
     }
 
 
-def shard_params(params: Dict[str, Any], mesh: Mesh, cfg: L.LlamaConfig):
-    """Stage-stack + device_put with NamedShardings (host → HBM, laid out)."""
+def shard_params(params: Dict[str, Any], mesh: Mesh, cfg):
+    """Stage-stack + device_put with NamedShardings (host → HBM, laid out).
+    cfg: LlamaConfig. (Generic Layers shard their params inside
+    hybrid_generic.GenericHybridEngine — no call needed.)"""
     pp = mesh.shape["pp"]
     stacked = stack_pipeline(params, pp)
     specs = param_specs(cfg)
@@ -151,7 +153,11 @@ def init_opt_state(params):
     return {"m": zeros(params), "v": zeros(params), "step": jnp.zeros((), jnp.int32)}
 
 
-def _adamw_update(params, grads, opt, hp: AdamWConfig, global_sq_sum):
+def _adamw_update(params, grads, opt, hp: AdamWConfig, global_sq_sum,
+                  lr=None):
+    """lr: optional traced scalar overriding hp.lr (lets an LR schedule
+    feed the compiled step without recompilation)."""
+    lr = hp.lr if lr is None else lr
     step = opt["step"] + 1
     if hp.grad_clip is not None:
         gnorm = jnp.sqrt(global_sq_sum)
@@ -167,7 +173,7 @@ def _adamw_update(params, grads, opt, hp: AdamWConfig, global_sq_sum):
         v = b2 * v + (1 - b2) * g * g
         u = (m / bc1) / (jnp.sqrt(v / bc2) + hp.eps)
         u = u + hp.weight_decay * p.astype(jnp.float32)
-        return (p.astype(jnp.float32) - hp.lr * u).astype(p.dtype), m, v
+        return (p.astype(jnp.float32) - lr * u).astype(p.dtype), m, v
 
     flat_p, tree = jax.tree.flatten(params)
     flat_g = jax.tree.leaves(grads)
@@ -432,13 +438,23 @@ def sync_grads(grads, specs):
 # Public train step factory
 # --------------------------------------------------------------------------
 
-def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
+def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
                     hp: Optional[AdamWConfig] = None,
                     remat: Union[bool, str] = True,
-                    attn_impl: str = "auto"):
-    """Returns jitted step(params, opt_state, tokens, targets) →
-    (params, opt_state, loss). params must be stage-stacked + sharded
-    (see shard_params); tokens/targets are [B_global, T] int32 sharded P('dp',None).
+                    attn_impl: str = "auto", loss_fn=None):
+    """Model-agnostic entry (VERDICT r3 task #2).
+
+    cfg: a LlamaConfig (the hand-optimized flagship path below) OR any
+    `nn.Layer` — Layers route to the generic compiled engine
+    (hybrid_generic.GenericHybridEngine: manual dp/pp GPipe + GSPMD tp)
+    and the returned step closes over engine state:
+    `step(x, labels) -> loss`, with the engine on `step.engine`.
+    `loss_fn` is required for the Layer path.
+
+    LlamaConfig path: returns jitted step(params, opt_state, tokens,
+    targets) → (params, opt_state, loss). params must be stage-stacked +
+    sharded (see shard_params); tokens/targets are [B_global, T] int32
+    sharded P('dp',None).
 
     remat: True = full per-block rematerialization (lowest memory);
     "dots" = jax.checkpoint_policies.dots_saveable — saves matmul outputs and
@@ -448,6 +464,21 @@ def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
     attn_impl: "auto" (Pallas flash on TPU when supported), "flash" (force),
     anything else = plain XLA attention.
     """
+    if not isinstance(cfg, L.LlamaConfig):
+        from .hybrid_generic import GenericHybridEngine
+
+        if loss_fn is None and getattr(cfg, "_loss_fn", None) is not None:
+            loss_fn = cfg._loss_fn
+        if loss_fn is None:
+            raise ValueError("make_train_step(Layer, ...) needs loss_fn=")
+        eng = GenericHybridEngine(cfg, mesh, loss_fn, hp=hp,
+                                  num_microbatches=num_microbatches)
+
+        def step(x, labels):
+            return eng.train_batch(x, labels)
+
+        step.engine = eng
+        return step
     hp = hp or AdamWConfig()
     dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
@@ -478,8 +509,9 @@ def make_train_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def make_eval_step(cfg: L.LlamaConfig, mesh: Mesh, num_microbatches: int = 1):
-    """Jitted loss-only step (no grads) with the same sharding layout."""
+def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1):
+    """Jitted loss-only step (no grads) with the same sharding layout.
+    cfg: LlamaConfig; Layers use GenericHybridEngine.eval_batch."""
     dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
     shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, cp,

@@ -164,6 +164,8 @@ class LLMPredictor:
             out.append(nxt[:, None])
             if return_scores:
                 scores.append(last_logits)
+            if i == max_new_tokens - 1:   # last token decided: the next
+                break                     # forward's logits would be unused
             if eos_token_id is not None and bool(finished.all()):
                 break
             last_logits, cache = self._decode(self.params, nxt, cache,

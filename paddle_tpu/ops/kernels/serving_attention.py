@@ -69,8 +69,9 @@ def _rope_pairwise(x, cos, sin, neox: bool):
     return out.astype(x.dtype)
 
 
-def _split_rotary(rotary_t, pos, hd):
-    """Gather (cos, sin) [B, hd//2] f32 at integer positions `pos` [B].
+def _rotary_table(rotary_t, hd):
+    """Normalize a rotary tensor into (cos, sin) tables [Br, S, hd//2] f32,
+    Br in {1, B}.
 
     Accepts both reference layouts: a leading stack dim of 2 (cos over sin,
     the fused_multi_transformer `rotary_embs` [2, B, 1, S, hd] form) or a
@@ -78,21 +79,22 @@ def _split_rotary(rotary_t, pos, hd):
     `rotary_tensor` [B, 1, 1, S, hd] form)."""
     rt = jnp.asarray(rotary_t, jnp.float32)
     if rt.ndim >= 4 and rt.shape[0] == 2:      # [2, B?, ..., S, hd] stack
-        cos_t = rt[0].reshape((-1,) + rt.shape[-2:])
+        cos_t = rt[0].reshape((-1,) + rt.shape[-2:])   # [Br, S, hd]
         sin_t = rt[1].reshape((-1,) + rt.shape[-2:])
-        if cos_t.shape[0] == 1:
-            cos, sin = cos_t[0][pos], sin_t[0][pos]
-        else:
-            b = jnp.arange(pos.shape[0])
-            cos, sin = cos_t[b, pos], sin_t[b, pos]
-        return cos[..., : hd // 2], sin[..., : hd // 2]
+        return cos_t[..., : hd // 2], sin_t[..., : hd // 2]
     rt = rt.reshape((-1,) + rt.shape[-2:]) if rt.ndim > 2 else rt[None]
     # interleaved lanes: [B,1,1,S,hd] / [1,S,hd] / [S,hd]
-    if rt.shape[0] == 1:
-        sel = rt[0][pos]                       # [B, hd]
-    else:
-        sel = rt[jnp.arange(pos.shape[0]), pos]
-    return sel[..., 0::2], sel[..., 1::2]
+    return rt[..., 0::2], rt[..., 1::2]
+
+
+def _split_rotary(rotary_t, pos, hd):
+    """(cos, sin) [B, hd//2] at integer positions `pos` [B] — one position
+    per batch row (the decode-step gather)."""
+    cos_t, sin_t = _rotary_table(rotary_t, hd)
+    if cos_t.shape[0] == 1:
+        return cos_t[0][pos], sin_t[0][pos]
+    b = jnp.arange(pos.shape[0])
+    return cos_t[b, pos], sin_t[b, pos]
 
 
 # ---------------------------------------------------------------------------
@@ -166,24 +168,39 @@ def masked_multihead_attention_(x, cache_kv=None, bias=None, src_mask=None,
 # flash_attn_unpadded (varlen packed flash)
 # ---------------------------------------------------------------------------
 
-def _segments_from_cu(cu_seqlens, total):
-    """cu_seqlens [B+1] → segment id per packed position [total]; positions
-    beyond cu[-1] (pad tail) get a fresh id so they only see themselves."""
+def _unpack_cu(cu_seqlens, total):
+    """cu_seqlens [B+1] → (seg id, local pos, seg length) per packed
+    position [total]. Tail positions beyond cu[-1] share a fresh id so they
+    only see each other (and are discarded on unpack)."""
+    cu = cu_seqlens.astype(jnp.int32)
+    nb = cu.shape[0] - 1
     idx = jnp.arange(total, dtype=jnp.int32)
-    seg = jnp.searchsorted(cu_seqlens.astype(jnp.int32), idx, side="right")
-    return seg.astype(jnp.int32)
+    seg = jnp.searchsorted(cu, idx, side="right").astype(jnp.int32)  # 1..B
+    start = cu[jnp.clip(seg - 1, 0, nb)]
+    end = cu[jnp.clip(seg, 0, nb)]
+    return seg, idx - start, jnp.maximum(end - start, 0)
 
 
-def _xla_varlen_sdpa(q, k, v, q_seg, k_seg, scale, causal):
-    """Masked SDPA over packed [total, H, hd] arrays (fallback path)."""
+def _xla_varlen_sdpa(q, k, v, qcu, kcu, scale, causal):
+    """Masked SDPA over packed [total, H, hd] arrays (fallback path).
+    Causal uses the flash-attention varlen convention: bottom-RIGHT
+    alignment — q local position i sees k local positions
+    <= i + (len_k - len_q), which reduces to plain causal when the
+    packings match and to full attention for a 1-token q over a longer
+    cached k (the decode case)."""
+    q_seg, q_loc, q_len = _unpack_cu(qcu, q.shape[0])
+    k_seg, k_loc, k_len = _unpack_cu(kcu, k.shape[0])
     s = jnp.einsum("thd,shd->hts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     mask = q_seg[:, None] == k_seg[None, :]
     if causal:
-        mask = mask & (jnp.arange(q.shape[0])[:, None]
-                       >= jnp.arange(k.shape[0])[None, :])
+        mask = mask & (k_loc[None, :]
+                       <= q_loc[:, None] + (k_len[None, :] - q_len[:, None]))
     s = jnp.where(mask[None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    # a q row whose whole k side is masked (possible for degenerate cu
+    # tables) yields a uniform softmax; zero it instead
+    p = jnp.where(mask.any(axis=1)[None, :, None], p, 0.0)
     return jnp.einsum("hts,shd->thd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -212,8 +229,8 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
     total_k = k.shape[0]
     if scale is None:
         scale = 1.0 / np.sqrt(hd)
-    q_seg = _segments_from_cu(cu_seqlens_q, total_q)
-    k_seg = _segments_from_cu(cu_seqlens_k, total_k)
+    q_seg, _, _ = _unpack_cu(cu_seqlens_q, total_q)
+    k_seg, _, _ = _unpack_cu(cu_seqlens_k, total_k)
 
     from ..pallas import flash_attention as FA
 
@@ -240,7 +257,8 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
         if kv_rep != H:  # GQA on the fallback path
             k = jnp.repeat(k, H // kv_rep, axis=1)
             v = jnp.repeat(v, H // kv_rep, axis=1)
-        o = _xla_varlen_sdpa(q, k, v, q_seg, k_seg, float(scale), causal)
+        o = _xla_varlen_sdpa(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                             float(scale), causal)
         if attn_mask is not None:
             raise NotImplementedError(
                 "flash_attn_unpadded attn_mask: use dense flash_attn")
@@ -378,10 +396,10 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     tok_valid = tok_local < this[tok_b]
 
     if rope_emb is not None:
-        re = jnp.asarray(rope_emb, jnp.float32)
-        re = re.reshape(2, -1, re.shape[-1])                 # [2, S, hd]
-        cos = re[0][tok_pos][..., 0::2]
-        sin = re[1][tok_pos][..., 0::2]
+        cos_t, sin_t = _rotary_table(rope_emb, hd)           # [Br, S, hd//2]
+        tb = jnp.zeros_like(tok_b) if cos_t.shape[0] == 1 else tok_b
+        cos = cos_t[tb, tok_pos]                             # [tok, hd//2]
+        sin = sin_t[tb, tok_pos]
         q_tok = _rope_pairwise(q_tok, cos[:, None], sin[:, None], use_neox_style)
         k_tok = _rope_pairwise(k_tok, cos[:, None], sin[:, None], use_neox_style)
 
@@ -414,16 +432,21 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     page_valid = jnp.broadcast_to(page_valid, (B, max_blocks, bs)
                                   ).reshape(B, max_kv)
 
-    k_rep = jnp.repeat(rows_k[tok_b], H // KV, axis=2)       # [tok, max_kv, H, hd]
-    v_rep = jnp.repeat(rows_v[tok_b], H // KV, axis=2)
-    s = jnp.einsum("thd,tshd->ths", q_tok.astype(jnp.float32),
-                   k_rep.astype(jnp.float32)) / np.sqrt(hd)  # [tok, H, max_kv]
+    # grouped-head attention WITHOUT materializing the GQA-expanded cache
+    # (q head h reads kv head h // G — the same mapping the Pallas kernel
+    # uses via index maps); rows stay [tok, max_kv, KV, hd]
+    G = H // KV
+    q_g = q_tok.reshape(token_num, KV, G, hd)                # head h = kv*G+g
+    k_tok_rows = rows_k[tok_b]                               # [tok, max_kv, KV, hd]
+    v_tok_rows = rows_v[tok_b]
+    s = jnp.einsum("tkgd,tskd->tkgs", q_g.astype(jnp.float32),
+                   k_tok_rows.astype(jnp.float32)) / np.sqrt(hd)
     kv_pos = jnp.arange(max_kv)[None, :]
-    ok = (kv_pos <= tok_pos[:, None]) & page_valid[tok_b]
-    s = jnp.where(ok[:, None, :], s, -1e30)
+    ok = (kv_pos <= tok_pos[:, None]) & page_valid[tok_b]    # [tok, max_kv]
+    s = jnp.where(ok[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("ths,tshd->thd", p, v_rep.astype(jnp.float32))
-    o = jnp.where(tok_valid[:, None, None], o, 0.0)
+    o = jnp.einsum("tkgs,tskd->tkgd", p, v_tok_rows.astype(jnp.float32))
+    o = jnp.where(tok_valid[:, None, None, None], o, 0.0)
     fmha_out = o.astype(qkv.dtype).reshape(token_num, H * hd)
     return fmha_out, qkv3.reshape(token_num, -1), key_cache_out, value_cache_out
 
@@ -517,12 +540,13 @@ def fused_multi_transformer_(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 qkv5 = qkv5 + qkv_biases[i].reshape(1, 1, 3, H, hd).astype(qkv5.dtype)
             q, k, v = qkv5[:, :, 0], qkv5[:, :, 1], qkv5[:, :, 2]
             if rotary_emb_dims and rotary_embs is not None:
-                pos = jnp.arange(T)
-                cos, sin = _split_rotary(rotary_embs, pos, hd)
-                q = _rope_pairwise(q, cos[None, :, None], sin[None, :, None],
-                                   use_neox_rotary_style)
-                k = _rope_pairwise(k, cos[None, :, None], sin[None, :, None],
-                                   use_neox_rotary_style)
+                # prefill: per-batch tables sliced over positions 0..T-1
+                # ([Br, S, hd//2] -> [Br, T, 1, hd//2], broadcast over heads)
+                cos_t, sin_t = _rotary_table(rotary_embs, hd)
+                cos = cos_t[:, :T, None]
+                sin = sin_t[:, :T, None]
+                q = _rope_pairwise(q, cos, sin, use_neox_rotary_style)
+                k = _rope_pairwise(k, cos, sin, use_neox_rotary_style)
             s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                            k.astype(jnp.float32)) / np.sqrt(hd)
             causal = jnp.tril(jnp.ones((T, T), bool))

@@ -1,0 +1,299 @@
+"""Generic compiled hybrid engine: dp×pp×tp for arbitrary Layers.
+
+VERDICT r3 task #2 acceptance: a BERT-style model and a non-transformer
+model train through dp×pp×tp via fleet with parity vs single-device, with
+no model-specific config in the engine's signatures.
+
+Parity caveat baked into the tests: params with mathematically-zero
+gradients (conv bias before BN) get ±lr Adam updates from float noise, so
+BN-adjacent convs use bias_attr=False (standard practice) — everything
+else must match to float tolerance.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.hybrid import AdamWConfig
+from paddle_tpu.distributed.hybrid_generic import (
+    GenericHybridEngine, functionalize, generic_tp_specs)
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, PipelineLayer)
+
+
+def mesh_of(dp, pp, tp):
+    n = dp * pp * tp
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(dp, pp, tp),
+                ("dp", "pp", "tp"))
+
+
+def ce(out, lab):
+    return paddle.nn.functional.cross_entropy(out, lab)
+
+
+def make_mlp(num_stages=2):
+    paddle.seed(0)
+    return PipelineLayer([
+        LayerDesc(paddle.nn.Linear, 16, 32),
+        LayerDesc(paddle.nn.ReLU),
+        LayerDesc(paddle.nn.Linear, 32, 32),
+        LayerDesc(paddle.nn.ReLU),
+        LayerDesc(paddle.nn.Linear, 32, 32),
+        LayerDesc(paddle.nn.ReLU),
+        LayerDesc(paddle.nn.Linear, 32, 10),
+    ], num_stages=num_stages, seg_method="uniform")
+
+
+def make_convnet(num_stages=2):
+    """Non-transformer (conv+BN) pipeline; BN-adjacent convs bias-free."""
+    paddle.seed(0)
+    return PipelineLayer([
+        LayerDesc(paddle.nn.Conv2D, 3, 8, 3, padding=1, bias_attr=False),
+        LayerDesc(paddle.nn.BatchNorm2D, 8),
+        LayerDesc(paddle.nn.ReLU),
+        LayerDesc(paddle.nn.Conv2D, 8, 8, 3, padding=1, bias_attr=False),
+        LayerDesc(paddle.nn.BatchNorm2D, 8),
+        LayerDesc(paddle.nn.ReLU),
+        LayerDesc(paddle.nn.Flatten),
+        LayerDesc(paddle.nn.Linear, 8 * 16, 10),
+    ], num_stages=num_stages, seg_method="uniform")
+
+
+class BertBlock(paddle.nn.Layer):
+    def __init__(self, d, heads):
+        super().__init__()
+        self.enc = paddle.nn.TransformerEncoderLayer(d, heads, 4 * d,
+                                                     dropout=0.0)
+
+    def forward(self, x):
+        return self.enc(x)
+
+
+class BertEmbed(paddle.nn.Layer):
+    def __init__(self, v, t, d):
+        super().__init__()
+        self.tok = paddle.nn.Embedding(v, d)
+        self.pos = paddle.nn.Embedding(t, d)
+
+    def forward(self, tokens):
+        T = tokens.shape[1]
+        import paddle_tpu as pdl
+        pos = pdl.to_tensor(np.arange(T))
+        return self.tok(tokens) + self.pos(pos)
+
+
+class BertHead(paddle.nn.Layer):
+    def __init__(self, d, v):
+        super().__init__()
+        self.fc = paddle.nn.Linear(d, v)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def make_bert(num_stages=2, V=64, T=8, D=32, heads=4, L=2):
+    paddle.seed(0)
+    descs = [LayerDesc(BertEmbed, V, T, D)]
+    descs += [LayerDesc(BertBlock, D, heads) for _ in range(L)]
+    descs += [LayerDesc(BertHead, D, V)]
+    return PipelineLayer(descs, num_stages=num_stages, seg_method="uniform")
+
+
+def bert_loss(out, lab):
+    V = out.shape[-1]
+    return paddle.nn.functional.cross_entropy(
+        out.reshape([-1, V]), lab.reshape([-1]))
+
+
+def run_engine(model, mesh, loss_fn, x, y, steps=3, M=1):
+    eng = GenericHybridEngine(model, mesh, loss_fn,
+                              AdamWConfig(lr=1e-2, weight_decay=0.0),
+                              num_microbatches=M)
+    return eng, [eng.train_batch(x, y) for _ in range(steps)]
+
+
+class TestGenericParity:
+    def test_mlp_dp2_pp2_tp2(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randint(0, 10, (8,))
+        _, l1 = run_engine(make_mlp(), mesh_of(1, 1, 1), ce, x, y)
+        _, l8 = run_engine(make_mlp(), mesh_of(2, 2, 2), ce, x, y, M=2)
+        np.testing.assert_allclose(l1, l8, rtol=2e-4, atol=2e-4)
+
+    def test_convnet_pp2_tp2_with_buffers(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(4, 3, 4, 4).astype(np.float32)
+        y = rs.randint(0, 10, (4,))
+        e1, l1 = run_engine(make_convnet(), mesh_of(1, 1, 1), ce, x, y)
+        e4, l4 = run_engine(make_convnet(), mesh_of(1, 2, 2), ce, x, y)
+        np.testing.assert_allclose(l1, l4, rtol=2e-4, atol=2e-4)
+        # BN running stats thread through the pipeline and match
+        assert set(e1.buffers) == set(e4.buffers) and len(e1.buffers) >= 4
+        for n in e1.buffers:
+            np.testing.assert_allclose(np.asarray(e1.buffers[n]),
+                                       np.asarray(e4.buffers[n]),
+                                       rtol=1e-4, atol=1e-5)
+        # stats actually moved off init
+        moved = [n for n in e1.buffers
+                 if float(jnp.abs(e1.buffers[n]).max()) > 1e-6]
+        assert moved
+
+    def test_bert_dp2_pp2_tp2(self):
+        """The BERT bench-config shape through the generic engine."""
+        rs = np.random.RandomState(2)
+        x = rs.randint(0, 64, (8, 8)).astype(np.int32)
+        y = rs.randint(0, 64, (8, 8)).astype(np.int64)
+        _, l1 = run_engine(make_bert(), mesh_of(1, 1, 1), bert_loss, x, y)
+        _, l8 = run_engine(make_bert(), mesh_of(2, 2, 2), bert_loss, x, y,
+                           M=2)
+        np.testing.assert_allclose(l1, l8, rtol=3e-4, atol=3e-4)
+        assert l1[-1] < l1[0]
+
+    def test_microbatch_invariance_pp(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randint(0, 10, (8,))
+        _, a = run_engine(make_mlp(), mesh_of(1, 2, 1), ce, x, y, M=1)
+        _, b = run_engine(make_mlp(), mesh_of(1, 2, 1), ce, x, y, M=4)
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+    def test_plain_layer_no_pipeline(self):
+        """Any Layer (not a PipelineLayer) works at pp=1."""
+        paddle.seed(0)
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(16, 4))
+        rs = np.random.RandomState(4)
+        x = rs.randn(8, 8).astype(np.float32)
+        y = rs.randint(0, 4, (8,))
+        eng = GenericHybridEngine(model, mesh_of(2, 1, 2), ce,
+                                  AdamWConfig(lr=1e-2, weight_decay=0.0))
+        losses = [eng.train_batch(x, y) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        # eval and write-back surfaces
+        ev = eng.eval_batch(x, y)
+        assert np.isfinite(ev)
+        eng.sync_to_layer()
+
+    def test_pp_mesh_requires_pipeline_layer(self):
+        model = paddle.nn.Linear(4, 4)
+        with pytest.raises(ValueError, match="PipelineLayer"):
+            GenericHybridEngine(model, mesh_of(1, 2, 1), ce)
+
+    def test_hybrid_make_train_step_dispatches_layers(self):
+        """hybrid.make_train_step is model-agnostic: a Layer routes to the
+        generic engine (VERDICT r3 task #2 acceptance)."""
+        from paddle_tpu.distributed import hybrid as H
+
+        step = H.make_train_step(make_mlp(), mesh_of(1, 2, 2),
+                                 num_microbatches=2, loss_fn=ce,
+                                 hp=AdamWConfig(lr=1e-2, weight_decay=0.0))
+        rs = np.random.RandomState(7)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randint(0, 10, (8,))
+        losses = [step(x, y) for _ in range(3)]
+        assert losses[-1] < losses[0]
+        assert step.engine.pp == 2 and step.engine.tp == 2
+
+
+class TestFunctionalize:
+    def test_pure_apply_no_side_effects(self):
+        paddle.seed(0)
+        layer = paddle.nn.Linear(4, 3)
+        apply, params, buffers = functionalize(layer)
+        x = np.ones((2, 4), np.float32)
+        out, _ = apply(params, buffers, x)
+        w0 = layer.weight.numpy().copy()
+        params2 = {n: v * 2 for n, v in params.items()}
+        out2, _ = apply(params2, buffers, x)
+        np.testing.assert_allclose(np.asarray(out2), 2 * np.asarray(out),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(layer.weight.numpy(), w0)  # restored
+
+    def test_tp_specs_rules(self):
+        paddle.seed(0)
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.Linear(16, 8),
+                                     paddle.nn.Embedding(10, 8))
+        specs = generic_tp_specs(model, tp=2, axis="tp")
+        vals = set(map(str, specs.values()))
+        # column then row alternation appears
+        assert any("'tp'" in s for s in vals)
+
+
+class TestFleetRouting:
+    def test_compiled_flag_routes_to_engine(self):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 2, "compiled": True,
+                                   "accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(make_mlp(num_stages=2))
+        from paddle_tpu.distributed.fleet.compiled_model import (
+            CompiledHybridModel)
+
+        assert isinstance(model, CompiledHybridModel)
+        rs = np.random.RandomState(5)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randint(0, 10, (8,))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters(),
+                                     weight_decay=0.0)
+        losses = [float(model.train_batch([x, y], opt, loss_fn=ce).numpy())
+                  for _ in range(3)]
+        assert losses[-1] < losses[0]
+        # parity against the direct single-device engine (betas matching
+        # the AdamW optimizer's defaults)
+        eng = GenericHybridEngine(
+            make_mlp(), mesh_of(1, 1, 1), ce,
+            AdamWConfig(lr=1e-2, weight_decay=0.0, beta2=0.999,
+                        grad_clip=None))
+        ref = [eng.train_batch(x, y) for _ in range(3)]
+        np.testing.assert_allclose(losses, ref, rtol=3e-4, atol=3e-4)
+        ev = float(model.eval_batch([x, y]).numpy())
+        assert np.isfinite(ev)
+        sd = model.state_dict()
+        assert sd
+
+    def test_lr_schedule_feeds_compiled_step(self):
+        """scheduler lr reaches the fused AdamW each step (r4 finding #3):
+        an lr=0 schedule must freeze the params."""
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "compiled": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(make_mlp(num_stages=2))
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.0, step_size=1)
+        opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                     parameters=model.parameters())
+        rs = np.random.RandomState(8)
+        x = rs.randn(4, 16).astype(np.float32)
+        y = rs.randint(0, 10, (4,))
+        l0 = float(model.train_batch([x, y], opt, lr_scheduler=sched,
+                                     loss_fn=ce).numpy())
+        l1 = float(model.train_batch([x, y], opt, lr_scheduler=sched,
+                                     loss_fn=ce).numpy())
+        assert l0 == l1  # lr 0 -> nothing moved
+
+    def test_compiled_rejects_unsupported_optimizer(self):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "compiled": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(make_mlp(num_stages=2))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=model.parameters())
+        rs = np.random.RandomState(6)
+        with pytest.raises(NotImplementedError, match="AdamW"):
+            model.train_batch([rs.randn(4, 16).astype(np.float32),
+                               rs.randint(0, 10, (4,))], opt, loss_fn=ce)

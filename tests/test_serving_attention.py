@@ -132,6 +132,48 @@ class TestFlashAttnUnpadded:
                                    ref[0].transpose(1, 0, 2),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_causal_decode_style_cross_lengths(self):
+        """causal varlen with q shorter than cached k must bottom-right
+        align (1 new token sees ALL cached keys) — r4 review finding #1."""
+        rs = np.random.RandomState(13)
+        H, hd, Lk = 2, 16, 10
+        q = rs.randn(1, H, hd).astype(np.float32)
+        k = rs.randn(Lk, H, hd).astype(np.float32)
+        v = rs.randn(Lk, H, hd).astype(np.float32)
+        out, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(np.array([0, 1], np.int32)),
+            jnp.asarray(np.array([0, Lk], np.int32)), causal=True)
+        ref = naive_sdpa(q.transpose(1, 0, 2)[None],
+                         k.transpose(1, 0, 2)[None],
+                         v.transpose(1, 0, 2)[None])  # full attend
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.asarray(ref)[0, :, 0],
+                                   rtol=2e-5, atol=2e-5)
+        # and a 2-seq batch: q lens [1,2] over k lens [5,4]
+        q2 = rs.randn(3, H, hd).astype(np.float32)
+        k2 = rs.randn(9, H, hd).astype(np.float32)
+        v2 = rs.randn(9, H, hd).astype(np.float32)
+        cu_q = np.array([0, 1, 3], np.int32)
+        cu_k = np.array([0, 5, 9], np.int32)
+        out2, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            jnp.asarray(q2), jnp.asarray(k2), jnp.asarray(v2),
+            jnp.asarray(cu_q), jnp.asarray(cu_k), causal=True)
+        out2 = np.asarray(out2)
+        # seq 0: 1 q token, 5 keys, sees all 5
+        ref0 = naive_sdpa(q2[0:1].transpose(1, 0, 2)[None],
+                          k2[:5].transpose(1, 0, 2)[None],
+                          v2[:5].transpose(1, 0, 2)[None])
+        np.testing.assert_allclose(out2[0], ref0[0, :, 0], rtol=2e-5,
+                                   atol=2e-5)
+        # seq 1: 2 q tokens over 4 keys, bottom-right aligned: q0 sees 3
+        ref1 = naive_sdpa(q2[1:3].transpose(1, 0, 2)[None],
+                          k2[5:9].transpose(1, 0, 2)[None],
+                          v2[5:9].transpose(1, 0, 2)[None],
+                          causal_from=4 - 2)
+        np.testing.assert_allclose(out2[1:3].transpose(1, 0, 2),
+                                   ref1[0], rtol=2e-5, atol=2e-5)
+
     def test_grad_flows(self):
         rs = np.random.RandomState(4)
         total, H, hd = 256, 2, 64
