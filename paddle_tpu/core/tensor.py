@@ -56,6 +56,7 @@ class Tensor:
         "_is_placeholder",
         "_var_id",
         "_program",
+        "_is_buffer",
         "_fc_layer",
         "_emb_layer",
         "__weakref__",

@@ -55,6 +55,8 @@ class CompiledHybridModel:
         self._num_microbatches = max(
             1, int(h.get("accumulate_steps", 1) or 1))
         self._loss_fn = getattr(model, "_loss_fn", None)
+        self._train_traced = False
+        self._eval_traced = False
 
     # -- engine lifecycle ------------------------------------------------
     def _ensure_engine(self, optimizer=None, loss_fn=None):
@@ -72,9 +74,18 @@ class CompiledHybridModel:
 
     # -- reference API ----------------------------------------------------
     def train_batch(self, data, optimizer=None, lr_scheduler=None,
-                    loss_fn=None):
+                    scaler=None, loss_fn=None):
+        """Positionally matches PipelineParallel.train_batch(data, optimizer,
+        lr_scheduler, scaler); loss_fn is the compiled-path extension."""
+        if scaler is not None and getattr(scaler, "_enable", False):
+            raise NotImplementedError(
+                "compiled hybrid step does not take a GradScaler: bf16 "
+                "training needs no loss scaling; drop "
+                "hybrid_configs['compiled'] for the eager fp16 path")
         x, labels = data
         eng = self._ensure_engine(optimizer, loss_fn)
+        if self._train_traced is False:
+            self._set_mode(train=True)
         # the CURRENT scheduled lr feeds the compiled step each call (the
         # engine's hp.lr is only the default) — reference train_batch
         # applies the scheduled lr per step too
@@ -87,6 +98,7 @@ class CompiledHybridModel:
         if sched is not None and hasattr(sched, "get_lr"):
             lr = float(sched.get_lr())
         loss = eng.train_batch(x, labels, lr=lr)
+        self._train_traced = True
         if lr_scheduler is not None:
             lr_scheduler.step()
         from ...core.tensor import Tensor
@@ -95,13 +107,37 @@ class CompiledHybridModel:
         return Tensor._from_data(jnp.float32(loss))
 
     def eval_batch(self, data, compute_loss=True, loss_fn=None):
-        x, labels = data
+        """Reference surface (pipeline_parallel.py eval_batch): eval mode;
+        compute_loss=False returns the raw model output."""
+        x, labels = (data if isinstance(data, (tuple, list)) and
+                     len(data) == 2 else (data, None))
+        if not compute_loss:
+            if self._engine is not None:
+                self._engine.sync_to_layer()
+            self._set_mode(train=False)
+            try:
+                return self._layers(x)
+            finally:
+                self._set_mode(train=True)
         eng = self._ensure_engine(None, loss_fn)
-        loss = eng.eval_batch(x, labels)
+        if self._eval_traced is False:
+            # the mode at FIRST eval trace is baked into the compiled
+            # program — reference eval_batch runs layers.eval()
+            self._set_mode(train=False)
+        try:
+            loss = eng.eval_batch(x, labels)
+            self._eval_traced = True
+        finally:
+            self._set_mode(train=True)
         from ...core.tensor import Tensor
         import jax.numpy as jnp
 
         return Tensor._from_data(jnp.float32(loss))
+
+    def _set_mode(self, train: bool):
+        fn = getattr(self._layers, "train" if train else "eval", None)
+        if callable(fn):
+            fn()
 
     def forward(self, *args, **kwargs):
         if self._engine is not None:

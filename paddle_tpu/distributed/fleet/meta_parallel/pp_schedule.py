@@ -143,11 +143,26 @@ class _Stage:
             (gp,) = vjp(gy)
             return list(gp)
 
+        def bwd_input(pa, ba, x, gy, key, lbl):
+            """dx ONLY — the zero-bubble split (reference
+            pipeline_zero_bubble.py ZB-H1: B is divided into input-grad and
+            weight-grad phases so dw can fill the cooldown bubble). Note:
+            with per-stage rematerialization the split costs one extra
+            forward recompute (dx and dw each replay the stage) — the
+            bubble saving pays for it at pp >= 4."""
+            def f(x_):
+                y, _ = self._kernel(pa, ba, x_, key, lbl)
+                return y
+            _, vjp = jax.vjp(f, x)
+            (gx,) = vjp(gy)
+            return gx
+
         fwd = jax.jit(fwd_fn)
         bwd_b = jax.jit(bwd_both,
                         out_shardings=(grad_shardings, x_sharding))
         bwd_p = jax.jit(bwd_params, out_shardings=grad_shardings)
-        return fwd, bwd_b, bwd_p
+        bwd_x = jax.jit(bwd_input, out_shardings=x_sharding)
+        return fwd, bwd_b, bwd_p, bwd_x
 
     def executables(self, x_arr, label_arr, train):
         key = self._sig(x_arr, label_arr, train)
@@ -162,11 +177,28 @@ class _Stage:
 
 def _stage_op_sequence(schedule: str, s: int, P_: int, M: int):
     """Per-stage op order. 1F1B: warmup fwds then alternate (the reference's
-    forward_backward_pipeline:575 structure); gpipe: all F then all B."""
+    forward_backward_pipeline:575 structure); gpipe: all F then all B;
+    zbh1: 1F1B with B split into BX (input grad, critical path) and BW
+    (weight grad) — BW ops are queued late so the dependency dispatcher
+    slides them into slots where the stage would otherwise wait for a
+    downstream cotangent (reference:
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py)."""
     if schedule == "gpipe":
         return [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
     w = min(M, P_ - s - 1)
     seq = [("F", m) for m in range(w)]
+    if schedule == "zbh1":
+        fm, xm, wm = w, 0, 0
+        while fm < M:             # steady state: F / BX pairs
+            seq.append(("F", fm)); fm += 1
+            seq.append(("BX", xm)); xm += 1
+        while xm < M:             # cooldown: BX chain + BW bubble-fill
+            seq.append(("BX", xm)); xm += 1
+            if wm < xm - 1:       # keep one BW in reserve for reordering
+                seq.append(("BW", wm)); wm += 1
+        while wm < M:
+            seq.append(("BW", wm)); wm += 1
+        return seq
     fm, bm = w, 0
     while fm < M or bm < M:
         if fm < M:
@@ -198,8 +230,11 @@ class PipelineEngine:
         self.P = pipe_layer.get_num_stages()
         self.P_phys = pipe_layer.get_num_physical_stages()
         self.V = self.P // self.P_phys
-        self.schedule = schedule.lower().replace("-", "")
-        if self.schedule not in ("1f1b", "gpipe", "fthenb", "interleave"):
+        self.schedule = schedule.lower().replace("-", "").replace("_", "")
+        if self.schedule in ("zb", "zerobubble", "zbh1"):
+            self.schedule = "zbh1"
+        if self.schedule not in ("1f1b", "gpipe", "fthenb", "interleave",
+                                 "zbh1"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
         if self.schedule == "fthenb":
             self.schedule = "gpipe"
@@ -252,8 +287,9 @@ class PipelineEngine:
         mb_x = self._split_micro(x_arr)
         mb_y = self._split_micro(y_arr)
 
-        seqs = {s: list(_stage_op_sequence(
-            "gpipe" if self.schedule == "gpipe" else "1f1b", s, P_, M))
+        seqs = {s: list(_stage_op_sequence(self.schedule if self.schedule in
+                                           ("gpipe", "zbh1") else "1f1b",
+                                           s, P_, M))
             for s in range(P_)}
         done = set()
         # per-(stage, mb) saved state for backward recompute
@@ -261,17 +297,25 @@ class PipelineEngine:
         buf_in: Dict[Tuple[int, int], List] = {}
         keys: Dict[Tuple[int, int], Any] = {}
         gy_buf: Dict[Tuple[int, int], Any] = {}
+        gy_saved: Dict[Tuple[int, int], Any] = {}
         y_dtype: Dict[Tuple[int, int], Any] = {}
         grad_acc: List[Optional[List]] = [None] * P_
         buf_state = [[b._data for b in st.buffers] for st in self.stages]
         losses = []
+        self.last_dispatch_order: List[Tuple[int, str, int]] = []
 
         def deps_met(s, kind, m):
             if kind == "F":
                 return s == 0 or ("F", s - 1, m) in done
+            if kind == "BW":
+                # dw only needs this stage's saved activations + cotangent;
+                # BX (the critical path) must have consumed gy first
+                return ("BX", s, m) in done
+            # B / BX need this stage's forward and the downstream cotangent
             ok = ("F", s, m) in done
             if s < P_ - 1:
-                ok = ok and ("B", s + 1, m) in done
+                ok = ok and (("B", s + 1, m) in done
+                             or ("BX", s + 1, m) in done)
             return ok
 
         def run_fwd(s, m):
@@ -287,7 +331,7 @@ class PipelineEngine:
             x_in[(s, m)] = x
             buf_in[(s, m)] = buf_state[s]
             keys[(s, m)] = key
-            fwd, _, _ = st.executables(x, lbl, train)
+            fwd, _, _, _ = st.executables(x, lbl, train)
             y, new_buf = fwd(list(p._data for p in st.params),
                              buf_state[s], x, key, lbl)
             buf_state[s] = new_buf
@@ -298,17 +342,22 @@ class PipelineEngine:
                 x_in[(s + 1, m)] = self.stages[s + 1].put_input(y)
             return y
 
+        def _gy_of(s, m):
+            st = self.stages[s]
+            if st.loss_fn is not None:
+                return jnp.asarray(loss_scale / M, y_dtype[(s, m)])
+            return gy_buf[(s, m)]
+
         def run_bwd(s, m):
+            """Monolithic B (1F1B/GPipe): dx + dw in one recompute."""
             st = self.stages[s]
             x = x_in.pop((s, m))
             bufs = buf_in.pop((s, m))
             key = keys.pop((s, m))
             lbl = mb_y[m] if st.loss_fn is not None else None
-            if st.loss_fn is not None:
-                gy = jnp.asarray(loss_scale / M, y_dtype.pop((s, m)))
-            else:
-                gy = gy_buf.pop((s, m))
-            _, bwd_b, bwd_p = st.executables(x, lbl, train)
+            gy = _gy_of(s, m)
+            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
+            _, bwd_b, bwd_p, _ = st.executables(x, lbl, train)
             pa = list(p._data for p in st.params)
             if s == 0:
                 gp = bwd_p(pa, bufs, x, gy, key, lbl)
@@ -320,8 +369,53 @@ class PipelineEngine:
             else:
                 grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
 
-        # dependency-driven round-robin dispatch (deadlock-free for both
-        # orders: each stage's head op becomes runnable once its producer ran)
+        def run_bx(s, m):
+            """ZB input-grad phase: unblocks stage s-1 as early as possible;
+            activations/gy stay saved for the BW phase."""
+            st = self.stages[s]
+            x = x_in[(s, m)]
+            bufs = buf_in[(s, m)]
+            key = keys[(s, m)]
+            lbl = mb_y[m] if st.loss_fn is not None else None
+            gy = _gy_of(s, m)
+            gy_saved[(s, m)] = gy
+            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
+            if s > 0:
+                _, _, _, bwd_x = st.executables(x, lbl, train)
+                gx = bwd_x(list(p._data for p in st.params), bufs, x, gy,
+                           key, lbl)
+                gy_buf[(s - 1, m)] = self.stages[s - 1].put_input(gx)
+
+        def run_bw(s, m):
+            """ZB weight-grad phase: fills former-bubble slots."""
+            st = self.stages[s]
+            x = x_in.pop((s, m))
+            bufs = buf_in.pop((s, m))
+            key = keys.pop((s, m))
+            lbl = mb_y[m] if st.loss_fn is not None else None
+            gy = gy_saved.pop((s, m))
+            _, _, bwd_p, _ = st.executables(x, lbl, train)
+            gp = bwd_p(list(p._data for p in st.params), bufs, x, gy, key,
+                       lbl)
+            if grad_acc[s] is None:
+                grad_acc[s] = list(gp)
+            else:
+                grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
+
+        RUN = {"F": run_fwd, "B": run_bwd, "BX": run_bx, "BW": run_bw}
+
+        def dispatch(s, i):
+            kind, m = seqs[s].pop(i)
+            if kind == "F" or train:
+                RUN[kind](s, m)
+            done.add((kind, s, m))
+            self.last_dispatch_order.append((s, kind, m))
+
+        # dependency-driven round-robin dispatch (deadlock-free for every
+        # order: each stage's head op becomes runnable once its producer
+        # ran). ZB twist: when a stage's head op is blocked (waiting on a
+        # downstream cotangent), a queued BW whose deps are met runs
+        # instead — dw genuinely fills the bubble slot.
         remaining = sum(len(v) for v in seqs.values())
         while remaining:
             progressed = False
@@ -329,16 +423,18 @@ class PipelineEngine:
                 if not seqs[s]:
                     continue
                 kind, m = seqs[s][0]
-                if not deps_met(s, kind, m):
+                if deps_met(s, kind, m):
+                    dispatch(s, 0)
+                    remaining -= 1
+                    progressed = True
                     continue
-                seqs[s].pop(0)
-                remaining -= 1
-                progressed = True
-                if kind == "F":
-                    run_fwd(s, m)
-                elif train:
-                    run_bwd(s, m)
-                done.add((kind, s, m))
+                # head blocked: opportunistic BW fill (zbh1 only)
+                for i, (k2, m2) in enumerate(seqs[s]):
+                    if k2 == "BW" and deps_met(s, k2, m2):
+                        dispatch(s, i)
+                        remaining -= 1
+                        progressed = True
+                        break
             if not progressed:
                 raise RuntimeError("pipeline schedule deadlocked (bug)")
 

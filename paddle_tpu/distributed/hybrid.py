@@ -509,9 +509,25 @@ def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1):
+def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1, loss_fn=None):
     """Jitted loss-only step (no grads) with the same sharding layout.
-    cfg: LlamaConfig; Layers use GenericHybridEngine.eval_batch."""
+    cfg: LlamaConfig (flagship path) or any nn.Layer (routes to the
+    generic engine, mirroring make_train_step)."""
+    if not isinstance(cfg, L.LlamaConfig):
+        from .hybrid_generic import GenericHybridEngine
+
+        if loss_fn is None and getattr(cfg, "_loss_fn", None) is not None:
+            loss_fn = cfg._loss_fn
+        if loss_fn is None:
+            raise ValueError("make_eval_step(Layer, ...) needs loss_fn=")
+        eng = GenericHybridEngine(cfg, mesh, loss_fn,
+                                  num_microbatches=num_microbatches)
+
+        def step(x, labels):
+            return eng.eval_batch(x, labels)
+
+        step.engine = eng
+        return step
     dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
     shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, cp,

@@ -172,10 +172,11 @@ class GenericHybridEngine:
         else:
             self._stages = None
 
-        self._apply, params0, buffers0 = functionalize(model)
         self._param_ts = dict(model.named_parameters())
         self._buffer_ts = {n: b for n, b in model.named_buffers()
                            if b is not None}
+        params0 = {n: t._data for n, t in self._param_ts.items()}
+        buffers0 = {n: t._data for n, t in self._buffer_ts.items()}
         tp_specs = (generic_tp_specs(model, self.tp, self._tp_axis)
                     if self.tp > 1 and self._tp_axis else {})
         self._specs = {n: tp_specs.get(n, P()) for n in params0}

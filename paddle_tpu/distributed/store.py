@@ -160,10 +160,18 @@ class _PyStoreClient:
         self._req(4, key)
 
     def close(self):
+        # serialize with an in-flight _req when possible: closing mid-request
+        # turns the requester's recv into a spurious ConnectionError on
+        # another thread. Bounded acquire — a thread stuck in a BLOCKING get
+        # must still be interruptible by close (no deadlock).
+        acquired = self._lock.acquire(timeout=0.5)
         try:
             self._sock.close()
         except OSError:
             pass
+        finally:
+            if acquired:
+                self._lock.release()
 
 
 class TCPStore:

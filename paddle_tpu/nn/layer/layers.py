@@ -101,6 +101,10 @@ class Layer:
     def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = Tensor(tensor)
+        if tensor is not None:
+            # static-graph recording reads buffers as named mutable state
+            # (not baked consts) — see static/graph.py GraphRecorder
+            tensor._is_buffer = True
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)

@@ -58,8 +58,15 @@ class _BatchNormBase(Layer):
             )
             with no_grad():
                 m = self._momentum
-                self._mean._data = m * self._mean._data + (1 - m) * batch_mean._data
-                self._variance._data = m * self._variance._data + (1 - m) * batch_var._data
+                # Tensor-op arithmetic (not raw ._data) so static recording
+                # captures the update; buffer_assign registers the write as
+                # a tape state output (MeanOut/VarianceOut semantics)
+                from ...ops.dispatch import buffer_assign
+
+                buffer_assign(self._mean,
+                              self._mean * m + batch_mean * (1 - m))
+                buffer_assign(self._variance,
+                              self._variance * m + batch_var * (1 - m))
             return out
         return _C_ops.batch_norm_infer(
             x, self._mean, self._variance, self.weight, self.bias, self._epsilon, self._data_format

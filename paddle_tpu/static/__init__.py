@@ -36,6 +36,13 @@ class Program:
         self.feed_names: Dict[str, Tensor] = {}
         self.params: Dict[str, "Parameter"] = {}
         self._param_keys: Dict[int, str] = {}
+        # mutable non-trainable state (BN running stats): read as inputs,
+        # writes recorded as state outputs the Executor rebinds (reference:
+        # batch_norm's MeanOut/VarianceOut outputs, infermeta/multiary.cc)
+        self.buffers: Dict[str, Tensor] = {}
+        self._buffer_keys: Dict[int, str] = {}
+        self.buffer_writes: Dict[str, int] = {}      # key -> var id
+        self._buffer_binding: Dict[int, int] = {}    # id(tensor) -> var id
         self.next_id = 0
         self.random_seed = None
         # training extension (append_backward / minimize)
@@ -51,6 +58,24 @@ class Program:
             self._param_keys[id(p)] = key
             self.params[key] = p
         return key
+
+    def register_buffer(self, t) -> str:
+        key = self._buffer_keys.get(id(t))
+        if key is None:
+            key = getattr(t, "name", None) or f"buffer_{len(self.buffers)}"
+            if key in self.buffers and self.buffers[key] is not t:
+                key = f"{key}_{len(self.buffers)}"
+            self._buffer_keys[id(t)] = key
+            self.buffers[key] = t
+        return key
+
+    def note_buffer_write(self, t, var_id: int):
+        """A recorded op's output becomes this buffer's new value: later
+        reads in the tape resolve to the written var, and Executor.run
+        returns-and-rebinds it (the MeanOut/VarianceOut contract)."""
+        key = self.register_buffer(t)
+        self.buffer_writes[key] = var_id
+        self._buffer_binding[id(t)] = var_id
 
     def global_block(self):
         return self
@@ -196,6 +221,7 @@ class Executor:
         feeds = {k: np.asarray(v._data if isinstance(v, Tensor) else v)
                  for k, v in feed.items()}
         params = {k: p._data for k, p in program.params.items()}
+        buffers = {k: b._data for k, b in program.buffers.items()}
         training = (program._optimizer is not None
                     and program._loss_id is not None)
         key = (id(program), tuple(sorted(
@@ -208,12 +234,17 @@ class Executor:
 
         if training:
             state = self._opt_states.get(id(program))
-            new_params, state, fetches = step(params, state, feeds)
+            new_params, state, fetches, new_buffers = step(
+                params, state, feeds, buffers)
             self._opt_states[id(program)] = state
             for k, p in program.params.items():
                 p._data = new_params[k]
         else:
-            fetches = step(params, feeds)
+            fetches, new_buffers = step(params, feeds, buffers)
+        # rebind written mutable state (BN running stats persist across
+        # Executor.run calls, matching dygraph semantics)
+        for k, v in new_buffers.items():
+            program.buffers[k]._data = v
         if return_numpy:
             return [np.asarray(jax.device_get(o)) for o in fetches]
         return [Tensor._from_data(o) for o in fetches]
@@ -224,8 +255,8 @@ class Executor:
         from .graph import replay
 
         if not training:
-            def fwd(params, feeds):
-                return replay(program, feeds, params, fetch_ids)
+            def fwd(params, feeds, buffers):
+                return replay(program, feeds, params, fetch_ids, buffers)
 
             return jax.jit(fwd)
 
@@ -237,24 +268,25 @@ class Executor:
                      if getattr(p, "trainable", True)
                      and not p.stop_gradient}
 
-        def train(params, opt_state, feeds):
+        def train(params, opt_state, feeds, buffers):
             if opt_state is None:
                 opt_state = init_opt({k: params[k] for k in trainable})
 
             def loss_of(tp):
                 merged = dict(params)
                 merged.update(tp)
-                outs = replay(program, feeds, merged,
-                              [loss_id] + list(fetch_ids))
-                return outs[0].mean(), outs[1:]
+                outs, new_buffers = replay(program, feeds, merged,
+                                           [loss_id] + list(fetch_ids),
+                                           buffers)
+                return outs[0].mean(), (outs[1:], new_buffers)
 
             tp = {k: params[k] for k in trainable}
-            (loss, fetches), grads = jax.value_and_grad(
+            (loss, (fetches, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(tp)
             new_tp, opt_state = update(tp, grads, opt_state)
             merged = dict(params)
             merged.update(new_tp)
-            return merged, opt_state, fetches
+            return merged, opt_state, fetches, new_buffers
 
         return jax.jit(train)
 

@@ -61,6 +61,14 @@ class GraphRecorder:
             elif isinstance(t, Parameter):
                 key = prog.register_param(t)
                 refs.append(("param", key))
+            elif getattr(t, "_is_buffer", False):
+                # mutable state: reads resolve to the latest in-tape write
+                # (if any), else to the buffers input dict
+                bvid = prog._buffer_binding.get(id(t))
+                if bvid is not None:
+                    refs.append(("var", bvid))
+                else:
+                    refs.append(("buffer", prog.register_buffer(t)))
             else:
                 prog.consts.append(np.asarray(t._data))
                 refs.append(("const", len(prog.consts) - 1))
@@ -82,9 +90,13 @@ class GraphRecorder:
 
 
 def replay(program, feeds: Dict[str, Any], params: Dict[str, Any],
-           fetch_ids: List[int]) -> List[Any]:
-    """Pure function of (feeds, params): walk the tape, return fetches.
-    Traced under jit by the Executor — this IS the compiled Program."""
+           fetch_ids: List[int],
+           buffers: Optional[Dict[str, Any]] = None):
+    """Pure function of (feeds, params, buffers): walk the tape, return
+    (fetches, new_buffers). Traced under jit by the Executor — this IS the
+    compiled Program. new_buffers carries the final value of every
+    written buffer (BN running stats) so the caller can rebind them."""
+    buffers = buffers or {}
     env: Dict[int, Any] = {}
     for rec in program.records:
         leaves = list(rec.const_leaves)
@@ -97,6 +109,8 @@ def replay(program, feeds: Dict[str, Any], params: Dict[str, Any],
                 arr = feeds[key]
             elif kind == "param":
                 arr = params[key]
+            elif kind == "buffer":
+                arr = buffers[key]
             else:
                 arr = program.consts[key]
             # kernels take raw arrays (dispatch unwraps Tensors the same way)
@@ -107,4 +121,5 @@ def replay(program, feeds: Dict[str, Any], params: Dict[str, Any],
         for oid, o in zip(rec.out_ids, out_leaves):
             if oid is not None:
                 env[oid] = o
-    return [env[i] for i in fetch_ids]
+    new_buffers = {k: env[v] for k, v in program.buffer_writes.items()}
+    return [env[i] for i in fetch_ids], new_buffers

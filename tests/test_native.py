@@ -47,8 +47,14 @@ def test_tcpstore_set_get_add(use_native):
             time.sleep(0.2)
             master.set("later", b"v")
 
-        threading.Thread(target=later).start()
+        t = threading.Thread(target=later)
+        t.start()
         assert client.get("later") == b"v"
+        # join BEFORE stop(): the waiting get wakes as soon as the server
+        # applies the set, which can be before the setter has read its ack —
+        # closing the master socket then races the in-flight _req (the
+        # unhandled-thread-exception shape of the r01 TCPStore GET race)
+        t.join()
     finally:
         client.stop()
         master.stop()

@@ -111,3 +111,92 @@ def test_disable_static_restores_eager():
     out = t + 1.0  # must not record anywhere / must execute eagerly
     np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 2)))
     assert static.default_main_program() is not None
+
+
+class TestStaticBuffers:
+    """VERDICT r3 Weak #3 / task #5: BN running stats thread through the
+    tape as state outputs (reference batch_norm MeanOut/VarianceOut,
+    paddle/phi/infermeta/multiary.cc BatchNormInferMeta)."""
+
+    def test_bn_running_stats_match_dygraph(self):
+        import numpy as np
+        rs = np.random.RandomState(0)
+        xs = [rs.randn(8, 1, 4, 4).astype(np.float32) for _ in range(3)]
+        ys = [rs.randint(0, 3, (8,)).astype(np.int64) for _ in range(3)]
+
+        def build():
+            paddle.seed(0)
+            return paddle.nn.Sequential(
+                paddle.nn.Conv2D(1, 4, 3, padding=1, bias_attr=False),
+                paddle.nn.BatchNorm2D(4), paddle.nn.ReLU(),
+                paddle.nn.Flatten(), paddle.nn.Linear(4 * 16, 3))
+
+        net_dy = build()
+        opt_dy = paddle.optimizer.SGD(learning_rate=0.05,
+                                      parameters=net_dy.parameters())
+        for x, y in zip(xs, ys):
+            loss = paddle.nn.functional.cross_entropy(
+                net_dy(paddle.to_tensor(x)), paddle.to_tensor(y))
+            loss.backward(); opt_dy.step(); opt_dy.clear_grad()
+
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                net = build()
+                xv = paddle.static.data("x", [None, 1, 4, 4])
+                yv = paddle.static.data("y", [None], dtype="int64")
+                loss = paddle.nn.functional.cross_entropy(net(xv), yv)
+                opt = paddle.optimizer.SGD(learning_rate=0.05)
+                opt.minimize(loss)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            losses = []
+            for x, y in zip(xs, ys):
+                out = exe.run(main, feed={"x": x, "y": y},
+                              fetch_list=[loss])
+                losses.append(float(out[0]))
+        finally:
+            paddle.disable_static()
+        # the write IS on the tape
+        assert main.buffer_writes
+        np.testing.assert_allclose(net_dy[1]._mean.numpy(),
+                                   net[1]._mean.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(net_dy[1]._variance.numpy(),
+                                   net[1]._variance.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert losses[-1] < losses[0]
+
+    def test_bn_eval_uses_trained_stats(self):
+        """After static training, an eval-mode (clone for_test analog)
+        forward normalizes with the TRAINED stats, not init values."""
+        import numpy as np
+        rs = np.random.RandomState(1)
+        xs = [rs.randn(16, 4).astype(np.float32) + 3.0 for _ in range(4)]
+
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                paddle.seed(0)
+                bn = paddle.nn.BatchNorm1D(4)
+                xv = paddle.static.data("x", [None, 4])
+                out = bn(xv)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            for x in xs:
+                exe.run(main, feed={"x": x}, fetch_list=[out])
+        finally:
+            paddle.disable_static()
+        # stats moved toward the data's mean=3 / var=1 neighborhood
+        assert float(np.abs(bn._mean.numpy()).max()) > 0.5
+        bn.eval()
+        y = bn(paddle.to_tensor(xs[0]))
+        # with trained mean≈3*decay the eval output is shifted off zero-mean
+        ref_unnorm = (xs[0] - bn._mean.numpy()) / np.sqrt(
+            bn._variance.numpy() + 1e-5)
+        np.testing.assert_allclose(y.numpy(), ref_unnorm, rtol=1e-3,
+                                   atol=1e-3)
