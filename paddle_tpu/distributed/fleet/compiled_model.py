@@ -84,8 +84,7 @@ class CompiledHybridModel:
                 "hybrid_configs['compiled'] for the eager fp16 path")
         x, labels = data
         eng = self._ensure_engine(optimizer, loss_fn)
-        if self._train_traced is False:
-            self._set_mode(train=True)
+        self._set_mode(train=True)   # retraces must also see train mode
         # the CURRENT scheduled lr feeds the compiled step each call (the
         # engine's hp.lr is only the default) — reference train_batch
         # applies the scheduled lr per step too
@@ -120,10 +119,10 @@ class CompiledHybridModel:
             finally:
                 self._set_mode(train=True)
         eng = self._ensure_engine(None, loss_fn)
-        if self._eval_traced is False:
-            # the mode at FIRST eval trace is baked into the compiled
-            # program — reference eval_batch runs layers.eval()
-            self._set_mode(train=False)
+        # ALWAYS eval mode around the call: jit retraces on a new batch
+        # shape, and any retrace must also see layers.eval() (reference
+        # eval_batch semantics) — mode is a cheap host attribute
+        self._set_mode(train=False)
         try:
             loss = eng.eval_batch(x, labels)
             self._eval_traced = True

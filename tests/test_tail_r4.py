@@ -1,0 +1,283 @@
+"""Round-4 op tail (VERDICT r3 Missing #6): torch/numpy cross-checks for
+conv transposes, beam search (+ an E2E seq2seq beam decode), LoD sequence
+ops, lrn, row_conv, fused lstm/gru names, MoE collectives (world-1),
+sparse phi names, strings, chunk_eval, detection_map.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.dispatch import OPS
+from paddle_tpu.ops.kernels import tail_r4 as T
+
+
+rs = np.random.RandomState(0)
+
+
+class TestConvTranspose:
+    def test_conv3d_transpose_torch(self):
+        x = rs.randn(2, 3, 4, 5, 6).astype(np.float32)
+        w = rs.randn(3, 4, 3, 3, 3).astype(np.float32)
+        out = T.conv3d_transpose.__wrapped__(
+            jnp.asarray(x), jnp.asarray(w), strides=2, paddings=1,
+            output_padding=1)
+        ref = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            output_padding=1)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_conv3d_transpose_groups_bias(self):
+        x = rs.randn(1, 4, 3, 3, 3).astype(np.float32)
+        w = rs.randn(4, 2, 2, 2, 2).astype(np.float32)
+        b = rs.randn(4).astype(np.float32)
+        out = T.conv3d_transpose.__wrapped__(
+            jnp.asarray(x), jnp.asarray(w), bias=jnp.asarray(b), groups=2)
+        ref = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x), torch.tensor(w), bias=torch.tensor(b), groups=2)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_depthwise_conv2d_transpose(self):
+        x = rs.randn(2, 4, 5, 5).astype(np.float32)
+        w = rs.randn(4, 1, 3, 3).astype(np.float32)
+        out = T.depthwise_conv2d_transpose.__wrapped__(
+            jnp.asarray(x), jnp.asarray(w), strides=2)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, groups=4)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestBeamSearch:
+    def test_one_step_topk(self):
+        pre_ids = np.full((4, 1), -1)
+        pre_sc = np.zeros((4, 1))
+        sc = np.log(np.asarray([[0.1, 0.9], [0.5, 0.5],
+                                [0.3, 0.7], [0.2, 0.8]]))
+        ids = np.tile(np.asarray([[10, 11]]), (4, 1))
+        si, ss, par = T.beam_search.__wrapped__(
+            pre_ids, pre_sc, ids, sc, beam_size=2, end_id=0)
+        assert np.asarray(si).ravel().tolist() == [11, 10, 11, 11]
+        assert np.asarray(par).tolist() == [0, 1, 3, 2]
+
+    def test_finished_beam_kept(self):
+        pre_ids = np.asarray([[7], [0]])       # row 1 finished (end_id 0)
+        pre_sc = np.asarray([[-1.0], [-0.1]])
+        sc = np.asarray([[-2.0, -3.0], [-9.0, -9.0]])
+        ids = np.asarray([[5, 6], [5, 6]])
+        si, ss, par = T.beam_search.__wrapped__(
+            pre_ids, pre_sc, ids, sc, beam_size=2, end_id=0)
+        # the finished beam's (end_id, score) survives as a candidate
+        assert 0 in np.asarray(si).ravel().tolist()
+        assert np.isclose(np.asarray(ss).ravel(), -0.1).any()
+
+    def test_seq2seq_beam_decode_e2e(self):
+        """Greedy-consistent E2E: beam_size=1 beam search over a tiny
+        next-token model must reproduce argmax decoding, and
+        beam_search_decode must backtrack the right sequence."""
+        V, steps = 6, 4
+        trans = rs.rand(V, V).astype(np.float64)
+        trans /= trans.sum(1, keepdims=True)
+        cur = np.asarray([[1]])                 # start token, batch=1 beam=1
+        pre_sc = np.zeros((1, 1))
+        step_ids, step_parents, step_scores = [], [], []
+        for _ in range(steps):
+            probs = trans[np.asarray(cur).ravel()]           # [1, V]
+            si, ss, par = T.beam_search.__wrapped__(
+                cur, pre_sc, np.tile(np.arange(V)[None], (1, 1)) * 0 +
+                np.arange(V)[None], np.log(probs) + np.asarray(pre_sc),
+                beam_size=1, end_id=V - 1)
+            step_ids.append(np.asarray(si).ravel())
+            step_parents.append(np.asarray(par))
+            step_scores.append(np.asarray(ss).ravel())
+            cur, pre_sc = np.asarray(si), np.asarray(ss)
+        seqs, finals = T.beam_search_decode.__wrapped__(
+            step_ids, step_parents, step_scores, beam_size=1, end_id=V - 1)
+        # greedy reference
+        ref, tok = [], 1
+        for _ in range(steps):
+            tok = int(np.argmax(trans[tok]))
+            ref.append(tok)
+        assert np.asarray(seqs)[0].tolist() == ref
+
+    def test_backtrack_parents(self):
+        ids = [np.asarray([3, 4]), np.asarray([5, 6])]
+        parents = [np.asarray([0, 1]), np.asarray([1, 0])]
+        seqs, _ = T.beam_search_decode.__wrapped__(ids, parents)
+        # slot 0 at t=1 came from row 1 at t=0 -> [4, 5]
+        assert np.asarray(seqs)[0].tolist() == [4, 5]
+        assert np.asarray(seqs)[1].tolist() == [3, 6]
+
+
+class TestSequenceOps:
+    def test_sequence_softmax(self):
+        x = rs.randn(7).astype(np.float32)
+        out = np.asarray(T.sequence_softmax.__wrapped__(
+            jnp.asarray(x), [0, 3, 7]))
+        for lo, hi in ((0, 3), (3, 7)):
+            ref = np.exp(x[lo:hi] - x[lo:hi].max())
+            ref /= ref.sum()
+            np.testing.assert_allclose(out[lo:hi], ref, rtol=1e-5)
+            np.testing.assert_allclose(out[lo:hi].sum(), 1.0, rtol=1e-5)
+
+    def test_sequence_expand(self):
+        x = np.arange(8.0).reshape(4, 2).astype(np.float32)
+        out = np.asarray(T.sequence_expand.__wrapped__(
+            jnp.asarray(x), [0, 2, 5], x_lod=[0, 1, 4]))
+        # seq0 (row 0) x2, seq1 (rows 1-3) x3
+        assert out.shape == (11, 2)
+        np.testing.assert_allclose(out[:2], x[[0, 0]])
+        np.testing.assert_allclose(out[2:], np.tile(x[1:4], (3, 1)))
+
+    def test_sequence_conv_respects_boundaries(self):
+        x = rs.randn(5, 3).astype(np.float32)
+        w = rs.randn(9, 2).astype(np.float32)  # context 3 * D 3 -> 2
+        out = np.asarray(T.sequence_conv.__wrapped__(
+            jnp.asarray(x), jnp.asarray(w), [0, 2, 5], context_length=3,
+            context_start=-1))
+        # row 0: context [-1,0,1] -> [0, x0, x1] (row -1 out of seq)
+        ref0 = np.concatenate([np.zeros(3, np.float32), x[0], x[1]]) @ w
+        np.testing.assert_allclose(out[0], ref0, rtol=1e-5, atol=1e-5)
+        # row 1 is the END of sequence 0:右 context is zero, NOT x[2]
+        ref1 = np.concatenate([x[0], x[1], np.zeros(3, np.float32)]) @ w
+        np.testing.assert_allclose(out[1], ref1, rtol=1e-5, atol=1e-5)
+
+    def test_sequence_pad_unpad_roundtrip(self):
+        x = rs.randn(5, 3).astype(np.float32)
+        padded, lens = T.sequence_pad.__wrapped__(
+            jnp.asarray(x), 0.0, [0, 2, 5])
+        assert padded.shape == (2, 3, 3)
+        back = np.asarray(T.sequence_unpad.__wrapped__(padded, lens))
+        np.testing.assert_allclose(back, x)
+
+
+class TestLrnRowConv:
+    def test_lrn_torch(self):
+        x = rs.randn(2, 8, 4, 4).astype(np.float32)
+        out = T.lrn.__wrapped__(jnp.asarray(x), n=5, k=2.0, alpha=1e-4,
+                                beta=0.75)
+        # torch divides alpha by size — paddle's lrn does not
+        ref = torch.nn.functional.local_response_norm(
+            torch.tensor(x), size=5, alpha=5 * 1e-4, beta=0.75, k=2.0)
+        np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_row_conv_batched_and_lod(self):
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        f = rs.randn(2, 3).astype(np.float32)
+        out = np.asarray(T.row_conv.__wrapped__(jnp.asarray(x),
+                                                jnp.asarray(f)))
+        np.testing.assert_allclose(out[0, 1], x[0, 1] * f[0] + x[0, 2] * f[1],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[1, 4], x[1, 4] * f[0], rtol=1e-5)
+        flat = x[0]
+        out2 = np.asarray(T.row_conv.__wrapped__(
+            jnp.asarray(flat), jnp.asarray(f), lod=[0, 2, 5]))
+        # row 1 ends sequence 0: no lookahead into row 2
+        np.testing.assert_allclose(out2[1], flat[1] * f[0], rtol=1e-5)
+
+
+class TestFusedRnnNames:
+    def test_lstm_torch_parity(self):
+        B, Ti, I, H = 2, 3, 4, 5
+        x = rs.randn(B, Ti, I).astype(np.float32)
+        wih = (rs.randn(4 * H, I) * 0.1).astype(np.float32)
+        whh = (rs.randn(4 * H, H) * 0.1).astype(np.float32)
+        out, h, c = T.lstm_fused.__wrapped__(
+            jnp.asarray(x), jnp.zeros((1, B, H)), jnp.zeros((1, B, H)),
+            jnp.asarray(wih), jnp.asarray(whh))
+        ref = torch.nn.LSTM(I, H, batch_first=True)
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.tensor(wih))
+            ref.weight_hh_l0.copy_(torch.tensor(whh))
+            ref.bias_ih_l0.zero_(); ref.bias_hh_l0.zero_()
+        ro, _ = ref(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), ro.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_torch_parity(self):
+        B, Ti, I, H = 2, 3, 4, 5
+        x = rs.randn(B, Ti, I).astype(np.float32)
+        wih = (rs.randn(3 * H, I) * 0.1).astype(np.float32)
+        whh = (rs.randn(3 * H, H) * 0.1).astype(np.float32)
+        out, h = T.gru_fused.__wrapped__(
+            jnp.asarray(x), jnp.zeros((1, B, H)), jnp.asarray(wih),
+            jnp.asarray(whh))
+        ref = torch.nn.GRU(I, H, batch_first=True)
+        with torch.no_grad():
+            ref.weight_ih_l0.copy_(torch.tensor(wih))
+            ref.weight_hh_l0.copy_(torch.tensor(whh))
+            ref.bias_ih_l0.zero_(); ref.bias_hh_l0.zero_()
+        ro, _ = ref(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), ro.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestMoeCollectives:
+    def test_world1_identity(self):
+        x = rs.randn(6, 4).astype(np.float32)
+        lc = np.asarray([4, 2])
+        out = OPS["global_scatter"](paddle.to_tensor(x), lc, lc)
+        np.testing.assert_allclose(out.numpy(), x)
+        back = OPS["global_gather"](out, lc, lc)
+        np.testing.assert_allclose(back.numpy(), x)
+
+
+class TestSparseNames:
+    def test_roundtrip_and_coalesce(self):
+        d = paddle.to_tensor(np.asarray([[0.0, 1.0], [2.0, 0.0]],
+                                        np.float32))
+        coo = OPS["to_sparse_coo"](d, 2)
+        assert coo.nnz == 2
+        np.testing.assert_allclose(OPS["to_dense"](coo).numpy(), d.numpy())
+        csr = OPS["to_sparse_csr"](d)
+        np.testing.assert_allclose(csr.to_dense().numpy(), d.numpy())
+        cl = OPS["coalesce"](coo)
+        np.testing.assert_allclose(cl.to_dense().numpy(), d.numpy())
+        # Tensor method patching resolves to these ops
+        assert type(d.to_sparse_coo(2)).__name__ == "SparseCooTensor"
+
+
+class TestStringsAndMetrics:
+    def test_lower_upper(self):
+        arr = np.asarray(["AbC", "XYZ"])
+        assert OPS["lower"](arr).tolist() == ["abc", "xyz"]
+        assert OPS["upper"](arr).tolist() == ["ABC", "XYZ"]
+        with pytest.raises(TypeError):
+            OPS["lower"](np.zeros(3))
+
+    def test_chunk_eval_iob(self):
+        # types=2, IOB: B0=0 I0=1 B1=2 I1=3, O=anything else
+        inf = [0, 1, 4, 2, 3]
+        lab = [0, 1, 4, 2, 3]
+        p, r, f1, ni, nl, nc = T.chunk_eval.__wrapped__(inf, lab, 2)
+        assert (float(p), float(r), float(f1)) == (1.0, 1.0, 1.0)
+        assert int(ni) == int(nl) == int(nc) == 2
+        # one wrong chunk boundary
+        inf2 = [0, 4, 4, 2, 3]
+        p2, r2, f2, ni2, nl2, nc2 = T.chunk_eval.__wrapped__(inf2, lab, 2)
+        assert int(nc2) == 1 and int(nl2) == 2
+        assert abs(float(r2) - 0.5) < 1e-6
+
+    def test_chunk_eval_iobes(self):
+        # IOBES: B=0 I=1 E=2 S=3 per type; type0: 0..3
+        inf = [0, 1, 2, 3]        # chunk (0,3) + single (3,4)
+        p, r, f1, ni, nl, nc = T.chunk_eval.__wrapped__(inf, inf, 1,
+                                                        chunk_scheme="IOBES")
+        assert float(f1) == 1.0 and int(ni) == 2
+
+    def test_detection_map_perfect_and_miss(self):
+        gt = np.asarray([[1, 10, 10, 20, 20], [2, 30, 30, 40, 40]],
+                        np.float32)
+        det_good = np.asarray([[1, 0.9, 10, 10, 20, 20],
+                               [2, 0.8, 30, 30, 40, 40]], np.float32)
+        m = T.detection_map.__wrapped__(det_good, gt, num_classes=3)
+        assert abs(float(m) - 1.0) < 1e-6
+        det_bad = np.asarray([[1, 0.9, 100, 100, 120, 120],
+                              [2, 0.8, 30, 30, 40, 40]], np.float32)
+        m2 = T.detection_map.__wrapped__(det_bad, gt, num_classes=3)
+        assert float(m2) < 1.0
