@@ -326,14 +326,41 @@ class Engine:
 
     def prepare(self, sample_x: np.ndarray, sample_y: np.ndarray = None,
                 mode: str = "train"):
-        """Plan the mesh and compile the step for `mode`."""
+        """Plan the mesh and compile the step for `mode`.
+
+        Plan EXECUTION (VERDICT r3 task #6): tp>1 / pp>1 plans are applied
+        to the generic model through the compiled hybrid engine
+        (distributed/hybrid_generic.py) — tp via GSPMD sharding rules on
+        Linear/Embedding/Conv params, pp via the model's PipelineLayer
+        segmentation; dp-only plans keep the GSPMD-jit path below."""
         flops, pbytes, act = self._estimate_sizes(sample_x)
         self._plan = self.planner.plan(
             self.strategy, flops_per_batch=flops, param_bytes=pbytes,
             act_bytes_per_microbatch=act)
-        # generic Layers: dp (+ ZeRO sharding); tp/pp plans belong to the
-        # model-specific hybrid engine
-        dp = self._plan.dp * self._plan.tp * self._plan.pp
+        plan = self._plan
+        self._hybrid = None
+        if plan.tp > 1 or plan.pp > 1:
+            from ..hybrid_generic import GenericHybridEngine
+            from ..fleet.meta_parallel.parallel_layers.pp_layers import (
+                PipelineLayer)
+            from ..fleet.compiled_model import _hp_from_optimizer
+
+            pp = plan.pp
+            dp = plan.dp
+            if pp > 1 and not isinstance(self.model, PipelineLayer):
+                # an un-segmented model cannot pipeline: fold pp into dp so
+                # the plan's degree is still used rather than wasted
+                dp, pp = dp * pp, 1
+            n = dp * pp * plan.tp
+            devices = np.asarray(jax.devices()[:n]).reshape(dp, pp, plan.tp)
+            mesh = Mesh(devices, ("dp", "pp", "tp"))
+            self._mesh = mesh
+            self._hybrid = GenericHybridEngine(
+                self.model, mesh, self.loss_fn,
+                hp=_hp_from_optimizer(self.optimizer),
+                num_microbatches=max(1, plan.micro_batches))
+            return self
+        dp = plan.dp * plan.tp * plan.pp
         devices = np.array(jax.devices()[:dp])
         self._mesh = Mesh(devices, ("dp",))
         self._params = self._param_tree()
@@ -431,11 +458,17 @@ class Engine:
                 x = np.asarray(x)
                 y = np.asarray(y)
                 if first:
-                    if self._plan is None or "train" not in self._steps:
+                    if self._plan is None or (
+                            getattr(self, "_hybrid", None) is None
+                            and "train" not in self._steps):
                         self.prepare(x, y, mode="train")
                     first = False
-                self._params, self._opt_state, loss = self._steps["train"](
-                    self._params, self._opt_state, x, y)
+                if getattr(self, "_hybrid", None) is not None:
+                    loss = self._hybrid.train_batch(x, y)
+                else:
+                    self._params, self._opt_state, loss = \
+                        self._steps["train"](self._params, self._opt_state,
+                                             x, y)
                 seen += x.shape[0]
                 if verbose and step % log_freq == 0:
                     rec = {"epoch": epoch, "step": step,
@@ -450,8 +483,31 @@ class Engine:
         for m in self.metrics:
             if hasattr(m, "reset"):
                 m.reset()
+        hybrid = getattr(self, "_hybrid", None)
+        if hybrid is not None and self.metrics:
+            hybrid.sync_to_layer()   # once: metrics run an eager forward
         for x, y in self._batches(eval_data, batch_size):
             x, y = np.asarray(x), np.asarray(y)
+            if hybrid is not None:
+                # eval mode around the call — first call bakes the mode
+                # into the compiled program (hybrid_generic.eval_batch)
+                was_training = getattr(self.model, "training", True)
+                if callable(getattr(self.model, "eval", None)):
+                    self.model.eval()
+                try:
+                    losses.append(hybrid.eval_batch(x, y))
+                finally:
+                    if was_training and callable(
+                            getattr(self.model, "train", None)):
+                        self.model.train()
+                count += x.shape[0]
+                for m in self.metrics:
+                    if hasattr(m, "compute"):
+                        pred = self.model(Tensor._from_data(jnp.asarray(x)))
+                        r = m.compute(pred,
+                                      Tensor._from_data(jnp.asarray(y)))
+                        m.update(r.numpy() if isinstance(r, Tensor) else r)
+                continue
             if "eval" not in self._steps:
                 if self._plan is None:
                     self.prepare(x, y, mode="eval")
@@ -474,9 +530,16 @@ class Engine:
 
     def predict(self, data, batch_size: int = 32):
         outs = []
+        if getattr(self, "_hybrid", None) is not None:
+            self._hybrid.sync_to_layer()   # once, not per batch
         for item in self._batches(data, batch_size):
             x = np.asarray(item[0] if isinstance(item, (tuple, list))
                            else item)
+            if getattr(self, "_hybrid", None) is not None:
+                out = self.model(Tensor._from_data(jnp.asarray(x)))
+                outs.append(np.asarray(out._data if isinstance(out, Tensor)
+                                       else out))
+                continue
             if "predict" not in self._steps:
                 if self._plan is None:
                     self.prepare(x, mode="predict")
@@ -499,6 +562,9 @@ class Engine:
 
     def _writeback(self):
         """Push compiled-step params back into the Layer objects."""
+        if getattr(self, "_hybrid", None) is not None:
+            self._hybrid.sync_to_layer()
+            return
         objs = dict(self.model.named_parameters())
         for n, p in objs.items():
             p._data = self._params[n]

@@ -89,8 +89,8 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
         rows = range(b * beam_size, (b + 1) * beam_size)
         cands = []  # (score, token, parent_row)
         for r in rows:
-            if pre_ids[r] == end_id and pre_scores[r] != 0:
-                cands.append((pre_scores[r], end_id, r))   # finished beam
+            if pre_ids[r] == end_id:       # finished beam holds its score
+                cands.append((pre_scores[r], end_id, r))
                 continue
             for k in range(K):
                 tok = int(cand_ids[r, k]) if cand_ids is not None else k
@@ -147,11 +147,11 @@ def sequence_softmax(x, lod):
                            side="right") - 1
     flat = x.reshape(n, -1).astype(jnp.float32)
     nseg = offs.shape[0] - 1
-    onehot = jax.nn.one_hot(seg, nseg, dtype=jnp.float32)      # [N, S]
-    segmax = jnp.max(jnp.where(onehot.T[:, :, None] > 0, flat[None], -jnp.inf),
-                     axis=1)                                    # [S, D]
+    # O(N·D) segment reductions (no [S,N,D] temporary); LoD sequence ops
+    # are CPU-tier legacy — fine for backends without segment-op lowering.
+    segmax = jax.ops.segment_max(flat, seg, num_segments=nseg)  # [S, D]
     shifted = jnp.exp(flat - segmax[seg])
-    segsum = onehot.T @ shifted                                 # [S, D]
+    segsum = jax.ops.segment_sum(shifted, seg, num_segments=nseg)
     out = shifted / segsum[seg]
     return out.reshape(x.shape).astype(x.dtype)
 

@@ -1,0 +1,62 @@
+"""ops.yaml as a SOURCE of truth (VERDICT r3 task #7 — reverse the arrow).
+
+Reference design: one YAML drives api/bindings/grad codegen
+(`paddle/phi/api/generator/api_gen.py:1`, `eager_gen.py:323`). Here the
+Python API surface (paddle.*, Tensor methods, _C_ops) already reflects the
+registry automatically, so the YAML's authoritative roles are:
+
+1. **signature pin** — `args:` lines fail tests/test_op_schema.py on any
+   drift between manifest and live kernels (both directions);
+2. **harness coverage** — hand-authored `test:` / `opt_out:` fields drive
+   the generated OpTest harness (tests/test_op_generated.py): adding a
+   YAML entry + kernel function auto-exposes API AND coverage with no
+   third touch-point. `test:` is a python dict literal:
+       test: {"inputs": ["sym(2, 3)"], "grad": [0], "bf16": true}
+   where input strings are generator expressions evaluated in the
+   harness's generator namespace (sym/pos/unit/away0/frac01/onehot/...).
+3. **grad-existence** — the `test:` field's `grad` indices declare which
+   inputs are differentiable; the harness finite-differences exactly
+   those.
+
+`tools/gen_op_manifest.py` regenerates the `args:` lines from the live
+registry but PRESERVES the hand-authored `test:`/`opt_out:` fields, so
+the file is simultaneously machine-pinned and human-sourced.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Any, Dict
+
+MANIFEST_PATH = Path(__file__).resolve().parent / "ops.yaml"
+
+_ENTRY = re.compile(r"^- op: (\S+)\s*$")
+_FIELD = re.compile(r"^  (\w+): (.*)$")
+
+
+def load_manifest(path: Path = MANIFEST_PATH) -> Dict[str, Dict[str, Any]]:
+    """Parse ops.yaml → {op: {"args": str, "test": dict|None,
+    "opt_out": str|None}}. The format is a deliberately small YAML
+    subset (flat entries, one-line fields) — no yaml dependency."""
+    out: Dict[str, Dict[str, Any]] = {}
+    cur = None
+    for line in path.read_text().splitlines():
+        m = _ENTRY.match(line)
+        if m:
+            cur = {"args": "", "test": None, "opt_out": None}
+            out[m.group(1)] = cur
+            continue
+        if cur is None:
+            continue
+        f = _FIELD.match(line)
+        if not f:
+            continue
+        key, val = f.group(1), f.group(2).strip()
+        if key == "args":
+            cur["args"] = val
+        elif key == "test":
+            cur["test"] = ast.literal_eval(val)
+        elif key == "opt_out":
+            cur["opt_out"] = val
+    return out

@@ -40,17 +40,30 @@ def sig_args(fn):
     return args
 
 
-def main():
+def main(out_path=None):
+    # the YAML is part hand-authored (test:/opt_out: fields are SOURCE —
+    # see paddle_tpu/ops/schema.py); regeneration refreshes args: lines
+    # from the live registry but preserves those fields
+    from paddle_tpu.ops.schema import load_manifest, MANIFEST_PATH
+
+    try:
+        prev = load_manifest()
+    except FileNotFoundError:
+        prev = {}
     lines = list(HEADER)
     for name in sorted(OPS):
         lines.append(f"- op: {name}")
         lines.append(f"  args: ({', '.join(sig_args(OPS[name]._kernel))})")
-    out = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu", "ops",
-                       "ops.yaml")
-    with open(out, "w") as f:
+        old = prev.get(name) or {}
+        if old.get("test") is not None:
+            lines.append(f"  test: {old['test']!r}")
+        if old.get("opt_out"):
+            lines.append(f"  opt_out: {old['opt_out']}")
+    out_path = out_path or MANIFEST_PATH
+    with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")
-    print(f"{len(OPS)} ops -> {os.path.normpath(out)}")
+    print(f"{len(OPS)} ops -> {out_path}")
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv[1] if len(sys.argv) > 1 else None)
