@@ -185,6 +185,55 @@ def convert_ifexp(pred, true_thunk: Callable, false_thunk: Callable):
     return _merge_leaf(p, true_thunk(), false_thunk())
 
 
+def _seed_undef_slots(cond_fn, body_fn, vals, Tensor):
+    """Replace UNDEF loop-var slots with zeros of the type the body
+    ASSIGNS to them (two-pass: scalar probe -> jax.eval_shape -> seed)."""
+    undef_idx = [i for i, v in enumerate(vals) if v is UNDEF]
+
+    def probe_call(*probe_vals):
+        out = body_fn(*[
+            Tensor._from_data(a) if isinstance(a, jnp.ndarray) else a
+            for a in probe_vals])
+        return tuple(jnp.asarray(_raw(o)) for o in out)
+
+    def mk_probe(fill):
+        return [fill if v is UNDEF
+                else (jnp.asarray(_raw(v)) if isinstance(v, Tensor) else v)
+                for v in vals]
+
+    try:
+        out_avals = jax.eval_shape(probe_call,
+                                   *mk_probe(jnp.zeros((), jnp.float32)))
+        # read-detector: a body that READS an UNDEF slot produces outputs
+        # that depend on the probe's type — re-probe with a distinctive
+        # shape+dtype and require ALL output avals identical (a body that
+        # only ASSIGNS the slot is probe-invariant)
+        out_alt = jax.eval_shape(probe_call,
+                                 *mk_probe(jnp.zeros((2, 3), jnp.int32)))
+        for a, b in zip(out_avals, out_alt):
+            if (a.shape, a.dtype) != (b.shape, b.dtype):
+                raise TypeError(
+                    "the body reads the variable before assigning it")
+        seeded = list(vals)
+        for i in undef_idx:
+            aval = out_avals[i]
+            seeded[i] = Tensor._from_data(
+                jnp.zeros(aval.shape, aval.dtype))
+        # and the carried type must be a fixed point
+        out2 = jax.eval_shape(probe_call, *[
+            jnp.asarray(_raw(v)) if isinstance(v, Tensor) else v
+            for v in seeded])
+        for i in undef_idx:
+            if (out2[i].shape, out2[i].dtype) != (out_avals[i].shape,
+                                                  out_avals[i].dtype):
+                raise TypeError("carried type is not a fixed point")
+        return tuple(seeded)
+    except Exception as e:  # noqa: BLE001 — any probe failure: honest break
+        raise GraphBreak(
+            "a loop variable may be undefined before a traced `while`; "
+            f"initialise it before the loop (type probe failed: {e})") from e
+
+
 def convert_while(cond_fn: Callable, body_fn: Callable,
                   vals: Tuple) -> Tuple:
     """`while cond: body` over the loop-carried variable tuple.
@@ -202,9 +251,13 @@ def convert_while(cond_fn: Callable, body_fn: Callable,
 
     Tensor = _tensor_cls()
     if any(v is UNDEF for v in vals):
-        raise GraphBreak(
-            "a loop variable may be undefined before a traced `while`; "
-            "initialise it before the loop")
+        # body-local loop vars (assigned before any read inside the body —
+        # e.g. an inner loop's counter) reach here UNDEF. Their ENTRY value
+        # is irrelevant, but lax.while_loop needs a typed carry, so probe
+        # the body abstractly once to learn each slot's carried type and
+        # seed zeros of that type. A body that actually READS the slot
+        # fails the probe -> the original graph break.
+        vals = _seed_undef_slots(cond_fn, body_fn, vals, Tensor)
     tags = [isinstance(v, Tensor) for v in vals]
 
     def wrap(arrs):

@@ -328,11 +328,12 @@ def multihead_matmul(input, w, bias=None, bias_qk=None, transpose_qkv=False,
                      alpha=1.0, head_number=1):
     """Reference multihead_matmul (TensorRT-style fused MHA): one packed
     QKV projection + attention + merge. input [B, T, C]; w [C, 3, H, D]."""
-    if transpose_qkv:
-        raise NotImplementedError(
-            "multihead_matmul transpose_qkv=True weight layout is not "
-            "supported; repack the weight to [C, 3, H, D]")
     B, T, C = input.shape
+    if transpose_qkv:
+        # transposed weight layout [3, H, D, C] (the TRT plugin form):
+        # repack to the canonical [C, 3, H, D] before the fused projection
+        D = w.size // (3 * head_number * C)
+        w = jnp.transpose(w.reshape(3, head_number, D, C), (3, 0, 1, 2))
     qkv = jnp.einsum("btc,chnd->bthnd", input,
                      w.reshape(C, 3, head_number, -1))
     if bias is not None:

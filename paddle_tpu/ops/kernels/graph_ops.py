@@ -126,20 +126,21 @@ def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
                        return_eids=False, seed=0):
     """K-hop sampling: iterate sample+frontier-merge, then one reindex
     over all gathered edges (reference graph_khop_sampler_kernel).
-    -> (edge_src, edge_dst, sample_index, reindex_x)."""
-    if return_eids or eids is not None:
-        raise NotImplementedError(
-            "graph_khop_sampler edge-id tracking (eids/return_eids) is "
-            "not implemented; use graph_sample_neighbors per hop for eids")
+    -> (edge_src, edge_dst, sample_index, reindex_x[, edge_eids])."""
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires the eids input")
     frontier = _np(x).reshape(-1)
-    all_src_nodes, all_dst_nodes = [], []
+    all_src_nodes, all_dst_nodes, all_eids = [], [], []
     seen = list(frontier.tolist())
     seen_set = set(seen)
     cur = frontier
     for hop, size in enumerate(tuple(sample_sizes)):
-        nb, cnt = graph_sample_neighbors.__wrapped__(
-            row, colptr, cur, sample_size=size,
-            seed=(seed + hop) if seed else 0)
+        res = graph_sample_neighbors.__wrapped__(
+            row, colptr, cur, eids=eids, sample_size=size,
+            return_eids=return_eids, seed=(seed + hop) if seed else 0)
+        nb, cnt = res[0], res[1]
+        if return_eids:
+            all_eids.append(_np(res[2]))
         nb = _np(nb)
         cnt = _np(cnt)
         all_src_nodes.append(nb)
@@ -163,6 +164,11 @@ def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
                           np.int64)
     reindex_x = np.asarray([mapping[v] for v in frontier.tolist()],
                            np.int64)
-    return (jnp.asarray(edge_src), jnp.asarray(edge_dst),
-            jnp.asarray(np.asarray(seen, frontier.dtype)),
-            jnp.asarray(reindex_x))
+    out = (jnp.asarray(edge_src), jnp.asarray(edge_dst),
+           jnp.asarray(np.asarray(seen, frontier.dtype)),
+           jnp.asarray(reindex_x))
+    if return_eids:
+        ee = (np.concatenate(all_eids) if all_eids
+              else np.zeros(0, np.int64))
+        return out + (jnp.asarray(ee),)
+    return out

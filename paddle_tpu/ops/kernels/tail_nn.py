@@ -146,7 +146,19 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
         counts = np.diff(np.concatenate(
             [np.nonzero(keep)[0], [a.size]]))
     else:
-        raise NotImplementedError("axis-wise unique_consecutive")
+        # axis-wise: consecutive-duplicate SLICES along `axis` collapse
+        ax = axis if axis >= 0 else a.ndim + axis
+        moved = np.moveaxis(a, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        if flat.shape[0] == 0:
+            keep = np.zeros(0, bool)
+        else:
+            keep = np.concatenate(
+                [[True], (flat[1:] != flat[:-1]).any(axis=1)])
+        out = np.moveaxis(moved[keep], 0, ax)
+        inv = np.cumsum(keep) - 1
+        counts = np.diff(np.concatenate(
+            [np.nonzero(keep)[0], [flat.shape[0]]]))
     res = [jnp.asarray(out)]
     if return_inverse:
         res.append(jnp.asarray(inv.astype(dtype)))

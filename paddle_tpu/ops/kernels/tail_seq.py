@@ -60,12 +60,20 @@ def warprnnt(input, label, input_lengths, label_lengths, blank=0,
     """RNN-T loss (reference warprnnt op over the warp-transducer binary;
     here a log-space lattice scan — each anti-step is VPU work, batched
     with vmap). input [B, T, U+1, V] logits."""
-    if fastemit_lambda:
-        raise NotImplementedError(
-            "warprnnt fastemit_lambda != 0 (FastEmit regularization) is "
-            "not implemented; the unregularized loss would silently "
-            "ignore the knob")
     logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    if fastemit_lambda:
+        # FastEmit (arXiv:2010.11148; warp-transducer's implementation):
+        # the loss VALUE is unchanged, but gradients of label-emission
+        # arcs are scaled by (1 + λ). Realized exactly with a
+        # straight-through scale on the label-emission log-probs: value
+        # logp, gradient (1+λ)·dlogp on masked entries.
+        B, T, U1, V = logp.shape
+        lab = label.astype(jnp.int32)
+        emit_mask = jnp.zeros((B, 1, U1, V), logp.dtype)
+        onehot = jax.nn.one_hot(lab, V, dtype=logp.dtype)     # [B, U, V]
+        emit_mask = emit_mask.at[:, 0, :U1 - 1, :].set(onehot[:, :U1 - 1])
+        lam = jnp.asarray(fastemit_lambda, logp.dtype)
+        logp = logp + lam * emit_mask * (logp - jax.lax.stop_gradient(logp))
     nll = jax.vmap(_rnnt_nll, in_axes=(0, 0, 0, 0, None))(
         logp, label.astype(jnp.int32), input_lengths.astype(jnp.int32),
         label_lengths.astype(jnp.int32), blank)
@@ -164,10 +172,13 @@ def auc(predict, label, stat_pos=None, stat_neg=None,
     tot_p = jnp.maximum(tp[-1], 1)
     tot_n = jnp.maximum(fp[-1], 1)
     if curve == "PR":
+        # exact average precision: right-step interpolation
+        # AP = Σ (R_i − R_{i−1}) · P_i (sklearn average_precision_score
+        # semantics), not a trapezoid — PR interpolation between operating
+        # points is known to overestimate (Davis & Goadrich 2006)
         precision = tp / jnp.maximum(tp + fp, 1)
         recall = tp / tot_p
-        area = jnp.sum((recall[1:] - recall[:-1])
-                       * (precision[1:] + precision[:-1]) / 2.0)
+        area = jnp.sum((recall[1:] - recall[:-1]) * precision[1:])
         area = area + recall[0] * precision[0]
     else:  # ROC
         tpr = tp / tot_p

@@ -112,7 +112,9 @@ def test_weighted_sampling_edge_cases_and_eids_contract():
     got_nb = np.asarray(nb2.numpy())
     got_e = np.asarray(out_eids.numpy())
     assert (ROW[got_e - 100] == got_nb).all()
-    with pytest.raises(NotImplementedError, match="edge-id tracking"):
+    # khop eids tracking implemented in round 4 (formerly raised);
+    # without the eids input it still refuses cleanly
+    with pytest.raises(ValueError, match="requires the eids"):
         _C_ops.graph_khop_sampler(_t(ROW), _t(COLPTR),
                                   _t(np.array([0], np.int64)),
                                   sample_sizes=(1,), return_eids=True)
