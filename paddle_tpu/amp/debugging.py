@@ -1,0 +1,162 @@
+"""paddle.amp.debugging parity — per-op numeric stats + accuracy compare.
+
+Reference: python/paddle/amp/debugging.py — operator stats collection
+(`enable_operator_stats_collection` / `disable_...` /
+`collect_operator_stats`), `TensorCheckerConfig` + `enable_tensor_checker`
+(per-op nan/inf watch), and `compare_accuracy` (fp32-vs-low-precision op
+audit). TPU-native: hooks ride the op-dispatch profiler seam
+(ops/dispatch.py) instead of a C++ tracer; the checks run eagerly on the
+dispatched outputs.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "compare_accuracy"]
+
+_STATS: Dict[str, Dict[str, int]] = {}
+_orig_call_op = None
+
+
+def _stat_hook(name, out_leaves):
+    for o in out_leaves:
+        dt = str(getattr(o, "dtype", ""))
+        if not dt:
+            continue
+        rec = _STATS.setdefault(name, {})
+        rec[dt] = rec.get(dt, 0) + 1
+
+
+def _install(hook):
+    """Wrap dispatch.call_op once; hook(name, out_leaves) per op."""
+    global _orig_call_op
+    from ..ops import dispatch
+
+    if _orig_call_op is not None:
+        return
+    _orig_call_op = dispatch.call_op
+
+    def wrapped(name, kernel, args, kwargs, nondiff=False):
+        out = _orig_call_op(name, kernel, args, kwargs, nondiff=nondiff)
+        try:
+            import jax
+
+            leaves = [x._data if hasattr(x, "_data") else x
+                      for x in jax.tree.leaves(
+                          out, is_leaf=lambda t: hasattr(t, "_data"))]
+            hook(name, [l for l in leaves if hasattr(l, "dtype")])
+        except Exception:  # noqa: BLE001 — stats must never break dispatch
+            pass
+        return out
+
+    dispatch.call_op = wrapped
+    # the registry binds call_op at decoration time through the module
+    # namespace, so patching the module attribute reaches every op
+
+
+def _uninstall():
+    global _orig_call_op
+    from ..ops import dispatch
+
+    if _orig_call_op is not None:
+        dispatch.call_op = _orig_call_op
+        _orig_call_op = None
+
+
+def enable_operator_stats_collection():
+    _STATS.clear()
+    _install(_stat_hook)
+
+
+def disable_operator_stats_collection():
+    _uninstall()
+    _print_stats()
+
+
+def _print_stats():
+    if not _STATS:
+        return
+    print("<{:-^120}>".format(" op list "))
+    print("{:<40}  {:<20}  {}".format("op", "dtype", "calls"))
+    for name in sorted(_STATS):
+        for dt, n in sorted(_STATS[name].items()):
+            print(f"{name:<40}  {dt:<20}  {n}")
+    print("<{:-^120}>".format(""))
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+class TensorCheckerConfig:
+    """Reference debugging.py TensorCheckerConfig — subset: enable +
+    debug_mode/checked op allow/deny lists."""
+
+    def __init__(self, enable=True, debug_mode=None, checked_op_list=None,
+                 skipped_op_list=None, **kwargs):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked = set(checked_op_list or [])
+        self.skipped = set(skipped_op_list or [])
+
+
+_checker_cfg: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    global _checker_cfg
+    _checker_cfg = config
+    if not config.enable:
+        return
+
+    def check_hook(name, out_leaves):
+        if config.checked and name not in config.checked:
+            return
+        if name in config.skipped:
+            return
+        for o in out_leaves:
+            if not jnp.issubdtype(o.dtype, jnp.floating):
+                continue
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"[tensor_checker] op {name!r} produced non-finite "
+                    f"values (dtype {o.dtype})")
+
+    _install(check_hook)
+
+
+def disable_tensor_checker():
+    _uninstall()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Reference compare_accuracy: diff two op-output dumps (produced by
+    the stats/checker runs with save paths). Here the dumps are .npz files
+    of {op_name: array}; writes a CSV of max-abs/rel errors."""
+    a = np.load(dump_path, allow_pickle=True)
+    b = np.load(another_dump_path, allow_pickle=True)
+    rows = ["op,max_abs_err,max_rel_err"]
+    for k in sorted(set(a.files) & set(b.files)):
+        x, y = np.asarray(a[k], np.float64), np.asarray(b[k], np.float64)
+        if x.shape != y.shape:
+            rows.append(f"{k},shape_mismatch,{x.shape}vs{y.shape}")
+            continue
+        err = np.abs(x - y)
+        rel = err / np.maximum(np.abs(y), 1e-12)
+        rows.append(f"{k},{err.max():.6e},{rel.max():.6e}")
+    with open(output_filename, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return output_filename

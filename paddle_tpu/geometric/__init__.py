@@ -1,0 +1,212 @@
+"""paddle.geometric parity — graph learning primitives.
+
+Reference: python/paddle/geometric/ — segment math (`math.py:29-209`),
+message passing (`message_passing/send_recv.py:55` send_u_recv,
+send_ue_recv, send_uv), reindex (`reindex.py`), sampling (`sampling/`).
+
+TPU-native: segment reductions map onto `jax.ops.segment_*` (XLA scatter
+lowering — on backends without scatter these are CPU-tier like the
+reference's CPU kernels); message passing is gather → elementwise →
+segment-reduce, the exact dataflow of the reference's
+graph_send_ue_recv kernels but left to XLA to fuse.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import register_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _nseg(ids, count=None):
+    if count is not None:
+        return int(count)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+# -- segment math (reference geometric/math.py) -----------------------------
+
+@register_op(name="segment_sum")
+def _segment_sum(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    return jax.ops.segment_sum(data, ids, num_segments=_nseg(ids))
+
+
+@register_op(name="segment_mean")
+def _segment_mean(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    n = _nseg(ids)
+    s = jax.ops.segment_sum(data.astype(jnp.float32), ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), jnp.float32), ids,
+                              num_segments=n)
+    return (s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (s.ndim - 1)]
+            ).astype(data.dtype)
+
+
+@register_op(name="segment_min")
+def _segment_min(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    return jax.ops.segment_min(data, ids, num_segments=_nseg(ids))
+
+
+@register_op(name="segment_max")
+def _segment_max(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    return jax.ops.segment_max(data, ids, num_segments=_nseg(ids))
+
+
+# -- message passing (reference message_passing/send_recv.py) ----------------
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,   # handled via sum/count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _reduce(msg, dst, reduce_op, out_size):
+    n = int(out_size) if out_size is not None else _nseg(dst)
+    dst = dst.astype(jnp.int32)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg.astype(jnp.float32), dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.float32),
+                                  dst, num_segments=n)
+        out = s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (s.ndim - 1)]
+        return out.astype(msg.dtype)
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    out = fn(msg, dst, num_segments=n)
+    if reduce_op in ("min", "max"):
+        # empty segments produce +/-inf identities; the reference zeros them
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32), dst,
+                                  num_segments=n)
+        out = jnp.where((cnt > 0)[(...,) + (None,) * (out.ndim - 1)], out, 0)
+    return out
+
+
+@register_op(name="graph_send_recv")
+def send_u_recv_kernel(x, src_index, dst_index, reduce_op="sum",
+                       out_size=None):
+    msg = jnp.take(x, src_index.astype(jnp.int32), axis=0)
+    return _reduce(msg, dst_index, reduce_op.lower(), out_size)
+
+
+@register_op(name="graph_send_ue_recv")
+def send_ue_recv_kernel(x, y, src_index, dst_index, message_op="add",
+                        reduce_op="sum", out_size=None):
+    xs = jnp.take(x, src_index.astype(jnp.int32), axis=0)
+    op = message_op.lower()
+    if op == "add":
+        msg = xs + y
+    elif op == "sub":
+        msg = xs - y
+    elif op == "mul":
+        msg = xs * y
+    elif op == "div":
+        msg = xs / y
+    else:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    return _reduce(msg, dst_index, reduce_op.lower(), out_size)
+
+
+@register_op(name="graph_send_uv")
+def send_uv_kernel(x, y, src_index, dst_index, message_op="add"):
+    xs = jnp.take(x, src_index.astype(jnp.int32), axis=0)
+    yd = jnp.take(y, dst_index.astype(jnp.int32), axis=0)
+    op = message_op.lower()
+    if op == "add":
+        return xs + yd
+    if op == "sub":
+        return xs - yd
+    if op == "mul":
+        return xs * yd
+    if op == "div":
+        return xs / yd
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+# -- public API (paddle signatures) -----------------------------------------
+
+from ..ops.dispatch import OPS as _OPS
+
+segment_sum = _OPS["segment_sum"]
+segment_mean = _OPS["segment_mean"]
+segment_min = _OPS["segment_min"]
+segment_max = _OPS["segment_max"]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    return _OPS["graph_send_recv"](x, src_index, dst_index,
+                                   reduce_op=reduce_op, out_size=out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    return _OPS["graph_send_ue_recv"](x, y, src_index, dst_index,
+                                      message_op=message_op,
+                                      reduce_op=reduce_op, out_size=out_size)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    return _OPS["graph_send_uv"](x, y, src_index, dst_index,
+                                 message_op=message_op)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    return _OPS["reindex_graph"](x, neighbors, count, value_buffer,
+                                 index_buffer)
+
+
+def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: reindex each edge type against ONE shared
+    node numbering (reference reindex.py:reindex_heter_graph)."""
+    xs = _arr(x)
+    reindexed = []
+    # shared numbering: x first, then first-seen neighbors across all types
+    mapping = {int(v): i for i, v in enumerate(np.asarray(xs).tolist())}
+    nodes = list(np.asarray(xs).tolist())
+    for nb in neighbors_list:
+        for v in np.asarray(_arr(nb)).tolist():
+            if int(v) not in mapping:
+                mapping[int(v)] = len(nodes)
+                nodes.append(int(v))
+    outs = []
+    for nb in neighbors_list:
+        outs.append(Tensor._from_data(jnp.asarray(
+            [mapping[int(v)] for v in np.asarray(_arr(nb)).tolist()],
+            dtype=jnp.int64)))
+    out_nodes = Tensor._from_data(jnp.asarray(nodes, jnp.int64))
+    return outs, [c for c in count_list], out_nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    return _OPS["graph_sample_neighbors"](row, colptr, input_nodes,
+                                          eids=eids,
+                                          sample_size=sample_size,
+                                          return_eids=return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    return _OPS["weighted_sample_neighbors"](row, colptr, edge_weight,
+                                             input_nodes, eids=eids,
+                                             sample_size=sample_size,
+                                             return_eids=return_eids)

@@ -1,0 +1,111 @@
+"""paddle.incubate.asp parity — 2:4 structured (N:M) sparsity.
+
+Reference: python/paddle/incubate/asp/ (`asp.py` decorate/prune_model,
+`utils.py` mask generation — check_mask_2d / get_mask_2d_best /
+calculate_density). The CUDA story targets sparse tensor cores; on TPU
+the VALUE of ASP is the mask workflow itself (train dense → prune to 2:4
+→ fine-tune with masked grads), with the masked matmuls staying dense on
+the MXU (XLA constant-folds the zeros; a sparsity-exploiting Pallas
+kernel is a future perf tier). Masks follow the same N:M-along-rows
+convention so exported checkpoints agree with the reference.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "create_mask", "check_sparsity"]
+
+_MASKS: Dict[int, jnp.ndarray] = {}
+_EXCLUDED: set = set()
+
+
+def calculate_density(x) -> float:
+    """Reference: asp/utils.py calculate_density — nonzero fraction."""
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def create_mask(tensor, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """N:M mask along the last axis: keep the n largest-|w| of every m.
+    (mask_1d; the reference's 2d variants refine tie-breaks, same
+    constraint.)"""
+    a = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    flat = a.reshape(-1, a.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    padded = np.pad(np.abs(flat), ((0, 0), (0, pad)))
+    groups = padded.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols].reshape(a.shape)
+    return mask.astype(a.dtype)
+
+
+def check_sparsity(tensor, func_name: str = "check_mask_1d", n: int = 2,
+                   m: int = 4) -> bool:
+    a = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    flat = np.abs(a.reshape(-1, a.shape[-1]))
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    groups = np.pad(flat, ((0, 0), (0, pad))).reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name: str, p) -> bool:
+    if any(ex in name for ex in _EXCLUDED):
+        return False
+    shape = p.shape
+    return len(shape) >= 2 and shape[-1] >= 4 and "bias" not in name
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Apply N:M masks to every prunable weight; masks are remembered so
+    `decorate`d optimizers keep pruned entries at zero through training
+    (reference asp.py prune_model)."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = jnp.asarray(create_mask(p, mask_algo, n, m))
+        p._data = p._data * mask
+        _MASKS[id(p)] = mask
+        masks[name] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so post-step weights are re-masked (the
+    OptimizerWithSparsityGuarantee of the reference)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self):
+            self._inner.step()
+            for p in getattr(self._inner, "_params", []) or []:
+                mask = _MASKS.get(id(p))
+                if mask is not None:
+                    p._data = p._data * mask
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    return _ASPOptimizer(optimizer)

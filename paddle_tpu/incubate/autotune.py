@@ -1,0 +1,52 @@
+"""paddle.incubate.autotune parity — runtime tuning config.
+
+Reference: python/paddle/incubate/autotune.py set_config — toggles kernel
+autotuning (cudnn exhaustive search), layout autotuning and dataloader
+worker tuning. TPU mapping: kernel choice belongs to XLA's autotuner
+(latency-hiding scheduler + GEMM fusion autotune — always on), layout to
+GSPMD; the knob that has a real runtime lever here is the dataloader.
+The accepted config schema matches the reference so scripts port as-is.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+from typing import Optional, Union
+
+_CONFIG = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False},
+}
+
+
+def set_config(config: Optional[Union[dict, str]] = None):
+    """Reference signature (autotune.py:23): dict or JSON file path with
+    'kernel' / 'layout' / 'dataloader' sections."""
+    if config is None:
+        for section in _CONFIG.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("set_config expects a dict, a JSON path or None")
+    for key in ("kernel", "layout", "dataloader"):
+        if key not in config:
+            continue
+        section = config[key]
+        if not isinstance(section, dict):
+            warnings.warn(f"autotune section {key!r} must be a dict")
+            continue
+        _CONFIG[key].update(section)
+    if _CONFIG["dataloader"].get("enable"):
+        from .. import io as _io
+
+        tune = getattr(_io, "tune_num_workers", None)
+        if callable(tune):
+            tune()
+
+
+def get_config() -> dict:
+    return {k: dict(v) for k, v in _CONFIG.items()}

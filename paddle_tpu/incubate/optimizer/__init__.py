@@ -1,0 +1,106 @@
+"""paddle.incubate.optimizer parity — LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}.
+Both are wrapper optimizers over an inner fast optimizer; state is a few
+extra slot arrays per parameter — plain jnp math the XLA step absorbs.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ...optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, 1 step back (reference lookahead.py:30): every k
+    inner steps, slow weights move alpha toward the fast weights and the
+    fast weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow: Dict[int, jnp.ndarray] = {}
+        self._count = 0
+
+    @property
+    def _params(self):
+        return getattr(self.inner_optimizer, "_params", [])
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._count += 1
+        if self._count % self.k:
+            return
+        for p in self._params:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._data  # first sync: fast IS slow
+            slow = slow.astype(jnp.float32) + self.alpha * (
+                p._data.astype(jnp.float32) - slow.astype(jnp.float32))
+            slow = slow.astype(p._data.dtype)
+            self._slow[id(p)] = slow
+            p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval time (reference
+    modelaverage.py:33): accumulate sums; `apply()` swaps averaged weights
+    in, `restore()` swaps the live ones back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = list(parameters or [])
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._num = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        self._num += 1
+        for p in self._params:
+            s = self._sum.get(id(p))
+            cur = p._data.astype(jnp.float32)
+            self._sum[id(p)] = cur if s is None else s + cur
+        # window restart (reference: sum_1/sum_2/sum_3 rotation collapses
+        # to a restart once the window outgrows the configured bounds)
+        if self._num > self.max_w and \
+                self._num > self.min_w * max(self.rate, 1e-9):
+            for p in self._params:
+                self._sum[id(p)] = p._data.astype(jnp.float32)
+            self._num = 1
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            s = self._sum.get(id(p))
+            if s is None or not self._num:
+                continue
+            self._backup[id(p)] = p._data
+            p._data = (s / self._num).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            b = self._backup.pop(id(p), None)
+            if b is not None:
+                p._data = b
+
+    def minimize(self, loss):
+        self.step()

@@ -1,6 +1,7 @@
 """paddle.optimizer-compatible API (reference: python/paddle/optimizer)."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
+    LBFGS,
     SGD,
     Adadelta,
     Adagrad,

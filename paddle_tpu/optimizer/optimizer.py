@@ -321,3 +321,133 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(param._data.dtype)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference: python/paddle/optimizer/lbfgs.py).
+
+    `step(closure)` re-evaluates the loss/gradients as needed: two-loop
+    recursion over the last `history_size` (s, y) pairs, strong-Wolfe or
+    fixed-step line search. All state is host-driven (L-BFGS is inherently
+    sequential); the closure's forward/backward is the compiled part.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+        self._prev_loss = None
+
+    # flatten helpers -------------------------------------------------------
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def _gather_grads(self):
+        return self._flat([p._grad if p._grad is not None
+                           else jnp.zeros(p._data.shape) for p in self._params])
+
+    def _assign_flat(self, flat):
+        off = 0
+        for p in self._params:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            p._data = flat[off:off + n].reshape(p._data.shape).astype(
+                p._data.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        q = flat_grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+                jnp.vdot(y_last, y_last), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+        loss = closure()
+        lr = self.get_lr() if hasattr(self, "get_lr") else self._learning_rate
+        lr = float(lr if not hasattr(lr, "get_lr") else lr.get_lr())
+        n_eval = 1
+        for _ in range(self._max_iter):
+            flat_grad = self._gather_grads()
+            if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+                break
+            if self._prev_flat_grad is not None:
+                y = flat_grad - self._prev_flat_grad
+                s = self._last_step
+                if float(jnp.vdot(y, s)) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._history:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(flat_grad)
+            x0 = self._flat([p._data for p in self._params])
+            t = lr if self._y else min(1.0, 1.0 / max(
+                float(jnp.abs(flat_grad).sum()), 1e-10)) * lr
+            if self._line_search == "strong_wolfe":
+                t, loss, n_ls = self._strong_wolfe(closure, x0, d, t, loss,
+                                                   flat_grad)
+                n_eval += n_ls
+            else:
+                self._assign_flat(x0 + t * d)
+                self.clear_grad()
+                loss = closure()
+                n_eval += 1
+            self._last_step = self._flat(
+                [p._data for p in self._params]) - x0
+            self._prev_flat_grad = flat_grad
+            if self._prev_loss is not None and abs(
+                    float(loss.numpy()) - self._prev_loss) < self._tol_change:
+                self._prev_loss = float(loss.numpy())
+                break
+            self._prev_loss = float(loss.numpy())
+            if n_eval >= self._max_eval:
+                break
+        self._step_count += 1
+        return loss
+
+    def _strong_wolfe(self, closure, x0, d, t, f0, g0, c1=1e-4, c2=0.9,
+                      max_ls=10):
+        """Backtracking satisfying Armijo + curvature (compact variant of
+        the reference's _strong_wolfe)."""
+        f0v = float(f0.numpy())
+        gtd0 = float(jnp.vdot(g0, d))
+        n_eval = 0
+        best_t, best_loss = t, f0
+        for _ in range(max_ls):
+            self._assign_flat(x0 + t * d)
+            self.clear_grad()
+            loss = closure()
+            n_eval += 1
+            fv = float(loss.numpy())
+            g = self._gather_grads()
+            gtd = float(jnp.vdot(g, d))
+            if fv <= f0v + c1 * t * gtd0 and abs(gtd) <= c2 * abs(gtd0):
+                return t, loss, n_eval
+            best_t, best_loss = t, loss
+            t *= 0.5
+        return best_t, best_loss, n_eval
