@@ -517,15 +517,19 @@ CONFIGS = [
 ]
 
 
-def _load_baselines(platform):
+def _read_base():
     if not os.path.exists(BASE_PATH):
-        return {}
+        return None
     try:
         with open(BASE_PATH) as f:
-            base = json.load(f)
+            return json.load(f)
     except (OSError, ValueError):
-        return {}
-    if base.get("platform") != platform:
+        return None
+
+
+def _load_baselines(platform):
+    base = _read_base()
+    if base is None or base.get("platform") != platform:
         return {}
     configs = dict(base.get("configs") or {})
     # legacy round-1/2 format: single llama number under "value"
@@ -610,37 +614,65 @@ print(json.dumps({"platform": d.platform,
 """
 
 
+def _probe_backend_once(timeout_s: float):
+    """One killable-child probe. Returns the probe dict or an error string."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        )
+        if out.returncode == 0:
+            for line in out.stdout.strip().splitlines()[::-1]:
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        return (out.stderr or out.stdout or "").strip()[-200:]
+    except subprocess.TimeoutExpired:
+        return f"probe hung >{timeout_s:.0f}s (tunnel down?)"
+    except OSError as e:
+        return f"{type(e).__name__}: {e}"
+
+
 def _probe_backend(timeout_s: float = float(
         os.environ.get("BENCH_PROBE_TIMEOUT_S", "120")),
-                   tries: int = 2, wait_s: float = 30.0):
+                   wait_s: float = 30.0):
     """Ask a KILLABLE child process what backend is available. jax.devices()
     can hang forever when the axon tunnel is down (r03: rc=124 artifact
     loss), so the parent must never be the first to call it. cwd must be
-    the repo root — the axon plugin only initializes from there."""
-    err = "unknown"
-    for attempt in range(tries):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout_s,
-                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
-            )
-            if out.returncode == 0:
-                for line in out.stdout.strip().splitlines()[::-1]:
-                    try:
-                        return json.loads(line)
-                    except ValueError:
-                        continue
-            err = (out.stderr or out.stdout or "").strip()[-200:]
-        except subprocess.TimeoutExpired:
-            err = f"probe hung >{timeout_s:.0f}s (tunnel down?)"
-        except OSError as e:
-            err = f"{type(e).__name__}: {e}"
-        print(f"[bench] backend probe failed (attempt {attempt + 1}/{tries}):"
-              f" {err}", file=sys.stderr, flush=True)
-        if attempt < tries - 1 and _remaining() > wait_s + timeout_s + 60:
-            time.sleep(wait_s)
-    return None
+    the repo root — the axon plugin only initializes from there.
+
+    Probes REPEATEDLY until half the bench budget is spent (r4 VERDICT:
+    a tunnel that recovers mid-window must be caught, and two up-front
+    tries cannot see that). The remaining half-budget still fits the CPU
+    fallback sweep (~150s in r4)."""
+    attempt = 0
+    half_budget = DEADLINE_S / 2.0
+    # even the first probe must not eat into the fallback's half-budget
+    timeout_s = max(10.0, min(timeout_s, half_budget))
+    while True:
+        attempt += 1
+        r = _probe_backend_once(timeout_s)
+        if isinstance(r, dict):
+            return r
+        print(f"[bench] backend probe failed (attempt {attempt}): {r}",
+              file=sys.stderr, flush=True)
+        spent = time.monotonic() - _T0
+        # next cycle costs up to wait_s + timeout_s; stop when it would
+        # cross half-budget so the CPU fallback keeps a full half window
+        if spent + wait_s + timeout_s > half_budget:
+            return None
+        time.sleep(wait_s)
+
+
+def _tpu_last_verified():
+    """The pinned TPU numbers, attached to any non-TPU artifact so a
+    CPU-fallback run can never read as on-target (r4 Weak #1)."""
+    base = _read_base()
+    if base is None or base.get("platform") != "tpu":
+        return None
+    return {"platform": "tpu", "configs": base.get("configs") or {}}
 
 
 def main():
@@ -654,6 +686,10 @@ def main():
             "accelerator probe failed/hung; benched on CPU fallback")
     platform = jax.devices()[0].platform
     _PLATFORM_NOTE["platform"] = platform
+    if platform == "cpu":
+        last = _tpu_last_verified()
+        if last:
+            _PLATFORM_NOTE["tpu_last_verified"] = last
     baselines = _load_baselines(platform)
     new_baselines = dict(baselines)
     for name, fn in CONFIGS:
@@ -667,8 +703,13 @@ def main():
         try:
             r = fn()
             pinned = baselines.get(name)
-            r["vs_baseline"] = (round(r["value"] / pinned, 4)
-                                if pinned else 1.0)
+            if pinned:
+                r["vs_baseline"] = round(r["value"] / pinned, 4)
+            elif platform == "cpu":
+                # no CPU pin: a fallback run must NOT read as on-baseline
+                r["vs_baseline"] = 0.0
+            else:
+                r["vs_baseline"] = 1.0  # first TPU run pins the baseline
             if platform != "cpu" and name not in new_baselines:
                 new_baselines[name] = r["value"]
         except Exception as e:  # noqa: BLE001 — one config must not kill the rest

@@ -1,0 +1,206 @@
+"""Opportunistic TPU perf capture (r4 VERDICT Next #1c).
+
+The axon tunnel to the real chip is flaky: it was down for the entire
+round-3 and round-4 driver bench windows, so the program's last
+driver-verified TPU number dates from round 1. This watcher decouples
+"chip-stamped evidence" from "the tunnel happens to be up during the one
+driver window": run it in the background for the whole build round; every
+time the tunnel is up it re-runs the flagship bench on the chip and
+commits `BENCH_TPU_attested.json` (device fingerprint, raw per-step
+timings, git head) so even a down-window round carries a fresh attested
+number. Reference frame: `tools/ci_op_benchmark.sh:128-131` (the CI habit
+of pinning perf on the real device whenever it is reachable).
+
+Modes:
+    python tools/bench_watch.py --watch    # loop forever (builder runs this)
+    python tools/bench_watch.py --once     # single probe+capture attempt
+    python tools/bench_watch.py --capture  # internal: killable child
+
+The parent never imports jax (a down tunnel can HANG jax.devices(), r3
+rc=124); all chip contact happens in a child with a hard timeout. On a
+successful capture the parent also pins the TPU op-bench baseline
+(tools/ci_op_benchmark.py --update) if no tpu/* key exists yet (r4 Weak
+#7), then git-commits both artifacts with index.lock retry (the builder
+may be committing concurrently).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ATTEST_PATH = os.path.join(REPO, "BENCH_TPU_attested.json")
+OP_BASE_PATH = os.path.join(REPO, "tools", "op_bench_baseline.json")
+LOG = os.path.join(REPO, "bench_watch.log")
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    try:
+        with open(LOG, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# child: touch the chip, run the flagship, print ONE json line
+# ---------------------------------------------------------------------------
+
+def capture() -> int:
+    import jax
+
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        print(json.dumps({"skip": "cpu backend"}), flush=True)
+        return 3
+    import bench
+
+    t0 = time.perf_counter()
+    flagship = bench.bench_llama()
+    flag_wall = round(time.perf_counter() - t0, 1)
+    t0 = time.perf_counter()
+    try:
+        decode = bench.bench_llama_decode()
+    except Exception as e:  # noqa: BLE001 — decode is secondary evidence
+        decode = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                          capture_output=True, text=True).stdout.strip()
+    out = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device": {"platform": d.platform,
+                   "device_kind": getattr(d, "device_kind", ""),
+                   "id": d.id},
+        "git_head": head,
+        "flagship": {**flagship, "metric": "llama_train_tokens_per_sec_per_chip",
+                     "wall_s": flag_wall},
+        "decode": {**decode, "wall_s": round(time.perf_counter() - t0, 1)},
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: killable child + pin + commit
+# ---------------------------------------------------------------------------
+
+def _git(args, timeout=60):
+    return subprocess.run(["git", *args], cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _commit(paths, msg) -> bool:
+    last = ""
+    for _ in range(10):
+        add = _git(["add", *paths])
+        last = add.stdout + add.stderr
+        if add.returncode == 0:
+            c = _git(["commit", "-m", msg, "--", *paths])
+            last = c.stdout + c.stderr
+            if c.returncode == 0 or "nothing to commit" in last:
+                return True
+        time.sleep(5)  # index.lock contention with the builder's commits
+    log(f"git commit failed after retries: {last[-200:]}")
+    return False
+
+
+def try_capture(capture_timeout: float) -> bool:
+    """Returns True when a chip-stamped artifact was captured+committed."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--capture"],
+            capture_output=True, text=True, timeout=capture_timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"capture child hung >{capture_timeout:.0f}s (tunnel down?)")
+        return False
+    if out.returncode == 3:
+        log("tunnel up but backend is cpu; skipping")
+        return False
+    if out.returncode != 0:
+        log(f"capture child failed rc={out.returncode}: "
+            f"{(out.stderr or '').strip()[-300:]}")
+        return False
+    payload = None
+    for line in out.stdout.strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if not isinstance(payload, dict) or "flagship" not in payload:
+        log(f"capture child emitted no artifact: {out.stdout[-200:]}")
+        return False
+    with open(ATTEST_PATH, "w") as f:
+        json.dump(payload, f, indent=1)
+    v = payload["flagship"].get("value")
+    log(f"captured TPU flagship: {v} tokens/s/chip "
+        f"on {payload['device'].get('device_kind')}")
+    paths = [ATTEST_PATH]
+    if _pin_op_bench():
+        paths.append(OP_BASE_PATH)
+    _commit(paths, f"attested TPU bench: flagship {v} tokens/s/chip")
+    return True
+
+
+def _pin_op_bench() -> bool:
+    """Pin the TPU op-bench baseline if no tpu/* key exists (r4 Weak #7)."""
+    try:
+        with open(OP_BASE_PATH) as f:
+            base = json.load(f)
+        if any(k.startswith("tpu/") for k in base):
+            return False
+    except (OSError, ValueError):
+        pass
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ci_op_benchmark.py"),
+             "--update"],
+            capture_output=True, text=True, timeout=900, cwd=REPO)
+        if out.returncode == 0:
+            log("pinned TPU op-bench baseline")
+            return True
+        log(f"op-bench pin failed rc={out.returncode}: "
+            f"{(out.stderr or '').strip()[-200:]}")
+    except subprocess.TimeoutExpired:
+        log("op-bench pin hung; skipped")
+    return False
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--capture", action="store_true")
+    ap.add_argument("--interval", type=float,
+                    default=float(os.environ.get("BENCH_WATCH_INTERVAL_S",
+                                                 "600")))
+    ap.add_argument("--capture-timeout", type=float,
+                    default=float(os.environ.get("BENCH_WATCH_CAPTURE_S",
+                                                 "1200")))
+    ap.add_argument("--recapture-interval", type=float, default=3600.0,
+                    help="seconds between captures once one succeeded")
+    args = ap.parse_args()
+    if args.capture:
+        sys.exit(capture())
+    if args.once:
+        sys.exit(0 if try_capture(args.capture_timeout) else 1)
+    # --watch (default)
+    log(f"watch loop: probe every {args.interval:.0f}s, "
+        f"capture timeout {args.capture_timeout:.0f}s")
+    while True:
+        try:
+            ok = try_capture(args.capture_timeout)
+        except Exception as e:  # noqa: BLE001 — the watcher must outlive any
+            # single failure (git timeout, full disk); log and keep probing
+            log(f"capture attempt crashed: {type(e).__name__}: {e}")
+            ok = False
+        time.sleep(args.recapture_interval if ok else args.interval)
+
+
+if __name__ == "__main__":
+    main()
