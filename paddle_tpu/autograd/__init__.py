@@ -6,3 +6,4 @@ from ..ops.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabl
 from .engine import GradNode, grad, run_backward  # noqa: F401
 from .backward_mode import backward  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp  # noqa: F401

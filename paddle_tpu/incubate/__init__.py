@@ -1,3 +1,4 @@
 """paddle.incubate parity — fused ops, MoE, experimental APIs."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import autograd  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.incubate.autograd parity — functional differentiation surface.
+
+Reference: `python/paddle/incubate/autograd/__init__.py` (exports Hessian,
+Jacobian, jvp, vjp from functional.py).
+"""
+from ...autograd.functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    hessian,
+    jacobian,
+    jvp,
+    vjp,
+)
+
+__all__ = ["Hessian", "Jacobian", "hessian", "jacobian", "jvp", "vjp"]

@@ -1,0 +1,283 @@
+"""Functional autograd parity: jacobian / hessian / jvp / vjp.
+
+Reference contracts: `python/paddle/autograd/autograd.py` (Jacobian lazy
+row indexing, batch_axis semantics, hessian nesting) and
+`python/paddle/incubate/autograd/functional.py` (vjp/jvp signatures,
+default cotangents/tangents of ones). Numeric ground truth: finite
+differences and closed forms.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def fd_jacobian(f, x, eps=1e-4):
+    """Finite-difference Jacobian of f: R^n -> R^m at x (numpy)."""
+    x = np.asarray(x, np.float64)
+    y0 = np.asarray(f(x), np.float64)
+    J = np.zeros((y0.size, x.size))
+    for j in range(x.size):
+        d = np.zeros_like(x)
+        d.flat[j] = eps
+        J[:, j] = (np.asarray(f(x + d), np.float64).ravel()
+                   - np.asarray(f(x - d), np.float64).ravel()) / (2 * eps)
+    return J.reshape(y0.shape + x.shape)
+
+
+class TestJacobian:
+    def test_vector_to_vector(self):
+        x_np = np.array([0.5, -1.2, 2.0], np.float32)
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        y = paddle.tanh(x) * paddle.sum(x * x)
+        J = paddle.autograd.jacobian(y, x)
+        assert list(J.shape) == [3, 3]
+        got = _np(J[:])
+
+        def f(v):
+            return np.tanh(v) * np.sum(v * v)
+
+        np.testing.assert_allclose(got, fd_jacobian(f, x_np), rtol=1e-2,
+                                   atol=1e-3)
+
+    def test_lazy_single_row(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        J = paddle.autograd.jacobian(y, x)
+        row1 = _np(J[1])
+        np.testing.assert_allclose(row1, [0.0, 4.0, 0.0], atol=1e-6)
+        # only row 1 was evaluated (lazy contract)
+        assert set(J._cache.keys()) == {1}
+        full = _np(J[:])
+        assert set(J._cache.keys()) == {0, 1, 2}
+        np.testing.assert_allclose(full, np.diag([2.0, 4.0, 6.0]), atol=1e-6)
+
+    def test_scalar_output(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = paddle.sum(x * x * x)
+        J = paddle.autograd.jacobian(y, x)
+        assert list(J.shape) == [2]
+        np.testing.assert_allclose(_np(J[:]), [3.0, 12.0], rtol=1e-5)
+
+    def test_tuple_xs(self):
+        x1 = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        x2 = paddle.to_tensor(np.array([0.5, 0.5, 0.5], np.float32))
+        x1.stop_gradient = False
+        x2.stop_gradient = False
+        y = x1 + 2.0 * x2
+        J = paddle.autograd.jacobian(y, (x1, x2))
+        assert isinstance(J, tuple) and len(J) == 2
+        np.testing.assert_allclose(_np(J[0][:]), np.eye(3), atol=1e-6)
+        np.testing.assert_allclose(_np(J[1][:]), 2.0 * np.eye(3), atol=1e-6)
+
+    def test_batched(self):
+        B, N, M = 4, 3, 2
+        rs = np.random.RandomState(0)
+        W_np = rs.randn(N, M).astype(np.float32)
+        x_np = rs.randn(B, N).astype(np.float32)
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        W = paddle.to_tensor(W_np)
+        y = paddle.matmul(x, W) ** 2
+        J = paddle.autograd.jacobian(y, x, batch_axis=0)
+        assert list(J.shape) == [B, M, N]
+        got = _np(J[:])
+        # per-sample: d(xW)^2/dx = 2*(xW)_m * W[:, m]
+        xw = x_np @ W_np
+        want = 2.0 * xw[:, :, None] * W_np.T[None, :, :]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # column slice of one output variable
+        col = _np(J[:, 1, :])
+        np.testing.assert_allclose(col, want[:, 1, :], rtol=1e-4, atol=1e-5)
+
+    def test_batch_axis_validation(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        x.stop_gradient = False
+        y = paddle.sum(x, axis=1)
+        with pytest.raises(ValueError):
+            paddle.autograd.jacobian(y, x, batch_axis=1)
+
+    def test_ndim_validation(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        x.stop_gradient = False
+        y = paddle.sum(x)
+        with pytest.raises(ValueError):
+            paddle.autograd.jacobian(y, x)  # 2-D xs needs batch_axis
+
+
+class TestHessian:
+    def test_quadratic_form(self):
+        A_np = np.array([[2.0, 1.0], [1.0, 3.0]], np.float32)
+        x = paddle.to_tensor(np.array([0.7, -0.3], np.float32))
+        x.stop_gradient = False
+        A = paddle.to_tensor(A_np)
+        y = 0.5 * paddle.sum(x * paddle.matmul(A, x))
+        H = paddle.autograd.hessian(y, x)
+        got = _np(H[:])
+        np.testing.assert_allclose(got, 0.5 * (A_np + A_np.T), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_nonlinear_vs_fd(self):
+        x_np = np.array([0.3, -0.6, 1.1], np.float32)
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        y = paddle.sum(paddle.exp(x * 0.5) + x ** 3)
+        H = paddle.autograd.hessian(y, x)
+
+        def grad_f(v):
+            return 0.5 * np.exp(v * 0.5) + 3 * v ** 2
+
+        np.testing.assert_allclose(_np(H[:]), fd_jacobian(grad_f, x_np),
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_tuple_xs_nesting(self):
+        x1 = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x2 = paddle.to_tensor(np.array([0.5, 0.1, 0.2], np.float32))
+        x1.stop_gradient = False
+        x2.stop_gradient = False
+        y = paddle.sum(x1 ** 2) + paddle.sum(x1) * paddle.sum(x2)
+        H = paddle.autograd.hessian(y, (x1, x2))
+        assert len(H) == 2 and len(H[0]) == 2
+        np.testing.assert_allclose(_np(H[0][0][:]), 2.0 * np.eye(2),
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np(H[0][1][:]), np.ones((2, 3)),
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np(H[1][0][:]), np.ones((3, 2)),
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np(H[1][1][:]), np.zeros((3, 3)),
+                                   atol=1e-5)
+
+    def test_batched(self):
+        B, N = 3, 2
+        x_np = np.random.RandomState(1).randn(B, N).astype(np.float32)
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        y = paddle.sum(x ** 3, axis=1)
+        H = paddle.autograd.hessian(y, x, batch_axis=0)
+        assert list(H.shape) == [B, N, N]
+        got = _np(H[:])
+        want = np.zeros((B, N, N), np.float32)
+        for b in range(B):
+            want[b] = np.diag(6.0 * x_np[b])
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_nonscalar_raises(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        y = x * x
+        with pytest.raises(ValueError):
+            paddle.autograd.hessian(y, x)
+
+
+class TestVjpJvp:
+    def test_vjp_default_cotangent(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+
+        def func(v):
+            return paddle.matmul(v, v)
+
+        _, g = paddle.incubate.autograd.vjp(func, x)
+        # reference docstring example: all-ones x -> vjp of ones is 4s
+        np.testing.assert_allclose(_np(g), np.full((2, 2), 4.0), atol=1e-5)
+
+    def test_vjp_custom_cotangent(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        v = paddle.to_tensor(
+            np.array([[1.0, 0.0], [0.0, 0.0]], np.float32))
+
+        def func(t):
+            return paddle.matmul(t, t)
+
+        _, g = paddle.incubate.autograd.vjp(func, x, v)
+        np.testing.assert_allclose(
+            _np(g), np.array([[2.0, 1.0], [1.0, 0.0]]), atol=1e-5)
+
+    def test_jvp_matches_reference_example(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+
+        def func(t):
+            return paddle.matmul(t, t)
+
+        _, g = paddle.incubate.autograd.jvp(func, x)
+        np.testing.assert_allclose(_np(g), np.full((2, 2), 4.0), atol=1e-5)
+        v = paddle.to_tensor(np.array([[1.0, 0.0], [0.0, 0.0]], np.float32))
+        _, g = paddle.incubate.autograd.jvp(func, x, v)
+        np.testing.assert_allclose(
+            _np(g), np.array([[2.0, 1.0], [1.0, 0.0]]), atol=1e-5)
+
+    def test_jvp_vjp_transpose_identity(self):
+        """<v, J u> == <J^T v, u> for random u, v."""
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(4).astype(np.float32))
+
+        def func(t):
+            return paddle.tanh(t) * paddle.sum(t * t)
+
+        u_np = rs.randn(4).astype(np.float32)
+        v_np = rs.randn(4).astype(np.float32)
+        _, ju = paddle.incubate.autograd.jvp(
+            func, x, paddle.to_tensor(u_np))
+        _, jtv = paddle.incubate.autograd.vjp(
+            func, x, paddle.to_tensor(v_np))
+        np.testing.assert_allclose(
+            float(np.dot(v_np, _np(ju))), float(np.dot(_np(jtv), u_np)),
+            rtol=1e-4)
+
+    def test_vjp_tuple_inputs(self):
+        x1 = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x2 = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+
+        def func(a, b):
+            return paddle.sum(a * b)
+
+        ys, gs = paddle.incubate.autograd.vjp(func, (x1, x2))
+        assert float(_np(ys)) == pytest.approx(11.0)
+        np.testing.assert_allclose(_np(gs[0]), [3.0, 4.0], atol=1e-6)
+        np.testing.assert_allclose(_np(gs[1]), [1.0, 2.0], atol=1e-6)
+
+    def test_vjp_shape_mismatch_raises(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+
+        def func(t):
+            return paddle.sum(t)
+
+        with pytest.raises(RuntimeError):
+            paddle.incubate.autograd.vjp(
+                func, x, paddle.to_tensor(np.ones(3, np.float32)))
+
+    def test_inputs_not_mutated(self):
+        """vjp runs on detached copies: caller tensors keep stop_gradient."""
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        assert x.stop_gradient
+
+        def func(t):
+            return paddle.sum(t * t)
+
+        paddle.incubate.autograd.vjp(func, x)
+        assert x.stop_gradient
+        assert x.grad is None
+
+
+class TestJacobianTensorLike:
+    def test_arithmetic_delegation(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        J = paddle.autograd.jacobian(y, x)
+        doubled = J + J
+        np.testing.assert_allclose(_np(doubled),
+                                   2 * np.diag([2.0, 4.0]), atol=1e-5)
+
+    def test_attr_delegation(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * 3.0
+        J = paddle.autograd.jacobian(y, x)
+        assert J.numpy().shape == (2, 2)
