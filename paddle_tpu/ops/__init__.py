@@ -37,6 +37,7 @@ from .kernels import (  # noqa: F401
     tail_math,
     tail_nn,
     tail_r4,
+    tail_r5,
     tail_seq,
     vision_ops,
     yolo_loss,

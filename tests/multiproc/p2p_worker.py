@@ -1,0 +1,35 @@
+"""Worker for the p_send/p_recv op test (2 ranks): rank 0 p_sends a tensor,
+rank 1 p_recvs it through the registered op names and writes what arrived."""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.ops.dispatch import OPS
+
+
+def main(out_dir):
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    payload = np.arange(12, dtype=np.float32).reshape(3, 4) * 7.0
+    if rank == 0:
+        OPS["p_send"](paddle.to_tensor(payload), ring_id=0, peer=1)
+        got = {"sent": payload.tolist()}
+        # barrier op: both ranks must pass before either exits
+        OPS["barrier"](ring_id=0)
+    else:
+        out = OPS["p_recv_array"](ring_id=0, peer=0, dtype="float32",
+                                  out_shape=[3, 4])
+        got = {"recv": np.asarray(out.numpy()).tolist()}
+        OPS["barrier"](ring_id=0)
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(got, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,364 @@
+"""Dedicated suite for the round-5 op tail (tail_r5.py).
+
+Semantic checks the generated harness can't express: FlashMask mask
+construction vs a dense reference for every C case, fused_moe vs a naive
+per-token expert loop, batch_norm's 6-output contract vs the train/infer
+functionals, strided-family numpy parity, multiclass_nms v1 vs the nms3
+kernel, and 2-process p_send/p_recv + barrier through the launcher
+(pattern-B, like tests/test_multiproc_collective.py).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.dispatch import OPS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+# ---------------------------------------------------------------------------
+# flashmask_attention: dense-mask reference for every C case
+# ---------------------------------------------------------------------------
+
+def dense_flashmask_reference(q, k, v, srow, causal):
+    """Naive attention with the FlashMask dense mask built index-by-index
+    per the reference docstring (flash_attention.py:1142-1159)."""
+    b, s, h, d = q.shape
+    hk = srow.shape[1]
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            hs = hi * hk // h  # broadcast srow heads onto q heads
+            scores = (q[bi, :, hi] @ k[bi, :, hi].T) / np.sqrt(d)
+            for i in range(s):
+                for j in range(s):
+                    r = srow[bi, hs, j]
+                    masked = False
+                    if causal and i < j:
+                        masked = True
+                    if i > j:  # lower-left triangle
+                        if causal and len(r) == 1:
+                            masked |= i >= r[0]
+                        elif causal and len(r) == 2:
+                            masked |= r[0] <= i < r[1]
+                        elif not causal and len(r) == 2:
+                            masked |= i >= r[0]
+                        elif not causal and len(r) == 4:
+                            masked |= r[0] <= i < r[1]
+                    if i < j and not causal:  # upper-right triangle
+                        if len(r) == 2:
+                            masked |= i < r[1]
+                        elif len(r) == 4:
+                            masked |= r[2] <= i < r[3]
+                    if masked:
+                        scores[i, j] = -1e30
+            p = np.exp(scores - scores.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+@pytest.mark.parametrize("causal,c", [(True, 1), (True, 2), (False, 2),
+                                      (False, 4)])
+def test_flashmask_vs_dense(causal, c):
+    rs = np.random.RandomState(0)
+    b, s, h, d = 1, 8, 2, 4
+    q = rs.randn(b, s, h, d).astype(np.float32)
+    k = rs.randn(b, s, h, d).astype(np.float32)
+    v = rs.randn(b, s, h, d).astype(np.float32)
+    if c == 1:
+        srow = rs.randint(4, s + 1, (b, h, s, 1)).astype(np.int32)
+    elif c == 2 and causal:
+        lo = rs.randint(2, 6, (b, h, s, 1))
+        srow = np.concatenate([lo, lo + 2], -1).astype(np.int32)
+    elif c == 2:
+        lo = rs.randint(4, s + 1, (b, h, s, 1))
+        hi = rs.randint(0, 3, (b, h, s, 1))
+        srow = np.concatenate([lo, hi], -1).astype(np.int32)
+    else:
+        a0 = rs.randint(4, 7, (b, h, s, 1))
+        u0 = rs.randint(0, 2, (b, h, s, 1))
+        srow = np.concatenate([a0, a0 + 1, u0, u0 + 1], -1).astype(np.int32)
+    out, _soft, lse, _seed = OPS["flashmask_attention"](
+        _t(q), _t(k), _t(v), _t(srow), causal=causal)
+    want = dense_flashmask_reference(q, k, v, srow, causal)
+    np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+    assert _np(lse).shape == (b, h, s)
+
+
+def test_flashmask_gqa_broadcast():
+    rs = np.random.RandomState(1)
+    b, s, hq, hk, d = 1, 6, 4, 2, 4
+    q = rs.randn(b, s, hq, d).astype(np.float32)
+    k = rs.randn(b, s, hk, d).astype(np.float32)
+    v = rs.randn(b, s, hk, d).astype(np.float32)
+    srow = np.full((b, 1, s, 1), s, np.int32)  # no extra masking
+    out, *_ = OPS["flashmask_attention"](_t(q), _t(k), _t(v), _t(srow),
+                                         causal=True)
+    # equals plain causal GQA attention
+    krep = np.repeat(k, hq // hk, axis=2)
+    vrep = np.repeat(v, hq // hk, axis=2)
+    want = dense_flashmask_reference(q, krep, vrep,
+                                     np.full((b, hq, s, 1), s, np.int32),
+                                     True)
+    np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused_moe vs naive per-token loop
+# ---------------------------------------------------------------------------
+
+def test_fused_moe_vs_loop():
+    rs = np.random.RandomState(2)
+    t_, d_, e_, i_ = 5, 4, 3, 6
+    x = rs.randn(t_, d_).astype(np.float32)
+    gw = rs.randn(d_, e_).astype(np.float32)
+    w1 = rs.randn(e_, d_, i_).astype(np.float32)
+    b1 = rs.randn(e_, i_).astype(np.float32)
+    w2 = rs.randn(e_, i_, d_).astype(np.float32)
+    b2 = rs.randn(e_, d_).astype(np.float32)
+    out = OPS["fused_moe"](_t(x), _t(gw), _t(w1), None, _t(b1), _t(w2),
+                           None, _t(b2), moe_topk=2, norm_topk_prob=True)
+
+    # naive loop reference
+    logits = x @ gw
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.zeros_like(x)
+    from math import erf, sqrt
+    for ti in range(t_):
+        top = np.argsort(-p[ti])[:2]
+        w = p[ti][top] / p[ti][top].sum()
+        acc = np.zeros(d_)
+        for wt, ei in zip(w, top):
+            up = x[ti] @ w1[ei] + b1[ei]
+            act = np.array([0.5 * u * (1 + erf(u / sqrt(2))) for u in up])
+            acc += wt * (act @ w2[ei] + b2[ei])
+        want[ti] = acc
+    np.testing.assert_allclose(_np(out), want, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_moe_swiglu_path():
+    rs = np.random.RandomState(3)
+    t_, d_, e_, i_ = 3, 4, 2, 5
+    x = rs.randn(t_, d_).astype(np.float32)
+    gw = rs.randn(d_, e_).astype(np.float32)
+    w1 = rs.randn(e_, d_, 2 * i_).astype(np.float32)  # 2I -> swiglu
+    w2 = rs.randn(e_, i_, d_).astype(np.float32)
+    out = OPS["fused_moe"](_t(x), _t(gw), _t(w1), None, None, _t(w2),
+                           moe_topk=1, norm_topk_prob=False)
+    logits = x @ gw
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.zeros_like(x)
+    for ti in range(t_):
+        ei = int(np.argmax(p[ti]))
+        up = x[ti] @ w1[ei]
+        g, lin = up[:i_], up[i_:]
+        act = (g / (1 + np.exp(-g))) * lin
+        want[ti] = p[ti, ei] * (act @ w2[ei])
+    np.testing.assert_allclose(_np(out), want, rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# batch_norm phi op contract
+# ---------------------------------------------------------------------------
+
+def test_batch_norm_train_updates_running_stats():
+    rs = np.random.RandomState(4)
+    x = rs.randn(6, 3, 4, 4).astype(np.float32) * 2 + 1
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    scale = rs.rand(3).astype(np.float32) + 0.5
+    bias = rs.randn(3).astype(np.float32)
+    out, m_out, v_out, s_mean, s_inv, _rs = OPS["batch_norm"](
+        _t(x), _t(mean), _t(var), _t(scale), _t(bias), is_test=False,
+        momentum=0.9, epsilon=1e-5)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(_np(m_out), 0.9 * mean + 0.1 * bm, rtol=1e-4)
+    np.testing.assert_allclose(_np(v_out), 0.9 * var + 0.1 * bv, rtol=1e-4)
+    np.testing.assert_allclose(_np(s_mean), bm, rtol=1e-4)
+    np.testing.assert_allclose(_np(s_inv), 1 / np.sqrt(bv + 1e-5), rtol=1e-4)
+    want = ((x - bm[None, :, None, None])
+            / np.sqrt(bv + 1e-5)[None, :, None, None]
+            * scale[None, :, None, None] + bias[None, :, None, None])
+    np.testing.assert_allclose(_np(out), want, rtol=1e-3, atol=1e-4)
+
+
+def test_batch_norm_infer_uses_running_stats():
+    rs = np.random.RandomState(5)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    mean = rs.randn(3).astype(np.float32)
+    var = rs.rand(3).astype(np.float32) + 0.5
+    out, m_out, v_out, *_ = OPS["batch_norm"](
+        _t(x), _t(mean), _t(var), None, None, is_test=True,
+        data_format="NCL")
+    want = ((x - mean[None, :, None]) / np.sqrt(var + 1e-5)[None, :, None])
+    np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(m_out), mean)  # untouched in test mode
+    np.testing.assert_allclose(_np(v_out), var)
+
+
+# ---------------------------------------------------------------------------
+# strided family numpy parity
+# ---------------------------------------------------------------------------
+
+def test_as_strided_matches_numpy():
+    base = np.arange(24, dtype=np.float32)
+    got = _np(OPS["as_strided"](_t(base), dims=[3, 4], stride=[8, 2],
+                                offset=1))
+    want = np.lib.stride_tricks.as_strided(
+        base[1:], shape=(3, 4), strides=(8 * 4, 2 * 4)).copy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_as_strided_overlapping_grad():
+    """Overlapping windows: grad accumulates into shared elements (the
+    scatter-add the reference's as_strided_grad performs)."""
+    x = paddle.to_tensor(np.arange(5).astype(np.float32))
+    x.stop_gradient = False
+    y = OPS["as_strided"](x, dims=[3, 2], stride=[1, 1], offset=0)
+    y.sum().backward()
+    # windows [0,1],[1,2],[2,3] -> counts 1,2,2,1,0
+    np.testing.assert_allclose(_np(x.grad), [1, 2, 2, 1, 0])
+
+
+def test_index_select_strided():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = _np(OPS["index_select_strided"](_t(x), index=2, axis=0))
+    np.testing.assert_allclose(got, x[2])
+
+
+def test_transfer_layout_round_trip():
+    x = np.random.RandomState(0).randn(2, 3, 4, 5).astype(np.float32)
+    nhwc = OPS["transfer_layout"](_t(x), src_layout=2, dst_layout=1)
+    assert _np(nhwc).shape == (2, 4, 5, 3)
+    back = OPS["transfer_layout"](nhwc, src_layout=1, dst_layout=2)
+    np.testing.assert_allclose(_np(back), x)
+    same = OPS["transfer_layout"](_t(x), src_layout=-1, dst_layout=-1)
+    np.testing.assert_allclose(_np(same), x)
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms v1
+# ---------------------------------------------------------------------------
+
+def test_multiclass_nms_v1_vs_v3():
+    rs = np.random.RandomState(6)
+    bboxes = np.abs(rs.randn(1, 8, 4)).astype(np.float32) * 10
+    bboxes[..., 2:] += bboxes[..., :2] + 1  # valid x2>x1, y2>y1
+    scores = rs.rand(1, 3, 8).astype(np.float32)
+    out1 = OPS["multiclass_nms"](_t(bboxes), _t(scores),
+                                 score_threshold=0.3, background_label=0)
+    out3, _idx, _num = OPS["multiclass_nms3"](
+        _t(bboxes), _t(scores), None, score_threshold=0.3,
+        background_label=0)
+    np.testing.assert_allclose(_np(out1), _np(out3))
+    got = _np(out1)
+    if got.size:
+        assert (got[:, 0] != 0).all()  # background class dropped
+
+
+# ---------------------------------------------------------------------------
+# legacy cross_entropy / tril_triu
+# ---------------------------------------------------------------------------
+
+def test_cross_entropy_prob_input():
+    p = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    lab = np.array([0, 1], np.int64)
+    got = _np(OPS["cross_entropy"](_t(p), _t(lab)))
+    np.testing.assert_allclose(got.ravel(), -np.log([0.7, 0.8]), rtol=1e-5)
+    soft = _np(OPS["cross_entropy"](_t(p), _t(p), soft_label=True))
+    want = -(p * np.log(p)).sum(-1, keepdims=True)
+    np.testing.assert_allclose(soft, want, rtol=1e-5)
+
+
+def test_tril_triu_both_modes():
+    x = np.random.RandomState(7).randn(4, 4).astype(np.float32)
+    np.testing.assert_allclose(_np(OPS["tril_triu"](_t(x), 1, True)),
+                               np.tril(x, 1))
+    np.testing.assert_allclose(_np(OPS["tril_triu"](_t(x), -1, False)),
+                               np.triu(x, -1))
+
+
+# ---------------------------------------------------------------------------
+# sparse_attention pattern semantics
+# ---------------------------------------------------------------------------
+
+def test_sparse_attention_masks_non_pattern():
+    rs = np.random.RandomState(8)
+    q = rs.randn(1, 1, 4, 3).astype(np.float32)
+    k = rs.randn(1, 1, 4, 3).astype(np.float32)
+    v = rs.randn(1, 1, 4, 3).astype(np.float32)
+    # row i attends only to {i, 0}
+    offset = np.array([[[0, 1, 3, 5, 7]]], np.int64)
+    cols = np.array([[[0, 0, 1, 0, 2, 0, 3]]], np.int64)
+    out, sdd, soft = OPS["sparse_attention"](_t(q), _t(k), _t(v),
+                                             _t(offset), _t(cols))
+    # dense reference with the same mask
+    scores = (q[0, 0] @ k[0, 0].T) / np.sqrt(3)
+    mask = np.zeros((4, 4), bool)
+    rows = [0, 1, 1, 2, 2, 3, 3]
+    for r, c in zip(rows, cols[0, 0]):
+        mask[r, c] = True
+    scores[~mask] = -1e30
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(_np(out)[0, 0], p @ v[0, 0], rtol=1e-4,
+                               atol=1e-5)
+    assert _np(sdd).shape == (1, 1, 7)
+    np.testing.assert_allclose(_np(soft)[0, 0], p[rows, cols[0, 0]],
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# p_send / p_recv / barrier: 2 real processes through the launcher
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_p2p_ops_two_processes(tmp_path):
+    worker = os.path.join(REPO, "tests", "multiproc", "p2p_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PADDLE_MASTER_PORT"] = str(_free_port())
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nnodes", "1", "--nproc_per_node", "2", "--max_restart", "0",
+           "--log_dir", str(tmp_path / "log"), worker, str(tmp_path)]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, timeout=420,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        logs = ""
+        log_dir = tmp_path / "log"
+        if log_dir.is_dir():
+            for f in sorted(os.listdir(log_dir)):
+                logs += f"\n--- {f} ---\n" + (log_dir / f).read_text()[-2000:]
+        raise AssertionError(f"launch rc={proc.returncode}\n"
+                             f"{proc.stdout}\n{proc.stderr}\n{logs}")
+    sent = json.loads((tmp_path / "rank0.json").read_text())["sent"]
+    recv = json.loads((tmp_path / "rank1.json").read_text())["recv"]
+    np.testing.assert_allclose(np.asarray(recv), np.asarray(sent))
