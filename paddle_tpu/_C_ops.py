@@ -1,20 +1,25 @@
 """Low-level op namespace.
 
 Analog of the reference's `paddle._C_ops` (python/paddle/_C_ops.py:20, a
-re-export of `core.eager.ops` — the generated Python-C functions). Here every
-registered kernel is exposed by name; attribute lookup goes straight to the
-op registry.
+re-export of `core.eager.ops` — the generated Python-C functions). The
+functions here come from `ops/generated_bindings.py`, which
+tools/gen_op_bindings.py emits FROM ops/ops.yaml — so an op is visible in
+this namespace exactly when the YAML names it (the reference's
+YAML→codegen arrow, `paddle/phi/api/generator/api_gen.py:1`).
 """
-from .ops.dispatch import OPS as _OPS
+from .ops import generated_bindings as _gen
 from . import ops as _ops_pkg  # noqa: F401  (ensures kernels are registered)
 
 
 def __getattr__(name):
-    try:
-        return _OPS[name]
-    except KeyError:
-        raise AttributeError(f"_C_ops has no op {name!r}") from None
+    # only YAML-listed names — plain getattr would leak the generated
+    # module's internals (_OPS, inf/nan) and defeat the YAML-only surface
+    if name in _gen.__all__:
+        return getattr(_gen, name)
+    raise AttributeError(
+        f"_C_ops has no op {name!r} — not present in ops/ops.yaml "
+        "(add a YAML entry + kernel, then run tools/gen_op_manifest.py)")
 
 
 def __dir__():
-    return sorted(_OPS)
+    return sorted(_gen.__all__)

@@ -74,16 +74,19 @@ from .ops.dispatch import (  # noqa: F401
 )
 from .autograd.engine import grad  # noqa: F401
 
-# Op library → module-level functions (paddle.add, paddle.matmul, ...) --------
+# Op library → module-level functions (paddle.add, paddle.matmul, ...).
+# Sourced from the YAML-generated binding surface (ops/generated_bindings),
+# NOT the raw registry: an op without an ops.yaml entry is not public.
 from .ops.dispatch import OPS as _OPS
+from .ops import generated_bindings as _gen_bindings
 from . import tensor as _tensor_methods  # noqa: F401  (patches Tensor methods)
 from . import _C_ops  # noqa: F401
 
 _globals = globals()
-for _name, _fn in _OPS.items():
+for _name in _gen_bindings.__all__:
     if _name not in _globals:
-        _globals[_name] = _fn
-del _name, _fn
+        _globals[_name] = getattr(_gen_bindings, _name)
+del _name
 
 
 # Creation / random wrappers with paddle signatures ---------------------------

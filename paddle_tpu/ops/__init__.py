@@ -42,3 +42,9 @@ from .kernels import (  # noqa: F401
     vision_ops,
     yolo_loss,
 )
+
+# The generated binding surface (tools/gen_op_bindings.py, FROM ops.yaml).
+# Kernels resolve at call time (quantization/geometric/incubate register
+# theirs after this import); a YAML entry without a kernel is caught by
+# tests/test_gen_bindings.py::test_registry_yaml_set_equality.
+from . import generated_bindings  # noqa: F401, E402

@@ -1,7 +1,8 @@
 """Tensor method library.
 
 Analog of the reference's `python/paddle/tensor/*` (36k LoC of methods
-patched onto the pybind Tensor type): here each registered op whose first
+patched onto the pybind Tensor type): each op the YAML-generated binding
+surface exposes (ops/generated_bindings.py — FROM ops.yaml) whose first
 argument is a tensor is attached as a method, plus the in-place `op_`
 variants (functional rebinds under the hood — XLA arrays are immutable, so
 "in-place" means adopting the new buffer, with donation doing the real
@@ -10,6 +11,7 @@ in-place optimization under jit).
 from __future__ import annotations
 
 from ..core.tensor import Tensor, register_tensor_method
+from ..ops import generated_bindings as _gen
 from ..ops.dispatch import OPS
 
 # Ops that are NOT tensor methods (first arg isn't a tensor).
@@ -46,14 +48,14 @@ _ALIASES = {
 
 
 def _install():
-    for name, api in OPS.items():
+    for name in _gen.__all__:
         if name in _NON_METHODS or name.endswith("_"):
             continue  # '_'-suffixed names are reserved for in-place rebinds below
         if not hasattr(Tensor, name):
-            setattr(Tensor, name, api)
+            setattr(Tensor, name, getattr(_gen, name))
     for alias, opname in _ALIASES.items():
         if opname and not hasattr(Tensor, alias):
-            setattr(Tensor, alias, OPS[opname])
+            setattr(Tensor, alias, getattr(_gen, opname))
 
     # In-place variants: value rebind (reference: inplace op variants x.add_()).
     inplace_bases = [

@@ -1,0 +1,119 @@
+"""Generate the public op-binding surface FROM ops/ops.yaml.
+
+The reference's arrow: one YAML drives C++ API + Python bindings + grad
+nodes (`paddle/phi/api/generator/api_gen.py:1`, `eager_gen.py:323`). This
+is that arrow for the Python surface here: every entry in ops.yaml becomes
+a def in `paddle_tpu/ops/generated_bindings.py` with the YAML signature —
+the signature-validation shim the dispatcher's *args/**kwargs wrapper
+can't provide — and `_C_ops` / `paddle.*` / Tensor methods expose ONLY
+what the YAML names. A kernel registered without a YAML entry is invisible
+to the public API (and fails tests/test_gen_bindings.py), so adding an op
+is exactly: kernel function + YAML entry.
+
+Run: python tools/gen_op_bindings.py   (gen_op_manifest.py chains into it)
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+OUT_PATH = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu",
+                        "ops", "generated_bindings.py")
+
+HEADER = '''\
+"""AUTO-GENERATED from ops/ops.yaml by tools/gen_op_bindings.py — DO NOT
+EDIT. Regenerate with: python tools/gen_op_manifest.py
+
+One def per YAML entry, carrying the YAML signature: unknown keywords and
+arity errors fail HERE with a normal Python TypeError naming the op,
+before the dispatcher sees them (the analog of the reference's generated
+Python-C arg parsing, `paddle/fluid/pybind/eager_op_function_generator`).
+`paddle.*`, `paddle._C_ops` and Tensor methods are built from THIS module,
+so ops.yaml is the source of truth for the public op surface.
+
+Kernels resolve at CALL time (some packages — quantization, geometric,
+incubate.nn.functional — register theirs after this module imports);
+set-equality between the registry and the YAML is enforced by
+tests/test_gen_bindings.py once the whole package is loaded.
+"""
+from math import inf, nan  # noqa: F401  (signature defaults)
+
+from .dispatch import OPS as _OPS
+
+'''
+
+
+def _forward_call(args_src: str) -> str:
+    """Build the forwarding argument list for a YAML signature string."""
+    tree = ast.parse(f"def f{args_src}: pass").body[0]
+    a = tree.args
+    parts = []
+    npos = len(a.posonlyargs) + len(a.args) - len(a.defaults)
+    ordered = list(a.posonlyargs) + list(a.args)
+    for i, arg in enumerate(ordered):
+        if i < npos:
+            parts.append(arg.arg)
+        else:
+            parts.append(f"{arg.arg}={arg.arg}")
+    if a.vararg:
+        parts.append(f"*{a.vararg.arg}")
+    for arg in a.kwonlyargs:
+        parts.append(f"{arg.arg}={arg.arg}")
+    if a.kwarg:
+        parts.append(f"**{a.kwarg.arg}")
+    return ", ".join(parts)
+
+
+def _load_manifest_standalone():
+    """Load schema.py directly from its file path: importing the paddle_tpu
+    package would import generated_bindings.py itself — a broken/missing
+    generated file could then never be regenerated (bootstrap deadlock)."""
+    import importlib.util
+
+    schema_path = os.path.join(os.path.dirname(__file__), "..",
+                               "paddle_tpu", "ops", "schema.py")
+    spec = importlib.util.spec_from_file_location("_ops_schema", schema_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load_manifest()
+
+
+def generate() -> str:
+    manifest = _load_manifest_standalone()
+    chunks = [HEADER]
+    for name in sorted(manifest):
+        args_src = manifest[name]["args"]
+        fwd = _forward_call(args_src)
+        chunks.append(
+            f"def {name}{args_src}:\n"
+            f"    return _OPS[{name!r}]({fwd})\n\n"
+        )
+    chunks.append(
+        "\n__all__ = [\n" + "".join(
+            f"    {n!r},\n" for n in sorted(manifest)) + "]\n"
+    )
+    return "\n".join(chunks)
+
+
+def main(check: bool = False) -> int:
+    src = generate()
+    if check:
+        with open(OUT_PATH) as f:
+            if f.read() != src:
+                print("generated_bindings.py is STALE — run "
+                      "python tools/gen_op_manifest.py", file=sys.stderr)
+                return 1
+        print("generated_bindings.py is current")
+        return 0
+    with open(OUT_PATH, "w") as f:
+        f.write(src)
+    n = src.count("\ndef ")
+    print(f"{n} bindings -> {OUT_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv))

@@ -63,6 +63,13 @@ def main(out_path=None):
     with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"{len(OPS)} ops -> {out_path}")
+    if str(out_path) == str(MANIFEST_PATH):
+        # the canonical YAML sources the public binding surface: refresh
+        # the generated module in the same pass. A custom out_path is a
+        # dry-run/test write — don't touch the tracked generated file.
+        import gen_op_bindings
+
+        gen_op_bindings.main()
 
 
 if __name__ == "__main__":
