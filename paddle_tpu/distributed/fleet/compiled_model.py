@@ -158,18 +158,9 @@ class CompiledHybridModel:
     def set_state_dict(self, sd, *a, **k):
         out = self._layers.set_state_dict(sd, *a, **k)
         if self._engine is not None:
-            # re-seed the engine's device copies from the layer
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            eng = self._engine
-            eng.params = {
-                n: jax.device_put(t._data,
-                                  NamedSharding(eng.mesh, eng._specs[n]))
-                for n, t in eng._param_ts.items()}
-            eng.buffers = {
-                n: jax.device_put(t._data, NamedSharding(eng.mesh, P()))
-                for n, t in eng._buffer_ts.items()}
+            # re-seed the engine's device copies from the layer — the
+            # engine knows its own layout (incl. pp-stacked params)
+            self._engine.refresh_from_layer()
         return out
 
     def __getattr__(self, name):

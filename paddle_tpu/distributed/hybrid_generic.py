@@ -179,20 +179,119 @@ class GenericHybridEngine:
         buffers0 = {n: t._data for n, t in self._buffer_ts.items()}
         tp_specs = (generic_tp_specs(model, self.tp, self._tp_axis)
                     if self.tp > 1 and self._tp_axis else {})
-        self._specs = {n: tp_specs.get(n, P()) for n in params0}
+        self._detect_uniform_stages()
         put = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
-        self.params = {n: put(v, self._specs[n]) for n, v in params0.items()}
-        self.buffers = {n: put(v, P()) for n, v in buffers0.items()}
+        stack_sharded = self._stack_sharded
+        if self._pp_stacked:
+            # Uniform stages: stage params live stage-stacked on a leading
+            # pp axis (the flagship layout, hybrid.py shard_params) — each
+            # pp rank stores ONLY its stage's slice, restoring PP's memory
+            # benefit (r4 Weak #3). Non-stage params stay replicated.
+            self._specs = {}
+            self.params = {}
+            for i, n0 in enumerate(self._stage_pnames[0]):
+                base = tp_specs.get(n0, P())
+                self._specs[n0] = P("pp", *base)
+                self.params[n0] = stack_sharded(
+                    [params0[self._stage_pnames[s][i]]
+                     for s in range(self.pp)], self._specs[n0])
+            for n in params0:
+                if n not in self._stage_param_set:
+                    self._specs[n] = tp_specs.get(n, P())
+                    self.params[n] = put(params0[n], self._specs[n])
+            self._bspecs = {}
+            self.buffers = {}
+            for i, n0 in enumerate(self._stage_bnames[0]):
+                self._bspecs[n0] = P("pp")
+                self.buffers[n0] = stack_sharded(
+                    [buffers0[self._stage_bnames[s][i]]
+                     for s in range(self.pp)], self._bspecs[n0])
+            for n in buffers0:
+                if n not in self._stage_buffer_set:
+                    self._bspecs[n] = P()
+                    self.buffers[n] = put(buffers0[n], P())
+        else:
+            self._specs = {n: tp_specs.get(n, P()) for n in params0}
+            self._bspecs = {n: P() for n in buffers0}
+            self.params = {n: put(v, self._specs[n])
+                           for n, v in params0.items()}
+            self.buffers = {n: put(v, P()) for n, v in buffers0.items()}
         self.opt_state = {
             "m": {n: put(jnp.zeros(v.shape, jnp.float32), self._specs[n])
-                  for n, v in params0.items()},
+                  for n, v in self.params.items()},
             "v": {n: put(jnp.zeros(v.shape, jnp.float32), self._specs[n])
-                  for n, v in params0.items()},
+                  for n, v in self.params.items()},
             "step": jnp.zeros((), jnp.int32),
         }
         self._train_step = None
         self._eval_step = None
         self._loss_history: List[float] = []
+
+    def _stack_sharded(self, pieces, spec):
+        """Assemble a pp-stacked global array shard-by-shard: a jnp.stack
+        would transiently materialize the FULL cross-stage stack on one
+        device — the exact replica this layout exists to avoid."""
+        pieces = [np.asarray(p) for p in pieces]
+        shape = (len(pieces),) + pieces[0].shape
+
+        def cb(idx):
+            s0 = idx[0].start or 0
+            s1 = idx[0].stop if idx[0].stop is not None else len(pieces)
+            return np.stack([pieces[s][tuple(idx[1:])]
+                             for s in range(s0, s1)])
+
+        return jax.make_array_from_callback(
+            shape, NamedSharding(self.mesh, spec), cb)
+
+    def _detect_uniform_stages(self):
+        """Stages are uniform when every stage is the same sequence of
+        Layer types with identical local param/buffer shapes and no tensor
+        shared across stages. Then one stage's CODE computes every stage's
+        function (only the values differ), so the per-device program drops
+        the all-stages lax.switch and params stack over pp. Reference
+        layout: meta_parallel/parallel_layers/pp_layers.py:258 — each rank
+        holds only its segment."""
+        from ..nn import Layer
+
+        self._pp_stacked = False
+        if self._stages is None:
+            return
+        sigs = []
+        seen_ids: set = set()
+        for st in self._stages:
+            sig = []
+            ids = set()
+            for fn in st:
+                if not isinstance(fn, Layer):
+                    return  # bare callables: can't prove uniformity
+                sig.append((
+                    type(fn).__name__,
+                    tuple((k, tuple(p.shape), str(p.dtype))
+                          for k, p in fn.named_parameters()),
+                    tuple((k, tuple(b.shape))
+                          for k, b in fn.named_buffers() if b is not None),
+                ))
+                ids |= {id(p) for _, p in fn.named_parameters()}
+                ids |= {id(b) for _, b in fn.named_buffers()
+                        if b is not None}
+            if seen_ids & ids:
+                return  # tied tensors across stages: stacking impossible
+            seen_ids |= ids
+            sigs.append(tuple(sig))
+        if not all(s == sigs[0] for s in sigs[1:]):
+            return
+        id2p = {id(t): n for n, t in self._param_ts.items()}
+        id2b = {id(t): n for n, t in self._buffer_ts.items()}
+        self._stage_pnames = [
+            [id2p[id(p)] for fn in st for _, p in fn.named_parameters()]
+            for st in self._stages]
+        self._stage_bnames = [
+            [id2b[id(b)] for fn in st for _, b in fn.named_buffers()
+             if b is not None]
+            for st in self._stages]
+        self._stage_param_set = {n for ns in self._stage_pnames for n in ns}
+        self._stage_buffer_set = {n for ns in self._stage_bnames for n in ns}
+        self._pp_stacked = True
 
     # -- pure per-shard programs ----------------------------------------
     def _swap(self, params, buffers):
@@ -217,9 +316,74 @@ class GenericHybridEngine:
         out = self.loss_fn(Tensor._from_data(y), Tensor._from_data(labels))
         return (out._data if isinstance(out, Tensor) else out).astype(jnp.float32)
 
+    def _shard_loss_stacked(self, params, buffers, x, labels):
+        """Uniform-stage pp: ONE stage program per device (no lax.switch),
+        stage params/buffers arriving as [1, ...] slices of the pp-stacked
+        leading axis. Stage 0's layer objects execute every rank's stage —
+        uniformity means only the VALUES differ."""
+        M, pp = self.M, self.pp
+        snap_p = {n: t._data for n, t in self._param_ts.items()}
+        snap_b = {n: t._data for n, t in self._buffer_ts.items()}
+        try:
+            # swap local stage slices into stage-0's tensors
+            for n in self._stage_pnames[0]:
+                self._param_ts[n]._data = params[n][0]
+            for n in self.params:
+                if n not in self._stage_param_set:
+                    self._param_ts[n]._data = params[n]
+            stage = lax.axis_index("pp")
+            Bloc = x.shape[0]
+            Bm = Bloc // M
+            xm = x.reshape(M, Bm, *x.shape[1:])
+            lm = labels.reshape(M, Bm, *labels.shape[1:])
+            bshape = jax.eval_shape(
+                lambda a: self._run_layers(self._stages[0], a),
+                jax.ShapeDtypeStruct(xm.shape[1:], x.dtype))
+            if (bshape.shape, bshape.dtype) != (xm.shape[1:], x.dtype):
+                raise ValueError(
+                    "uniform pipeline stages must map activations to the "
+                    f"same shape/dtype (stage maps {xm.shape[1:]}/{x.dtype}"
+                    f" -> {bshape.shape}/{bshape.dtype})")
+            is_last = stage == pp - 1
+
+            def pipe_step(carry, t):
+                x_in, buf_vals, acc = carry
+                m = jnp.clip(t - stage, 0, M - 1)
+                active = (t - stage >= 0) & (t - stage < M)
+                for n in self._stage_bnames[0]:
+                    self._buffer_ts[n]._data = buf_vals[n][0]
+                xin = jnp.where(stage == 0, xm[m], x_in)
+                y = self._run_layers(self._stages[0], xin)
+                new_b = dict(buf_vals)
+                for n in self._stage_bnames[0]:
+                    upd = self._buffer_ts[n]._data[None]
+                    new_b[n] = jnp.where(active, upd, buf_vals[n])
+                # loss only on the last stage's active ticks: lax.cond,
+                # not a where-mask — intermediate activations may lie
+                # outside loss_fn's domain (log/sqrt) and 0*NaN from a
+                # masked where still poisons the cotangents
+                lval = lax.cond(active & is_last,
+                                lambda: self._loss_arr(y, lm[m]),
+                                lambda: jnp.float32(0.0))
+                acc = acc + lval
+                y_send = lax.ppermute(
+                    y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+                return (y_send, new_b, acc), None
+
+            x_init = jnp.zeros(bshape.shape, bshape.dtype)
+            (_, new_buffers, loss_sum), _ = lax.scan(
+                pipe_step, (x_init, buffers, jnp.float32(0.0)),
+                jnp.arange(M + pp - 1))
+            loss_sum = lax.psum(loss_sum, "pp")
+            return loss_sum / (M * self.dp), new_buffers
+        finally:
+            self._restore(snap_p, snap_b)
+
     def _shard_loss(self, params, buffers, x, labels):
         """Per-(dp,pp)-shard loss; tp stays global (GSPMD). Returns
         (loss, new_buffers)."""
+        if self.pp > 1 and self._pp_stacked:
+            return self._shard_loss_stacked(params, buffers, x, labels)
         M, pp = self.M, self.pp
         snap_p = {n: t._data for n, t in self._param_ts.items()}
         snap_b = {n: t._data for n, t in self._buffer_ts.items()}
@@ -304,10 +468,26 @@ class GenericHybridEngine:
             self._restore(snap_p, snap_b)
 
     # -- step builders ---------------------------------------------------
+    def _manual_pspecs(self):
+        """Per-name manual-axes view of the param/buffer layouts: stacked
+        names carry P('pp') on the leading axis (each rank's slice), the
+        rest are replicated over the manual axes (tp stays GSPMD)."""
+        if self._pp_stacked:
+            pspec = {n: (P("pp") if n in self._stage_param_set else P())
+                     for n in self._specs}
+            bspec = {n: (P("pp") if n in self._stage_buffer_set else P())
+                     for n in self.buffers}
+        else:
+            pspec = {n: P() for n in self._specs}
+            bspec = {n: P() for n in self.buffers}
+        return pspec, bspec
+
     def _build_train(self):
-        specs = self._specs
         hp = self.hp
         manual = frozenset(a for a in ("dp", "pp") if a in self.mesh.axis_names)
+        stacked_p = self._stage_param_set if self._pp_stacked else frozenset()
+        stacked_b = (self._stage_buffer_set if self._pp_stacked
+                     else frozenset())
 
         def per_shard(params, opt, buffers, x, labels, lr):
             def lossf(p):
@@ -316,36 +496,39 @@ class GenericHybridEngine:
 
             (loss, new_buffers), grads = jax.value_and_grad(
                 lossf, has_aux=True)(params)
-            sync_axes = tuple(a for a in ("dp", "pp") if a in manual)
-            if sync_axes:
-                # params are replicated over dp and pp: psum reassembles
-                # per-stage grads (zeros on foreign pp ranks) and sums dp
-                # shards (loss carries the 1/dp pre-scale).
-                grads = jax.tree.map(lambda g: lax.psum(g, sync_axes), grads)
             if "dp" in manual:
+                # dp shards each saw 1/dp of the batch (loss pre-scaled)
+                grads = {n: lax.psum(g, "dp") for n, g in grads.items()}
                 loss = lax.psum(loss, "dp")
             if "pp" in manual:
-                # each buffer is owned by ONE stage: owner has the update,
-                # other pp ranks still hold the old value — psum the deltas
+                # replicated params: psum reassembles per-stage grads
+                # (zeros on foreign pp ranks). Stacked params already hold
+                # exactly their own stage's grads — no pp sync.
+                grads = {n: (g if n in stacked_p else lax.psum(g, "pp"))
+                         for n, g in grads.items()}
                 new_buffers = {
-                    n: buffers[n] + lax.psum(new_buffers[n] - buffers[n],
-                                             "pp")
-                    for n in new_buffers}
+                    n: (v if n in stacked_b
+                        else buffers[n] + lax.psum(v - buffers[n], "pp"))
+                    for n, v in new_buffers.items()}
             if "dp" in manual:
                 # dp ranks saw different data: average the running stats
                 new_buffers = {n: lax.pmean(v, "dp")
                                for n, v in new_buffers.items()}
-            # grads are now fully synced and replicated on the manual axes,
-            # so the global grad-norm² is a plain sum (tp is GSPMD-global).
-            sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                     for g in jax.tree.leaves(grads))
+            # global grad-norm²: stacked slices are pp-local partials,
+            # replicated grads are already identical on every pp rank
+            sq_stacked = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                             for n, g in grads.items() if n in stacked_p)
+            sq_rep = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                         for n, g in grads.items() if n not in stacked_p)
+            if stacked_p and "pp" in manual:
+                sq_stacked = lax.psum(sq_stacked, "pp")
+            sq = sq_stacked + sq_rep
             new_params, new_opt = _adamw_update(params, grads, opt, hp, sq,
                                                 lr=lr)
             return new_params, new_opt, new_buffers, loss
 
-        pspec = {n: P() for n in specs}
+        pspec, bspec = self._manual_pspecs()
         opt_spec = {"m": pspec, "v": pspec, "step": P()}
-        bspec = {n: P() for n in self.buffers}
         data_spec = P("dp") if "dp" in self.mesh.axis_names else P()
         f = jax.shard_map(
             per_shard, mesh=self.mesh,
@@ -363,8 +546,7 @@ class GenericHybridEngine:
                 loss = lax.psum(loss, "dp")
             return loss
 
-        pspec = {n: P() for n in self._specs}
-        bspec = {n: P() for n in self.buffers}
+        pspec, bspec = self._manual_pspecs()
         data_spec = P("dp") if "dp" in self.mesh.axis_names else P()
         f = jax.shard_map(per_shard, mesh=self.mesh,
                           in_specs=(pspec, bspec, data_spec, data_spec),
@@ -402,7 +584,24 @@ class GenericHybridEngine:
 
     def sync_to_layer(self):
         """Write the engine's params/buffers back into the Layer's Tensors
-        (for state_dict / save / eager eval)."""
+        (for state_dict / save / eager eval). Stacked entries unstack onto
+        each stage's original tensors."""
+        if self._pp_stacked:
+            for i, n0 in enumerate(self._stage_pnames[0]):
+                arr = self.params[n0]
+                for s in range(self.pp):
+                    self._param_ts[self._stage_pnames[s][i]]._data = arr[s]
+            for i, n0 in enumerate(self._stage_bnames[0]):
+                arr = self.buffers[n0]
+                for s in range(self.pp):
+                    self._buffer_ts[self._stage_bnames[s][i]]._data = arr[s]
+            for n, t in self._param_ts.items():
+                if n in self.params and n not in self._stage_param_set:
+                    t._data = self.params[n]
+            for n, t in self._buffer_ts.items():
+                if n in self.buffers and n not in self._stage_buffer_set:
+                    t._data = self.buffers[n]
+            return
         for n, t in self._param_ts.items():
             t._data = self.params[n]
         for n, t in self._buffer_ts.items():
@@ -413,6 +612,24 @@ class GenericHybridEngine:
         Tensors (the inverse of sync_to_layer) — used when another engine
         or eager code updated the layer since this engine was built."""
         put = lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s))
+        if self._pp_stacked:
+            self.params = {}
+            for i, n0 in enumerate(self._stage_pnames[0]):
+                self.params[n0] = self._stack_sharded(
+                    [self._param_ts[self._stage_pnames[s][i]]._data
+                     for s in range(self.pp)], self._specs[n0])
+            for n, t in self._param_ts.items():
+                if n in self._specs and n not in self._stage_param_set:
+                    self.params[n] = put(t._data, self._specs[n])
+            self.buffers = {}
+            for i, n0 in enumerate(self._stage_bnames[0]):
+                self.buffers[n0] = self._stack_sharded(
+                    [self._buffer_ts[self._stage_bnames[s][i]]._data
+                     for s in range(self.pp)], self._bspecs[n0])
+            for n, t in self._buffer_ts.items():
+                if n in self._bspecs and n not in self._stage_buffer_set:
+                    self.buffers[n] = put(t._data, P())
+            return
         self.params = {n: put(t._data, self._specs[n])
                        for n, t in self._param_ts.items()}
         self.buffers = {n: put(t._data, P())

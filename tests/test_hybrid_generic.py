@@ -297,3 +297,132 @@ class TestFleetRouting:
         with pytest.raises(NotImplementedError, match="AdamW"):
             model.train_batch([rs.randn(4, 16).astype(np.float32),
                                rs.randint(0, 10, (4,))], opt, loss_fn=ce)
+
+
+def make_uniform_mlp(num_stages=2, width=32):
+    """A truly uniform pipeline: every stage is [Linear(w, w), ReLU] — the
+    stacked-pp path (r4 VERDICT #6) applies."""
+    paddle.seed(0)
+    descs = []
+    for _ in range(num_stages):
+        descs.append(LayerDesc(paddle.nn.Linear, width, width))
+        descs.append(LayerDesc(paddle.nn.ReLU))
+    return PipelineLayer(descs, num_stages=num_stages, seg_method="uniform")
+
+
+class TestStackedPP:
+    """Uniform stages drop the all-stages lax.switch and shard stage
+    params over the pp axis (r4 VERDICT Next #6 acceptance)."""
+
+    def test_uniform_detected_heterogeneous_not(self):
+        e_u = GenericHybridEngine(make_uniform_mlp(2), mesh_of(1, 2, 1), ce)
+        assert e_u._pp_stacked
+        e_h = GenericHybridEngine(make_mlp(2), mesh_of(1, 2, 1), ce)
+        assert not e_h._pp_stacked
+
+    def test_per_device_param_bytes_scale_with_pp(self):
+        """THE memory claim: each device stores ~total/pp of the stage
+        params, not a full replica."""
+        pp = 4
+        e = GenericHybridEngine(make_uniform_mlp(pp), mesh_of(1, pp, 1), ce)
+        total = 0
+        local = 0
+        for n, arr in e.params.items():
+            total += arr.nbytes
+            local += arr.addressable_shards[0].data.nbytes
+        assert local * pp == total, (local, total)
+
+    def test_uniform_parity_vs_single_device(self):
+        rs = np.random.RandomState(11)
+        x = rs.randn(8, 32).astype(np.float32)
+        y = rs.randint(0, 32, (8,))
+        _, l1 = run_engine(make_uniform_mlp(2), mesh_of(1, 1, 1), ce, x, y)
+        e2, l2 = run_engine(make_uniform_mlp(2), mesh_of(1, 2, 1), ce, x, y,
+                            M=2)
+        assert e2._pp_stacked
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
+
+    def test_uniform_parity_dp2_pp2_tp2(self):
+        rs = np.random.RandomState(12)
+        x = rs.randn(8, 32).astype(np.float32)
+        y = rs.randint(0, 32, (8,))
+        _, l1 = run_engine(make_uniform_mlp(2), mesh_of(1, 1, 1), ce, x, y)
+        e8, l8 = run_engine(make_uniform_mlp(2), mesh_of(2, 2, 2), ce, x, y,
+                            M=2)
+        assert e8._pp_stacked
+        np.testing.assert_allclose(l1, l8, rtol=2e-4, atol=2e-4)
+
+    def test_uniform_with_buffers_parity(self):
+        """Per-stage BN buffers live pp-sharded and still match the
+        single-device run."""
+
+        def make_bn_pipe(num_stages=2):
+            paddle.seed(0)
+            descs = []
+            for _ in range(num_stages):
+                descs.append(LayerDesc(paddle.nn.Linear, 16, 16,
+                                       bias_attr=False))
+                descs.append(LayerDesc(paddle.nn.BatchNorm1D, 16))
+                descs.append(LayerDesc(paddle.nn.ReLU))
+            return PipelineLayer(descs, num_stages=num_stages,
+                                 seg_method="uniform")
+
+        rs = np.random.RandomState(13)
+        x = rs.randn(8, 16).astype(np.float32)
+        y = rs.randint(0, 16, (8,))
+        e1, l1 = run_engine(make_bn_pipe(2), mesh_of(1, 1, 1), ce, x, y)
+        e2, l2 = run_engine(make_bn_pipe(2), mesh_of(1, 2, 1), ce, x, y)
+        assert e2._pp_stacked
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
+        # compare buffers through the layer view (stacked layout differs)
+        e1.sync_to_layer()
+        b1 = {n: np.asarray(t.numpy())
+              for n, t in e1.model.named_buffers() if t is not None}
+        e2.sync_to_layer()
+        b2 = {n: np.asarray(t.numpy())
+              for n, t in e2.model.named_buffers() if t is not None}
+        assert set(b1) == set(b2)
+        for n in b1:
+            np.testing.assert_allclose(b1[n], b2[n], rtol=1e-4, atol=1e-5)
+
+    def test_tied_params_fall_back(self):
+        """A tensor shared across stages forbids stacking."""
+        paddle.seed(0)
+        shared = paddle.nn.Linear(16, 16)
+        model = PipelineLayer([LayerDesc(paddle.nn.ReLU)], num_stages=1)
+        # hand-build a 2-stage pipeline sharing one layer object
+        model.run_function = [shared, paddle.nn.ReLU(), shared,
+                              paddle.nn.ReLU()]
+        model._stage_of = [0, 0, 1, 1]
+        model._num_stages = 2
+        e = GenericHybridEngine.__new__(GenericHybridEngine)
+        e._stages = [[shared, model.run_function[1]],
+                     [shared, model.run_function[3]]]
+        e._param_ts = dict(model.named_parameters())
+        e._buffer_ts = {}
+        e._detect_uniform_stages()
+        assert not e._pp_stacked
+
+    def test_loss_under_cond_keeps_parity(self):
+        """The stacked path computes loss inside lax.cond (only the last
+        stage's active ticks) so a partial-domain loss_fn never evaluates
+        on bubble-tick garbage; this locks grad parity for a log-based
+        loss through the cond."""
+
+        def log_loss(out, lab):
+            # requires positive inputs — intermediate Linear outputs are not
+            p = paddle.nn.functional.softmax(out, axis=-1)
+            picked = paddle.sum(
+                p * paddle.nn.functional.one_hot(lab, p.shape[-1]), axis=-1)
+            return -paddle.mean(paddle.log(picked))
+
+        rs = np.random.RandomState(14)
+        x = rs.randn(8, 32).astype(np.float32)
+        y = rs.randint(0, 32, (8,))
+        _, l1 = run_engine(make_uniform_mlp(2), mesh_of(1, 1, 1), log_loss,
+                           x, y)
+        e2, l2 = run_engine(make_uniform_mlp(2), mesh_of(1, 2, 1), log_loss,
+                            x, y, M=2)
+        assert e2._pp_stacked
+        assert np.isfinite(l2).all(), l2
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
