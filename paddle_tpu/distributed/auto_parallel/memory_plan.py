@@ -1,0 +1,100 @@
+"""AOT HBM planning: XLA-measured per-device memory for a parallel config.
+
+VERDICT r4 Next #5 — BASELINE config 4 ("LLaMA-7B/13B, TP+PP hybrid") had
+never been exercised at full parameter count anywhere: the dryruns use toy
+shapes. `jax.jit(...).lower(...).compile().memory_analysis()` proves what
+fits WITHOUT hardware: parameters never materialize (abstract
+ShapeDtypeStructs with NamedShardings), yet XLA runs real SPMD
+partitioning + buffer assignment and reports per-device bytes.
+
+Reference analog: the auto-parallel memory estimation in
+`python/paddle/distributed/auto_parallel/static/cost/estimate_cost.py`
+(analytic) — here the ground truth comes from the compiler itself, and
+tests/test_memory_plan.py cross-checks the analytic CostModel
+(engine.py:131) against it so the Planner can never bless a config XLA
+says OOMs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+V5E_HBM = 16e9
+V5P_HBM = 95e9
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """Per-device bytes for one (dp, pp, tp) config of the flagship step.
+
+    `state_bytes` = the step's arguments (params + AdamW m/v + inputs) —
+    the resident state between steps. `temp_bytes` = XLA's transient
+    buffers (grads, bf16 param copies, remat'd activations).
+    `required_bytes` = args + temps + un-aliased outputs: the conservative
+    per-device HBM requirement (donation aliases outputs onto arguments).
+    """
+
+    dp: int
+    pp: int
+    tp: int
+    micro_batches: int
+    state_bytes: int
+    temp_bytes: int
+    output_bytes: int
+    alias_bytes: int
+
+    @property
+    def required_bytes(self) -> int:
+        return (self.state_bytes + self.temp_bytes
+                + self.output_bytes - self.alias_bytes)
+
+    def fits(self, hbm_bytes: float) -> bool:
+        return self.required_bytes <= hbm_bytes
+
+
+def aot_memory_plan(cfg, dp: int, pp: int, tp: int,
+                    num_microbatches: int = 1,
+                    batch_per_dp: Optional[int] = None,
+                    remat=True, attn_impl: str = "xla") -> MemoryPlan:
+    """Compile the FULL flagship train step at cfg's real parameter count
+    on an abstract (dp, pp, tp) mesh and read XLA's buffer assignment.
+
+    No parameter memory is allocated: inputs are ShapeDtypeStructs. Works
+    on any backend with >= dp*pp*tp devices (the 8-virtual-CPU mesh in
+    tests); compile is seconds because the per-layer scan keeps the
+    program size independent of depth.
+    """
+    from ...models import llama as L
+    from .. import hybrid as H
+
+    mesh = H.build_mesh(dp=dp, pp=pp, tp=tp)
+    step = H.make_train_step(cfg, mesh, num_microbatches=num_microbatches,
+                             remat=remat, attn_impl=attn_impl)
+    shapes = jax.eval_shape(
+        lambda: H.stack_pipeline(L.init_params(cfg, jax.random.PRNGKey(0)),
+                                 pp))
+    specs = H.param_specs(cfg)
+
+    def sds(s, sp, dt=None):
+        return jax.ShapeDtypeStruct(s.shape, dt or s.dtype,
+                                    sharding=NamedSharding(mesh, sp))
+
+    ap = jax.tree.map(sds, shapes, specs)
+    f32 = lambda s, sp: sds(s, sp, jnp.float32)
+    aopt = {"m": jax.tree.map(f32, shapes, specs),
+            "v": jax.tree.map(f32, shapes, specs),
+            "step": jax.ShapeDtypeStruct((), jnp.int32,
+                                         sharding=NamedSharding(mesh, P()))}
+    B = dp * (batch_per_dp or num_microbatches)
+    tok = jax.ShapeDtypeStruct((B, cfg.max_seq_len), jnp.int32,
+                               sharding=NamedSharding(mesh, P("dp", "cp")))
+    ma = step.lower(ap, aopt, tok, tok).compile().memory_analysis()
+    return MemoryPlan(dp=dp, pp=pp, tp=tp, micro_batches=num_microbatches,
+                      state_bytes=ma.argument_size_in_bytes,
+                      temp_bytes=ma.temp_size_in_bytes,
+                      output_bytes=ma.output_size_in_bytes,
+                      alias_bytes=ma.alias_size_in_bytes)

@@ -1,0 +1,133 @@
+"""LLaMA-7B/13B HBM plans from XLA's own buffer assignment (r4 Next #5).
+
+BASELINE config 4 evidence at FULL parameter count: the flagship train
+step is AOT-compiled abstractly for real 7B/13B configs across candidate
+tp×pp(×dp) meshes on the 8-virtual-device handle, and XLA's per-device
+byte counts drive the assertions — including the cross-check that the
+analytic CostModel/Planner (auto_parallel/engine.py) never blesses a
+config XLA says OOMs.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel.engine import (
+    Cluster, CostModel, PlanItem, Planner, Strategy)
+from paddle_tpu.distributed.auto_parallel.memory_plan import (
+    V5E_HBM, V5P_HBM, aot_memory_plan)
+from paddle_tpu.models import llama as L
+
+CANDIDATES = ((1, 2, 4), (1, 4, 2), (2, 2, 2), (1, 1, 8))
+
+
+class _PlanCache:
+    plans = {}
+
+    @classmethod
+    def get(cls, name, dp, pp, tp):
+        key = (name, dp, pp, tp)
+        if key not in cls.plans:
+            cls.plans[key] = aot_memory_plan(L.CONFIGS[name], dp, pp, tp)
+        return cls.plans[key]
+
+
+def _cost(cfg, dp, pp, tp, hbm):
+    cluster = Cluster(n_devices=8, devices_per_host=8, hbm_bytes=hbm)
+    plan = PlanItem(dp=dp, tp=tp, pp=pp, micro_batches=max(1, pp),
+                    sharding_stage=0)
+    T, d = cfg.max_seq_len, cfg.hidden_size
+    act = T * d * 2 * cfg.num_layers + T * cfg.vocab_size * 4
+    return CostModel(cluster).estimate(
+        flops_per_batch=cfg.flops_per_token() * T,
+        param_bytes=cfg.num_params() * 4,
+        act_bytes_per_microbatch=act, plan=plan,
+        n_layers=cfg.num_layers)
+
+
+@pytest.mark.parametrize("name", ["llama-7b", "llama-13b"])
+class TestAotMemoryPlan:
+    def test_state_shards_over_tp_pp(self, name):
+        """Per-device resident state ≈ total AdamW state / (tp·pp) — the
+        sharding really divides the 12-bytes-per-param state."""
+        cfg = L.CONFIGS[name]
+        p = _PlanCache.get(name, 1, 1, 8)
+        total_state = cfg.num_params() * 12  # f32 params + m + v
+        assert abs(p.state_bytes - total_state / 8) / (total_state / 8) < 0.05
+
+    def test_dp_replication_doubles_state(self, name):
+        cfg = L.CONFIGS[name]
+        p8 = _PlanCache.get(name, 1, 2, 4)
+        p_dp2 = _PlanCache.get(name, 2, 2, 2)
+        ratio = p_dp2.state_bytes / p8.state_bytes
+        assert 1.8 < ratio < 2.2, ratio
+
+    def test_fits_v5p_everywhere(self, name):
+        for dp, pp, tp in CANDIDATES:
+            p = _PlanCache.get(name, dp, pp, tp)
+            assert p.fits(V5P_HBM), (name, dp, pp, tp,
+                                     p.required_bytes / 1e9)
+
+    def test_v5e_verdicts(self, name):
+        """The honest 16G story: full-f32-state AdamW training of 7B/13B
+        does NOT fit 8 v5e chips at these configs (state alone is ~81 GB
+        for 7B); dp replication is the worst offender. This is the test
+        that turns 'LLaMA-7B fits' from a hope into a measured claim."""
+        for dp, pp, tp in CANDIDATES:
+            p = _PlanCache.get(name, dp, pp, tp)
+            assert not p.fits(V5E_HBM), (name, dp, pp, tp)
+        p_dp2 = _PlanCache.get(name, 2, 2, 2)
+        assert p_dp2.state_bytes > V5E_HBM  # replication alone busts it
+
+    def test_cost_model_agrees_with_xla(self, name):
+        """CostModel's analytic HBM estimate within 2.5x of XLA's
+        measured requirement AND same fit verdict on both chip budgets."""
+        cfg = L.CONFIGS[name]
+        for dp, pp, tp in CANDIDATES:
+            p = _PlanCache.get(name, dp, pp, tp)
+            for hbm in (V5E_HBM, V5P_HBM):
+                c = _cost(cfg, dp, pp, tp, hbm)
+                ratio = c.memory_bytes / p.required_bytes
+                assert 0.4 < ratio < 2.5, (name, dp, pp, tp, ratio)
+                assert c.fits == p.fits(hbm), (
+                    f"{name} dp{dp}pp{pp}tp{tp} hbm={hbm:.0e}: CostModel "
+                    f"fits={c.fits} ({c.memory_bytes/1e9:.1f}G) but XLA "
+                    f"measures {p.required_bytes/1e9:.1f}G")
+
+
+def test_planner_pick_is_xla_verified():
+    """THE acceptance: whatever the Planner picks for 7B on a v5p-class
+    cluster must fit per XLA's buffer assignment. Fails if the planner
+    ever blesses a config the compiler says OOMs."""
+    cfg = L.CONFIGS["llama-7b"]
+    cluster = Cluster(n_devices=8, devices_per_host=8, hbm_bytes=V5P_HBM,
+                      peak_flops=459e12)
+    T, d = cfg.max_seq_len, cfg.hidden_size
+    act = T * d * 2 * cfg.num_layers + T * cfg.vocab_size * 4
+    pick = Planner(cluster).plan(
+        Strategy(), flops_per_batch=cfg.flops_per_token() * T,
+        param_bytes=cfg.num_params() * 4, act_bytes_per_microbatch=act,
+        n_layers=cfg.num_layers)
+    assert pick.cost.fits
+    if cfg.num_layers % pick.pp:
+        pytest.skip(f"planner chose pp={pick.pp}; layers not divisible")
+    p = aot_memory_plan(cfg, pick.dp, pick.pp, pick.tp)
+    assert p.fits(V5P_HBM), (
+        f"planner blessed dp{pick.dp}pp{pick.pp}tp{pick.tp} but XLA "
+        f"measures {p.required_bytes/1e9:.1f}G > 95G")
+
+
+def test_planner_rejects_everything_on_v5e_7b():
+    """On 16G chips no full-f32-state 7B config fits — the planner must
+    agree (its least-bad fallback is marked fits=False)."""
+    cfg = L.CONFIGS["llama-7b"]
+    cluster = Cluster(n_devices=8, devices_per_host=8, hbm_bytes=V5E_HBM)
+    T, d = cfg.max_seq_len, cfg.hidden_size
+    act = T * d * 2 * cfg.num_layers + T * cfg.vocab_size * 4
+    planner = Planner(cluster)
+    strat = Strategy()
+    for cand in planner.candidates(strat):
+        cand.cost = planner.cost_model.estimate(
+            flops_per_batch=cfg.flops_per_token() * T,
+            param_bytes=cfg.num_params() * 4,
+            act_bytes_per_microbatch=act, plan=cand,
+            n_layers=cfg.num_layers)
+        assert not cand.cost.fits, (cand.dp, cand.pp, cand.tp)
