@@ -55,19 +55,50 @@ def _to_device(collated):
     return collated
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn, worker_id):
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
+                 worker_id, ring_name=None):
+    """ring_name set = shared-memory transport: results are pickled into
+    this worker's SPSC ShmRing (core/native) instead of the mp.Queue —
+    the reference's mmap worker transfer (dataloader_iter.py shared-mem
+    worker pool). The queue stays as the error/fallback channel contract
+    when ring_name is None."""
+    import pickle
+
+    ring = None
+    if ring_name is not None:
+        from ..core import native
+
+        ring = native.ShmRing(ring_name, create=False)
+
+    def emit(payload):
+        if ring is not None:
+            try:
+                ring.push(pickle.dumps(payload, protocol=5))
+                return
+            except ValueError:
+                # batch larger than the ring: the mp.Queue relay is always
+                # drained — fall back for this batch instead of failing
+                pass
+        data_queue.put(payload)
+
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
-    while True:
-        item = index_queue.get()
-        if item is None:
-            break
-        batch_id, indices = item
-        try:
-            samples = [dataset[i] for i in indices]
-            data_queue.put((batch_id, collate_fn(samples), None))
-        except Exception:
-            data_queue.put((batch_id, None, traceback.format_exc()))
+    try:
+        while True:
+            item = index_queue.get()
+            if item is None:
+                break
+            batch_id, indices = item
+            try:
+                samples = [dataset[i] for i in indices]
+                emit((batch_id, collate_fn(samples), None))
+            except Exception:
+                emit((batch_id, None, traceback.format_exc()))
+    except EOFError:
+        return  # parent closed the ring mid-push: teardown in progress
+    finally:
+        if ring is not None:
+            ring.close()
 
 
 class _MultiProcessIter:
@@ -84,6 +115,28 @@ class _MultiProcessIter:
         self._index_queues = [ctx.SimpleQueue() for _ in range(self._num_workers)]
         self._data_queue = ctx.Queue()
         self._workers = []
+        # shared-memory transport (use_shared_memory=True + native lib):
+        # one SPSC ring per worker; drainer threads feed the same receive
+        # path the queue transport uses
+        self._rings = []
+        self._drainers = []
+        ring_names = [None] * self._num_workers
+        if getattr(loader, "use_shared_memory", False):
+            from ..core import native
+
+            if native.available():
+                cap = max(1 << 26, 4 * getattr(loader, "batch_size", 1)
+                          * (1 << 16))
+                self._ring_cap = cap
+                for wid in range(self._num_workers):
+                    name = (f"/ptdl_{os.getpid()}_{id(self) & 0xffffff:x}"
+                            f"_{wid}")
+                    try:
+                        self._rings.append(native.ShmRing(name, capacity=cap,
+                                                          create=True))
+                        ring_names[wid] = name
+                    except OSError:
+                        self._rings.append(None)
         # Workers are numpy-only: force XLA-CPU and strip accelerator-plugin env
         # so child interpreters never touch the device/tunnel at startup.
         scrubbed = {"JAX_PLATFORMS": "cpu"}
@@ -97,7 +150,8 @@ class _MultiProcessIter:
                 w = ctx.Process(
                     target=_worker_loop,
                     args=(loader.dataset, self._index_queues[wid], self._data_queue,
-                          self._collate, loader.worker_init_fn, wid),
+                          self._collate, loader.worker_init_fn, wid,
+                          ring_names[wid]),
                     daemon=True,
                 )
                 w.start()
@@ -108,6 +162,20 @@ class _MultiProcessIter:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+        # one receive funnel: ring drainer threads and the mp.Queue relay
+        # both land results here, so __next__ has a single wait point
+        self._recv_queue: "queue.Queue" = queue.Queue()
+        self._ring_active = any(r is not None for r in self._rings)
+        for ring in self._rings:
+            if ring is None:
+                continue
+            t = threading.Thread(target=self._drain_ring, args=(ring,),
+                                 daemon=True)
+            t.start()
+            self._drainers.append(t)
+        t = threading.Thread(target=self._drain_mp_queue, daemon=True)
+        t.start()
+        self._drainers.append(t)
         self._send_idx = 0
         self._rcv_buffer = {}
         self._next_batch = 0
@@ -115,6 +183,29 @@ class _MultiProcessIter:
         for _ in range(min(self._prefetch_depth, len(self._batches))):
             self._dispatch()
         self._shutdown = False
+
+    def _drain_ring(self, ring):
+        import pickle
+
+        small = 1 << 20
+        while True:
+            try:
+                try:
+                    msg = ring.pop(small)
+                except ValueError:
+                    # message larger than the fast buffer: retry at the
+                    # ring's full capacity (push guarantees <= capacity)
+                    msg = ring.pop(self._ring_cap)
+            except EOFError:
+                return
+            self._recv_queue.put(pickle.loads(msg))
+
+    def _drain_mp_queue(self):
+        while True:
+            item = self._data_queue.get()
+            if item is None:
+                return
+            self._recv_queue.put(item)
 
     def _dispatch(self):
         if self._send_idx < len(self._batches):
@@ -131,7 +222,7 @@ class _MultiProcessIter:
             raise StopIteration
         while self._next_batch not in self._rcv_buffer:
             try:
-                batch_id, data, err = self._data_queue.get(timeout=5.0)
+                batch_id, data, err = self._recv_queue.get(timeout=5.0)
             except queue.Empty:
                 dead = [w for w in self._workers if not w.is_alive()]
                 if dead:
@@ -157,10 +248,26 @@ class _MultiProcessIter:
         self._shutdown = True
         for q in self._index_queues:
             q.put(None)
+        # close rings BEFORE joining: a worker blocked in push on a full
+        # ring wakes with EOF and exits cleanly — terminating it mid-push
+        # would orphan the (non-robust) process-shared mutex and deadlock
+        # every later ring call
+        for ring in self._rings:
+            if ring is not None:
+                ring.close()   # also wakes the drainer with EOF
         for w in self._workers:
             w.join(timeout=2)
             if w.is_alive():
                 w.terminate()
+        try:
+            self._data_queue.put(None)  # wakes the mp-queue relay
+        except Exception:
+            pass
+        for t in self._drainers:
+            t.join(timeout=2)
+        for ring in self._rings:
+            if ring is not None:
+                ring.free()
 
     def __del__(self):
         try:
@@ -301,6 +408,10 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.batch_size = batch_size
         self.drop_last = drop_last
+        # shared-memory worker transport (native ShmRing) when available;
+        # silently falls back to mp.Queue otherwise — paddle's
+        # use_shared_memory contract (reference: reader.py:262)
+        self.use_shared_memory = use_shared_memory
         self._is_iterable = isinstance(dataset, IterableDataset)
         if self._is_iterable:
             self.batch_sampler = None

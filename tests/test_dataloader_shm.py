@@ -1,0 +1,140 @@
+"""DataLoader shared-memory worker transport (r4 VERDICT Next #7).
+
+The native SPSC ShmRing (core/native) is now the worker→parent batch
+channel when use_shared_memory=True — the analog of the reference's mmap
+worker transfer (python/paddle/io/dataloader/dataloader_iter.py). These
+tests run REAL spawned workers over the ring, assert parity with the
+mp.Queue path, exercise in-band worker errors and oversized batches, and
+record the transport-time comparison.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native lib unavailable")
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, shape=(3, 16, 16), seed=0):
+        self.x = np.random.RandomState(seed).rand(n, *shape).astype(
+            np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i % 10)
+
+
+class FailingDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("poisoned sample")
+        return super().__getitem__(i)
+
+
+def _collect(loader):
+    out = []
+    for xb, yb in loader:
+        out.append((np.asarray(xb.numpy()), np.asarray(yb.numpy())))
+    return out
+
+
+@needs_native
+def test_ring_transport_active_and_parity():
+    ds = ArrayDataset()
+    shm = DataLoader(ds, batch_size=8, num_workers=2,
+                     use_shared_memory=True)
+    it = iter(shm)
+    inner = it._inner  # _TimedIter wraps the multiprocess iter
+    assert inner._ring_active, "native path should be active"
+    got_shm = [(x.copy(), y.copy()) for x, y in _iter_np(it)]
+    q = DataLoader(ds, batch_size=8, num_workers=2, use_shared_memory=False)
+    got_q = _collect(q)
+    assert len(got_shm) == len(got_q) == 8
+    for (xa, ya), (xb, yb) in zip(got_shm, got_q):
+        np.testing.assert_allclose(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def _iter_np(it):
+    for xb, yb in it:
+        yield np.asarray(xb.numpy()), np.asarray(yb.numpy())
+
+
+@needs_native
+def test_ring_worker_error_propagates():
+    dl = DataLoader(FailingDataset(), batch_size=4, num_workers=2,
+                    use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="poisoned sample"):
+        _collect(dl)
+
+
+def test_queue_fallback_when_disabled():
+    ds = ArrayDataset(n=16)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    use_shared_memory=False)
+    it = iter(dl)
+    assert not it._inner._ring_active
+    assert len(list(_iter_np(it))) == 4
+
+
+@needs_native
+def test_large_batch_transport():
+    """Multi-megabyte batches flow through the ring (chunked pop path)."""
+    ds = ArrayDataset(n=8, shape=(3, 128, 128))
+    dl = DataLoader(ds, batch_size=4, num_workers=1,
+                    use_shared_memory=True)
+    batches = list(_iter_np(iter(dl)))
+    assert batches[0][0].shape == (4, 3, 128, 128)
+
+
+@needs_native
+def test_transport_timing_recorded():
+    """reader-side wall time for ~100 MB through each transport; the ring
+    must at least be in the same league (hard bound is loose — CI noise),
+    and the measured ratio is printed for the bench record."""
+    ds = ArrayDataset(n=96, shape=(3, 224, 224))  # ~57 MB total
+
+    def run(use_shm):
+        dl = DataLoader(ds, batch_size=16, num_workers=2,
+                        use_shared_memory=use_shm)
+        t0 = time.perf_counter()
+        n = sum(1 for _ in _iter_np(iter(dl)))
+        assert n == 6
+        return time.perf_counter() - t0
+
+    run(False)  # warm spawn caches
+    t_q = min(run(False) for _ in range(2))
+    t_ring = min(run(True) for _ in range(2))
+    print(f"\n[shm-ring] queue={t_q:.3f}s ring={t_ring:.3f}s "
+          f"ratio={t_ring / t_q:.2f}")
+    assert t_ring < 3.0 * t_q
+
+
+class BigDataset(Dataset):
+    """12 MB/sample -> a 96 MB batch exceeds the 64 MB default ring."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return np.full((3, 1024, 1024), float(i), np.float32)
+
+
+@needs_native
+def test_oversized_batch_falls_back_to_queue():
+    """A batch bigger than the ring capacity must still arrive (mp.Queue
+    fallback for that batch), not abort the iteration."""
+    dl = DataLoader(BigDataset(), batch_size=8, num_workers=1,
+                    use_shared_memory=True)
+    batches = [np.asarray(b.numpy()) for b in iter(dl)]
+    assert batches[0].shape == (8, 3, 1024, 1024)
+    np.testing.assert_allclose(batches[0][3, 0, 0, 0], 3.0)
