@@ -26,6 +26,20 @@ from .place import Place, current_place, jax_device
 _name_counter = itertools.count()
 _ops_cache = {}
 
+# SOT capture hook (jit/sot): while a capture/traced pass is active, every
+# tensor→python-scalar conversion routes here so concretizations in NESTED
+# calls are recorded/guarded exactly like top-frame ones. None = inactive.
+_scalar_capture_hook = None
+
+
+def set_scalar_capture_hook(hook):
+    """Install (or clear with None) the scalar-conversion capture hook.
+    Returns the previous hook so callers can nest/restore."""
+    global _scalar_capture_hook
+    prev = _scalar_capture_hook
+    _scalar_capture_hook = hook
+    return prev
+
 
 def _ops():
     """Late import of the op namespace to break the core<->ops cycle."""
@@ -329,15 +343,23 @@ class Tensor:
             raise ValueError(
                 "The truth value of a Tensor with more than one element is ambiguous"
             )
+        if _scalar_capture_hook is not None:
+            return _scalar_capture_hook(self, bool)
         return bool(self.numpy())
 
     def __int__(self):
+        if _scalar_capture_hook is not None:
+            return _scalar_capture_hook(self, int)
         return int(self.item())
 
     def __float__(self):
+        if _scalar_capture_hook is not None:
+            return _scalar_capture_hook(self, float)
         return float(self.item())
 
     def __index__(self):
+        if _scalar_capture_hook is not None:
+            return _scalar_capture_hook(self, int)
         return int(self.item())
 
     def __format__(self, spec):

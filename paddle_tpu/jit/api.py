@@ -70,16 +70,28 @@ class _CacheEntry:
 
 
 class _EagerEntry:
-    """A signature that graph-broke: run the original function eagerly.
-
-    Reference analog: an SOT graph break + eager resume
-    (python/paddle/jit/sot/translate.py:31) — ours breaks at function
-    granularity and remembers why."""
+    """A signature that graph-broke past even the SOT rescue: run the
+    original function eagerly (reference analog: a hard SOT fallback,
+    python/paddle/jit/sot/translate.py:31) and remember why."""
 
     __slots__ = ("reason",)
 
     def __init__(self, reason: str):
         self.reason = reason
+
+
+class _SotEntry:
+    """A signature captured by the SOT bytecode VM (jit/sot): programs
+    are outcome-specialized compiled (fwd, bwd) pairs plus the guard
+    table from the capture pass. Reference analog: the guarded
+    CustomCode cache in sot/opcode_translator/transform.py."""
+
+    __slots__ = ("capture", "programs", "guard_fn")
+
+    def __init__(self, capture, guard_fn):
+        self.capture = capture
+        self.programs: Dict[Any, _CacheEntry] = {}
+        self.guard_fn = guard_fn  # the live function guards re-check
 
 
 class StaticFunction:
@@ -197,6 +209,95 @@ class StaticFunction:
 
         return _CacheEntry(fwd, jax.jit(bwd))
 
+    # -- SOT rescue path (jit/sot bytecode VM) ------------------------------
+
+    def _bound_fn(self, layer):
+        fn = self._fn
+        if layer is not None and getattr(fn, "__self__", None) is None:
+            fn = self._fn.__get__(layer, type(layer))
+        return fn
+
+    def _build_sot_program(self, capture, treedef, const_leaves,
+                           tensor_slots, layer):
+        """One outcome-specialized compiled (fwd, bwd) pair: the bytecode
+        VM re-simulated under the tracer with recorded branch outcomes
+        injected; branch tensors come back as guard outputs."""
+        from . import sot
+
+        params, buffers = self._named_state(layer)
+        param_objs = [p for _, p in params]
+        buffer_objs = [b for _, b in buffers]
+        fn = self._bound_fn(layer)
+
+        def kernel(key_data, param_arrays, buffer_arrays, input_arrays):
+            snap_p = [p._data for p in param_objs]
+            snap_b = [b._data for b in buffer_objs]
+            snap_sg = [p.stop_gradient for p in param_objs]
+            _tls.tracing = getattr(_tls, "tracing", 0) + 1
+            try:
+                for p, arr in zip(param_objs, param_arrays):
+                    p._data = arr
+                for b, arr in zip(buffer_objs, buffer_arrays):
+                    b._data = arr
+                leaves = list(const_leaves)
+                ti = 0
+                for slot in tensor_slots:
+                    leaves[slot] = Tensor._from_data(input_arrays[ti])
+                    ti += 1
+                args2, kw2 = jax.tree.unflatten(treedef, leaves)
+                with rng.scoped_rng_key(key_data), dispatch.no_grad():
+                    ex = sot.OpcodeExecutor(fn, capture, "traced")
+                    out = ex.run(*args2, **kw2)
+                out_arrays = jax.tree.map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=_is_tensor,
+                )
+                new_buffers = [b._data for b in buffer_objs]
+                guard_vals = [g._data for g in ex.guard_outputs]
+                return out_arrays, new_buffers, guard_vals
+            finally:
+                _tls.tracing -= 1
+                for p, arr, sg in zip(param_objs, snap_p, snap_sg):
+                    p._data = arr
+                    p.stop_gradient = sg
+                for b, arr in zip(buffer_objs, snap_b):
+                    b._data = arr
+
+        fwd = jax.jit(kernel)
+
+        def bwd(cots, key_data, param_arrays, buffer_arrays, input_arrays):
+            def fwd_only(pa, ia):
+                out, _, _ = kernel(key_data, pa, buffer_arrays, ia)
+                return out
+
+            _, vjp_fn = jax.vjp(fwd_only, param_arrays, input_arrays)
+            return vjp_fn(cots)
+
+        return _CacheEntry(fwd, jax.jit(bwd))
+
+    def _sot_capture_call(self, sig, layer, args, kwargs, treedef,
+                          const_leaves, tensor_slots):
+        """Concrete VM pass: serves THIS call with eager semantics (tape
+        grads included) while recording outcomes + guards, then compiles
+        the outcome-specialized program for subsequent calls."""
+        from . import sot
+
+        fn = self._bound_fn(layer)
+        cap = sot.Capture()
+        out = sot.OpcodeExecutor(fn, cap, "concrete").run(*args, **kwargs)
+        entry = self._cache.get(sig)
+        if not isinstance(entry, _SotEntry):
+            entry = _SotEntry(cap, fn)
+            self._cache[sig] = entry
+        else:
+            entry.capture = cap
+            entry.guard_fn = fn
+        key = tuple(cap.outcomes)
+        if key not in entry.programs:
+            entry.programs[key] = self._build_sot_program(
+                cap, treedef, const_leaves, tensor_slots, layer)
+        return out
+
     # -- call ----------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         orig_args, orig_kwargs = args, kwargs
@@ -229,23 +330,74 @@ class StaticFunction:
         input_arrays = [t._data for t in input_tensors]
         key_data = jax.random.key_data(rng.next_key())
 
+        bwd_exec = None
         if entry is None:
             # build + first execution together: a capture failure anywhere
             # (untransformable control flow, tracer leaking into python,
-            # branch-structure mismatch, unjittable output) is a GRAPH
-            # BREAK — fall back to running the original function eagerly
-            # (ops dispatch one by one, tape records, grads work) and cache
-            # that decision for this signature. A genuine user bug raises
-            # identically in the eager rerun, so nothing is masked.
+            # branch-structure mismatch, unjittable output) first tries the
+            # SOT bytecode VM (jit/sot) — it compiles tensor-conditioned
+            # control flow with branch-outcome guards — and only if THAT
+            # capture is also impossible falls back to running the original
+            # function eagerly, caching the decision for this signature.
+            # A genuine user bug raises identically either way.
             try:
                 entry = self._build(treedef, const_leaves, tensor_slots, layer)
                 out_arrays, new_buffers = entry.fwd(
                     key_data, param_arrays, buffer_arrays, input_arrays)
             except Exception as e:  # noqa: BLE001 - see above
-                self._cache[sig] = _EagerEntry(f"{type(e).__name__}: {e}")
-                self._graph_breaks.append((sig, f"{type(e).__name__}: {e}"))
-                return self._fn(*orig_args, **orig_kwargs)
+                try:
+                    return self._sot_capture_call(
+                        sig, layer, args, kwargs, treedef, const_leaves,
+                        tensor_slots)
+                except Exception as e2:  # noqa: BLE001 — hard graph break
+                    reason = (f"{type(e).__name__}: {e} | "
+                              f"sot: {type(e2).__name__}: {e2}")
+                    self._cache[sig] = _EagerEntry(reason)
+                    self._graph_breaks.append((sig, reason))
+                    return self._fn(*orig_args, **orig_kwargs)
             self._cache[sig] = entry
+        elif isinstance(entry, _SotEntry):
+            from . import sot
+
+            for kind, name, snap in entry.capture.guard_cells:
+                if not sot.check_guard(kind, name, snap, entry.guard_fn):
+                    # closure/global mutated since capture: re-capture
+                    return self._sot_capture_call(
+                        sig, layer, args, kwargs, treedef, const_leaves,
+                        tensor_slots)
+            prog = entry.programs[tuple(entry.capture.outcomes)]
+            try:
+                out_arrays, new_buffers, guard_vals = prog.fwd(
+                    key_data, param_arrays, buffer_arrays, input_arrays)
+            except Exception as e:  # noqa: BLE001 — a traced-pass capture
+                # gap (e.g. unrecorded concretization in nested code):
+                # terminal for this signature, eager is always valid
+                reason = f"sot traced pass: {type(e).__name__}: {e}"
+                self._cache[sig] = _EagerEntry(reason)
+                self._graph_breaks.append((sig, reason))
+                return self._fn(*orig_args, **orig_kwargs)
+            if not sot.branch_guards_ok(entry.capture.outcomes, guard_vals):
+                # branch flipped: if the observed path is already compiled
+                # run it (validated against its own key) — alternating
+                # inputs then never pay an eager pass
+                hint = sot.observed_outcome_key(entry.capture.outcomes,
+                                                guard_vals)
+                alt = entry.programs.get(hint)
+                served = False
+                if alt is not None:
+                    out_arrays, new_buffers, guard_vals2 = alt.fwd(
+                        key_data, param_arrays, buffer_arrays, input_arrays)
+                    if sot.branch_guards_ok(list(hint), guard_vals2):
+                        bwd_exec = alt.bwd
+                        served = True
+                if not served:
+                    # one concrete pass serves the call and registers the
+                    # new path's program
+                    return self._sot_capture_call(
+                        sig, layer, args, kwargs, treedef, const_leaves,
+                        tensor_slots)
+            else:
+                bwd_exec = prog.bwd
         else:
             out_arrays, new_buffers = entry.fwd(
                 key_data, param_arrays, buffer_arrays, input_arrays)
@@ -280,7 +432,8 @@ class StaticFunction:
             else:
                 edges.append(None)
 
-        bwd_exec = entry.bwd
+        if bwd_exec is None:
+            bwd_exec = entry.bwd
 
         def vjp_fn(cot_tree):
             gp, gi = bwd_exec(cot_tree, key_data, param_arrays, buffer_arrays, input_arrays)

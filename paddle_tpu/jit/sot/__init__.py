@@ -1,36 +1,173 @@
-"""paddle.jit.sot parity surface.
+"""SOT: symbolic opcode translation (bytecode capture VM).
 
-Reference: python/paddle/jit/sot/translate.py:31 — `symbolic_translate`
-wraps a function so its execution is captured opcode-by-opcode with
-guards and graph breaks. Here the same contract is served by the
-dy2static AST converter (jit/dy2static): data-dependent control flow
-compiles, anything else graph-breaks to eager. This module maps the SOT
-entry points onto that machinery so SOT-style callers work unchanged.
+Reference: `python/paddle/jit/sot/translate.py:31` symbolic_translate,
+`opcode_translator/executor/opcode_executor.py` (frame simulation),
+`guard.py` (guard table), `pycode_generator.py` (resume functions).
+
+TPU-native architecture (see opcode_executor.py for the full story): the
+VM simulates the function's bytecode twice —
+
+1. **concrete pass** (first call / after a guard miss): real tensors,
+   eager dispatch, full Python semantics. Tensor→scalar uses are
+   recorded as branch outcomes; closure/global reads become guards.
+   The pass's outputs ARE that call's results (eager parity).
+2. **traced pass** (compilation): the same bytecode re-simulated inside
+   `jax.jit` with the recorded outcomes injected, producing ONE
+   outcome-specialized XLA program per (input signature × branch path).
+   Branch tensors are extra outputs; every compiled call re-checks them
+   against the recorded outcomes, so a flipped branch falls back to one
+   concrete pass and picks (or captures) the program for the new path —
+   the role of the reference's resume-function chain.
+
+`symbolic_translate` wraps a plain function in this machinery;
+`paddle.jit.to_static` uses the same VM as its rescue path when direct
+tracing graph-breaks (jit/api.py `_build_sot`).
 """
 from __future__ import annotations
 
 import functools
+from typing import Any, Dict, List, Tuple
 
-from ..dy2static import TransformError, transform_function
+import jax
 
-__all__ = ["symbolic_translate"]
+from ...core.tensor import Tensor
+from .opcode_executor import (  # noqa: F401
+    Capture,
+    GuardViolated,
+    OpcodeExecutor,
+    SotUnsupported,
+    branch_guards_ok,
+    check_guard,
+    observed_outcome_key,
+    _snapshot,
+)
+
+__all__ = ["symbolic_translate", "SotUnsupported", "Capture",
+           "OpcodeExecutor", "branch_guards_ok", "check_guard",
+           "observed_outcome_key"]
+
+
+class SotFunction:
+    """Guarded, self-caching compiled wrapper for a plain function
+    (tensor-in/tensor-out; Layer state goes through jit/api.py instead).
+    """
+
+    def __init__(self, fn):
+        self._fn = getattr(fn, "__func__", fn)
+        self._bound_self = getattr(fn, "__self__", None)
+        # sig -> {"capture": Capture, "programs": {outcome_key: jitted}}
+        self._cache: Dict[Any, Dict[str, Any]] = {}
+        functools.update_wrapper(self, self._fn)
+
+    # -- capture / compile -------------------------------------------------
+
+    def _sig(self, flat):
+        return tuple(
+            (tuple(x._data.shape), str(x._data.dtype))
+            if isinstance(x, Tensor) else ("py", repr(x))
+            for x in flat)
+
+    def _concrete_pass(self, args, kwargs):
+        cap = Capture()
+        fn = (self._fn.__get__(self._bound_self)
+              if self._bound_self is not None else self._fn)
+        out = OpcodeExecutor(fn, cap, "concrete").run(*args, **kwargs)
+        return cap, out
+
+    def _compile(self, cap, treedef, const_leaves, tensor_slots):
+        fn = (self._fn.__get__(self._bound_self)
+              if self._bound_self is not None else self._fn)
+
+        def kernel(arrays):
+            leaves = list(const_leaves)
+            for slot, arr in zip(tensor_slots, arrays):
+                leaves[slot] = Tensor._from_data(arr)
+            args2, kw2 = jax.tree.unflatten(treedef, leaves)
+            ex = OpcodeExecutor(fn, cap, "traced")
+            out = ex.run(*args2, **kw2)
+            out_arrays = jax.tree.map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            return out_arrays, [g._data for g in ex.guard_outputs]
+
+        return jax.jit(kernel)
+
+    # -- call --------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        flat, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_slots = [i for i, v in enumerate(flat)
+                        if isinstance(v, Tensor)]
+        const_leaves = [None if i in tensor_slots else v
+                        for i, v in enumerate(flat)]
+        arrays = [flat[i]._data for i in tensor_slots]
+        sig = self._sig(flat)
+        state = self._cache.get(sig)
+        fn = (self._fn.__get__(self._bound_self)
+              if self._bound_self is not None else self._fn)
+
+        def capture_now():
+            cap, out = self._concrete_pass(args, kwargs)
+            key = tuple(cap.outcomes)
+            st = self._cache.setdefault(sig,
+                                        {"capture": cap, "programs": {}})
+            st["capture"] = cap
+            if key not in st["programs"]:
+                st["programs"][key] = self._compile(
+                    cap, treedef, const_leaves, tensor_slots)
+            return out
+
+        if state is None:
+            return capture_now()
+        if any(isinstance(f, Tensor) and not f.stop_gradient for f in flat):
+            # the compiled path returns detached outputs (no GradNode is
+            # built here — the full grad plumbing lives in jit/api.py's
+            # to_static integration); differentiable inputs always take
+            # the concrete pass so the eager tape carries gradients
+            return capture_now()
+        cap = state["capture"]
+        for kind, name, snap in cap.guard_cells:
+            if not check_guard(kind, name, snap, fn):
+                # closure/global mutated: whole entry invalid
+                del self._cache[sig]
+                return capture_now()
+        program = state["programs"].get(tuple(cap.outcomes))
+        if program is None:
+            return capture_now()
+        try:
+            out_arrays, guard_vals = program(arrays)
+        except Exception:  # noqa: BLE001 — traced-pass capture gap
+            # (e.g. an unrecorded concretization): eager is always valid
+            del self._cache[sig]
+            return OpcodeExecutor(fn, Capture(), "concrete").run(
+                *args, **kwargs)
+        if not branch_guards_ok(cap.outcomes, guard_vals):
+            # a branch flipped. The observed outcomes are a lookup HINT
+            # (trustworthy only up to the first divergence): if that path
+            # is already compiled, run it and validate against ITS OWN
+            # key — alternating inputs then never pay an eager pass.
+            hint = observed_outcome_key(cap.outcomes, guard_vals)
+            alt = state["programs"].get(hint)
+            if alt is not None:
+                out_arrays, guard_vals2 = alt(arrays)
+                if branch_guards_ok(list(hint), guard_vals2):
+                    return jax.tree.map(
+                        lambda a: Tensor._from_data(a)
+                        if hasattr(a, "dtype") else a, out_arrays)
+            # one concrete pass serves this call + captures the new path
+            return capture_now()
+        return jax.tree.map(
+            lambda a: Tensor._from_data(a) if hasattr(a, "dtype") else a,
+            out_arrays)
+
+    @property
+    def program_count(self):
+        return sum(len(s["programs"]) for s in self._cache.values())
 
 
 def symbolic_translate(fn, training: bool = False, **kwargs):
-    """Reference: sot/translate.py symbolic_translate(fn) -> callable.
-
-    Returns the AST-converted function (control flow lowered to XLA
-    select / lax.while_loop when traced); untransformable functions run
-    unchanged — the graph-break behavior then lives at the to_static
-    layer that traces them.
-    """
-    try:
-        out = transform_function(fn)
-    except TransformError:
+    """Reference: sot/translate.py symbolic_translate(fn) -> callable."""
+    if isinstance(fn, SotFunction):
         return fn
-
-    @functools.wraps(fn)
-    def wrapper(*args, **kw):
-        return out(*args, **kw)
-
-    return wrapper
+    return SotFunction(fn)

@@ -1,0 +1,744 @@
+"""SOT opcode executor: a CPython 3.12 bytecode VM for graph capture.
+
+Reference analog: `python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py` (the frame simulator) + `guard.py` (the guard table)
++ the resume-function machinery in `pycode_generator.py`. The TPU-native
+re-design collapses those ~35k LoC onto the substrate this framework
+already has — eager ops are jax-traceable — so the VM's job is ONLY the
+Python-level semantics the tracer cannot see:
+
+* **concretization points**: `bool(t)` / `float(t)` / `int(t)` /
+  `len(t)` on a Tensor and tensor-conditioned jumps. In CONCRETE mode
+  (capture) the real value is available: the VM records the outcome and
+  keeps simulating — the graph does not break. In TRACED mode (inside
+  `jax.jit`) the recorded outcome is injected as a compile-time constant
+  and the branch tensor is emitted as a guard output, so the compiled
+  program checks its own branch assumptions every call (the reference's
+  resume-function chain becomes outcome-specialized whole programs).
+* **guard sources**: every LOAD_DEREF / LOAD_GLOBAL of a non-callable
+  value is recorded with a snapshot, so closure-cell or global mutation
+  invalidates the cache entry (the reference's GuardedFunctions).
+* **bytecode-only features**: exception tables (try/except/finally on
+  3.12 has no SETUP_* opcodes), `with`, loops over concrete iterables,
+  inner MAKE_FUNCTION closures — all simulated faithfully; anything
+  outside the supported subset raises SotUnsupported and the caller
+  falls back (translate.py decides eager vs AST).
+
+Simulation depth: the VM simulates the TOP frame; calls execute natively
+(nested tensor ops are traced anyway — the jit sees through them). A
+concretization INSIDE a nested call is caught by the scalar-conversion
+hook the VM installs for the duration of run()
+(`core.tensor.set_scalar_capture_hook`), so a helper doing `int(x)` or
+`bool(x)` records/guards exactly like top-frame code instead of silently
+baking.
+"""
+from __future__ import annotations
+
+import dis
+import operator
+import sys
+import types
+from typing import Any, Dict, List, Optional
+
+from ...core.tensor import Tensor
+
+
+class SotUnsupported(Exception):
+    """Bytecode/feature outside the VM subset — caller should fall back."""
+
+
+class GuardViolated(Exception):
+    pass
+
+
+class _Null:
+    """The PUSH_NULL sentinel (CPython's internal NULL)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<NULL>"
+
+
+NULL = _Null()
+
+
+_BINARY_OPS = {
+    0: operator.add, 1: operator.and_, 2: operator.floordiv,
+    3: operator.lshift, 4: operator.matmul, 5: operator.mul,
+    6: operator.mod, 7: operator.or_, 8: operator.pow, 9: operator.rshift,
+    10: operator.sub, 11: operator.truediv, 12: operator.xor,
+    # inplace variants: same function — the VM works on values, and
+    # Tensors implement __iadd__ as functional rebind anyway
+    13: operator.iadd, 14: operator.iand, 15: operator.ifloordiv,
+    16: operator.ilshift, 17: operator.imatmul, 18: operator.imul,
+    19: operator.imod, 20: operator.ior, 21: operator.ipow,
+    22: operator.irshift, 23: operator.isub, 24: operator.itruediv,
+    25: operator.ixor,
+}
+
+_COMPARES = {
+    "<": operator.lt, "<=": operator.le, "==": operator.eq,
+    "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
+}
+
+_INTRINSIC_1 = {
+    1: lambda v: print(v),       # INTRINSIC_PRINT (interactive only)
+    2: None,                     # INTRINSIC_IMPORT_STAR — unsupported
+    5: operator.pos,             # INTRINSIC_UNARY_POSITIVE
+    6: list,                     # INTRINSIC_LIST_TO_TUPLE (tuple())
+}
+
+_SCALAR_BUILTINS = (bool, float, int, len)
+
+
+class Capture:
+    """What a concrete VM pass learned: branch outcomes in encounter
+    order + guard sources (closure/global snapshots)."""
+
+    def __init__(self):
+        self.outcomes: List[Any] = []       # concrete python scalars
+        self.guard_cells: List[tuple] = []  # (kind, name, snapshot)
+        self.break_tensors_spec: List[str] = []  # op names, for debugging
+
+    def record_outcome(self, val, tensor, why: str):
+        self.outcomes.append(val)
+        self.break_tensors_spec.append(why)
+        return val
+
+
+class OpcodeExecutor:
+    """Simulate one code object. mode="concrete": real values, outcomes
+    recorded into `capture`. mode="traced": tensors are tracer-backed,
+    concretizations consume capture.outcomes and append the branch tensor
+    to `guard_outputs` (checked against the recorded outcome at runtime).
+    """
+
+    def __init__(self, fn, capture: Capture, mode: str = "concrete"):
+        # bound methods: remember the receiver BEFORE unwrapping __func__
+        self._self_obj = getattr(fn, "__self__", None)
+        if not isinstance(fn, types.FunctionType):
+            fn = getattr(fn, "__func__", None) or fn
+        if not isinstance(fn, types.FunctionType):
+            raise SotUnsupported(f"not a plain function: {fn!r}")
+        self.fn = fn
+        self.code = fn.__code__
+        if self.code.co_flags & (0x20 | 0x80 | 0x200):
+            # generator / coroutine / async generator
+            raise SotUnsupported("generator/coroutine frames")
+        self.capture = capture
+        self.mode = mode
+        self.guard_outputs: List[Any] = []   # traced branch tensors
+        self._outcome_idx = 0
+        bc = dis.Bytecode(self.code)
+        self.instructions = list(bc)
+        self.by_offset = {i.offset: idx
+                          for idx, i in enumerate(self.instructions)}
+        self.exc_table = list(getattr(bc, "exception_entries", []))
+
+    # -- concretization ---------------------------------------------------
+    #
+    # * top-frame ``float(t)`` stays SYMBOLIC (a 0-d tensor): python
+    #   arithmetic on it keeps tracing — no value baked, no per-value
+    #   recompile (torch's SymFloat idea).
+    # * ``bool(t)`` / jumps record the BRANCH outcome; the compiled
+    #   program re-emits the branch tensor and the runtime check compares
+    #   bool(value), so any same-path input reuses the program.
+    # * ``int(t)`` (and ``float(t)`` reached through Tensor.__float__ in
+    #   NESTED calls, where python forces a real float) record the exact
+    #   value; a changed value recaptures. Float guards compare with a
+    #   small tolerance — eager vs XLA may differ in the last ulp and an
+    #   exact compare would recapture every call.
+    #
+    # Nested-call conversions are caught by the core.tensor scalar hook
+    # installed for the duration of run(), so a helper doing ``int(t)``
+    # guards exactly like top-frame code.
+
+    def _record_or_inject(self, tensor, to, why):
+        if self.mode == "concrete":
+            # bypass the hook for the real conversion (we ARE the hook)
+            val = _raw_convert(tensor, to)
+            return self.capture.record_outcome((to.__name__, val), tensor,
+                                               why)[1]
+        if self._outcome_idx >= len(self.capture.outcomes):
+            raise SotUnsupported("traced pass hit an unrecorded branch")
+        kind, val = self.capture.outcomes[self._outcome_idx]
+        if kind != to.__name__:
+            raise SotUnsupported(
+                f"traced pass diverged: expected {kind}, hit {to.__name__}")
+        self._outcome_idx += 1
+        self.guard_outputs.append(tensor)
+        return val
+
+    def _concretize(self, tensor, to, why):
+        if to is float:
+            import numpy as _np
+
+            if int(_np.prod(tensor.shape)) != 1:
+                raise TypeError("only 1-element tensors convert to float")
+            out = tensor.reshape([])
+            if not _np.issubdtype(_np.dtype(str(out._data.dtype)),
+                                  _np.floating):
+                out = out.astype("float32")
+            return out
+        return self._record_or_inject(tensor, to, why)
+
+    def _scalarize(self, v, to, why):
+        if isinstance(v, Tensor):
+            return self._record_or_inject(v, to, why)
+        return to(v)
+
+    def _hook(self, tensor, to):
+        """core.tensor scalar-conversion hook: a nested call concretized a
+        tensor. Python forces the real type here, so even float() records
+        an exact-value outcome."""
+        return self._record_or_inject(tensor, to, f"nested_{to.__name__}")
+
+    # -- frame setup ------------------------------------------------------
+
+    def run(self, *args, **kwargs):
+        code = self.code
+        fn = self.fn
+        if self._self_obj is not None:
+            args = (self._self_obj,) + args
+        # bind arguments (positional + defaults + kwonly); *args/**kwargs
+        narg = code.co_argcount
+        nkwonly = code.co_kwonlyargcount
+        varnames = code.co_varnames
+        local: Dict[str, Any] = {}
+        pos = list(args)
+        has_varargs = bool(code.co_flags & 0x04)
+        has_varkw = bool(code.co_flags & 0x08)
+        for i in range(narg):
+            name = varnames[i]
+            if i < len(pos):
+                local[name] = pos[i]
+            elif name in kwargs:
+                local[name] = kwargs.pop(name)
+            else:
+                defaults = fn.__defaults__ or ()
+                j = i - (narg - len(defaults))
+                if j < 0:
+                    raise TypeError(f"missing argument {name!r}")
+                local[name] = defaults[j]
+        extra = tuple(pos[narg:])
+        if has_varargs:
+            local[varnames[narg + nkwonly]] = extra
+        elif extra:
+            raise TypeError("too many positional arguments")
+        for i in range(narg, narg + nkwonly):
+            name = varnames[i]
+            if name in kwargs:
+                local[name] = kwargs.pop(name)
+            else:
+                kwd = fn.__kwdefaults__ or {}
+                if name not in kwd:
+                    raise TypeError(f"missing kwonly argument {name!r}")
+                local[name] = kwd[name]
+        if has_varkw:
+            local[varnames[narg + nkwonly + has_varargs]] = dict(kwargs)
+        elif kwargs:
+            raise TypeError(f"unexpected kwargs {list(kwargs)}")
+        # cells: MAKE_CELL creates them; freevars come from __closure__
+        cells: Dict[str, Any] = {}
+        closure = fn.__closure__ or ()
+        for name, cell in zip(code.co_freevars, closure):
+            cells[name] = cell
+        from ...core import tensor as _tensor_mod
+
+        prev_hook = _tensor_mod.set_scalar_capture_hook(self._hook)
+        try:
+            return self._execute(local, cells)
+        finally:
+            _tensor_mod.set_scalar_capture_hook(prev_hook)
+
+    # -- main loop --------------------------------------------------------
+
+    def _execute(self, local, cells):
+        stack: List[Any] = []
+        blocks: List[Any] = []  # exception handler state
+        fn = self.fn
+        glb = fn.__globals__
+        idx = 0
+        kw_names: tuple = ()
+        instrs = self.instructions
+        n = len(instrs)
+
+        def jump_to(offset):
+            nonlocal idx
+            idx = self.by_offset[offset]
+
+        while idx < n:
+            ins = instrs[idx]
+            op = ins.opname
+            arg = ins.arg
+            val = ins.argval
+            idx += 1
+            try:
+                # ---- loads / stores ----
+                if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                          "MAKE_CELL", "COPY_FREE_VARS", "EXTENDED_ARG"):
+                    if op == "MAKE_CELL":
+                        cells[val] = types.CellType(local.get(val))
+                    continue
+                if op == "LOAD_CONST":
+                    stack.append(val)
+                elif op == "RETURN_CONST":
+                    return val
+                elif op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
+                    if val in cells:
+                        stack.append(cells[val])  # closure slot (3.12)
+                    elif val in local:
+                        stack.append(local[val])
+                    else:
+                        raise UnboundLocalError(val)
+                elif op == "LOAD_FAST_AND_CLEAR":
+                    stack.append(local.pop(val, NULL))
+                elif op == "STORE_FAST":
+                    v = stack.pop()
+                    if val in cells:
+                        cells[val].cell_contents = v
+                    else:
+                        local[val] = v
+                elif op == "DELETE_FAST":
+                    del local[val]
+                elif op == "LOAD_GLOBAL":
+                    if arg & 1:
+                        stack.append(NULL)
+                    name = val
+                    if name in glb:
+                        v = glb[name]
+                        src = "global"
+                    elif name in glb.get("__builtins__", {}) if isinstance(
+                            glb.get("__builtins__"), dict) else hasattr(
+                            glb.get("__builtins__", object()), name):
+                        bi = glb.get("__builtins__")
+                        v = (bi[name] if isinstance(bi, dict)
+                             else getattr(bi, name))
+                        src = "builtin"
+                    else:
+                        import builtins
+
+                        v = getattr(builtins, name)
+                        src = "builtin"
+                    if self.mode == "concrete" and src == "global" \
+                            and not callable(v) \
+                            and not isinstance(v, types.ModuleType):
+                        self.capture.guard_cells.append(
+                            ("global", name, _snapshot(v)))
+                    stack.append(v)
+                elif op == "STORE_GLOBAL":
+                    glb[val] = stack.pop()
+                elif op == "LOAD_DEREF":
+                    cell = cells.get(val)
+                    if cell is None:
+                        raise SotUnsupported(f"unbound deref {val}")
+                    v = cell.cell_contents
+                    # guard FREE variables only: cellvars are frame-local
+                    # state this very frame recreates (guarding them would
+                    # never validate — check_guard sees co_freevars)
+                    if self.mode == "concrete" and not callable(v) \
+                            and val in self.code.co_freevars:
+                        self.capture.guard_cells.append(
+                            ("deref", val, _snapshot(v)))
+                    stack.append(v)
+                elif op == "STORE_DEREF":
+                    v = stack.pop()
+                    if val in cells:
+                        cells[val].cell_contents = v
+                    else:
+                        cells[val] = types.CellType(v)
+                elif op == "LOAD_CLOSURE":
+                    stack.append(cells[val])
+                elif op == "LOAD_ATTR":
+                    obj = stack.pop()
+                    if arg & 1:
+                        # method form: CPython pushes (unbound, self) or
+                        # (NULL, attr). Bound-method + NULL is equivalent
+                        # under our CALL and needs no descriptor peeking.
+                        stack.append(NULL)
+                        stack.append(getattr(obj, val))
+                    else:
+                        stack.append(getattr(obj, val))
+                elif op == "STORE_ATTR":
+                    obj = stack.pop()
+                    v = stack.pop()
+                    setattr(obj, val, v)
+                elif op == "LOAD_NAME":
+                    if val in local:
+                        stack.append(local[val])
+                    else:
+                        import builtins
+
+                        stack.append(glb.get(val, getattr(builtins, val,
+                                                          None)))
+                # ---- stack ops ----
+                elif op == "POP_TOP":
+                    stack.pop()
+                elif op == "PUSH_NULL":
+                    stack.append(NULL)
+                elif op == "COPY":
+                    stack.append(stack[-arg])
+                elif op == "SWAP":
+                    stack[-1], stack[-arg] = stack[-arg], stack[-1]
+                # ---- build / unpack ----
+                elif op == "BUILD_TUPLE":
+                    items = _popn(stack, arg)
+                    stack.append(tuple(items))
+                elif op == "BUILD_LIST":
+                    stack.append(_popn(stack, arg))
+                elif op == "BUILD_SET":
+                    stack.append(set(_popn(stack, arg)))
+                elif op == "BUILD_MAP":
+                    items = _popn(stack, 2 * arg)
+                    stack.append({items[2 * i]: items[2 * i + 1]
+                                  for i in range(arg)})
+                elif op == "BUILD_CONST_KEY_MAP":
+                    keys = stack.pop()
+                    vals = _popn(stack, arg)
+                    stack.append(dict(zip(keys, vals)))
+                elif op == "BUILD_SLICE":
+                    items = _popn(stack, arg)
+                    stack.append(slice(*items))
+                elif op == "BUILD_STRING":
+                    items = _popn(stack, arg)
+                    stack.append("".join(items))
+                elif op == "FORMAT_VALUE":
+                    flags = arg
+                    spec = stack.pop() if flags & 0x04 else ""
+                    v = stack.pop()
+                    conv = flags & 0x03
+                    if conv == 1:
+                        v = str(v)
+                    elif conv == 2:
+                        v = repr(v)
+                    elif conv == 3:
+                        v = ascii(v)
+                    stack.append(format(v, spec))
+                elif op == "LIST_EXTEND":
+                    seq = stack.pop()
+                    stack[-arg].extend(seq)
+                elif op == "LIST_APPEND":
+                    v = stack.pop()
+                    stack[-arg].append(v)
+                elif op == "SET_UPDATE":
+                    seq = stack.pop()
+                    stack[-arg].update(seq)
+                elif op == "SET_ADD":
+                    v = stack.pop()
+                    stack[-arg].add(v)
+                elif op == "MAP_ADD":
+                    v = stack.pop()
+                    k = stack.pop()
+                    stack[-arg][k] = v
+                elif op in ("DICT_UPDATE", "DICT_MERGE"):
+                    other = stack.pop()
+                    stack[-arg].update(other)
+                elif op == "UNPACK_SEQUENCE":
+                    seq = stack.pop()
+                    items = list(seq)
+                    if len(items) != arg:
+                        raise ValueError("unpack length mismatch")
+                    stack.extend(reversed(items))
+                elif op == "UNPACK_EX":
+                    seq = list(stack.pop())
+                    before = arg & 0xFF
+                    after = arg >> 8
+                    mid = seq[before:len(seq) - after]
+                    out = seq[:before] + [mid] + (seq[len(seq) - after:]
+                                                  if after else [])
+                    stack.extend(reversed(out))
+                # ---- operators ----
+                elif op == "BINARY_OP":
+                    b = stack.pop()
+                    a = stack.pop()
+                    stack.append(_BINARY_OPS[arg](a, b))
+                elif op == "BINARY_SUBSCR":
+                    k = stack.pop()
+                    obj = stack.pop()
+                    stack.append(obj[k])
+                elif op == "STORE_SUBSCR":
+                    k = stack.pop()
+                    obj = stack.pop()
+                    v = stack.pop()
+                    obj[k] = v
+                elif op == "DELETE_SUBSCR":
+                    k = stack.pop()
+                    obj = stack.pop()
+                    del obj[k]
+                elif op == "BINARY_SLICE":
+                    end = stack.pop()
+                    start = stack.pop()
+                    obj = stack.pop()
+                    stack.append(obj[start:end])
+                elif op == "STORE_SLICE":
+                    end = stack.pop()
+                    start = stack.pop()
+                    obj = stack.pop()
+                    v = stack.pop()
+                    obj[start:end] = v
+                elif op == "UNARY_NEGATIVE":
+                    stack.append(-stack.pop())
+                elif op == "UNARY_INVERT":
+                    stack.append(~stack.pop())
+                elif op == "UNARY_NOT":
+                    v = stack.pop()
+                    stack.append(not self._scalarize(v, bool, "not"))
+                elif op == "COMPARE_OP":
+                    b = stack.pop()
+                    a = stack.pop()
+                    cmp = val if isinstance(val, str) else val
+                    stack.append(_COMPARES[cmp](a, b))
+                elif op == "IS_OP":
+                    b = stack.pop()
+                    a = stack.pop()
+                    stack.append((a is not b) if arg else (a is b))
+                elif op == "CONTAINS_OP":
+                    b = stack.pop()
+                    a = stack.pop()
+                    r = a in b
+                    stack.append((not r) if arg else r)
+                elif op == "CALL_INTRINSIC_1":
+                    f = _INTRINSIC_1.get(arg)
+                    if f is None:
+                        raise SotUnsupported(f"intrinsic {arg}")
+                    v = stack.pop()
+                    stack.append(tuple(v) if arg == 6 else f(v))
+                # ---- calls ----
+                elif op == "KW_NAMES":
+                    kw_names = val
+                elif op == "CALL":
+                    nargs = arg
+                    kwn = kw_names
+                    kw_names = ()
+                    args_ = _popn(stack, nargs)
+                    b = stack.pop()
+                    a = stack.pop()
+                    if a is NULL:
+                        callee, callargs = b, args_
+                    else:
+                        callee, callargs = a, [b] + args_
+                    kwargs_ = {}
+                    if kwn:
+                        kwvals = callargs[len(callargs) - len(kwn):]
+                        callargs = callargs[:len(callargs) - len(kwn)]
+                        kwargs_ = dict(zip(kwn, kwvals))
+                    stack.append(self._call(callee, callargs, kwargs_))
+                elif op == "CALL_FUNCTION_EX":
+                    kwargs_ = stack.pop() if arg & 1 else {}
+                    args_ = stack.pop()
+                    callee = stack.pop()
+                    if stack and stack[-1] is NULL:
+                        stack.pop()
+                    stack.append(self._call(callee, list(args_),
+                                            dict(kwargs_)))
+                elif op == "MAKE_FUNCTION":
+                    code_obj = stack.pop()
+                    closure = stack.pop() if arg & 0x08 else None
+                    ann = stack.pop() if arg & 0x04 else None
+                    kwd = stack.pop() if arg & 0x02 else None
+                    dflt = stack.pop() if arg & 0x01 else None
+                    f = types.FunctionType(code_obj, glb,
+                                           code_obj.co_name, dflt,
+                                           closure)
+                    if kwd:
+                        f.__kwdefaults__ = kwd
+                    stack.append(f)
+                elif op == "RETURN_VALUE":
+                    return stack.pop()
+                # ---- jumps / loops ----
+                elif op == "JUMP_FORWARD" or op == "JUMP_BACKWARD" \
+                        or op == "JUMP_BACKWARD_NO_INTERRUPT":
+                    jump_to(val)
+                elif op == "POP_JUMP_IF_TRUE":
+                    v = stack.pop()
+                    if self._scalarize(v, bool, "jump_if_true"):
+                        jump_to(val)
+                elif op == "POP_JUMP_IF_FALSE":
+                    v = stack.pop()
+                    if not self._scalarize(v, bool, "jump_if_false"):
+                        jump_to(val)
+                elif op == "POP_JUMP_IF_NONE":
+                    if stack.pop() is None:
+                        jump_to(val)
+                elif op == "POP_JUMP_IF_NOT_NONE":
+                    if stack.pop() is not None:
+                        jump_to(val)
+                elif op == "GET_ITER":
+                    stack.append(iter(stack.pop()))
+                elif op == "FOR_ITER":
+                    it = stack[-1]
+                    try:
+                        stack.append(next(it))
+                    except StopIteration:
+                        stack.append(NULL)  # consumed by END_FOR
+                        jump_to(val)
+                elif op == "END_FOR":
+                    stack.pop()
+                    stack.pop()
+                # ---- exceptions (3.12 zero-cost try) ----
+                elif op == "PUSH_EXC_INFO":
+                    v = stack.pop()
+                    blocks.append(sys.exc_info()[1])
+                    stack.append(blocks[-1] if blocks[-1] is not None
+                                 else None)
+                    stack.append(v)
+                elif op == "CHECK_EXC_MATCH":
+                    etype = stack.pop()
+                    exc = stack[-1]
+                    stack.append(isinstance(exc, etype))
+                elif op == "POP_EXCEPT":
+                    if blocks:
+                        blocks.pop()
+                    stack.pop()
+                elif op == "RERAISE":
+                    exc = stack.pop()
+                    if arg:
+                        stack.pop()  # saved lasti — meaningless to the VM
+                    raise exc
+                elif op == "RAISE_VARARGS":
+                    if arg == 0:
+                        raise SotUnsupported("bare raise outside handler")
+                    elif arg == 1:
+                        exc = stack.pop()
+                        raise exc if isinstance(exc, BaseException) \
+                            else exc()
+                    else:
+                        cause = stack.pop()
+                        exc = stack.pop()
+                        exc = exc if isinstance(exc, BaseException) else exc()
+                        exc.__cause__ = cause
+                        raise exc
+                elif op == "LOAD_ASSERTION_ERROR":
+                    stack.append(AssertionError)
+                # ---- with ----
+                elif op == "BEFORE_WITH":
+                    mgr = stack.pop()
+                    exitfn = type(mgr).__exit__.__get__(mgr)
+                    enter = type(mgr).__enter__.__get__(mgr)
+                    stack.append(exitfn)
+                    stack.append(enter())
+                elif op == "WITH_EXCEPT_START":
+                    exc = stack[-1]
+                    exitfn = stack[-4]
+                    stack.append(exitfn(type(exc), exc,
+                                        exc.__traceback__))
+                else:
+                    raise SotUnsupported(f"opcode {op}")
+            except SotUnsupported:
+                raise
+            except BaseException as e:  # noqa: BLE001 — route via exc table
+                handler = self._find_handler(ins.offset)
+                if handler is None:
+                    raise
+                h_offset, depth, lasti = handler
+                del stack[depth:]
+                if lasti:
+                    stack.append(ins.offset)
+                stack.append(e)
+                jump_to(h_offset)
+        raise SotUnsupported("fell off the end of the bytecode")
+
+    def _find_handler(self, offset):
+        for entry in self.exc_table:
+            if entry.start <= offset < entry.end:
+                return entry.target, entry.depth, entry.lasti
+        return None
+
+    def _call(self, callee, args, kwargs):
+        # top-frame float()/len() on a Tensor: float stays symbolic (we
+        # control the return value here, unlike Tensor.__float__), len is
+        # static shape. bool()/int() flow through the dunders, where the
+        # scalar hook records them like any nested concretization.
+        if len(args) == 1 and isinstance(args[0], Tensor) and not kwargs:
+            if callee is len:
+                return len(args[0])
+            if callee is float:
+                return self._concretize(args[0], float, "float")
+        if callee is NULL:
+            raise SotUnsupported("call through NULL")
+        return callee(*args, **kwargs)
+
+
+def _raw_convert(tensor, to):
+    """Convert without re-entering the capture hook (we ARE the hook)."""
+    from ...core import tensor as _tensor_mod
+
+    prev = _tensor_mod.set_scalar_capture_hook(None)
+    try:
+        return to(tensor)
+    finally:
+        _tensor_mod.set_scalar_capture_hook(prev)
+
+
+def _snapshot(v):
+    """Guard snapshot: by value for simple immutables, by buffer identity
+    for tensors (rebinding OR in-place rebind changes id(v._data), so a
+    same-shape replacement cannot silently reuse the baked constant), by
+    object identity otherwise (reference guard.py: value vs id guards)."""
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return ("value", v)
+    if isinstance(v, Tensor):
+        return ("tensor", id(v), id(v._data))
+    return ("id", id(v))
+
+
+def observed_outcome_key(outcomes, guard_vals):
+    """The outcome vector a compiled run ACTUALLY took, derived from its
+    guard outputs. Only trustworthy up to (and including) the first
+    divergence — values after a flipped branch were computed along the
+    wrong path — so callers use it as a cache-lookup HINT whose pick is
+    re-validated by its own guards, never as truth."""
+    out = []
+    for (kind, expected), v in zip(outcomes, guard_vals):
+        if kind == "bool":
+            out.append((kind, bool(v)))
+        elif kind == "int":
+            out.append((kind, int(v)))
+        else:
+            out.append((kind, float(v)))
+    return tuple(out)
+
+
+def branch_guards_ok(outcomes, guard_vals) -> bool:
+    """Compare a compiled run's branch tensors against the recorded
+    outcomes. Floats tolerate last-ulp eager-vs-XLA drift; an exact
+    compare would recapture on every call."""
+    for (kind, expected), v in zip(outcomes, guard_vals):
+        if kind == "bool":
+            ok = bool(v) == expected
+        elif kind == "int":
+            ok = int(v) == expected
+        else:  # float
+            a = float(v)
+            ok = abs(a - expected) <= 1e-6 * (1.0 + abs(expected))
+        if not ok:
+            return False
+    return True
+
+
+def check_guard(kind, name, snap, fn):
+    """Re-evaluate one guard source against the live function."""
+    if kind == "deref":
+        code = fn.__code__
+        closure = fn.__closure__ or ()
+        cellmap = dict(zip(code.co_freevars, closure))
+        cell = cellmap.get(name)
+        if cell is None:
+            return False
+        cur = cell.cell_contents
+    elif kind == "global":
+        if name not in fn.__globals__:
+            return False
+        cur = fn.__globals__[name]
+    else:
+        return False
+    return _snapshot(cur) == snap
+
+
+def _popn(stack, n):
+    if n == 0:
+        return []
+    items = stack[-n:]
+    del stack[-n:]
+    return items

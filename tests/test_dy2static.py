@@ -187,9 +187,13 @@ def test_convert_call_recurses_into_user_helpers():
 
 # -- graph breaks -------------------------------------------------------------
 
-def test_graph_break_falls_back_to_eager():
+def test_concretization_compiles_via_sot():
+    """int(tensor) used to be a whole-function graph break; the SOT
+    bytecode VM now captures it with a value guard (r5): same answers,
+    zero graph breaks, and a changed count recaptures."""
+
     def f(x):
-        n = int(x.sum())  # concretization: cannot stay in the graph
+        n = int(x.sum())  # concretization: SOT records the value
         out = x
         for _ in range(n):
             out = out + 1.0
@@ -197,17 +201,16 @@ def test_graph_break_falls_back_to_eager():
 
     sf = static_of(f)
     np.testing.assert_allclose(sf(T([2.0])).numpy(), [4.0])
-    assert len(sf.graph_breaks) == 1
-    _, reason = sf.graph_breaks[0]
-    assert "Concretization" in reason or "Tracer" in reason
-    # fallback decision is cached: same signature keeps working eagerly
+    assert sf.graph_breaks == []
+    np.testing.assert_allclose(sf(T([2.0])).numpy(), [4.0])  # compiled
+    # new int value: the guard recaptures instead of returning stale n=2
     np.testing.assert_allclose(sf(T([3.0])).numpy(), [6.0])
-    assert len(sf.graph_breaks) == 1
+    assert sf.graph_breaks == []
 
 
-def test_graph_break_preserves_autograd():
+def test_concretization_preserves_autograd():
     def f(x):
-        n = int((x * 0).sum()) + 2  # forces the eager fallback
+        n = int((x * 0).sum()) + 2  # SOT-captured concretization
         y = x
         for _ in range(n):
             y = y * x
@@ -219,7 +222,12 @@ def test_graph_break_preserves_autograd():
     loss = sf(x)
     loss.backward()
     np.testing.assert_allclose(x.grad.numpy(), [27.0])  # d(x^3)/dx = 3x^2
-    assert len(sf.graph_breaks) == 1
+    # and again through the COMPILED path
+    x._grad = None
+    loss = sf(x)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [27.0])
+    assert sf.graph_breaks == []
 
 
 # -- gradients through converted control flow ---------------------------------

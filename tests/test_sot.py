@@ -1,0 +1,426 @@
+"""SOT bytecode capture VM (r4 VERDICT Next #2).
+
+Covers the three layers: the opcode executor's CPython-3.12 semantics
+(pure-python parity battery incl. exception tables / with / closures),
+the guarded capture machinery (branch-outcome specialization, symbolic
+floats, closure/global guard invalidation — reference guard.py), and the
+to_static integration (the SOT rescue compiles tensor-conditioned
+control flow that previously fell whole-function eager, with grad
+parity between the concrete and compiled passes).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.api import _SotEntry
+from paddle_tpu.jit.sot import (
+    Capture, OpcodeExecutor, SotUnsupported, symbolic_translate)
+
+
+def vm_run(fn, *a, **k):
+    return OpcodeExecutor(fn, Capture(), "concrete").run(*a, **k)
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+# ---------------------------------------------------------------------------
+# pure-python opcode parity
+# ---------------------------------------------------------------------------
+
+MODULE_K = 7
+
+
+class Ctx:
+    def __init__(self):
+        self.events = []
+
+    def __enter__(self):
+        self.events.append("enter")
+        return 5
+
+    def __exit__(self, *exc):
+        self.events.append("exit")
+        return False
+
+
+def _arith(a, b):
+    return (a + b) * 3 - a / b + a // 2 + a % 3 + a ** 2
+
+
+def _loop(n):
+    acc = 0
+    for i in range(n):
+        if i % 2:
+            continue
+        if i > 7:
+            break
+        acc += i
+    return acc
+
+
+def _containers(a):
+    xs = [a, a * 2]
+    xs.append(a * 3)
+    d = {"k": xs, **{"j": 1}}
+    p, q, *rest = tuple(xs)
+    return d["k"][1] + p + q + sum(rest) + d["j"]
+
+
+def _nested_try(flag):
+    out = 0
+    try:
+        try:
+            if flag:
+                raise ValueError("inner")
+            out += 1
+        except KeyError:
+            out += 10
+        finally:
+            out += 100
+    except ValueError:
+        out += 1000
+    return out
+
+
+def _with_fn(a, ctx):
+    with ctx as v:
+        return a + v
+
+
+def _kwargs_fn(a, b=2, *args, c=3, **kw):
+    return a + b + c + sum(args) + sum(kw.values())
+
+
+def _inner_fn(a):
+    def h(y):
+        return y + a
+
+    return h(10) + (lambda z: z * 2)(a)
+
+
+def _fstring(x):
+    return f"v={x:.2f}|{x!r}"
+
+
+class TestOpcodeVM:
+    @pytest.mark.parametrize("fn,args,kwargs", [
+        (_arith, (7.0, 2.0), {}),
+        (_loop, (12,), {}),
+        (_containers, (4,), {}),
+        (_nested_try, (True,), {}),
+        (_nested_try, (False,), {}),
+        (_kwargs_fn, (1, 5, 9), {"c": 4, "z": 10}),
+        (_inner_fn, (5,), {}),
+        (_fstring, (3.14159,), {}),
+        (lambda a: 1 < a < 5, (3,), {}),
+        (lambda a: MODULE_K * a, (3,), {}),
+    ])
+    def test_parity(self, fn, args, kwargs):
+        assert vm_run(fn, *args, **kwargs) == fn(*args, **kwargs)
+
+    def test_with_runs_exit(self):
+        ctx = Ctx()
+        assert vm_run(_with_fn, 1, ctx) == 6
+        assert ctx.events == ["enter", "exit"]
+
+    def test_assert_raises(self):
+        def f(a):
+            assert a > 0, "positive please"
+            return a
+
+        assert vm_run(f, 3) == 3
+        with pytest.raises(AssertionError, match="positive please"):
+            vm_run(f, -1)
+
+    def test_user_exception_propagates(self):
+        def f():
+            raise KeyError("boom")
+
+        with pytest.raises(KeyError):
+            vm_run(f)
+
+    def test_generator_unsupported(self):
+        def g():
+            yield 1
+
+        with pytest.raises(SotUnsupported):
+            vm_run(g)
+
+
+# ---------------------------------------------------------------------------
+# guarded capture (symbolic_translate)
+# ---------------------------------------------------------------------------
+
+class TestGuardedCapture:
+    def test_branch_specialization(self):
+        def f(x):
+            try:
+                if float(x.sum()) > 0:
+                    y = paddle.tanh(x)
+                else:
+                    y = x * -1.0
+            except ValueError:
+                y = x
+            return y + 1
+
+        sf = symbolic_translate(f)
+        xp = paddle.to_tensor(np.array([1., 2.], np.float32))
+        xn = paddle.to_tensor(np.array([-1., -2.], np.float32))
+        np.testing.assert_allclose(_np(sf(xp)), np.tanh([1, 2]) + 1,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_np(sf(xp)), np.tanh([1, 2]) + 1,
+                                   rtol=1e-6)  # compiled
+        assert sf.program_count == 1
+        np.testing.assert_allclose(_np(sf(xn)), [2., 3.])  # flip
+        np.testing.assert_allclose(_np(sf(xn)), [2., 3.])  # compiled
+        np.testing.assert_allclose(_np(sf(xp)), np.tanh([1, 2]) + 1,
+                                   rtol=1e-6)  # back — reuses program
+        assert sf.program_count == 2
+
+    def test_float_stays_symbolic(self):
+        def g(x):
+            s = float(x.mean())
+            return x * s
+
+        sg = symbolic_translate(g)
+        a = sg(paddle.to_tensor(np.array([2., 4.], np.float32)))
+        np.testing.assert_allclose(_np(a), [6., 12.])
+        b = sg(paddle.to_tensor(np.array([10., 20.], np.float32)))
+        np.testing.assert_allclose(_np(b), [150., 300.])
+        # DIFFERENT float values, SAME compiled program — no baking
+        assert sg.program_count == 1
+
+    def test_closure_guard_invalidation(self):
+        def make(k):
+            def h(x):
+                return x * k
+
+            return h
+
+        h = make(3.0)
+        sh = symbolic_translate(h)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(_np(sh(x)), [3., 3.])
+        np.testing.assert_allclose(_np(sh(x)), [3., 3.])  # compiled
+        h.__closure__[0].cell_contents = 5.0
+        np.testing.assert_allclose(_np(sh(x)), [5., 5.])  # guard caught it
+
+    def test_global_guard_invalidation(self):
+        ns = {"K": 2.0, "__builtins__": __builtins__}
+        exec("def f(x):\n    return x * K\n", ns)
+        sf = symbolic_translate(ns["f"])
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(_np(sf(x)), [2., 2.])
+        np.testing.assert_allclose(_np(sf(x)), [2., 2.])
+        ns["K"] = 9.0
+        np.testing.assert_allclose(_np(sf(x)), [9., 9.])
+
+    def test_int_concretization_guards_value(self):
+        def f(x, n):
+            acc = x
+            for _ in range(int(n.sum())):
+                acc = acc + 1
+            return acc
+
+        sf = symbolic_translate(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        n2 = paddle.to_tensor(np.array([1, 1], np.int64))
+        n3 = paddle.to_tensor(np.array([1, 2], np.int64))
+        np.testing.assert_allclose(_np(sf(x, n2)), [2., 2.])
+        np.testing.assert_allclose(_np(sf(x, n2)), [2., 2.])  # compiled
+        np.testing.assert_allclose(_np(sf(x, n3)), [3., 3.])  # recapture
+        np.testing.assert_allclose(_np(sf(x, n3)), [3., 3.])
+
+
+# ---------------------------------------------------------------------------
+# to_static integration (the rescue path)
+# ---------------------------------------------------------------------------
+
+class TestToStaticSot:
+    def test_try_plus_dynamic_if_compiles(self):
+        """r4 Weak #6's exact symptom: a try-guarded forward with a
+        tensor-valued condition must COMPILE (no eager fallback)."""
+
+        def f(x):
+            try:
+                if float(x.sum()) > 0:
+                    return x + 1
+                return x - 1
+            finally:
+                pass
+
+        sf = paddle.jit.to_static(f)
+        xp = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(_np(sf(xp)), [2, 2, 2])
+        assert sf.graph_breaks == []
+        np.testing.assert_allclose(_np(sf(xp)), [2, 2, 2])
+        xn = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(_np(sf(xn)), [-2, -2, -2])
+        entries = [e for e in sf._cache.values()
+                   if isinstance(e, _SotEntry)]
+        assert entries and len(entries[0].programs) == 2
+
+    def test_grads_concrete_vs_compiled(self):
+        def g(x, w):
+            if float((x * w).sum()) > 0:
+                return (x * w * w).sum()
+            return (x + w).sum()
+
+        sg = paddle.jit.to_static(g)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        w = paddle.to_tensor(np.array([2., 3., 4.], np.float32))
+        w.stop_gradient = False
+        sg(x, w).backward()
+        g1 = _np(w.grad)
+        w._grad = None
+        sg(x, w).backward()  # compiled path
+        g2 = _np(w.grad)
+        np.testing.assert_allclose(g1, 2 * np.array([2., 3., 4.]))
+        np.testing.assert_allclose(g2, g1, rtol=1e-5)
+
+    def test_bn_buffers_update_through_compiled_path(self):
+        class M(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+                self.bn = paddle.nn.BatchNorm1D(4)
+
+            def forward(self, x):
+                h = self.bn(self.lin(x))
+                if float(h.mean()) > -1e9:  # tensor-conditioned: SOT path
+                    return h.sum()
+                return h.mean()
+
+        m = M()
+        paddle.jit.to_static(m)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype(np.float32))
+        m(x)
+        assert m.forward.graph_breaks == []  # SOT captured, not eager
+        assert any(isinstance(e, _SotEntry)
+                   for e in m.forward._cache.values())
+        m1 = _np(m.bn._mean).copy()
+        m(x)  # compiled; running stats must keep moving
+        m2 = _np(m.bn._mean)
+        assert not np.allclose(m1, m2)
+
+    def test_ast_path_still_first(self):
+        """Plain traceable forwards keep the direct-trace path (no SOT
+        entry created)."""
+
+        def f(x):
+            return paddle.tanh(x) * 2
+
+        sf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        sf(x)
+        assert not any(isinstance(e, _SotEntry)
+                       for e in sf._cache.values())
+
+
+class TestReviewRegressions:
+    """Regressions for the r5 review findings."""
+
+    def test_nested_helper_concretization_guards(self):
+        """int(t) inside a NESTED call is caught by the scalar hook and
+        guarded — previously unrecorded, crashing the traced pass."""
+
+        def helper(t):
+            return int(t.sum())
+
+        def f(x):
+            n = helper(x)
+            return x + n
+
+        sf = symbolic_translate(f)
+        x2 = paddle.to_tensor(np.array([1., 1.], np.float32))
+        x4 = paddle.to_tensor(np.array([2., 2.], np.float32))
+        np.testing.assert_allclose(_np(sf(x2)), [3., 3.])
+        np.testing.assert_allclose(_np(sf(x2)), [3., 3.])  # compiled
+        np.testing.assert_allclose(_np(sf(x4)), [6., 6.])  # value guard
+        np.testing.assert_allclose(_np(sf(x4)), [6., 6.])
+
+    def test_tensor_closure_rebind_guarded(self):
+        """A same-shape tensor rebound into a closure must NOT reuse the
+        baked constant (guards snapshot the buffer identity)."""
+        holder = {"scale": paddle.to_tensor(np.float32(2.0))}
+
+        def make():
+            scale = holder["scale"]
+
+            def h(x):
+                return x * scale
+
+            return h
+
+        h = make()
+        sh = symbolic_translate(h)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(_np(sh(x)), [2., 2.])
+        np.testing.assert_allclose(_np(sh(x)), [2., 2.])  # compiled
+        h.__closure__[0].cell_contents = paddle.to_tensor(np.float32(7.0))
+        np.testing.assert_allclose(_np(sh(x)), [7., 7.])
+
+    def test_alternating_branches_no_eager_thrash(self):
+        """Once both paths are compiled, +,-,+,- inputs run compiled
+        programs only (the observed-outcome hint picks the sibling)."""
+        calls = {"n": 0}
+
+        def probe(x):
+            calls["n"] += 1
+            return x
+
+        def f(x):
+            x = probe(x)
+            if float(x.sum()) > 0:
+                return x + 1
+            return x - 1
+
+        sf = symbolic_translate(f)
+        xp = paddle.to_tensor(np.ones(2, np.float32))
+        xn = paddle.to_tensor(-np.ones(2, np.float32))
+        sf(xp)  # capture pos (eager: probe runs, + traced compile later)
+        sf(xn)  # capture neg
+        assert sf.program_count == 2
+        sf(xp)
+        sf(xn)  # both programs now traced (each trace runs probe once)
+        base = calls["n"]
+        for _ in range(3):
+            np.testing.assert_allclose(_np(sf(xp)), [2., 2.])
+            np.testing.assert_allclose(_np(sf(xn)), [-2., -2.])
+        # probe() only executes during concrete (eager) passes — compiled
+        # re-simulation happens at trace time, already counted
+        assert calls["n"] == base, (calls["n"], base)
+
+    def test_float_dtype_preserved_symbolically(self):
+        """The symbolic float(t) keeps t's floating dtype (no forced
+        float32 downcast)."""
+        def f(x):
+            s = float(x.mean())
+            return x * s
+
+        sf = symbolic_translate(f)
+        x = paddle.to_tensor(np.array([1., 3.], np.float32)).astype(
+            "float64")
+        out = sf(x)
+        assert str(x.dtype) == str(out.dtype)
+
+    def test_grad_inputs_take_concrete_pass(self):
+        """symbolic_translate with differentiable inputs must keep the
+        eager tape (the compiled path is grad-detached by design)."""
+
+        def f(x):
+            if float(x.sum()) > 0:
+                return (x * x).sum()
+            return x.sum()
+
+        sf = symbolic_translate(f)
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        x.stop_gradient = False
+        sf(x)
+        sf(x)  # would be compiled if x were non-differentiable
+        loss = sf(x)
+        loss.backward()
+        np.testing.assert_allclose(_np(x.grad), [2., 4.])
