@@ -23,7 +23,58 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["PsServer", "PsClient", "Table"]
+__all__ = ["PsServer", "PsClient", "Table", "start_ps_servers"]
+
+
+def start_ps_servers(n: int, n_workers: int = 1, snapshot_dir: str = None,
+                     load: bool = False, timeout: float = 30.0):
+    """Spawn `n` OUT-OF-PROCESS PS servers (``python -m
+    paddle_tpu.distributed.ps``) and return (endpoints, processes).
+
+    Reference analog: the launcher's `--servers` role starting brpc
+    server processes. Each server prints its bound port on stdout; with
+    snapshot_dir, server i persists to `{dir}/ps{i}.pkl` on SIGTERM/stop
+    and `load=True` restores at boot.
+    """
+    import os
+    import subprocess
+    import sys
+    import time
+
+    procs, endpoints = [], []
+    for i in range(n):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.ps",
+               "--port", "0", "--n-workers", str(n_workers)]
+        if snapshot_dir:
+            cmd += ["--snapshot", os.path.join(snapshot_dir, f"ps{i}.pkl")]
+            if load:
+                cmd += ["--load"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                             env=env)
+        procs.append(p)
+    import select
+
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        line = ""
+        while time.monotonic() < deadline:
+            if p.poll() is not None:
+                break  # child exited before reporting
+            ready, _, _ = select.select([p.stdout], [], [], 0.2)
+            if not ready:
+                continue  # deadline keeps being honored on a silent child
+            line = p.stdout.readline()
+            if line.startswith("PS_SERVER_PORT="):
+                break
+            if line == "":
+                break  # EOF: child closed stdout
+        if not line.startswith("PS_SERVER_PORT="):
+            for q in procs:
+                q.kill()
+            raise RuntimeError("PS server failed to report its port")
+        endpoints.append(f"127.0.0.1:{line.strip().split('=')[1]}")
+    return endpoints, procs
 
 
 def _recv_exact(conn, n: int) -> bytes:
@@ -101,6 +152,41 @@ class Table:
         with self._lock:
             return np.stack([self._row(int(i)) for i in ids])
 
+    # -- persistence (reference: ps/table save/load, ssd_sparse_table's
+    # checkpoint contract scoped to file-backed snapshots) ------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            spec = dict(name=self.name, kind=self.kind, dim=self.dim,
+                        optimizer=self.optimizer, lr=self.lr,
+                        init_std=self.init_std)
+            if self.kind == "dense":
+                return {"spec": dict(spec, shape=list(self.data.shape)),
+                        "data": self.data.copy(), "g2": self._g2.copy()}
+            return {"spec": spec,
+                    # RNG stream position too: a resumed shard must draw
+                    # the SAME on-demand rows an uninterrupted run would
+                    "rng_state": self._rng.get_state(),
+                    "rows": {i: r.copy() for i, r in self.rows.items()},
+                    "row_g2": {i: g.copy()
+                               for i, g in self._row_g2.items()}}
+
+    @classmethod
+    def from_state(cls, st: dict) -> "Table":
+        t = cls(**st["spec"])
+        with t._lock:
+            if t.kind == "dense":
+                t.data = np.asarray(st["data"], np.float32)
+                t._g2 = np.asarray(st["g2"], np.float32)
+            else:
+                if st.get("rng_state") is not None:
+                    t._rng.set_state(st["rng_state"])
+                t.rows = {int(i): np.asarray(r, np.float32)
+                          for i, r in st["rows"].items()}
+                t._row_g2 = {int(i): np.asarray(g, np.float32)
+                             for i, g in st["row_g2"].items()}
+        return t
+
     def push_sparse(self, ids: Sequence[int], grads: np.ndarray) -> None:
         grads = np.asarray(grads, np.float32)
         with self._lock:
@@ -122,6 +208,10 @@ class PsServer:
 
     def __init__(self, port: int = 0, n_workers: int = 1):
         self.tables: Dict[str, Table] = {}
+        # push dedup: last applied sequence number per client — an
+        # at-least-once retry after a lost reply must not apply the same
+        # gradient twice (snapshotted alongside the tables)
+        self._applied: Dict[str, int] = {}
         self.n_workers = n_workers
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -159,14 +249,16 @@ class PsServer:
                     _send_msg(conn, {"ok": True, "data":
                                      self.tables[msg["name"]].pull_dense()})
                 elif op == "push_dense":
-                    self.tables[msg["name"]].push_dense(msg["grad"])
+                    if self._fresh_push(msg):
+                        self.tables[msg["name"]].push_dense(msg["grad"])
                     _send_msg(conn, {"ok": True})
                 elif op == "pull_sparse":
                     _send_msg(conn, {"ok": True, "data": self.tables[
                         msg["name"]].pull_sparse(msg["ids"])})
                 elif op == "push_sparse":
-                    self.tables[msg["name"]].push_sparse(
-                        msg["ids"], msg["grads"])
+                    if self._fresh_push(msg):
+                        self.tables[msg["name"]].push_sparse(
+                            msg["ids"], msg["grads"])
                     _send_msg(conn, {"ok": True})
                 elif op == "barrier":
                     with self._cv:
@@ -181,6 +273,16 @@ class PsServer:
                                    and not self._stopped.is_set()):
                                 self._cv.wait(0.1)
                     _send_msg(conn, {"ok": True})
+                elif op in ("save", "load"):
+                    try:
+                        (self.save if op == "save" else self.load)(
+                            msg["path"])
+                        _send_msg(conn, {"ok": True})
+                    except OSError as e:
+                        # reply in-band: closing the connection would turn
+                        # a file error into a client-side retry hang
+                        _send_msg(conn, {"ok": False,
+                                         "error": f"{op}: {e}"})
                 elif op == "stop":
                     _send_msg(conn, {"ok": True})
                     self.stop()
@@ -196,6 +298,19 @@ class PsServer:
             except OSError:
                 pass
 
+    def _fresh_push(self, msg) -> bool:
+        """True when this push has not been applied yet (client seq is
+        monotone; a retried push after a lost reply arrives with the same
+        seq and is dropped — already applied)."""
+        client = msg.get("client")
+        if client is None:
+            return True  # unversioned caller: apply unconditionally
+        seq = int(msg["seq"])
+        if seq <= self._applied.get(client, -1):
+            return False
+        self._applied[client] = seq
+        return True
+
     def run(self):
         """Block until stopped (reference: run_server)."""
         self._stopped.wait()
@@ -209,6 +324,39 @@ class PsServer:
         except OSError:
             pass
 
+    # -- snapshot persistence (reference: FleetWrapper save/load_model
+    # over brpc; here one pickled file per server shard) --------------------
+
+    def save(self, path: str) -> None:
+        import os
+        import tempfile
+
+        state = {"__tables__": {name: t.state()
+                                for name, t in self.tables.items()},
+                 "__applied__": dict(self._applied)}
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        # atomic replace: a kill mid-save never corrupts the snapshot
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".ps_snap_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(state, f, protocol=4)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        tables = state.get("__tables__", state)  # legacy: tables at root
+        self.tables = {name: Table.from_state(st)
+                       for name, st in tables.items()}
+        self._applied = dict(state.get("__applied__", {}))
+
 
 class PsClient:
     """Trainer-side handle to all PS shards (reference: brpc_ps_client.h).
@@ -217,11 +365,24 @@ class PsClient:
     rows scatter by `id % n_servers` (the reference's shard_num routing).
     """
 
-    def __init__(self, endpoints: Sequence[str]):
+    def __init__(self, endpoints: Sequence[str], retry_timeout: float = 60.0,
+                 retry_interval: float = 0.5):
         self._eps = list(endpoints)
         self._conns: List[Optional[socket.socket]] = [None] * len(self._eps)
         self._locks = [threading.Lock() for _ in self._eps]
         self._table_kind: Dict[str, str] = {}
+        # spec replay on reconnect: a restarted server (with or without a
+        # snapshot) gets its tables re-created idempotently, so a
+        # kill-server-mid-train sequence resumes without client-side code
+        self._specs: Dict[int, List[dict]] = {i: []
+                                              for i in range(len(self._eps))}
+        self.retry_timeout = retry_timeout
+        self.retry_interval = retry_interval
+        # push versioning for server-side dedup under at-least-once retry
+        import uuid
+
+        self._client_id = uuid.uuid4().hex
+        self._push_seq = 0
 
     def _conn(self, i: int) -> socket.socket:
         if self._conns[i] is None:
@@ -229,19 +390,50 @@ class PsClient:
             s = socket.create_connection((host, int(port)), timeout=120)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns[i] = s
+            for spec in self._specs[i]:
+                _send_msg(s, {"op": "create_table", "spec": spec})
+                _recv_msg(s)
         return self._conns[i]
 
-    def _call(self, i: int, msg):
-        with self._locks[i]:
-            conn = self._conn(i)
-            _send_msg(conn, msg)
-            out = _recv_msg(conn)
+    def _drop_conn(self, i: int) -> None:
+        c = self._conns[i]
+        self._conns[i] = None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _call(self, i: int, msg, retry: bool = True):
+        import time as _time
+
+        deadline = _time.monotonic() + self.retry_timeout
+        while True:
+            try:
+                with self._locks[i]:
+                    conn = self._conn(i)
+                    _send_msg(conn, msg)
+                    out = _recv_msg(conn)
+                break
+            except (ConnectionError, EOFError, OSError):
+                # server down/restarting (reference: brpc client retry):
+                # drop the connection and keep knocking until the window
+                # closes — a restarted server replays table specs above
+                self._drop_conn(i)
+                if not retry or _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(self.retry_interval)
         if not out.get("ok"):
             raise RuntimeError(out.get("error", "ps call failed"))
         return out
 
     def _dense_home(self, name: str) -> int:
-        return hash(name) % len(self._eps)
+        import zlib
+
+        # stable across processes (builtin hash is seed-randomized — a
+        # resuming client would route to a different shard than the one
+        # whose snapshot holds the table)
+        return zlib.crc32(name.encode()) % len(self._eps)
 
     # -- API -----------------------------------------------------------------
 
@@ -252,21 +444,28 @@ class PsClient:
                     optimizer=optimizer, lr=lr, init_std=init_std)
         self._table_kind[name] = kind
         if kind == "dense":
-            self._call(self._dense_home(name),
-                       {"op": "create_table", "spec": spec})
+            home = self._dense_home(name)
+            self._specs[home].append(spec)
+            self._call(home, {"op": "create_table", "spec": spec})
         else:  # every shard owns a slice of the id space
             for i in range(len(self._eps)):
-                self._call(i, {"op": "create_table",
-                               "spec": dict(spec, seed=i)})
+                shard_spec = dict(spec, seed=i)
+                self._specs[i].append(shard_spec)
+                self._call(i, {"op": "create_table", "spec": shard_spec})
 
     def pull_dense(self, name: str) -> np.ndarray:
         return self._call(self._dense_home(name),
                           {"op": "pull_dense", "name": name})["data"]
 
+    def _next_seq(self) -> int:
+        self._push_seq += 1
+        return self._push_seq
+
     def push_dense(self, name: str, grad: np.ndarray) -> None:
         self._call(self._dense_home(name),
                    {"op": "push_dense", "name": name,
-                    "grad": np.asarray(grad, np.float32)})
+                    "grad": np.asarray(grad, np.float32),
+                    "client": self._client_id, "seq": self._next_seq()})
 
     def pull_sparse(self, name: str, ids: Sequence[int]) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
@@ -295,16 +494,32 @@ class PsClient:
             if mask.any():
                 self._call(i, {"op": "push_sparse", "name": name,
                                "ids": (ids[mask] // n).tolist(),
-                               "grads": grads[mask]})
+                               "grads": grads[mask],
+                               "client": self._client_id,
+                               "seq": self._next_seq()})
 
     def barrier(self) -> None:
         self._call(0, {"op": "barrier"})
 
+    def save_tables(self, path_prefix: str) -> None:
+        """Snapshot every shard to `{prefix}.shard{i}.pkl` (reference:
+        fleet.save_persistables over the PS)."""
+        for i in range(len(self._eps)):
+            self._call(i, {"op": "save",
+                           "path": f"{path_prefix}.shard{i}.pkl"})
+
+    def load_tables(self, path_prefix: str) -> None:
+        for i in range(len(self._eps)):
+            self._call(i, {"op": "load",
+                           "path": f"{path_prefix}.shard{i}.pkl"})
+
     def stop_servers(self) -> None:
         for i in range(len(self._eps)):
             try:
-                self._call(i, {"op": "stop"})
-            except (RuntimeError, ConnectionError, OSError):
+                # no retry: a dead server is already stopped — retrying
+                # would block retry_timeout per dead shard
+                self._call(i, {"op": "stop"}, retry=False)
+            except (RuntimeError, ConnectionError, EOFError, OSError):
                 pass
 
     def close(self) -> None:

@@ -1,0 +1,57 @@
+"""Out-of-process PS server entry: ``python -m paddle_tpu.distributed.ps``.
+
+Reference analog: the standalone brpc PS server process the reference's
+launcher starts for `--servers` role endpoints
+(`paddle/fluid/distributed/ps/service/brpc_ps_server.h:1`,
+`python/paddle/distributed/launch/context/args_envs.py` server role).
+The process owns the tables; trainers connect over sockets. SIGTERM (or
+a client `stop` op) snapshots to --snapshot before exiting, and
+--load restores a previous snapshot at boot — together with the client's
+spec-replay reconnect this gives kill/restart resume.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+from . import PsServer
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="paddle_tpu.distributed.ps")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--n-workers", type=int, default=1)
+    ap.add_argument("--snapshot", default=None,
+                    help="snapshot file; written on stop/SIGTERM")
+    ap.add_argument("--load", action="store_true",
+                    help="restore tables from --snapshot at boot")
+    args = ap.parse_args()
+    server = PsServer(port=args.port, n_workers=args.n_workers)
+    if args.load and args.snapshot and os.path.exists(args.snapshot):
+        server.load(args.snapshot)
+    # the launcher reads the bound port from the first stdout line
+    print(f"PS_SERVER_PORT={server.port}", flush=True)
+
+    def _term(signum, frame):
+        if args.snapshot:
+            try:
+                server.save(args.snapshot)
+            except Exception:  # noqa: BLE001 — still shut down
+                pass
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    server.run()
+    if args.snapshot:
+        try:
+            server.save(args.snapshot)
+        except Exception:  # noqa: BLE001
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
