@@ -57,6 +57,7 @@ class Config:
         self._precision = PrecisionType.Float32
         self._enable_memory_optim = True
         self._donate_inputs = False
+        self._ir_optim = True
 
     def set_prog_file(self, path: str):
         self.prog_file = path
@@ -83,7 +84,9 @@ class Config:
         self._enable_memory_optim = flag
 
     def switch_ir_optim(self, flag: bool = True):
-        pass  # XLA always optimizes; parity no-op
+        # gates the pre-compile pass pipeline (the AnalysisPredictor's
+        # OptimizeInferenceProgram stage); XLA's own fusion always runs
+        self._ir_optim = flag
 
     def device(self) -> str:
         return self._device
@@ -140,7 +143,19 @@ class Predictor:
         if isinstance(payload, dict) and payload.get("stablehlo_program"):
             from ..pir import Program
 
-            self._exported = Program.deserialize(payload["stablehlo_program"])
+            # precision selection — the load-time half of the analysis
+            # stage (reference: analysis_predictor.cc:1252): the
+            # fold/CSE/DCE pipeline ran at SAVE, before lowering (a
+            # deserialized StableHLO blob is an opaque call_exported the
+            # jaxpr passes cannot see), and the save path shipped a
+            # bf16-rewritten variant this Config picks
+            blob = payload["stablehlo_program"]
+            if (config.precision() in (PrecisionType.Bfloat16,
+                                       PrecisionType.Half)
+                    and getattr(config, "_ir_optim", True)
+                    and payload.get("stablehlo_program_bf16")):
+                blob = payload["stablehlo_program_bf16"]
+            self._exported = Program.deserialize(blob)
             self._feed_names = list(self._exported.feed_names)
             self._fetch_names = list(self._exported.fetch_names)
         elif isinstance(payload, dict) and payload.get("layer") is not None:

@@ -65,7 +65,7 @@ def save(layer, path, input_spec=None, **configs):
         ]
     payload = {"state": state, "input_spec": spec_doc}
     if input_spec is not None and isinstance(layer, Layer):
-        from ..pir import trace_program
+        from ..pir import Bf16MixedPrecisionPass, PassManager, trace_program
 
         modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
         layer.eval()
@@ -75,7 +75,24 @@ def save(layer, path, input_spec=None, **configs):
             program = trace_program(lambda *xs: layer(*xs),
                                     *_spec_avals(input_spec),
                                     feed_names=feed_names)
+            # offline analysis stage (reference:
+            # analysis_predictor.cc:1252 OptimizeInferenceProgram): the
+            # pipeline must run BEFORE lowering — a deserialized StableHLO
+            # blob is an opaque call_exported the jaxpr passes can't see
+            pm = PassManager()
+            pm.add_pass("constant_folding_pass")
+            pm.add_pass("common_subexpression_elimination_pass")
+            pm.add_pass("dead_code_elimination_pass")
+            program = pm.run(program)
             payload["stablehlo_program"] = program.serialize()
+            # precision variant: the deploy Config picks bf16 at load time
+            # (Predictor), so ship the rewritten program alongside —
+            # the reference's per-precision deploy-model pattern
+            try:
+                bf16_prog = Bf16MixedPrecisionPass().run(program)
+                payload["stablehlo_program_bf16"] = bf16_prog.serialize()
+            except Exception:  # noqa: BLE001 — variant is best-effort
+                payload["stablehlo_program_bf16"] = None
         finally:
             for l, was_training in modes:
                 l.training = was_training

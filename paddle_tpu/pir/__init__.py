@@ -36,8 +36,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["Program", "Operation", "Value", "Block", "PassManager", "Pass",
            "DeadCodeEliminationPass", "ConstantFoldingPass",
-           "CommonSubexpressionEliminationPass", "Interpreter",
-           "trace_program"]
+           "CommonSubexpressionEliminationPass", "Bf16MixedPrecisionPass",
+           "Interpreter", "trace_program"]
 
 
 class Value:
@@ -497,6 +497,59 @@ class CommonSubexpressionEliminationPass(Pass):
         return _rebuild(program, new_jaxpr, program.jaxpr.consts)
 
 
+class Bf16MixedPrecisionPass(Pass):
+    """reference: auto_mixed_precision_pass.cc — rewrite the FLOP-heavy
+    primitives (dot_general / conv) to consume bf16 operands while
+    accumulating f32 via preferred_element_type: the canonical TPU MXU
+    mixed-precision recipe. Elementwise work stays f32 (XLA fuses it);
+    primitives with sub-jaxprs (scan/cond/pjit) are left untouched."""
+
+    name = "bf16_mixed_precision_pass"
+    _TARGETS = {"dot_general", "conv_general_dilated"}
+
+    def run(self, program: Program) -> Program:
+        import jax
+        import jax.numpy as jnp
+
+        closed = program.jaxpr
+        targets = self._TARGETS
+
+        def eval_rewritten(*args):
+            jaxpr = closed.jaxpr
+            env: Dict[Any, Any] = {}
+
+            def read(v):
+                return (v.val if isinstance(v, jex_core.Literal)
+                        else env[v])
+
+            for cv, cval in zip(jaxpr.constvars, closed.consts):
+                env[cv] = cval
+            for iv, aval in zip(jaxpr.invars, args):
+                env[iv] = aval
+            for eqn in jaxpr.eqns:
+                invals = [read(v) for v in eqn.invars]
+                subfuns, bind_params = eqn.primitive.get_bind_params(
+                    eqn.params)
+                if (eqn.primitive.name in targets
+                        and all(getattr(v, "dtype", None) == jnp.float32
+                                for v in invals)):
+                    invals = [v.astype(jnp.bfloat16) for v in invals]
+                    bind_params = dict(
+                        bind_params, preferred_element_type=jnp.float32)
+                outs = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+                if not eqn.primitive.multiple_results:
+                    outs = [outs]
+                for var, val in zip(eqn.outvars, outs):
+                    env[var] = val
+            return [read(v) for v in jaxpr.outvars]
+
+        in_specs = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                    for v in closed.jaxpr.invars]
+        new_closed = jax.make_jaxpr(eval_rewritten)(*in_specs)
+        return Program(new_closed, program.feed_names, program.fetch_names,
+                       program._in_avals, program._out_tree)
+
+
 class PassManager:
     """reference: pir::PassManager (pir/include/pass)."""
 
@@ -521,4 +574,5 @@ _PASS_REGISTRY = {
     "constant_folding_pass": ConstantFoldingPass,
     "common_subexpression_elimination_pass":
         CommonSubexpressionEliminationPass,
+    "bf16_mixed_precision_pass": Bf16MixedPrecisionPass,
 }

@@ -241,3 +241,86 @@ class TestPredictor:
         pred = infer.create_predictor(infer.Config(path))
         with pytest.raises(ValueError, match="inputs not set"):
             pred.run()
+
+
+class TestAnalysisPassStage:
+    """r5 (VERDICT #10): the Predictor's pre-compile pass pipeline —
+    AnalysisPredictor.OptimizeInferenceProgram analog."""
+
+    def _save_conv_model(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                          nn.Conv2D(8, 4, 1))
+        m.eval()
+        path = str(tmp_path / "inference" / "model")
+        paddle.jit.save(m, path,
+                        input_spec=[paddle.static.InputSpec([1, 3, 8, 8],
+                                                            "float32")])
+        return m, path
+
+    def test_pipeline_runs_and_parity(self, tmp_path):
+        m, path = self._save_conv_model(tmp_path)
+        from paddle_tpu import inference as infer
+
+        x = np.random.RandomState(0).normal(
+            size=(1, 3, 8, 8)).astype(np.float32)
+        pred = infer.create_predictor(infer.Config(path))  # ir_optim on
+        got = np.asarray(pred.run([paddle.to_tensor(x)])[0].numpy())
+        cfg_raw = infer.Config(path)
+        cfg_raw.switch_ir_optim(False)
+        raw = infer.create_predictor(cfg_raw)
+        want = np.asarray(raw.run([paddle.to_tensor(x)])[0].numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got, m(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bf16_pass_rewrites_matmuls(self, tmp_path):
+        m, path = self._save_conv_model(tmp_path)
+        from paddle_tpu import inference as infer
+        from paddle_tpu.pir import Bf16MixedPrecisionPass
+
+        x = np.random.RandomState(1).normal(
+            size=(1, 3, 8, 8)).astype(np.float32)
+        cfg = infer.Config(path)
+        cfg.enable_tpu(precision=infer.PrecisionType.Bfloat16)
+        pred = infer.create_predictor(cfg)
+        # the bf16 variant was selected: its StableHLO carries bf16 convs
+        mlir = pred._exported._exported.mlir_module()
+        assert "bf16" in mlir, mlir[:400]
+        got = np.asarray(pred.run([paddle.to_tensor(x)])[0].numpy())
+        want = m(paddle.to_tensor(x)).numpy()
+        # bf16 mantissa: ~3 decimal digits
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+        # outputs stay f32 (accumulate dtype)
+        assert got.dtype == np.float32
+
+    def test_ptq_int8_detector_roundtrip_through_passes(self, tmp_path):
+        """PTQ int8 conv backbone -> save_inference_model packaging ->
+        Predictor with the full pass pipeline: parity with direct eager
+        execution of the quantized model."""
+        from paddle_tpu import inference as infer
+        from paddle_tpu.quantization import PTQ
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1, bias_attr=False),
+                          nn.BatchNorm2D(8), nn.ReLU(),
+                          nn.Conv2D(8, 4, 1))
+        m.eval()
+        rs = np.random.RandomState(2)
+        calib = [paddle.to_tensor(rs.rand(1, 3, 8, 8).astype(np.float32))
+                 for _ in range(4)]
+        ptq = PTQ()
+        qm = ptq.quantize(m)
+        for c in calib:
+            qm(c)
+        qm = ptq.convert(qm)
+        qm.eval()
+        path = str(tmp_path / "det" / "model")
+        paddle.jit.save(qm, path,
+                        input_spec=[paddle.static.InputSpec([1, 3, 8, 8],
+                                                            "float32")])
+        x = paddle.to_tensor(rs.rand(1, 3, 8, 8).astype(np.float32))
+        want = np.asarray(qm(x).numpy())
+        pred = infer.create_predictor(infer.Config(path))
+        got = np.asarray(pred.run([x])[0].numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
