@@ -1,0 +1,76 @@
+"""User-style drive: functional autograd + r5 op tail through the public API."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+# 1. A user computing the Hessian of a tiny MLP loss wrt inputs (PINN-style)
+x = paddle.to_tensor(np.linspace(-1, 1, 8).astype(np.float32))
+x.stop_gradient = False
+net_w = paddle.to_tensor(np.float32(1.7))
+u = paddle.tanh(net_w * x)            # "network" output
+# du/dx via jacobian, d2u/dx2 via hessian of sum(u)
+J = paddle.autograd.jacobian(u, x)
+du = np.diag(np.asarray(J[:].numpy()))
+want_du = 1.7 / np.cosh(1.7 * np.asarray(x.numpy())) ** 2
+np.testing.assert_allclose(du, want_du, rtol=1e-4)
+H = paddle.autograd.hessian(paddle.sum(u), x)
+d2 = np.diag(np.asarray(H[:].numpy()))
+xa = np.asarray(x.numpy())
+want_d2 = -2 * 1.7**2 * np.tanh(1.7 * xa) / np.cosh(1.7 * xa) ** 2
+np.testing.assert_allclose(d2, want_d2, rtol=1e-3)
+print("PINN-style jacobian/hessian OK")
+
+# lazy indexing really is lazy
+J2 = paddle.autograd.jacobian(u, x)
+_ = J2[3]
+assert len(J2._cache) == 1, J2._cache.keys()
+print("lazy row cache OK")
+
+# 2. incubate jvp/vjp on a function of two tensors
+def f(a, b):
+    return paddle.sum(a * paddle.exp(b))
+a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+b = paddle.to_tensor(np.array([0.1, 0.2], np.float32))
+ys, (ga, gb) = paddle.incubate.autograd.vjp(f, (a, b))
+np.testing.assert_allclose(np.asarray(ga.numpy()), np.exp([0.1, 0.2]), rtol=1e-5)
+_, jv = paddle.incubate.autograd.jvp(f, (a, b))
+# J @ ones = sum of all partials
+want = np.exp([0.1, 0.2]).sum() + (np.array([1, 2]) * np.exp([0.1, 0.2])).sum()
+np.testing.assert_allclose(float(jv.numpy()), want, rtol=1e-5)
+print("vjp/jvp OK")
+
+# 3. op tail through the dispatch surface a graph-importer uses
+from paddle_tpu.ops.dispatch import OPS
+from paddle_tpu import _C_ops
+for name in ("batch_norm", "fused_moe", "flashmask_attention",
+             "sparse_attention", "as_strided", "p_send", "multiclass_nms",
+             "tril_triu", "add_n", "c_embedding"):
+    assert name in OPS, name
+    assert hasattr(_C_ops, name) or name in OPS, name
+x4 = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32))
+out = OPS["batch_norm"](x4, paddle.to_tensor(np.zeros(3, np.float32)),
+                        paddle.to_tensor(np.ones(3, np.float32)),
+                        None, None, is_test=True)
+assert np.asarray(out[0].numpy()).shape == (2, 3, 4, 4)
+tri = paddle.tril(paddle.ones([3, 3]))  # existing surface still fine
+np.testing.assert_allclose(np.asarray(OPS["tril_triu"](paddle.ones([3, 3]), 0, True).numpy()),
+                           np.asarray(tri.numpy()))
+print("op tail dispatch OK")
+
+# 4. double-check autograd engine still healthy end-to-end (regression drive)
+import paddle_tpu.nn as nn
+lin = nn.Linear(3, 1)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+rs = np.random.RandomState(0)
+X = rs.randn(64, 3).astype(np.float32)
+Y = (X @ np.array([[3.], [3.], [3.]]) + 1).astype(np.float32)
+for _ in range(80):
+    loss = ((lin(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+assert float(loss.numpy()) < 1e-2, float(loss.numpy())
+print("linear regression converges OK")
+print("ALL DRIVES PASSED")

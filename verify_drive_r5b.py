@@ -1,0 +1,88 @@
+"""User-style drive after the binding rewire: the whole public surface
+(paddle.*, Tensor methods, _C_ops, nn training loop, to_static, error
+paths) must behave exactly as before, now sourced from ops.yaml."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+# 1. module functions + tensor methods + _C_ops all resolve and agree
+x = paddle.to_tensor(np.array([[1., -2.], [3., -4.]], np.float32))
+a = np.asarray(paddle.tanh(x).numpy())
+b = np.asarray(x.tanh().numpy())
+c = np.asarray(paddle._C_ops.tanh(x).numpy())
+np.testing.assert_allclose(a, b); np.testing.assert_allclose(a, c)
+print("three surfaces agree OK")
+
+# 2. signature validation is now a real error at the boundary
+try:
+    paddle.matmul(x, x, not_an_arg=1)
+    raise SystemExit("should have raised")
+except TypeError as e:
+    assert "matmul" in str(e)
+print("signature validation OK")
+
+# 3. standard training drive (methods + ops via new surface)
+lin = nn.Linear(3, 1)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+rs = np.random.RandomState(0)
+X = rs.randn(64, 3).astype(np.float32)
+Y = (X @ np.array([[3.], [3.], [3.]]) + 1).astype(np.float32)
+for _ in range(80):
+    loss = ((lin(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+assert float(loss.numpy()) < 1e-2
+print("training loop OK", float(loss.numpy()))
+
+# 4. to_static through the new surface
+class M(nn.Layer):
+    def forward(self, t):
+        return paddle.nn.functional.relu(t).sum()
+m = M(); paddle.jit.to_static(m)
+assert abs(float(m(x).numpy()) - 4.0) < 1e-6
+print("to_static OK")
+
+# 5. in-place variants + conversions still patched
+t = paddle.ones([3]); t.add_(paddle.ones([3]))
+np.testing.assert_allclose(np.asarray(t.numpy()), 2 * np.ones(3))
+t.zero_(); assert float(t.sum().numpy()) == 0.0
+print("in-place methods OK")
+
+# 6. error paths still raise cleanly
+try:
+    paddle.to_tensor(np.zeros(2), dtype="float99"); raise SystemExit("no raise")
+except Exception:
+    pass
+try:
+    bool(paddle.ones([2])); raise SystemExit("no raise")
+except Exception:
+    pass
+print("error paths OK")
+
+# 7. hybrid flagship quick drive on 8-dev mesh (engine untouched, but its
+# imports flow through the package — regression check)
+from paddle_tpu.models import llama as L
+from paddle_tpu.distributed import hybrid as H
+import jax.numpy as jnp
+cfg = L.LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=4,
+                    max_seq_len=32, dtype=jnp.float32)
+mesh = H.build_mesh(dp=2, pp=1, tp=2)
+params = L.init_params(cfg, jax.random.PRNGKey(0))
+sp = H.shard_params(params, mesh, cfg)
+opt_state = H.init_opt_state(sp)
+step = H.make_train_step(cfg, mesh, num_microbatches=1, hp=H.AdamWConfig(lr=1e-3))
+k = jax.random.PRNGKey(1)
+toks = jax.random.randint(k, (4, 32), 0, 64, jnp.int32)
+tgts = jnp.roll(toks, -1, axis=1)
+losses = []
+for _ in range(3):
+    sp, opt_state, loss = step(sp, opt_state, toks, tgts)
+    losses.append(float(loss))
+assert losses[-1] < losses[0], losses
+print("hybrid dp2xtp2 drive OK", losses)
+print("ALL DRIVES PASSED")

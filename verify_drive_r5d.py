@@ -1,0 +1,54 @@
+"""User-style drive: train a small model fed by a multi-worker DataLoader
+over the shared-memory ring transport (the default use_shared_memory=True)."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.core import native
+
+
+class Toy(Dataset):
+    def __init__(self):
+        rs = np.random.RandomState(0)
+        self.x = rs.randn(64, 8).astype(np.float32)
+        self.w = np.array([[1.5], [-2.0], [0.5], [3.0], [0.0], [1.0],
+                           [-1.0], [2.0]], np.float32)
+        self.y = self.x @ self.w
+    def __len__(self): return 64
+    def __getitem__(self, i): return self.x[i], self.y[i]
+
+
+def main():
+    print("native available:", native.available())
+    model = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+    loader = DataLoader(Toy(), batch_size=16, num_workers=2, shuffle=True,
+                        use_shared_memory=True)
+    first = None
+    for epoch in range(30):
+        it = iter(loader)
+        if epoch == 0:
+            assert it._inner._ring_active, "ring transport must be active"
+        for xb, yb in it:
+            loss = ((model(xb) - yb) ** 2).mean()
+            loss.backward(); opt.step(); opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    final = float(loss.numpy())
+    assert final < first * 0.05, (first, final)
+    print(f"trained over ring transport: loss {first:.4f} -> {final:.5f}")
+    import glob
+    leftover = glob.glob("/dev/shm/ptdl_*")
+    assert not leftover, leftover
+    print("no /dev/shm leaks OK")
+    print("ALL DRIVES PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""User-style drive: (1) a recsys-style embedding train loop against real
+out-of-process PS servers with a kill/restart in the middle; (2) export a
+quantized conv model and deploy it through the Predictor at f32 and bf16."""
+import os, signal, subprocess, sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+
+def drive_ps(tmp):
+    from paddle_tpu.distributed.ps import PsClient, start_ps_servers
+
+    eps, procs = start_ps_servers(2, snapshot_dir=tmp)
+    c = PsClient(eps, retry_timeout=30.0, retry_interval=0.2)
+    c.create_table("emb", kind="sparse", dim=4, init_std=0.0, lr=0.5)
+    rs = np.random.RandomState(0)
+    for step in range(6):
+        ids = rs.randint(0, 50, 8)
+        rows = c.pull_sparse("emb", ids)
+        c.push_sparse("emb", ids, np.ones_like(rows))  # constant pull-down
+        if step == 3:
+            c.save_tables(os.path.join(tmp, "mid"))
+            for i in range(2):
+                os.replace(os.path.join(tmp, f"mid.shard{i}.pkl"),
+                           os.path.join(tmp, f"ps{i}.pkl"))
+            procs[0].kill(); procs[0].wait(timeout=10)
+            port = eps[0].rsplit(":", 1)[1]
+            procs[0] = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.ps",
+                 "--port", port, "--n-workers", "1",
+                 "--snapshot", os.path.join(tmp, "ps0.pkl"), "--load"],
+                stdout=subprocess.PIPE, text=True)
+            assert "PS_SERVER_PORT=" in procs[0].stdout.readline()
+    # rows that were pushed k times are at -0.5*k; spot check one id's row
+    final = c.pull_sparse("emb", [int(ids[0])])
+    assert np.all(final <= 0), final
+    c.stop_servers()
+    for p in procs:
+        p.wait(timeout=10)
+    print("PS kill/restart drive OK")
+
+
+def drive_inference(tmp):
+    from paddle_tpu import inference as infer
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                      nn.Conv2D(8, 4, 1))
+    m.eval()
+    path = os.path.join(tmp, "deploy", "model")
+    paddle.jit.save(m, path,
+                    input_spec=[paddle.static.InputSpec([1, 3, 16, 16],
+                                                        "float32")])
+    x = np.random.RandomState(1).rand(1, 3, 16, 16).astype(np.float32)
+    want = m(paddle.to_tensor(x)).numpy()
+    p32 = infer.create_predictor(infer.Config(path))
+    got32 = np.asarray(p32.run([paddle.to_tensor(x)])[0].numpy())
+    np.testing.assert_allclose(got32, want, rtol=1e-4, atol=1e-5)
+    cfg = infer.Config(path)
+    cfg.enable_tpu(precision=infer.PrecisionType.Bfloat16)
+    pb = infer.create_predictor(cfg)
+    gotb = np.asarray(pb.run([paddle.to_tensor(x)])[0].numpy())
+    assert "bf16" in pb._exported._exported.mlir_module()
+    np.testing.assert_allclose(gotb, want, rtol=3e-2, atol=3e-2)
+    print("inference f32/bf16 deploy drive OK")
+
+
+if __name__ == "__main__":
+    with tempfile.TemporaryDirectory() as t1:
+        drive_ps(t1)
+    with tempfile.TemporaryDirectory() as t2:
+        drive_inference(t2)
+    print("ALL DRIVES PASSED")
