@@ -38,6 +38,7 @@ from .kernels import (  # noqa: F401
     tail_nn,
     tail_r4,
     tail_r5,
+    tail_r5b,
     tail_seq,
     vision_ops,
     yolo_loss,

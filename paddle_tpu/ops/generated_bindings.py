@@ -118,6 +118,10 @@ def amin(x, axis=None, keepdim=False):
     return _OPS['amin'](x, axis=axis, keepdim=keepdim)
 
 
+def anchor_generator(input, anchor_sizes=(), aspect_ratios=(), variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0), offset=0.5):
+    return _OPS['anchor_generator'](input, anchor_sizes=anchor_sizes, aspect_ratios=aspect_ratios, variances=variances, stride=stride, offset=offset)
+
+
 def angle(x):
     return _OPS['angle'](x)
 
@@ -216,6 +220,10 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
 
 def barrier(x=None, ring_id=0):
     return _OPS['barrier'](x=x, ring_id=ring_id)
+
+
+def batch_fc(input, w, bias=None):
+    return _OPS['batch_fc'](input, w, bias=bias)
 
 
 def batch_norm(x, mean, variance, scale=None, bias=None, is_test=False, momentum=0.9, epsilon=1e-05, data_format='NCHW', use_global_stats=False, trainable_statistics=False):
@@ -482,6 +490,10 @@ def corrcoef(x, rowvar=True):
     return _OPS['corrcoef'](x, rowvar=rowvar)
 
 
+def correlation(input1, input2, pad_size, kernel_size, max_displacement, stride1, stride2, corr_type_multiply=1):
+    return _OPS['correlation'](input1, input2, pad_size, kernel_size, max_displacement, stride1, stride2, corr_type_multiply=corr_type_multiply)
+
+
 def cos(x):
     return _OPS['cos'](x)
 
@@ -576,6 +588,10 @@ def depthwise_conv2d_transpose(x, filter, bias=None, strides=1, paddings=0, outp
 
 def dequantize_abs_max(x, scale, max_range):
     return _OPS['dequantize_abs_max'](x, scale, max_range)
+
+
+def dequantize_linear(x, scale, zero_point=None, in_accum=None, in_state=None, quant_axis=0, bit_length=8, qmin=-128, qmax=127, round_type=0, is_test=True, only_observer=False):
+    return _OPS['dequantize_linear'](x, scale, zero_point=zero_point, in_accum=in_accum, in_state=in_state, quant_axis=quant_axis, bit_length=bit_length, qmin=qmin, qmax=qmax, round_type=round_type, is_test=is_test, only_observer=only_observer)
 
 
 def det(x):
@@ -1138,6 +1154,10 @@ def hardtanh(x, min=-1.0, max=1.0):
     return _OPS['hardtanh'](x, min=min, max=max)
 
 
+def hash(x, num_hash=1, mod_by=100000, runtime_shape=True):
+    return _OPS['hash'](x, num_hash=num_hash, mod_by=mod_by, runtime_shape=runtime_shape)
+
+
 def heaviside(x, y):
     return _OPS['heaviside'](x, y)
 
@@ -1650,6 +1670,10 @@ def nansum(x, axis=None, dtype=None, keepdim=False):
     return _OPS['nansum'](x, axis=axis, dtype=dtype, keepdim=keepdim)
 
 
+def nce(input, label, weight, bias=None, sample_weight=None, custom_dist_probs=None, custom_dist_alias=None, custom_dist_alias_probs=None, num_total_classes=None, custom_neg_classes=(), num_neg_samples=10, sampler=0, seed=0, is_sparse=False, remote_prefetch=False, is_test=False):
+    return _OPS['nce'](input, label, weight, bias=bias, sample_weight=sample_weight, custom_dist_probs=custom_dist_probs, custom_dist_alias=custom_dist_alias, custom_dist_alias_probs=custom_dist_alias_probs, num_total_classes=num_total_classes, custom_neg_classes=custom_neg_classes, num_neg_samples=num_neg_samples, sampler=sampler, seed=seed, is_sparse=is_sparse, remote_prefetch=remote_prefetch, is_test=is_test)
+
+
 def nearest_interp(x, out_h, out_w, align_corners=False):
     return _OPS['nearest_interp'](x, out_h, out_w, align_corners=align_corners)
 
@@ -1812,6 +1836,10 @@ def qr(x, mode='reduced'):
 
 def quantile(x, q, axis=None, keepdim=False):
     return _OPS['quantile'](x, q, axis=axis, keepdim=keepdim)
+
+
+def quantize_linear(x, scale, zero_point=None, in_accum=None, in_state=None, quant_axis=0, bit_length=8, qmin=-128, qmax=127, round_type=0, is_test=True, only_observer=False):
+    return _OPS['quantize_linear'](x, scale, zero_point=zero_point, in_accum=in_accum, in_state=in_state, quant_axis=quant_axis, bit_length=bit_length, qmin=qmin, qmax=qmax, round_type=round_type, is_test=is_test, only_observer=only_observer)
 
 
 def rad2deg(x):
@@ -2465,6 +2493,7 @@ __all__ = [
     'allclose',
     'amax',
     'amin',
+    'anchor_generator',
     'angle',
     'any',
     'apply_per_channel_scale',
@@ -2490,6 +2519,7 @@ __all__ = [
     'avg_pool1d',
     'avg_pool2d',
     'barrier',
+    'batch_fc',
     'batch_norm',
     'batch_norm_infer',
     'batch_norm_train',
@@ -2556,6 +2586,7 @@ __all__ = [
     'copy_to',
     'copysign',
     'corrcoef',
+    'correlation',
     'cos',
     'cosh',
     'count_nonzero',
@@ -2580,6 +2611,7 @@ __all__ = [
     'depthwise_conv2d',
     'depthwise_conv2d_transpose',
     'dequantize_abs_max',
+    'dequantize_linear',
     'det',
     'detection_map',
     'diag',
@@ -2720,6 +2752,7 @@ __all__ = [
     'hardsigmoid',
     'hardswish',
     'hardtanh',
+    'hash',
     'heaviside',
     'hinge_loss',
     'histogram',
@@ -2848,6 +2881,7 @@ __all__ = [
     'nanmean',
     'nanmedian',
     'nansum',
+    'nce',
     'nearest_interp',
     'nextafter',
     'nll_loss',
@@ -2889,6 +2923,7 @@ __all__ = [
     'put_along_axis',
     'qr',
     'quantile',
+    'quantize_linear',
     'rad2deg',
     'radam_',
     'randint',

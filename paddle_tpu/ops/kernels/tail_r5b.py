@@ -1,0 +1,247 @@
+"""Op tail 8 (round 5, second batch): remaining real-workload legacy ops.
+
+* ``quantize_linear`` / ``dequantize_linear`` — the ONNX-style QDQ pair
+  modern quantized paddle graphs carry
+  (`paddle/phi/ops/yaml/inconsistent/static_ops.yaml:190,746`).
+* ``anchor_generator`` — RCNN/SSD anchor grids, formula transcribed from
+  `paddle/phi/kernels/impl/anchor_generator_kernel_impl.h:73-99`.
+* ``correlation`` — the FlowNet correlation layer
+  (`paddle/phi/kernels/gpu/correlation_kernel.cu:20-90`; the reference's
+  CPU kernel just raises "GPU only" — this one runs anywhere XLA does).
+* ``batch_fc`` — per-slot batched FC for rank models
+  (`paddle/phi/ops/yaml/ops.yaml:494`).
+* ``hash`` — bucketed id hashing (`legacy/static_ops.yaml:382`); shape
+  contract faithful, hash family deterministic but NOT bit-compatible
+  with the reference's XXH64 (hash values are an implementation detail;
+  no model weight depends on them across frameworks).
+* ``nce`` — noise-contrastive estimation loss
+  (`inconsistent/static_ops.yaml:1058`; math from
+  `paddle/fluid/operators/nce_op.h`: per-sample logistic with the
+  k·p(class) correction), uniform/log-uniform samplers.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import register_op
+
+
+# ---------------------------------------------------------------------------
+# QDQ pair
+# ---------------------------------------------------------------------------
+
+def _per_channel_shape(scale, x, quant_axis):
+    if scale.ndim == 0 or scale.size == 1:
+        return scale.reshape(())
+    shape = [1] * x.ndim
+    shape[quant_axis] = scale.shape[0]
+    return scale.reshape(shape)
+
+
+@register_op
+def quantize_linear(x, scale, zero_point=None, in_accum=None, in_state=None,
+                    quant_axis=0, bit_length=8, qmin=-128, qmax=127,
+                    round_type=0, is_test=True, only_observer=False):
+    """QDQ quantize: round(x/scale + zp) clipped to [qmin, qmax], values
+    carried in x's dtype (the reference stores int values in a float
+    tensor). Per-channel when scale is a vector along quant_axis;
+    only_observer passes x through (observer-only node)."""
+    if only_observer:
+        return x + 0
+    s = _per_channel_shape(scale, x, int(quant_axis))
+    zp = (0.0 if zero_point is None
+          else _per_channel_shape(zero_point, x, int(quant_axis)))
+    q = x / s + zp
+    # round_type 0: ties-to-even (the reference's default rounding);
+    # 1: round half away from zero. Straight-through estimator: the
+    # rounding residual is stop_gradient'd so QAT gradients pass through
+    # inside the clip range (reference quantize_linear backward)
+    r = jnp.round(q) if int(round_type) == 0 \
+        else jnp.sign(q) * jnp.floor(jnp.abs(q) + 0.5)
+    q = q + jax.lax.stop_gradient(r - q)
+    return jnp.clip(q, qmin, qmax).astype(x.dtype)
+
+
+@register_op
+def dequantize_linear(x, scale, zero_point=None, in_accum=None,
+                      in_state=None, quant_axis=0, bit_length=8, qmin=-128,
+                      qmax=127, round_type=0, is_test=True,
+                      only_observer=False):
+    """QDQ dequantize: (x - zp) * scale."""
+    if only_observer:
+        return x + 0
+    s = _per_channel_shape(scale, x, int(quant_axis))
+    zp = (0.0 if zero_point is None
+          else _per_channel_shape(zero_point, x, int(quant_axis)))
+    return (x.astype(jnp.float32) - zp) * s
+
+
+# ---------------------------------------------------------------------------
+# anchor_generator
+# ---------------------------------------------------------------------------
+
+@register_op(nondiff=True)
+def anchor_generator(input, anchor_sizes=(), aspect_ratios=(),
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    """Anchors [H, W, A, 4] + variances_out, A = len(ar) x len(sizes);
+    exact transcription of anchor_generator_kernel_impl.h:73-99."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    boxes = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            base_w = round(math.sqrt(area / ar))
+            base_h = round(base_w * ar)
+            aw = (size / sw) * base_w
+            ah = (size / sh) * base_h
+            boxes.append((aw, ah))
+    xc = jnp.arange(w, dtype=jnp.float32) * sw + offset * (sw - 1)
+    yc = jnp.arange(h, dtype=jnp.float32) * sh + offset * (sh - 1)
+    xg, yg = jnp.meshgrid(xc, yc)             # [H, W]
+    per_anchor = []
+    for aw, ah in boxes:
+        per_anchor.append(jnp.stack([
+            xg - 0.5 * (aw - 1), yg - 0.5 * (ah - 1),
+            xg + 0.5 * (aw - 1), yg + 0.5 * (ah - 1)], axis=-1))
+    anchors = jnp.stack(per_anchor, axis=2)   # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return anchors, var
+
+
+# ---------------------------------------------------------------------------
+# correlation
+# ---------------------------------------------------------------------------
+
+@register_op
+def correlation(input1, input2, pad_size, kernel_size, max_displacement,
+                stride1, stride2, corr_type_multiply=1):
+    """FlowNet correlation (correlation_kernel.cu:20): mean over channels
+    and a kernel_size window of input1 ⋅ shifted input2, one output
+    channel per displacement in a (2·max_disp/stride2+1)² grid. Static
+    python loops over the (small) displacement/kernel offsets keep every
+    slice XLA-fusible."""
+    b, c, hh, ww = input1.shape
+    kr = (kernel_size - 1) // 2
+    drad = max_displacement // stride2
+    dsize = 2 * drad + 1
+    pad = int(pad_size)
+    x1 = jnp.pad(input1.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    x2 = jnp.pad(input2.astype(jnp.float32),
+                 ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = hh + 2 * pad, ww + 2 * pad
+    border = max_displacement + kr
+    out_h = (ph - 2 * border + stride1 - 1) // stride1
+    out_w = (pw - 2 * border + stride1 - 1) // stride1
+    nelems = kernel_size * kernel_size * c
+
+    def win(x, dh, dw):
+        """[B, C, out_h, out_w] window whose (0,0) sits at padded coord
+        (max_displacement+dh, max_displacement+dw), stride1-strided."""
+        h0 = max_displacement + dh
+        w0 = max_displacement + dw
+        return x[:, :, h0:h0 + (out_h - 1) * stride1 + 1:stride1,
+                 w0:w0 + (out_w - 1) * stride1 + 1:stride1]
+
+    chans = []
+    for tj in range(-drad, drad + 1):
+        for ti in range(-drad, drad + 1):
+            acc = 0.0
+            for j in range(-kr, kr + 1):
+                for i in range(-kr, kr + 1):
+                    a = win(x1, j, i)
+                    b2 = win(x2, tj * stride2 + j, ti * stride2 + i)
+                    acc = acc + jnp.sum(a * b2, axis=1)  # over channels
+            chans.append(acc / nelems)
+    return jnp.stack(chans, axis=1).astype(input1.dtype)  # [B, D², H', W']
+
+
+# ---------------------------------------------------------------------------
+# batch_fc / hash / nce
+# ---------------------------------------------------------------------------
+
+@register_op
+def batch_fc(input, w, bias=None):
+    """Per-slot batched FC (ops.yaml:494): input [S, B, I] @ w [S, I, O]
+    (+ bias [S, 1, O]) — rank-model slot towers in one einsum."""
+    out = jnp.einsum("sbi,sio->sbo", input, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op(nondiff=True)
+def hash(x, num_hash=1, mod_by=100000, runtime_shape=True):
+    """Bucketed id hashing (legacy/static_ops.yaml:382): x int ids
+    [N, 1] → [N, num_hash, 1] buckets in [0, mod_by). Deterministic
+    multiply-shift family (NOT the reference's XXH64 bit pattern — the
+    contract is stable well-spread buckets, not specific values)."""
+    ids = x.astype(jnp.uint64).reshape(x.shape[0], -1)
+    # fold feature columns into one key per row first
+    key = ids[:, 0]
+    for c in range(1, ids.shape[1]):
+        key = key * jnp.uint64(1000003) + ids[:, c]
+    outs = []
+    for k in range(int(num_hash)):
+        mult = jnp.uint64(0x9E3779B97F4A7C15 + 2 * k + 1)
+        h = key * mult
+        h = h ^ (h >> jnp.uint64(29))
+        h = h * jnp.uint64(0xBF58476D1CE4E5B9)
+        h = h ^ (h >> jnp.uint64(32))
+        outs.append((h % jnp.uint64(int(mod_by))).astype(jnp.int64))
+    return jnp.stack(outs, axis=1)[..., None]
+
+
+@register_op
+def nce(input, label, weight, bias=None, sample_weight=None,
+        custom_dist_probs=None, custom_dist_alias=None,
+        custom_dist_alias_probs=None, num_total_classes=None,
+        custom_neg_classes=(), num_neg_samples=10, sampler=0, seed=0,
+        is_sparse=False, remote_prefetch=False, is_test=False):
+    """NCE loss (nce_op.h): per-example true classes + k sampled
+    negatives scored as independent logistic classifications with the
+    k·p(class) correction. sampler 0=uniform, 1=log-uniform (Zipf).
+    Returns (cost [B,1], sample_logits [B, T+k], sample_labels)."""
+    x = input.astype(jnp.float32)
+    lab = label.reshape(input.shape[0], -1).astype(jnp.int32)
+    bsz, t = lab.shape
+    c = int(num_total_classes)
+    k = int(num_neg_samples)
+    key = jax.random.PRNGKey(int(seed))
+    if int(sampler) == 1:
+        # log-uniform (Zipfian): P(cls) = log((cls+2)/(cls+1)) / log(C+1)
+        u = jax.random.uniform(key, (bsz, k))
+        negs = (jnp.exp(u * jnp.log(float(c + 1))) - 1.0).astype(jnp.int32)
+        negs = jnp.clip(negs, 0, c - 1)
+        p_neg = (jnp.log((negs + 2.0) / (negs + 1.0))
+                 / jnp.log(float(c + 1)))
+        p_true_fn = lambda cls: (jnp.log((cls + 2.0) / (cls + 1.0))
+                                 / jnp.log(float(c + 1)))
+    else:
+        negs = jax.random.randint(key, (bsz, k), 0, c)
+        p_neg = jnp.full((bsz, k), 1.0 / c)
+        p_true_fn = lambda cls: jnp.full(cls.shape, 1.0 / c)
+    samples = jnp.concatenate([lab, negs], axis=1)     # [B, T+k]
+    w_s = jnp.take(weight.astype(jnp.float32), samples, axis=0)
+    logits = jnp.einsum("bd,bsd->bs", x, w_s)
+    if bias is not None:
+        logits = logits + jnp.take(bias.astype(jnp.float32), samples,
+                                   axis=0)
+    o = jnp.exp(logits)
+    p = jnp.concatenate([p_true_fn(lab.astype(jnp.float32)), p_neg],
+                        axis=1)
+    b1 = k * p
+    cost_true = -jnp.log(o[:, :t] / (o[:, :t] + b1[:, :t]) + 1e-20)
+    cost_neg = -jnp.log(b1[:, t:] / (o[:, t:] + b1[:, t:]) + 1e-20)
+    cost = jnp.sum(cost_true, axis=1) + jnp.sum(cost_neg, axis=1)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1).astype(jnp.float32)
+    return (cost[:, None], logits,
+            samples.astype(jnp.int64))
