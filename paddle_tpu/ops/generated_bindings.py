@@ -594,6 +594,10 @@ def dequantize_linear(x, scale, zero_point=None, in_accum=None, in_state=None, q
     return _OPS['dequantize_linear'](x, scale, zero_point=zero_point, in_accum=in_accum, in_state=in_state, quant_axis=quant_axis, bit_length=bit_length, qmin=qmin, qmax=qmax, round_type=round_type, is_test=is_test, only_observer=only_observer)
 
 
+def dequantize_log(x, dict):
+    return _OPS['dequantize_log'](x, dict)
+
+
 def det(x):
     return _OPS['det'](x)
 
@@ -1436,6 +1440,10 @@ def logsumexp(x, axis=None, keepdim=False):
 
 def lookup_table(w, ids, padding_idx=-1, start_index=0):
     return _OPS['lookup_table'](w, ids, padding_idx=padding_idx, start_index=start_index)
+
+
+def lookup_table_dequant(w, ids, padding_idx=-1):
+    return _OPS['lookup_table_dequant'](w, ids, padding_idx=padding_idx)
 
 
 def lower(x, use_utf8_encoding=False):
@@ -2612,6 +2620,7 @@ __all__ = [
     'depthwise_conv2d_transpose',
     'dequantize_abs_max',
     'dequantize_linear',
+    'dequantize_log',
     'det',
     'detection_map',
     'diag',
@@ -2823,6 +2832,7 @@ __all__ = [
     'logspace',
     'logsumexp',
     'lookup_table',
+    'lookup_table_dequant',
     'lower',
     'lp_pool2d',
     'lrn',

@@ -245,3 +245,43 @@ def nce(input, label, weight, bias=None, sample_weight=None,
         cost = cost * sample_weight.reshape(-1).astype(jnp.float32)
     return (cost[:, None], logits,
             samples.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# dequantized-embedding remnants
+# ---------------------------------------------------------------------------
+
+@register_op
+def dequantize_log(x, dict):
+    """phi dequantize_log (dequantize_log_kernel.cc:30-36): code >= 0
+    reads dict[code]; code < 0 reads -dict[code + 128] (the table's upper
+    half, two's-complement offset) — exact reference convention."""
+    codes = x.astype(jnp.int32)
+    pos = jnp.take(dict, jnp.clip(codes, 0, dict.shape[0] - 1), axis=0)
+    neg = -jnp.take(dict, jnp.clip(codes + 128, 0, dict.shape[0] - 1),
+                    axis=0)
+    return jnp.where(codes < 0, neg, pos)
+
+
+@register_op
+def lookup_table_dequant(w, ids, padding_idx=-1):
+    """phi lookup_table_dequant (lookup_table_dequant_kernel.cc:26-90):
+    each row stores [min, max] as float32 then (D-2) float32 slots each
+    PACKING 4 uint8 codes; output width is (D-2)*4 and
+    value = (max - min)/256 * code + min. Out-of-range / padding ids
+    produce zero rows (the reference enforces in-range ids host-side;
+    an XLA program cannot raise data-dependently)."""
+    idx = ids.astype(jnp.int32)
+    if idx.ndim and idx.shape[-1] == 1:
+        idx = idx[..., 0]
+    rows = jnp.take(w.astype(jnp.float32),
+                    jnp.clip(idx, 0, w.shape[0] - 1), axis=0)
+    lo, hi = rows[..., 0:1], rows[..., 1:2]
+    packed = rows[..., 2:]
+    codes = jax.lax.bitcast_convert_type(packed, jnp.uint8)  # [..., D-2, 4]
+    codes = codes.reshape(*packed.shape[:-1], packed.shape[-1] * 4)
+    out = (hi - lo) / 256.0 * codes.astype(jnp.float32) + lo
+    invalid = (idx < 0) | (idx >= w.shape[0])
+    if int(padding_idx) >= 0:
+        invalid = invalid | (idx == int(padding_idx))
+    return jnp.where(invalid[..., None], jnp.zeros((), out.dtype), out)

@@ -195,3 +195,33 @@ def test_nce_trains():
     cost.sum().backward()
     assert x.grad is not None and float(np.abs(_np(x.grad)).max()) > 0
     assert w.grad is not None and float(np.abs(_np(w.grad)).max()) > 0
+
+
+def test_dequantize_log_reference_convention():
+    """code >= 0 -> dict[code]; code < 0 -> -dict[code + 128]
+    (dequantize_log_kernel.cc:30-36)."""
+    dic = np.geomspace(1e-3, 1.0, 128).astype(np.float32)
+    codes = np.array([[5, -5], [20, -128]], np.int8)
+    out = _np(OPS["dequantize_log"](_t(codes), _t(dic)))
+    want = np.array([[dic[5], -dic[123]], [dic[20], -dic[0]]], np.float32)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_lookup_table_dequant_packed_layout():
+    """Row = [min, max, packed-uint8 floats]; output width (D-2)*4;
+    value = (max-min)/256 * code + min (lookup_table_dequant_kernel.cc)."""
+    codes = np.arange(8, dtype=np.uint8) * 30          # 2 packed floats
+    packed = codes.view(np.float32)                    # 4 codes per float
+    row = np.concatenate([[np.float32(-1.0), np.float32(3.0)], packed])
+    w = np.stack([np.zeros_like(row), row]).astype(np.float32)
+    ids = np.array([[1]], np.int64)
+    out = _np(OPS["lookup_table_dequant"](_t(w), _t(ids)))
+    assert out.shape == (1, 8)
+    want = (3.0 - (-1.0)) / 256.0 * codes.astype(np.float32) + (-1.0)
+    np.testing.assert_allclose(out[0], want, rtol=1e-6)
+    # padding / out-of-range ids give zero rows
+    out2 = _np(OPS["lookup_table_dequant"](_t(w), _t(ids), padding_idx=1))
+    np.testing.assert_allclose(out2, np.zeros((1, 8)))
+    out3 = _np(OPS["lookup_table_dequant"](_t(w), _t(np.array([[7]],
+                                                              np.int64))))
+    np.testing.assert_allclose(out3, np.zeros((1, 8)))
