@@ -53,6 +53,10 @@ def log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 def capture() -> int:
+    # invoked as tools/bench_watch.py, so sys.path[0] is tools/ — make the
+    # repo root importable before `import bench`
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
     import jax
 
     d = jax.devices()[0]
@@ -110,7 +114,22 @@ def _commit(paths, msg) -> bool:
 
 
 def try_capture(capture_timeout: float) -> bool:
-    """Returns True when a chip-stamped artifact was captured+committed."""
+    """Returns True when a chip-stamped artifact was captured+committed.
+    Holds the advisory chip lock for the whole capture INCLUDING the
+    op-bench pin — both spawn chip clients, and overlapping clients wedge
+    the tunnel (see tools/tpu_lock.py)."""
+    import tpu_lock
+
+    if not tpu_lock.acquire(wait_s=0):
+        log("chip lock held by another process; skipping this probe")
+        return False
+    try:
+        return _capture_locked(capture_timeout)
+    finally:
+        tpu_lock.release()
+
+
+def _capture_locked(capture_timeout: float) -> bool:
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--capture"],

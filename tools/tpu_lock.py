@@ -1,0 +1,115 @@
+"""Advisory single-client lock for the tunneled TPU chip.
+
+The axon tunnel serves ONE chip; concurrent clients queue behind each
+other's sessions and a client killed mid-session can wedge the tunnel for
+minutes (observed round 5: a watcher capture child + an interactive bench
+overlapped, both hung, and the chip stayed unreachable until every client
+exited). This advisory lock keeps the repo's own chip users — the
+bench_watch capture loop, bench.py, and interactive experiments — from
+overlapping. It cannot stop foreign processes, but all in-repo chip entry
+points honor it, and bench.py (the artifact the driver depends on) waits
+for a fresh lock to clear rather than probing into a busy tunnel and
+misreading it as "down".
+
+Lock = O_EXCL-created JSON file {pid, started} at /root/repo/.tpu_chip.lock.
+Stale (holder dead, or older than TTL) locks are broken on acquire.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+LOCK_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         ".tpu_chip.lock")
+TTL_S = 1800.0   # a capture is ~5 min; anything older is a leak
+
+
+def _read():
+    try:
+        with open(LOCK_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _holder_alive(info) -> bool:
+    pid = info.get("pid") if isinstance(info, dict) else None
+    if not isinstance(pid, int):
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True   # EPERM: process exists, owned by another user
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def is_held_by_other() -> bool:
+    """True when a live, fresh lock from another process exists."""
+    info = _read()
+    if info is None:
+        return False
+    if info.get("pid") == os.getpid():
+        return False
+    if time.time() - info.get("started", 0) > TTL_S:
+        return False
+    return _holder_alive(info)
+
+
+def acquire(wait_s: float = 0.0, poll_s: float = 5.0) -> bool:
+    """Try to take the lock, waiting up to wait_s. Returns True on success."""
+    deadline = time.time() + wait_s
+    while True:
+        try:
+            # O_EXCL first — never unlink a path we haven't just verified
+            # stale, or two acquirers racing past a stale check could each
+            # delete the other's fresh lock and both "win" (TOCTOU).
+            fd = os.open(LOCK_PATH, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                json.dump({"pid": os.getpid(), "started": time.time()}, f)
+            return True
+        except OSError:
+            pass
+        if not is_held_by_other():
+            # existing file is stale/ours — re-confirm, then break exactly
+            # that file and retry O_EXCL on the next loop iteration
+            info = _read()
+            if info is None or info.get("pid") == os.getpid() \
+                    or not _holder_alive(info) \
+                    or time.time() - info.get("started", 0) > TTL_S:
+                try:
+                    os.unlink(LOCK_PATH)
+                except OSError:
+                    pass
+                continue
+        if time.time() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def release() -> None:
+    info = _read()
+    if isinstance(info, dict) and info.get("pid") == os.getpid():
+        try:
+            os.unlink(LOCK_PATH)
+        except OSError:
+            pass
+
+
+class held:
+    """Context manager: `with tpu_lock.held(wait_s=600):` — raises
+    TimeoutError if the lock cannot be taken in time."""
+
+    def __init__(self, wait_s: float = 0.0):
+        self.wait_s = wait_s
+
+    def __enter__(self):
+        if not acquire(self.wait_s):
+            raise TimeoutError("TPU chip lock held by another process")
+        return self
+
+    def __exit__(self, *exc):
+        release()
+        return False
