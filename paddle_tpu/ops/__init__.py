@@ -39,6 +39,7 @@ from .kernels import (  # noqa: F401
     tail_r4,
     tail_r5,
     tail_r5b,
+    tail_r5c,
     tail_seq,
     vision_ops,
     yolo_loss,

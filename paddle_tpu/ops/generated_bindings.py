@@ -210,6 +210,10 @@ def auc(predict, label, stat_pos=None, stat_neg=None, num_thresholds=4095, curve
     return _OPS['auc'](predict, label, stat_pos=stat_pos, stat_neg=stat_neg, num_thresholds=num_thresholds, curve=curve, slide_steps=slide_steps, ins_tag_weight=ins_tag_weight)
 
 
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates, in_old_num_accumulates, in_num_updates, average_window=0.0, max_average_window=16384, min_average_window=10000):
+    return _OPS['average_accumulates_'](param, in_sum_1, in_sum_2, in_sum_3, in_num_accumulates, in_old_num_accumulates, in_num_updates, average_window=average_window, max_average_window=max_average_window, min_average_window=min_average_window)
+
+
 def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format='NCL'):
     return _OPS['avg_pool1d'](x, kernel_size, stride=stride, padding=padding, ceil_mode=ceil_mode, exclusive=exclusive, data_format=data_format)
 
@@ -228,6 +232,10 @@ def batch_fc(input, w, bias=None):
 
 def batch_norm(x, mean, variance, scale=None, bias=None, is_test=False, momentum=0.9, epsilon=1e-05, data_format='NCHW', use_global_stats=False, trainable_statistics=False):
     return _OPS['batch_norm'](x, mean, variance, scale=scale, bias=bias, is_test=is_test, momentum=momentum, epsilon=epsilon, data_format=data_format, use_global_stats=use_global_stats, trainable_statistics=trainable_statistics)
+
+
+def batch_norm_(x, mean, variance, scale=None, bias=None, is_test=False, momentum=0.9, epsilon=1e-05, data_format='NCHW', use_global_stats=False, trainable_statistics=False):
+    return _OPS['batch_norm_'](x, mean, variance, scale=scale, bias=bias, is_test=is_test, momentum=momentum, epsilon=epsilon, data_format=data_format, use_global_stats=use_global_stats, trainable_statistics=trainable_statistics)
 
 
 def batch_norm_infer(x, mean, variance, weight=None, bias=None, epsilon=1e-05, data_format='NCHW'):
@@ -406,6 +414,10 @@ def check_finite_and_unscale_(xs, scale):
     return _OPS['check_finite_and_unscale_'](xs, scale)
 
 
+def check_numerics(x, op_type='', var_name='', check_nan_inf_level=0, stack_height_limit=-1, output_dir=''):
+    return _OPS['check_numerics'](x, op_type=op_type, var_name=var_name, check_nan_inf_level=check_nan_inf_level, stack_height_limit=stack_height_limit, output_dir=output_dir)
+
+
 def cholesky(x, upper=False):
     return _OPS['cholesky'](x, upper=upper)
 
@@ -440,6 +452,10 @@ def coalesce(x):
 
 def coalesce_tensor(input, dtype=None, copy_data=True, set_constant=False, constant=0.0, persist_output=False, align_size=-1):
     return _OPS['coalesce_tensor'](input, dtype=dtype, copy_data=copy_data, set_constant=set_constant, constant=constant, persist_output=persist_output, align_size=align_size)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, rois_num_per_level, post_nms_topn=100):
+    return _OPS['collect_fpn_proposals'](multi_rois, multi_scores, rois_num_per_level, post_nms_topn=post_nms_topn)
 
 
 def complex(real, imag):
@@ -562,6 +578,10 @@ def cvm(x, cvm_input, use_cvm=True):
     return _OPS['cvm'](x, cvm_input, use_cvm=use_cvm)
 
 
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95, epsilon=1e-06):
+    return _OPS['decayed_adagrad'](param, grad, moment, learning_rate, decay=decay, epsilon=epsilon)
+
+
 def decode_jpeg(x, mode='unchanged'):
     return _OPS['decode_jpeg'](x, mode=mode)
 
@@ -642,8 +662,16 @@ def divide(x, y):
     return _OPS['divide'](x, y)
 
 
+def divide_scalar(x, scalar=1.0):
+    return _OPS['divide_scalar'](x, scalar=scalar)
+
+
 def dot(x, y):
     return _OPS['dot'](x, y)
+
+
+def dpsgd(param, grad, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, seed=0):
+    return _OPS['dpsgd'](param, grad, learning_rate, clip=clip, batch_size=batch_size, sigma=sigma, seed=seed)
 
 
 def dropout(x, p=0.5, training=True, mode='upscale_in_train', seed=0):
@@ -850,6 +878,10 @@ def flatten(x, start_axis=0, stop_axis=-1):
     return _OPS['flatten'](x, start_axis=start_axis, stop_axis=stop_axis)
 
 
+def flatten2(x, axis=1):
+    return _OPS['flatten2'](x, axis=axis)
+
+
 def flip(x, axis):
     return _OPS['flip'](x, axis)
 
@@ -892,6 +924,10 @@ def frame(x, frame_length, hop_length, axis=-1):
 
 def frobenius_norm(x, axis=None, keepdim=False):
     return _OPS['frobenius_norm'](x, axis=axis, keepdim=keepdim)
+
+
+def ftrl(param, squared_accumulator, linear_accumulator, grad, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5):
+    return _OPS['ftrl'](param, squared_accumulator, linear_accumulator, grad, learning_rate, l1=l1, l2=l2, lr_power=lr_power)
 
 
 def ftrl_(param, squared_accum, linear_accum, grad, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5):
@@ -1138,6 +1174,10 @@ def gru(x, init_h, w_ih, w_hh, b_ih=None, b_hh=None, is_bidirec=False, num_layer
     return _OPS['gru'](x, init_h, w_ih, w_hh, b_ih=b_ih, b_hh=b_hh, is_bidirec=is_bidirec, num_layers=num_layers, time_major=time_major)
 
 
+def gru_unit(input, hidden_prev, weight, bias=None, activation=2, gate_activation=1, origin_mode=False):
+    return _OPS['gru_unit'](input, hidden_prev, weight, bias=bias, activation=activation, gate_activation=gate_activation, origin_mode=origin_mode)
+
+
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
     return _OPS['gumbel_softmax'](x, temperature=temperature, hard=hard, axis=axis)
 
@@ -1330,6 +1370,14 @@ def leaky_relu(x, negative_slope=0.01):
     return _OPS['leaky_relu'](x, negative_slope=negative_slope)
 
 
+def legacy_crop(x, shape, offsets=None):
+    return _OPS['legacy_crop'](x, shape, offsets=offsets)
+
+
+def legacy_expand(x, expand_times):
+    return _OPS['legacy_expand'](x, expand_times)
+
+
 def lerp(x, y, weight):
     return _OPS['lerp'](x, y, weight)
 
@@ -1498,8 +1546,16 @@ def masked_select(x, mask):
     return _OPS['masked_select'](x, mask)
 
 
+def match_matrix_tensor(x, y, w, x_lod, y_lod, dim_t=1):
+    return _OPS['match_matrix_tensor'](x, y, w, x_lod, y_lod, dim_t=dim_t)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False):
     return _OPS['matmul'](x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def matmul_with_flatten(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    return _OPS['matmul_with_flatten'](x, y, x_num_col_dims=x_num_col_dims, y_num_col_dims=y_num_col_dims)
 
 
 def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0, nms_top_k=400, keep_top_k=200, use_gaussian=False, gaussian_sigma=2.0, background_label=0, normalized=True):
@@ -1554,6 +1610,10 @@ def maxout(x, groups, axis=1):
     return _OPS['maxout'](x, groups, axis=axis)
 
 
+def maxpool(x, kernel_size, strides=None, paddings=0, ceil_mode=False, data_format='NCHW'):
+    return _OPS['maxpool'](x, kernel_size, strides=strides, paddings=paddings, ceil_mode=ceil_mode, data_format=data_format)
+
+
 def mean(x, axis=None, keepdim=False):
     return _OPS['mean'](x, axis=axis, keepdim=keepdim)
 
@@ -1576,6 +1636,10 @@ def memcpy_h2d(x, dst_place_type=1):
 
 def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None, cu_seqlens_k=None, causal=False, dropout_p=0.0, scale=None):
     return _OPS['memory_efficient_attention'](query, key, value, bias=bias, cu_seqlens_q=cu_seqlens_q, cu_seqlens_k=cu_seqlens_k, causal=causal, dropout_p=dropout_p, scale=scale)
+
+
+def merge_selected_rows(ids, values):
+    return _OPS['merge_selected_rows'](ids, values)
 
 
 def merged_adam_(params, grads, learning_rate, moments1, moments2, beta1_pows, beta2_pows, beta1=0.9, beta2=0.999, epsilon=1e-08):
@@ -1842,6 +1906,10 @@ def qr(x, mode='reduced'):
     return _OPS['qr'](x, mode=mode)
 
 
+def quant_linear(x, w, bias=None, in_num_col_dims=1, activation_type='', padding_weights=False, scale_in=1.0, scale_weights=(1.0,), quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0):
+    return _OPS['quant_linear'](x, w, bias=bias, in_num_col_dims=in_num_col_dims, activation_type=activation_type, padding_weights=padding_weights, scale_in=scale_in, scale_weights=scale_weights, quant_round_type=quant_round_type, quant_max_bound=quant_max_bound, quant_min_bound=quant_min_bound)
+
+
 def quantile(x, q, axis=None, keepdim=False):
     return _OPS['quantile'](x, q, axis=axis, keepdim=keepdim)
 
@@ -1868,6 +1936,10 @@ def random_routing(topk_idx, topk_value, prob):
 
 def randperm(n, dtype=None, seed=0):
     return _OPS['randperm'](n, dtype=dtype, seed=seed)
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0):
+    return _OPS['rank_attention'](x, rank_offset, rank_param, max_rank=max_rank, max_size=max_size)
 
 
 def read_file(filename):
@@ -1972,6 +2044,10 @@ def round(x, decimals=0):
 
 def row_conv(x, filter, lod=None):
     return _OPS['row_conv'](x, filter, lod=lod)
+
+
+def rprop_(param, grad, prev, learning_rate, learning_rate_range, etas):
+    return _OPS['rprop_'](param, grad, prev, learning_rate, learning_rate_range, etas)
 
 
 def rrelu(x, lower=0.125, upper=0.3333333333333333, is_test=False):
@@ -2158,6 +2234,10 @@ def sparse_attention(q, k, v, offset, columns, key_padding_mask=None, attn_mask=
     return _OPS['sparse_attention'](q, k, v, offset, columns, key_padding_mask=key_padding_mask, attn_mask=attn_mask)
 
 
+def sparse_momentum(param, grad, velocity, index, learning_rate, mu=0.9, use_nesterov=False, regularization_method='', regularization_coeff=0.0, axis=0):
+    return _OPS['sparse_momentum'](param, grad, velocity, index, learning_rate, mu=mu, use_nesterov=use_nesterov, regularization_method=regularization_method, regularization_coeff=regularization_coeff, axis=axis)
+
+
 def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     return _OPS['spectral_norm'](weight, u, v, dim=dim, power_iters=power_iters, eps=eps)
 
@@ -2266,6 +2346,14 @@ def tanhshrink(x):
     return _OPS['tanhshrink'](x)
 
 
+def tdm_child(x, tree_info, child_nums=2):
+    return _OPS['tdm_child'](x, tree_info, child_nums=child_nums)
+
+
+def tdm_sampler(x, travel, layer, neg_samples_num_list=(1,), layer_offset_lod=(0, 1), output_positive=True, seed=0):
+    return _OPS['tdm_sampler'](x, travel, layer, neg_samples_num_list=neg_samples_num_list, layer_offset_lod=layer_offset_lod, output_positive=output_positive, seed=seed)
+
+
 def temporal_shift(x, seg_num=1, shift_ratio=0.25, data_format='NCHW'):
     return _OPS['temporal_shift'](x, seg_num=seg_num, shift_ratio=shift_ratio, data_format=data_format)
 
@@ -2296,6 +2384,10 @@ def top_p_sampling(x, ps, threshold=None, seed=0):
 
 def topk(x, k, axis=-1, largest=True, sorted=True):
     return _OPS['topk'](x, k, axis=axis, largest=largest, sorted=sorted)
+
+
+def topk_v1(x, k=1):
+    return _OPS['topk_v1'](x, k=k)
 
 
 def trace(x, offset=0, axis1=0, axis2=1):
@@ -2524,11 +2616,13 @@ __all__ = [
     'atan2',
     'atanh',
     'auc',
+    'average_accumulates_',
     'avg_pool1d',
     'avg_pool2d',
     'barrier',
     'batch_fc',
     'batch_norm',
+    'batch_norm_',
     'batch_norm_infer',
     'batch_norm_train',
     'bce_loss',
@@ -2573,6 +2667,7 @@ __all__ = [
     'celu',
     'channel_shuffle',
     'check_finite_and_unscale_',
+    'check_numerics',
     'cholesky',
     'cholesky_solve',
     'chunk',
@@ -2582,6 +2677,7 @@ __all__ = [
     'clip_by_norm',
     'coalesce',
     'coalesce_tensor',
+    'collect_fpn_proposals',
     'complex',
     'concat',
     'cond',
@@ -2612,6 +2708,7 @@ __all__ = [
     'cumprod',
     'cumsum',
     'cvm',
+    'decayed_adagrad',
     'decode_jpeg',
     'deformable_conv',
     'deg2rad',
@@ -2632,7 +2729,9 @@ __all__ = [
     'dist',
     'distribute_fpn_proposals',
     'divide',
+    'divide_scalar',
     'dot',
+    'dpsgd',
     'dropout',
     'dropout_nd',
     'edit_distance',
@@ -2684,6 +2783,7 @@ __all__ = [
     'flash_attn_varlen_qkvpacked',
     'flashmask_attention',
     'flatten',
+    'flatten2',
     'flip',
     'floor',
     'floor_divide',
@@ -2695,6 +2795,7 @@ __all__ = [
     'fractional_max_pool3d',
     'frame',
     'frobenius_norm',
+    'ftrl',
     'ftrl_',
     'full',
     'full_',
@@ -2756,6 +2857,7 @@ __all__ = [
     'grid_sample',
     'group_norm',
     'gru',
+    'gru_unit',
     'gumbel_softmax',
     'hardshrink',
     'hardsigmoid',
@@ -2804,6 +2906,8 @@ __all__ = [
     'lcm',
     'ldexp',
     'leaky_relu',
+    'legacy_crop',
+    'legacy_expand',
     'lerp',
     'less_equal',
     'less_than',
@@ -2846,7 +2950,9 @@ __all__ = [
     'masked_matmul',
     'masked_multihead_attention_',
     'masked_select',
+    'match_matrix_tensor',
     'matmul',
+    'matmul_with_flatten',
     'matrix_nms',
     'matrix_power',
     'matrix_rank',
@@ -2860,12 +2966,14 @@ __all__ = [
     'max_pool3d_with_index',
     'maximum',
     'maxout',
+    'maxpool',
     'mean',
     'mean_all',
     'median',
     'memcpy_d2h',
     'memcpy_h2d',
     'memory_efficient_attention',
+    'merge_selected_rows',
     'merged_adam_',
     'merged_momentum_',
     'meshgrid',
@@ -2932,6 +3040,7 @@ __all__ = [
     'psroi_pool',
     'put_along_axis',
     'qr',
+    'quant_linear',
     'quantile',
     'quantize_linear',
     'rad2deg',
@@ -2939,6 +3048,7 @@ __all__ = [
     'randint',
     'random_routing',
     'randperm',
+    'rank_attention',
     'read_file',
     'real',
     'reciprocal',
@@ -2965,6 +3075,7 @@ __all__ = [
     'rot90',
     'round',
     'row_conv',
+    'rprop_',
     'rrelu',
     'rsqrt',
     'scale',
@@ -3011,6 +3122,7 @@ __all__ = [
     'solve',
     'sort',
     'sparse_attention',
+    'sparse_momentum',
     'spectral_norm',
     'split',
     'split_with_num',
@@ -3038,6 +3150,8 @@ __all__ = [
     'tanh',
     'tanh_shrink',
     'tanhshrink',
+    'tdm_child',
+    'tdm_sampler',
     'temporal_shift',
     'thresholded_relu',
     'tile',
@@ -3046,6 +3160,7 @@ __all__ = [
     'to_sparse_csr',
     'top_p_sampling',
     'topk',
+    'topk_v1',
     'trace',
     'trans_layout',
     'transfer_layout',

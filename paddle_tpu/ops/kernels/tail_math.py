@@ -556,6 +556,12 @@ def asgd_(param, grad, learning_rate, d, y, n):
 @register_op(name="ftrl_", nondiff=True)
 def ftrl_(param, squared_accum, linear_accum, grad, learning_rate,
           l1=0.0, l2=0.0, lr_power=-0.5):
+    """FTRL-proximal (ftrl_kernel_impl.h:138-187). The reference shifts
+    l1/l2 by 1e-10 before use; reproduced so the sparsity threshold and
+    denominator match. Also registered under the legacy forward name
+    `ftrl` (tail_r5c.py)."""
+    l1 = l1 + 1e-10
+    l2 = l2 + 1e-10
     new_sq = squared_accum + grad * grad
     sigma = (new_sq ** (-lr_power) - squared_accum ** (-lr_power)) \
         / learning_rate

@@ -1,0 +1,501 @@
+"""Op tail 9 (round 5, third batch): remaining non-XPU forward names from
+the reference's op YAMLs — legacy optimizers, legacy aliases, tree/recsys
+ops, and detection collection.
+
+Optimizer updates (all follow the repo's `*_` update-op convention —
+functional: return the new states instead of mutating):
+
+* ``decayed_adagrad`` — `paddle/phi/kernels/impl/decayed_adagrad_kernel_impl.h:44-48`:
+  m' = decay*m + (1-decay)*g²; p' = p - lr*g/(sqrt(m')+eps).
+* ``ftrl`` — `paddle/phi/kernels/impl/ftrl_kernel_impl.h:158-187` dense path,
+  including the lr_power==-0.5 special case and the l1/l2 +1e-10 shifts.
+* ``dpsgd`` — `paddle/phi/kernels/cpu/dpsgd_kernel.cc:63-103`: global-norm
+  clip to `clip` then one shared gaussian noise draw scaled by sigma /
+  batch_size (CCS16 DP-SGD). Noise here uses jax PRNG from `seed`
+  (deterministic; bit-compat with the reference's minstd_rand Box-Muller is
+  not a contract — the reference itself reseeds from time() when seed==0).
+* ``rprop_`` — `paddle/phi/kernels/cpu/rprop_kernel.cc:44-104`: sign
+  agreement with the previous gradient scales per-element lr by eta+/eta-,
+  clips to [lr_min, lr_max]; disagreeing elements zero the applied grad.
+* ``sparse_momentum`` — `paddle/phi/kernels/impl/sparse_momentum_kernel_impl.h:222-228`:
+  momentum applied only to the rows named by `index` (grad is gathered-shape).
+* ``average_accumulates_`` — `paddle/phi/kernels/impl/average_accumulates_kernel_impl.h:110-136`:
+  the ASGD window accumulator shuffle (sum_1/sum_2/sum_3 + 3 counters).
+
+Legacy aliases / plumbing:
+
+* ``divide_scalar``, ``flatten2``, ``matmul_with_flatten`` (the fluid `mul`
+  op), ``maxpool``, ``topk_v1``, ``legacy_expand`` (expand_times ≡ tile),
+  ``legacy_crop``, ``merge_selected_rows``, ``batch_norm_``.
+* ``check_numerics`` — `paddle/phi/kernels/check_numerics_kernel.h`: count
+  nan/inf and extremes of a tensor (the debugging hook behind
+  FLAGS_check_nan_inf).
+
+Structured ops:
+
+* ``gru_unit`` — `paddle/phi/kernels/impl/gru_unit_kernel_impl.h:51-153`:
+  one GRU cell step with selectable gate activations and origin_mode.
+* ``quant_linear`` — `legacy/static_ops.yaml:691` +
+  `paddle/phi/kernels/funcs/quant_dequant.h:70-85,361-391`: quantize x by
+  round(max_bound*scale_in*x) clipped, int8 matmul, dequantize by
+  acc/(max_bound²·scale_in·scale_w[col]), then bias/relu.
+* ``rank_attention`` — `paddle/phi/kernels/funcs/rank_attention.cu.h:71-123`
+  (GPU-only in the reference; this one runs anywhere XLA does): per-instance
+  rank-selected parameter blocks, out[i] = Σ_k x[idx_k] @ W[lower_i·K+faster_k].
+* ``tdm_child`` — `paddle/phi/kernels/cpu/tdm_child_kernel.cc:49-101`:
+  child-id lookup in the [node, item;layer;ancestor;children...] tree table.
+* ``tdm_sampler`` — `paddle/phi/kernels/cpu/tdm_sampler_kernel.cc:52-200`:
+  per-layer positive + uniform negative sampling along the travel path
+  (jax PRNG; exclusion of the positive done by shift-past-index).
+* ``match_matrix_tensor`` — `paddle/phi/kernels/cpu/match_matrix_tensor_kernel.cc`:
+  per-channel bilinear interaction x·W_t·yᵀ over LoD segment pairs (lod
+  passed explicitly as offsets, the repo's LoD convention).
+* ``collect_fpn_proposals`` — `paddle/phi/kernels/impl/collect_fpn_proposals_kernel_impl.h:59-...`:
+  concat per-level RoIs, global top-post_nms_topn by score, regroup by
+  batch id. EAGER host op (data-dependent shapes), like the repo's other
+  proposal ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dispatch import register_op
+
+
+# ---------------------------------------------------------------------------
+# Optimizer updates
+# ---------------------------------------------------------------------------
+
+@register_op(name="decayed_adagrad", nondiff=True)
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6):
+    m = decay * moment + (1 - decay) * grad * grad
+    p = param - learning_rate * grad / (jnp.sqrt(m) + epsilon)
+    return p, m
+
+
+@register_op(name="ftrl", nondiff=True)
+def ftrl(param, squared_accumulator, linear_accumulator, grad, learning_rate,
+         l1=0.0, l2=0.0, lr_power=-0.5):
+    """Legacy forward name for the FTRL-proximal update — one shared
+    kernel with ftrl_ (tail_math.py) so the two names cannot drift."""
+    from .tail_math import ftrl_
+    return ftrl_.__wrapped__(param, squared_accumulator, linear_accumulator,
+                             grad, learning_rate, l1=l1, l2=l2,
+                             lr_power=lr_power)
+
+
+@register_op(name="dpsgd", nondiff=True)
+def dpsgd(param, grad, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0,
+          seed=0):
+    """Faithful to the reference's noise shape: dpsgd_kernel.cc:76-103
+    computes ONE Box-Muller gaussian draw before the element loop and adds
+    the same scalar to every element. Difference: the reference reseeds
+    from time() when seed==0 (non-reproducible); here seed==0 is just
+    another deterministic stream — vary `seed` per step for fresh noise."""
+    g = grad
+    l2 = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+    scale = jnp.where(l2 > clip, l2 / clip, 1.0).astype(param.dtype)
+    noise = sigma * jax.random.normal(jax.random.PRNGKey(int(seed)), ())
+    noise = noise.astype(param.dtype)
+    return param - learning_rate * (g / scale + noise / batch_size)
+
+
+@register_op(name="rprop_", nondiff=True)
+def rprop_(param, grad, prev, learning_rate, learning_rate_range, etas):
+    """learning_rate is per-element [same shape as param];
+    learning_rate_range = [lr_min, lr_max]; etas = [eta_negative,
+    eta_positive] (rprop_kernel.cc:44-104)."""
+    lr_min, lr_max = learning_rate_range[0], learning_rate_range[1]
+    eta_neg, eta_pos = etas[0], etas[1]
+    prod = grad * prev
+    eta = jnp.where(prod > 0, eta_pos, jnp.where(prod < 0, eta_neg,
+                                                 jnp.ones_like(prod)))
+    g = jnp.where(prod < 0, jnp.zeros_like(grad), grad)
+    lr = jnp.clip(learning_rate * eta, lr_min, lr_max)
+    p = param - jnp.sign(g) * lr
+    return p, g, lr
+
+
+@register_op(name="sparse_momentum", nondiff=True)
+def sparse_momentum(param, grad, velocity, index, learning_rate, mu=0.9,
+                    use_nesterov=False, regularization_method="",
+                    regularization_coeff=0.0, axis=0):
+    """grad covers only the rows named by `index` along `axis`
+    (sparse_momentum_kernel_impl.h:222-228); other rows keep their param
+    and velocity."""
+    idx = jnp.asarray(index, jnp.int32)
+    p_rows = jnp.take(param, idx, axis=axis)
+    v_rows = jnp.take(velocity, idx, axis=axis)
+    g = grad
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * p_rows
+    v_new = mu * v_rows + g
+    step = g + mu * v_new if use_nesterov else v_new
+    p_new = p_rows - learning_rate * step
+    axis = int(axis)
+
+    def put(full, rows):
+        moved = jnp.moveaxis(full, axis, 0)
+        moved = moved.at[idx].set(jnp.moveaxis(rows, axis, 0))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return put(param, p_new), put(velocity, v_new)
+
+
+@register_op(name="average_accumulates_", nondiff=True)
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=0.0,
+                         max_average_window=16384, min_average_window=10000):
+    """ASGD window accumulators (average_accumulates_kernel_impl.h:110-136).
+    Counters are int64 scalars carried as tensors; kMaxNumAccumulates=16384
+    triggers the precision spill of sum_1 into sum_2."""
+    k_max = 16384
+    num_updates = jnp.asarray(in_num_updates).reshape(()) + 1
+    num_acc = jnp.asarray(in_num_accumulates).reshape(()) + 1
+    old_num_acc = jnp.asarray(in_old_num_accumulates).reshape(())
+    sum_1 = in_sum_1 + param
+    sum_2 = in_sum_2
+    sum_3 = in_sum_3
+    spill = num_updates % k_max == 0
+    sum_2 = jnp.where(spill, sum_2 + sum_1, sum_2)
+    sum_1 = jnp.where(spill, jnp.zeros_like(sum_1), sum_1)
+    window = jnp.minimum(jnp.asarray(max_average_window, jnp.float32),
+                         num_updates.astype(jnp.float32) * average_window)
+    flush = (num_acc >= min_average_window) & (num_acc.astype(jnp.float32)
+                                               >= window)
+    sum_3 = jnp.where(flush, sum_1 + sum_2, sum_3)
+    sum_1 = jnp.where(flush, jnp.zeros_like(sum_1), sum_1)
+    sum_2 = jnp.where(flush, jnp.zeros_like(sum_2), sum_2)
+    old_num_acc = jnp.where(flush, num_acc, old_num_acc)
+    num_acc = jnp.where(flush, jnp.zeros_like(num_acc), num_acc)
+    return (sum_1, sum_2, sum_3,
+            num_acc.reshape(jnp.asarray(in_num_accumulates).shape),
+            old_num_acc.reshape(jnp.asarray(in_old_num_accumulates).shape),
+            num_updates.reshape(jnp.asarray(in_num_updates).shape))
+
+
+# ---------------------------------------------------------------------------
+# Legacy aliases / plumbing
+# ---------------------------------------------------------------------------
+
+@register_op
+def divide_scalar(x, scalar=1.0):
+    return x / scalar
+
+
+@register_op
+def flatten2(x, axis=1):
+    """Legacy flatten2: (out, xshape). xshape leads with a 0 the way the
+    reference's shape-carrying outputs do."""
+    axis = int(axis)
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    out = x.reshape(lead, -1)
+    xshape = jnp.zeros((0,) + tuple(x.shape), x.dtype)
+    return out, xshape
+
+
+@register_op
+def matmul_with_flatten(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """The fluid `mul` op: flatten both operands to 2-D then matmul,
+    restoring the leading dims on the output."""
+    xs, ys = x.shape, y.shape
+    xm = int(np.prod(xs[:x_num_col_dims], dtype=np.int64))
+    xk = int(np.prod(xs[x_num_col_dims:], dtype=np.int64))
+    yk = int(np.prod(ys[:y_num_col_dims], dtype=np.int64))
+    yn = int(np.prod(ys[y_num_col_dims:], dtype=np.int64))
+    # explicit column counts (not -1) so zero-sized batches reshape cleanly
+    out2 = x.reshape(xm, xk) @ y.reshape(yk, yn)
+    return out2.reshape(tuple(xs[:x_num_col_dims]) + tuple(ys[y_num_col_dims:]))
+
+
+@register_op
+def maxpool(x, kernel_size, strides=None, paddings=0, ceil_mode=False,
+            data_format="NCHW"):
+    """Legacy alias of max pool2d."""
+    from ..dispatch import OPS
+    return OPS["pool2d"]._kernel(x, kernel_size, strides=strides,
+                                 paddings=paddings, ceil_mode=ceil_mode,
+                                 pooling_type="max", data_format=data_format)
+
+
+@register_op
+def topk_v1(x, k=1):
+    """Legacy top_k: k as a plain attribute, last-axis only."""
+    vals, idx = jax.lax.top_k(x, int(k))
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op
+def legacy_expand(x, expand_times):
+    """Old expand semantics: per-axis repeat counts (≡ tile), not target
+    shape."""
+    return jnp.tile(x, tuple(int(t) for t in expand_times))
+
+
+@register_op
+def legacy_crop(x, shape, offsets=None):
+    """Old crop: static offsets (default 0) + output shape."""
+    shape = tuple(int(s) for s in shape)
+    offsets = (0,) * x.ndim if offsets is None else tuple(int(o) for o in offsets)
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op(nondiff=True)
+def merge_selected_rows(ids, values):
+    """Merge duplicate rows of a SelectedRows pair by summing (reference
+    merge_selected_rows op): returns (unique ids ascending, summed rows).
+    EAGER host op — output row count is data-dependent."""
+    ids_np = np.asarray(ids).reshape(-1)
+    vals_np = np.asarray(values)
+    uniq, inv = np.unique(ids_np, return_inverse=True)
+    out = np.zeros((len(uniq),) + vals_np.shape[1:], vals_np.dtype)
+    np.add.at(out, inv, vals_np)
+    return jnp.asarray(uniq), jnp.asarray(out)
+
+
+@register_op(name="batch_norm_", nondiff=False)
+def batch_norm_(x, mean, variance, scale=None, bias=None, is_test=False,
+                momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                use_global_stats=False, trainable_statistics=False):
+    """Inplace-suffixed alias of batch_norm (functional here — the repo's
+    convention for the reference's `_` ops)."""
+    from ..dispatch import OPS
+    return OPS["batch_norm"]._kernel(
+        x, mean, variance, scale=scale, bias=bias, is_test=is_test,
+        momentum=momentum, epsilon=epsilon, data_format=data_format,
+        use_global_stats=use_global_stats,
+        trainable_statistics=trainable_statistics)
+
+
+@register_op(nondiff=True)
+def check_numerics(x, op_type="", var_name="", check_nan_inf_level=0,
+                   stack_height_limit=-1, output_dir=""):
+    """Numeric health stats (check_numerics_kernel.h): returns
+    (stats[3] = [#nan, #inf, #zero] int64, values[3] = [max, min, mean])."""
+    xf = x.astype(jnp.float32)
+    bad = jnp.isnan(xf) | jnp.isinf(xf)
+    stats = jnp.stack([jnp.sum(jnp.isnan(xf)), jnp.sum(jnp.isinf(xf)),
+                       jnp.sum(x == 0)]).astype(jnp.int64)
+    # extremes/mean over the FINITE values only (zero-substitution would
+    # report a max/min that never occurs in the tensor); all-bad tensors
+    # report ∓inf extremes and mean 0
+    n_ok = jnp.maximum(jnp.sum(~bad), 1)
+    values = jnp.stack([
+        jnp.max(jnp.where(bad, -jnp.inf, xf)),
+        jnp.min(jnp.where(bad, jnp.inf, xf)),
+        jnp.sum(jnp.where(bad, 0.0, xf)) / n_ok,
+    ])
+    return stats, values
+
+
+# ---------------------------------------------------------------------------
+# Structured ops
+# ---------------------------------------------------------------------------
+
+_GRU_ACTS = {0: lambda v: v, 1: jax.nn.sigmoid, 2: jnp.tanh,
+             3: jax.nn.relu}
+
+
+@register_op
+def gru_unit(input, hidden_prev, weight, bias=None, activation=2,
+             gate_activation=1, origin_mode=False):
+    """One GRU cell step (gru_unit_kernel_impl.h:51-153).
+    input [B, 3D] = x @ W_x (precomputed, fluid convention); hidden_prev
+    [B, D]; weight [D, 3D] packed as [W_update|W_reset | W_candidate].
+    Returns (gate [B, 3D], reset_hidden_prev [B, D], hidden [B, D])."""
+    B, D = hidden_prev.shape
+    act = _GRU_ACTS[int(activation)]
+    gate_act = _GRU_ACTS[int(gate_activation)]
+    g = input if bias is None else input + bias.reshape(1, 3 * D)
+    w_ur = weight[:, :2 * D].reshape(D, 2 * D)
+    w_c = weight[:, 2 * D:].reshape(D, D)
+    g = jnp.concatenate([g[:, :2 * D] + hidden_prev @ w_ur, g[:, 2 * D:]], 1)
+    u = gate_act(g[:, :D])
+    r = gate_act(g[:, D:2 * D])
+    rhp = r * hidden_prev
+    c_lin = g[:, 2 * D:] + rhp @ w_c
+    c = act(c_lin)
+    if origin_mode:
+        h = c + u * (hidden_prev - c)
+    else:
+        h = u * (c - hidden_prev) + hidden_prev
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return gate, rhp, h
+
+
+@register_op
+def quant_linear(x, w, bias=None, in_num_col_dims=1, activation_type="",
+                 padding_weights=False, scale_in=1.0, scale_weights=(1.0,),
+                 quant_round_type=1, quant_max_bound=127.0,
+                 quant_min_bound=-127.0):
+    """Quantized FC (quant_dequant.h:70-85 quantize, :361-391 dequantize):
+    x_q = clip(round(max_bound·scale_in·x)); acc = x_q @ w (w carries int8
+    values); out = acc / (max_bound²·scale_in·scale_w[col]) + bias
+    (+ relu). round_type 0 = ties-to-even, else away-from-zero.
+    padding_weights=True means w carries 4 padding rows and columns
+    (QuantLinearKernel: w_dims - 4) which are stripped here."""
+    if padding_weights:
+        w = w[:-4, :-4]
+    xs = x.shape
+    m = int(np.prod(xs[:in_num_col_dims], dtype=np.int64))
+    k = int(np.prod(xs[in_num_col_dims:], dtype=np.int64))
+    x2 = x.reshape(m, k).astype(jnp.float32)
+    q = quant_max_bound * scale_in * x2
+    if int(quant_round_type) == 0:
+        q = jnp.round(q)            # jnp.round is ties-to-even
+    else:
+        q = jnp.trunc(q + jnp.sign(q) * 0.5)   # ties away from zero
+    q = jnp.clip(q, quant_min_bound, quant_max_bound)
+    acc = q @ jnp.asarray(w, jnp.float32)   # int8-valued; f32 matmul is exact
+    sw = jnp.asarray(scale_weights, jnp.float32).reshape(1, -1)
+    out = acc / (quant_max_bound * quant_max_bound * scale_in * sw)
+    if bias is not None:
+        out = out + bias.reshape(1, -1).astype(out.dtype)
+    if activation_type == "relu":
+        out = jax.nn.relu(out)
+    out = out.astype(x.dtype)
+    return out.reshape(tuple(xs[:in_num_col_dims]) + (w.shape[1],))
+
+
+@register_op
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0):
+    """Ad-ranking rank attention (rank_attention.cu.h:71-123; the
+    reference's CPU kernel raises "GPU only" — this runs anywhere).
+    x [N, d]; rank_offset [N, 1+2K] int (col 0 = lower rank, odd cols =
+    faster rank per slot, even cols = row index into x); rank_param
+    [K*K*d, p] viewed as [K*K, d, p] blocks.
+    Returns (input_help [N, K*d], out [N, p], ins_rank [N, 1])."""
+    N, d = x.shape
+    K = int(max_rank)
+    p = rank_param.shape[-1]
+    ro = jnp.asarray(rank_offset, jnp.int32)
+    lower = ro[:, 0] - 1                      # [N]
+    faster = ro[:, 1::2][:, :K] - 1           # [N, K]
+    index = ro[:, 2::2][:, :K]                # [N, K]
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+    xk = jnp.take(x, jnp.clip(index, 0, N - 1), axis=0)      # [N, K, d]
+    xk = jnp.where(valid[..., None], xk, 0)
+    blocks = rank_param.reshape(K * K, d, p)
+    bidx = jnp.clip(lower[:, None] * K + faster, 0, K * K - 1)
+    wk = jnp.take(blocks, bidx, axis=0)                      # [N, K, d, p]
+    wk = jnp.where(valid[..., None, None], wk, 0)
+    out = jnp.einsum("nkd,nkdp->np", xk, wk)
+    input_help = xk.reshape(N, K * d)
+    ins_rank = ro[:, :1].astype(x.dtype)
+    return input_help, out, ins_rank
+
+
+@register_op(nondiff=True)
+def tdm_child(x, tree_info, child_nums=2):
+    """Child lookup in the TDM tree table (tdm_child_kernel.cc:49-101).
+    tree_info rows: [item_id, layer_id, ancestor_id, child_0, child_1, ...];
+    node 0 or zero child slot ⇒ no child. Returns (child, mask) shaped
+    x.shape + (child_nums,)."""
+    ids = jnp.asarray(x, jnp.int32)
+    info = jnp.asarray(tree_info, jnp.int32)
+    C = int(child_nums)
+    rows = jnp.take(info, ids.reshape(-1), axis=0)           # [M, L]
+    has_child = (ids.reshape(-1) != 0) & (rows[:, 3] != 0)
+    children = rows[:, 3:3 + C]                              # [M, C]
+    children = jnp.where(has_child[:, None], children, 0)
+    child_item = jnp.take(info[:, 0], jnp.clip(children, 0, info.shape[0] - 1),
+                          axis=0)
+    mask = jnp.where(has_child[:, None] & (children != 0)
+                     & (child_item != 0), 1, 0)
+    shape = tuple(ids.shape) + (C,)
+    return children.reshape(shape), mask.reshape(shape).astype(jnp.int32)
+
+
+@register_op(nondiff=True)
+def tdm_sampler(x, travel, layer, neg_samples_num_list=(1,),
+                layer_offset_lod=(0, 1), output_positive=True, seed=0):
+    """Layer-wise TDM sampling (tdm_sampler_kernel.cc:52-200): for each
+    input id, walk its travel path; per layer emit the positive node
+    (optional) + `neg` uniform negatives from that layer excluding the
+    positive (exclusion by shift-past-index). Padding layers (positive==0)
+    emit zeros with mask 0. Returns (out, labels, mask), each
+    [num_ids, Σ(neg_i + output_positive)] int32."""
+    ids = jnp.asarray(x, jnp.int32).reshape(-1)
+    trav = jnp.asarray(travel, jnp.int32)
+    layer_off = [int(v) for v in layer_offset_lod]
+    negs = [int(n) for n in neg_samples_num_list]
+    lay = jnp.asarray(layer, jnp.int32).reshape(-1)
+    key = jax.random.PRNGKey(int(seed))
+    outs, labels, masks = [], [], []
+    for li, neg in enumerate(negs):
+        lo, hi = layer_off[li], layer_off[li + 1]
+        n_nodes = hi - lo
+        pos = trav[ids, li]                                  # [M]
+        alive = pos != 0
+        if output_positive:
+            outs.append(pos[:, None])
+            labels.append(jnp.where(alive, 1, 0)[:, None])
+            masks.append(jnp.where(alive, 1, 0)[:, None])
+        key, sub = jax.random.split(key)
+        # sample from n_nodes-1 then shift indices >= positive's slot by 1
+        draw = jax.random.randint(sub, (ids.shape[0], neg), 0,
+                                  max(n_nodes - 1, 1))
+        pos_slot = jnp.argmax(jnp.asarray(lay[lo:hi])[None, :]
+                              == pos[:, None], axis=1)       # [M]
+        draw = jnp.where(draw >= pos_slot[:, None], draw + 1, draw)
+        neg_ids = jnp.take(lay[lo:hi], jnp.clip(draw, 0, n_nodes - 1), axis=0)
+        neg_ids = jnp.where(alive[:, None], neg_ids, 0)
+        outs.append(neg_ids)
+        labels.append(jnp.zeros_like(neg_ids))
+        masks.append(jnp.where(alive, 1, 0)[:, None]
+                     * jnp.ones((1, neg), jnp.int32))
+    return (jnp.concatenate(outs, 1), jnp.concatenate(labels, 1),
+            jnp.concatenate(masks, 1))
+
+
+@register_op
+def match_matrix_tensor(x, y, w, x_lod, y_lod, dim_t=1):
+    """Text-matching bilinear interaction
+    (match_matrix_tensor_kernel.cc): for each segment pair i, channel t:
+    out_i_t = (x_i @ W_t) @ y_iᵀ, flattened over segment pairs. lod as
+    explicit offsets (repo LoD convention). Returns (out [Σ lx·ly·dim_t, 1],
+    tmp = x @ W flattened [N·dim_t·d, 1])."""
+    x_off = np.asarray(x_lod, np.int64).reshape(-1)
+    y_off = np.asarray(y_lod, np.int64).reshape(-1)
+    d = x.shape[1]
+    T = int(dim_t)
+    wt = jnp.asarray(w).reshape(d, T, -1)           # [d, T, d_y]
+    xw = jnp.einsum("nd,dte->nte", x, wt)           # [N, T, d_y]
+    outs = []
+    for i in range(len(x_off) - 1):
+        xs, xe = int(x_off[i]), int(x_off[i + 1])
+        ys, ye = int(y_off[i]), int(y_off[i + 1])
+        seg = jnp.einsum("lte,me->tlm", xw[xs:xe], y[ys:ye])  # [T, lx, ly]
+        outs.append(seg.reshape(-1))
+    out = jnp.concatenate(outs).reshape(-1, 1)
+    return out, xw.reshape(-1, 1)
+
+
+@register_op(nondiff=True)
+def collect_fpn_proposals(multi_rois, multi_scores, rois_num_per_level,
+                          post_nms_topn=100):
+    """FPN proposal collection (collect_fpn_proposals_kernel_impl.h):
+    concat levels -> global top-post_nms_topn by score -> regroup rows by
+    batch id. `rois_num_per_level` is a list of per-level [B] counts.
+    Returns (fpn_rois [M, 4], rois_num [B]). EAGER host op."""
+    rois_np = [np.asarray(r, np.float32).reshape(-1, 4) for r in multi_rois]
+    scores_np = [np.asarray(s, np.float32).reshape(-1) for s in multi_scores]
+    nums_np = [np.asarray(n, np.int64).reshape(-1) for n in rois_num_per_level]
+    B = len(nums_np[0])
+    batch_ids = []
+    for nums in nums_np:
+        batch_ids.append(np.repeat(np.arange(B), nums))
+    rois = np.concatenate(rois_np, 0)
+    scores = np.concatenate(scores_np, 0)
+    bids = np.concatenate(batch_ids, 0)
+    keep = np.argsort(-scores, kind="stable")[:int(post_nms_topn)]
+    keep = keep[np.argsort(bids[keep], kind="stable")]
+    out_rois = rois[keep]
+    out_nums = np.bincount(bids[keep], minlength=B).astype(np.int32)
+    return jnp.asarray(out_rois), jnp.asarray(out_nums)

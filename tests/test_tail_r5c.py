@@ -1,0 +1,288 @@
+"""Dedicated semantics tests for op tail 9 (tail_r5c.py) — the ops whose
+signatures don't fit the generic generated harness, plus reference-formula
+cross-checks for the structured ones."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.dispatch import OPS
+
+
+def K(name):
+    return OPS[name]._kernel
+
+
+# ---------------------------------------------------------------------------
+# optimizer updates — formula cross-checks vs straight numpy transcription
+# ---------------------------------------------------------------------------
+
+def test_decayed_adagrad_formula():
+    rs = np.random.RandomState(0)
+    p, g, m = rs.randn(3, 4), rs.randn(3, 4), np.abs(rs.randn(3, 4))
+    lr = np.float32(0.05)
+    p2, m2 = K("decayed_adagrad")(p.astype(np.float32), g.astype(np.float32),
+                                  m.astype(np.float32), lr, decay=0.9,
+                                  epsilon=1e-6)
+    m_ref = 0.9 * m + 0.1 * g * g
+    p_ref = p - 0.05 * g / (np.sqrt(m_ref) + 1e-6)
+    np.testing.assert_allclose(np.asarray(m2), m_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p2), p_ref, rtol=1e-5)
+
+
+def test_ftrl_lr_power_half_shrinks_small_weights():
+    """|linear accumulator| <= l1 ⇒ param goes to exactly 0 (the FTRL
+    sparsity property, ftrl_kernel_impl.h:171-187)."""
+    p = np.full((4,), 0.1, np.float32)
+    sq = np.full((4,), 1.0, np.float32)
+    lin = np.zeros((4,), np.float32)
+    g = np.array([1e-4, -1e-4, 2.0, -2.0], np.float32)
+    lr = np.float32(0.1)
+    p2, sq2, lin2 = K("ftrl")(p, sq, lin, g, lr, l1=0.5, l2=0.0)
+    p2 = np.asarray(p2)
+    assert p2[0] == 0.0 and p2[1] == 0.0          # tiny grads -> zeroed
+    assert p2[2] != 0.0 and p2[3] != 0.0          # big grads -> live
+    np.testing.assert_allclose(np.asarray(sq2), sq + g * g, rtol=1e-6)
+
+
+def test_dpsgd_clips_and_is_deterministic():
+    p = np.zeros((6,), np.float32)
+    g = np.full((6,), 10.0, np.float32)     # l2 = 24.49 > clip
+    lr = np.float32(1.0)
+    out1 = np.asarray(K("dpsgd")(p, g, lr, clip=1.0, sigma=0.0, seed=7))
+    out2 = np.asarray(K("dpsgd")(p, g, lr, clip=1.0, sigma=0.0, seed=7))
+    np.testing.assert_array_equal(out1, out2)
+    # with sigma=0 the update is exactly -lr * g/scale, ||g/scale|| == clip
+    np.testing.assert_allclose(np.linalg.norm(out1), 1.0, rtol=1e-5)
+
+
+def test_rprop_sign_logic():
+    p = np.zeros((3,), np.float32)
+    g = np.array([1.0, 1.0, 1.0], np.float32)
+    prev = np.array([1.0, -1.0, 0.0], np.float32)   # agree / disagree / zero
+    lr = np.full((3,), 0.01, np.float32)
+    rng = np.array([0.001, 1.0], np.float32)
+    etas = np.array([0.5, 1.2], np.float32)
+    p2, prev2, lr2 = K("rprop_")(p, g, prev, lr, rng, etas)
+    lr2, prev2 = np.asarray(lr2), np.asarray(prev2)
+    np.testing.assert_allclose(lr2, [0.012, 0.005, 0.01], rtol=1e-5)
+    # disagreeing element applies zero grad and stores zero as prev
+    assert prev2[1] == 0.0 and np.asarray(p2)[1] == 0.0
+    np.testing.assert_allclose(np.asarray(p2)[0], -0.012, rtol=1e-5)
+
+
+def test_sparse_momentum_touches_only_indexed_rows():
+    p = np.ones((5, 3), np.float32)
+    v = np.zeros((5, 3), np.float32)
+    g = np.full((2, 3), 2.0, np.float32)
+    idx = np.array([1, 4], np.int64)
+    lr = np.float32(0.1)
+    p2, v2 = K("sparse_momentum")(p, g, v, idx, lr, mu=0.9)
+    p2, v2 = np.asarray(p2), np.asarray(v2)
+    np.testing.assert_array_equal(p2[[0, 2, 3]], p[[0, 2, 3]])
+    np.testing.assert_array_equal(v2[[0, 2, 3]], v[[0, 2, 3]])
+    np.testing.assert_allclose(v2[[1, 4]], np.full((2, 3), 2.0), rtol=1e-6)
+    np.testing.assert_allclose(p2[[1, 4]], 1.0 - 0.1 * 2.0, rtol=1e-6)
+
+
+def test_average_accumulates_flush():
+    """Hitting the window triggers the sum_3 flush + counter reset
+    (average_accumulates_kernel_impl.h:125-136)."""
+    p = np.full((3,), 2.0, np.float32)
+    zeros = np.zeros((3,), np.float32)
+    s1, s2, s3, na, ona, nu = K("average_accumulates_")(
+        p, zeros, zeros, zeros,
+        np.array(0, np.int64), np.array(0, np.int64), np.array(0, np.int64),
+        average_window=1.0, max_average_window=100, min_average_window=1)
+    # first step: num_acc=1 >= min(1) and >= 1*1.0 -> flush
+    np.testing.assert_allclose(np.asarray(s3), p)
+    np.testing.assert_array_equal(np.asarray(s1), zeros)
+    assert int(na) == 0 and int(ona) == 1 and int(nu) == 1
+    # no flush when min_average_window is large
+    s1b, _, s3b, nab, _, nub = K("average_accumulates_")(
+        p, zeros, zeros, zeros,
+        np.array(0, np.int64), np.array(0, np.int64), np.array(0, np.int64),
+        average_window=1.0, max_average_window=100, min_average_window=10)
+    np.testing.assert_allclose(np.asarray(s1b), p)
+    np.testing.assert_array_equal(np.asarray(s3b), zeros)
+    assert int(nab) == 1 and int(nub) == 1
+
+
+# ---------------------------------------------------------------------------
+# plumbing ops
+# ---------------------------------------------------------------------------
+
+def test_merge_selected_rows_sums_duplicates():
+    ids = np.array([3, 1, 3, 1, 2], np.int64)
+    vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+    uids, merged = K("merge_selected_rows")(ids, vals)
+    np.testing.assert_array_equal(np.asarray(uids), [1, 2, 3])
+    np.testing.assert_allclose(np.asarray(merged),
+                               [[2 + 6, 3 + 7], [8, 9], [0 + 4, 1 + 5]])
+
+
+def test_gru_unit_matches_manual_formula():
+    rs = np.random.RandomState(1)
+    B, D = 2, 3
+    x = rs.randn(B, 3 * D).astype(np.float32)
+    hp = rs.randn(B, D).astype(np.float32)
+    w = rs.randn(D, 3 * D).astype(np.float32)
+    b = rs.randn(3 * D).astype(np.float32)
+    gate, rhp, h = K("gru_unit")(x, hp, w, b, activation=2,
+                                 gate_activation=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    g0 = x + b
+    g0[:, :2 * D] += hp @ w[:, :2 * D]
+    u = sig(g0[:, :D]); r = sig(g0[:, D:2 * D])
+    rh = r * hp
+    c = np.tanh(g0[:, 2 * D:] + rh @ w[:, 2 * D:].reshape(D, D))
+    h_ref = u * (c - hp) + hp
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rhp), rh, rtol=1e-5, atol=1e-6)
+    # origin_mode flips the convex combination
+    _, _, h_o = K("gru_unit")(x, hp, w, b, origin_mode=True)
+    np.testing.assert_allclose(np.asarray(h_o), c + u * (hp - c), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_quant_linear_approximates_float_fc():
+    """With a fine scale the QDQ roundtrip tracks the float matmul
+    (quant_dequant.h:70-85 quantize, :361-391 dequantize scales)."""
+    rs = np.random.RandomState(2)
+    x = rs.uniform(-1, 1, (4, 6)).astype(np.float32)
+    w_int = np.round(rs.uniform(-100, 100, (6, 3))).astype(np.float32)
+    sw = (0.8, 0.9, 1.0)
+    si = 1.0   # x in [-1,1] -> scale 1: quant x_q = round(127*x)
+    out = np.asarray(K("quant_linear")(x, w_int, None, scale_in=si,
+                                       scale_weights=sw))
+    w_float = w_int / (127.0 * np.asarray(sw))
+    ref = x @ w_float
+    np.testing.assert_allclose(out, ref, atol=0.05)
+    # relu + bias path
+    b = rs.randn(3).astype(np.float32)
+    out2 = np.asarray(K("quant_linear")(x, w_int, b, scale_in=si,
+                                        scale_weights=sw,
+                                        activation_type="relu"))
+    assert (out2 >= 0).all()
+
+
+def test_rank_attention_masks_absent_ranks():
+    rs = np.random.RandomState(3)
+    N, d, Kr, p = 3, 4, 2, 5
+    x = rs.randn(N, d).astype(np.float32)
+    par = rs.randn(Kr * Kr * d, p).astype(np.float32)
+    ro = np.array([[1, 1, 0, 2, 1],      # lower=0, slots (0,0) and (1,1)
+                   [0, 0, 0, 0, 0],      # no rank at all -> zero row
+                   [2, 1, 2, 0, 0]],     # lower=1, slot 0 only
+                  np.int32)
+    ih, out, ir = K("rank_attention")(x, ro, par, max_rank=Kr)
+    ih, out = np.asarray(ih), np.asarray(out)
+    assert (out[1] == 0).all() and (ih[1] == 0).all()
+    blocks = par.reshape(Kr * Kr, d, p)
+    # row 0: lower=0; slot 0 (faster=0, idx 0) + slot 1 (faster=1, idx 1)
+    ref0 = x[0] @ blocks[0 * Kr + 0] + x[1] @ blocks[0 * Kr + 1]
+    np.testing.assert_allclose(out[0], ref0, rtol=1e-5, atol=1e-5)
+    ref2 = x[2] @ blocks[1 * Kr + 0]
+    np.testing.assert_allclose(out[2], ref2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(ir).ravel(), [1, 0, 2])
+
+
+# ---------------------------------------------------------------------------
+# tree / recsys / matching / detection
+# ---------------------------------------------------------------------------
+
+TREE = np.array([[0, 0, 0, 0, 0],      # node 0: padding
+                 [1, 1, 0, 3, 4],      # node 1 -> children 3,4
+                 [0, 1, 0, 5, 6],      # node 2 -> children 5,6 (2 not item)
+                 [2, 2, 1, 0, 0],      # leaf, item 2
+                 [3, 2, 1, 0, 0],      # leaf, item 3
+                 [4, 2, 2, 0, 0],      # leaf, item 4
+                 [0, 2, 2, 0, 0]],     # leaf, NOT an item (item_id 0)
+                np.int32)
+
+
+def test_tdm_child_lookup_and_mask():
+    ch, mk = K("tdm_child")(np.array([[1, 2], [3, 0]], np.int32), TREE, 2)
+    ch, mk = np.asarray(ch), np.asarray(mk)
+    assert ch.shape == (2, 2, 2)
+    np.testing.assert_array_equal(ch[0, 0], [3, 4])
+    np.testing.assert_array_equal(mk[0, 0], [1, 1])
+    np.testing.assert_array_equal(ch[0, 1], [5, 6])
+    np.testing.assert_array_equal(mk[0, 1], [1, 0])   # node 6 is not an item
+    np.testing.assert_array_equal(ch[1], np.zeros((2, 2)))  # leaf + padding
+    np.testing.assert_array_equal(mk[1], np.zeros((2, 2)))
+
+
+def test_tdm_sampler_layout_and_exclusion():
+    travel = np.array([[0, 0], [1, 3], [2, 5]], np.int32)
+    layer = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    out, lab, mask = K("tdm_sampler")(np.array([1, 2], np.int32), travel,
+                                      layer, neg_samples_num_list=(1, 2),
+                                      layer_offset_lod=(0, 2, 6), seed=3)
+    out, lab, mask = np.asarray(out), np.asarray(lab), np.asarray(mask)
+    assert out.shape == (2, 5)          # (1 pos + 1 neg) + (1 pos + 2 neg)
+    np.testing.assert_array_equal(out[:, 0], [1, 2])       # layer-0 positive
+    np.testing.assert_array_equal(out[:, 2], [3, 5])       # layer-1 positive
+    np.testing.assert_array_equal(lab[0], [1, 0, 1, 0, 0])
+    assert out[0, 1] in (2,) and out[1, 1] in (1,)         # neg != positive
+    for row, pos1 in [(0, 3), (1, 5)]:
+        negs = out[row, 3:]
+        assert pos1 not in negs
+        assert set(negs) <= {3, 4, 5, 6} - {pos1}
+    assert mask.all()
+
+
+def test_tdm_sampler_padding_path():
+    travel = np.array([[0, 0], [1, 0]], np.int32)   # id 1: layer-1 padded
+    layer = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    out, lab, mask = K("tdm_sampler")(np.array([1], np.int32), travel, layer,
+                                      neg_samples_num_list=(1, 1),
+                                      layer_offset_lod=(0, 2, 6), seed=0)
+    out, mask = np.asarray(out), np.asarray(mask)
+    np.testing.assert_array_equal(out[0, 2:], [0, 0])
+    np.testing.assert_array_equal(mask[0, 2:], [0, 0])
+
+
+def test_match_matrix_tensor_vs_naive():
+    rs = np.random.RandomState(4)
+    d, dy, T = 3, 4, 2
+    x = rs.randn(5, d).astype(np.float32)       # segments [0:2], [2:5]
+    y = rs.randn(4, dy).astype(np.float32)      # segments [0:1], [1:4]
+    w = rs.randn(d, T, dy).astype(np.float32)
+    out, tmp = K("match_matrix_tensor")(x, y, w, [0, 2, 5], [0, 1, 4],
+                                        dim_t=T)
+    out = np.asarray(out).ravel()
+    ref = []
+    for (xs, xe), (ys, ye) in [((0, 2), (0, 1)), ((2, 5), (1, 4))]:
+        for t in range(T):
+            ref.append((x[xs:xe] @ w[:, t, :] @ y[ys:ye].T).ravel())
+    ref = np.concatenate(ref)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    assert np.asarray(tmp).shape == (5 * T * dy, 1)
+
+
+def test_collect_fpn_proposals_topn_and_regroup():
+    # two levels, batch of 2
+    rois_l0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    rois_l1 = 100 + np.arange(8, dtype=np.float32).reshape(2, 4)
+    scores_l0 = np.array([0.9, 0.2, 0.8], np.float32)
+    scores_l1 = np.array([0.95, 0.1], np.float32)
+    nums_l0 = np.array([2, 1], np.int64)   # rows 0,1 -> img0; row 2 -> img1
+    nums_l1 = np.array([1, 1], np.int64)
+    rois, nums = K("collect_fpn_proposals")(
+        [rois_l0, rois_l1], [scores_l0, scores_l1], [nums_l0, nums_l1],
+        post_nms_topn=3)
+    rois, nums = np.asarray(rois), np.asarray(nums)
+    # top-3 scores: 0.95 (l1 img0), 0.9 (l0 img0), 0.8 (l0 img1); within a
+    # batch the rows keep score-descending order
+    np.testing.assert_array_equal(nums, [2, 1])
+    np.testing.assert_allclose(rois[0], rois_l1[0])        # img0, score 0.95
+    np.testing.assert_allclose(rois[1], rois_l0[0])        # img0, score 0.9
+    np.testing.assert_allclose(rois[2], rois_l0[2])        # img1, score 0.8
+
+
+def test_flatten2_xshape_contract():
+    out, xshape = K("flatten2")(np.zeros((2, 3, 4), np.float32), axis=2)
+    assert np.asarray(out).shape == (6, 4)
+    assert np.asarray(xshape).shape == (0, 2, 3, 4)
