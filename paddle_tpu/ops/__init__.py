@@ -40,6 +40,7 @@ from .kernels import (  # noqa: F401
     tail_r5,
     tail_r5b,
     tail_r5c,
+    tail_r5d,
     tail_seq,
     vision_ops,
     yolo_loss,

@@ -26,6 +26,10 @@ def accuracy(x, indices, label, k=1):
     return _OPS['accuracy'](x, indices, label, k=k)
 
 
+def accuracy_check(x, y, fn_name='', rtol=1e-05, atol=1e-08, equal_nan=False):
+    return _OPS['accuracy_check'](x, y, fn_name=fn_name, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
 def acos(x):
     return _OPS['acos'](x)
 
@@ -206,6 +210,10 @@ def atanh(x):
     return _OPS['atanh'](x)
 
 
+def attention_lstm(x, c0, h0, attention_weight, attention_bias, attention_scalar, attention_scalar_bias, lstm_weight, lstm_bias, lod, gate_activation='sigmoid', cell_activation='tanh', candidate_activation='tanh'):
+    return _OPS['attention_lstm'](x, c0, h0, attention_weight, attention_bias, attention_scalar, attention_scalar_bias, lstm_weight, lstm_bias, lod, gate_activation=gate_activation, cell_activation=cell_activation, candidate_activation=candidate_activation)
+
+
 def auc(predict, label, stat_pos=None, stat_neg=None, num_thresholds=4095, curve='ROC', slide_steps=1, ins_tag_weight=None):
     return _OPS['auc'](predict, label, stat_pos=stat_pos, stat_neg=stat_neg, num_thresholds=num_thresholds, curve=curve, slide_steps=slide_steps, ins_tag_weight=ins_tag_weight)
 
@@ -314,6 +322,10 @@ def bitwise_xor(x, y):
     return _OPS['bitwise_xor'](x, y)
 
 
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    return _OPS['blha_get_max_len'](seq_lens_encoder, seq_lens_decoder, batch_size)
+
+
 def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=None, cum_offsets=None, cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None, pre_key_cache=None, pre_value_cache=None, rope_emb=None, mask=None, tgt_mask=None, cache_k_quant_scales=None, cache_v_quant_scales=None, cache_k_dequant_scales=None, cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None, max_enc_len_this_time=None, max_dec_len_this_time=None, max_seq_len=-1, block_size=64, use_neox_style=False, dynamic_cachekv_quant=False, quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1.0, compute_dtype='default', rope_theta=10000.0):
     return _OPS['block_multihead_attention_'](qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=padding_offsets, cum_offsets=cum_offsets, cu_seqlens_q=cu_seqlens_q, cu_seqlens_k=cu_seqlens_k, block_tables=block_tables, pre_key_cache=pre_key_cache, pre_value_cache=pre_value_cache, rope_emb=rope_emb, mask=mask, tgt_mask=tgt_mask, cache_k_quant_scales=cache_k_quant_scales, cache_v_quant_scales=cache_v_quant_scales, cache_k_dequant_scales=cache_k_dequant_scales, cache_v_dequant_scales=cache_v_dequant_scales, qkv_out_scale=qkv_out_scale, qkv_bias=qkv_bias, out_shift=out_shift, out_smooth=out_smooth, max_enc_len_this_time=max_enc_len_this_time, max_dec_len_this_time=max_dec_len_this_time, max_seq_len=max_seq_len, block_size=block_size, use_neox_style=use_neox_style, dynamic_cachekv_quant=dynamic_cachekv_quant, quant_round_type=quant_round_type, quant_max_bound=quant_max_bound, quant_min_bound=quant_min_bound, out_scale=out_scale, compute_dtype=compute_dtype, rope_theta=rope_theta)
 
@@ -394,6 +406,10 @@ def c_split(x, rank=0, nranks=1, ring_id=0, use_calc_stream=False, use_model_par
     return _OPS['c_split'](x, rank=rank, nranks=nranks, ring_id=ring_id, use_calc_stream=use_calc_stream, use_model_parallel=use_model_parallel)
 
 
+def calc_reduced_attn_scores(q, k, softmax_lse):
+    return _OPS['calc_reduced_attn_scores'](q, k, softmax_lse)
+
+
 def cast(x, dtype):
     return _OPS['cast'](x, dtype)
 
@@ -458,6 +474,10 @@ def collect_fpn_proposals(multi_rois, multi_scores, rois_num_per_level, post_nms
     return _OPS['collect_fpn_proposals'](multi_rois, multi_scores, rois_num_per_level, post_nms_topn=post_nms_topn)
 
 
+def comm_init_all(devices=(), ring_id=0):
+    return _OPS['comm_init_all'](devices=devices, ring_id=ring_id)
+
+
 def complex(real, imag):
     return _OPS['complex'](real, imag)
 
@@ -486,8 +506,16 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return _OPS['conv2d_transpose'](x, weight, bias=bias, stride=stride, padding=padding, output_padding=output_padding, dilation=dilation, groups=groups, data_format=data_format)
 
 
+def conv2d_transpose_bias(x, filter, bias, strides=(1, 1), paddings=(0, 0), output_padding=(), output_size=(), padding_algorithm='EXPLICIT', groups=1, dilations=(1, 1), data_format='NCHW'):
+    return _OPS['conv2d_transpose_bias'](x, filter, bias, strides=strides, paddings=paddings, output_padding=output_padding, output_size=output_size, padding_algorithm=padding_algorithm, groups=groups, dilations=dilations, data_format=data_format)
+
+
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format='NCDHW'):
     return _OPS['conv3d'](x, weight, bias=bias, stride=stride, padding=padding, dilation=dilation, groups=groups, data_format=data_format)
+
+
+def conv3d_implicit_gemm(x, filter, strides=(1, 1, 1), paddings=(0, 0, 0), padding_algorithm='EXPLICIT', groups=1, dilations=(1, 1, 1), data_format='NCDHW'):
+    return _OPS['conv3d_implicit_gemm'](x, filter, strides=strides, paddings=paddings, padding_algorithm=padding_algorithm, groups=groups, dilations=dilations, data_format=data_format)
 
 
 def conv3d_transpose(x, filter, bias=None, strides=1, paddings=0, output_padding=0, output_size=None, padding_algorithm='EXPLICIT', groups=1, dilations=1, data_format='NCDHW'):
@@ -558,6 +586,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, norm_by_t
     return _OPS['ctc_loss'](log_probs, labels, input_lengths, label_lengths, blank=blank, norm_by_times=norm_by_times)
 
 
+def cudnn_lstm(x, init_h, init_c, w=None, weight_list=None, sequence_length=None, dropout_prob=0.0, is_bidirec=False, hidden_size=100, num_layers=1, is_test=False, seed=0):
+    return _OPS['cudnn_lstm'](x, init_h, init_c, w=w, weight_list=weight_list, sequence_length=sequence_length, dropout_prob=dropout_prob, is_bidirec=is_bidirec, hidden_size=hidden_size, num_layers=num_layers, is_test=is_test, seed=seed)
+
+
 def cummax(x, axis=None):
     return _OPS['cummax'](x, axis=axis)
 
@@ -576,6 +608,10 @@ def cumsum(x, axis=None):
 
 def cvm(x, cvm_input, use_cvm=True):
     return _OPS['cvm'](x, cvm_input, use_cvm=use_cvm)
+
+
+def data(name='', shape=(), dtype='float32', place=None):
+    return _OPS['data'](name=name, shape=shape, dtype=dtype, place=place)
 
 
 def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95, epsilon=1e-06):
@@ -626,6 +662,18 @@ def detection_map(detect_res, label, num_classes, background_label=0, overlap_th
     return _OPS['detection_map'](detect_res, label, num_classes, background_label=background_label, overlap_threshold=overlap_threshold, evaluate_difficult=evaluate_difficult, ap_type=ap_type)
 
 
+def dgc(u, v, grad, param, current_step, nranks, m=0.9, use_nesterov=True, sparsity=(), rampup_begin_step=0.0, rampup_step=0.0, regular_coeff=0.0, regular_type=0):
+    return _OPS['dgc'](u, v, grad, param, current_step, nranks, m=m, use_nesterov=use_nesterov, sparsity=sparsity, rampup_begin_step=rampup_begin_step, rampup_step=rampup_step, regular_coeff=regular_coeff, regular_type=regular_type)
+
+
+def dgc_clip_by_norm(x, current_step, max_norm=1.0, rampup_begin_step=-1.0):
+    return _OPS['dgc_clip_by_norm'](x, current_step, max_norm=max_norm, rampup_begin_step=rampup_begin_step)
+
+
+def dgc_momentum(param, grad, velocity, learning_rate, master_param, current_step_tensor, nranks_tensor, mu=0.9, use_nesterov=False, regularization_method='', regularization_coeff=0.0, multi_precision=False, rescale_grad=1.0, rampup_begin_step=-1.0):
+    return _OPS['dgc_momentum'](param, grad, velocity, learning_rate, master_param, current_step_tensor, nranks_tensor, mu=mu, use_nesterov=use_nesterov, regularization_method=regularization_method, regularization_coeff=regularization_coeff, multi_precision=multi_precision, rescale_grad=rescale_grad, rampup_begin_step=rampup_begin_step)
+
+
 def diag(x, offset=0, padding_value=0):
     return _OPS['diag'](x, offset=offset, padding_value=padding_value)
 
@@ -650,12 +698,24 @@ def dirichlet(alpha, seed=0):
     return _OPS['dirichlet'](alpha, seed=seed)
 
 
+def disable_check_model_nan_inf(x, flag=0):
+    return _OPS['disable_check_model_nan_inf'](x, flag=flag)
+
+
 def dist(x, y, p=2.0):
     return _OPS['dist'](x, y, p=p)
 
 
+def dist_concat(x, ring_id=0, nranks=1):
+    return _OPS['dist_concat'](x, ring_id=ring_id, nranks=nranks)
+
+
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale, rois_num=None, pixel_offset=False):
     return _OPS['distribute_fpn_proposals'](fpn_rois, min_level, max_level, refer_level, refer_scale, rois_num=rois_num, pixel_offset=pixel_offset)
+
+
+def distributed_fused_lamb_init(param, grad, beta1=0.9, beta2=0.999, apply_weight_decay=(), alignment=128, rank=0, nranks=1):
+    return _OPS['distributed_fused_lamb_init'](param, grad, beta1=beta1, beta2=beta2, apply_weight_decay=apply_weight_decay, alignment=alignment, rank=rank, nranks=nranks)
 
 
 def divide(x, y):
@@ -746,6 +806,10 @@ def empty_like(x, dtype=None):
     return _OPS['empty_like'](x, dtype=dtype)
 
 
+def enable_check_model_nan_inf(x, flag=1):
+    return _OPS['enable_check_model_nan_inf'](x, flag=flag)
+
+
 def equal(x, y):
     return _OPS['equal'](x, y)
 
@@ -830,6 +894,10 @@ def fc(input, w, bias=None, in_num_col_dims=1, activation_type='', padding_weigh
     return _OPS['fc'](input, w, bias=bias, in_num_col_dims=in_num_col_dims, activation_type=activation_type, padding_weights=padding_weights)
 
 
+def fetch_barrier(x, trainer_id=0, endpoints=('127.0.0.1:6164',)):
+    return _OPS['fetch_barrier'](x, trainer_id=trainer_id, endpoints=endpoints)
+
+
 def fft_c2c(x, axes=(-1,), normalization='backward', forward=True):
     return _OPS['fft_c2c'](x, axes=axes, normalization=normalization, forward=forward)
 
@@ -906,6 +974,10 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     return _OPS['fold'](x, output_sizes, kernel_sizes, strides=strides, paddings=paddings, dilations=dilations)
 
 
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False, transpose_y=False, scale=1.0, output_dtype='float16', activation_type='identity'):
+    return _OPS['fp8_fp8_half_gemm_fused'](x, y, bias=bias, transpose_x=transpose_x, transpose_y=transpose_y, scale=scale, output_dtype=output_dtype, activation_type=activation_type)
+
+
 def frac(x):
     return _OPS['frac'](x)
 
@@ -962,6 +1034,10 @@ def fused_attention(x, qkv_weight, linear_weight, qkv_bias=None, linear_bias=Non
     return _OPS['fused_attention'](x, qkv_weight, linear_weight, qkv_bias=qkv_bias, linear_bias=linear_bias, pre_ln_scale=pre_ln_scale, pre_ln_bias=pre_ln_bias, ln_scale=ln_scale, ln_bias=ln_bias, num_heads=num_heads, pre_layer_norm=pre_layer_norm, epsilon=epsilon, attn_dropout_rate=attn_dropout_rate, dropout_rate=dropout_rate, attn_mask=attn_mask, training=training)
 
 
+def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9, epsilon=1e-05, act_type='relu'):
+    return _OPS['fused_batch_norm_act'](x, scale, bias, mean, variance, momentum=momentum, epsilon=epsilon, act_type=act_type)
+
+
 def fused_bias_act(x, bias=None, act_method='gelu'):
     return _OPS['fused_bias_act'](x, bias=bias, act_method=act_method)
 
@@ -974,8 +1050,16 @@ def fused_bias_residual_layernorm(x, bias=None, residual=None, norm_weight=None,
     return _OPS['fused_bias_residual_layernorm'](x, bias=bias, residual=residual, norm_weight=norm_weight, norm_bias=norm_bias, epsilon=epsilon, residual_alpha=residual_alpha, begin_norm_axis=begin_norm_axis, quant_scale=quant_scale)
 
 
+def fused_bn_add_activation(x, z, scale, bias, mean, variance, momentum=0.9, epsilon=1e-05, act_type='relu'):
+    return _OPS['fused_bn_add_activation'](x, z, scale, bias, mean, variance, momentum=momentum, epsilon=epsilon, act_type=act_type)
+
+
 def fused_conv2d_add_act(input, filter, bias=None, residual_data=None, strides=(1, 1), paddings=(0, 0), dilations=(1, 1), groups=1, activation='relu', padding_algorithm='EXPLICIT', split_channels=()):
     return _OPS['fused_conv2d_add_act'](input, filter, bias=bias, residual_data=residual_data, strides=strides, paddings=paddings, dilations=dilations, groups=groups, activation=activation, padding_algorithm=padding_algorithm, split_channels=split_channels)
+
+
+def fused_dconv_drelu_dbn(grad_output, weight, grad_output_add, residual_input, bn1_eqscale, bn1_eqbias, conv_input, bn1_mean, bn1_inv_std, bn1_gamma, bn1_beta, bn1_input, bn2_mean=None, bn2_inv_std=None, bn2_gamma=None, bn2_beta=None, bn2_input=None, paddings=(0, 0), dilations=(1, 1), strides=(1, 1), padding_algorithm='EXPLICIT', groups=1, data_format='NHWC', fuse_shortcut=False, fuse_dual=False, fuse_add=False, exhaustive_search=False):
+    return _OPS['fused_dconv_drelu_dbn'](grad_output, weight, grad_output_add, residual_input, bn1_eqscale, bn1_eqbias, conv_input, bn1_mean, bn1_inv_std, bn1_gamma, bn1_beta, bn1_input, bn2_mean=bn2_mean, bn2_inv_std=bn2_inv_std, bn2_gamma=bn2_gamma, bn2_beta=bn2_beta, bn2_input=bn2_input, paddings=paddings, dilations=dilations, strides=strides, padding_algorithm=padding_algorithm, groups=groups, data_format=data_format, fuse_shortcut=fuse_shortcut, fuse_dual=fuse_dual, fuse_add=fuse_add, exhaustive_search=exhaustive_search)
 
 
 def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None, dropout_probability=0.0, is_training=False, is_causal_masking=False):
@@ -1002,12 +1086,20 @@ def fused_elementwise_sub(x, y, axis=-1, fuse_alpha=None, fused_unary_fn='identi
     return _OPS['fused_elementwise_sub'](x, y, axis=axis, fuse_alpha=fuse_alpha, fused_unary_fn=fused_unary_fn)
 
 
+def fused_elemwise_activation(x, y, functor_list=('elementwise_add', 'relu'), axis=-1, scale=0.0, save_intermediate_out=False):
+    return _OPS['fused_elemwise_activation'](x, y, functor_list=functor_list, axis=axis, scale=scale, save_intermediate_out=save_intermediate_out)
+
+
 def fused_elemwise_add_activation(x, y, functor_list=('elementwise_add', 'relu'), axis=-1, scale=1.0, save_intermediate_out=False):
     return _OPS['fused_elemwise_add_activation'](x, y, functor_list=functor_list, axis=axis, scale=scale, save_intermediate_out=save_intermediate_out)
 
 
 def fused_embedding_eltwise_layernorm(ids, embs, bias=None, scale=None, epsilon=1e-05):
     return _OPS['fused_embedding_eltwise_layernorm'](ids, embs, bias=bias, scale=scale, epsilon=epsilon)
+
+
+def fused_embedding_fc_lstm(ids, embeddings, weight_h, bias, h0, c0, lod, use_peepholes=False, is_reverse=False, gate_activation='sigmoid', cell_activation='tanh', candidate_activation='tanh'):
+    return _OPS['fused_embedding_fc_lstm'](ids, embeddings, weight_h, bias, h0, c0, lod, use_peepholes=use_peepholes, is_reverse=is_reverse, gate_activation=gate_activation, cell_activation=cell_activation, candidate_activation=candidate_activation)
 
 
 def fused_fc_elementwise_layernorm(x, w, y, bias0=None, scale=None, bias1=None, epsilon=1e-05, begin_norm_axis=-1, activation_type=''):
@@ -1046,6 +1138,14 @@ def fused_scale_bias_add_relu(x1, scale1, bias1, x2, scale2=None, bias2=None, fu
     return _OPS['fused_scale_bias_add_relu'](x1, scale1, bias1, x2, scale2=scale2, bias2=bias2, fuse_dual=fuse_dual, exhaustive_search=exhaustive_search)
 
 
+def fused_scale_bias_relu_conv_bn(x, w, scale, bias, bn_scale, bn_bias, input_running_mean, input_running_var, paddings=(0, 0), dilations=(1, 1), strides=(1, 1), padding_algorithm='EXPLICIT', groups=1, data_format='NHWC', momentum=0.9, epsilon=1e-05, fuse_prologue=True, exhaustive_search=False, accumulation_count=0):
+    return _OPS['fused_scale_bias_relu_conv_bn'](x, w, scale, bias, bn_scale, bn_bias, input_running_mean, input_running_var, paddings=paddings, dilations=dilations, strides=strides, padding_algorithm=padding_algorithm, groups=groups, data_format=data_format, momentum=momentum, epsilon=epsilon, fuse_prologue=fuse_prologue, exhaustive_search=exhaustive_search, accumulation_count=accumulation_count)
+
+
+def fused_seqpool_cvm(x, cvm, lod, pooltype='SUM', pad_value=0.0, use_cvm=True, cvm_offset=2):
+    return _OPS['fused_seqpool_cvm'](x, cvm, lod, pooltype=pooltype, pad_value=pad_value, use_cvm=use_cvm, cvm_offset=cvm_offset)
+
+
 def fused_softmax_mask(x, mask):
     return _OPS['fused_softmax_mask'](x, mask)
 
@@ -1068,6 +1168,22 @@ def fusion_lstm(x, weight_x, weight_h, h0=None, c0=None, bias=None, activation='
 
 def fusion_repeated_fc_relu(x, w, bias):
     return _OPS['fusion_repeated_fc_relu'](x, w, bias)
+
+
+def fusion_seqconv_eltadd_relu(x, filter, bias, lod, context_length=3, context_start=0, context_stride=1):
+    return _OPS['fusion_seqconv_eltadd_relu'](x, filter, bias, lod, context_length=context_length, context_start=context_start, context_stride=context_stride)
+
+
+def fusion_seqexpand_concat_fc(x, fc_weight, fc_bias, lod, fc_activation='identity'):
+    return _OPS['fusion_seqexpand_concat_fc'](x, fc_weight, fc_bias, lod, fc_activation=fc_activation)
+
+
+def fusion_seqpool_concat(x, lod, pooltype='SUM', axis=1):
+    return _OPS['fusion_seqpool_concat'](x, lod, pooltype=pooltype, axis=axis)
+
+
+def fusion_seqpool_cvm_concat(x, cvm, lod, pooltype='SUM', use_cvm=True, axis=1):
+    return _OPS['fusion_seqpool_cvm_concat'](x, cvm, lod, pooltype=pooltype, use_cvm=use_cvm, axis=axis)
 
 
 def fusion_squared_mat_sub(x, y, scalar=1.0):
@@ -1282,6 +1398,10 @@ def index_select_strided(x, index, axis=0):
     return _OPS['index_select_strided'](x, index, axis=axis)
 
 
+def indices(x):
+    return _OPS['indices'](x)
+
+
 def inner(x, y):
     return _OPS['inner'](x, y)
 
@@ -1370,12 +1490,24 @@ def leaky_relu(x, negative_slope=0.01):
     return _OPS['leaky_relu'](x, negative_slope=negative_slope)
 
 
+def legacy_bilinear_interp(x, out_h=0, out_w=0, align_corners=True, align_mode=1, data_format='NCHW'):
+    return _OPS['legacy_bilinear_interp'](x, out_h=out_h, out_w=out_w, align_corners=align_corners, align_mode=align_mode, data_format=data_format)
+
+
 def legacy_crop(x, shape, offsets=None):
     return _OPS['legacy_crop'](x, shape, offsets=offsets)
 
 
 def legacy_expand(x, expand_times):
     return _OPS['legacy_expand'](x, expand_times)
+
+
+def legacy_generate_proposals(scores, bbox_deltas, im_info, anchors, variances, pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1, eta=1.0):
+    return _OPS['legacy_generate_proposals'](scores, bbox_deltas, im_info, anchors, variances, pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n, nms_thresh=nms_thresh, min_size=min_size, eta=eta)
+
+
+def legacy_nearest_interp(x, out_h=0, out_w=0, align_corners=True, data_format='NCHW'):
+    return _OPS['legacy_nearest_interp'](x, out_h=out_h, out_w=out_w, align_corners=align_corners, data_format=data_format)
 
 
 def lerp(x, y, weight):
@@ -1838,6 +1970,10 @@ def pad3d(x, paddings, mode='constant', value=0.0, data_format='NCDHW'):
     return _OPS['pad3d'](x, paddings, mode=mode, value=value, data_format=data_format)
 
 
+def partial_allgather(x, nranks=1, rank=0, ring_id=0):
+    return _OPS['partial_allgather'](x, nranks=nranks, rank=rank, ring_id=ring_id)
+
+
 def partial_concat(inputs, start_index=0, length=-1):
     return _OPS['partial_concat'](inputs, start_index=start_index, length=length)
 
@@ -1900,6 +2036,14 @@ def psroi_pool(x, boxes, boxes_num=None, output_channels=1, spatial_scale=1.0, p
 
 def put_along_axis(x, indices, values, axis, reduce='assign'):
     return _OPS['put_along_axis'](x, indices, values, axis, reduce=reduce)
+
+
+def pyramid_hash(x, w, white_list, black_list, lod, num_emb=8, space_len=100, pyramid_layer=2, rand_len=4, drop_out_percent=0.0, is_training=0, use_filter=False, white_list_len=0, black_list_len=0, seed=0, lr=1.0, distribute_update_vars=''):
+    return _OPS['pyramid_hash'](x, w, white_list, black_list, lod, num_emb=num_emb, space_len=space_len, pyramid_layer=pyramid_layer, rand_len=rand_len, drop_out_percent=drop_out_percent, is_training=is_training, use_filter=use_filter, white_list_len=white_list_len, black_list_len=black_list_len, seed=seed, lr=lr, distribute_update_vars=distribute_update_vars)
+
+
+def qkv_unpack_mha(q, k, v, src_mask):
+    return _OPS['qkv_unpack_mha'](q, k, v, src_mask)
 
 
 def qr(x, mode='reduced'):
@@ -2146,12 +2290,20 @@ def sgd_(param, learning_rate, grad):
     return _OPS['sgd_'](param, learning_rate, grad)
 
 
+def shadow_output(x, name=''):
+    return _OPS['shadow_output'](x, name=name)
+
+
 def shape(input):
     return _OPS['shape'](input)
 
 
 def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     return _OPS['shard_index'](x, index_num, nshards, shard_id, ignore_value=ignore_value)
+
+
+def share_buffer(x, share_dims_and_dtype=()):
+    return _OPS['share_buffer'](x, share_dims_and_dtype=share_dims_and_dtype)
 
 
 def share_data(x):
@@ -2232,6 +2384,10 @@ def sort(x, axis=-1, descending=False, stable=False):
 
 def sparse_attention(q, k, v, offset, columns, key_padding_mask=None, attn_mask=None):
     return _OPS['sparse_attention'](q, k, v, offset, columns, key_padding_mask=key_padding_mask, attn_mask=attn_mask)
+
+
+def sparse_coo_tensor(values, indices, shape=()):
+    return _OPS['sparse_coo_tensor'](values, indices, shape=shape)
 
 
 def sparse_momentum(param, grad, velocity, index, learning_rate, mu=0.9, use_nesterov=False, regularization_method='', regularization_coeff=0.0, axis=0):
@@ -2498,6 +2654,10 @@ def upper(x, use_utf8_encoding=False):
     return _OPS['upper'](x, use_utf8_encoding=use_utf8_encoding)
 
 
+def values(x):
+    return _OPS['values'](x)
+
+
 def var(x, axis=None, unbiased=True, keepdim=False):
     return _OPS['var'](x, axis=axis, unbiased=unbiased, keepdim=keepdim)
 
@@ -2554,6 +2714,14 @@ def yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01, downsample_
     return _OPS['yolo_box'](x, img_size, anchors=anchors, class_num=class_num, conf_thresh=conf_thresh, downsample_ratio=downsample_ratio, clip_bbox=clip_bbox, scale_x_y=scale_x_y, iou_aware=iou_aware, iou_aware_factor=iou_aware_factor)
 
 
+def yolo_box_head(x, anchors=(), class_num=1):
+    return _OPS['yolo_box_head'](x, anchors=anchors, class_num=class_num)
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale, anchors0=(), anchors1=(), anchors2=(), class_num=80, conf_thresh=0.01, downsample_ratio0=8, downsample_ratio1=16, downsample_ratio2=32, clip_bbox=True, scale_x_y=1.0, nms_threshold=0.45):
+    return _OPS['yolo_box_post'](boxes0, boxes1, boxes2, image_shape, image_scale, anchors0=anchors0, anchors1=anchors1, anchors2=anchors2, class_num=class_num, conf_thresh=conf_thresh, downsample_ratio0=downsample_ratio0, downsample_ratio1=downsample_ratio1, downsample_ratio2=downsample_ratio2, clip_bbox=clip_bbox, scale_x_y=scale_x_y, nms_threshold=nms_threshold)
+
+
 def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(), anchor_mask=(), class_num=1, ignore_thresh=0.7, downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0):
     return _OPS['yolo_loss'](x, gt_box, gt_label, gt_score=gt_score, anchors=anchors, anchor_mask=anchor_mask, class_num=class_num, ignore_thresh=ignore_thresh, downsample_ratio=downsample_ratio, use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
 
@@ -2570,6 +2738,7 @@ def zeros_like(x, dtype=None):
 __all__ = [
     'abs',
     'accuracy',
+    'accuracy_check',
     'acos',
     'acosh',
     'adadelta_',
@@ -2615,6 +2784,7 @@ __all__ = [
     'atan',
     'atan2',
     'atanh',
+    'attention_lstm',
     'auc',
     'average_accumulates_',
     'avg_pool1d',
@@ -2642,6 +2812,7 @@ __all__ = [
     'bitwise_or',
     'bitwise_right_shift',
     'bitwise_xor',
+    'blha_get_max_len',
     'block_multihead_attention_',
     'bmm',
     'box_clip',
@@ -2662,6 +2833,7 @@ __all__ = [
     'c_scatter',
     'c_softmax_with_cross_entropy',
     'c_split',
+    'calc_reduced_attn_scores',
     'cast',
     'ceil',
     'celu',
@@ -2678,6 +2850,7 @@ __all__ = [
     'coalesce',
     'coalesce_tensor',
     'collect_fpn_proposals',
+    'comm_init_all',
     'complex',
     'concat',
     'cond',
@@ -2685,7 +2858,9 @@ __all__ = [
     'conv1d',
     'conv2d',
     'conv2d_transpose',
+    'conv2d_transpose_bias',
     'conv3d',
+    'conv3d_implicit_gemm',
     'conv3d_transpose',
     'copy_to',
     'copysign',
@@ -2703,11 +2878,13 @@ __all__ = [
     'cross_entropy_with_softmax',
     'ctc_align',
     'ctc_loss',
+    'cudnn_lstm',
     'cummax',
     'cummin',
     'cumprod',
     'cumsum',
     'cvm',
+    'data',
     'decayed_adagrad',
     'decode_jpeg',
     'deformable_conv',
@@ -2720,14 +2897,20 @@ __all__ = [
     'dequantize_log',
     'det',
     'detection_map',
+    'dgc',
+    'dgc_clip_by_norm',
+    'dgc_momentum',
     'diag',
     'diag_embed',
     'diagflat',
     'diagonal',
     'digamma',
     'dirichlet',
+    'disable_check_model_nan_inf',
     'dist',
+    'dist_concat',
     'distribute_fpn_proposals',
+    'distributed_fused_lamb_init',
     'divide',
     'divide_scalar',
     'dot',
@@ -2750,6 +2933,7 @@ __all__ = [
     'embedding',
     'empty',
     'empty_like',
+    'enable_check_model_nan_inf',
     'equal',
     'equal_all',
     'erf',
@@ -2771,6 +2955,7 @@ __all__ = [
     'fake_quantize_moving_average_abs_max',
     'fake_quantize_range_abs_max',
     'fc',
+    'fetch_barrier',
     'fft_c2c',
     'fft_c2r',
     'fft_r2c',
@@ -2790,6 +2975,7 @@ __all__ = [
     'fmax',
     'fmin',
     'fold',
+    'fp8_fp8_half_gemm_fused',
     'frac',
     'fractional_max_pool2d',
     'fractional_max_pool3d',
@@ -2804,18 +2990,23 @@ __all__ = [
     'full_like',
     'full_with_tensor',
     'fused_attention',
+    'fused_batch_norm_act',
     'fused_bias_act',
     'fused_bias_dropout_residual_layer_norm',
     'fused_bias_residual_layernorm',
+    'fused_bn_add_activation',
     'fused_conv2d_add_act',
+    'fused_dconv_drelu_dbn',
     'fused_dot_product_attention',
     'fused_dropout_add',
     'fused_elementwise_add',
     'fused_elementwise_div',
     'fused_elementwise_mul',
     'fused_elementwise_sub',
+    'fused_elemwise_activation',
     'fused_elemwise_add_activation',
     'fused_embedding_eltwise_layernorm',
+    'fused_embedding_fc_lstm',
     'fused_fc_elementwise_layernorm',
     'fused_feedforward',
     'fused_linear',
@@ -2825,12 +3016,18 @@ __all__ = [
     'fused_rms_norm',
     'fused_rotary_position_embedding',
     'fused_scale_bias_add_relu',
+    'fused_scale_bias_relu_conv_bn',
+    'fused_seqpool_cvm',
     'fused_softmax_mask',
     'fused_softmax_mask_upper_triangle',
     'fused_token_prune',
     'fusion_gru',
     'fusion_lstm',
     'fusion_repeated_fc_relu',
+    'fusion_seqconv_eltadd_relu',
+    'fusion_seqexpand_concat_fc',
+    'fusion_seqpool_concat',
+    'fusion_seqpool_cvm_concat',
     'fusion_squared_mat_sub',
     'fusion_transpose_flatten_concat',
     'gammaincc',
@@ -2884,6 +3081,7 @@ __all__ = [
     'index_sample',
     'index_select',
     'index_select_strided',
+    'indices',
     'inner',
     'instance_norm',
     'interpolate_bilinear',
@@ -2906,8 +3104,11 @@ __all__ = [
     'lcm',
     'ldexp',
     'leaky_relu',
+    'legacy_bilinear_interp',
     'legacy_crop',
     'legacy_expand',
+    'legacy_generate_proposals',
+    'legacy_nearest_interp',
     'lerp',
     'less_equal',
     'less_than',
@@ -3023,6 +3224,7 @@ __all__ = [
     'p_send_array',
     'pad',
     'pad3d',
+    'partial_allgather',
     'partial_concat',
     'partial_sum',
     'pinv',
@@ -3039,6 +3241,8 @@ __all__ = [
     'prune_gate_by_capacity',
     'psroi_pool',
     'put_along_axis',
+    'pyramid_hash',
+    'qkv_unpack_mha',
     'qr',
     'quant_linear',
     'quantile',
@@ -3100,8 +3304,10 @@ __all__ = [
     'set_value_with_tensor',
     'setitem',
     'sgd_',
+    'shadow_output',
     'shape',
     'shard_index',
+    'share_buffer',
     'share_data',
     'shuffle_batch',
     'shuffle_channel',
@@ -3122,6 +3328,7 @@ __all__ = [
     'solve',
     'sort',
     'sparse_attention',
+    'sparse_coo_tensor',
     'sparse_momentum',
     'spectral_norm',
     'split',
@@ -3188,6 +3395,7 @@ __all__ = [
     'unstack',
     'update_loss_scaling_',
     'upper',
+    'values',
     'var',
     'variable_length_memory_efficient_attention',
     'view_dtype',
@@ -3202,6 +3410,8 @@ __all__ = [
     'weighted_sample_neighbors',
     'where',
     'yolo_box',
+    'yolo_box_head',
+    'yolo_box_post',
     'yolo_loss',
     'zeros',
     'zeros_like',

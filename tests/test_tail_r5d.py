@@ -1,0 +1,384 @@
+"""Dedicated semantics tests for op tail 10 (tail_r5d.py) — the final
+sweep ops whose signatures don't fit the generic generated harness."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.dispatch import OPS
+
+
+def K(name):
+    return OPS[name]._kernel
+
+
+def test_accuracy_check_verdicts():
+    x = np.array([1.0, 1.0, np.nan], np.float32)
+    y = np.array([1.0, 1.1, np.nan], np.float32)
+    out = np.asarray(K("accuracy_check")(x, y, rtol=1e-3))
+    np.testing.assert_array_equal(out, [True, False, False])
+    out = np.asarray(K("accuracy_check")(x, y, rtol=1e-3, equal_nan=True))
+    np.testing.assert_array_equal(out, [True, False, True])
+
+
+def test_check_model_nan_inf_flag_toggle():
+    from paddle_tpu.core import flags
+    x = np.ones(2, np.float32)
+    K("enable_check_model_nan_inf")(x)
+    assert flags.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    K("disable_check_model_nan_inf")(x)
+    assert not flags.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+
+
+def test_calc_reduced_attn_scores_vs_naive():
+    rs = np.random.RandomState(0)
+    B, Sq, Sk, H, D = 2, 4, 6, 2, 8
+    q = rs.randn(B, Sq, H, D).astype(np.float32)
+    k = rs.randn(B, Sk, H, D).astype(np.float32)
+    s = np.einsum("bihd,bjhd->bhij", q, k) / np.sqrt(D)
+    lse = np.log(np.exp(s).sum(-1)).astype(np.float32)      # [B, H, Sq]
+    red = np.asarray(K("calc_reduced_attn_scores")(q, k, lse))
+    p = np.exp(s - lse[..., None])                           # softmax probs
+    np.testing.assert_allclose(red[:, :, 0, :], p.sum(2), rtol=1e-4,
+                               atol=1e-5)
+    # each row of p sums to 1 -> reduced sums to Sq per (b, h)
+    np.testing.assert_allclose(red.sum(-1).ravel(), Sq, rtol=1e-4)
+
+
+def test_sparse_trio_roundtrip():
+    vals = np.array([3.0, 4.0], np.float32)
+    idx = np.array([[0, 1], [2, 0]], np.int64)
+    sp = K("sparse_coo_tensor")(vals, idx, shape=(2, 3))
+    got_i = np.asarray(K("indices")(sp).numpy())
+    got_v = np.asarray(K("values")(sp).numpy())
+    np.testing.assert_array_equal(np.sort(got_v), [3.0, 4.0])
+    assert got_i.shape == (2, 2)
+
+
+def test_collectives_single_rank():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = np.asarray(K("dist_concat")(x, nranks=1))
+    np.testing.assert_array_equal(out, x)
+    out = np.asarray(K("partial_allgather")(x, nranks=1, rank=0))
+    np.testing.assert_array_equal(out, x)
+    outs = K("fetch_barrier")([jnp.asarray(x)])
+    np.testing.assert_array_equal(np.asarray(outs[0]), x)
+    assert int(np.asarray(K("comm_init_all")())) == 0
+
+
+def test_fused_scale_bias_relu_conv_bn_contract():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 5, 5, 3).astype(np.float32)
+    scale = rs.rand(3).astype(np.float32) + 0.5
+    bias = rs.randn(3).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32)     # OIHW (repo convention)
+    bn_scale = rs.rand(4).astype(np.float32) + 0.5
+    bn_bias = rs.randn(4).astype(np.float32)
+    rm = np.zeros(4, np.float32)
+    rv = np.ones(4, np.float32)
+    out, nm, nv, sm, sinv, eqs, eqb = K("fused_scale_bias_relu_conv_bn")(
+        x, w, scale, bias, bn_scale, bn_bias, rm, rv,
+        paddings=(1, 1), strides=(1, 1))
+    out = np.asarray(out)
+    # eq_scale/eq_bias must fold BN exactly: bn(out) == out*eqs + eqb
+    bn_ref = (out - np.asarray(sm)) * np.asarray(sinv) * bn_scale + bn_bias
+    np.testing.assert_allclose(out * np.asarray(eqs) + np.asarray(eqb),
+                               bn_ref, rtol=1e-4, atol=1e-4)
+    # conv path matches the unfused composition
+    h = np.maximum(x * scale + bias, 0)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(h), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            h.shape, w.shape, ("NHWC", "OIHW", "NHWC")))
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_fused_dconv_drelu_dbn_matches_autograd():
+    """The fused backward must equal jax.grad of the composed forward
+    conv(relu(bn1_eqscale*conv_input + bn1_eqbias)) and the BN1 grads of
+    gamma/beta at bn1_input."""
+    rs = np.random.RandomState(2)
+    N, Hh, W, C, O = 2, 5, 5, 3, 4
+    conv_input = rs.randn(N, Hh, W, C).astype(np.float32)
+    weight = rs.randn(O, C, 3, 3).astype(np.float32)     # OIHW
+    eqs = rs.rand(C).astype(np.float32) + 0.5
+    eqb = rs.randn(C).astype(np.float32)
+    go = rs.randn(N, Hh - 2, W - 2, O).astype(np.float32)
+    bn1_input = rs.randn(N, Hh, W, C).astype(np.float32)
+    mu = bn1_input.mean((0, 1, 2))
+    inv = 1.0 / np.sqrt(bn1_input.var((0, 1, 2)) + 1e-5)
+    gamma = rs.rand(C).astype(np.float32) + 0.5
+    beta = rs.randn(C).astype(np.float32)
+
+    gw, dx, dgamma, dbeta = K("fused_dconv_drelu_dbn")(
+        go, weight, None, None, eqs, eqb, conv_input, mu, inv, gamma, beta,
+        bn1_input, paddings=(0, 0), strides=(1, 1))
+
+    def fwd_w(w_):
+        act = jax.nn.relu(jnp.asarray(conv_input) * eqs + eqb)
+        out = jax.lax.conv_general_dilated(
+            act, w_, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                act.shape, w_.shape, ("NHWC", "OIHW", "NHWC")))
+        return jnp.sum(out * go)
+
+    gw_ref = jax.grad(fwd_w)(jnp.asarray(weight))
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=1e-3, atol=1e-3)
+
+    def fwd_bn(g_, b_):
+        xhat = (jnp.asarray(bn1_input) - mu) * inv
+        y = g_ * xhat + b_
+        # dact at bn1 output == the drelu'd conv input-grad; emulate by
+        # feeding y through the same relu+conv pipeline in conv_input's
+        # place is NOT the contract — gamma/beta grads use dact directly,
+        # so check them against manual sums instead.
+        return y
+
+    # manual dgamma/dbeta from the fused op's own dact definition
+    relu_in = conv_input * eqs + eqb
+    act = jnp.maximum(jnp.asarray(relu_in), 0)
+
+    def fwd_in(inp):
+        out = jax.lax.conv_general_dilated(
+            inp, jnp.asarray(weight), (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                inp.shape, weight.shape, ("NHWC", "OIHW", "NHWC")))
+        return jnp.sum(out * go)
+
+    gin_ref = jax.grad(fwd_in)(act)
+    dact_ref = np.where(relu_in > 0, np.asarray(gin_ref), 0.0)
+    xhat = (bn1_input - mu) * inv
+    np.testing.assert_allclose(np.asarray(dgamma),
+                               (dact_ref * xhat).sum((0, 1, 2)),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dbeta),
+                               dact_ref.sum((0, 1, 2)), rtol=1e-3, atol=1e-3)
+
+
+def test_dgc_topk_and_masking():
+    rs = np.random.RandomState(3)
+    g = rs.randn(20).astype(np.float32)
+    u = np.zeros(20, np.float32)
+    v = np.zeros(20, np.float32)
+    p = np.zeros(20, np.float32)
+    uo, vo, enc, go, k = K("dgc")(u, v, g, p, np.array([10.0]),
+                                  np.array([2.0]), sparsity=(0.75,),
+                                  rampup_begin_step=0.0, rampup_step=1.0,
+                                  use_nesterov=False)
+    kk = int(np.asarray(k)[0])
+    assert kk == 5                      # 20 * (1 - 0.75)
+    enc = np.asarray(enc)
+    assert enc.shape == (2 * kk,)
+    idx = enc[:kk].view(np.int32).astype(np.int64)   # bitcast-packed
+    vals = enc[kk:]
+    # selected values are the top-|.| of v_new = u_new + v = 2*g here? no:
+    # u_new = 0.9*0 + 2g = 2g; v_new = u_new + 0 = 2g
+    v_new = 2 * g
+    order = np.argsort(-np.abs(v_new))[:kk]
+    assert set(idx.tolist()) == set(order.tolist())
+    np.testing.assert_allclose(vals, v_new[idx], rtol=1e-5)
+    # masked at selected, intact elsewhere
+    vo = np.asarray(vo)
+    assert (vo[idx] == 0).all()
+    rest = np.setdiff1d(np.arange(20), idx)
+    np.testing.assert_allclose(vo[rest], v_new[rest], rtol=1e-5)
+    # before rampup: passthrough
+    uo2, vo2, enc2, go2, k2 = K("dgc")(u, v, g, p, np.array([0.0]),
+                                       np.array([2.0]), sparsity=(0.75,),
+                                       rampup_begin_step=5.0, rampup_step=1.0)
+    assert np.asarray(enc2).size == 0
+    np.testing.assert_allclose(np.asarray(go2), 2 * g, rtol=1e-6)
+
+
+def test_seqpool_fusions():
+    lod = [0, 2, 5]
+    x1 = np.arange(10, dtype=np.float32).reshape(5, 2)
+    x2 = np.ones((5, 3), np.float32)
+    pooled = K("fused_seqpool_cvm")([x1, x2], None, lod, pooltype="SUM",
+                                    use_cvm=True)
+    np.testing.assert_allclose(np.asarray(pooled[0]),
+                               [[0 + 2, 1 + 3], [4 + 6 + 8, 5 + 7 + 9]])
+    stripped = K("fused_seqpool_cvm")([x2], None, lod, use_cvm=False)
+    assert np.asarray(stripped[0]).shape == (2, 1)    # 3 - cvm_offset
+    cat = np.asarray(K("fusion_seqpool_concat")([x1, x2], lod))
+    assert cat.shape == (2, 5)
+    cat2 = np.asarray(K("fusion_seqpool_cvm_concat")([x1, x2], None, lod))
+    assert cat2.shape == (2, 5)
+
+
+def test_fusion_seqconv_eltadd_relu_nonneg_and_parity():
+    rs = np.random.RandomState(4)
+    x = rs.randn(5, 3).astype(np.float32)
+    filt = rs.randn(9, 4).astype(np.float32)
+    bias = rs.randn(4).astype(np.float32)
+    lod = [0, 2, 5]
+    out = np.asarray(K("fusion_seqconv_eltadd_relu")(x, filt, bias, lod,
+                                                     context_length=3,
+                                                     context_start=-1))
+    from paddle_tpu.ops.kernels.tail_r4 import sequence_conv
+    ref = np.maximum(np.asarray(
+        sequence_conv.__wrapped__(x, filt, lod, context_length=3,
+                                  context_start=-1)) + bias, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_seqexpand_concat_fc():
+    rs = np.random.RandomState(5)
+    ref_rows = rs.randn(5, 2).astype(np.float32)   # lod [0,2,5]
+    extra = rs.randn(2, 3).astype(np.float32)      # one row per sequence
+    wfc = rs.randn(5, 4).astype(np.float32)
+    bfc = rs.randn(4).astype(np.float32)
+    out = np.asarray(K("fusion_seqexpand_concat_fc")(
+        [ref_rows, extra], wfc, bfc, [0, 2, 5], fc_activation="relu"))
+    exp = np.concatenate([ref_rows,
+                          np.concatenate([np.tile(extra[0], (2, 1)),
+                                          np.tile(extra[1], (3, 1))])], 1)
+    np.testing.assert_allclose(out, np.maximum(exp @ wfc + bfc, 0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_attention_lstm_shapes_and_first_step():
+    rs = np.random.RandomState(6)
+    M, D = 4, 3
+    x = rs.randn(5, M).astype(np.float32)          # lod [0,2,5]
+    c0 = rs.randn(2, D).astype(np.float32)
+    h0 = rs.randn(2, D).astype(np.float32)
+    aw = rs.randn(M + D, 1).astype(np.float32)
+    lw = rs.randn(D + M, 4 * D).astype(np.float32)
+    lb = rs.randn(4 * D).astype(np.float32)
+    hid, cell = K("attention_lstm")(x, c0, h0, aw, None, None, None, lw, lb,
+                                    [0, 2, 5])
+    assert np.asarray(hid).shape == (5, D) and np.asarray(cell).shape == (5, D)
+    # manual first step of sequence 0
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    xi = x[0:2]
+    fc = np.maximum(xi @ aw[:M, 0] + c0[0] @ aw[M:, 0], 0)
+    att = np.exp(fc - fc.max()); att = att / att.sum()
+    lx = att @ xi
+    gates = lx @ lw[D:] + h0[0] @ lw[:D] + lb
+    f, i_, o = sig(gates[:D]), sig(gates[D:2 * D]), sig(gates[2 * D:3 * D])
+    cand = np.tanh(gates[3 * D:])
+    c1 = f * c0[0] + i_ * cand
+    h1 = np.tanh(c1) * o
+    np.testing.assert_allclose(np.asarray(cell)[0], c1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hid)[0], h1, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_embedding_fc_lstm_manual_parity():
+    rs = np.random.RandomState(7)
+    V, D = 10, 3
+    ids = np.array([1, 4, 2], np.int64)            # one sequence
+    emb = rs.randn(V, 4 * D).astype(np.float32)
+    wh = rs.randn(D, 4 * D).astype(np.float32)
+    b = rs.randn(4 * D).astype(np.float32)
+    hid, cell, xx = K("fused_embedding_fc_lstm")(ids, emb, wh, b, None, None,
+                                                 [0, 3])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros(D, np.float32); c = np.zeros(D, np.float32)
+    for t, tok in enumerate(ids):
+        gates = emb[tok] + b + h @ wh
+        cand = np.tanh(gates[:D])
+        i_, f, o = sig(gates[D:2 * D]), sig(gates[2 * D:3 * D]), sig(gates[3 * D:])
+        c = i_ * cand + f * c
+        h = np.tanh(c) * o
+        np.testing.assert_allclose(np.asarray(hid)[t], h, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_cudnn_lstm_delegates_to_rnn():
+    rs = np.random.RandomState(8)
+    T, B, In, H = 4, 2, 3, 5
+    x = rs.randn(T, B, In).astype(np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    wl = [[rs.randn(4 * H, In).astype(np.float32),
+           rs.randn(4 * H, H).astype(np.float32), None, None]]
+    out, h, c, reserve = K("cudnn_lstm")(x, h0, c0, weight_list=wl,
+                                         hidden_size=H)
+    assert np.asarray(out).shape == (T, B, H)
+    from paddle_tpu.ops.kernels.rnn_ops import rnn
+    ref, rh, rc = rnn.__wrapped__(x, h0, c0, wl, mode="LSTM",
+                                  time_major=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_distributed_fused_lamb_init_bookkeeping():
+    rs = np.random.RandomState(9)
+    p1 = rs.randn(3, 3).astype(np.float32)
+    p2 = rs.randn(5).astype(np.float32)
+    outs = K("distributed_fused_lamb_init")([p1, p2], [p1 * 0, p2 * 0],
+                                            alignment=4)
+    fused_param, fused_grad = np.asarray(outs[0]), np.asarray(outs[1])
+    offsets = np.asarray(outs[8])
+    assert fused_param.size % 4 == 0
+    np.testing.assert_allclose(fused_param[:9], p1.reshape(-1))
+    np.testing.assert_allclose(fused_param[offsets[1]:offsets[1] + 5], p2)
+    m1 = np.asarray(outs[4])
+    assert (m1 == 0).all() and m1.size == fused_param.size
+
+
+def test_pyramid_hash_shapes_and_determinism():
+    w = np.random.RandomState(10).randn(104 + 4).astype(np.float32)
+    ids = np.array([3, 7, 7, 2], np.int64)
+    top, drop, xt = K("pyramid_hash")(ids, w, np.zeros(0), np.zeros(0),
+                                      [0, 4], num_emb=8, space_len=104,
+                                      pyramid_layer=3, rand_len=4)
+    top = np.asarray(top)
+    # ngrams: len2 -> 3, len3 -> 2 (pyramid_layer=3) => 5 rows
+    assert top.shape == (5, 8)
+    top2 = np.asarray(K("pyramid_hash")(ids, w, np.zeros(0), np.zeros(0),
+                                        [0, 4], num_emb=8, space_len=104,
+                                        pyramid_layer=3, rand_len=4)[0])
+    np.testing.assert_array_equal(top, top2)
+    # identical ngrams hash identically: rows for (7,7) window repeated ids
+    short, _, _ = K("pyramid_hash")(np.array([5, 5], np.int64), w,
+                                    np.zeros(0), np.zeros(0), [0, 2],
+                                    num_emb=8, space_len=104,
+                                    pyramid_layer=3, rand_len=4)
+    assert np.asarray(short).shape == (1, 8)
+
+
+def test_legacy_generate_proposals_smoke():
+    rs = np.random.RandomState(11)
+    N, A, Hh, W = 1, 2, 3, 3
+    scores = rs.rand(N, A, Hh, W).astype(np.float32)
+    deltas = (rs.randn(N, A * 4, Hh, W) * 0.1).astype(np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    anchors = np.tile(np.array([0, 0, 7, 7], np.float32),
+                      (Hh, W, A, 1)).astype(np.float32)
+    var = np.ones_like(anchors)
+    rois, rois_num = K("legacy_generate_proposals")(
+        scores, deltas, im_info, anchors, var, pre_nms_top_n=10,
+        post_nms_top_n=5, nms_thresh=0.7)[:2]
+    assert np.asarray(rois).shape[1] == 4
+    assert np.asarray(rois).shape[0] <= 5
+
+
+def test_yolo_box_post_smoke():
+    rs = np.random.RandomState(12)
+    C = 2
+    heads = [rs.randn(1, 3 * (5 + C), s, s).astype(np.float32) * 0.1
+             for s in (8, 4, 2)]
+    img_shape = np.array([[64, 64]], np.float32)
+    img_scale = np.array([[1.0]], np.float32)
+    out, nums = K("yolo_box_post")(
+        heads[0], heads[1], heads[2], img_shape, img_scale,
+        anchors0=(10, 13, 16, 30, 33, 23), anchors1=(30, 61, 62, 45, 59, 119),
+        anchors2=(116, 90, 156, 198, 373, 326), class_num=C,
+        conf_thresh=0.3, nms_threshold=0.45)
+    out, nums = np.asarray(out), np.asarray(nums)
+    assert out.ndim == 2 and (out.shape[1] == 6 or out.shape[0] == 0)
+    assert nums.shape == (1,) and nums[0] == out.shape[0]
+
+
+def test_share_buffer_and_data_and_blha():
+    xs, found = K("share_buffer")([jnp.ones((2, 2))])
+    assert np.asarray(xs[0]).shape == (2, 2) and bool(found[0])
+    d = np.asarray(K("data")(name="x", shape=(2, 3), dtype="float32"))
+    assert d.shape == (2, 3) and (d == 0).all()
+    me, md = K("blha_get_max_len")(np.array([3, 9], np.int32),
+                                   np.array([1, 2], np.int32),
+                                   np.zeros(2, np.int32))
+    assert int(np.asarray(me)[0]) == 9 and int(np.asarray(md)[0]) == 2
