@@ -667,6 +667,8 @@ def _probe_backend(timeout_s: float = float(
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import tpu_lock
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            raise RuntimeError("cpu-pinned run touches no chip; skip lock")
         wait_budget = min(420.0, max(0.0, half_budget - 2 * timeout_s))
         if tpu_lock.is_held_by_other():
             print("[bench] chip lock held (bench_watch capture?); waiting",

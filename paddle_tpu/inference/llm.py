@@ -106,6 +106,26 @@ def _forward_cached(params, tokens, cache, pos, cfg: L.LlamaConfig,
     return logits, {"k": ks, "v": vs}
 
 
+def _sample_next(logits, key, temperature, top_p, top_k):
+    """Temperature/top-k/top-p token selection on f32 logits [B, V]
+    (the serving analog of the reference's top_p_sampling fused op,
+    `ops/kernels/tail_nn.py:616`). top_k is static (0 = off); top_p is a
+    traced scalar or None (static off); temperature a traced scalar."""
+    l = logits / temperature
+    if top_k:
+        vals = jax.lax.top_k(l, int(top_k))[0]
+        l = jnp.where(l < vals[..., -1:], -jnp.inf, l)
+    if top_p is not None:
+        sl = jnp.sort(l, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sl, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p           # exclusive prefix mass
+        cutoff = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
+                         keepdims=True)
+        l = jnp.where(l < cutoff, -jnp.inf, l)
+    return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+
 _DECODE_CHUNKS = (32, 8, 1)
 
 
@@ -165,61 +185,113 @@ class LLMPredictor:
 
         self._prefill = prefill
         self._decode = decode_step
-        self._chunk_fns: Dict[int, Any] = {}
+        # keyed by (chunk_len, sample, top_k, use_top_p)
+        self._chunk_fns: Dict[Tuple[int, bool, int, bool], Any] = {}
 
-    def _decode_chunk_fn(self, C: int):
+    def _decode_chunk_fn(self, C: int, top_k: int = 0, use_top_p: bool = False,
+                         sample: bool = False):
         """Jitted on-device loop of C decode steps. Carry: (last_logits,
-        cache, pos, finished); emits the C chosen tokens. `eos` is a traced
-        int32 scalar, -1 = no eos (finished then never sets)."""
-        fn = self._chunk_fns.get(C)
+        cache, pos, finished[, key]); emits the C chosen tokens. `eos` is a
+        traced int32 scalar, -1 = no eos (finished then never sets).
+        Greedy by default; `sample` adds temperature/top-k/top-p selection
+        with the PRNG key threaded through the carry."""
+        cache_key = (C, sample, int(top_k), bool(use_top_p))
+        fn = self._chunk_fns.get(cache_key)
         if fn is not None:
             return fn
         cfg_ = self.cfg
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def decode_chunk(params, last_logits, cache, pos, finished, eos):
-            def body(carry, _):
-                logits, cache, pos, finished = carry
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                nxt = jnp.where(finished, eos, nxt)
-                finished = finished | (nxt == eos)
-                logits, cache = _forward_cached(params, nxt[:, None], cache,
-                                                pos, cfg_, "xla")
-                return (logits[:, -1], cache, pos + 1, finished), nxt
+        if sample:
+            @functools.partial(jax.jit, donate_argnums=(2,))
+            def decode_chunk(params, last_logits, cache, pos, finished, eos,
+                             key, temperature, top_p):
+                tp = top_p if use_top_p else None
 
-            (logits, cache, pos, finished), toks = lax.scan(
-                body, (last_logits, cache, pos, finished), None, length=C)
-            return logits, cache, finished, toks.T  # [B, C]
+                def body(carry, _):
+                    logits, cache, pos, finished, key = carry
+                    key, sub = jax.random.split(key)
+                    nxt = _sample_next(logits, sub, temperature, tp, top_k)
+                    nxt = jnp.where(finished, eos, nxt)
+                    finished = finished | (nxt == eos)
+                    logits, cache = _forward_cached(params, nxt[:, None],
+                                                    cache, pos, cfg_, "xla")
+                    return (logits[:, -1], cache, pos + 1, finished, key), nxt
 
-        self._chunk_fns[C] = decode_chunk
+                (logits, cache, pos, finished, key), toks = lax.scan(
+                    body, (last_logits, cache, pos, finished, key), None,
+                    length=C)
+                return logits, cache, finished, key, toks.T  # [B, C]
+        else:
+            @functools.partial(jax.jit, donate_argnums=(2,))
+            def decode_chunk(params, last_logits, cache, pos, finished, eos):
+                def body(carry, _):
+                    logits, cache, pos, finished = carry
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(finished, eos, nxt)
+                    finished = finished | (nxt == eos)
+                    logits, cache = _forward_cached(params, nxt[:, None],
+                                                    cache, pos, cfg_, "xla")
+                    return (logits[:, -1], cache, pos + 1, finished), nxt
+
+                (logits, cache, pos, finished), toks = lax.scan(
+                    body, (last_logits, cache, pos, finished), None, length=C)
+                return logits, cache, finished, toks.T  # [B, C]
+
+        self._chunk_fns[cache_key] = decode_chunk
         return decode_chunk
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
-                 return_scores: bool = False):
-        """tokens [B, T] int32 prompt → [B, T + max_new] greedy completion.
-        Default path: on-device chunked scan (one dispatch per ≤32 tokens).
-        `return_scores=True` keeps the host-driven per-token loop since it
-        must surface every step's logits."""
+                 return_scores: bool = False,
+                 temperature: Optional[float] = None,
+                 top_k: int = 0, top_p: Optional[float] = None,
+                 seed: int = 0):
+        """tokens [B, T] int32 prompt → [B, T + max_new] completion.
+        Greedy by default; `temperature` (with optional `top_k`/`top_p`)
+        switches to on-device sampling — the serving analog of the
+        reference's top_p_sampling decode. Default path: on-device chunked
+        scan (one dispatch per ≤32 tokens). `return_scores=True` keeps the
+        host-driven per-token loop since it must surface every step's
+        logits."""
         tokens = jnp.asarray(tokens, jnp.int32)
         B, T = tokens.shape
         if T + max_new_tokens > self.max_len:
             raise ValueError(f"prompt {T} + new {max_new_tokens} exceeds "
                              f"max_len {self.max_len}")
+        if temperature is None and (top_k or top_p is not None):
+            temperature = 1.0        # top-k/top-p imply sampling
+        sample = temperature is not None and temperature > 0.0
+        if temperature is not None and temperature <= 0.0:
+            top_k, top_p = 0, None   # temperature<=0 = greedy by convention
         cache = init_cache(self.cfg, B, self.max_len, self.cache_dtype)
         last_logits, cache = self._prefill(self.params, tokens, cache)
         if return_scores:
+            if sample:
+                raise NotImplementedError(
+                    "return_scores=True uses the greedy host loop; "
+                    "sampling + per-step scores is not supported")
             return self._generate_hostloop(tokens, last_logits, cache,
                                            max_new_tokens, eos_token_id)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
         finished = jnp.zeros((B,), bool)
+        key = jax.random.PRNGKey(int(seed))
+        temp = jnp.float32(temperature if sample else 1.0)
+        tp = jnp.float32(top_p if top_p is not None else 1.0)
         out = [tokens]
         done = 0
         for C in _chunk_plan(max_new_tokens):
-            fn = self._decode_chunk_fn(C)
-            last_logits, cache, finished, toks = fn(
-                self.params, last_logits, cache, jnp.int32(T + done),
-                finished, eos)
+            if sample:
+                fn = self._decode_chunk_fn(C, top_k=int(top_k),
+                                           use_top_p=top_p is not None,
+                                           sample=True)
+                last_logits, cache, finished, key, toks = fn(
+                    self.params, last_logits, cache, jnp.int32(T + done),
+                    finished, eos, key, temp, tp)
+            else:
+                fn = self._decode_chunk_fn(C)
+                last_logits, cache, finished, toks = fn(
+                    self.params, last_logits, cache, jnp.int32(T + done),
+                    finished, eos)
             out.append(toks)
             done += C
             if eos_token_id is not None and bool(finished.all()):
