@@ -1,0 +1,87 @@
+"""Build/load helper for the inference C API shared library.
+
+`lib_path()` compiles src/paddle_c_api.cc with g++ on first use (cached by
+source hash, same scheme as paddle_tpu/core/native) and returns the .so
+path a C/C++/ctypes consumer links against. The public header is
+paddle_c_api.h next to this file.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "paddle_c_api.cc")
+HEADER = os.path.join(_DIR, "paddle_c_api.h")
+_lock = threading.Lock()
+_so_path = None
+
+# mirrors PD_DataType in paddle_c_api.h
+DTYPE_TO_ENUM = {"float32": 0, "int32": 1, "int64": 2, "float64": 3,
+                 "uint8": 4, "bool": 5}
+ENUM_TO_DTYPE = {v: k for k, v in DTYPE_TO_ENUM.items()}
+MAX_DIMS = 16
+
+
+def lib_path() -> str:
+    """Builds (if needed) and returns the path of libpaddle_tpu_c.so."""
+    global _so_path
+    with _lock:
+        if _so_path:
+            return _so_path
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache = os.environ.get(
+            "PADDLE_TPU_NATIVE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+        os.makedirs(cache, exist_ok=True)
+        so = os.path.join(cache, f"libpaddle_tpu_c_{digest}.so")
+        if not os.path.exists(so):
+            tmp = so + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", _SRC,
+                 "-o", tmp],
+                check=True, capture_output=True, timeout=300)
+            os.replace(tmp, so)
+        _so_path = so
+        return so
+
+
+def load() -> ctypes.CDLL:
+    """ctypes handle with signatures declared (the in-repo C consumer)."""
+    lib = ctypes.CDLL(lib_path())
+    c = ctypes
+    lib.PD_ConfigCreate.restype = c.c_void_p
+    lib.PD_ConfigDestroy.argtypes = [c.c_void_p]
+    for fn in ("PD_ConfigSetModel", "PD_ConfigSetDevice",
+               "PD_ConfigSetPrecision", "PD_ConfigSetPythonExe"):
+        getattr(lib, fn).argtypes = [c.c_void_p, c.c_char_p]
+    lib.PD_ConfigSetStartupTimeout.argtypes = [c.c_void_p, c.c_int]
+    lib.PD_PredictorCreate.restype = c.c_void_p
+    lib.PD_PredictorCreate.argtypes = [c.c_void_p]
+    lib.PD_PredictorDestroy.argtypes = [c.c_void_p]
+    lib.PD_PredictorGetInputNum.argtypes = [c.c_void_p]
+    lib.PD_PredictorGetInputNum.restype = c.c_int
+    lib.PD_PredictorGetInputName.argtypes = [c.c_void_p, c.c_int]
+    lib.PD_PredictorGetInputName.restype = c.c_char_p
+    lib.PD_PredictorGetOutputNum.argtypes = [c.c_void_p]
+    lib.PD_PredictorGetOutputNum.restype = c.c_int
+    lib.PD_PredictorGetOutputName.argtypes = [c.c_void_p, c.c_int]
+    lib.PD_PredictorGetOutputName.restype = c.c_char_p
+    lib.PD_PredictorSetInput.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
+        c.c_void_p]
+    lib.PD_PredictorSetInput.restype = c.c_int
+    lib.PD_PredictorRun.argtypes = [c.c_void_p]
+    lib.PD_PredictorRun.restype = c.c_int
+    lib.PD_PredictorGetOutput.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.c_int),
+        c.POINTER(c.c_int64), c.POINTER(c.c_int),
+        c.POINTER(c.c_void_p)]
+    lib.PD_PredictorGetOutput.restype = c.c_int
+    lib.PD_GetLastError.restype = c.c_char_p
+    lib.PD_GetVersion.restype = c.c_char_p
+    return lib

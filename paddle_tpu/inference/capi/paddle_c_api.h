@@ -1,0 +1,86 @@
+/* paddle_tpu inference C API.
+ *
+ * TPU-native analog of the reference's C inference API
+ * (paddle/fluid/inference/capi_exp/pd_inference_api.h): a plain C ABI a
+ * non-Python deployment stack can link against. The compute path of this
+ * framework is XLA behind a Python driver, so the library hosts the
+ * predictor in a dedicated worker process (python -m
+ * paddle_tpu.inference.capi_worker) and speaks a length-prefixed binary
+ * protocol over a unix socket — the process boundary IS the ABI boundary,
+ * the same design as the out-of-process parameter server
+ * (paddle_tpu/distributed/ps).
+ *
+ * Lifecycle:
+ *   PD_Config* cfg = PD_ConfigCreate();
+ *   PD_ConfigSetModel(cfg, "model.pdmodel");
+ *   PD_Predictor* pred = PD_PredictorCreate(cfg);   // spawns the worker
+ *   PD_PredictorSetInput(pred, "x", PD_FLOAT32, shape, 2, data);
+ *   PD_PredictorRun(pred);
+ *   const void* out; int64_t oshape[PD_MAX_DIMS]; int ondim, odtype;
+ *   PD_PredictorGetOutput(pred, "out", &odtype, oshape, &ondim, &out);
+ *   PD_PredictorDestroy(pred);                       // stops the worker
+ *
+ * Output buffers are owned by the predictor and remain valid until the
+ * next PD_PredictorRun or PD_PredictorDestroy (zero-copy contract of the
+ * reference's ZeroCopyTensor, scoped to the C side of the socket).
+ */
+#ifndef PADDLE_TPU_C_API_H_
+#define PADDLE_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PD_MAX_DIMS 16
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_FLOAT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+} PD_DataType;
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigDestroy(PD_Config* cfg);
+void PD_ConfigSetModel(PD_Config* cfg, const char* prog_file);
+/* device: "tpu" (default) or "cpu"; precision: "float32"/"bfloat16". */
+void PD_ConfigSetDevice(PD_Config* cfg, const char* device);
+void PD_ConfigSetPrecision(PD_Config* cfg, const char* precision);
+/* Python interpreter hosting the worker (default: "python3"). */
+void PD_ConfigSetPythonExe(PD_Config* cfg, const char* exe);
+/* Seconds to wait for the worker to come up (default 180). */
+void PD_ConfigSetStartupTimeout(PD_Config* cfg, int seconds);
+
+/* Returns NULL on failure; PD_GetLastError() describes why. */
+PD_Predictor* PD_PredictorCreate(PD_Config* cfg);
+void PD_PredictorDestroy(PD_Predictor* pred);
+
+int PD_PredictorGetInputNum(PD_Predictor* pred);
+const char* PD_PredictorGetInputName(PD_Predictor* pred, int i);
+int PD_PredictorGetOutputNum(PD_Predictor* pred);
+const char* PD_PredictorGetOutputName(PD_Predictor* pred, int i);
+
+/* Stage one input; data is copied. Returns 0 on success. */
+int PD_PredictorSetInput(PD_Predictor* pred, const char* name, int dtype,
+                         const int64_t* shape, int ndim, const void* data);
+/* Execute; returns 0 on success (PD_GetLastError() on failure). */
+int PD_PredictorRun(PD_Predictor* pred);
+/* Fetch one output by name. *data points at predictor-owned memory. */
+int PD_PredictorGetOutput(PD_Predictor* pred, const char* name, int* dtype,
+                          int64_t* shape, int* ndim, const void** data);
+
+const char* PD_GetLastError(void);
+const char* PD_GetVersion(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_C_API_H_ */

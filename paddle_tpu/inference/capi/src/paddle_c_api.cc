@@ -1,0 +1,366 @@
+// paddle_tpu inference C API implementation.
+//
+// Hosts the Python/XLA predictor in a worker process
+// (python -m paddle_tpu.inference.capi_worker) and exposes a plain C ABI
+// over it (see ../paddle_c_api.h). The C side OWNS the unix listening
+// socket: it binds, spawns the worker with --connect <path>, and accepts
+// with a timeout — no filesystem polling. All integers on the wire are
+// little-endian host order (both ends are the same machine by design).
+//
+// Wire protocol (every message framed as u64 body_len + body; body starts
+// with u8 op for requests / u8 ok for responses):
+//   op 1 META  -> ok, u32 n_in, {u16 len, bytes}*, u32 n_out, {...}*
+//   op 2 RUN   (u32 n_tensors, tensor*) -> ok, u32 n_out, tensor*
+//              tensor = u16 name_len, name, u8 dtype, u8 ndim,
+//                       i64 shape[ndim], u64 nbytes, raw bytes
+//   op 3 EXIT  -> ok
+// ok=0 responses carry u32 err_len + message instead of a payload.
+
+#include "../paddle_c_api.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+struct Tensor {
+  std::string name;
+  int dtype = PD_FLOAT32;
+  std::vector<int64_t> shape;
+  std::vector<char> data;
+};
+
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case PD_FLOAT32: case PD_INT32: return 4;
+    case PD_INT64: case PD_FLOAT64: return 8;
+    case PD_UINT8: case PD_BOOL: return 1;
+    default: return 0;
+  }
+}
+
+// -- buffered little-endian writer/reader -----------------------------------
+
+struct Writer {
+  std::vector<char> buf;
+  void raw(const void* p, size_t n) {
+    buf.insert(buf.end(), (const char*)p, (const char*)p + n);
+  }
+  void u8(uint8_t v) { raw(&v, 1); }
+  void u16(uint16_t v) { raw(&v, 2); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void str16(const std::string& s) { u16((uint16_t)s.size()); raw(s.data(), s.size()); }
+};
+
+struct Reader {
+  const char* p;
+  const char* end;
+  bool fail = false;
+  Reader(const std::vector<char>& b) : p(b.data()), end(b.data() + b.size()) {}
+  bool take(void* out, size_t n) {
+    if ((size_t)(end - p) < n) { fail = true; return false; }
+    memcpy(out, p, n); p += n; return true;
+  }
+  uint8_t u8() { uint8_t v = 0; take(&v, 1); return v; }
+  uint16_t u16() { uint16_t v = 0; take(&v, 2); return v; }
+  uint32_t u32() { uint32_t v = 0; take(&v, 4); return v; }
+  uint64_t u64() { uint64_t v = 0; take(&v, 8); return v; }
+  int64_t i64() { int64_t v = 0; take(&v, 8); return v; }
+  std::string str16() {
+    uint16_t n = u16();
+    if ((size_t)(end - p) < n) { fail = true; return ""; }
+    std::string s(p, p + n); p += n; return s;
+  }
+};
+
+bool write_all(int fd, const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) { if (errno == EINTR) continue; return false; }
+    p += w; n -= (size_t)w;
+  }
+  return true;
+}
+
+bool read_all(int fd, void* data, size_t n) {
+  char* p = (char*)data;
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r < 0) { if (errno == EINTR) continue; return false; }
+    if (r == 0) return false;
+    p += r; n -= (size_t)r;
+  }
+  return true;
+}
+
+bool send_frame(int fd, const Writer& w) {
+  uint64_t len = w.buf.size();
+  return write_all(fd, &len, 8) && write_all(fd, w.buf.data(), w.buf.size());
+}
+
+bool recv_frame(int fd, std::vector<char>* body) {
+  uint64_t len = 0;
+  if (!read_all(fd, &len, 8)) return false;
+  if (len > (uint64_t)1 << 40) return false;  // corrupt frame guard
+  body->resize((size_t)len);
+  return len == 0 || read_all(fd, body->data(), (size_t)len);
+}
+
+}  // namespace
+
+struct PD_Config {
+  std::string model;
+  std::string device = "tpu";
+  std::string precision = "float32";
+  std::string python_exe = "python3";
+  int startup_timeout_s = 180;
+};
+
+struct PD_Predictor {
+  int fd = -1;
+  pid_t worker = -1;
+  std::string sock_dir;
+  std::vector<std::string> input_names, output_names;
+  std::vector<Tensor> staged;        // inputs awaiting Run
+  std::vector<Tensor> outputs;       // owned until next Run/Destroy
+};
+
+extern "C" {
+
+PD_Config* PD_ConfigCreate(void) { return new PD_Config(); }
+void PD_ConfigDestroy(PD_Config* cfg) { delete cfg; }
+void PD_ConfigSetModel(PD_Config* cfg, const char* f) { if (cfg && f) cfg->model = f; }
+void PD_ConfigSetDevice(PD_Config* cfg, const char* d) { if (cfg && d) cfg->device = d; }
+void PD_ConfigSetPrecision(PD_Config* cfg, const char* p) { if (cfg && p) cfg->precision = p; }
+void PD_ConfigSetPythonExe(PD_Config* cfg, const char* e) { if (cfg && e) cfg->python_exe = e; }
+void PD_ConfigSetStartupTimeout(PD_Config* cfg, int s) { if (cfg && s > 0) cfg->startup_timeout_s = s; }
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+const char* PD_GetVersion(void) { return "paddle_tpu-c-api-1.0"; }
+
+static bool predictor_meta(PD_Predictor* p) {
+  Writer w;
+  w.u8(1);
+  if (!send_frame(p->fd, w)) { set_error("meta: send failed"); return false; }
+  std::vector<char> body;
+  if (!recv_frame(p->fd, &body)) { set_error("meta: recv failed"); return false; }
+  Reader r(body);
+  if (r.u8() != 1) { set_error("meta: worker error"); return false; }
+  uint32_t n_in = r.u32();
+  for (uint32_t i = 0; i < n_in; i++) p->input_names.push_back(r.str16());
+  uint32_t n_out = r.u32();
+  for (uint32_t i = 0; i < n_out; i++) p->output_names.push_back(r.str16());
+  if (r.fail) { set_error("meta: truncated response"); return false; }
+  return true;
+}
+
+PD_Predictor* PD_PredictorCreate(PD_Config* cfg) {
+  if (!cfg || cfg->model.empty()) { set_error("config has no model file"); return nullptr; }
+  char dir_tmpl[] = "/tmp/pd_capi_XXXXXX";
+  if (!mkdtemp(dir_tmpl)) { set_error("mkdtemp failed"); return nullptr; }
+  std::string sock_path = std::string(dir_tmpl) + "/predictor.sock";
+
+  int lfd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (lfd < 0) { set_error("socket() failed"); return nullptr; }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", sock_path.c_str());
+  if (::bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 || ::listen(lfd, 1) != 0) {
+    set_error("bind/listen failed: " + std::string(strerror(errno)));
+    ::close(lfd);
+    return nullptr;
+  }
+
+  pid_t pid = fork();
+  if (pid < 0) { set_error("fork failed"); ::close(lfd); return nullptr; }
+  if (pid == 0) {
+    ::close(lfd);
+    std::vector<std::string> args = {
+        cfg->python_exe, "-m", "paddle_tpu.inference.capi_worker",
+        "--model", cfg->model, "--connect", sock_path,
+        "--device", cfg->device, "--precision", cfg->precision};
+    std::vector<char*> argv;
+    for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+
+  // accept with timeout — worker startup includes importing jax. Poll in
+  // short slices interleaved with waitpid(WNOHANG) so a worker that dies
+  // at startup (bad model, bad interpreter) fails fast with the real
+  // cause instead of burning the whole timeout.
+  pollfd pfd{lfd, POLLIN, 0};
+  int rc = 0;
+  int waited_ms = 0;
+  const int total_ms = cfg->startup_timeout_s * 1000;
+  while (waited_ms < total_ms) {
+    rc = ::poll(&pfd, 1, 250);
+    if (rc != 0) break;  // connected (or poll error)
+    waited_ms += 250;
+    int status = 0;
+    if (waitpid(pid, &status, WNOHANG) == pid) {
+      char msg[128];
+      snprintf(msg, sizeof(msg), "worker exited during startup (status %d)",
+               WIFEXITED(status) ? WEXITSTATUS(status) : -1);
+      set_error(msg);
+      ::close(lfd); unlink(sock_path.c_str()); rmdir(dir_tmpl);
+      return nullptr;
+    }
+  }
+  if (rc <= 0) {
+    set_error("worker did not connect within startup timeout");
+    ::kill(pid, SIGKILL); waitpid(pid, nullptr, 0);
+    ::close(lfd); unlink(sock_path.c_str()); rmdir(dir_tmpl);
+    return nullptr;
+  }
+  int fd = ::accept(lfd, nullptr, nullptr);
+  ::close(lfd);
+  if (fd < 0) {
+    set_error("accept failed");
+    ::kill(pid, SIGKILL); waitpid(pid, nullptr, 0);
+    unlink(sock_path.c_str()); rmdir(dir_tmpl);
+    return nullptr;
+  }
+
+  PD_Predictor* p = new PD_Predictor();
+  p->fd = fd;
+  p->worker = pid;
+  p->sock_dir = dir_tmpl;
+  if (!predictor_meta(p)) { PD_PredictorDestroy(p); return nullptr; }
+  return p;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  if (p->fd >= 0) {
+    Writer w;
+    w.u8(3);
+    if (send_frame(p->fd, w)) {
+      std::vector<char> body;
+      recv_frame(p->fd, &body);  // best-effort: worker acks then exits
+    }
+    ::close(p->fd);
+  }
+  if (p->worker > 0) {
+    int status = 0;
+    for (int i = 0; i < 50; i++) {  // ~5s grace, then SIGKILL
+      if (waitpid(p->worker, &status, WNOHANG) == p->worker) { p->worker = -1; break; }
+      usleep(100000);
+    }
+    if (p->worker > 0) { ::kill(p->worker, SIGKILL); waitpid(p->worker, nullptr, 0); }
+  }
+  if (!p->sock_dir.empty()) {
+    unlink((p->sock_dir + "/predictor.sock").c_str());
+    rmdir(p->sock_dir.c_str());
+  }
+  delete p;
+}
+
+int PD_PredictorGetInputNum(PD_Predictor* p) { return p ? (int)p->input_names.size() : 0; }
+const char* PD_PredictorGetInputName(PD_Predictor* p, int i) {
+  if (!p || i < 0 || i >= (int)p->input_names.size()) return nullptr;
+  return p->input_names[i].c_str();
+}
+int PD_PredictorGetOutputNum(PD_Predictor* p) { return p ? (int)p->output_names.size() : 0; }
+const char* PD_PredictorGetOutputName(PD_Predictor* p, int i) {
+  if (!p || i < 0 || i >= (int)p->output_names.size()) return nullptr;
+  return p->output_names[i].c_str();
+}
+
+int PD_PredictorSetInput(PD_Predictor* p, const char* name, int dtype,
+                         const int64_t* shape, int ndim, const void* data) {
+  if (!p || !name || !data || ndim < 0 || ndim > PD_MAX_DIMS) {
+    set_error("SetInput: bad arguments"); return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (!esz) { set_error("SetInput: unknown dtype"); return -1; }
+  Tensor t;
+  t.name = name;
+  t.dtype = dtype;
+  size_t n = 1;
+  for (int i = 0; i < ndim; i++) { t.shape.push_back(shape[i]); n *= (size_t)shape[i]; }
+  t.data.assign((const char*)data, (const char*)data + n * esz);
+  for (auto& s : p->staged)
+    if (s.name == t.name) { s = std::move(t); return 0; }
+  p->staged.push_back(std::move(t));
+  return 0;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  if (!p || p->fd < 0) { set_error("Run: predictor not live"); return -1; }
+  Writer w;
+  w.u8(2);
+  w.u32((uint32_t)p->staged.size());
+  for (const auto& t : p->staged) {
+    w.str16(t.name);
+    w.u8((uint8_t)t.dtype);
+    w.u8((uint8_t)t.shape.size());
+    for (int64_t d : t.shape) w.i64(d);
+    w.u64(t.data.size());
+    w.raw(t.data.data(), t.data.size());
+  }
+  if (!send_frame(p->fd, w)) { set_error("Run: send failed (worker dead?)"); return -1; }
+  std::vector<char> body;
+  if (!recv_frame(p->fd, &body)) { set_error("Run: recv failed (worker dead?)"); return -1; }
+  Reader r(body);
+  if (r.u8() != 1) {
+    uint32_t n = r.u32();
+    std::string msg(r.p, r.p + std::min((size_t)n, (size_t)(r.end - r.p)));
+    set_error("worker error: " + msg);
+    return -1;
+  }
+  p->outputs.clear();
+  uint32_t n_out = r.u32();
+  for (uint32_t i = 0; i < n_out; i++) {
+    Tensor t;
+    t.name = r.str16();
+    t.dtype = r.u8();
+    uint8_t nd = r.u8();
+    for (uint8_t d = 0; d < nd; d++) t.shape.push_back(r.i64());
+    uint64_t nbytes = r.u64();
+    if ((size_t)(r.end - r.p) < nbytes) { set_error("Run: truncated output"); return -1; }
+    t.data.assign(r.p, r.p + nbytes);
+    r.p += nbytes;
+    p->outputs.push_back(std::move(t));
+  }
+  if (r.fail) { set_error("Run: malformed response"); return -1; }
+  return 0;
+}
+
+int PD_PredictorGetOutput(PD_Predictor* p, const char* name, int* dtype,
+                          int64_t* shape, int* ndim, const void** data) {
+  if (!p || !name) { set_error("GetOutput: bad arguments"); return -1; }
+  for (const auto& t : p->outputs) {
+    if (t.name != name) continue;
+    if (dtype) *dtype = t.dtype;
+    if (ndim) *ndim = (int)t.shape.size();
+    if (shape)
+      for (size_t i = 0; i < t.shape.size() && i < PD_MAX_DIMS; i++) shape[i] = t.shape[i];
+    if (data) *data = t.data.data();
+    return 0;
+  }
+  set_error("GetOutput: no output named '" + std::string(name) + "'");
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""Predictor worker behind the inference C API.
+
+`libpaddle_tpu_c.so` (paddle_tpu/inference/capi) spawns this module with
+--connect pointing at a unix socket the C side listens on, then drives it
+with the framed binary protocol documented in capi/src/paddle_c_api.cc:
+META (input/output names), RUN (tensors in, tensors out), EXIT. One worker
+process == one Predictor == one compiled XLA program; the reference's
+equivalent boundary is the C++ AnalysisPredictor behind
+paddle/fluid/inference/capi_exp.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import struct
+import sys
+
+import numpy as np
+
+_DTYPES = ["float32", "int32", "int64", "float64", "uint8", "bool"]
+
+
+def _recv_all(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", _recv_all(sock, 8))
+    return _recv_all(sock, n)
+
+
+def _send_frame(sock, body: bytes):
+    sock.sendall(struct.pack("<Q", len(body)) + body)
+
+
+def _pack_tensor(name: str, arr: np.ndarray) -> bytes:
+    dt = _DTYPES.index(str(arr.dtype))
+    nb = name.encode()
+    raw = np.ascontiguousarray(arr).tobytes()
+    return (struct.pack("<H", len(nb)) + nb
+            + struct.pack("<BB", dt, arr.ndim)
+            + struct.pack(f"<{arr.ndim}q", *arr.shape)
+            + struct.pack("<Q", len(raw)) + raw)
+
+
+def _unpack_tensors(body: bytes, off: int, count: int):
+    out = []
+    for _ in range(count):
+        (nlen,) = struct.unpack_from("<H", body, off)
+        off += 2
+        name = body[off:off + nlen].decode()
+        off += nlen
+        dt, nd = struct.unpack_from("<BB", body, off)
+        off += 2
+        shape = struct.unpack_from(f"<{nd}q", body, off)
+        off += 8 * nd
+        (nbytes,) = struct.unpack_from("<Q", body, off)
+        off += 8
+        arr = np.frombuffer(body[off:off + nbytes],
+                            dtype=_DTYPES[dt]).reshape(shape)
+        off += nbytes
+        out.append((name, arr))
+    return out, off
+
+
+def _err(msg: str) -> bytes:
+    eb = msg.encode()[:65000]
+    return struct.pack("<B", 0) + struct.pack("<I", len(eb)) + eb
+
+
+def serve(sock, predictor) -> None:
+    feed = predictor.get_input_names()
+    fetch = predictor.get_output_names()
+    while True:
+        body = _recv_frame(sock)
+        op = body[0]
+        if op == 1:  # META
+            resp = [struct.pack("<B", 1), struct.pack("<I", len(feed))]
+            for n in feed:
+                nb = n.encode()
+                resp.append(struct.pack("<H", len(nb)) + nb)
+            resp.append(struct.pack("<I", len(fetch)))
+            for n in fetch:
+                nb = n.encode()
+                resp.append(struct.pack("<H", len(nb)) + nb)
+            _send_frame(sock, b"".join(resp))
+        elif op == 2:  # RUN
+            try:
+                (count,) = struct.unpack_from("<I", body, 1)
+                tensors, _ = _unpack_tensors(body, 5, count)
+                for name, arr in tensors:
+                    predictor.get_input_handle(name).copy_from_cpu(arr)
+                predictor.run()
+                resp = [struct.pack("<B", 1), struct.pack("<I", len(fetch))]
+                for name in fetch:
+                    out = predictor.get_output_handle(name).copy_to_cpu()
+                    out = np.asarray(out)
+                    if str(out.dtype) not in _DTYPES:  # e.g. bfloat16 deploy
+                        out = out.astype("float32")
+                    resp.append(_pack_tensor(name, out))
+                _send_frame(sock, b"".join(resp))
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                _send_frame(sock, _err(f"{type(e).__name__}: {e}"))
+        elif op == 3:  # EXIT
+            _send_frame(sock, struct.pack("<B", 1))
+            return
+        else:
+            _send_frame(sock, _err(f"unknown op {op}"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--connect", required=True)
+    ap.add_argument("--device", default="tpu")
+    ap.add_argument("--precision", default="float32")
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from paddle_tpu import inference
+
+    cfg = inference.Config(args.model)
+    if args.device == "cpu":
+        cfg.disable_gpu()
+    else:
+        cfg.enable_tpu(precision=args.precision)
+    predictor = inference.create_predictor(cfg)
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.connect)
+    try:
+        serve(sock, predictor)
+    finally:
+        sock.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

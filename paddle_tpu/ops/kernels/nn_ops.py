@@ -367,24 +367,70 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     return summed / kernel[0]
 
 
+def _adaptive_windows(in_size: int, out_size: int):
+    """Paddle/torch adaptive-pool window for output cell i:
+    [floor(i*in/out), ceil((i+1)*in/out))."""
+    idx = np.arange(out_size)
+    starts = (idx * in_size) // out_size
+    ends = -((-(idx + 1) * in_size) // out_size)  # ceil div
+    return starts, ends
+
+
+def _adaptive_avg_matrix(in_size: int, out_size: int, dtype):
+    """[out, in] row-stochastic interval matrix; pooling becomes a matmul
+    (einsum below), which XLA tiles onto the MXU — the TPU-friendly form of
+    a variable-window pool."""
+    starts, ends = _adaptive_windows(in_size, out_size)
+    a = np.zeros((out_size, in_size), np.float32)
+    for r in range(out_size):
+        a[r, starts[r]:ends[r]] = 1.0 / (ends[r] - starts[r])
+    return jnp.asarray(a, dtype=dtype)
+
+
+def _adaptive_mask(in_size: int, out_size: int):
+    starts, ends = _adaptive_windows(in_size, out_size)
+    cols = np.arange(in_size)
+    return jnp.asarray((cols >= starts[:, None]) & (cols < ends[:, None]))
+
+
 @register_op
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     out = _pair(output_size, 2)
     if data_format == "NCHW":
         N, C, H, W = x.shape
-        x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
-        return x5.mean(axis=(3, 5))
+        if H % out[0] == 0 and W % out[1] == 0:  # uniform-window fast path
+            x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
+            return x5.mean(axis=(3, 5))
+        ah = _adaptive_avg_matrix(H, out[0], x.dtype)
+        aw = _adaptive_avg_matrix(W, out[1], x.dtype)
+        # highest precision: these matmuls implement an exact window
+        # average; default bf16 MXU passes cost ~3 decimal digits
+        return jnp.einsum("nchw,oh,pw->ncop", x, ah, aw,
+                          precision="highest")
     N, H, W, C = x.shape
-    x5 = x.reshape(N, out[0], H // out[0], out[1], W // out[1], C)
-    return x5.mean(axis=(2, 4))
+    if H % out[0] == 0 and W % out[1] == 0:
+        x5 = x.reshape(N, out[0], H // out[0], out[1], W // out[1], C)
+        return x5.mean(axis=(2, 4))
+    ah = _adaptive_avg_matrix(H, out[0], x.dtype)
+    aw = _adaptive_avg_matrix(W, out[1], x.dtype)
+    return jnp.einsum("nhwc,oh,pw->nopc", x, ah, aw, precision="highest")
 
 
 @register_op
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     out = _pair(output_size, 2)
     N, C, H, W = x.shape
-    x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
-    return x5.max(axis=(3, 5))
+    if H % out[0] == 0 and W % out[1] == 0:
+        x5 = x.reshape(N, C, out[0], H // out[0], out[1], W // out[1])
+        return x5.max(axis=(3, 5))
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    mh = _adaptive_mask(H, out[0])          # [O, H]
+    xh = jnp.where(mh[None, None, :, :, None], x[:, :, None, :, :], neg)
+    xh = xh.max(axis=3)                     # [N, C, O, W]
+    mw = _adaptive_mask(W, out[1])          # [P, W]
+    xw = jnp.where(mw[None, None, None, :, :], xh[:, :, :, None, :], neg)
+    return xw.max(axis=4)                   # [N, C, O, P]
 
 
 # ---- normalization ---------------------------------------------------------

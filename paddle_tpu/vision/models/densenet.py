@@ -1,0 +1,115 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py).
+
+Dense blocks concatenate every preceding feature map; transitions halve
+channels and spatial dims. BN-ReLU-Conv ordering per the paper.
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+# depth -> per-block layer counts (growth_rate 32 except 161's 48)
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_ch)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_ch, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(nn.Sequential):
+    def __init__(self, num_layers, in_ch, growth_rate, bn_size, dropout):
+        super().__init__(*[
+            DenseLayer(in_ch + i * growth_rate, growth_rate, bn_size,
+                       dropout)
+            for i in range(num_layers)
+        ])
+
+
+class Transition(nn.Sequential):
+    def __init__(self, in_ch, out_ch):
+        super().__init__(
+            nn.BatchNorm2D(in_ch),
+            nn.ReLU(),
+            nn.Conv2D(in_ch, out_ch, 1, bias_attr=False),
+            nn.AvgPool2D(2, stride=2),
+        )
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"unsupported DenseNet depth {layers!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        init_ch, growth_rate, block_cfg = _CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_ch),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        blocks = []
+        ch = init_ch
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(n, ch, growth_rate, bn_size, dropout))
+            ch += n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(ch, ch // 2))
+                ch //= 2
+        blocks += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)

@@ -1,0 +1,96 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/googlenet.py).
+
+Four-branch inception modules. Like the paddle API, forward returns
+(out, aux1, aux2) — the two auxiliary classifier heads used for deep
+supervision during training.
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        relu = nn.ReLU
+        self.branch1 = nn.Sequential(nn.Conv2D(in_ch, c1, 1), relu())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_ch, c3r, 1), relu(),
+            nn.Conv2D(c3r, c3, 3, padding=1), relu())
+        self.branch3 = nn.Sequential(
+            nn.Conv2D(in_ch, c5r, 1), relu(),
+            nn.Conv2D(c5r, c5, 5, padding=2), relu())
+        self.branch4 = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(in_ch, proj, 1), relu())
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class AuxHead(nn.Layer):
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((4, 4))
+        self.conv = nn.Conv2D(in_ch, 128, 1)
+        self.relu = nn.ReLU()
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024)
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.conv(self.pool(x)))
+        x = self.relu(self.fc1(x.flatten(1)))
+        return self.fc2(self.dropout(x))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        relu = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), relu(),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            nn.Conv2D(64, 64, 1), relu(),
+            nn.Conv2D(64, 192, 3, padding=1), relu(),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = AuxHead(512, num_classes)
+            self.aux2 = AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.pool3(self.inc3b(self.inc3a(self.stem(x))))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

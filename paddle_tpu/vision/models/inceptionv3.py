@@ -1,0 +1,159 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py).
+
+Factorized inception modules (A-E) with the 299x299 stem. All branches are
+conv+BN+ReLU so each module fuses into a handful of XLA convolutions.
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+
+class ConvBN(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU(),
+        )
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_ch):
+        super().__init__()
+        self.b1 = ConvBN(in_ch, 64, 1)
+        self.b5 = nn.Sequential(ConvBN(in_ch, 48, 1),
+                                ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBN(in_ch, 64, 1),
+                                ConvBN(64, 96, 3, padding=1),
+                                ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(in_ch, pool_ch, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class ReductionA(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = ConvBN(in_ch, 384, 3, stride=2)
+        self.b3d = nn.Sequential(ConvBN(in_ch, 64, 1),
+                                 ConvBN(64, 96, 3, padding=1),
+                                 ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionB(nn.Layer):
+    """7x1/1x7 factorized module."""
+
+    def __init__(self, in_ch, mid):
+        super().__init__()
+        self.b1 = ConvBN(in_ch, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBN(in_ch, mid, 1),
+            ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            ConvBN(mid, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            ConvBN(in_ch, mid, 1),
+            ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            ConvBN(mid, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(in_ch, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class ReductionB(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBN(in_ch, 192, 1),
+                                ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBN(in_ch, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    """Expanded 3x3 module with split 1x3/3x1 branches."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = ConvBN(in_ch, 320, 1)
+        self.b3_stem = ConvBN(in_ch, 384, 1)
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(ConvBN(in_ch, 448, 1),
+                                      ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       self.b3_a(s), self.b3_b(s),
+                       self.b3d_a(d), self.b3d_b(d),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBN(3, 32, 3, stride=2),
+            ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBN(64, 80, 1),
+            ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32),
+            InceptionA(256, 64),
+            InceptionA(288, 64),
+            ReductionA(288),
+            InceptionB(768, 128),
+            InceptionB(768, 160),
+            InceptionB(768, 160),
+            InceptionB(768, 192),
+            ReductionB(768),
+            InceptionC(1280),
+            InceptionC(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,56 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py).
+
+Depthwise-separable stacks: 3x3 depthwise (groups=channels) + 1x1 pointwise,
+each followed by BN+ReLU. Depthwise convs lower to XLA grouped convolutions.
+"""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenet import ConvBNReLU
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.depthwise = ConvBNReLU(in_ch, in_ch, 3, stride=stride,
+                                    groups=in_ch)
+        self.pointwise = ConvBNReLU(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    # (out_channels, stride) per depthwise-separable block at scale=1.0
+    _CFG = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = int(32 * scale)
+        blocks = [ConvBNReLU(3, in_ch, 3, stride=2)]
+        for out, stride in self._CFG:
+            out_ch = int(out * scale)
+            blocks.append(DepthwiseSeparable(in_ch, out_ch, stride))
+            in_ch = out_ch
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,148 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/mobilenetv3.py).
+
+Inverted residuals with optional squeeze-excitation and hardswish
+activations. SE reductions are 1x1 convs so the whole block stays one fused
+XLA region.
+"""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenet import _make_divisible
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1, act=None):
+        layers = [
+            nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, kernel, stride, use_se,
+                 use_hs):
+        super().__init__()
+        act = nn.Hardswish if use_hs else nn.ReLU
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp_ch != in_ch:
+            layers.append(ConvBNAct(in_ch, exp_ch, 1, act=act))
+        layers.append(ConvBNAct(exp_ch, exp_ch, kernel, stride=stride,
+                                groups=exp_ch, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_ch,
+                                            _make_divisible(exp_ch // 4)))
+        layers.append(ConvBNAct(exp_ch, out_ch, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, use_hs, stride)
+_LARGE = [
+    (3, 16, 16, False, False, 1),
+    (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1),
+    (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1),
+    (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2),
+    (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1),
+    (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2),
+    (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+_SMALL = [
+    (3, 16, 16, True, False, 2),
+    (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1),
+    (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1),
+    (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1),
+    (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2),
+    (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        blocks = [ConvBNAct(3, in_ch, 3, stride=2, act=nn.Hardswish)]
+        for k, exp, out, se, hs, s in config:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            blocks.append(InvertedResidualV3(in_ch, exp_ch, out_ch, k, s,
+                                             se, hs))
+            in_ch = out_ch
+        last_conv = _make_divisible(6 * in_ch)
+        blocks.append(ConvBNAct(in_ch, last_conv, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,148 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py).
+
+Channel-split residual units with a channel shuffle between branches. The
+shuffle is a reshape/transpose pair that XLA lowers to a layout change.
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+def _act_layer(act):
+    try:
+        return {"relu": nn.ReLU, "swish": nn.Swish}[act]
+    except KeyError:
+        raise ValueError(f"unsupported ShuffleNetV2 activation {act!r}")
+
+
+class InvertedResidualUnit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        act_cls = _act_layer(act)
+        branch_ch = out_ch // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch),
+                act_cls(),
+            )
+            b2_in = in_ch
+        else:
+            b2_in = in_ch // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            act_cls(),
+            nn.Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                      groups=branch_ch, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            act_cls(),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_REPEATS = [4, 8, 4]
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        outs = _STAGE_OUT[scale]
+        act_cls = _act_layer(act)
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, outs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(outs[0]),
+            act_cls(),
+        )
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_ch = outs[0]
+        for repeats, out_ch in zip(_STAGE_REPEATS, outs[1:4]):
+            units = [InvertedResidualUnit(in_ch, out_ch, 2, act)]
+            units += [InvertedResidualUnit(out_ch, out_ch, 1, act)
+                      for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            in_ch = out_ch
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, outs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(outs[-1]),
+            act_cls(),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

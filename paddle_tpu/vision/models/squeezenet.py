@@ -1,0 +1,87 @@
+"""SqueezeNet 1.0 / 1.1 (reference: python/paddle/vision/models/squeezenet.py).
+
+Fire modules: 1x1 squeeze then concatenated 1x1/3x3 expands. The final
+classifier is a 1x1 conv + global average pool (no fc), as published.
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+
+class Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze_ch, expand1x1_ch, expand3x3_ch):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze_ch, 1)
+        self.expand1x1 = nn.Conv2D(squeeze_ch, expand1x1_ch, 1)
+        self.expand3x3 = nn.Conv2D(squeeze_ch, expand3x3_ch, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1x1(s)),
+                       self.relu(self.expand3x3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2),
+                nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(96, 16, 64, 64),
+                Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(256, 32, 128, 128),
+                Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2),
+                nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(64, 16, 64, 64),
+                Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(128, 32, 128, 128),
+                Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256),
+                Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version!r}")
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5),
+                nn.Conv2D(512, num_classes, 1),
+                nn.ReLU(),
+            )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x).flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.1", **kwargs)

@@ -1,0 +1,147 @@
+"""Inference C API end-to-end tests.
+
+Builds libpaddle_tpu_c.so (g++, cached) and drives it exactly the way a C
+deployment client would — via the C ABI declared in
+paddle_tpu/inference/capi/paddle_c_api.h — against a jit-saved model. The
+ctypes layer here stands in for the C consumer; the worker process, socket
+protocol, and output-ownership contract are all exercised for real.
+Reference analog: paddle/fluid/inference/capi_exp (C API over
+AnalysisPredictor).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    from paddle_tpu.inference import capi as capi_mod
+
+    os.environ["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    return capi_mod.load()
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m.eval()
+    path = str(tmp_path_factory.mktemp("capi") / "inference" / "model")
+    paddle.jit.save(m, path,
+                    input_spec=[paddle.static.InputSpec([1, 8], "float32")])
+    return m, path + ".pdmodel"
+
+
+def _make_predictor(capi, model_file):
+    cfg = capi.PD_ConfigCreate()
+    capi.PD_ConfigSetModel(cfg, model_file.encode())
+    capi.PD_ConfigSetDevice(cfg, b"cpu")
+    capi.PD_ConfigSetPythonExe(cfg, sys.executable.encode())
+    capi.PD_ConfigSetStartupTimeout(cfg, 300)
+    pred = capi.PD_PredictorCreate(cfg)
+    capi.PD_ConfigDestroy(cfg)
+    return pred
+
+
+def _run_once(capi, pred, name, x):
+    shape = (ctypes.c_int64 * x.ndim)(*x.shape)
+    rc = capi.PD_PredictorSetInput(
+        pred, name, 0, shape, x.ndim,
+        x.ctypes.data_as(ctypes.c_void_p))
+    assert rc == 0
+    assert capi.PD_PredictorRun(pred) == 0, capi.PD_GetLastError()
+
+
+def _fetch(capi, pred, name):
+    dtype = ctypes.c_int()
+    ndim = ctypes.c_int()
+    shape = (ctypes.c_int64 * 16)()
+    data = ctypes.c_void_p()
+    rc = capi.PD_PredictorGetOutput(pred, name, ctypes.byref(dtype), shape,
+                                    ctypes.byref(ndim), ctypes.byref(data))
+    assert rc == 0, capi.PD_GetLastError()
+    dims = [shape[i] for i in range(ndim.value)]
+    n = int(np.prod(dims)) if dims else 1
+    from paddle_tpu.inference.capi import ENUM_TO_DTYPE
+
+    np_dtype = ENUM_TO_DTYPE[dtype.value]
+    buf = ctypes.cast(
+        data, ctypes.POINTER(ctypes.c_char * (n * np.dtype(np_dtype).itemsize)))
+    return np.frombuffer(buf.contents, dtype=np_dtype).reshape(dims).copy()
+
+
+class TestCApiEndToEnd:
+    def test_full_lifecycle_matches_in_process(self, capi, saved_model):
+        m, model_file = saved_model
+        pred = _make_predictor(capi, model_file)
+        assert pred, capi.PD_GetLastError()
+        try:
+            n_in = capi.PD_PredictorGetInputNum(pred)
+            n_out = capi.PD_PredictorGetOutputNum(pred)
+            assert n_in >= 1 and n_out >= 1
+            in_name = capi.PD_PredictorGetInputName(pred, 0)
+            out_name = capi.PD_PredictorGetOutputName(pred, 0)
+
+            rs = np.random.RandomState(0)
+            x = rs.normal(size=(1, 8)).astype(np.float32)
+            _run_once(capi, pred, in_name, x)
+            got = _fetch(capi, pred, out_name)
+            ref = m(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+            # second run through the SAME worker: new inputs, new outputs
+            x2 = rs.normal(size=(1, 8)).astype(np.float32)
+            _run_once(capi, pred, in_name, x2)
+            got2 = _fetch(capi, pred, out_name)
+            ref2 = m(paddle.to_tensor(x2)).numpy()
+            np.testing.assert_allclose(got2, ref2, rtol=1e-4, atol=1e-5)
+            assert not np.allclose(got, got2)
+        finally:
+            capi.PD_PredictorDestroy(pred)
+
+    def test_bad_output_name_reports_error(self, capi, saved_model):
+        _, model_file = saved_model
+        pred = _make_predictor(capi, model_file)
+        assert pred, capi.PD_GetLastError()
+        try:
+            in_name = capi.PD_PredictorGetInputName(pred, 0)
+            x = np.zeros((1, 8), np.float32)
+            _run_once(capi, pred, in_name, x)
+            dtype = ctypes.c_int()
+            ndim = ctypes.c_int()
+            shape = (ctypes.c_int64 * 16)()
+            data = ctypes.c_void_p()
+            rc = capi.PD_PredictorGetOutput(
+                pred, b"no_such_output", ctypes.byref(dtype), shape,
+                ctypes.byref(ndim), ctypes.byref(data))
+            assert rc != 0
+            assert b"no_such_output" in capi.PD_GetLastError()
+        finally:
+            capi.PD_PredictorDestroy(pred)
+
+    def test_create_with_missing_model_fails(self, capi, tmp_path):
+        cfg = capi.PD_ConfigCreate()
+        capi.PD_ConfigSetModel(cfg, str(tmp_path / "nope.pdmodel").encode())
+        capi.PD_ConfigSetDevice(cfg, b"cpu")
+        capi.PD_ConfigSetPythonExe(cfg, sys.executable.encode())
+        capi.PD_ConfigSetStartupTimeout(cfg, 60)
+        pred = capi.PD_PredictorCreate(cfg)
+        capi.PD_ConfigDestroy(cfg)
+        assert not pred
+        assert capi.PD_GetLastError()
+
+    def test_version_string(self, capi):
+        assert b"paddle_tpu" in capi.PD_GetVersion()

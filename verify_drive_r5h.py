@@ -1,0 +1,96 @@
+"""Verify drive (round 5, session 3): vision-zoo additions + adaptive-pool
+general windows + inference C API, all through the public package surface.
+
+Run: cd /root/repo && python verify_drive_r5h.py
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import ctypes  # noqa: E402
+import sys  # noqa: E402
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.vision import models as M  # noqa: E402
+
+t0 = time.time()
+rs = np.random.RandomState(0)
+
+
+def check(name, ok):
+    print(f"[{time.time() - t0:6.1f}s] {'PASS' if ok else 'FAIL'}  {name}")
+    if not ok:
+        sys.exit(1)
+
+
+# 1. adaptive pool, non-divisible windows, vs an explicit window average
+x = rs.randn(2, 3, 14, 9).astype(np.float32)
+got = paddle.nn.functional.adaptive_avg_pool2d(paddle.to_tensor(x), (4, 4)).numpy()
+ref = np.zeros((2, 3, 4, 4), np.float32)
+for i in range(4):
+    for j in range(4):
+        hs, he = (i * 14) // 4, -((-(i + 1) * 14) // 4)
+        ws, we = (j * 9) // 4, -((-(j + 1) * 9) // 4)
+        ref[:, :, i, j] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+check("adaptive_avg_pool2d non-divisible windows",
+      np.allclose(got, ref, rtol=1e-5, atol=1e-6))
+
+# 2. new zoo model trains: MobileNetV3-small classifier, loss decreases
+model = M.mobilenet_v3_small(scale=0.5, num_classes=10)
+model.train()
+opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+xb = paddle.to_tensor(rs.randn(4, 3, 64, 64).astype(np.float32))
+yb = paddle.to_tensor(rs.randint(0, 10, (4,)))
+losses = []
+for _ in range(5):
+    loss = paddle.nn.functional.cross_entropy(model(xb), yb)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss.numpy()))
+check(f"mobilenet_v3_small trains ({losses[0]:.3f} -> {losses[-1]:.3f})",
+      losses[-1] < losses[0])
+
+# 3. to_static parity on a zoo model (squeezenet 1.1)
+sq = M.squeezenet1_1(num_classes=7)
+sq.eval()
+xs = paddle.to_tensor(rs.randn(1, 3, 96, 96).astype(np.float32))
+eager = sq(xs).numpy()
+static = paddle.jit.to_static(sq)(xs).numpy()
+check("squeezenet1_1 to_static == eager",
+      np.allclose(eager, static, rtol=1e-4, atol=1e-5))
+
+# 4. googlenet aux heads (the case that needed general adaptive windows)
+g = M.googlenet(num_classes=5)
+g.eval()
+out, a1, a2 = g(paddle.to_tensor(rs.randn(1, 3, 224, 224).astype(np.float32)))
+check("googlenet forward w/ aux heads",
+      out.shape == [1, 5] and a1.shape == [1, 5] and a2.shape == [1, 5]
+      and np.isfinite(out.numpy()).all())
+
+# 5. C API: version + fast-fail on a missing model (no 60s stall)
+from paddle_tpu.inference import capi  # noqa: E402
+
+lib = capi.load()
+check("C API version", b"paddle_tpu" in lib.PD_GetVersion())
+cfg = lib.PD_ConfigCreate()
+lib.PD_ConfigSetModel(cfg, b"/tmp/definitely_missing.pdmodel")
+lib.PD_ConfigSetDevice(cfg, b"cpu")
+lib.PD_ConfigSetPythonExe(cfg, sys.executable.encode())
+lib.PD_ConfigSetStartupTimeout(cfg, 120)
+t_create = time.time()
+pred = lib.PD_PredictorCreate(cfg)
+elapsed = time.time() - t_create
+lib.PD_ConfigDestroy(cfg)
+check(f"C API fast-fail on bad model ({elapsed:.1f}s)",
+      (not pred) and elapsed < 60 and b"worker" in lib.PD_GetLastError())
+
+print(f"ALL PASS in {time.time() - t0:.1f}s")
