@@ -501,13 +501,33 @@ def bench_llama_decode():
     jax.block_until_ready(seq)
     dt = time.perf_counter() - t0
     tps = B * new / dt
+    details = {"batch": B, "prompt": T, "new_tokens": new,
+               "ms_per_token": round(1e3 * dt / new, 3),
+               "weights": str(np.dtype(weight_dtype).name)
+               if weight_dtype is not None else "param_dtype",
+               "decode_loop": "on-device scan, 32 tokens/dispatch"}
+    if _on_tpu():
+        # serving-throughput point: decode is HBM-bandwidth-bound (one full
+        # bf16 weight read per step), so a bigger batch amortizes the read
+        # over more sequences — report B=32 alongside the pinned B=8 config
+        try:
+            B2 = 32
+            prompt2 = jnp.tile(prompt, (B2 // B, 1))
+            seq = pred.generate(prompt2, max_new_tokens=warm_new)
+            jax.block_until_ready(seq)
+            t0 = time.perf_counter()
+            seq = pred.generate(prompt2, max_new_tokens=new)
+            jax.block_until_ready(seq)
+            dt2 = time.perf_counter() - t0
+            details["throughput_b32"] = {
+                "decode_tokens_per_s": round(B2 * new / dt2, 2),
+                "ms_per_step": round(1e3 * dt2 / new, 3)}
+        except Exception as e:  # noqa: BLE001 — extra evidence, never fatal
+            details["throughput_b32"] = {"error": f"{type(e).__name__}: "
+                                                  f"{str(e)[:160]}"}
     return {
         "value": round(tps, 2), "unit": "decode_tokens/s/chip",
-        "details": {"batch": B, "prompt": T, "new_tokens": new,
-                    "ms_per_token": round(1e3 * dt / new, 3),
-                    "weights": str(np.dtype(weight_dtype).name)
-                    if weight_dtype is not None else "param_dtype",
-                    "decode_loop": "on-device scan, 32 tokens/dispatch"},
+        "details": details,
     }
 
 

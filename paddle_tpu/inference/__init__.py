@@ -22,9 +22,11 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "LLMPredictor", "init_cache"]
+           "PlaceType", "LLMPredictor", "init_cache", "ServingEngine",
+           "Request", "Completion"]
 
 from .llm import LLMPredictor, init_cache  # noqa: E402,F401
+from .serving import Completion, Request, ServingEngine  # noqa: E402,F401
 
 
 class PrecisionType:
