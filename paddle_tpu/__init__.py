@@ -33,6 +33,8 @@ from .core.dtype import (  # noqa: F401
     bool_,
     complex64,
     complex128,
+    float8_e4m3fn,
+    float8_e5m2,
     float16,
     float32,
     float64,
@@ -44,8 +46,11 @@ from .core.dtype import (  # noqa: F401
     set_default_dtype,
     uint8,
 )
+from .core.dtype import DType as dtype  # noqa: F401
 from .core.place import (  # noqa: F401
     CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
     Place,
     TPUPlace,
     get_device,
@@ -56,6 +61,10 @@ from .core.place import (  # noqa: F401
 )
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.rng import get_rng_state as get_cuda_rng_state  # noqa: F401
+from .core.rng import set_rng_state as set_cuda_rng_state  # noqa: F401
+
+bool = bool_  # noqa: A001 — paddle.bool is the dtype, as in the reference
 
 
 def __getattr__(name):
@@ -191,5 +200,17 @@ from . import framework  # noqa: F401, E402
 from .framework.io_api import load, save  # noqa: F401, E402
 from .hapi.model import Model  # noqa: F401, E402
 from . import hapi  # noqa: F401, E402
+
+# Reference __all__ parity tail: compositions/aliases that aren't phi ops
+# (numpy-style stacks/splits, predicates, in-place functional spellings,
+# dlpack, utilities) — see tensor/compat_ext.py.
+from .tensor import compat_ext as _compat_ext  # noqa: E402
+
+for _name in _compat_ext.__all__:
+    if _name not in _globals:
+        _globals[_name] = getattr(_compat_ext, _name)
+del _name
+from .hapi.summary import flops, summary  # noqa: F401, E402
+from .nn import ParamAttr  # noqa: F401, E402
 
 __version__ = "0.1.0"

@@ -49,12 +49,12 @@ class DType:
             n = name
         else:
             n = np.dtype(name).name  # handles np dtypes, python types
-        if n == "bfloat16":
-            return "bfloat16"
-        if n not in _CANONICAL and n not in ("bfloat16",):
+        if n in _EXTENDED:
+            return n
+        if n not in _CANONICAL:
             # things like 'float' / 'int'
             n = np.dtype(n).name
-        if n not in _CANONICAL and n != "bfloat16":
+        if n not in _CANONICAL and n not in _EXTENDED:
             raise TypeError(f"Unsupported dtype: {name!r}")
         return n
 
@@ -88,7 +88,8 @@ class DType:
 
     @property
     def is_floating_point(self):
-        return self.name in ("float16", "bfloat16", "float32", "float64")
+        return self.name in ("float16", "bfloat16", "float32", "float64",
+                             "float8_e4m3fn", "float8_e5m2")
 
     @property
     def is_complex(self):
@@ -103,11 +104,16 @@ class DType:
         return self.np_dtype.itemsize
 
 
+# ml_dtypes-backed names (TPU low-precision family; fp8 feeds the fp8 gemm
+# kernels registered in ops/kernels/tail_r5d.py)
+_EXTENDED = ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
 def _np_for(name: str):
-    if name == "bfloat16":
+    if name in _EXTENDED:
         import ml_dtypes
 
-        return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(getattr(ml_dtypes, name))
     return _CANONICAL[name]
 
 
@@ -124,6 +130,8 @@ float32 = DType("float32")
 float64 = DType("float64")
 complex64 = DType("complex64")
 complex128 = DType("complex128")
+float8_e4m3fn = DType("float8_e4m3fn")
+float8_e5m2 = DType("float8_e5m2")
 
 _DEFAULT_DTYPE = float32
 

@@ -35,9 +35,12 @@ class Place:
     def is_tpu_place(self):
         return self.device_type == "tpu"
 
-    # reference-API compat
+    # reference-API compat: code written for GPU targets the accelerator
+    # here (Place("gpu", i) normalizes to tpu), so a "gpu place" question
+    # means "is this the accelerator" — must answer True or ported code
+    # silently takes its CPU fallback branch.
     def is_gpu_place(self):
-        return False
+        return self.device_type == "tpu"
 
     def __repr__(self):
         return f"Place({self.device_type}:{self.device_id})"
@@ -64,6 +67,18 @@ def CPUPlace():
 
 def TPUPlace(device_id: int = 0):
     return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0):
+    """Reference-compat: code written for GPU runs on the accelerator
+    (Place("gpu", i) already normalizes to the tpu device)."""
+    return Place("gpu", device_id)
+
+
+def CUDAPinnedPlace():
+    """Reference-compat: pinned host staging memory maps to plain host
+    memory (PJRT handles the staging buffers)."""
+    return Place("cpu", 0)
 
 
 _state = threading.local()

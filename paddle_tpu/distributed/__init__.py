@@ -67,6 +67,10 @@ def __getattr__(name):
     # Lazy submodule access: paddle.distributed.fleet / auto_parallel / etc.
     import importlib
 
+    if name == "stream":
+        mod = importlib.import_module(".communication.stream", __name__)
+        globals()[name] = mod
+        return mod
     if name in ("fleet", "auto_parallel", "checkpoint", "launch", "sharding",
                 "parallel", "hybrid", "rpc", "utils", "communication"):
         try:

@@ -551,7 +551,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = group or _get_or_init_default()
-    if isinstance(in_tensor_list, (list, tuple)):
+    was_list = isinstance(in_tensor_list, (list, tuple))
+    if was_list:
         x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
     else:
         x = _unwrap(in_tensor_list)
@@ -559,8 +560,12 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if out_tensor_list is not None and isinstance(out_tensor_list, list):
         del out_tensor_list[:]
         n = g.nranks
-        chunk = out.shape[0] // n if out.shape[0] % n == 0 else out.shape[0]
-        if chunk and out.shape[0] == n * chunk:
+        if was_list and out.shape[0] == n:
+            # list-in/list-out contract: out[i] has in_tensor_list[i]'s shape
+            for i in range(n):
+                out_tensor_list.append(Tensor(out[i]))
+        elif out.shape[0] % n == 0 and out.shape[0]:
+            chunk = out.shape[0] // n
             for i in range(n):
                 out_tensor_list.append(Tensor(out[i * chunk:(i + 1) * chunk]))
         else:

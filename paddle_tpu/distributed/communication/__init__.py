@@ -1,0 +1,29 @@
+"""paddle.distributed.communication parity package.
+
+Reference: python/paddle/distributed/communication/ — the op-wrapper layer
+(all_reduce/all_gather/…) plus the low-level `stream` variants. Here the
+top-level wrappers already live in `paddle_tpu.distributed.collective`;
+this package re-exports them under the reference's module path and adds
+the `stream` namespace.
+"""
+from ..collective import (  # noqa: F401
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    irecv,
+    isend,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from . import stream  # noqa: E402,F401

@@ -1,0 +1,107 @@
+"""Low-level `paddle.distributed.stream` collective variants.
+
+Reference: python/paddle/distributed/communication/stream/*.py — the same
+collectives as the top-level API plus `sync_op` / `use_calc_stream` knobs
+controlling whether the op runs on the communication stream and whether
+the caller waits.
+
+TPU-native meaning: PJRT has no user-visible stream split — dispatch is
+always async and ordering is program order, so `use_calc_stream=True`
+(reference semantics: run inline on the compute stream, no Task) maps to
+"wait for the result before returning" and `sync_op` keeps its usual
+meaning. Every function returns the Task handle (or None when
+use_calc_stream=True, matching the reference's contract that inline ops
+yield no task).
+"""
+from __future__ import annotations
+
+from .. import collective as C
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "alltoall",
+           "alltoall_single", "broadcast", "reduce", "scatter", "send",
+           "recv"]
+
+
+def _finish(task, sync_op: bool, use_calc_stream: bool):
+    # In traced (inside-jit) mode the collectives return the result array
+    # rather than a Task — pass it through untouched.
+    waitable = hasattr(task, "wait")
+    if use_calc_stream:
+        if waitable:
+            task.wait()
+            return None
+        return task
+    if sync_op and waitable:
+        task.wait()
+    return task
+
+
+def all_reduce(tensor, op=C.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _finish(C.all_reduce(tensor, op=op, group=group, sync_op=False),
+                   sync_op, use_calc_stream)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    if isinstance(tensor_or_tensor_list, list):
+        task = C.all_gather(tensor_or_tensor_list, tensor, group=group,
+                            sync_op=False)
+    else:
+        task = C.all_gather_into_tensor(tensor_or_tensor_list, tensor,
+                                        group=group, sync_op=False)
+    return _finish(task, sync_op, use_calc_stream)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=C.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    task = C.reduce_scatter(tensor, tensor_or_tensor_list, op=op,
+                            group=group, sync_op=False)
+    return _finish(task, sync_op, use_calc_stream)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    task = C.alltoall(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=False)
+    return _finish(task, sync_op, use_calc_stream)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    task = C.alltoall_single(out_tensor, in_tensor,
+                             in_split_sizes=in_split_sizes,
+                             out_split_sizes=out_split_sizes, group=group,
+                             sync_op=False)
+    return _finish(task, sync_op, use_calc_stream)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _finish(C.broadcast(tensor, src=src, group=group, sync_op=False),
+                   sync_op, use_calc_stream)
+
+
+def reduce(tensor, dst=0, op=C.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _finish(C.reduce(tensor, dst=dst, op=op, group=group,
+                            sync_op=False),
+                   sync_op, use_calc_stream)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    task = C.scatter(tensor, tensor_list=tensor_or_tensor_list, src=src,
+                     group=group, sync_op=False)
+    return _finish(task, sync_op, use_calc_stream)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _finish(C.send(tensor, dst=dst, group=group, sync_op=False),
+                   sync_op, use_calc_stream)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _finish(C.recv(tensor, src=src, group=group, sync_op=False),
+                   sync_op, use_calc_stream)

@@ -1,0 +1,158 @@
+"""paddle.summary / paddle.flops (reference: python/paddle/hapi/
+model_summary.py:41 and dynamic_flops.py:40).
+
+Both run one forward pass with forward-post hooks collecting per-layer
+output shapes / parameter counts / FLOP estimates, then print a table and
+return the totals. FLOP rules cover the layers that dominate real models
+(conv, linear, matmul-free elementwise ignored) like the reference's
+register_hooks table.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _make_input(input_size, dtype):
+    import paddle_tpu as paddle
+
+    if isinstance(input_size, Tensor):
+        return input_size
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], (list, tuple)):
+        return [_make_input(s, dtype) for s in input_size]
+    shape = [1 if (d is None or d == -1) else int(d) for d in input_size]
+    rs = np.random.RandomState(0)
+    return paddle.to_tensor(rs.randn(*shape).astype(dtype or "float32"))
+
+
+def _leaf_layers(net):
+    out = []
+    for name, layer in net.named_sublayers(include_self=False):
+        if not list(layer.sublayers()):
+            out.append((name, layer))
+    return out
+
+
+def _out_shape(out):
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)) and out:
+        return _out_shape(out[0])
+    return []
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Print a per-layer table (name, output shape, #params); returns
+    {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            rows.append((name, type(lyr).__name__, _out_shape(outputs),
+                         n_params))
+
+        return hook
+
+    for name, layer in _leaf_layers(net):
+        hooks.append(layer.register_forward_post_hook(make_hook(name, layer)))
+    was_training = getattr(net, "training", False)
+    try:
+        x = input if input is not None else _make_input(
+            input_size, dtypes if isinstance(dtypes, str) else None)
+        net.eval()
+        if isinstance(x, (list, tuple)):
+            net(*x)
+        else:
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not getattr(p, "stop_gradient", False))
+    name_w = max([len(r[0]) for r in rows] + [10]) + 2
+    print(f"{'Layer':<{name_w}}{'Type':<18}{'Output Shape':<20}{'Params':>10}")
+    print("-" * (name_w + 48))
+    for name, kind, shape, n in rows:
+        print(f"{name:<{name_w}}{kind:<18}{str(shape):<20}{n:>10}")
+    print("-" * (name_w + 48))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _flops_of(layer, inputs, outputs):
+    kind = type(layer).__name__
+    out_shape = _out_shape(outputs)
+    if not out_shape:
+        return 0
+    out_elems = int(np.prod(out_shape))
+    if kind.startswith("Conv"):
+        w = getattr(layer, "weight", None)
+        if w is None:
+            return 0
+        # per output element: one MAC per kernel element x in-channels/groups
+        kernel_elems = int(np.prod(w.shape[1:]))
+        return 2 * out_elems * kernel_elems
+    if kind == "Linear":
+        in_f = int(layer.weight.shape[0])
+        return 2 * out_elems * in_f
+    if kind in ("BatchNorm2D", "BatchNorm1D", "BatchNorm3D", "LayerNorm"):
+        return 2 * out_elems
+    if kind in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Hardswish",
+                "Hardsigmoid", "Swish", "Silu", "Softmax"):
+        return out_elems
+    if kind.endswith("Pool2D") or kind.endswith("Pool1D"):
+        return out_elems
+    return 0
+
+
+def flops(net, input_size=None, inputs=None, custom_ops: Optional[dict] = None,
+          print_detail: bool = False):
+    """Total forward FLOPs estimate; `custom_ops` maps Layer classes to
+    `fn(layer, inputs, outputs) -> flops` overrides."""
+    total = [0]
+    detail = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, ins, outs):
+            fn = (custom_ops or {}).get(type(lyr))
+            n = fn(lyr, ins, outs) if fn else _flops_of(lyr, ins, outs)
+            total[0] += int(n)
+            detail.append((name, type(lyr).__name__, int(n)))
+
+        return hook
+
+    for name, layer in _leaf_layers(net):
+        hooks.append(layer.register_forward_post_hook(make_hook(name, layer)))
+    was_training = getattr(net, "training", False)
+    try:
+        x = inputs if inputs is not None else _make_input(input_size, None)
+        net.eval()
+        if isinstance(x, (list, tuple)):
+            net(*x)
+        else:
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        for name, kind, n in detail:
+            print(f"{name:<40}{kind:<18}{n:>14}")
+    print(f"Total Flops: {total[0]}")
+    return total[0]

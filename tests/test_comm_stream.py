@@ -1,0 +1,80 @@
+"""paddle.distributed.communication.stream API tests.
+
+Reference: python/paddle/distributed/communication/stream/*.py — the same
+collectives as the top-level API with `sync_op`/`use_calc_stream` knobs.
+World-size-1 eager semantics are exact (degenerate ring); the knob
+contract is what these tests pin: use_calc_stream=True waits inline and
+returns no task, sync_op=False returns a waitable Task.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+RS = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _env():
+    if not dist.is_initialized():
+        dist.init_parallel_env()
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_namespace_paths():
+    from paddle_tpu.distributed import communication
+
+    assert communication.stream is dist.stream
+    assert callable(communication.all_reduce)
+
+
+def test_stream_all_reduce_knobs():
+    x = RS.randn(4, 3).astype(np.float32)
+    t = _t(x)
+    task = dist.stream.all_reduce(t, sync_op=False)
+    if task is not None:
+        assert hasattr(task, "wait")
+        task.wait()
+    np.testing.assert_allclose(t.numpy(), x, rtol=1e-6)  # world-1 identity
+
+    t2 = _t(x)
+    out = dist.stream.all_reduce(t2, use_calc_stream=True)
+    assert out is None
+    np.testing.assert_allclose(t2.numpy(), x, rtol=1e-6)
+
+
+def test_stream_all_gather_and_reduce_scatter():
+    x = RS.randn(2, 3).astype(np.float32)
+    lst = []
+    dist.stream.all_gather(lst, _t(x), use_calc_stream=True)
+    assert len(lst) == dist.get_world_size()
+    np.testing.assert_allclose(lst[0].numpy(), x, rtol=1e-6)
+
+    t = _t(np.zeros_like(x))
+    dist.stream.reduce_scatter(t, [_t(x)], use_calc_stream=True)
+    np.testing.assert_allclose(t.numpy(), x, rtol=1e-6)
+
+
+def test_stream_broadcast_scatter_reduce():
+    x = RS.randn(3, 2).astype(np.float32)
+    t = _t(x)
+    dist.stream.broadcast(t, src=0, use_calc_stream=True)
+    np.testing.assert_allclose(t.numpy(), x, rtol=1e-6)
+    t2 = _t(np.zeros_like(x))
+    dist.stream.scatter(t2, [_t(x)], src=0, use_calc_stream=True)
+    np.testing.assert_allclose(t2.numpy(), x, rtol=1e-6)
+    t3 = _t(x)
+    dist.stream.reduce(t3, dst=0, use_calc_stream=True)
+    np.testing.assert_allclose(t3.numpy(), x, rtol=1e-6)
+
+
+def test_stream_alltoall():
+    x = RS.randn(2, 2).astype(np.float32)
+    out = []
+    dist.stream.alltoall(out, [_t(x)], use_calc_stream=True)
+    assert len(out) == dist.get_world_size()
+    np.testing.assert_allclose(out[0].numpy(), x, rtol=1e-6)

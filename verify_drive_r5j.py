@@ -1,0 +1,94 @@
+"""Verify drive (round 5, session 3c): top-level API parity tail +
+communication.stream, driven the way a reference user's script would.
+
+Run: cd /root/repo && python verify_drive_r5j.py
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+t0 = time.time()
+
+
+def check(name, ok):
+    print(f"[{time.time() - t0:6.1f}s] {'PASS' if ok else 'FAIL'}  {name}")
+    if not ok:
+        sys.exit(1)
+
+
+# a reference-style feature-prep pipeline using the compat tail
+rs = np.random.RandomState(0)
+raw_a = paddle.to_tensor(rs.randn(64, 3).astype(np.float32))
+raw_b = paddle.to_tensor(rs.randn(64, 2).astype(np.float32))
+feats = paddle.hstack([raw_a, raw_b])                      # [64, 5]
+edges = paddle.to_tensor(np.array([-1.0, 0.0, 1.0], np.float32))
+bucket_feat = paddle.bucketize(feats[:, 0], edges, out_int32=True)
+feats = paddle.column_stack([feats,
+                             paddle.cast(bucket_feat, "float32")])
+check("hstack/bucketize/column_stack pipeline", list(feats.shape) == [64, 6])
+
+# grads flow through the compat composites (built on public ops)
+w = paddle.create_parameter([6, 1], "float32")
+target = paddle.to_tensor(rs.randn(64, 1).astype(np.float32))
+opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
+first = None
+for _ in range(40):
+    pred = paddle.matmul(feats, w)
+    loss = paddle.mean((pred - target) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    first = first if first is not None else float(loss.numpy())
+check(f"create_parameter trains through compat pipeline "
+      f"({first:.3f} -> {float(loss.numpy()):.3f})",
+      float(loss.numpy()) < first)
+
+# summary + flops leave training mode intact
+model = paddle.nn.Sequential(paddle.nn.Linear(6, 16), paddle.nn.ReLU(),
+                             paddle.nn.Dropout(), paddle.nn.Linear(16, 1))
+model.train()
+info = paddle.summary(model, (1, 6))
+fl = paddle.flops(model, (1, 6))
+check("summary/flops report and restore train mode",
+      info["total_params"] > 0 and fl > 0 and model.training)
+
+# stream collectives (world-1 exactness + knob contract)
+dist.init_parallel_env()
+x = paddle.to_tensor(np.ones((4,), np.float32))
+out = dist.stream.all_reduce(x, use_calc_stream=True)
+check("stream.all_reduce inline", out is None
+      and np.allclose(x.numpy(), 1.0))
+task = dist.stream.broadcast(x, src=0, sync_op=False)
+if task is not None:
+    task.wait()
+check("stream.broadcast async task", np.allclose(x.numpy(), 1.0))
+
+# dlpack interop with torch (both directions)
+import torch  # noqa: E402
+
+tt = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+pt = paddle.from_dlpack(tt)
+back = torch.utils.dlpack.from_dlpack(paddle.to_dlpack(pt))
+check("dlpack torch round-trip",
+      np.allclose(back.numpy(), tt.numpy()))
+
+# in-place spellings + dtype info
+z = paddle.to_tensor(np.array([0.25], np.float32))
+paddle.sqrt_(z)
+check("paddle.sqrt_ in-place", float(z.numpy()) == 0.5)
+check("finfo/iinfo", paddle.finfo("bfloat16").bits == 16
+      and paddle.iinfo("int8").min == -128)
+
+print(f"ALL PASS in {time.time() - t0:.1f}s")
