@@ -191,6 +191,7 @@ from . import linalg  # noqa: F401, E402
 from . import fft  # noqa: F401, E402
 from . import signal  # noqa: F401, E402
 from . import distribution  # noqa: F401, E402
+from . import geometric  # noqa: F401, E402  (registers graph/segment ops)
 from . import sparse  # noqa: F401, E402
 from . import pir  # noqa: F401, E402
 from . import inference  # noqa: F401, E402

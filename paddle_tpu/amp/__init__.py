@@ -13,3 +13,13 @@ from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from ..ops import dispatch as _dispatch
 
 _dispatch.set_amp_hook(amp_pre_dispatch)
+
+
+def is_bfloat16_supported(place=None):
+    """bf16 is the TPU-native compute dtype; XLA-CPU emulates it."""
+    return True
+
+
+def is_float16_supported(place=None):
+    """fp16 compiles on both backends (bf16 is preferred on TPU)."""
+    return True

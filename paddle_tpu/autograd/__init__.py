@@ -7,3 +7,28 @@ from .engine import GradNode, grad, run_backward  # noqa: F401
 from .backward_mode import backward  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp  # noqa: F401
+
+
+class saved_tensors_hooks:
+    """Context manager routing every tensor saved for backward through
+    pack()/unpack() (reference: python/paddle/autograd/saved_tensors_hooks.py
+    — the activation-offload hook point). pack(tensor) runs at save time
+    and may return anything (e.g. a host copy); unpack(obj) must return the
+    tensor at backward time. Applies to every op dispatched inside the
+    `with` block; the vjp residual leaves are the saved tensors here.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        from ..ops import dispatch as _d
+
+        _d._saved_tensors_hooks.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        from ..ops import dispatch as _d
+
+        _d._saved_tensors_hooks.pop()
+        return False

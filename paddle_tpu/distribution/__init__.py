@@ -638,3 +638,297 @@ def _kl_beta_beta(p: Beta, q: Beta):
                  - (gl(q.alpha + q.beta) - gl(q.alpha) - gl(q.beta))
                  + (p.alpha - q.alpha) * (dg(p.alpha) - dg(sp))
                  + (p.beta - q.beta) * (dg(p.beta) - dg(sp)))
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail (reference: python/paddle/distribution/{chi2,independent,
+# continuous_bernoulli,exponential_family,lkj_cholesky,multivariate_normal,
+# transformed_distribution}.py)
+# ---------------------------------------------------------------------------
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter base class: subclasses define
+    `_natural_parameters` and `_log_normalizer`; entropy falls out of the
+    Bregman identity via jax autodiff (the reference differentiates the
+    log-normalizer the same way with paddle autograd)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(_arr(p)) for p in self._natural_parameters]
+        grads = jax.grad(lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+                         argnums=tuple(range(len(nat))))(*nat)
+        per = -self._mean_carrier_measure + self._log_normalizer(*nat)
+        for p, g in zip(nat, grads):
+            per = per - p * g
+        return _wrap(per)
+
+
+class Chi2(Gamma):
+    """Chi-squared with `df` degrees of freedom = Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _arr(df).astype(jnp.float32)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df, 0.5))
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims of `base` as event dims
+    (reference: distribution/independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if self.rank > len(bshape):
+            raise ValueError("reinterpreted_batch_rank exceeds the base "
+                             "distribution's batch rank")
+        split = len(bshape) - self.rank
+        super().__init__(bshape[:split],
+                         bshape[split:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        axes = tuple(range(lp.ndim - self.rank, lp.ndim))
+        return _wrap(jnp.sum(lp, axis=axes) if axes else lp)
+
+    def entropy(self):
+        ent = _arr(self.base.entropy())
+        axes = tuple(range(ent.ndim - self.rank, ent.ndim))
+        return _wrap(jnp.sum(ent, axis=axes) if axes else ent)
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lambda) (reference: distribution/continuous_bernoulli.py;
+    Loaiza-Ganem & Cunningham 2019): Bernoulli density on [0,1] with the
+    C(lambda) normalizer."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs).astype(jnp.float32)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        """log C(lambda), Taylor-stabilized near lambda=1/2."""
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.25)
+        log_c = jnp.log(
+            jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+            / jnp.abs(1.0 - 2.0 * safe))
+        x = lam - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside(), log_c, taylor)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.25)
+        m = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        x = lam - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return _wrap(jnp.where(self._outside(), m, taylor))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lam = jnp.clip(self.probs, 1e-6, 1.0 - 1e-6)
+        return _wrap(v * jnp.log(lam) + (1.0 - v) * jnp.log1p(-lam)
+                     + self._log_norm())
+
+    def sample(self, shape=(), seed=0):
+        shp = _shape(shape, self.probs)
+        u = jax.random.uniform(next_key(), shp, minval=1e-6, maxval=1 - 1e-6)
+        # inverse CDF away from 1/2; u itself at 1/2. The discarded branch
+        # of the where must stay finite under jax.grad, so the icdf is
+        # evaluated at a SAFE lambda (same trick as _log_norm/mean).
+        lam = jnp.clip(self.probs, 1e-6, 1.0 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _wrap(jnp.where(self._outside(), icdf, u))
+
+    rsample = sample
+
+
+class MultivariateNormal(Distribution):
+    """Reference: distribution/multivariate_normal.py. Parameterized by
+    loc + one of covariance_matrix / precision_matrix / scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        given = [a is not None for a in (covariance_matrix,
+                                         precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("specify exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _arr(scale_tril).astype(jnp.float32)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(
+                _arr(covariance_matrix).astype(jnp.float32))
+        else:
+            prec = _arr(precision_matrix).astype(jnp.float32)
+            self.scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return _wrap(self.scale_tril @ jnp.swapaxes(self.scale_tril, -1, -2))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.sum(self.scale_tril ** 2, axis=-1))
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(next_key(), shp)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i",
+                                           self.scale_tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value) - self.loc
+        d = self.loc.shape[-1]
+        # solve L y = v; quad form = |y|^2 (broadcast L over v's batch)
+        L = jnp.broadcast_to(self.scale_tril, v.shape[:-1] + (d, d))
+        y = jax.scipy.linalg.solve_triangular(L, v[..., None],
+                                              lower=True)[..., 0]
+        half_log_det = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return _wrap(-0.5 * (d * math.log(2 * math.pi)
+                             + jnp.sum(y * y, -1)) - half_log_det)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_log_det = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        return _wrap(0.5 * d * (1.0 + math.log(2 * math.pi)) + half_log_det)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices
+    (reference: distribution/lkj_cholesky.py). Sampling: onion method;
+    log_prob: the standard per-row diagonal-power density."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = float(_arr(concentration).reshape(()))
+        super().__init__((), (self.dim, self.dim))
+
+    def sample(self, shape=(), seed=0):
+        d = self.dim
+        eta = self.concentration
+        shp = tuple(shape)
+        key1 = next_key()
+        # onion method (Lewandowski et al. 2009): row i's squared radius
+        # r2 ~ Beta(i/2, eta + (d-1-i)/2), direction uniform on S^{i-1}
+        L = jnp.zeros(shp + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            key1, ka, kb = jax.random.split(key1, 3)
+            r2 = jax.random.beta(ka, i / 2.0, eta + (d - 1 - i) / 2.0,
+                                 shp, dtype=jnp.float32)
+            u = jax.random.normal(kb, shp + (i,), dtype=jnp.float32)
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(r2)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(1.0 - r2))
+        return _wrap(L)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        L = _arr(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+        powers = 2.0 * (eta - 1.0) + d - orders
+        unnorm = jnp.sum(powers * jnp.log(diag), axis=-1)
+        # normalizer (reference lkj_cholesky.py log-density constant):
+        # 0.5 (d-1) log(pi) + mvlgamma(alpha - 0.5, d-1) - (d-1) lgamma(alpha)
+        # with alpha = eta + (d-1)/2
+        from jax.scipy.special import gammaln
+
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+
+        def mvlgamma(a, p):
+            out = p * (p - 1) / 4.0 * math.log(math.pi)
+            for j in range(1, p + 1):
+                out += float(gammaln(a + (1.0 - j) / 2.0))
+            return out
+
+        norm = (0.5 * dm1 * math.log(math.pi)
+                + mvlgamma(alpha - 0.5, dm1)
+                - dm1 * float(gammaln(alpha)))
+        return _wrap(unnorm - norm)
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms
+    (reference: distribution/transformed_distribution.py). Transforms are
+    objects with forward / inverse / forward_log_det_jacobian."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        log_det = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            log_det = log_det + _arr(t.forward_log_det_jacobian(x))
+            y = x
+        return _wrap(_arr(self.base.log_prob(y)) - log_det)
+
+
+__all__ += ["Chi2", "ContinuousBernoulli", "ExponentialFamily",
+            "Independent", "LKJCholesky", "MultivariateNormal",
+            "TransformedDistribution"]

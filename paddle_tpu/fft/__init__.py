@@ -123,3 +123,35 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-D FFT of a Hermitian-symmetric input → real output (reference:
+    python/paddle/fft.py:830 hfftn = fftn_c2r forward). Composition: full
+    complex FFT over the leading axes, Hermitian c2r FFT over the last —
+    the per-axis norm factors compose to the n-D convention."""
+    def kernel(a):
+        ax = tuple(axes) if axes is not None else tuple(range(a.ndim))
+        lead, last = ax[:-1], ax[-1]
+        n_last = (s[-1] if s is not None
+                  else 2 * (a.shape[last] - 1))
+        if lead:
+            a = jnp.fft.fftn(a, s=None if s is None else list(s[:-1]),
+                             axes=lead, norm=_norm(norm))
+        return jnp.fft.hfft(a, n=n_last, axis=last, norm=_norm(norm))
+    return _op("hfftn", kernel, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn (reference: fft.py ihfftn): real → Hermitian
+    half-spectrum."""
+    def kernel(a):
+        ax = tuple(axes) if axes is not None else tuple(range(a.ndim))
+        lead, last = ax[:-1], ax[-1]
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=last,
+                            norm=_norm(norm))
+        if lead:
+            out = jnp.fft.ifftn(out, s=None if s is None else list(s[:-1]),
+                                axes=lead, norm=_norm(norm))
+        return out
+    return _op("ihfftn", kernel, x)

@@ -324,3 +324,20 @@ fused_attention = _OPS["fused_attention"]
 fused_feedforward = _OPS["fused_feedforward"]
 fused_linear = _OPS["fused_linear"]
 fused_matmul_bias = _OPS["fused_linear"]
+
+
+fused_dropout_add = _OPS["fused_dropout_add"]
+# reference alias: incubate/nn/functional/fused_multi_head_attention
+fused_multi_head_attention = _OPS["fused_attention"]
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py
+    fused_linear_activation — matmul+bias+act in one fused region (XLA
+    fuses the epilogue)."""
+    xx = x.t() if trans_x else x
+    out = _OPS["fused_linear"](xx, y, bias, transpose_weight=trans_y)
+    if activation in (None, "", "none"):
+        return out
+    return _OPS[activation](out)

@@ -1,6 +1,7 @@
 """paddle.io-compatible API (reference: python/paddle/io)."""
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .dataset import (  # noqa: F401
+    SubsetRandomSampler,
     BatchSampler,
     ChainDataset,
     ComposeDataset,
@@ -16,3 +17,11 @@ from .dataset import (  # noqa: F401
     WeightedRandomSampler,
     random_split,
 )
+
+
+def get_worker_info():
+    """Inside a DataLoader worker returns (id, num_workers, dataset);
+    None in the main process (reference: io/dataloader/worker.py)."""
+    from . import dataloader as _dl
+
+    return getattr(_dl, "_worker_info", None)

@@ -55,6 +55,18 @@ def _to_device(collated):
     return collated
 
 
+class WorkerInfo:
+    """get_worker_info() payload inside a worker process."""
+
+    def __init__(self, wid, dataset):
+        self.id = wid
+        self.dataset = dataset
+        self.num_workers = int(os.environ.get("PADDLE_TPU_NUM_WORKERS", "1"))
+
+
+_worker_info = None
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
                  worker_id, ring_name=None):
     """ring_name set = shared-memory transport: results are pickled into
@@ -63,6 +75,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_init_fn,
     worker pool). The queue stays as the error/fallback channel contract
     when ring_name is None."""
     import pickle
+
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, dataset)
 
     ring = None
     if ring_name is not None:
@@ -146,6 +161,7 @@ class _MultiProcessIter:
             os.environ.update(scrubbed)
             for k in removed:
                 os.environ.pop(k, None)
+            os.environ["PADDLE_TPU_NUM_WORKERS"] = str(self._num_workers)
             for wid in range(self._num_workers):
                 w = ctx.Process(
                     target=_worker_loop,

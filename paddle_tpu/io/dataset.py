@@ -236,3 +236,21 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+
+class SubsetRandomSampler(Sampler):
+    """Samples from a fixed index subset without replacement (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        import numpy as _np
+
+        perm = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)

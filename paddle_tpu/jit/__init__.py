@@ -1,4 +1,30 @@
 """paddle.jit-compatible API (reference: python/paddle/jit)."""
 from .api import InputSpec, StaticFunction, ignore_module, in_to_static_trace, not_to_static, to_static  # noqa: F401
-from .serialization import load, save  # noqa: F401
+from .serialization import TranslatedLayer, load, save  # noqa: F401
+
+
+def enable_to_static(flag: bool = True):
+    """Global to_static switch (reference: jit/api.py enable_to_static):
+    False makes @to_static functions run eagerly — the debugging escape
+    hatch. The live flag is jit/api.py's; this is the public entry."""
+    from .api import set_to_static_enabled
+
+    set_to_static_enabled(bool(flag))
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log level for transformed-code dumps (reference: jit/dy2static
+    logging_utils.set_code_level). Stored; the AST converter reads it to
+    decide whether to print transformed source."""
+    from .dy2static import transformers as _tr
+
+    _tr.CODE_LEVEL = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity (reference: logging_utils.set_verbosity)."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
 from . import dy2static, sot  # noqa: F401, E402

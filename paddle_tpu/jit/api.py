@@ -39,6 +39,14 @@ from . import dy2static
 
 _tls = threading.local()
 
+# jit.enable_to_static(False) flips every StaticFunction to eager — the
+# debugging escape hatch (reference: jit/api.py enable_to_static)
+_to_static_enabled = [True]
+
+
+def set_to_static_enabled(flag: bool):
+    _to_static_enabled[0] = bool(flag)
+
 
 def in_to_static_trace() -> bool:
     return getattr(_tls, "tracing", 0) > 0
@@ -308,6 +316,8 @@ class StaticFunction:
             # cached programs via id(layer) in the signature)
             layer = args[0]
             args = args[1:]
+        if not _to_static_enabled[0]:  # jit.enable_to_static(False)
+            return self._fn(*args, **kwargs)
         if in_to_static_trace():
             return self._fn(*args, **kwargs)
 

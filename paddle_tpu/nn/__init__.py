@@ -101,3 +101,4 @@ from .layer.pooling import (  # noqa: F401
     MaxPool2D,
 )
 from .param_attr import ParamAttr  # noqa: F401
+from .layer.extra import *  # noqa: F401,F403,E402  (round-5 layer tail)

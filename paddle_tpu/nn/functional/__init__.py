@@ -1,7 +1,10 @@
 """nn.functional — the functional NN API.
 
 Analog of `python/paddle/nn/functional/*` (reference). Thin wrappers mapping
-paddle signatures onto the op registry (`paddle_tpu.ops`).
+paddle signatures onto the op registry (`paddle_tpu.ops`). The round-5
+tail (pool/conv wrappers, loss compositions, in-place spellings) lives in
+extra.py and is star-imported at the END of this module (it imports names
+from here).
 """
 from __future__ import annotations
 
@@ -300,3 +303,6 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
     return _C_ops.max_pool2d_with_index(
         x, kernel_size, stride=stride, padding=padding,
         global_pooling=global_pooling)
+
+
+from .extra import *  # noqa: F401,F403,E402  (round-5 functional tail)

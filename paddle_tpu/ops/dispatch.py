@@ -79,6 +79,10 @@ def _wrap_out(arr, node=None, idx=0):
 
 
 _amp_hook = None
+
+# active saved-tensors hook stack: [(pack, unpack), ...] — see the
+# saved_tensors_hooks context manager in autograd/__init__.py
+_saved_tensors_hooks: list = []
 # static-graph recorder (paddle.enable_static + program_guard): records
 # every dispatched op into the active Program for Executor replay
 _static_recorder = [None]
@@ -199,6 +203,22 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
             return kernel(*a2, **k2)
 
         out, vjp_fn = jax.vjp(pure, *arrays)
+        if _saved_tensors_hooks:
+            # reference: autograd/saved_tensors_hooks — every tensor saved
+            # for backward passes through pack() now and unpack() at
+            # backward time. The vjp closure is a jax pytree, so its
+            # residual leaves ARE the saved tensors.
+            pack, unpack = _saved_tensors_hooks[-1]
+            res_leaves, res_tree = jax.tree.flatten(vjp_fn)
+            packed = [pack(Tensor._from_data(leaf)) for leaf in res_leaves]
+
+            def vjp_fn(cot, _packed=packed, _tree=res_tree, _unpack=unpack):
+                leaves = []
+                for p in _packed:
+                    u = _unpack(p)
+                    leaves.append(u._data if isinstance(u, Tensor)
+                                  else jax.numpy.asarray(u))
+                return jax.tree.unflatten(_tree, leaves)(cot)
         out_leaves, out_treedef = jax.tree.flatten(out)
         edges = []
         for t in in_tensors:

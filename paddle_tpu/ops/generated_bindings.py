@@ -58,12 +58,28 @@ def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow, b
     return _OPS['adamw_'](param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow, beta1=beta1, beta2=beta2, epsilon=epsilon, weight_decay=weight_decay, lr_ratio=lr_ratio)
 
 
+def adaptive_avg_pool1d(x, output_size):
+    return _OPS['adaptive_avg_pool1d'](x, output_size)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format='NCHW'):
     return _OPS['adaptive_avg_pool2d'](x, output_size, data_format=data_format)
 
 
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW'):
+    return _OPS['adaptive_avg_pool3d'](x, output_size, data_format=data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    return _OPS['adaptive_max_pool1d'](x, output_size, return_mask=return_mask)
+
+
 def adaptive_max_pool2d(x, output_size, data_format='NCHW'):
     return _OPS['adaptive_max_pool2d'](x, output_size, data_format=data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, data_format='NCDHW'):
+    return _OPS['adaptive_max_pool3d'](x, output_size, return_mask=return_mask, data_format=data_format)
 
 
 def add(x, y):
@@ -1270,6 +1286,18 @@ def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None, sample_s
     return _OPS['graph_sample_neighbors'](row, colptr, x, eids=eids, perm_buffer=perm_buffer, sample_size=sample_size, return_eids=return_eids, flag_perm_buffer=flag_perm_buffer, seed=seed)
 
 
+def graph_send_recv(x, src_index, dst_index, reduce_op='sum', out_size=None):
+    return _OPS['graph_send_recv'](x, src_index, dst_index, reduce_op=reduce_op, out_size=out_size)
+
+
+def graph_send_ue_recv(x, y, src_index, dst_index, message_op='add', reduce_op='sum', out_size=None):
+    return _OPS['graph_send_ue_recv'](x, y, src_index, dst_index, message_op=message_op, reduce_op=reduce_op, out_size=out_size)
+
+
+def graph_send_uv(x, y, src_index, dst_index, message_op='add'):
+    return _OPS['graph_send_uv'](x, y, src_index, dst_index, message_op=message_op)
+
+
 def greater_equal(x, y):
     return _OPS['greater_equal'](x, y)
 
@@ -2222,8 +2250,24 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     return _OPS['searchsorted'](sorted_sequence, values, out_int32=out_int32, right=right)
 
 
+def segment_max(data, segment_ids):
+    return _OPS['segment_max'](data, segment_ids)
+
+
+def segment_mean(data, segment_ids):
+    return _OPS['segment_mean'](data, segment_ids)
+
+
+def segment_min(data, segment_ids):
+    return _OPS['segment_min'](data, segment_ids)
+
+
 def segment_pool(x, segment_ids, pooltype='SUM', num_segments=None):
     return _OPS['segment_pool'](x, segment_ids, pooltype=pooltype, num_segments=num_segments)
+
+
+def segment_sum(data, segment_ids):
+    return _OPS['segment_sum'](data, segment_ids)
 
 
 def self_dp_attention(x, alpha=1.0, head_number=1):
@@ -2746,8 +2790,12 @@ __all__ = [
     'adam_',
     'adamax_',
     'adamw_',
+    'adaptive_avg_pool1d',
     'adaptive_avg_pool2d',
+    'adaptive_avg_pool3d',
+    'adaptive_max_pool1d',
     'adaptive_max_pool2d',
+    'adaptive_max_pool3d',
     'add',
     'add_group_norm_silu',
     'add_n',
@@ -3049,6 +3097,9 @@ __all__ = [
     'grad_add',
     'graph_khop_sampler',
     'graph_sample_neighbors',
+    'graph_send_recv',
+    'graph_send_ue_recv',
+    'graph_send_uv',
     'greater_equal',
     'greater_than',
     'grid_sample',
@@ -3287,7 +3338,11 @@ __all__ = [
     'scatter',
     'scatter_nd_add',
     'searchsorted',
+    'segment_max',
+    'segment_mean',
+    'segment_min',
     'segment_pool',
+    'segment_sum',
     'self_dp_attention',
     'selu',
     'send_u_recv',

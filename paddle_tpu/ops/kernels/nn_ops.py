@@ -417,6 +417,87 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
 
 
 @register_op
+def adaptive_avg_pool1d(x, output_size):
+    """x [N, C, L] → [N, C, out]; paddle/torch variable windows."""
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    N, C, L = x.shape
+    if L % out == 0:
+        return x.reshape(N, C, out, L // out).mean(axis=3)
+    a = _adaptive_avg_matrix(L, out, x.dtype)
+    return jnp.einsum("ncl,ol->nco", x, a, precision="highest")
+
+
+@register_op
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    N, C, L = x.shape
+    if L % out == 0 and not return_mask:
+        return x.reshape(N, C, out, L // out).max(axis=3)
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    m = _adaptive_mask(L, out)                       # [O, L]
+    windows = jnp.where(m[None, None, :, :], x[:, :, None, :], neg)
+    vals = windows.max(axis=3)
+    if not return_mask:
+        return vals
+    idx = windows.argmax(axis=3).astype(jnp.int64)   # flat L index per window
+    return vals, idx
+
+
+@register_op
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _pair(output_size, 3)
+    N, C, D, H, W = x.shape
+    if D % out[0] == 0 and H % out[1] == 0 and W % out[2] == 0:
+        x6 = x.reshape(N, C, out[0], D // out[0], out[1], H // out[1],
+                       out[2], W // out[2])
+        return x6.mean(axis=(3, 5, 7))
+    ad = _adaptive_avg_matrix(D, out[0], x.dtype)
+    ah = _adaptive_avg_matrix(H, out[1], x.dtype)
+    aw = _adaptive_avg_matrix(W, out[2], x.dtype)
+    return jnp.einsum("ncdhw,ed,oh,pw->nceop", x, ad, ah, aw,
+                      precision="highest")
+
+
+@register_op
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    out = _pair(output_size, 3)
+    N, C, D, H, W = x.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    if return_mask:
+        # windowed form over the flattened volume: [OPQ, DHW] membership,
+        # argmax gives the flat D*H*W index per output cell (small OPQ ×
+        # DHW product — adaptive output sizes are tiny in practice)
+        md = _adaptive_mask(D, out[0])
+        mh = _adaptive_mask(H, out[1])
+        mw = _adaptive_mask(W, out[2])
+        m = (md[:, None, None, :, None, None]
+             & mh[None, :, None, None, :, None]
+             & mw[None, None, :, None, None, :])
+        m = m.reshape(out[0] * out[1] * out[2], D * H * W)
+        xf = x.reshape(N, C, 1, D * H * W)
+        windows = jnp.where(m[None, None, :, :], xf, neg)
+        vals = windows.max(axis=3).reshape(N, C, *out)
+        idx = windows.argmax(axis=3).astype(jnp.int64).reshape(N, C, *out)
+        return vals, idx
+    if D % out[0] == 0 and H % out[1] == 0 and W % out[2] == 0:
+        x6 = x.reshape(N, C, out[0], D // out[0], out[1], H // out[1],
+                       out[2], W // out[2])
+        return x6.max(axis=(3, 5, 7))
+    md = _adaptive_mask(D, out[0])
+    xd = jnp.where(md[None, None, :, :, None, None],
+                   x[:, :, None, :, :, :], neg).max(axis=3)   # [N,C,E,H,W]
+    mh = _adaptive_mask(H, out[1])
+    xh = jnp.where(mh[None, None, None, :, :, None],
+                   xd[:, :, :, None, :, :], neg).max(axis=4)  # [N,C,E,O,W]
+    mw = _adaptive_mask(W, out[2])
+    return jnp.where(mw[None, None, None, None, :, :],
+                     xh[:, :, :, :, None, :], neg).max(axis=5)
+
+
+@register_op
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     out = _pair(output_size, 2)
     N, C, H, W = x.shape

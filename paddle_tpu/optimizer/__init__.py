@@ -1,6 +1,7 @@
 """paddle.optimizer-compatible API (reference: python/paddle/optimizer)."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
+    ASGD,
     LBFGS,
     SGD,
     Adadelta,
@@ -10,6 +11,9 @@ from .optimizer import (  # noqa: F401
     AdamW,
     Lamb,
     Momentum,
+    NAdam,
     Optimizer,
+    RAdam,
     RMSProp,
+    Rprop,
 )

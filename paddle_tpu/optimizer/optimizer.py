@@ -451,3 +451,138 @@ class LBFGS(Optimizer):
             best_t, best_loss = t, loss
             t *= 0.5
         return best_t, best_loss, n_eval
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py): keeps a
+    running average of the last `t_half`-window gradients (the reference's
+    simplified d/y-register formulation)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = max(1, int(batch_num))
+
+    def _update(self, param, grad, lr):
+        g32 = grad.astype(jnp.float32)
+        n = self._batch_num
+        d = self._acc(param, "d", jnp.zeros(param._data.shape, jnp.float32))
+        # ys holds the window's gradient slots; rotate through them
+        idx = self._step_count % n
+        ys = self._acc(param, "ys",
+                       jnp.zeros((n, *param._data.shape), jnp.float32))
+        old = ys[idx]
+        d = d - old + g32
+        ys = ys.at[idx].set(g32)
+        self._set_acc(param, "d", d)
+        self._set_acc(param, "ys", ys)
+        return (param._data.astype(jnp.float32) - lr * d / n).astype(
+            param._data.dtype)
+
+
+class NAdam(Optimizer):
+    """Reference: python/paddle/optimizer/nadam.py (Adam + Nesterov
+    momentum schedule mu_t = beta1 * (1 - 0.5 * 0.96^(0.004 t)))."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update(self, param, grad, lr):
+        t = self._step_count + 1
+        g32 = grad.astype(jnp.float32)
+        m = self._acc(param, "moment1",
+                      jnp.zeros(param._data.shape, jnp.float32))
+        v = self._acc(param, "moment2",
+                      jnp.zeros(param._data.shape, jnp.float32))
+        mu_t = self._beta1 * (1.0 - 0.5 * 0.96 ** (self._psi * t))
+        mu_next = self._beta1 * (1.0 - 0.5 * 0.96 ** (self._psi * (t + 1)))
+        prod = self._acc(param, "mu_product",
+                         jnp.ones((), jnp.float32))
+        prod_t = prod * mu_t
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        self._set_acc(param, "moment1", m)
+        self._set_acc(param, "moment2", v)
+        self._set_acc(param, "mu_product", prod_t)
+        m_hat = (mu_next * m / (1 - prod_t * mu_next)
+                 + (1 - mu_t) * g32 / (1 - prod_t))
+        v_hat = v / (1 - self._beta2 ** t)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return (param._data.astype(jnp.float32) - upd).astype(
+            param._data.dtype)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: python/paddle/optimizer/radam.py):
+    falls back to SGD-with-momentum while the variance estimate's
+    rectification term rho_t <= 4."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, param, grad, lr):
+        t = self._step_count + 1
+        g32 = grad.astype(jnp.float32)
+        m = self._acc(param, "moment1",
+                      jnp.zeros(param._data.shape, jnp.float32))
+        v = self._acc(param, "moment2",
+                      jnp.zeros(param._data.shape, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        self._set_acc(param, "moment1", m)
+        self._set_acc(param, "moment2", v)
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        beta2_t = self._beta2 ** t
+        rho_t = rho_inf - 2.0 * t * beta2_t / (1 - beta2_t)
+        m_hat = m / (1 - self._beta1 ** t)
+        if rho_t > 5.0:  # reference radam.py: rectify only when rho_t > 5
+            r = ((rho_t - 4) * (rho_t - 2) * rho_inf
+                 / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            v_hat = jnp.sqrt(v / (1 - beta2_t))
+            upd = lr * r * m_hat / (v_hat + self._epsilon)
+        else:
+            upd = lr * m_hat
+        return (param._data.astype(jnp.float32) - upd).astype(
+            param._data.dtype)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py):
+    per-weight step sizes grown/shrunk by the gradient sign agreement;
+    full-batch algorithm."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _update(self, param, grad, lr):
+        g32 = grad.astype(jnp.float32)
+        prev = self._acc(param, "prev_grad",
+                         jnp.zeros(param._data.shape, jnp.float32))
+        steps = self._acc(param, "step_size",
+                          jnp.full(param._data.shape, float(lr), jnp.float32))
+        sign = jnp.sign(prev * g32)
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        steps = jnp.clip(steps * factor, self._lr_min, self._lr_max)
+        # sign change: zero the gradient for this step (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        self._set_acc(param, "prev_grad", g_eff)
+        self._set_acc(param, "step_size", steps)
+        upd = steps * jnp.sign(g_eff)
+        return (param._data.astype(jnp.float32) - upd).astype(
+            param._data.dtype)

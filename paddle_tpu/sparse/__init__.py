@@ -349,3 +349,40 @@ def coalesce(x):
 
 
 from . import nn  # noqa: E402  (sparse.nn: activations, conv, norm layers)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """dense_out = beta * input + alpha * (x @ y) where x may be sparse
+    (reference: python/paddle/sparse/multiary.py addmm)."""
+    return beta * input + alpha * matmul(x, y)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA over a (sparse or dense) matrix (reference:
+    python/paddle/sparse/unary.py pca_lowrank → _C_ops path): densifies —
+    XLA has no sparse SVD — and runs the subspace-iteration sketch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        a = x.to_dense()._data
+    else:
+        a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = a.shape
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=0, keepdims=True)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return (Tensor._from_data(u), Tensor._from_data(s),
+            Tensor._from_data(vt.T))

@@ -365,3 +365,338 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..autograd.engine import grad as grad_fn
 
     return grad_fn(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: scope/state/serialization utilities + compat names
+# (reference: python/paddle/static/__init__.py surface)
+# ---------------------------------------------------------------------------
+
+Variable = Tensor  # static-graph variables ARE tensors in this runtime
+
+from ..nn.param_attr import ParamAttr  # noqa: E402
+
+
+class Scope:
+    """Variable scope (reference: base/executor global_scope): name → value
+    store the executor and state utilities share."""
+
+    def __init__(self):
+        self._vars: Dict[str, object] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self._scope._vars.get(self._name)
+
+    def set_value(self, v):
+        self._scope._vars[self._name] = v
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: static device_guard — pins ops to a device inside the
+    block. One accelerator here: the guard is scoping-only."""
+    yield
+
+
+def cpu_places(device_count=None):
+    import os as _os
+
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    ids = device_ids if device_ids is not None else [0]
+    from ..core.place import CUDAPlace
+
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as _np
+
+    t = to_tensor(_np.full(shape, value, dtype=_np.dtype(str(dtype))))
+    if name:
+        _global_scope.set(name, t)
+    return t
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference: static/nn/control_flow.py Print): prints
+    and passes the tensor through."""
+    import numpy as _np
+
+    head = message or "Print"
+    arr = _np.asarray(input.numpy())
+    print(f"{head}: shape={list(arr.shape)} dtype={arr.dtype} "
+          f"values={arr.reshape(-1)[:summarize]}")
+    return input
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Reference: static WeightNormParamAttr — ParamAttr carrying the
+    weight-norm dim; layers read .dim when reparameterizing."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         trainable=trainable)
+        self.dim = dim
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from .. import _C_ops
+
+    return _C_ops.accuracy(input, _top_idx(input, k), label)
+
+
+def _top_idx(input, k):
+    from .. import _C_ops
+
+    return _C_ops.topk(input, k)[1]
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    from .. import _C_ops
+
+    return _C_ops.auc(input, label, curve=curve,
+                      num_thresholds=num_thresholds)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (reference: static/nn/metric.py ctr_metric_bundle):
+    returns (abserr, sqrerr, prob, q, pos, total) accumulators' batch
+    values."""
+    from .. import _C_ops
+
+    pred = input[:, -1] if len(input.shape) > 1 else input
+    lab = _C_ops.cast(label, "float32")
+    lab = lab[:, 0] if len(lab.shape) > 1 else lab
+    abserr = _C_ops.sum(_C_ops.abs(_C_ops.subtract(pred, lab)))
+    sqrerr = _C_ops.sum(_C_ops.square(_C_ops.subtract(pred, lab)))
+    prob = _C_ops.sum(pred)
+    q = _C_ops.sum(_C_ops.square(pred))
+    pos = _C_ops.sum(lab)
+    total = to_tensor(float(pred.shape[0]))
+    return abserr, sqrerr, prob, q, pos, total
+
+
+# -- program/persistables (de)serialization ----------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+
+    prog = program or default_main_program()
+    return pickle.dumps({"kind": "paddle_tpu_program",
+                         "repr": repr(prog)})
+
+
+def deserialize_program(data):
+    import pickle
+
+    payload = pickle.loads(data)
+    if not isinstance(payload, dict) or \
+            payload.get("kind") != "paddle_tpu_program":
+        raise ValueError("not a serialized paddle_tpu program")
+    return payload
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+
+    prog = program or default_main_program()
+    state = {name: np.asarray(p.numpy())
+             for name, p in getattr(prog, "_params", {}).items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    for name, arr in state.items():
+        p = getattr(program, "_params", {}).get(name)
+        if p is not None:
+            p.set_value(arr)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_prefix, protocol=4, **configs):
+    """Save program params to <prefix>.pdparams (reference: static/io.py
+    save)."""
+    import pickle
+
+    state = {name: np.asarray(p.numpy())
+             for name, p in getattr(program, "_params", {}).items()}
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    import pickle
+
+    with open(model_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for name, arr in state.items():
+        p = getattr(program, "_params", {}).get(name)
+        if p is not None:
+            p.set_value(arr)
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    for name, arr in state_dict.items():
+        p = getattr(program, "_params", {}).get(name)
+        if p is not None:
+            p.set_value(arr)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference: static/io.py normalize_program (prunes to the
+    feed→fetch slice). Programs here are already traced slices."""
+    return program
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: static
+    ExponentialMovingAverage): update() refreshes shadows; apply() swaps
+    them in (context manager), restore() undoes."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[int, object] = {}
+        self._backup: Dict[int, object] = {}
+        self._params: List = []
+
+    def update(self, parameters=None):
+        import numpy as _np
+
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            key = id(p)
+            cur = _np.asarray(p.numpy())
+            prev = self._shadow.get(key)
+            self._shadow[key] = (cur if prev is None
+                                 else self._decay * prev
+                                 + (1 - self._decay) * cur)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import numpy as _np
+
+        for p in self._params:
+            self._backup[id(p)] = _np.asarray(p.numpy())
+            if id(p) in self._shadow:
+                p.set_value(self._shadow[id(p)])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.set_value(self._backup.pop(id(p)))
+
+
+class IpuStrategy:
+    """Graphcore IPU strategy (reference: static IpuStrategy). This build
+    targets TPU; constructing IPU machinery raises like a non-IPU
+    reference build does."""
+
+    def __init__(self):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("paddle_tpu is not compiled with IPU support")
+    yield  # pragma: no cover
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+from . import nn  # noqa: E402,F401

@@ -48,3 +48,332 @@ def embedding(input, size, is_sparse: bool = False, padding_idx=None,
     out = emb(input)
     out._emb_layer = emb
     return out
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: the reference's static.nn function surface
+# (reference: python/paddle/static/nn/common.py + control_flow.py) — static
+# functional forms over the same kernels the dygraph layers use.
+# ---------------------------------------------------------------------------
+
+def _F():
+    from ..nn import functional as F  # noqa: N802
+
+    return F
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from ..nn import Conv2D
+
+    layer = Conv2D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   bias_attr=bias_attr)
+    out = layer(input)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from ..nn import Conv3D
+
+    layer = Conv3D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    from ..nn import Conv2DTranspose
+
+    layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            dilation=dilation, groups=groups,
+                            bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    from ..nn import Conv3DTranspose
+
+    layer = Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            dilation=dilation, groups=groups,
+                            bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    return getattr(_F(), act)(out)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn import BatchNorm2D, BatchNorm1D, BatchNorm3D
+
+    cls = {2: BatchNorm1D, 3: BatchNorm1D, 4: BatchNorm2D,
+           5: BatchNorm3D}[len(input.shape)]
+    layer = cls(input.shape[1], momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import numpy as np
+
+    from .. import create_parameter
+
+    shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    weight = create_parameter(shape, "float32") if scale else None
+    bias = create_parameter(shape, "float32", is_bias=True) if shift else None
+    out = _F().layer_norm(input, weight, bias, epsilon, begin_norm_axis)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import create_parameter
+
+    c = input.shape[1]
+    weight = create_parameter([c], "float32")
+    bias = create_parameter([c], "float32", is_bias=True)
+    return _act(_F().group_norm(input, weight, bias, epsilon, groups), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import create_parameter
+
+    c = input.shape[1]
+    weight = create_parameter([c], "float32")
+    bias = create_parameter([c], "float32", is_bias=True)
+    return _F().instance_norm(input, None, None, weight, bias, epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Per-feature normalization by accumulated batch statistics
+    (reference: static/nn/common.py data_norm — PS-style normalization
+    without learned affine unless enabled). Eager form: normalize by the
+    batch's own mean/std."""
+    from .. import _C_ops
+
+    mean = _C_ops.mean(input, 0, True)
+    var = _C_ops.mean(_C_ops.square(_C_ops.subtract(input, mean)), 0, True)
+    out = _C_ops.divide(_C_ops.subtract(input, mean),
+                        _C_ops.sqrt(_C_ops.add(var, _C_ops.full_like(var, epsilon))))
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    import numpy as np
+
+    from .. import randn
+
+    h = weight.shape[dim]
+    w = int(np.prod(weight.shape)) // h
+    from .. import _C_ops
+
+    return _C_ops.spectral_norm(weight, randn([h]), randn([w]), dim,
+                                power_iters, eps)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import create_parameter
+    from ..nn import functional as F
+
+    w = create_parameter([size, x.shape[-1], y.shape[-1]], "float32")
+    b = create_parameter([1, size], "float32", is_bias=True)
+    return _act(F.bilinear(x, y, w, b), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import create_parameter
+
+    n = {"all": 1, "channel": x.shape[1] if len(x.shape) > 1 else 1,
+         "element": int(__import__("numpy").prod(x.shape[1:]))}[mode]
+    alpha = create_parameter([n], "float32")
+    return _F().prelu(x, alpha)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import _C_ops, create_parameter
+
+    k = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else filter_size
+    w = create_parameter([num_filters, x.shape[1] // groups, *k], "float32")
+    return _C_ops.deformable_conv(x, offset, w, mask, stride=stride,
+                                  padding=padding, dilation=dilation,
+                                  groups=groups,
+                                  deformable_groups=deformable_groups)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    from .. import _C_ops, create_parameter
+
+    w = create_parameter([num_total_classes, input.shape[-1]], "float32")
+    b = create_parameter([num_total_classes], "float32", is_bias=True)
+    return _C_ops.nce(input, label, w, b,
+                      num_neg_samples=num_neg_samples or 10, seed=seed)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    from .. import _C_ops, create_parameter
+
+    w = create_parameter([future_context_size + 1, input.shape[-1]],
+                         "float32")
+    return _act(_C_ops.row_conv(input, w), act)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    from .. import _C_ops, create_parameter
+
+    w = create_parameter([filter_size * input.shape[-1], num_filters],
+                         "float32")
+    return _act(_C_ops.sequence_conv(input, w,
+                                     context_length=filter_size,
+                                     context_start=padding_start or
+                                     -(filter_size // 2)), act)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    from .. import _C_ops
+
+    return _C_ops.sequence_expand(x, y, ref_level)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    from .. import _C_ops
+
+    return _C_ops.sequence_pool(input, None, pool_type.upper())
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    from .. import _C_ops
+
+    return _C_ops.sequence_softmax(input)
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "FIRST")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "LAST")
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-backed sparse embedding (reference: static/nn/common.py
+    sparse_embedding → distributed lookup table). Single-process form:
+    a dense embedding lookup; the parameter-server path shards the table
+    via distributed/ps."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Eager-composable py_func (reference: static/nn/common.py py_func):
+    runs the python callable on the inputs."""
+    if isinstance(x, (list, tuple)):
+        return func(*x)
+    return func(x)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Static conditional (reference: static/nn/control_flow.py cond).
+    Under a to_static trace this lowers to lax.cond; eagerly it branches
+    on the concrete value."""
+    from ..jit.api import in_to_static_trace
+
+    if in_to_static_trace():
+        import jax
+
+        from ..core.tensor import Tensor
+
+        p = pred._data if isinstance(pred, Tensor) else pred
+        return jax.lax.cond(p.reshape(()), lambda _: true_fn(),
+                            lambda _: false_fn(), operand=None)
+    if bool(pred):
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins conditional chain (reference: control_flow.case)."""
+    for pred, fn in pred_fn_pairs:
+        if bool(pred):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Static while (reference: control_flow.while_loop). Eager: python
+    loop; traced: the caller should use lax primitives via dy2static."""
+    vars_ = list(loop_vars)
+    while bool(cond(*vars_)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Custom-gradient block in static graphs (reference:
+    static/nn/static_pylayer.py). Composed over the eager PyLayer: the
+    forward/backward callables define the op's autograd contract."""
+    from ..autograd import PyLayer
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer without backward_fn "
+                                   "cannot be differentiated")
+            return backward_fn(*grads)
+
+    return _StaticPyLayer.apply(*inputs)

@@ -217,3 +217,91 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n,
         nms_thresh=nms_thresh, min_size=min_size, eta=eta,
         pixel_offset=pixel_offset)
+
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Reference: python/paddle/vision/ops.py yolo_loss -> yolo_loss op
+    (ops/kernels/yolo_loss.py here)."""
+    return _C_ops.yolo_loss(x, gt_box, gt_label, gt_score=gt_score,
+                            anchors=anchors, anchor_mask=anchor_mask,
+                            class_num=class_num,
+                            ignore_thresh=ignore_thresh,
+                            downsample_ratio=downsample_ratio,
+                            use_label_smooth=use_label_smooth,
+                            scale_x_y=scale_x_y)
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    import numpy as _np
+
+    from .. import to_tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(_np.frombuffer(data, dtype=_np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes tensor -> CHW uint8 image (reference: vision/ops.py
+    decode_jpeg, nvjpeg on GPU; PIL on the host here — IO-side op, not a
+    compute kernel)."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    from .. import to_tensor
+
+    raw = bytes(bytearray(_np.asarray(x.numpy(), dtype=_np.uint8)))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+class RoIAlign:
+    """Layer form of roi_align (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None):
+        out = self.output_size
+        ph, pw = (out, out) if isinstance(out, int) else out
+        c = x.shape[1] // (ph * pw)
+        return _C_ops.psroi_pool(x, boxes, boxes_num, output_channels=c,
+                                 spatial_scale=self.spatial_scale,
+                                 pooled_height=ph, pooled_width=pw)

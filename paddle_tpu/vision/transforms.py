@@ -193,3 +193,476 @@ def normalize(img, mean, std, data_format="CHW"):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: functional image ops + the remaining transform classes
+# (reference: python/paddle/vision/transforms/{functional,transforms}.py).
+# Convention: functional ops take/return HWC numpy arrays (or CHW when the
+# array is detected as CHW), matching the file's ToTensor boundary.
+# ---------------------------------------------------------------------------
+
+def _hwc(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _hwc(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    arr = _hwc(img)
+    oh, ow = ((output_size, output_size)
+              if isinstance(output_size, int) else output_size)
+    h, w = arr.shape[:2]
+    top = max(0, (h - oh) // 2)
+    left = max(0, (w - ow) // 2)
+    return crop(arr, top, left, oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Zero/value out the region [i:i+h, j:j+w] (reference: functional
+    erase; works on HWC/CHW arrays and Tensors)."""
+    from ..core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        arr = img._data
+        val = jnp.broadcast_to(jnp.asarray(v, arr.dtype),
+                               arr[..., i:i + h, j:j + w].shape)
+        return Tensor._from_data(arr.at[..., i:i + h, j:j + w].set(val))
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] > 4:
+        out[:, i:i + h, j:j + w] = v      # CHW
+    else:
+        out[i:i + h, j:j + w] = v         # HWC
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _hwc(img).astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray.astype(np.asarray(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _hwc(img)
+    dt = arr.dtype
+    out = arr.astype(np.float32) * brightness_factor
+    if dt == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _hwc(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    mean = to_grayscale(f).mean()
+    out = (f - mean) * contrast_factor + mean
+    if dt == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dt)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _hwc(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = to_grayscale(f)
+    out = (f - gray) * saturation_factor + gray
+    if dt == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dt)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: shift the HSV hue channel."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _hwc(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32) / (255.0 if dt == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f[..., :3].max(-1)
+    minc = f[..., :3].min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4))
+    h = np.where(delta == 0, 0.0, h) / 6.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fpart = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fpart)
+    t = v * (1 - s * (1 - fpart))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if dt == np.uint8:
+        out = np.clip(out * 255.0, 0, 255)
+    return out.astype(dt)
+
+
+_INTERP_ORDER = {"nearest": 0, "bilinear": 1, "bicubic": 3}
+
+
+def _warp(img, inv_matrix, fill=0, interpolation="bilinear",
+          out_size=None):
+    """Inverse-map warp via scipy (per channel). inv_matrix: output (x, y)
+    -> input coords, 2x3; out_size optionally enlarges the canvas."""
+    from scipy import ndimage
+
+    arr = _hwc(img).astype(np.float32)
+    order = _INTERP_ORDER.get(interpolation, 1)
+    a, b, tx = inv_matrix[0]
+    c, d, ty = inv_matrix[1]
+    # scipy uses (row, col) = (y, x): matrix rows are [d, c] and [b, a]
+    mat = np.array([[d, c], [b, a]], np.float64)
+    off = np.array([ty, tx], np.float64)
+    shape = out_size if out_size is not None else arr.shape[:2]
+    chans = [ndimage.affine_transform(arr[..., ch], mat, offset=off,
+                                      order=order, mode="constant",
+                                      cval=fill, output_shape=tuple(shape))
+             for ch in range(arr.shape[-1])]
+    out = np.stack(chans, axis=-1)
+    return out.astype(np.asarray(img).dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    th = np.deg2rad(angle)
+    cos, sin = np.cos(th), np.sin(th)
+    out_size = None
+    ocy, ocx = cy, cx
+    if expand:
+        # round before ceil: cos(90deg) is ~6e-17 in float, which would
+        # otherwise inflate the canvas by one spurious pixel
+        nw = int(np.ceil(round(abs(w * cos) + abs(h * sin), 6)))
+        nh = int(np.ceil(round(abs(w * sin) + abs(h * cos), 6)))
+        out_size = (nh, nw)
+        ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    # inverse rotation: output coords about the (possibly new) center map
+    # back to input coords about the original center
+    inv = [[cos, sin, cx - cos * ocx - sin * ocy],
+           [-sin, cos, cy + sin * ocx - cos * ocy]]
+    return _warp(arr, inv, fill, interpolation, out_size)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix: T(center+translate) R S Shear T(-center)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0]], np.float64) * scale
+    m[0, 2] = cx + translate[0] - (m[0, 0] * cx + m[0, 1] * cy)
+    m[1, 2] = cy + translate[1] - (m[1, 0] * cx + m[1, 1] * cy)
+    # invert the 2x3 forward map
+    full = np.vstack([m, [0, 0, 1]])
+    inv = np.linalg.inv(full)[:2]
+    return _warp(arr, inv, fill, interpolation)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp mapping startpoints -> endpoints (reference:
+    functional perspective; solves the 8-dof homography)."""
+    from scipy import ndimage
+
+    arr = _hwc(img).astype(np.float32)
+    a_mat = []
+    b_vec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a_mat.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b_vec.append(sx)
+        a_mat.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b_vec.append(sy)
+    coeffs = np.linalg.solve(np.asarray(a_mat, np.float64),
+                             np.asarray(b_vec, np.float64))
+    ha, hb, hc, hd, he, hf, hg, hh = coeffs
+    h, w = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    denom = hg * xs + hh * ys + 1.0
+    src_x = (ha * xs + hb * ys + hc) / denom
+    src_y = (hd * xs + he * ys + hf) / denom
+    chans = [ndimage.map_coordinates(arr[..., ch], [src_y, src_x],
+                                     order=_INTERP_ORDER.get(interpolation,
+                                                             1),
+                                     mode="constant", cval=fill)
+             for ch in range(arr.shape[-1])]
+    return np.stack(chans, axis=-1).astype(np.asarray(img).dtype)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _hwc(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.args = (padding, fill, padding_mode)
+
+    def _apply_image(self, img):
+        return pad(img, *self.args)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = crop(arr, top, left, ch, cw)
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, (min(h, w), min(h, w))), self.size,
+                      self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale_rng = scale
+        self.shear = shear
+        self.kw = dict(interpolation=interpolation, fill=fill, center=center)
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = int(np.random.uniform(-self.translate[0],
+                                       self.translate[0]) * w)
+            ty = int(np.random.uniform(-self.translate[1],
+                                       self.translate[1]) * h)
+        sc = (np.random.uniform(*self.scale_rng)
+              if self.scale_rng is not None else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            sh = ((np.random.uniform(-s, s), 0.0) if np.isscalar(s)
+                  else (np.random.uniform(s[0], s[1]), 0.0))
+        return affine(arr, angle, (tx, ty), sc, sh, **self.kw)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        dx = int(self.distortion * w / 2)
+        dy = int(self.distortion * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] > 4
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                top = np.random.randint(0, h - eh + 1)
+                left = np.random.randint(0, w - ew + 1)
+                return erase(arr, top, left, eh, ew, self.value)
+        return arr

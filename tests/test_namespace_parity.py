@@ -1,0 +1,445 @@
+"""Submodule namespace parity + semantics for the round-5 tail batches.
+
+The oracle (tests/data/reference_submodule_all.txt) pins every name the
+reference exports from 18 submodules (568 names); when the live reference
+tree is present the fixture is cross-checked for drift. Semantics of the
+additions (optimizers, fft n-D hermitian, distributions, static.nn,
+transforms, saved_tensors_hooks, dlpack-free tails) are spot-checked
+against torch / closed forms.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                        "reference_submodule_all.txt")
+_REF_ROOT = "/root/reference/python/paddle/"
+_MODS = {
+    "nn": "nn/__init__.py", "nn.functional": "nn/functional/__init__.py",
+    "fft": "fft.py", "sparse": "sparse/__init__.py",
+    "vision.transforms": "vision/transforms/__init__.py",
+    "vision.ops": "vision/ops.py", "static": "static/__init__.py",
+    "static.nn": "static/nn/__init__.py",
+    "distribution": "distribution/__init__.py", "amp": "amp/__init__.py",
+    "autograd": "autograd/__init__.py", "io": "io/__init__.py",
+    "jit": "jit/__init__.py", "optimizer": "optimizer/__init__.py",
+    "geometric": "geometric/__init__.py", "metric": "metric/__init__.py",
+    "signal": "signal.py",
+    "incubate.nn.functional": "incubate/nn/functional/__init__.py",
+}
+
+
+def _fixture_names():
+    return sorted(set(open(_FIXTURE).read().split()))
+
+
+def test_fixture_matches_live_reference():
+    if not os.path.exists(_REF_ROOT):
+        pytest.skip("reference tree not present")
+    import re
+
+    live = set()
+    for mod, rel in _MODS.items():
+        src = open(_REF_ROOT + rel).read()
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+        for n in set(re.findall(r"'([^']+)'", m.group(1))):
+            live.add(f"{mod}.{n}")
+    assert live == set(_fixture_names()), (
+        "fixture drifted — regenerate reference_submodule_all.txt")
+
+
+def test_every_submodule_name_resolves():
+    missing = []
+    for qual in _fixture_names():
+        mod, _, name = qual.rpartition(".")
+        obj = importlib.import_module(f"paddle_tpu.{mod}")
+        if not hasattr(obj, name):
+            missing.append(qual)
+    assert not missing, f"missing submodule names: {missing}"
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestOptimizers:
+    def _run(self, P, T, steps=6, lr=0.01, **kw):
+        torch = pytest.importorskip("torch")
+        w0 = np.array([1.5, -2.0, 0.7], np.float32)
+        g_seq = [np.array([0.3, -0.1, 0.5], np.float32) * (i + 1)
+                 for i in range(steps)]
+        p = _t(w0.copy())
+        p.stop_gradient = False
+        opt = P(learning_rate=lr, parameters=[p], **kw)
+        for g in g_seq:
+            p.grad = _t(g.copy())
+            opt.step()
+            opt.clear_grad()
+        tp = torch.tensor(w0.copy(), requires_grad=True)
+        topt = T([tp], lr=lr)
+        for g in g_seq:
+            tp.grad = torch.tensor(g.copy())
+            topt.step()
+            topt.zero_grad()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_nadam_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        self._run(paddle.optimizer.NAdam, torch.optim.NAdam)
+
+    def test_radam_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        self._run(paddle.optimizer.RAdam, torch.optim.RAdam, steps=8)
+
+    def test_rprop_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        self._run(paddle.optimizer.Rprop, torch.optim.Rprop)
+
+    def test_asgd_averages_window(self):
+        w0 = np.zeros(2, np.float32)
+        p = _t(w0.copy())
+        p.stop_gradient = False
+        opt = paddle.optimizer.ASGD(learning_rate=1.0, batch_num=2,
+                                    parameters=[p])
+        for g in [np.array([1.0, 0.0], np.float32),
+                  np.array([0.0, 1.0], np.float32)]:
+            p.grad = _t(g.copy())
+            opt.step()
+            opt.clear_grad()
+        # after both grads the averaged direction is (g1+g2)/2 each step
+        np.testing.assert_allclose(p.numpy(), [-1.0, -0.5], rtol=1e-6)
+
+
+class TestFFT:
+    def test_hfftn_ihfftn_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = (rs.randn(4, 5) + 1j * rs.randn(4, 5)).astype(np.complex64)
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(_t(x)).numpy(),
+            torch.fft.hfftn(torch.tensor(x)).numpy(), rtol=1e-4, atol=1e-4)
+        r = rs.randn(4, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.ihfftn(_t(r)).numpy(),
+            torch.fft.ihfftn(torch.tensor(r)).numpy(), rtol=1e-4,
+            atol=1e-5)
+
+
+class TestSparseTail:
+    def test_addmm(self):
+        dense = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        a = np.diag([1.0, 2.0, 3.0]).astype(np.float32)
+        b = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(
+            np.array([[0, 1, 2], [0, 1, 2]]), np.array([1.0, 2.0, 3.0],
+                                                       np.float32),
+            (3, 3))
+        out = paddle.sparse.addmm(_t(dense), sp, _t(b), beta=0.5,
+                                  alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * dense + 2.0 * (a @ b),
+                                   rtol=1e-5)
+
+    def test_pca_lowrank(self):
+        rs = np.random.RandomState(0)
+        base = rs.randn(20, 3).astype(np.float32) @ \
+            rs.randn(3, 8).astype(np.float32)
+        u, s, v = paddle.sparse.pca_lowrank(_t(base), q=3)
+        # rank-3 matrix: 3 dominant singular values reconstruct it
+        centered = base - base.mean(0, keepdims=True)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, centered, atol=1e-3)
+
+
+class TestDistributionsTail:
+    torch = None
+
+    def test_chi2_mvn_independent(self):
+        torch = pytest.importorskip("torch")
+        D = paddle.distribution
+        x = np.array([0.5, 2.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            D.Chi2(3.0).log_prob(_t(x)).numpy(),
+            torch.distributions.Chi2(torch.tensor(3.0)).log_prob(
+                torch.tensor(x)).numpy(), rtol=1e-4)
+        loc = np.array([1.0, -2.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(loc, covariance_matrix=cov)
+        tm = torch.distributions.MultivariateNormal(torch.tensor(loc),
+                                                    torch.tensor(cov))
+        v = np.array([[0.0, 0.0], [1.5, -1.0]], np.float32)
+        np.testing.assert_allclose(mvn.log_prob(_t(v)).numpy(),
+                                   tm.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(mvn.entropy().numpy()),
+                                   float(tm.entropy()), rtol=1e-5)
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        val = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        ti = torch.distributions.Independent(
+            torch.distributions.Normal(torch.zeros(3, 4),
+                                       torch.ones(3, 4)), 1)
+        np.testing.assert_allclose(ind.log_prob(_t(val)).numpy(),
+                                   ti.log_prob(torch.tensor(val)).numpy(),
+                                   rtol=1e-4)
+
+    def test_lkj_and_continuous_bernoulli(self):
+        torch = pytest.importorskip("torch")
+        D = paddle.distribution
+        lkj = D.LKJCholesky(3, 1.5)
+        L = lkj.sample().numpy()
+        np.testing.assert_allclose(np.diag(L @ L.T), np.ones(3), atol=1e-5)
+        tl = torch.distributions.LKJCholesky(3, 1.5)
+        np.testing.assert_allclose(
+            float(lkj.log_prob(_t(L)).numpy()),
+            float(tl.log_prob(torch.tensor(L))), rtol=1e-4)
+        cb = D.ContinuousBernoulli(np.array([0.3, 0.7], np.float32))
+        tc = torch.distributions.ContinuousBernoulli(
+            torch.tensor([0.3, 0.7]))
+        vv = np.array([0.2, 0.9], np.float32)
+        np.testing.assert_allclose(cb.log_prob(_t(vv)).numpy(),
+                                   tc.log_prob(torch.tensor(vv)).numpy(),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(cb.mean.numpy(), tc.mean.numpy(),
+                                   rtol=1e-3)
+
+    def test_transformed_distribution_is_lognormal(self):
+        torch = pytest.importorskip("torch")
+        D = paddle.distribution
+
+        class ExpT:
+            def forward(self, x):
+                return paddle.exp(x)
+
+            def inverse(self, y):
+                return paddle.log(y)
+
+            def forward_log_det_jacobian(self, x):
+                return x
+
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [ExpT()])
+        val = np.array([0.5, 2.0], np.float32)
+        ref = torch.distributions.LogNormal(0.0, 1.0).log_prob(
+            torch.tensor(val)).numpy()
+        np.testing.assert_allclose(td.log_prob(_t(val)).numpy(), ref,
+                                   rtol=1e-4)
+
+
+class TestReviewRegressions:
+    """Fixes from the round-5 namespace-batch review."""
+
+    def test_lkj_sample_statistics_match_theory(self):
+        D = paddle.distribution
+        Ls = D.LKJCholesky(3, 1.0).sample((4000,)).numpy()
+        corr = np.einsum("bij,bkj->bik", Ls, Ls)
+        # uniform LKJ (eta=1): Var[corr_ij] = 1/(dim+1)
+        assert abs(corr[:, 2, 0].var() - 0.25) < 0.04
+        assert abs(corr[:, 1, 0].var() - 0.25) < 0.04
+
+    def test_continuous_bernoulli_rsample_grad_finite_at_half(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import ContinuousBernoulli
+
+        def f(lam):
+            return ContinuousBernoulli(paddle.Tensor(lam)).rsample()._data.sum()
+
+        g = jax.grad(f)(jnp.float32(0.5))
+        assert bool(jnp.isfinite(g))
+
+    def test_rotate_expand_and_nearest(self):
+        T = paddle.vision.transforms
+        img = (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8)
+        r = T.rotate(img.astype(np.float32), 90.0, expand=True)
+        assert r.shape[:2] == (6, 8)
+        rn = T.rotate(img.astype(np.float32), 90.0, expand=True,
+                      interpolation="nearest")
+        # 90-degree nearest rotation is a permutation of the pixels
+        assert sorted(rn.reshape(-1)) == sorted(
+            img.astype(np.float32).reshape(-1))
+
+    def test_adaptive_max_pool3d_return_mask_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.nn as nn
+
+        for shape in [(1, 2, 4, 4, 4), (1, 2, 5, 4, 3)]:
+            x = np.random.RandomState(1).randn(*shape).astype(np.float32)
+            vals, idx = nn.AdaptiveMaxPool3D(2, return_mask=True)(_t(x))
+            tv, ti = torch.nn.functional.adaptive_max_pool3d(
+                torch.tensor(x), 2, return_indices=True)
+            np.testing.assert_allclose(vals.numpy(), tv.numpy())
+            np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+
+
+class TestStaticTail:
+    def test_static_nn_functions(self):
+        st = paddle.static
+        x = _t(np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+        assert list(st.nn.conv2d(x, 4, 3).shape) == [2, 4, 6, 6]
+        assert list(st.nn.batch_norm(x).shape) == [2, 3, 8, 8]
+        c = st.nn.cond(_t(np.array(True)), lambda: _t(1.0), lambda: _t(0.0))
+        assert float(c.numpy()) == 1.0
+        out = st.nn.while_loop(lambda i: i < 3, lambda i: i + 1, [_t(0)])
+        assert int(out[0].numpy()) == 3
+        assert int(st.nn.switch_case(_t(1), {0: lambda: _t(10),
+                                             1: lambda: _t(20)}).numpy()) == 20
+
+    def test_scope_and_program_state(self, tmp_path):
+        st = paddle.static
+        scope = st.Scope()
+        with st.scope_guard(scope):
+            assert st.global_scope() is scope
+            st.global_scope().set("v", 41)
+            assert scope.find_var("v").get_tensor() == 41
+        assert st.global_scope() is not scope
+        assert len(st.cpu_places(2)) == 2
+
+    def test_ema(self):
+        st = paddle.static
+        p = paddle.create_parameter([2], "float32")
+        p.set_value(np.array([1.0, 1.0], np.float32))
+        ema = st.ExponentialMovingAverage(0.5)
+        ema.update([p])
+        p.set_value(np.array([3.0, 3.0], np.float32))
+        ema.update()
+        with ema.apply():
+            np.testing.assert_allclose(p.numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(p.numpy(), [3.0, 3.0])
+
+    def test_ipu_raises_like_non_ipu_build(self):
+        with pytest.raises(RuntimeError, match="IPU"):
+            paddle.static.IpuStrategy()
+
+
+class TestSavedTensorsHooks:
+    def test_pack_unpack_roundtrip_grad(self):
+        packed = []
+
+        def pack(t):
+            packed.append(True)
+            return t.numpy()
+
+        def unpack(o):
+            return paddle.to_tensor(o)
+
+        x = _t(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        x.stop_gradient = False
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = paddle.sin(x) * x
+        y.sum().backward()
+        ref = np.cos(x.numpy()) * x.numpy() + np.sin(x.numpy())
+        np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-5)
+        assert packed  # hooks actually fired
+
+
+class TestIOJitVisionTails:
+    def test_subset_random_sampler(self):
+        s = paddle.io.SubsetRandomSampler([3, 7, 9])
+        assert sorted(s) == [3, 7, 9] and len(s) == 3
+
+    def test_get_worker_info_main_process(self):
+        assert paddle.io.get_worker_info() is None
+
+    def test_enable_to_static_switch(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(a):
+            calls.append(1)
+            return a * 2
+
+        paddle.jit.enable_to_static(False)
+        try:
+            out = f(_t(np.ones(2, np.float32)))
+            np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+        finally:
+            paddle.jit.enable_to_static(True)
+
+    def test_vision_ops_layers(self):
+        import paddle_tpu.vision.ops as vo
+
+        x = _t(np.random.RandomState(0).randn(1, 4, 8, 8).astype(np.float32))
+        boxes = _t(np.array([[0.0, 0.0, 4.0, 4.0]], np.float32))
+        num = _t(np.array([1], np.int32))
+        out = vo.RoIAlign(2, spatial_scale=1.0)(x, boxes, num)
+        assert list(out.shape) == [1, 4, 2, 2]
+        out = vo.RoIPool(2, spatial_scale=1.0)(x, boxes, num)
+        assert list(out.shape) == [1, 4, 2, 2]
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        import paddle_tpu.vision.ops as vo
+        from PIL import Image
+
+        img = Image.fromarray(
+            (np.random.RandomState(0).rand(6, 5, 3) * 255).astype(np.uint8))
+        path = str(tmp_path / "img.jpg")
+        img.save(path)
+        raw = vo.read_file(path)
+        assert raw.dtype == "uint8"
+        decoded = vo.decode_jpeg(raw)
+        assert list(decoded.shape) == [3, 6, 5]
+
+    def test_fused_linear_activation(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        w = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        b = np.zeros(3, np.float32)
+        out = IF.fused_linear_activation(_t(x), _t(w), _t(b),
+                                         activation="relu").numpy()
+        np.testing.assert_allclose(out, np.maximum(x @ w, 0), rtol=1e-5)
+
+
+class TestTransformsTail:
+    IMG = (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8)
+
+    def test_flip_crop_pad(self):
+        T = paddle.vision.transforms
+        np.testing.assert_array_equal(T.hflip(T.hflip(self.IMG)), self.IMG)
+        np.testing.assert_array_equal(T.vflip(T.vflip(self.IMG)), self.IMG)
+        assert T.center_crop(self.IMG, 4).shape == (4, 4, 3)
+        assert T.pad(self.IMG, 2).shape == (12, 10, 3)
+
+    def test_color_ops(self):
+        T = paddle.vision.transforms
+        np.testing.assert_allclose(
+            T.adjust_hue(self.IMG, 0.0).astype(int), self.IMG.astype(int),
+            atol=2)
+        b = T.adjust_brightness(self.IMG.astype(np.float32), 2.0)
+        np.testing.assert_allclose(b, self.IMG.astype(np.float32) * 2.0)
+        g = T.to_grayscale(self.IMG, 3)
+        assert g.shape == (8, 6, 3)
+        assert np.allclose(g[..., 0], g[..., 1])
+
+    def test_geometry_ops(self):
+        T = paddle.vision.transforms
+        r = T.rotate(self.IMG.astype(np.float32), 360.0)
+        np.testing.assert_allclose(r[1:-1, 1:-1],
+                                   self.IMG.astype(np.float32)[1:-1, 1:-1],
+                                   atol=1.0)
+        ident = T.affine(self.IMG.astype(np.float32))
+        np.testing.assert_allclose(ident, self.IMG.astype(np.float32),
+                                   atol=1e-3)
+        pts = [(0, 0), (5, 0), (5, 7), (0, 7)]
+        p = T.perspective(self.IMG.astype(np.float32), pts, pts)
+        np.testing.assert_allclose(p, self.IMG.astype(np.float32),
+                                   atol=1e-3)
+
+    def test_random_transform_classes(self):
+        T = paddle.vision.transforms
+        for t in [T.ColorJitter(0.4, 0.4, 0.4, 0.1),
+                  T.RandomResizedCrop(5), T.RandomRotation(10),
+                  T.RandomAffine(10, translate=(0.1, 0.1)),
+                  T.RandomPerspective(prob=1.0),
+                  T.RandomErasing(prob=1.0), T.RandomVerticalFlip(1.0),
+                  T.Pad(1), T.Grayscale()]:
+            out = t(self.IMG)
+            assert out is not None and out.ndim == 3

@@ -1,0 +1,341 @@
+"""Round-5 nn tail tests: 1D/3D pools, unpools, transposed convs, dropout
+variants, loss modules — semantics pinned against torch where torch has the
+same operator, shape/finiteness otherwise.
+Reference: python/paddle/nn/layer/* and nn/functional/*.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+RS = np.random.RandomState
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestPools:
+    def test_adaptive_avg_pool1d_vs_torch(self):
+        x = RS(0).randn(2, 3, 11).astype(np.float32)
+        got = F.adaptive_avg_pool1d(_t(x), 4).numpy()
+        ref = torch.nn.functional.adaptive_avg_pool1d(torch.tensor(x),
+                                                      4).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_adaptive_max_pool1d_vs_torch(self):
+        x = RS(1).randn(2, 3, 11).astype(np.float32)
+        got = F.adaptive_max_pool1d(_t(x), 4).numpy()
+        ref = torch.nn.functional.adaptive_max_pool1d(torch.tensor(x),
+                                                      4).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_adaptive_avg_pool3d_vs_torch(self):
+        x = RS(2).randn(1, 2, 5, 7, 6).astype(np.float32)
+        got = F.adaptive_avg_pool3d(_t(x), (2, 3, 4)).numpy()
+        ref = torch.nn.functional.adaptive_avg_pool3d(
+            torch.tensor(x), (2, 3, 4)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_adaptive_max_pool3d_vs_torch(self):
+        x = RS(3).randn(1, 2, 5, 7, 6).astype(np.float32)
+        got = F.adaptive_max_pool3d(_t(x), (2, 3, 4)).numpy()
+        ref = torch.nn.functional.adaptive_max_pool3d(
+            torch.tensor(x), (2, 3, 4)).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_max_avg_pool3d_vs_torch(self):
+        x = RS(4).randn(1, 2, 6, 6, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            F.max_pool3d(_t(x), 2).numpy(),
+            torch.nn.functional.max_pool3d(torch.tensor(x), 2).numpy())
+        np.testing.assert_allclose(
+            F.avg_pool3d(_t(x), 2).numpy(),
+            torch.nn.functional.avg_pool3d(torch.tensor(x), 2).numpy(),
+            rtol=1e-6)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = RS(5).randn(1, 2, 6, 6).astype(np.float32)
+        pooled, idx = F.max_pool2d_with_index(_t(x), 2)
+        up = F.max_unpool2d(pooled, idx, 2, output_size=[6, 6]).numpy()
+        tp, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2,
+                                                return_indices=True)
+        ref = torch.nn.functional.max_unpool2d(tp, ti, 2,
+                                               output_size=[6, 6]).numpy()
+        np.testing.assert_allclose(up, ref)
+
+    def test_lp_pool1d_vs_torch(self):
+        x = np.abs(RS(6).randn(2, 3, 8)).astype(np.float32)
+        got = F.lp_pool1d(_t(x), 2.0, 2).numpy()
+        ref = torch.nn.functional.lp_pool1d(torch.tensor(x), 2.0, 2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_pool_layers_forward(self):
+        x = _t(RS(7).randn(1, 2, 6, 6, 6).astype(np.float32))
+        assert list(nn.MaxPool3D(2)(x).shape) == [1, 2, 3, 3, 3]
+        assert list(nn.AvgPool3D(2)(x).shape) == [1, 2, 3, 3, 3]
+        assert list(nn.AdaptiveAvgPool3D(1)(x).shape) == [1, 2, 1, 1, 1]
+        x1 = _t(RS(8).randn(1, 2, 9).astype(np.float32))
+        assert list(nn.AdaptiveAvgPool1D(4)(x1).shape) == [1, 2, 4]
+        assert list(nn.LPPool1D(2.0, 3)(x1).shape) == [1, 2, 3]
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_vs_torch(self):
+        x = RS(9).randn(2, 3, 8).astype(np.float32)
+        w = RS(10).randn(3, 4, 3).astype(np.float32)
+        got = F.conv1d_transpose(_t(x), _t(w), stride=2, padding=1).numpy()
+        ref = torch.nn.functional.conv_transpose1d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv_transpose_layers(self):
+        m1 = nn.Conv1DTranspose(3, 6, 3, stride=2)
+        y = m1(_t(RS(11).randn(1, 3, 5).astype(np.float32)))
+        assert y.shape[1] == 6
+        m3 = nn.Conv3DTranspose(2, 4, 3)
+        y3 = m3(_t(RS(12).randn(1, 2, 4, 4, 4).astype(np.float32)))
+        assert y3.shape[1] == 4 and y3.shape[2] == 6
+
+
+class TestDropoutVariants:
+    def test_alpha_dropout_stats(self):
+        x = _t(RS(13).randn(20000).astype(np.float32))
+        y = F.alpha_dropout(x, p=0.3, training=True).numpy()
+        # self-normalizing: mean/var approximately preserved
+        assert abs(y.mean()) < 0.1 and abs(y.std() - 1.0) < 0.15
+        y_eval = F.alpha_dropout(x, p=0.3, training=False)
+        np.testing.assert_allclose(y_eval.numpy(), x.numpy())
+
+    def test_dropout3d_drops_whole_channels(self):
+        x = _t(np.ones((2, 8, 3, 3, 3), np.float32))
+        y = nn.Dropout3D(0.5)(x).numpy()
+        per_channel = y.reshape(2, 8, -1)
+        for b in range(2):
+            for c in range(8):
+                vals = np.unique(per_channel[b, c])
+                assert len(vals) == 1  # all-kept (scaled) or all-dropped
+
+
+class TestLosses:
+    def test_cosine_embedding_loss_vs_torch(self):
+        a = RS(14).randn(4, 6).astype(np.float32)
+        b = RS(15).randn(4, 6).astype(np.float32)
+        lab = np.array([1, -1, 1, -1], np.int64)
+        got = float(F.cosine_embedding_loss(_t(a), _t(b), _t(lab),
+                                            margin=0.2).numpy())
+        ref = float(torch.nn.functional.cosine_embedding_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(lab),
+            margin=0.2))
+        assert abs(got - ref) < 1e-5
+
+    def test_hinge_embedding_loss_vs_torch(self):
+        x = RS(16).randn(4, 5).astype(np.float32)
+        lab = np.where(RS(17).rand(4, 5) < 0.5, 1.0, -1.0).astype(np.float32)
+        got = float(F.hinge_embedding_loss(_t(x), _t(lab)).numpy())
+        ref = float(torch.nn.functional.hinge_embedding_loss(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(got - ref) < 1e-5
+
+    def test_soft_margin_loss_vs_torch(self):
+        x = RS(18).randn(6).astype(np.float32)
+        lab = np.where(RS(19).rand(6) < 0.5, 1.0, -1.0).astype(np.float32)
+        got = float(F.soft_margin_loss(_t(x), _t(lab)).numpy())
+        ref = float(torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(got - ref) < 1e-5
+
+    def test_multi_margin_loss_vs_torch(self):
+        x = RS(20).randn(5, 7).astype(np.float32)
+        lab = RS(21).randint(0, 7, (5,))
+        got = float(F.multi_margin_loss(_t(x), _t(lab)).numpy())
+        ref = float(torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(got - ref) < 1e-5
+
+    def test_multi_label_soft_margin_vs_torch(self):
+        x = RS(22).randn(4, 6).astype(np.float32)
+        lab = (RS(23).rand(4, 6) < 0.5).astype(np.float32)
+        got = float(F.multi_label_soft_margin_loss(_t(x), _t(lab)).numpy())
+        ref = float(torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(got - ref) < 1e-5
+
+    def test_poisson_nll_vs_torch(self):
+        x = RS(24).randn(8).astype(np.float32)
+        lab = np.abs(RS(25).randn(8)).astype(np.float32)
+        got = float(F.poisson_nll_loss(_t(x), _t(lab)).numpy())
+        ref = float(torch.nn.functional.poisson_nll_loss(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(got - ref) < 1e-5
+
+    def test_gaussian_nll_vs_torch(self):
+        x = RS(26).randn(8).astype(np.float32)
+        lab = RS(27).randn(8).astype(np.float32)
+        var = np.abs(RS(28).randn(8)).astype(np.float32) + 0.1
+        got = float(F.gaussian_nll_loss(_t(x), _t(lab), _t(var)).numpy())
+        ref = float(torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(lab), torch.tensor(var)))
+        assert abs(got - ref) < 1e-5
+
+    def test_triplet_margin_vs_torch(self):
+        a = RS(29).randn(4, 6).astype(np.float32)
+        p = RS(30).randn(4, 6).astype(np.float32)
+        n = RS(31).randn(4, 6).astype(np.float32)
+        got = float(F.triplet_margin_loss(_t(a), _t(p), _t(n)).numpy())
+        ref = float(torch.nn.functional.triplet_margin_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)))
+        assert abs(got - ref) < 1e-4
+        got_l = float(nn.TripletMarginLoss(swap=True)(_t(a), _t(p),
+                                                      _t(n)).numpy())
+        ref_l = float(torch.nn.TripletMarginLoss(swap=True)(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)))
+        assert abs(got_l - ref_l) < 1e-4
+
+    def test_pairwise_distance_vs_torch(self):
+        a = RS(32).randn(4, 6).astype(np.float32)
+        b = RS(33).randn(4, 6).astype(np.float32)
+        got = F.pairwise_distance(_t(a), _t(b)).numpy()
+        ref = torch.nn.functional.pairwise_distance(
+            torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_sigmoid_focal_loss_shape_and_value(self):
+        logit = RS(34).randn(4, 3).astype(np.float32)
+        lab = (RS(35).rand(4, 3) < 0.3).astype(np.float32)
+        loss = float(F.sigmoid_focal_loss(_t(logit), _t(lab)).numpy())
+        # closed-form recompute in numpy
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(lab * np.log(p) + (1 - lab) * np.log(1 - p))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = 0.25 * lab + 0.75 * (1 - lab)
+        want = float((a_t * (1 - p_t) ** 2.0 * ce).sum())
+        assert abs(loss - want) < 1e-3
+
+    def test_dice_loss_range(self):
+        probs = paddle.nn.functional.softmax(
+            _t(RS(36).randn(3, 5).astype(np.float32)), axis=-1)
+        lab = _t(RS(37).randint(0, 5, (3, 1)))
+        loss = float(F.dice_loss(probs, lab).numpy())
+        assert 0.0 <= loss <= 1.0
+
+    def test_adaptive_log_softmax_vs_torch(self):
+        in_f, n_cls = 8, 12
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(in_f, n_cls, cutoffs=[4, 8],
+                                                 div_value=2.0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(in_f, n_cls, cutoffs=[4, 8],
+                                          div_value=2.0)
+        # copy torch's weights in (torch head.weight is [out, in])
+        m.head_weight.set_value(
+            tm.head.weight.detach().numpy().T.astype(np.float32))
+        for ci in range(2):
+            w1 = tm.tail[ci][0].weight.detach().numpy().T.astype(np.float32)
+            w2 = tm.tail[ci][1].weight.detach().numpy().T.astype(np.float32)
+            m.tail_weights[ci][0].set_value(w1)
+            m.tail_weights[ci][1].set_value(w2)
+        x = RS(38).randn(6, in_f).astype(np.float32)
+        y = RS(39).randint(0, n_cls, (6,))
+        out, loss = m(_t(x), _t(y))
+        tout = tm(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(),
+                                   tout.output.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        assert abs(float(loss.numpy()) - float(tout.loss)) < 1e-4
+
+
+class TestMisc:
+    def test_zeropad2d_and_pad_layers(self):
+        x = _t(RS(40).randn(1, 2, 3, 3).astype(np.float32))
+        y = F.zeropad2d(x, [1, 2, 3, 4])
+        assert list(y.shape) == [1, 2, 10, 6]
+        x1 = _t(RS(41).randn(1, 2, 5).astype(np.float32))
+        assert list(nn.ZeroPad1D(2)(x1).shape) == [1, 2, 9]
+        x3 = _t(RS(42).randn(1, 2, 3, 3, 3).astype(np.float32))
+        assert list(nn.ZeroPad3D(1)(x3).shape) == [1, 2, 5, 5, 5]
+
+    def test_upsampling_layers(self):
+        x = _t(RS(43).randn(1, 2, 4, 4).astype(np.float32))
+        assert list(nn.UpsamplingNearest2D(scale_factor=2)(x).shape) == \
+            [1, 2, 8, 8]
+        assert list(nn.UpsamplingBilinear2D(size=[6, 6])(x).shape) == \
+            [1, 2, 6, 6]
+
+    def test_bilinear_layer_vs_torch(self):
+        m = nn.Bilinear(3, 4, 5, bias_attr=False)
+        tw = RS(44).randn(5, 3, 4).astype(np.float32)
+        m.weight.set_value(tw)
+        x1 = RS(45).randn(2, 3).astype(np.float32)
+        x2 = RS(46).randn(2, 4).astype(np.float32)
+        got = m(_t(x1), _t(x2)).numpy()
+        ref = torch.nn.functional.bilinear(
+            torch.tensor(x1), torch.tensor(x2), torch.tensor(tw)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({"a": paddle.create_parameter([2, 2],
+                                                            "float32")})
+        pd["b"] = paddle.create_parameter([3], "float32")
+        assert set(pd.keys()) == {"a", "b"}
+        assert len(list(pd.values())) == 2
+        assert len(pd) == 2
+
+    def test_unflatten_softmax2d_channelshuffle(self):
+        x = _t(RS(47).randn(2, 6, 4).astype(np.float32))
+        assert list(nn.Unflatten(1, [2, 3])(x).shape) == [2, 2, 3, 4]
+        img = _t(RS(48).randn(1, 4, 3, 3).astype(np.float32))
+        s = nn.Softmax2D()(img).numpy()
+        np.testing.assert_allclose(s.sum(axis=1), np.ones((1, 3, 3)),
+                                   rtol=1e-5)
+        assert list(nn.ChannelShuffle(2)(img).shape) == [1, 4, 3, 3]
+
+    def test_rrelu_modes(self):
+        x = _t(RS(49).randn(100).astype(np.float32))
+        m = nn.RReLU()
+        m.eval()
+        y = m(x).numpy()
+        neg = x.numpy() < 0
+        slope = np.mean((1 / 8 + 1 / 3) / 2)
+        np.testing.assert_allclose(y[neg], x.numpy()[neg] * slope, rtol=1e-5)
+
+    def test_inplace_activations(self):
+        z = _t(np.array([-1.0, 2.0], np.float32))
+        F.relu_(z)
+        np.testing.assert_allclose(z.numpy(), [0.0, 2.0])
+        w = _t(np.array([-5.0, 5.0], np.float32))
+        F.hardtanh_(w)
+        np.testing.assert_allclose(w.numpy(), [-1.0, 1.0])
+
+    def test_rnnt_loss_runs(self):
+        B, T, U, V = 2, 4, 3, 5
+        acts = _t(RS(50).randn(B, T, U, V).astype(np.float32))
+        labels = _t(RS(51).randint(1, V, (B, U - 1)).astype(np.int32))
+        in_len = _t(np.full((B,), T, np.int32))
+        lab_len = _t(np.full((B,), U - 1, np.int32))
+        loss = F.rnnt_loss(acts, labels, in_len, lab_len)
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_dynamic_decode_beam_search(self):
+        V, H = 7, 5
+
+        class Cell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.proj = nn.Linear(H, H)
+                self.out = nn.Linear(H, V)
+
+            def forward(self, tok, state):
+                h = paddle.nn.functional.relu(self.proj(state))
+                return self.out(h), h
+
+        cell = Cell()
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=2)
+        init = paddle.to_tensor(RS(52).randn(3, H).astype(np.float32))
+        ids, state = nn.dynamic_decode(dec, init, max_step_num=5)
+        assert ids.shape[0] == 3 and ids.shape[2] == 2

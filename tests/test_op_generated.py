@@ -492,6 +492,14 @@ add_specs({
     "adaptive_avg_pool2d": S([sym(1, 2, 4, 4)], kwargs={"output_size": 2},
                              grad=(0,)),
     "adaptive_max_pool2d": S([sym(1, 2, 4, 4)], kwargs={"output_size": 2}),
+    # non-divisible sizes exercise the variable-window interval-matrix path
+    "adaptive_avg_pool1d": S([sym(1, 2, 7)], kwargs={"output_size": 3},
+                             grad=(0,)),
+    "adaptive_max_pool1d": S([sym(1, 2, 7)], kwargs={"output_size": 3}),
+    "adaptive_avg_pool3d": S([sym(1, 2, 5, 4, 3)], kwargs={"output_size": 2},
+                             grad=(0,)),
+    "adaptive_max_pool3d": S([sym(1, 2, 5, 4, 3)],
+                             kwargs={"output_size": 2}),
     "layer_norm": S([sym(2, 4), pos(4, seed=9), sym(4, seed=4)],
                     grad=(0, 1, 2)),
     "rms_norm": S([sym(2, 4), pos(4, seed=9)], grad=(0, 1)),

@@ -9,6 +9,12 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import paddle_tpu  # noqa: F401  (registers all ops)
+# lazy registrars: these packages add ops at THEIR import time, not the
+# package root's — load them so the manifest covers the full registry
+# (tests/test_gen_bindings.py enforces set equality with everything loaded)
+import paddle_tpu.geometric  # noqa: F401
+import paddle_tpu.quantization  # noqa: F401
+import paddle_tpu.incubate.nn.functional  # noqa: F401
 from paddle_tpu.ops.dispatch import OPS
 
 HEADER = [

@@ -1,0 +1,117 @@
+"""Verify drive (round 5, session 3d): namespace-parity tail driven as a
+reference user's workload — transforms data prep, nn tail layers, NAdam,
+distributions, static.nn, saved-tensor hooks.
+
+Run: cd /root/repo && python verify_drive_r5k.py
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+
+t0 = time.time()
+
+
+def check(name, ok):
+    print(f"[{time.time() - t0:6.1f}s] {'PASS' if ok else 'FAIL'}  {name}")
+    if not ok:
+        sys.exit(1)
+
+
+rs = np.random.RandomState(0)
+
+# 1. torchvision-style input pipeline with the new transforms
+T = paddle.vision.transforms
+aug = T.Compose([T.RandomResizedCrop(16), T.ColorJitter(0.2, 0.2, 0.2, 0.05),
+                 T.RandomVerticalFlip(0.5), T.ToTensor()])
+imgs = np.stack([aug((rs.rand(24, 20, 3) * 255).astype(np.uint8))
+                 for _ in range(8)])
+check("transforms pipeline -> CHW batch", imgs.shape == (8, 3, 16, 16))
+
+# 2. a model using the round-5 layer tail, trained with NAdam
+nn = paddle.nn
+model = nn.Sequential(
+    nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+    nn.LPPool2D(2.0, 2),
+    nn.AlphaDropout(0.1),
+    nn.AdaptiveAvgPool2D(4),
+    nn.Flatten(),
+    nn.Linear(8 * 16, 10),
+)
+model.train()
+opt = paddle.optimizer.NAdam(learning_rate=2e-3,
+                             parameters=model.parameters())
+x = paddle.to_tensor(imgs.astype(np.float32))
+y = paddle.to_tensor(rs.randint(0, 10, (8,)))
+first = None
+for _ in range(8):
+    loss = nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    first = first if first is not None else float(loss.numpy())
+check(f"nn-tail model trains under NAdam "
+      f"({first:.3f} -> {float(loss.numpy()):.3f})",
+      float(loss.numpy()) < first)
+
+# 3. training step under saved_tensors_hooks (activation offload pattern)
+offloaded = []
+with paddle.autograd.saved_tensors_hooks(
+        lambda t: (offloaded.append(1), t.numpy())[1],
+        lambda o: paddle.to_tensor(o)):
+    loss = nn.functional.cross_entropy(model(x), y)
+loss.backward()
+opt.step()
+opt.clear_grad()
+check(f"saved_tensors_hooks offloads ({len(offloaded)} tensors) and trains",
+      len(offloaded) > 0)
+
+# 4. distributions: fit an MVN by maximizing log-likelihood of samples
+D = paddle.distribution
+true = D.MultivariateNormal(np.array([1.0, -1.0], np.float32),
+                            covariance_matrix=np.array(
+                                [[1.5, 0.3], [0.3, 0.8]], np.float32))
+data = true.sample([2000])
+emp_mean = data.numpy().mean(0)
+check("MVN sampling matches parameters",
+      np.allclose(emp_mean, [1.0, -1.0], atol=0.1))
+lp = true.log_prob(data)
+check("MVN log_prob finite over batch",
+      np.isfinite(lp.numpy()).all())
+
+# 5. static.nn + scope utilities
+st = paddle.static
+scope = st.Scope()
+with st.scope_guard(scope):
+    v = st.create_global_var([2], 3.0, "float32", name="gv")
+    got = scope.find_var("gv").get_tensor()
+check("static scope/global var", float(np.asarray(got.numpy())[0]) == 3.0)
+branch = st.nn.cond(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0),
+                    lambda: paddle.to_tensor(2.0))
+check("static.nn.cond eager branch", float(branch.numpy()) == 2.0)
+
+# 6. jit.enable_to_static escape hatch round trip
+@paddle.jit.to_static
+def double(a):
+    return a * 2
+
+
+paddle.jit.enable_to_static(False)
+eager_out = double(paddle.to_tensor(np.ones(3, np.float32)))
+paddle.jit.enable_to_static(True)
+static_out = double(paddle.to_tensor(np.ones(3, np.float32)))
+check("enable_to_static toggles",
+      np.allclose(eager_out.numpy(), 2.0)
+      and np.allclose(static_out.numpy(), 2.0))
+
+print(f"ALL PASS in {time.time() - t0:.1f}s")
