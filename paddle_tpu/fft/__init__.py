@@ -131,7 +131,12 @@ def hfftn(x, s=None, axes=None, norm="backward", name=None):
     complex FFT over the leading axes, Hermitian c2r FFT over the last —
     the per-axis norm factors compose to the n-D convention."""
     def kernel(a):
-        ax = tuple(axes) if axes is not None else tuple(range(a.ndim))
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(a.ndim - len(s), a.ndim))  # last len(s) axes
+        else:
+            ax = tuple(range(a.ndim))
         lead, last = ax[:-1], ax[-1]
         n_last = (s[-1] if s is not None
                   else 2 * (a.shape[last] - 1))
@@ -146,7 +151,12 @@ def ihfftn(x, s=None, axes=None, norm="backward", name=None):
     """Inverse of hfftn (reference: fft.py ihfftn): real → Hermitian
     half-spectrum."""
     def kernel(a):
-        ax = tuple(axes) if axes is not None else tuple(range(a.ndim))
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(a.ndim - len(s), a.ndim))  # last len(s) axes
+        else:
+            ax = tuple(range(a.ndim))
         lead, last = ax[:-1], ax[-1]
         out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=last,
                             norm=_norm(norm))

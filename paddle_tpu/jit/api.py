@@ -317,7 +317,8 @@ class StaticFunction:
             layer = args[0]
             args = args[1:]
         if not _to_static_enabled[0]:  # jit.enable_to_static(False)
-            return self._fn(*args, **kwargs)
+            # orig_args keeps the Layer instance for the unbound-forward case
+            return self._fn(*orig_args, **orig_kwargs)
         if in_to_static_trace():
             return self._fn(*args, **kwargs)
 

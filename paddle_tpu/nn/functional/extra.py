@@ -205,11 +205,15 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
-    """Drops whole 3-D channels (reference functional/common.py)."""
+    """Drops whole 3-D channels (reference functional/common.py); the
+    channel axis follows data_format."""
     if not training or p == 0.0:
         return x
     shape = list(x.shape)
-    mask_shape = shape[:2] + [1, 1, 1]
+    if data_format == "NDHWC":
+        mask_shape = [shape[0], 1, 1, 1, shape[-1]]
+    else:
+        mask_shape = shape[:2] + [1, 1, 1]
     ones = OPS["full"](mask_shape, 1.0 - p, x.dtype)
     mask = OPS["cast"](OPS["bernoulli"](ones), x.dtype)
     return x * mask / (1.0 - p)
@@ -311,7 +315,8 @@ def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
     oh = OPS["one_hot"](label, C)
     m = m * (1.0 - oh)
     if weight is not None:
-        m = m * OPS["gather"](weight, label)
+        # per-sample weight w[y_i], broadcast over the class axis
+        m = m * OPS["reshape"](OPS["gather"](weight, label), [-1, 1])
     loss = OPS["sum"](m, 1) / float(C)
     return _reduce(loss, reduction)
 

@@ -119,11 +119,15 @@ class FractionalMaxPool2D(Layer):
                  return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.kernel_size = kernel_size
         self.random_u = random_u
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.fractional_max_pool2d(x, self.output_size,
-                                       random_u=self.random_u)
+                                       kernel_size=self.kernel_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
 
 
 class FractionalMaxPool3D(Layer):
@@ -131,11 +135,15 @@ class FractionalMaxPool3D(Layer):
                  return_mask=False, name=None):
         super().__init__()
         self.output_size = output_size
+        self.kernel_size = kernel_size
         self.random_u = random_u
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.fractional_max_pool3d(x, self.output_size,
-                                       random_u=self.random_u)
+                                       kernel_size=self.kernel_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
 
 
 class MaxUnPool1D(Layer):

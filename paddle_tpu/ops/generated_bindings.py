@@ -998,12 +998,12 @@ def frac(x):
     return _OPS['frac'](x)
 
 
-def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None):
-    return _OPS['fractional_max_pool2d'](x, output_size, kernel_size=kernel_size, random_u=random_u)
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None, return_mask=False):
+    return _OPS['fractional_max_pool2d'](x, output_size, kernel_size=kernel_size, random_u=random_u, return_mask=return_mask)
 
 
-def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None):
-    return _OPS['fractional_max_pool3d'](x, output_size, kernel_size=kernel_size, random_u=random_u)
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None, return_mask=False):
+    return _OPS['fractional_max_pool3d'](x, output_size, kernel_size=kernel_size, random_u=random_u, return_mask=return_mask)
 
 
 def frame(x, frame_length, hop_length, axis=-1):

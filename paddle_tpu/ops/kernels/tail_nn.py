@@ -285,12 +285,33 @@ def _fractional_bounds(in_size, out_size, u=0.5):
     return idx
 
 
+def _bounds_mask(bounds, n, out):
+    """[out, n] bool membership from fractional window bounds."""
+    import numpy as _np
+
+    m = _np.zeros((out, n), bool)
+    for i in range(out):
+        m[i, bounds[i]:max(bounds[i + 1], bounds[i] + 1)] = True
+    return jnp.asarray(m)
+
+
 @register_op
-def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None):
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
     oh, ow = _pair(output_size)
     u = 0.5 if random_u is None else float(random_u)
     hb = _fractional_bounds(x.shape[2], oh, u)
     wb = _fractional_bounds(x.shape[3], ow, u)
+    if return_mask:
+        N, C, H, W = x.shape
+        m = (_bounds_mask(hb, H, oh)[:, None, :, None]
+             & _bounds_mask(wb, W, ow)[None, :, None, :])
+        m = m.reshape(oh * ow, H * W)
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        windows = jnp.where(m[None, None], x.reshape(N, C, 1, H * W), neg)
+        vals = windows.max(axis=3).reshape(N, C, oh, ow)
+        idx = windows.argmax(axis=3).astype(jnp.int64).reshape(N, C, oh, ow)
+        return vals, idx
     rows = [jnp.max(x[:, :, hb[i]:max(hb[i + 1], hb[i] + 1)], axis=2)
             for i in range(oh)]
     stacked = jnp.stack(rows, axis=2)  # [N, C, oh, W]
@@ -300,10 +321,26 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None):
 
 
 @register_op
-def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None):
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
     od, oh, ow = _pair(output_size, 3)
     u = 0.5 if random_u is None else float(random_u)
     db = _fractional_bounds(x.shape[2], od, u)
+    if return_mask:
+        N, C, D, H, W = x.shape
+        hb = _fractional_bounds(H, oh, u)
+        wb = _fractional_bounds(W, ow, u)
+        m = (_bounds_mask(db, D, od)[:, None, None, :, None, None]
+             & _bounds_mask(hb, H, oh)[None, :, None, None, :, None]
+             & _bounds_mask(wb, W, ow)[None, None, :, None, None, :])
+        m = m.reshape(od * oh * ow, D * H * W)
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        windows = jnp.where(m[None, None], x.reshape(N, C, 1, D * H * W),
+                            neg)
+        vals = windows.max(axis=3).reshape(N, C, od, oh, ow)
+        idx = windows.argmax(axis=3).astype(jnp.int64).reshape(N, C, od,
+                                                               oh, ow)
+        return vals, idx
     planes = [jnp.max(x[:, :, db[i]:max(db[i + 1], db[i] + 1)], axis=2)
               for i in range(od)]
     stacked = jnp.stack(planes, axis=2)  # [N, C, od, H, W]

@@ -370,19 +370,12 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         a = x.to_dense()._data
     else:
         a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    m, n = a.shape
     if q is None:
-        q = min(6, m, n)
+        q = min(6, *a.shape)
     if center:
         a = a - jnp.mean(a, axis=0, keepdims=True)
-    key = jax.random.PRNGKey(0)
-    omega = jax.random.normal(key, (n, q), a.dtype)
-    y = a @ omega
-    for _ in range(niter):
-        y = a @ (a.T @ y)
-    qmat, _ = jnp.linalg.qr(y)
-    b = qmat.T @ a
-    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
-    u = qmat @ u_b
+    from ..tensor.compat_ext import _lowrank_svd
+
+    u, s, v = _lowrank_svd(a, q, niter)
     return (Tensor._from_data(u), Tensor._from_data(s),
-            Tensor._from_data(vt.T))
+            Tensor._from_data(v))

@@ -858,3 +858,173 @@ class _OpaqueDType:
 pstring = _OpaqueDType("pstring")
 raw = _OpaqueDType("raw")
 __all__ += ["pstring", "raw"]
+
+
+# ---------------------------------------------------------------------------
+# linalg lowrank / factor helpers (reference tensor_method_func names)
+# ---------------------------------------------------------------------------
+
+@_public
+def cholesky_inverse(x, upper=False):
+    """(A)^-1 from its Cholesky factor (reference: linalg
+    cholesky_inverse): A = L L^T (or U^T U). Batched inputs transpose the
+    last two axes only."""
+    a = _u(x)
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    if upper:
+        a = jnp.swapaxes(a, -1, -2)
+    inv_l = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return _w(jnp.swapaxes(inv_l, -1, -2) @ inv_l)
+
+
+def _lowrank_svd(a, q, niter):
+    """Shared Halko sketch (+ subspace iteration): returns (U, S, V) with
+    V column-major (a ≈ U diag(S) V^T). Used by svd_lowrank here and
+    sparse.pca_lowrank."""
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (*a.shape[:-2], n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, jnp.swapaxes(vt, -1, -2)
+
+
+@_public
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: linalg svd_lowrank)."""
+    a = _u(x)
+    if M is not None:
+        a = a - _u(M)
+    u, s, v = _lowrank_svd(a, q, niter)
+    return _w(u), _w(s), _w(v)
+
+
+@_public
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Dense PCA sketch (reference: linalg pca_lowrank); the sparse entry
+    point lives in paddle.sparse."""
+    from ..sparse import pca_lowrank as _sp
+
+    return _sp(x, q=q, center=center, niter=niter)
+
+
+@_public
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply by Q from a QR factorization's householder form
+    (reference: linalg ormqr). Q is the FULL m x m orthogonal factor, so
+    the householder vectors are zero-padded to square before the
+    product."""
+    a, tv = _u(x), _u(tau)
+    m, n = a.shape[-2], a.shape[-1]
+    if n < m:
+        pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - n)]
+        a = jnp.pad(a, pad_a)
+        pad_t = [(0, 0)] * (tv.ndim - 1) + [(0, m - tv.shape[-1])]
+        tv = jnp.pad(tv, pad_t)
+    q = jax.lax.linalg.householder_product(a, tv)
+    mat = jnp.swapaxes(q, -1, -2) if transpose else q
+    other = _u(y)
+    return _w(mat @ other if left else other @ mat)
+
+
+@_public
+def create_tensor(dtype, name=None, persistable=False):
+    """Reference: paddle.tensor.creation.create_tensor — an empty
+    placeholder tensor of the given dtype."""
+    return _w(jnp.zeros((0,), np.dtype(str(dtype))
+                        if str(dtype) != "bfloat16" else jnp.bfloat16))
+
+
+# in-place variants of scatter-style ops + trig tail + set_
+def _more_inplace():
+    extra = ["acosh", "asin", "asinh", "atanh", "cosh", "put_along_axis",
+             "index_put"]
+    for base in extra:
+        fn = OPS.get(base)
+        if fn is None:
+            continue
+        iname = base + "_"
+        if not hasattr(Tensor, iname):
+            def make(f):
+                def method(self, *args, **kwargs):
+                    return self._rebind(f(self, *args, **kwargs))
+
+                return method
+
+            setattr(Tensor, iname, make(fn))
+
+        def make_mod(nm):
+            def mod_fn(x, *args, **kwargs):
+                return getattr(x, nm)(*args, **kwargs)
+
+            mod_fn.__name__ = nm
+            return mod_fn
+
+        globals().setdefault(iname, make_mod(iname))
+        if iname not in __all__:
+            __all__.append(iname)
+
+    def set_(self, source=None, shape=None):
+        """Rebind this tensor's buffer to `source` (reference Tensor.set_)."""
+        if source is None:
+            return self._rebind(_w(jnp.zeros((0,), _u(self).dtype)))
+        arr = _u(source)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return self._rebind(_w(arr))
+
+    if not hasattr(Tensor, "set_"):
+        Tensor.set_ = set_
+
+
+_more_inplace()
+
+
+# patch the compat surface onto Tensor as methods (the reference's
+# tensor_method_func list includes these names)
+_METHOD_NAMES = [
+    "atleast_1d", "atleast_2d", "atleast_3d", "bitwise_invert",
+    "bitwise_invert_", "block_diag", "broadcast_shape", "bucketize",
+    "cdist", "cholesky_inverse", "create_parameter", "create_tensor",
+    "cumulative_trapezoid", "diagonal_scatter", "diff", "dsplit",
+    "frexp", "gammainc", "histogram_bin_edges", "histogramdd", "hsplit",
+    "index_fill", "is_complex", "is_floating_point", "is_integer",
+    "isin", "isneginf", "isposinf", "isreal", "less", "less_",
+    "masked_scatter", "mod_", "floor_mod_", "multigammaln",
+    "nanquantile", "neg", "ormqr", "pca_lowrank", "polar", "scatter_nd",
+    "select_scatter", "sgn", "signbit", "sinc", "slice_scatter",
+    "svd_lowrank", "take", "tensor_split", "tensordot", "trapezoid",
+    "unflatten", "vander", "view", "view_as", "vsplit",
+]
+
+
+def _patch_methods():
+    from ..ops.dispatch import OPS as _ops
+
+    for name in _METHOD_NAMES:
+        if hasattr(Tensor, name):
+            continue
+        fn = globals().get(name) or _ops.get(name)
+        if fn is not None:
+            setattr(Tensor, name, fn)
+    # module-level helpers that are tensor methods in the reference
+    if not hasattr(Tensor, "multi_dot"):
+        Tensor.multi_dot = lambda self, *rest: _ops["multi_dot"](
+            [self, *rest])
+    if not hasattr(Tensor, "is_tensor"):
+        Tensor.is_tensor = lambda self: True
+    if not hasattr(Tensor, "istft"):
+        def istft(self, *args, **kwargs):
+            from .. import signal
+
+            return signal.istft(self, *args, **kwargs)
+
+        Tensor.istft = istft
+
+
+_patch_methods()

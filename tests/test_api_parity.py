@@ -40,6 +40,68 @@ def test_every_reference_top_level_name_resolves():
     assert not missing, f"missing top-level names: {missing}"
 
 
+def test_every_reference_tensor_method_resolves():
+    """The reference patches ~383 names onto Tensor
+    (python/paddle/tensor/__init__.py tensor_method_func)."""
+    if not os.path.exists("/root/reference/python/paddle/tensor/__init__.py"):
+        pytest.skip("reference tree not present")
+    import re
+
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, re.S)
+    names = sorted(set(re.findall(r"'([^']+)'", m.group(1))))
+    missing = [n for n in names if not hasattr(paddle.Tensor, n)]
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+class TestTensorMethodTail:
+    def test_method_spellings(self):
+        x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        assert paddle.to_tensor(x).sgn().shape == [4, 3]
+        outs = paddle.to_tensor(np.arange(10.0)).tensor_split(3)
+        assert len(outs) == 3
+        z = paddle.to_tensor(np.array([0.5], np.float32))
+        z.cosh_()
+        np.testing.assert_allclose(z.numpy(), np.cosh(0.5), rtol=1e-6)
+        a = paddle.to_tensor(np.zeros(3, np.float32))
+        a.set_(paddle.to_tensor(x), shape=[12])
+        assert list(a.shape) == [12]
+        w = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        w.put_along_axis_(paddle.to_tensor(np.array([[0], [1]])),
+                          paddle.to_tensor(np.array([[5.0], [6.0]],
+                                                    np.float32)), 1)
+        assert w.numpy()[0, 0] == 5 and w.numpy()[1, 1] == 6
+
+    def test_cholesky_inverse_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        A = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+        A = A @ A.T + 4 * np.eye(4, dtype=np.float32)
+        L = np.linalg.cholesky(A)
+        got = paddle.cholesky_inverse(paddle.to_tensor(L)).numpy()
+        ref = torch.cholesky_inverse(torch.tensor(L)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-5)
+
+    def test_svd_lowrank_reconstructs(self):
+        B = (np.random.RandomState(2).randn(12, 3)
+             @ np.random.RandomState(3).randn(3, 9)).astype(np.float32)
+        U, S, V = paddle.svd_lowrank(paddle.to_tensor(B), q=3)
+        rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+        np.testing.assert_allclose(rec, B, atol=1e-3)
+
+    def test_ormqr_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        M = np.random.RandomState(4).randn(5, 3).astype(np.float64)
+        qr_h, tau = np.linalg.qr(M, mode="raw")
+        y = np.random.RandomState(5).randn(5, 2).astype(np.float64)
+        got = paddle.ormqr(paddle.to_tensor(qr_h.T.copy()),
+                           paddle.to_tensor(tau.copy()),
+                           paddle.to_tensor(y)).numpy()
+        ref = torch.ormqr(torch.tensor(qr_h.T.copy()),
+                          torch.tensor(tau.copy()),
+                          torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-8)
+
+
 def _t(a):
     return paddle.to_tensor(np.asarray(a))
 
