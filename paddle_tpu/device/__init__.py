@@ -109,3 +109,117 @@ def _dev_id(device) -> int:
 cuda = _MemNamespace()
 tpu = _MemNamespace()
 xpu = _MemNamespace()
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail (reference: python/paddle/device/__init__.py __all__)
+# ---------------------------------------------------------------------------
+
+from ..core.place import Place as _Place
+
+
+def XPUPlace(device_id: int = 0):
+    """Compat: XPU code targets the accelerator here."""
+    return _Place("gpu", device_id)
+
+
+def IPUPlace(*a, **k):
+    raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """XLA plays CINN's role; the CINN build flag itself is absent."""
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU; reference returns None when not compiled in."""
+    return None
+
+
+def get_all_custom_device_type():
+    return []
+
+
+class Stream:
+    """Execution-stream shim (reference: device/__init__.py Stream). PJRT
+    dispatch is ordered per device — one implicit stream — so this object
+    carries identity only; synchronize() drains the device."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        synchronize(self.device)
+
+    def wait_stream(self, stream):
+        synchronize(self.device)
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    """Stream-event shim: recording synchronizes (PJRT order is program
+    order), so queries are immediately true."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev, _current_stream = _current_stream, stream
+    return prev
+
+
+class stream_guard:
+    """Context manager pinning ops to a stream (scoping-only here)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream or _current_stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

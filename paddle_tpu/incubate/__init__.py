@@ -2,3 +2,22 @@
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
+
+# round-5 tail (reference: python/paddle/incubate/__init__.py __all__)
+from .. import geometric as _geometric  # noqa: F401  (registers graph ops)
+from ..ops.dispatch import OPS as _OPS
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .. import inference  # noqa: F401  (paddle.incubate.inference alias)
+
+graph_send_recv = _OPS["graph_send_recv"]
+graph_khop_sampler = _OPS["graph_khop_sampler"]
+graph_sample_neighbors = _OPS["graph_sample_neighbors"]
+graph_reindex = _OPS["reindex_graph"]
+segment_sum = _OPS["segment_sum"]
+segment_mean = _OPS["segment_mean"]
+segment_min = _OPS["segment_min"]
+segment_max = _OPS["segment_max"]
+identity_loss = _OPS["identity_loss"]
+softmax_mask_fuse = _OPS["fused_softmax_mask"]
+softmax_mask_fuse_upper_triangle = _OPS["fused_softmax_mask_upper_triangle"]

@@ -4,3 +4,82 @@ from .layer import (  # noqa: F401
     FusedLinear,
     FusedMultiHeadAttention,
 )
+
+# round-5 tail: fused Layer classes (reference: incubate/nn/__init__.py)
+from ...nn.layer.layers import Layer as _Layer
+from . import functional as _IF
+
+
+class FusedDropoutAdd(_Layer):
+    """y = dropout(x) + residual in one fused region (reference:
+    incubate/nn/layer/fused_dropout_add.py)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return _IF.fused_dropout_add(x, y, p=self.p, mode=self.mode,
+                                     is_test=not self.training)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    """bias+dropout+residual+LN fusion (reference:
+    incubate/nn/layer/fused_transformer.py)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        return _IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedMultiTransformer(_Layer):
+    """Layer form of the fused_multi_transformer decode op (reference:
+    incubate/nn/layer/fused_transformer.py FusedMultiTransformer); weights
+    are provided per call like the functional form the serving stack
+    uses."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, num_layers=1, name=None, **kw):
+        super().__init__()
+        self.cfg = dict(embed_dim=embed_dim, num_heads=num_heads,
+                        dim_feedforward=dim_feedforward,
+                        num_layers=num_layers)
+
+    def forward(self, x, *args, **kwargs):
+        return _IF.fused_multi_transformer(x, *args, **kwargs)
+
+
+class FusedTransformerEncoderLayer(_Layer):
+    """Fused encoder layer (reference: incubate FusedTransformerEncoderLayer)
+    — composed over fused_attention + fused_feedforward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        from ...nn import TransformerEncoderLayer
+
+        self._inner = TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout=dropout_rate,
+            activation=activation,
+            attn_dropout=attn_dropout_rate,
+            act_dropout=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self._inner(src, src_mask)

@@ -285,14 +285,16 @@ def _fractional_bounds(in_size, out_size, u=0.5):
     return idx
 
 
-def _bounds_mask(bounds, n, out):
-    """[out, n] bool membership from fractional window bounds."""
-    import numpy as _np
-
-    m = _np.zeros((out, n), bool)
+def _windowed_argmax(x, bounds, out, axis):
+    """(max, absolute-argmax) over each [bounds[i], bounds[i+1]) window
+    along `axis` — separable form, O(input) memory."""
+    vals, idxs = [], []
     for i in range(out):
-        m[i, bounds[i]:max(bounds[i + 1], bounds[i] + 1)] = True
-    return jnp.asarray(m)
+        lo, hi = bounds[i], max(bounds[i + 1], bounds[i] + 1)
+        sl = jax.lax.slice_in_dim(x, lo, hi, axis=axis)
+        vals.append(jnp.max(sl, axis=axis))
+        idxs.append(jnp.argmax(sl, axis=axis) + lo)
+    return jnp.stack(vals, axis=axis), jnp.stack(idxs, axis=axis)
 
 
 @register_op
@@ -303,15 +305,14 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     hb = _fractional_bounds(x.shape[2], oh, u)
     wb = _fractional_bounds(x.shape[3], ow, u)
     if return_mask:
-        N, C, H, W = x.shape
-        m = (_bounds_mask(hb, H, oh)[:, None, :, None]
-             & _bounds_mask(wb, W, ow)[None, :, None, :])
-        m = m.reshape(oh * ow, H * W)
-        neg = jnp.asarray(-jnp.inf, x.dtype)
-        windows = jnp.where(m[None, None], x.reshape(N, C, 1, H * W), neg)
-        vals = windows.max(axis=3).reshape(N, C, oh, ow)
-        idx = windows.argmax(axis=3).astype(jnp.int64).reshape(N, C, oh, ow)
-        return vals, idx
+        W = x.shape[3]
+        # separable argmax: rows first ([N,C,oh,W] values + row index),
+        # then cols; combine into the flat H*W index the reference emits
+        rv, ri = _windowed_argmax(x, hb, oh, axis=2)
+        cv, ci = _windowed_argmax(rv, wb, ow, axis=3)
+        row_at_c = jnp.take_along_axis(ri, ci, axis=3)
+        idx = (row_at_c * W + ci).astype(jnp.int64)
+        return cv, idx
     rows = [jnp.max(x[:, :, hb[i]:max(hb[i + 1], hb[i] + 1)], axis=2)
             for i in range(oh)]
     stacked = jnp.stack(rows, axis=2)  # [N, C, oh, W]
@@ -327,20 +328,17 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     u = 0.5 if random_u is None else float(random_u)
     db = _fractional_bounds(x.shape[2], od, u)
     if return_mask:
-        N, C, D, H, W = x.shape
+        H, W = x.shape[3], x.shape[4]
         hb = _fractional_bounds(H, oh, u)
         wb = _fractional_bounds(W, ow, u)
-        m = (_bounds_mask(db, D, od)[:, None, None, :, None, None]
-             & _bounds_mask(hb, H, oh)[None, :, None, None, :, None]
-             & _bounds_mask(wb, W, ow)[None, None, :, None, None, :])
-        m = m.reshape(od * oh * ow, D * H * W)
-        neg = jnp.asarray(-jnp.inf, x.dtype)
-        windows = jnp.where(m[None, None], x.reshape(N, C, 1, D * H * W),
-                            neg)
-        vals = windows.max(axis=3).reshape(N, C, od, oh, ow)
-        idx = windows.argmax(axis=3).astype(jnp.int64).reshape(N, C, od,
-                                                               oh, ow)
-        return vals, idx
+        dv, di = _windowed_argmax(x, db, od, axis=2)   # [N,C,od,H,W]
+        hv, hi = _windowed_argmax(dv, hb, oh, axis=3)  # [N,C,od,oh,W]
+        wv, wi = _windowed_argmax(hv, wb, ow, axis=4)  # [N,C,od,oh,ow]
+        h_at_w = jnp.take_along_axis(hi, wi, axis=4)   # abs h per cell
+        di_at_h = jnp.take_along_axis(di, hi, axis=3)  # [N,C,od,oh,W]
+        d_at_hw = jnp.take_along_axis(di_at_h, wi, axis=4)
+        idx = ((d_at_hw * H + h_at_w) * W + wi).astype(jnp.int64)
+        return wv, idx
     planes = [jnp.max(x[:, :, db[i]:max(db[i + 1], db[i] + 1)], axis=2)
               for i in range(od)]
     stacked = jnp.stack(planes, axis=2)  # [N, C, od, H, W]

@@ -388,3 +388,37 @@ def benchmark() -> _TimerHub:
     """Reference: paddle.profiler.utils.benchmark() — the ips/reader_cost
     throughput timer hooked into DataLoader and hapi callbacks."""
     return _hub
+
+
+class SortedKeys(Enum):
+    """Summary-table sort keys (reference: profiler/profiler_statistic.py)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary views (reference: profiler/profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(path="profiler.pb"):
+    """Reference exports a protobuf trace; here the chrome-trace JSON is
+    the interchange format — write it under the requested path."""
+    _collect_spans(path)
+    return path

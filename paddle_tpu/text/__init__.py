@@ -122,3 +122,15 @@ class Conll05st(_GatedDataset):
 
 class Movielens(_GatedDataset):
     _NAME = "Movielens"
+
+
+class Imikolov(_GatedDataset):
+    _NAME = "Imikolov (PTB language-model dataset)"
+
+
+class WMT14(_GatedDataset):
+    _NAME = "WMT14 en-fr translation dataset"
+
+
+class WMT16(_GatedDataset):
+    _NAME = "WMT16 en-de translation dataset"

@@ -1,0 +1,82 @@
+"""paddle.utils parity (reference: python/paddle/utils/__init__.py —
+__all__ = deprecated, run_check, require_version, try_import; plus the
+unique_name submodule and cpp_extension stub the ecosystem imports).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+from . import unique_name  # noqa: E402,F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    utils/deprecated.py): warns once per call site with the replacement."""
+
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module, raising a friendly error when absent (reference:
+    utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"Failed to import {module_name!r}: install it to "
+                          f"use this feature") from e
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference:
+    base/framework.py require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+
+
+def run_check():
+    """Smoke-check the install: one op on every visible device (reference:
+    utils/install_check.py run_check)."""
+    import jax
+    import numpy as np
+
+    from .. import matmul, to_tensor
+
+    a = to_tensor(np.ones((2, 2), np.float32))
+    out = matmul(a, a)
+    assert float(out.numpy()[0, 0]) == 2.0
+    n = jax.device_count()
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} device(s) available, backend: "
+          f"{jax.devices()[0].platform}")

@@ -1,0 +1,42 @@
+"""paddle.utils.unique_name (reference: base/unique_name.py): process-wide
+unique name generator with guard scoping — layers use it for parameter
+names."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = defaultdict(int)
+
+    def generate(self, key):
+        self.ids[key] += 1
+        return f"{key}_{self.ids[key] - 1}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator if isinstance(new_generator, _Generator)
+                 else None)
+    try:
+        yield
+    finally:
+        switch(old)

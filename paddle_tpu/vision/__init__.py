@@ -2,3 +2,37 @@
 from . import datasets, models, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
 from . import ops  # noqa: F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """'pil' (numpy HWC via PIL) or 'cv2' (reference:
+    vision/image.py set_image_backend)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: vision/image.py image_load):
+    'pil' → PIL.Image, 'cv2' → HWC BGR ndarray, 'tensor' → CHW tensor."""
+    backend = backend or _image_backend
+    from PIL import Image
+
+    if backend == "pil":
+        return Image.open(path)
+    import numpy as _np
+
+    with Image.open(path) as img:
+        arr = _np.asarray(img.convert("RGB"))
+    if backend == "cv2":
+        return arr[:, :, ::-1].copy()   # cv2.imread convention is BGR
+    from .. import to_tensor
+
+    return to_tensor(arr.transpose(2, 0, 1).copy())

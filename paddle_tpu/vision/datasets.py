@@ -92,26 +92,30 @@ FashionMNIST = MNIST
 
 
 class Cifar10(Dataset):
+    _LABEL_KEY = b"labels"
+
+    def _batch_names(self, mode):
+        return ([f"data_batch_{i}" for i in range(1, 6)]
+                if mode == "train" else ["test_batch"])
+
     def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
         if data_file is None or not os.path.exists(data_file):
             raise RuntimeError(
-                "Cifar10 archive not found locally and downloading is unavailable; "
+                f"{type(self).__name__} archive not found locally and downloading is unavailable; "
                 "pass data_file, or use FakeData"
             )
         import tarfile
 
         self.transform = transform
         images, labels = [], []
-        names = (
-            [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
-        )
+        names = self._batch_names(mode)
         with tarfile.open(data_file) as tf:
             for m in tf.getmembers():
                 base = os.path.basename(m.name)
                 if base in names:
                     d = pickle.load(tf.extractfile(m), encoding="bytes")
                     images.append(d[b"data"].reshape(-1, 3, 32, 32))
-                    labels.extend(d[b"labels"])
+                    labels.extend(d[self._LABEL_KEY])
         self.images = np.concatenate(images)
         self.labels = np.asarray(labels, np.int64)
 
@@ -143,6 +147,164 @@ class ImageFolder(Dataset):
         if self.transform:
             img = self.transform(img)
         return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 from a local archive (reference:
+    vision/datasets/cifar.py Cifar100): Cifar10's wire format with single
+    train/test members and fine labels."""
+
+    _LABEL_KEY = b"fine_labels"
+
+    def _batch_names(self, mode):
+        return ["train"] if mode == "train" else ["test"]
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory image folder (reference:
+    vision/datasets/folder.py DatasetFolder): targets come from the sorted
+    subdirectory names."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions) if extensions else self.IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in os.walk(cdir):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root!r}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 from local files (reference: vision/datasets/flowers.py):
+    image tgz + imagelabels.mat + setid.mat, loaded with scipy.io."""
+
+    _SETID_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        missing = [p for p in (data_file, label_file, setid_file)
+                   if p is None or not os.path.exists(p)]
+        if missing:
+            raise RuntimeError(
+                "Flowers needs local copies of the image archive "
+                "(102flowers.tgz), imagelabels.mat and setid.mat — "
+                "downloading is unavailable in this environment; use "
+                "FakeData if you only need the shape contract")
+        import tarfile
+
+        from scipy.io import loadmat
+
+        self.transform = transform
+        labels = loadmat(label_file)["labels"][0]
+        ids = loadmat(setid_file)[self._SETID_KEY[mode]][0]
+        self._tar_path = data_file
+        with tarfile.open(data_file) as tf:
+            members = {os.path.basename(m.name): m.name
+                       for m in tf.getmembers() if m.isfile()}
+        self.samples = []
+        for i in ids:
+            name = f"image_{int(i):05d}.jpg"
+            if name in members:
+                self.samples.append((members[name], int(labels[i - 1]) - 1))
+
+    def __getitem__(self, idx):
+        import tarfile
+
+        from PIL import Image
+
+        name, label = self.samples[idx]
+        with tarfile.open(self._tar_path) as tf:
+            img = np.asarray(Image.open(tf.extractfile(name)).convert("RGB"))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from the local VOCtrainval archive
+    (reference: vision/datasets/voc2012.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "VOC2012 needs a local VOCtrainval archive — downloading is "
+                "unavailable in this environment; use FakeData if you only "
+                "need the shape contract")
+        import tarfile
+
+        self.transform = transform
+        self._tar_path = data_file
+        split = {"train": "train.txt", "valid": "val.txt",
+                 "test": "val.txt"}.get(mode, "trainval.txt")
+        with tarfile.open(data_file) as tf:
+            names = {m.name for m in tf.getmembers() if m.isfile()}
+            seg_list = next((n for n in names
+                             if n.endswith(f"Segmentation/{split}")), None)
+            if seg_list is None:
+                raise RuntimeError("archive has no ImageSets/Segmentation "
+                                   f"list for mode {mode!r}")
+            ids = tf.extractfile(seg_list).read().decode().split()
+            prefix = seg_list.split("ImageSets/")[0]
+        self.samples = [(f"{prefix}JPEGImages/{i}.jpg",
+                         f"{prefix}SegmentationClass/{i}.png") for i in ids]
+
+    def __getitem__(self, idx):
+        import tarfile
+
+        from PIL import Image
+
+        img_name, seg_name = self.samples[idx]
+        with tarfile.open(self._tar_path) as tf:
+            img = np.asarray(Image.open(tf.extractfile(img_name))
+                             .convert("RGB"))
+            seg = np.asarray(Image.open(tf.extractfile(seg_name)))
+        if self.transform:
+            img = self.transform(img)
+        return img, seg
 
     def __len__(self):
         return len(self.samples)

@@ -29,7 +29,13 @@ from .resnet import (  # noqa: F401
     resnet101,
     resnet152,
     resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
+    wide_resnet101_2,
 )
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2,

@@ -156,3 +156,38 @@ def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
     return ResNet(BottleneckBlock, 50, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return ResNet(BottleneckBlock, 50, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return ResNet(BottleneckBlock, 152, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return ResNet(BottleneckBlock, 152, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return ResNet(BottleneckBlock, 101, **kwargs)
