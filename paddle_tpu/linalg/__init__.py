@@ -90,3 +90,41 @@ def pca_lowrank(x, q=None, center=True, niter=2):
         return u[..., :k], s[..., :k], jnp.swapaxes(vt, -2, -1)[..., :k]
 
     return call_op("pca_lowrank", kernel, (x,), {})
+
+
+# round-5 tail: factor helpers shared with the tensor compat surface
+def cholesky_inverse(x, upper=False, name=None):
+    from ..tensor.compat_ext import cholesky_inverse as _ci
+
+    return _ci(x, upper)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    from ..tensor.compat_ext import ormqr as _o
+
+    return _o(x, tau, y, left, transpose)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    from ..tensor.compat_ext import svd_lowrank as _s
+
+    return _s(x, q, niter, M)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Reference signature: lu_unpack(x, y) where x is the packed LU and
+    y the pivots."""
+    return _OPS["lu_unpack"](x, y, unpack_ludata, unpack_pivots)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Reference: linalg vecdot — sum(conj(x) * y) along `axis`. Routed
+    through call_op so autograd/AMP see it like the rest of the module."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import call_op
+
+    def kernel(a, b):
+        return jnp.sum(jnp.conj(a) * b, axis=axis)
+
+    return call_op("vecdot", kernel, (x, y), {})

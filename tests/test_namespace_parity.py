@@ -603,3 +603,25 @@ class TestDatasetLoaders:
         assert not np.allclose(m(x, r).numpy(), m(x, r).numpy())
         m.eval()
         np.testing.assert_allclose(m(x, r).numpy(), m(x, r).numpy())
+
+
+class TestLinalgTail:
+    def test_names_resolve(self):
+        for n in ("cholesky_inverse", "lu_unpack", "ormqr", "svd_lowrank",
+                  "vecdot"):
+            assert hasattr(paddle.linalg, n), n
+
+    def test_vecdot_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        a = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        b = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        got = paddle.linalg.vecdot(_t(a), _t(b)).numpy()
+        ref = torch.linalg.vecdot(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_lu_unpack_roundtrip(self):
+        M = np.random.RandomState(2).randn(4, 4).astype(np.float32)
+        lu, piv = paddle.linalg.lu(_t(M))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), M,
+                                   rtol=1e-4, atol=1e-5)
