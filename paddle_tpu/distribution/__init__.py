@@ -932,3 +932,10 @@ class TransformedDistribution(Distribution):
 __all__ += ["Chi2", "ContinuousBernoulli", "ExponentialFamily",
             "Independent", "LKJCholesky", "MultivariateNormal",
             "TransformedDistribution"]
+
+
+from . import transform  # noqa: E402
+from .transform import *  # noqa: E402,F401,F403  — transform.__all__ is
+# the single source of truth for both the namespace and __all__ below
+
+__all__ += transform.__all__

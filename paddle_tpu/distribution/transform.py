@@ -1,0 +1,338 @@
+"""Probability transforms (reference: python/paddle/distribution/
+transform.py — the 13-class Transform library TransformedDistribution
+composes). Each transform maps forward/inverse with log-det-Jacobian
+accounting; the math runs on jnp arrays with Tensor wrappers at the API
+boundary.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _u(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _w(a):
+    return Tensor._from_data(jnp.asarray(a))
+
+
+class Transform:
+    """Base transform: subclasses implement _forward/_inverse (+ the
+    log-det-Jacobian pair) on jnp arrays."""
+
+    _is_injective = True
+
+    @property
+    def is_injective(self):
+        return self._is_injective
+
+    def forward(self, x):
+        return _w(self._forward(_u(x)))
+
+    def inverse(self, y):
+        return _w(self._inverse(_u(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _w(self._forward_log_det_jacobian(_u(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _w(-self._forward_log_det_jacobian(self._inverse(_u(y))))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # -- jnp-level hooks -----------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (not injective: inverse returns the positive branch)."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _u(loc)
+        self.scale = _u(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (on the positive half-line)."""
+
+    def __init__(self, power):
+        self.power = _u(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) in the softplus-stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (applied left to right)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @property
+    def is_injective(self):
+        return all(t.is_injective for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    """Treats the trailing `reinterpreted_batch_rank` dims of the base
+    transform as event dims: the log-det-Jacobian sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        axes = tuple(range(ldj.ndim - self.rank, ldj.ndim))
+        return jnp.sum(ldj, axis=axes) if axes else ldj
+
+
+class ReshapeTransform(Transform):
+    """Reshapes the event block; volume-preserving (ldj = 0)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        import numpy as np
+
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("in_event_shape and out_event_shape must have "
+                             "the same number of elements")
+
+    def _batch(self, x, event):
+        return x.shape[:x.ndim - len(event)]
+
+    def _forward(self, x):
+        return x.reshape(self._batch(x, self.in_event_shape)
+                         + self.out_event_shape)
+
+    def _inverse(self, y):
+        return y.reshape(self._batch(y, self.out_event_shape)
+                         + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros(self._batch(x, self.in_event_shape), x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax-style normalization (reference: transform.py
+    SoftmaxTransform — forward exp-normalizes, inverse takes log; not a
+    bijection, no log-det-Jacobian)."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        z = jnp.exp(x - jnp.max(x, axis=-1, keepdims=True))
+        return z / jnp.sum(z, axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not injective; no log-det-Jacobian")
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _apply(self, arr, method):
+        slices = [getattr(t, method)(jnp.take(arr, i, axis=self.axis))
+                  for i, t in enumerate(self.transforms)]
+        return jnp.stack(slices, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._apply(x, "_forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k → open (k+1)-simplex via stick breaking
+    (reference: transform.py StickBreakingTransform)."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        z_cumprod = jnp.cumprod(1.0 - z, axis=-1)
+        pad_one = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        left = jnp.concatenate([z, pad_one], axis=-1)
+        right = jnp.concatenate([pad_one, z_cumprod], axis=-1)
+        return left * right
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        rest = 1.0 - jnp.cumsum(y[..., :-1], axis=-1)
+        denom = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), rest[..., :-1]],
+            axis=-1)
+        z = y[..., :-1] / denom
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        # triangular Jacobian: det = prod_i sigmoid'(t_i) * stick_i with
+        # stick_i = prod_{j<i}(1 - z_j); log sigmoid' = log z + log(1-z)
+        stick = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1.0 - z[..., :-1], axis=-1)], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(stick),
+                       axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
