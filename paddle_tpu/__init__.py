@@ -182,7 +182,7 @@ from . import io  # noqa: F401, E402
 from . import metric  # noqa: F401, E402
 from . import static  # noqa: F401, E402
 from .static import enable_static, disable_static  # noqa: F401, E402
-from . import audio, hub, text, utils  # noqa: F401, E402
+from . import audio, hub, text, utils, version  # noqa: F401, E402
 from . import vision  # noqa: F401, E402
 from . import distributed  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402

@@ -180,10 +180,19 @@ class ChainTransform(Transform):
         return y
 
     def _forward_log_det_jacobian(self, x):
-        total = 0.0
+        # members may emit ldjs at different event ranks (e.g. an
+        # IndependentTransform already summed its event dims); align by
+        # reducing every ldj down to the smallest rank before summing
+        # (the reference chains via sum-rightmost the same way)
+        ldjs = []
         for t in self.transforms:
-            total = total + t._forward_log_det_jacobian(x)
+            ldjs.append(t._forward_log_det_jacobian(x))
             x = t._forward(x)
+        min_rank = min(ldj.ndim for ldj in ldjs)
+        total = 0.0
+        for ldj in ldjs:
+            extra = tuple(range(min_rank, ldj.ndim))
+            total = total + (jnp.sum(ldj, axis=extra) if extra else ldj)
         return total
 
     def forward_shape(self, shape):
@@ -204,6 +213,9 @@ class IndependentTransform(Transform):
     def __init__(self, base, reinterpreted_batch_rank):
         self.base = base
         self.rank = int(reinterpreted_batch_rank)
+        if self.rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive, "
+                             f"got {reinterpreted_batch_rank}")
 
     def _forward(self, x):
         return self.base._forward(x)
@@ -213,6 +225,10 @@ class IndependentTransform(Transform):
 
     def _forward_log_det_jacobian(self, x):
         ldj = self.base._forward_log_det_jacobian(x)
+        if self.rank > ldj.ndim:
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds the "
+                f"log-det-Jacobian rank {ldj.ndim}")
         axes = tuple(range(ldj.ndim - self.rank, ldj.ndim))
         return jnp.sum(ldj, axis=axes) if axes else ldj
 
@@ -280,6 +296,11 @@ class StackTransform(Transform):
         self.axis = int(axis)
 
     def _apply(self, arr, method):
+        n = arr.shape[self.axis]
+        if n != len(self.transforms):
+            raise ValueError(
+                f"StackTransform has {len(self.transforms)} transforms but "
+                f"axis {self.axis} has size {n}")
         slices = [getattr(t, method)(jnp.take(arr, i, axis=self.axis))
                   for i, t in enumerate(self.transforms)]
         return jnp.stack(slices, axis=self.axis)

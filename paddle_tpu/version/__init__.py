@@ -1,0 +1,78 @@
+"""paddle.version parity (reference: the build-time generated
+python/paddle/version/__init__.py): version components plus the
+toolchain-probe helpers, answering for the XLA/PJRT stack instead of
+CUDA. `commit` is resolved lazily (module __getattr__) so importing the
+package never forks git."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+with_pip_cuda_libraries = "OFF"
+
+_commit_cache = None
+
+
+def _git_commit() -> str:
+    global _commit_cache
+    if _commit_cache is None:
+        _commit_cache = "unknown"
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "HEAD"], capture_output=True,
+                text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if out.returncode == 0:
+                _commit_cache = out.stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            pass
+    return _commit_cache
+
+
+def __getattr__(name):
+    if name == "commit":
+        return _git_commit()
+    raise AttributeError(f"module 'paddle_tpu.version' has no attribute "
+                         f"{name!r}")
+
+
+def cuda():
+    """Reference returns the CUDA build version; this stack has none."""
+    return "False"
+
+
+def cudnn():
+    return "False"
+
+
+def nccl():
+    return "False"
+
+
+def xpu():
+    return "False"
+
+
+def xpu_xccl():
+    return "False"
+
+
+def cinn():
+    """XLA plays CINN's role; the CINN toolchain itself is absent."""
+    return "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {_git_commit()}")
+    print("cuda: False  cudnn: False  (XLA/PJRT backend)")
