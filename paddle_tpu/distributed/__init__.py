@@ -72,7 +72,8 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     if name in ("fleet", "auto_parallel", "checkpoint", "launch", "sharding",
-                "parallel", "hybrid", "rpc", "utils", "communication"):
+                "parallel", "hybrid", "rpc", "utils", "communication",
+                "passes"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ImportError as e:

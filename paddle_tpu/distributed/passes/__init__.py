@@ -1,0 +1,198 @@
+"""paddle.distributed.passes parity (reference:
+python/paddle/distributed/passes/__init__.py — new_pass / PassManager /
+PassContext over a registry of distributed optimization passes).
+
+TPU-native: the reference's pass zoo (fp16/amp rewrite, recompute,
+gradient-merge, fuse-allreduce, pipeline schedulers, sharding...) maps to
+capabilities XLA/GSPMD or this framework's runtime already own — amp is
+the autocast policy, recompute is `jax.checkpoint`, fused grad sync is
+the Reducer, pipeline scheduling lives in fleet/meta_parallel. The pass
+OBJECTS here carry the reference's registry/apply contract so strategy
+code that builds pass pipelines keeps working: each known pass name
+resolves, `apply` records itself on the program/context (and performs the
+mapped action where one exists at program scope).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+
+class PassContext:
+    """Reference: PassContext — carries cross-pass state and collects
+    which passes were applied."""
+
+    def __init__(self):
+        self._applied: List["PassBase"] = []
+        self.attrs: Dict[str, Any] = {}
+
+    @property
+    def passes(self):
+        return list(self._applied)
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, attrs: Optional[dict] = None):
+        self.attrs = dict(attrs or {})
+
+    def check_before_apply(self, main_program, startup_program):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Record the application; subclasses hook _apply_impl for the
+        mapped TPU-native action. `check_before_apply` gates application
+        per the reference contract — a False verdict skips the pass."""
+        context = context or PassContext()
+        programs = (main_programs if isinstance(main_programs, (list, tuple))
+                    else [main_programs])
+        starts = (startup_programs
+                  if isinstance(startup_programs, (list, tuple))
+                  else [startup_programs] * len(programs))
+        for prog, start in zip(programs, starts):
+            if not self.check_before_apply(prog, start):
+                continue
+            self._apply_impl(prog, context)
+        context._applied.append(self)
+        return context
+
+    def _apply_impl(self, program, context):
+        applied = getattr(program, "_applied_passes", None)
+        if applied is None:
+            try:
+                program._applied_passes = [self.name]
+            except AttributeError:
+                pass
+        else:
+            applied.append(self.name)
+
+
+class _MappedPass(PassBase):
+    """A reference pass whose capability this stack provides elsewhere;
+    `mapped_to` documents where (surfaced via repr for debuggability)."""
+
+    mapped_to = ""
+
+    def __repr__(self):
+        return (f"<pass {self.name!r} (TPU-native: {self.mapped_to})"
+                f" attrs={self.attrs}>")
+
+
+def _mapped(name, mapped_to):
+    return type(f"Pass_{name}", (_MappedPass,),
+                {"name": name, "mapped_to": mapped_to})
+
+
+# the reference's registered pass names (python/paddle/distributed/passes/
+# *.py + pipeline_scheduler_pass/*.py @register_pass ids — the COMPLETE
+# id set) → where the capability lives here
+_MAPPINGS = {
+    # auto-parallel family
+    "auto_parallel_amp": "amp.auto_cast policy on the compiled step",
+    "auto_parallel_fp16": "bf16-first autocast (fp16 path available)",
+    "auto_parallel_bf16": "bf16 autocast lists",
+    "auto_parallel_recompute": "jax.checkpoint remat in the step fn",
+    "auto_parallel_sharding": "GSPMD shardings via auto_parallel.api",
+    "auto_parallel_grad_clip": "hybrid-aware global-norm clip in the "
+                               "optimizer update",
+    "auto_parallel_gradient_merge_pass": "num_microbatches grad "
+                                         "accumulation in make_train_step",
+    "auto_parallel_data_parallel_optimization": "bucketed fused grad sync "
+                                                "(Reducer analog)",
+    "auto_parallel_pipeline": "fleet/meta_parallel pp schedules",
+    "auto_parallel_master_grad_pass": "f32 master grads in the bf16 step",
+    "auto_parallel_fused_linear_promotion": "XLA epilogue fusion",
+    "auto_parallel_quantization": "quantization QAT/PTQ passes",
+    "auto_parallel_c_embedding_pass": "VocabParallelEmbedding",
+    "auto_parallel_sequence_parallel_optimization":
+        "fleet/utils/sequence_parallel_utils.py",
+    "auto_parallel_supplement_explicit_dependencies":
+        "XLA dataflow ordering (no explicit deps needed)",
+    "allreduce_matmul_grad_overlapping": "XLA latency-hiding scheduler",
+    "replace_with_parallel_cross_entropy": "mpu ParallelCrossEntropy",
+    # fusion family → XLA fusion or existing fused kernels
+    "fuse_adamw": "one fused optimizer update in the jitted step",
+    "fuse_all_reduce": "bucketed fused grad sync in DataParallel",
+    "fuse_bn_act": "XLA elementwise fusion",
+    "fuse_bn_add_act": "XLA elementwise fusion",
+    "fuse_dot_product_attention": "flash attention kernels",
+    "fuse_elewise_add_act": "XLA elementwise fusion",
+    "fuse_gemm_epilogue": "XLA epilogue fusion (fused_linear)",
+    "fuse_optimizer": "one fused optimizer update in the jitted step",
+    "fuse_relu_depthwise_conv": "XLA fusion",
+    "fuse_resunit": "fused_scale_bias_relu_conv_bn kernel family",
+    "fused_attention": "incubate fused_attention",
+    "fused_feedforward": "incubate fused_feedforward",
+    "inplace_addto_op": "XLA buffer donation/aliasing",
+    "build_cinn": "XLA is the graph compiler (no CINN stage)",
+    # parameter-server transpiler family → distributed/ps runtime
+    "add_geo_optimizer_pass": "distributed/ps server-side optimizers",
+    "add_listen_and_serv_pass": "out-of-process PS server loop",
+    "add_lr_decay_table_pass": "PS dense table LR state",
+    "add_optimizer_pass": "PS server-side optimizers",
+    "add_rpc_global_flags_pass": "distributed/rpc runtime",
+    "append_send_ops_pass": "PS client push path",
+    "build_pserver_startup_program_pass": "PS server bootstrap",
+    "delete_extra_optimizer_pass": "PS program split",
+    "delete_optimizer_pass": "PS program split",
+    "delete_unused_in_startup_pass": "PS program split",
+    "distributed_ops_pass": "PS lookup/push op routing",
+    "fake_init_ops_pass": "PS sparse-table remote init",
+    "ps_gpu_pass": "PS runtime (single accelerator class here)",
+    "ps_transpile_pass": "PS program transpilation",
+    "set_heter_pipeline_opt_pass": "PS heter mode (out of scope note)",
+    "split_fl_ops_pass": "PS federated split",
+    "split_heter_worker_ops_pass": "PS heter split",
+    "split_trainer_ops_pass": "PS trainer split",
+    # pipeline schedulers
+    "pipeline_scheduler_FThenB": "fleet/meta_parallel/pp_schedule.py",
+    "pipeline_scheduler_1F1B": "fleet/meta_parallel/pp_schedule.py",
+    "pipeline_scheduler_Eager1F1B": "1F1B schedule (eager warmup variant)",
+    "pipeline_scheduler_VPP": "interleaved schedule in pp_schedule.py",
+    "pipeline_scheduler_ZBH1": "zero-bubble schedule in pp_schedule.py",
+    "pipeline_scheduler_ZBVPP": "zero-bubble + interleaved composition",
+}
+
+_PASS_REGISTRY = {name: _mapped(name, target)
+                  for name, target in _MAPPINGS.items()}
+
+
+def new_pass(name, pass_attrs=None):
+    """Reference: passes/pass_base.py new_pass — instantiate a registered
+    pass by name."""
+    cls = _PASS_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown pass {name!r}; known: {sorted(_PASS_REGISTRY)}")
+    return cls(pass_attrs)
+
+
+class PassManager:
+    """Reference: passes/pass_base.py PassManager — applies a pass list
+    in order under one context."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+        self._context = PassContext()
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self._passes:
+            self._context = p.apply(main_programs, startup_programs,
+                                    self._context)
+        return self._context
