@@ -26,6 +26,8 @@ if _os.environ.get("JAX_PLATFORMS"):
 # is safe.
 _jax.config.update("jax_enable_x64", True)
 
+from .core import jax_compat as _jax_compat  # noqa: E402  (installs jax.shard_map shim)
+
 # Core types ------------------------------------------------------------------
 from .core.dtype import (  # noqa: F401
     DType,
@@ -166,10 +168,11 @@ def device_count():
 
 def synchronize():
     """Block until all dispatched device work completes (analog of
-    DeviceContext Wait; PJRT exposes it per-array)."""
-    import jax
+    DeviceContext Wait): drains the in-flight step pipeline, then fences
+    the device."""
+    from .core import async_engine
 
-    (jax.device_put(0) + 0).block_until_ready()
+    async_engine.synchronize()
 
 
 # Subpackages (populated as the framework grows; see SURVEY.md §7 build plan) -

@@ -170,7 +170,17 @@ def run_backward(
                     nodes[id(tgt)] = tgt
                     stack.append(tgt)
 
-    # 3. Process queue.
+    # 3. Process queue. Like forward dispatch, the whole pass only ENQUEUES
+    # device work (each vjp is itself async under JAX); the span makes the
+    # host-side tape walk attributable next to op::/fetch:: spans.
+    from ..ops.dispatch import _op_profiling
+
+    span = None
+    if _op_profiling[0]:
+        from ..profiler import RecordEvent
+
+        span = RecordEvent(f"backward::{len(nodes)}nodes")
+        span.begin()
     ready = [n for n in nodes.values() if indeg[id(n)] == 0]
     processed = 0
     while ready:
@@ -221,6 +231,8 @@ def run_backward(
                 _leaf_accumulate(e[1], g, capture)
     # Any nodes not processed had unreachable contributions pending; that is
     # fine (they were not on a path from the seeds).
+    if span is not None:
+        span.end()
     return processed
 
 

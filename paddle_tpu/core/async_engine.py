@@ -1,0 +1,161 @@
+"""Pipelined eager execution: the in-flight step queue and its sync points.
+
+The eager hot loop used to pay a host<->chip round trip every step: dispatch
+step N, block on `float(loss)`, dispatch step N+1. JAX dispatch is already
+asynchronous underneath (PJRT enqueues and returns), so the framework's job
+is (a) to NOT force a premature sync, (b) to bound how far the host may run
+ahead of the chip, and (c) to make the points where values DO materialize
+explicit and observable.
+
+Reference analog: the dygraph async executor / GC queue depth
+(FLAGS_max_inplace_grad_add-style pacing) + DeviceContext::Wait. Here:
+
+- ``mark_step(arrays, tag)`` is called at step boundaries (Optimizer.step);
+  it enqueues the step's output buffers. When more than
+  ``FLAGS_eager_async_depth`` steps are in flight the OLDEST is waited on
+  (backpressure), so host run-ahead — and therefore live HBM for activation
+  buffers — stays bounded.
+- ``scalar_fetch(arr, tag)`` is the D2H sync point behind
+  ``Tensor.numpy()/.item()/__float__``: it blocks only on the requested
+  array (values are immutable, so that is fully coherent), retires any
+  already-finished steps from the queue, and shows up in the profiler as a
+  ``fetch::<tag>`` span so sync stalls are attributable.
+- ``FLAGS_eager_async_depth = 0`` disables pipelining: every step mark
+  blocks immediately (the old synchronous behavior, for debugging).
+- The static-graph recorder (``program_guard``) forces sync mode: a tape
+  being recorded must observe program order.
+- ``synchronize()`` drains everything (paddle.device.synchronize analog).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Iterable, Optional
+
+from . import flags
+
+_lock = threading.Lock()
+_queue: deque = deque()  # (tag, [arrays]) step groups in dispatch order
+
+_stats = {
+    "steps_marked": 0,
+    "backpressure_waits": 0,
+    "sync_fetches": 0,
+    "drains": 0,
+    "max_depth_seen": 0,
+}
+
+
+def depth() -> int:
+    """Effective pipeline depth. 0 = synchronous (flag, or a static-graph
+    recording in progress — a tape must observe program order)."""
+    from ..ops import dispatch
+
+    if dispatch.get_static_recorder() is not None:
+        return 0
+    return max(0, int(flags.flag_value("eager_async_depth")))
+
+
+def in_flight() -> int:
+    return len(_queue)
+
+
+def stats() -> dict:
+    out = dict(_stats)
+    out["in_flight"] = len(_queue)
+    out["depth"] = depth()
+    return out
+
+
+def reset_stats():
+    for k in _stats:
+        _stats[k] = 0
+
+
+def _block_on(arrays: Iterable[Any]):
+    for a in arrays:
+        try:
+            if a.is_deleted():
+                # donated away (fused optimizer in-place update): the buffer
+                # was consumed by a YOUNGER computation, so it is past ready
+                continue
+            a.block_until_ready()
+        except Exception:  # noqa: BLE001 — deleted between check and wait,
+            pass           # or a non-array leaked in: never fail a sync
+
+
+def _is_ready(a) -> bool:
+    try:
+        return a.is_deleted() or bool(a.is_ready())
+    except Exception:  # noqa: BLE001
+        return True
+
+
+def mark_step(arrays: Iterable[Any], tag: str = "step"):
+    """Note a completed step dispatch. Blocks on the oldest in-flight step
+    once more than ``depth()`` are outstanding (or immediately at depth 0)."""
+    arrays = [a for a in arrays if hasattr(a, "block_until_ready")]
+    d = depth()
+    if d == 0:
+        _block_on(arrays)
+        _stats["steps_marked"] += 1
+        return
+    with _lock:
+        _queue.append((tag, arrays))
+        _stats["steps_marked"] += 1
+        overflow = []
+        while len(_queue) > d:
+            overflow.append(_queue.popleft())
+        _stats["max_depth_seen"] = max(_stats["max_depth_seen"], len(_queue))
+    for tag_o, arrs in overflow:
+        _stats["backpressure_waits"] += 1
+        _with_span(f"wait::{tag_o}", _block_on, arrs)
+
+
+def _retire_ready():
+    """Pop already-finished steps off the head of the queue (non-blocking)."""
+    with _lock:
+        while _queue and all(_is_ready(a) for a in _queue[0][1]):
+            _queue.popleft()
+
+
+def _with_span(name: str, fn, *args):
+    from ..ops.dispatch import _op_profiling
+
+    if _op_profiling[0]:
+        from ..profiler import RecordEvent
+
+        with RecordEvent(name):
+            return fn(*args)
+    return fn(*args)
+
+
+def scalar_fetch(arr, tag: str = "tensor"):
+    """The D2H sync point: block until ``arr`` is computed, under a
+    ``fetch::<tag>`` profiler span. Only the requested value is waited on —
+    younger in-flight steps keep running; already-finished steps retire."""
+    if not hasattr(arr, "block_until_ready") or hasattr(arr, "_trace"):
+        return arr  # tracer or non-array: preserve the eager error path
+    _stats["sync_fetches"] += 1
+    _with_span(f"fetch::{tag}", _block_on, (arr,))
+    if _queue:
+        _retire_ready()
+    return arr
+
+
+def drain():
+    """Block until every in-flight step completes and clear the queue."""
+    with _lock:
+        groups = list(_queue)
+        _queue.clear()
+    _stats["drains"] += 1
+    for _tag, arrs in groups:
+        _block_on(arrs)
+
+
+def synchronize():
+    """paddle.synchronize: drain the pipeline, then fence the device."""
+    import jax
+
+    drain()
+    (jax.device_put(0) + 0).block_until_ready()

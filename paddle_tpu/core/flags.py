@@ -77,3 +77,12 @@ define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; PJRT owns memor
 define_flag("tpu_allow_cpu_fallback", True, "Allow 'tpu' place to map to CPU XLA when no TPU")
 define_flag("jit_cache_size", 4096, "Max cached compiled executables per op signature")
 define_flag("log_level", 0, "VLOG-style verbosity tier")
+define_flag("eager_async_depth", 2,
+            "Max training steps in flight before dispatch backpressures; "
+            "0 = fully synchronous eager execution (debugging)")
+define_flag("eager_dispatch_cache", True,
+            "Signature-keyed cache of jitted forward+vjp executables on the "
+            "eager dispatch hot path (KernelFactory-cache analog)")
+define_flag("fused_optimizer", True,
+            "Fuse Optimizer.step's per-parameter update loop into one "
+            "buffer-donated cached executable per parameter-group signature")

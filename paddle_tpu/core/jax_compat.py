@@ -1,0 +1,45 @@
+"""Version shims for the installed JAX.
+
+The codebase targets the modern `jax.shard_map` entry point
+(`check_vma=`, partial-manual `axis_names=`). Older releases only ship
+`jax.experimental.shard_map.shard_map`, whose signature spells the same
+options as `check_rep=` and the inverted `auto=` (axes NOT listed are
+manual there, auto here). Installing the adapter on the `jax` module
+keeps every call site on the one modern spelling.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_adapter(f, mesh=None, in_specs=None, out_specs=None,
+                       axis_names=None, check_vma=None, **kwargs):
+    from jax.experimental.shard_map import shard_map as _legacy
+    if check_vma is not None:
+        kwargs.setdefault("check_rep", check_vma)
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs.setdefault("auto", auto)
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)
+
+
+def _axis_size_adapter(axis_name):
+    # jax.core.axis_frame(name) returns the bound size (raising NameError
+    # when unbound), which is exactly lax.axis_size's contract.
+    import math
+
+    if isinstance(axis_name, (tuple, list)):
+        return math.prod(jax.core.axis_frame(a) for a in axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_adapter
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_adapter
+
+
+install()

@@ -65,8 +65,20 @@ def seed(s: int):
     return default_generator
 
 
+# Monotonic count of global-stream key consumptions. The dispatch cache
+# probes this around a kernel's first eager run: a kernel that drew from the
+# generator is impure (jitting it would freeze the key as a constant) and
+# must never be cached. next_key() is the single chokepoint for that stream.
+_consumed = [0]
+
+
+def consumption_count() -> int:
+    return _consumed[0]
+
+
 def next_key():
     """Get a fresh PRNG key: the scoped (traced) key if installed, else global."""
+    _consumed[0] += 1
     stack = getattr(_tls, "scoped", None)
     if stack:
         key, count = stack[-1]

@@ -231,6 +231,15 @@ class Tensor:
 
     # -- host transfer -------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        # The pipeline's D2H sync point: blocks only on THIS buffer (values
+        # are immutable, so that is coherent) and retires finished in-flight
+        # steps; shows up as a fetch::<op> profiler span. item()/tolist()/
+        # __float__/__bool__/__format__ all funnel through here.
+        from . import async_engine
+
+        node = self._grad_node
+        async_engine.scalar_fetch(
+            self._data, node.name if node is not None else "tensor")
         return np.asarray(self._data)
 
     def item(self, *args):

@@ -14,12 +14,13 @@ from __future__ import annotations
 import contextlib
 import functools
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict
 
 import jax
 import numpy as np
 
-from ..core import dtype as dtype_mod, flags
+from ..core import dtype as dtype_mod, flags, rng as rng_mod
 from ..core.tensor import Tensor
 
 
@@ -165,6 +166,228 @@ def _harmonize_devices(arrays):
     return out
 
 
+# ---------------------------------------------------------------------------
+# Signature-keyed dispatch cache (the KernelFactory-cache analog).
+#
+# jax.vjp retraces the kernel on EVERY eager dispatch; for the hot loop that
+# tracing + tree bookkeeping dominates per-op host cost. Keyed on
+# (op, kernel, treedef, input avals/shardings, static kwargs, needs_grad),
+# the cache holds ONE jitted executable that returns the op's output leaves
+# concatenated with its vjp residual leaves, so a repeat dispatch is a dict
+# hit + compiled-call — zero retraces after warmup.
+#
+# Safety contract: the FIRST call of a signature always runs the plain eager
+# path and doubles as a validation probe — a kernel that consumed the global
+# RNG stream (rng.consumption_count moved: jitting would freeze the key as a
+# constant) or produced non-Array outputs poisons the key (negative cache,
+# eager forever). Tracer inputs, an active static recorder, and unhashable
+# static leaves bypass keying entirely. Any exception from the cached
+# executable poisons the key and re-runs the eager path.
+# ---------------------------------------------------------------------------
+
+_BYPASS = object()  # negative-cache sentinel: signature proven uncacheable
+
+_cache: "OrderedDict[Any, Any]" = OrderedDict()
+_cache_lock = threading.Lock()
+_cache_stats = {
+    "hits": 0, "misses": 0, "bypasses": 0, "negative_hits": 0,
+    "evictions": 0, "traces": 0, "poisoned": 0,
+}
+
+
+class _CacheEntry:
+    __slots__ = ("fwd", "meta", "grad")
+
+    def __init__(self, fwd, meta, grad):
+        self.fwd = fwd      # jitted: (*arrays) -> out_leaves (+ res_leaves)
+        self.meta = meta    # populated as a tracing side effect on 1st exec
+        self.grad = grad    # True: fwd also returns vjp residual leaves
+
+
+def dispatch_cache_stats() -> dict:
+    """Hit/miss/trace counters for the profiler and perf tooling."""
+    out = dict(_cache_stats)
+    with _cache_lock:
+        out["entries"] = len(_cache)
+    total = out["hits"] + out["misses"] + out["negative_hits"]
+    out["hit_rate"] = round(out["hits"] / total, 4) if total else 0.0
+    return out
+
+
+def reset_dispatch_cache_stats():
+    for k in _cache_stats:
+        _cache_stats[k] = 0
+
+
+def clear_dispatch_cache():
+    with _cache_lock:
+        _cache.clear()
+
+
+def _aval_key(a):
+    av = a.aval if hasattr(a, "aval") else jax.api_util.shaped_abstractify(a)
+    return (av.shape, av.dtype, getattr(av, "weak_type", False),
+            getattr(a, "sharding", None))
+
+
+def _make_key(name, kernel, treedef, leaves, t_slots, arrays, needs_grad):
+    """None = bypass (don't key this call)."""
+    if flags.flag_value("eager_dispatch_cache") is False:
+        return None
+    if _static_recorder[0] is not None:
+        return None
+    static = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Tensor):
+            continue
+        # a raw array smuggled through a non-Tensor slot would be baked
+        # into the executable as a constant — never key those calls
+        if isinstance(leaf, (np.ndarray, jax.Array)) or hasattr(leaf, "aval"):
+            return None
+        static.append((i, leaf))
+    try:
+        key = (name, id(kernel), treedef, tuple(static),
+               tuple(_aval_key(a) for a in arrays), needs_grad,
+               dtype_mod.get_default_dtype())
+        hash(key)
+    except TypeError:
+        return None
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            return None
+    return key
+
+
+def _cache_get(key):
+    with _cache_lock:
+        entry = _cache.get(key)
+        if entry is not None:
+            _cache.move_to_end(key)
+        return entry
+
+
+def _cache_put(key, entry):
+    limit = int(flags.flag_value("jit_cache_size"))
+    with _cache_lock:
+        _cache[key] = entry
+        _cache.move_to_end(key)
+        while len(_cache) > limit > 0:
+            _cache.popitem(last=False)
+            _cache_stats["evictions"] += 1
+
+
+def _build_entry(kernel, treedef, leaves, t_slots, needs_grad):
+    """Compile-once executable for this signature. Static leaves are frozen
+    from the probe call (they are part of the cache key, so every hit passes
+    identical values); tensor slots are overwritten with the live arrays."""
+    static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
+    meta = {}
+
+    if needs_grad:
+        def fwd(*arrs):
+            _cache_stats["traces"] += 1
+
+            def pure(*xs):
+                ls = list(static_leaves)
+                for slot, x in zip(t_slots, xs):
+                    ls[slot] = x
+                a2, k2 = jax.tree.unflatten(treedef, ls)
+                return kernel(*a2, **k2)
+
+            out, vjp_fn = jax.vjp(pure, *arrs)
+            out_leaves, out_tree = jax.tree.flatten(out)
+            res_leaves, res_tree = jax.tree.flatten(vjp_fn)
+            meta["out_tree"] = out_tree
+            meta["res_tree"] = res_tree
+            meta["n_out"] = len(out_leaves)
+            return tuple(out_leaves) + tuple(res_leaves)
+    else:
+        def fwd(*arrs):
+            _cache_stats["traces"] += 1
+            ls = list(static_leaves)
+            for slot, x in zip(t_slots, arrs):
+                ls[slot] = x
+            a2, k2 = jax.tree.unflatten(treedef, ls)
+            out = kernel(*a2, **k2)
+            out_leaves, out_tree = jax.tree.flatten(out)
+            meta["out_tree"] = out_tree
+            meta["n_out"] = len(out_leaves)
+            return tuple(out_leaves)
+
+    return _CacheEntry(jax.jit(fwd), meta, needs_grad)
+
+
+def _cached_vjp(res_leaves, res_tree):
+    if _saved_tensors_hooks:
+        # reference: autograd/saved_tensors_hooks — every tensor saved for
+        # backward passes through pack() now and unpack() at backward time;
+        # the cached executable exposes the residual leaves directly.
+        pack, unpack = _saved_tensors_hooks[-1]
+        packed = [pack(Tensor._from_data(leaf)) for leaf in res_leaves]
+
+        def vjp_fn(cot, _packed=packed, _tree=res_tree, _unpack=unpack):
+            ls = []
+            for p in _packed:
+                u = _unpack(p)
+                ls.append(u._data if isinstance(u, Tensor)
+                          else jax.numpy.asarray(u))
+            return jax.tree.unflatten(_tree, ls)(cot)
+        return vjp_fn
+
+    def vjp_fn(cot, _res=res_leaves, _tree=res_tree):
+        return jax.tree.unflatten(_tree, _res)(cot)
+    return vjp_fn
+
+
+def _run_cached(entry, name, kernel, treedef, leaves, t_slots, in_tensors,
+                arrays):
+    outs = entry.fwd(*arrays)
+    meta = entry.meta
+    n_out = meta["n_out"]
+    out_leaves = list(outs[:n_out])
+    if not entry.grad:
+        out_tensors = [_wrap_out(o) for o in out_leaves]
+        return jax.tree.unflatten(meta["out_tree"], out_tensors)
+    res_leaves = list(outs[n_out:])
+    vjp_fn = _cached_vjp(res_leaves, meta["res_tree"])
+    edges = _build_edges(in_tensors)
+    node = _grad_node_cls()(
+        name,
+        vjp_fn,
+        [(tuple(o.shape), o.dtype) for o in out_leaves],
+        meta["out_tree"],
+        edges,
+    )
+    node.saved_for_double = (_make_pure(kernel, treedef, leaves, t_slots),
+                             tuple(in_tensors))
+    out_tensors = [_wrap_out(o, node, i) for i, o in enumerate(out_leaves)]
+    return jax.tree.unflatten(meta["out_tree"], out_tensors)
+
+
+def _make_pure(kernel, treedef, leaves, t_slots):
+    def pure(*arrs):
+        ls = list(leaves)
+        for slot, a in zip(t_slots, arrs):
+            ls[slot] = a
+        a2, k2 = jax.tree.unflatten(treedef, ls)
+        return kernel(*a2, **k2)
+    return pure
+
+
+def _build_edges(in_tensors):
+    edges = []
+    for t in in_tensors:
+        if (not t.stop_gradient or t._grad_node is not None) \
+                and dtype_mod.is_inexact_dtype(t._data.dtype):
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_index))
+            else:
+                edges.append(("leaf", t))
+        else:
+            edges.append(None)
+    return edges
+
+
 def call_op(name: str, kernel: Callable, args, kwargs, nondiff: bool = False):
     if _op_profiling[0]:
         from ..profiler import RecordEvent
@@ -193,48 +416,73 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
         )
     )
 
+    key = _make_key(name, kernel, treedef, leaves, t_slots, arrays,
+                    needs_grad)
+    result = None
+    if key is None:
+        _cache_stats["bypasses"] += 1
+    else:
+        entry = _cache_get(key)
+        if entry is _BYPASS:
+            _cache_stats["negative_hits"] += 1
+        elif entry is not None:
+            try:
+                result = _run_cached(entry, name, kernel, treedef, leaves,
+                                     t_slots, in_tensors, arrays)
+                _cache_stats["hits"] += 1
+            except Exception:  # noqa: BLE001 — a signature that traces
+                # eagerly but fails under jit (concretization, leaked
+                # tracer in the residual treedef) is poisoned and re-run
+                # on the always-correct eager path
+                _cache_put(key, _BYPASS)
+                _cache_stats["poisoned"] += 1
+                result = None
+
+    if result is None:
+        rng_before = rng_mod.consumption_count()
+        result, cacheable = _call_op_eager(name, kernel, treedef, leaves,
+                                           t_slots, in_tensors, arrays,
+                                           needs_grad)
+        if key is not None and _cache_get(key) is None:
+            _cache_stats["misses"] += 1
+            if cacheable and rng_mod.consumption_count() == rng_before:
+                _cache_put(key, _build_entry(kernel, treedef, leaves,
+                                             t_slots, needs_grad))
+            else:
+                _cache_put(key, _BYPASS)
+
+    if flags.flag_value("benchmark"):
+        for t in jax.tree.leaves(result, is_leaf=_is_tensor):
+            if isinstance(t, Tensor) and hasattr(t._data,
+                                                 "block_until_ready"):
+                t._data.block_until_ready()
+    if flags.flag_value("check_nan_inf"):
+        _check_nan_inf(name, result)
+    if _static_recorder[0] is not None:
+        _static_recorder[0].record(name, kernel, treedef, leaves, t_slots,
+                                   in_tensors, result)
+    return result
+
+
+def _call_op_eager(name, kernel, treedef, leaves, t_slots, in_tensors,
+                   arrays, needs_grad):
+    """The always-correct uncached path (also the cache's validation probe).
+    Returns (result, cacheable): cacheable is False when the op produced
+    non-Array output leaves (jit would change their types)."""
+    cacheable = True
     if needs_grad:
-
-        def pure(*arrs):
-            ls = list(leaves)
-            for slot, a in zip(t_slots, arrs):
-                ls[slot] = a
-            a2, k2 = jax.tree.unflatten(treedef, ls)
-            return kernel(*a2, **k2)
-
+        pure = _make_pure(kernel, treedef, leaves, t_slots)
         out, vjp_fn = jax.vjp(pure, *arrays)
         if _saved_tensors_hooks:
-            # reference: autograd/saved_tensors_hooks — every tensor saved
-            # for backward passes through pack() now and unpack() at
-            # backward time. The vjp closure is a jax pytree, so its
-            # residual leaves ARE the saved tensors.
-            pack, unpack = _saved_tensors_hooks[-1]
             res_leaves, res_tree = jax.tree.flatten(vjp_fn)
-            packed = [pack(Tensor._from_data(leaf)) for leaf in res_leaves]
-
-            def vjp_fn(cot, _packed=packed, _tree=res_tree, _unpack=unpack):
-                leaves = []
-                for p in _packed:
-                    u = _unpack(p)
-                    leaves.append(u._data if isinstance(u, Tensor)
-                                  else jax.numpy.asarray(u))
-                return jax.tree.unflatten(_tree, leaves)(cot)
+            vjp_fn = _cached_vjp(res_leaves, res_tree)
         out_leaves, out_treedef = jax.tree.flatten(out)
-        edges = []
-        for t in in_tensors:
-            if (not t.stop_gradient or t._grad_node is not None) and dtype_mod.is_inexact_dtype(t._data.dtype):
-                if t._grad_node is not None:
-                    edges.append(("node", t._grad_node, t._out_index))
-                else:
-                    edges.append(("leaf", t))
-            else:
-                edges.append(None)
         node = _grad_node_cls()(
             name,
             lambda cot, _f=vjp_fn: _f(cot),
             [(tuple(o.shape), o.dtype) for o in out_leaves],
             out_treedef,
-            edges,
+            _build_edges(in_tensors),
         )
         # Higher-order support (reference: general_grad.h): keep the pure
         # kernel + input tensors so a create_graph backward can re-derive the
@@ -250,14 +498,12 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
             ls[slot] = a
         a2, k2 = jax.tree.unflatten(treedef, ls)
         out = kernel(*a2, **k2)
+        for leaf in jax.tree.leaves(out):
+            if not isinstance(leaf, jax.Array):
+                cacheable = False
+                break
         result = jax.tree.map(_wrap_out, out)
-
-    if flags.flag_value("check_nan_inf"):
-        _check_nan_inf(name, result)
-    if _static_recorder[0] is not None:
-        _static_recorder[0].record(name, kernel, treedef, leaves, t_slots,
-                                   in_tensors, result)
-    return result
+    return result, cacheable
 
 
 def _check_nan_inf(name, result):

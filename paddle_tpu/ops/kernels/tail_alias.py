@@ -419,8 +419,8 @@ def multiplex(inputs, index):
     """phi multiplex: out[i] = inputs[index[i]][i]."""
     stacked = jnp.stack(inputs)                      # [K, N, ...]
     idx = index.reshape(-1).astype(jnp.int32)
-    return jnp.take_along_axis(
-        stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+    idx = idx.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+    return jnp.take_along_axis(stacked, idx, axis=0)[0]
 
 
 @register_op

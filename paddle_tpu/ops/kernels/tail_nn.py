@@ -681,4 +681,8 @@ def standard_gamma(x, seed=0):
 @register_op(nondiff=True)
 def binomial(count, prob, seed=0):
     key = _key(seed)
+    # f64 inputs: under x64, jax<0.5's binomial clamps f32 counts against
+    # f64 literals and trips lax.clamp's dtype check.
+    count = jnp.asarray(count, jnp.float64)
+    prob = jnp.asarray(prob, jnp.float64)
     return jax.random.binomial(key, count, prob).astype(jnp.int64)

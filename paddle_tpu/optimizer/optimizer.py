@@ -8,15 +8,30 @@ ready for jitted/sharded training steps and for checkpointing.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import async_engine, flags
 from ..core.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradBase
 from ..ops.dispatch import no_grad
 from .lr import LRScheduler
+
+
+class _ParamProxy:
+    """Stand-in handed to _update/_apply_decay during fused tracing: carries
+    the traced data array plus the identity attrs those methods read."""
+
+    __slots__ = ("_data", "name", "optimize_attr")
+
+    def __init__(self, data, name, lr_mult):
+        self._data = data
+        self.name = name
+        self.optimize_attr = {"learning_rate": lr_mult}
 
 
 class Optimizer:
@@ -36,6 +51,14 @@ class Optimizer:
         self._grad_clip: Optional[ClipGradBase] = grad_clip
         self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
+        # fused-step machinery: one donated executable per param-group
+        # signature; a signature fuses from its SECOND occurrence (the first
+        # runs the plain loop, which materializes accumulators with their
+        # python-side init expressions). Any trace/runtime failure (e.g.
+        # RAdam's host-side rho_t branch) disables fusion for this instance.
+        self._fused_cache: Dict[tuple, object] = {}
+        self._fused_seen: set = set()
+        self._fused_disabled = False
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -108,13 +131,107 @@ class Optimizer:
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)
         lr = self.get_lr()
+        pg = [(p, g) for p, g in pg if g is not None]
+        if not pg:
+            self._step_count += 1
+            return
+        if self._fused_disabled or not flags.flag_value("fused_optimizer"):
+            self._eager_step(pg, lr)
+        else:
+            self._try_fused(pg, lr)
+        self._step_count += 1
+        # step boundary for the pipeline: enqueue this step's param buffers;
+        # blocks the host only once > FLAGS_eager_async_depth are in flight
+        async_engine.mark_step([p._data for p, _ in pg],
+                               tag=f"{type(self).__name__}.step")
+
+    def _eager_step(self, pg, lr):
         for p, g in pg:
-            if g is None:
-                continue
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             g = self._apply_decay(p, g, plr)
             p._data = self._update(p, g, plr)
-        self._step_count += 1
+
+    # -- fused stepping ------------------------------------------------------
+    def _fused_key(self, pg):
+        try:
+            parts = []
+            for p, g in pg:
+                mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+                parts.append((p.name, tuple(p._data.shape), str(p._data.dtype),
+                              tuple(g.shape), str(g.dtype), float(mult)))
+            accs = tuple(sorted(
+                (pn, an, tuple(a.shape), str(a.dtype))
+                for pn, store in self._accumulators.items()
+                for an, a in store.items()))
+            return (tuple(parts), accs)
+        except Exception:  # noqa: BLE001 — unkeyable group: stay eager
+            return None
+
+    def _try_fused(self, pg, lr):
+        """Apply this step via the fused donated executable, warming up or
+        falling back to the plain per-parameter loop as needed."""
+        key = self._fused_key(pg)
+        if key is None:
+            self._eager_step(pg, lr)
+            return
+        if key not in self._fused_seen:
+            # warmup occurrence: the plain loop materializes accumulators
+            # (their init expressions are host-side) and validates _update
+            self._fused_seen.add(key)
+            self._eager_step(pg, lr)
+            return
+        try:
+            fn = self._fused_cache.get(key)
+            if fn is None:
+                fn = self._build_fused(pg)
+                self._fused_cache[key] = fn
+            param_arrs = [p._data for p, _ in pg]
+            grad_arrs = [jnp.asarray(g) for _, g in pg]
+            with warnings.catch_warnings():
+                # CPU/unshardable buffers make XLA decline the donation with
+                # a warning; the update is still correct, just not in-place
+                warnings.simplefilter("ignore")
+                new_params, new_accs = fn(
+                    param_arrs, grad_arrs, self._accumulators,
+                    jnp.float32(lr), jnp.int32(self._step_count))
+            for (p, _), arr in zip(pg, new_params):
+                p._data = arr
+            self._accumulators = new_accs
+        except Exception:  # noqa: BLE001 — host-side control flow in
+            # _update (RAdam's rho_t branch, LBFGS) cannot trace; run this
+            # instance eagerly forever
+            self._fused_disabled = True
+            self._fused_cache.clear()
+            self._eager_step(pg, lr)
+
+    def _build_fused(self, pg):
+        """One executable for the whole parameter group: the per-parameter
+        _update loop is traced ONCE (step count and lr enter as traced
+        scalars, accumulators as a donated pytree) so every later step is a
+        single dispatch with buffer reuse instead of len(params) dispatches."""
+        names = [p.name for p, _ in pg]
+        mults = [getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+                 for p, _ in pg]
+
+        def fused(param_arrs, grad_arrs, accs, lr, step_count):
+            saved_accs = self._accumulators
+            saved_step = self._step_count
+            self._accumulators = jax.tree.map(lambda a: a, accs)
+            self._step_count = step_count
+            try:
+                new_params = []
+                for name, mult, p_arr, g_arr in zip(names, mults, param_arrs,
+                                                    grad_arrs):
+                    proxy = _ParamProxy(p_arr, name, mult)
+                    plr = lr * mult
+                    g = self._apply_decay(proxy, g_arr, plr)
+                    new_params.append(self._update(proxy, g, plr))
+                return new_params, self._accumulators
+            finally:
+                self._accumulators = saved_accs
+                self._step_count = saved_step
+
+        return jax.jit(fused, donate_argnums=(0, 2))
 
     def _update(self, param, grad, lr):
         raise NotImplementedError

@@ -27,7 +27,23 @@ from ..core import native as _native
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark"]
+           "benchmark", "dispatch_cache_stats", "async_stats"]
+
+
+def dispatch_cache_stats() -> dict:
+    """Eager dispatch-cache counters (hits/misses/traces/hit_rate): the
+    profiler-facing view of the signature-keyed executable cache."""
+    from ..ops.dispatch import dispatch_cache_stats as _stats
+
+    return _stats()
+
+
+def async_stats() -> dict:
+    """Pipelined-execution counters (in-flight depth, sync fetches,
+    backpressure waits) from the async engine."""
+    from ..core import async_engine
+
+    return async_engine.stats()
 
 
 class ProfilerState(Enum):

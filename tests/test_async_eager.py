@@ -1,0 +1,325 @@
+"""Async eager execution: the signature-keyed dispatch cache, the pipelined
+in-flight step queue with lazy scalar fetch, and the fused donated optimizer
+step. Covers the PR's acceptance bar: zero retraces after warmup, grad parity
+between sync (depth 0) and pipelined (depth 2) execution, hook/debug-flag
+correctness on the cached path, and program_guard forcing sync mode.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import async_engine, flags
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu.ops import dispatch
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dispatch.clear_dispatch_cache()
+    dispatch.reset_dispatch_cache_stats()
+    async_engine.drain()
+    async_engine.reset_stats()
+    yield
+    flags.set_flags({"eager_async_depth": 2, "eager_dispatch_cache": True,
+                     "fused_optimizer": True, "check_nan_inf": False})
+
+
+def _lenet_step(model, opt, x, y):
+    loss = paddle.nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+def _train_lenet(depth, steps=4):
+    paddle.seed(0)
+    flags.set_flags({"eager_async_depth": depth})
+    np.random.seed(0)
+    model = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 10, (8,)))
+    losses = [float(_lenet_step(model, opt, x, y).numpy())
+              for _ in range(steps)]
+    params = [np.asarray(p.numpy()) for p in model.parameters()]
+    return losses, params
+
+
+# ---------------------------------------------------------------------------
+# dispatch cache
+# ---------------------------------------------------------------------------
+
+def test_zero_retraces_after_warmup():
+    """Acceptance bar: after the two-call warmup (probe + compile) a repeated
+    signature never traces again."""
+    a = paddle.to_tensor(np.random.rand(16, 16).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(16, 16).astype(np.float32))
+    for _ in range(2):  # warmup: call 1 = eager probe, call 2 = compile
+        (a @ b + a).sum()
+    dispatch.reset_dispatch_cache_stats()
+    for _ in range(10):
+        r = (a @ b + a).sum()
+    stats = dispatch.dispatch_cache_stats()
+    assert stats["traces"] == 0, f"retraced after warmup: {stats}"
+    assert stats["hits"] == 30
+    assert stats["misses"] == 0
+    assert stats["hit_rate"] == 1.0
+    np.testing.assert_allclose(
+        float(r.numpy()),
+        float(np.asarray((np.asarray(a.numpy()) @ np.asarray(b.numpy())
+                          + np.asarray(a.numpy())).sum())), rtol=1e-5)
+
+
+def test_cached_path_matches_eager_forward_backward():
+    npa = np.random.rand(8, 8).astype(np.float32)
+    npb = np.random.rand(8, 8).astype(np.float32)
+
+    def run(cache_on):
+        flags.set_flags({"eager_dispatch_cache": cache_on})
+        a = paddle.to_tensor(npa)
+        a.stop_gradient = False
+        b = paddle.to_tensor(npb)
+        out = None
+        for _ in range(3):  # past warmup so the cached executable runs
+            if a.grad is not None:
+                a.clear_grad()
+            out = ((a * b).sum() + (a @ b).mean())
+            out.backward()
+        return float(out.numpy()), np.asarray(a.grad.numpy())
+
+    v_eager, g_eager = run(False)
+    v_cached, g_cached = run(True)
+    np.testing.assert_allclose(v_cached, v_eager, rtol=1e-6)
+    np.testing.assert_allclose(g_cached, g_eager, rtol=1e-6)
+
+
+def test_rng_ops_never_cached():
+    """A kernel that drew from the global generator is impure: it must be
+    negative-cached (jit would freeze the key) and stay stochastic."""
+    paddle.seed(123)
+    vals = [float(paddle.uniform([32]).sum().numpy()) for _ in range(4)]
+    assert len(set(vals)) == len(vals), "uniform repeated a value: key frozen"
+    stats = dispatch.dispatch_cache_stats()
+    assert stats["negative_hits"] >= 2
+
+
+def test_cache_eviction_bounded():
+    old = flags.flag_value("jit_cache_size")
+    flags.set_flags({"jit_cache_size": 4})
+    try:
+        for n in range(1, 10):  # 9 distinct shapes -> 9 signatures
+            t = paddle.to_tensor(np.ones((n,), np.float32))
+            (t + t).sum()
+        stats = dispatch.dispatch_cache_stats()
+        assert stats["entries"] <= 4
+        assert stats["evictions"] > 0
+    finally:
+        flags.set_flags({"jit_cache_size": old})
+
+
+def test_saved_tensors_hooks_on_cached_path():
+    """pack/unpack must see every residual tensor on the cached path too
+    (hooks affect GradNode construction, not the cached executable)."""
+    packed_count = [0]
+    unpacked_count = [0]
+
+    def pack(t):
+        packed_count[0] += 1
+        return np.asarray(t.numpy())  # simulate offload to host
+
+    def unpack(h):
+        unpacked_count[0] += 1
+        return paddle.to_tensor(h)
+
+    a = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+    a.stop_gradient = False
+    ref = None
+    for i in range(3):
+        if a.grad is not None:
+            a.clear_grad()
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            loss = (a * a).sum()
+        loss.backward()
+        if i == 0:
+            ref = np.asarray(a.grad.numpy())
+    assert dispatch.dispatch_cache_stats()["hits"] > 0
+    assert packed_count[0] > 0 and unpacked_count[0] > 0
+    np.testing.assert_allclose(np.asarray(a.grad.numpy()), ref, rtol=1e-6)
+
+
+def test_check_nan_inf_fires_on_cached_path():
+    flags.set_flags({"check_nan_inf": True})
+    a = paddle.to_tensor(np.ones((4,), np.float32))
+    b = paddle.to_tensor(np.zeros((4,), np.float32))
+    for _ in range(2):
+        a * 2.0  # warm a benign signature
+    with pytest.raises(Exception, match="[Nn]an|[Ii]nf"):
+        for _ in range(3):  # hit the cached path with a nan-producing input
+            (a / b) * 1.0
+
+
+def test_double_grad_still_works_through_cache():
+    a = paddle.to_tensor(np.array([3.0], np.float32))
+    a.stop_gradient = False
+    for _ in range(3):
+        y = (a * a * a).sum()
+        (g,) = paddle.grad([y], [a], create_graph=True)
+        (gg,) = paddle.grad([g], [a])
+        a.clear_grad()
+    np.testing.assert_allclose(np.asarray(gg.numpy()), [18.0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pipelined steps + lazy scalar fetch
+# ---------------------------------------------------------------------------
+
+def test_grad_parity_sync_vs_pipelined_lenet():
+    """Acceptance bar: a LeNet training run is bit-compatible between fully
+    synchronous (depth 0) and pipelined (depth 2) execution."""
+    losses0, params0 = _train_lenet(depth=0)
+    losses2, params2 = _train_lenet(depth=2)
+    np.testing.assert_allclose(losses0, losses2, rtol=1e-5)
+    for p0, p2 in zip(params0, params2):
+        np.testing.assert_allclose(p0, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_scalar_fetch_is_sync_point():
+    flags.set_flags({"eager_async_depth": 2})
+    async_engine.reset_stats()
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    assert float(t.sum().numpy()) == 15.0
+    assert t.sum().item() == 15.0
+    assert int(t.sum()) == 15
+    assert async_engine.stats()["sync_fetches"] >= 3
+
+
+def test_mark_step_backpressure_at_depth():
+    flags.set_flags({"eager_async_depth": 2})
+    async_engine.drain()
+    async_engine.reset_stats()
+    p = Parameter(paddle.to_tensor(np.ones((4,), np.float32))._data)
+    p.stop_gradient = False
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    for _ in range(5):
+        loss = (p * p).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    s = async_engine.stats()
+    assert s["steps_marked"] == 5
+    assert s["in_flight"] <= 2  # never more than depth in flight
+    assert s["max_depth_seen"] <= 2
+    paddle.synchronize()
+    assert async_engine.in_flight() == 0
+
+
+def test_depth_zero_is_fully_synchronous():
+    flags.set_flags({"eager_async_depth": 0})
+    async_engine.drain()
+    async_engine.reset_stats()
+    p = Parameter(paddle.to_tensor(np.ones((4,), np.float32))._data)
+    p.stop_gradient = False
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    loss.backward()
+    opt.step()
+    s = async_engine.stats()
+    assert s["steps_marked"] == 1
+    assert s["in_flight"] == 0  # depth 0 blocks at the mark, queues nothing
+
+
+def test_program_guard_forces_sync_mode():
+    """A static-graph recording must observe program order: the effective
+    pipeline depth is 0 while the recorder is active, whatever the flag."""
+    flags.set_flags({"eager_async_depth": 4})
+    assert async_engine.depth() == 4
+    main = paddle.static.Program()
+    startup = paddle.static.Program()
+    paddle.enable_static()
+    try:
+        with paddle.static.program_guard(main, startup):
+            assert async_engine.depth() == 0
+            # dispatches under the recorder bypass the cache (key=None)
+            before = dispatch.dispatch_cache_stats()["bypasses"]
+            x = paddle.static.data(name="x", shape=[4], dtype="float32")
+            _ = x + x
+            assert dispatch.dispatch_cache_stats()["bypasses"] > before
+    finally:
+        paddle.disable_static()
+    assert async_engine.depth() == 4
+
+
+def test_synchronize_api():
+    flags.set_flags({"eager_async_depth": 3})
+    t = paddle.to_tensor(np.ones((8, 8), np.float32))
+    for _ in range(4):
+        t = t @ t
+    paddle.synchronize()  # must drain + fence without error
+    assert async_engine.in_flight() == 0
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer
+# ---------------------------------------------------------------------------
+
+def test_fused_optimizer_parity_adam():
+    def run(fused):
+        paddle.seed(0)
+        flags.set_flags({"fused_optimizer": fused})
+        np.random.seed(0)
+        p = Parameter(paddle.to_tensor(
+            np.random.randn(16, 4).astype(np.float32))._data)
+        p.stop_gradient = False
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[p])
+        x = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+        for _ in range(6):
+            loss = ((p @ x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(p.numpy()), opt
+
+    w_eager, _ = run(False)
+    w_fused, opt = run(True)
+    np.testing.assert_allclose(w_fused, w_eager, rtol=1e-5, atol=1e-6)
+    assert not opt._fused_disabled
+    assert len(opt._fused_cache) == 1  # one executable per group signature
+
+
+def test_fused_optimizer_host_branch_falls_back():
+    """RAdam's rho_t rectification branch is host-side python: the fused
+    trace must fail closed into the always-correct eager loop."""
+    paddle.seed(0)
+    p = Parameter(paddle.to_tensor(np.ones((4,), np.float32))._data)
+    p.stop_gradient = False
+    opt = paddle.optimizer.RAdam(learning_rate=0.1, parameters=[p])
+    for _ in range(4):
+        loss = (p * p).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert opt._fused_disabled
+    assert np.all(np.isfinite(np.asarray(p.numpy())))
+
+
+def test_fused_optimizer_with_grad_clip():
+    """Grad clip runs eagerly BEFORE the fused executable; results match."""
+    def run(fused):
+        flags.set_flags({"fused_optimizer": fused})
+        np.random.seed(1)
+        p = Parameter(paddle.to_tensor(
+            np.random.randn(8,).astype(np.float32))._data)
+        p.stop_gradient = False
+        clip = paddle.nn.ClipGradByGlobalNorm(0.5)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p],
+                                   grad_clip=clip)
+        for _ in range(4):
+            loss = (p * p * 10.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(p.numpy())
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)

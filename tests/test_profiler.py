@@ -74,6 +74,44 @@ def test_benchmark_timer_ips():
     hub.end()
 
 
+def test_fetch_span_recorded_at_sync_point(tmp_path):
+    """Tensor.numpy() under the profiler shows the D2H wait as a
+    fetch::<op> span, making pipeline sync stalls attributable."""
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    p.start()
+    x = paddle.rand([16, 16])
+    y = paddle.matmul(x, x).sum()
+    _ = float(y.numpy())  # the sync point
+    p.stop()
+    out = str(tmp_path / "trace.json")
+    p.export(out)
+    with open(out) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert any(n.startswith("fetch::") for n in names), names
+
+
+def test_dispatch_cache_stats_api():
+    from paddle_tpu.ops import dispatch
+
+    dispatch.clear_dispatch_cache()
+    dispatch.reset_dispatch_cache_stats()
+    a = paddle.rand([8, 8])
+    for _ in range(4):
+        _ = a + a
+    stats = prof.dispatch_cache_stats()
+    for key in ("hits", "misses", "traces", "hit_rate", "entries"):
+        assert key in stats
+    assert stats["misses"] >= 1
+    assert stats["hits"] >= 2
+    assert 0.0 <= stats["hit_rate"] <= 1.0
+
+
+def test_async_stats_api():
+    stats = prof.async_stats()
+    for key in ("in_flight", "depth", "sync_fetches", "steps_marked"):
+        assert key in stats
+
+
 def test_dataloader_feeds_reader_cost():
     import paddle_tpu.io as io
 

@@ -68,6 +68,19 @@ def capture() -> int:
     t0 = time.perf_counter()
     flagship = bench.bench_llama()
     flag_wall = round(time.perf_counter() - t0, 1)
+    # regression-floor check (policy in BENCH_BASELINE.json): a pinned
+    # same-platform flagship below 1.0x is a RED build signal
+    try:
+        with open(os.path.join(REPO, "BENCH_BASELINE.json")) as f:
+            base = json.load(f)
+        pin = (base.get("configs") or {}).get(
+            "llama_train_tokens_per_sec_per_chip")
+        if base.get("platform") == d.platform and pin:
+            flagship["vs_baseline"] = round(flagship["value"] / pin, 4)
+            if flagship["vs_baseline"] < 1.0:
+                flagship["red_signal"] = True
+    except (OSError, ValueError):
+        pass
     t0 = time.perf_counter()
     try:
         decode = bench.bench_llama_decode()
@@ -159,6 +172,10 @@ def _capture_locked(capture_timeout: float) -> bool:
     v = payload["flagship"].get("value")
     log(f"captured TPU flagship: {v} tokens/s/chip "
         f"on {payload['device'].get('device_kind')}")
+    if payload["flagship"].get("red_signal"):
+        log(f"RED: flagship vs_baseline="
+            f"{payload['flagship'].get('vs_baseline')} < 1.0 — perf "
+            f"regression against the pinned floor (BENCH_BASELINE.json)")
     paths = [ATTEST_PATH]
     if _pin_op_bench():
         paths.append(OP_BASE_PATH)

@@ -69,12 +69,26 @@ def _basket():
     t_tiny = Tensor._from_data(tiny)
     t_tiny_g = Tensor._from_data(tiny)
     t_tiny_g.stop_gradient = False
+
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.ops import dispatch as _dispatch
+
+    def _add_uncached():
+        # the pre-cache dispatch cost: flag off forces the jax.vjp-every-call
+        # path, which is what every dispatch paid before the signature cache
+        _flags.set_flags({"eager_dispatch_cache": False})
+        try:
+            return OPS["add"](t_tiny_g, t_tiny_g)._data
+        finally:
+            _flags.set_flags({"eager_dispatch_cache": True})
+
     # eager entries run the PUBLIC api (dispatch + tape), not raw kernels;
     # they are marked so measure() skips jitting them
     eager = {
         "eager_dispatch_add": lambda: OPS["add"](t_tiny, t_tiny)._data,
         "eager_dispatch_add_grad": lambda: OPS["add"](
             t_tiny_g, t_tiny_g)._data,
+        "eager_dispatch_add_uncached": _add_uncached,
     }
     jitted = {
         "matmul_256": lambda: K["matmul"](a, b),
@@ -95,6 +109,9 @@ def _basket():
 def measure(reps: int = 20, warmup: int = 3):
     out = {}
     eager, jitted = _basket()
+    from paddle_tpu.ops import dispatch as _dispatch
+
+    _dispatch.reset_dispatch_cache_stats()
     entries = [(n, f, False) for n, f in eager.items()] + \
         [(n, f, True) for n, f in jitted.items()]
     for name, fn, do_jit in entries:
@@ -135,7 +152,14 @@ def main():
         ncpu = os.cpu_count()
     key = f"{platform}/{ncpu}cpu"
     current = measure(args.reps)
-    print(json.dumps({"key": key, "timings": current}, indent=1))
+    from paddle_tpu.ops.dispatch import dispatch_cache_stats
+
+    cache = dispatch_cache_stats()
+    print(json.dumps({"key": key, "timings": current,
+                      "dispatch_cache": {"hit_rate": cache["hit_rate"],
+                                         "traces": cache["traces"],
+                                         "entries": cache["entries"]}},
+                     indent=1))
 
     if args.update:
         broken = {n: t for n, t in current.items() if isinstance(t, dict)}

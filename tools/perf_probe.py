@@ -46,15 +46,32 @@ def probe(B, remat, steps, warmup, M=1):
         sp, opt, loss = step(sp, opt, tokens, targets)
     if loss is not None:
         float(loss)
+    from paddle_tpu.core import async_engine
+    from paddle_tpu.ops import dispatch
+
+    async_engine.reset_stats()
+    dispatch.reset_dispatch_cache_stats()
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         sp, opt, loss = step(sp, opt, tokens, targets)
+        a_s = async_engine.stats()
+        c_s = dispatch.dispatch_cache_stats()
+        print(f"  step {i}: in_flight={a_s['in_flight']}/{a_s['depth']} "
+              f"cache_hit_rate={c_s['hit_rate']}", flush=True)
     float(loss)
     dt = time.perf_counter() - t0
     tps = B * T * steps / dt
     mfu = cfg.flops_per_token() * tps / bench.chip_peak_flops(jax.devices()[0])
+    a_s = async_engine.stats()
+    c_s = dispatch.dispatch_cache_stats()
     return {"tokens_per_s": round(tps, 1), "mfu": round(mfu, 4),
-            "step_s": round(dt / steps, 4), "loss": float(loss)}
+            "step_s": round(dt / steps, 4), "loss": float(loss),
+            "async": {"depth": a_s["depth"],
+                      "max_in_flight": a_s["max_depth_seen"],
+                      "backpressure_waits": a_s["backpressure_waits"],
+                      "sync_fetches": a_s["sync_fetches"]},
+            "dispatch_cache": {"hit_rate": c_s["hit_rate"],
+                               "traces": c_s["traces"]}}
 
 
 def main():
