@@ -819,7 +819,13 @@ def main():
         print(f"[bench] running {name} ({_remaining():.0f}s left)...",
               file=sys.stderr, flush=True)
         try:
+            # per-config observability window: the snapshot embedded below
+            # covers exactly this config's dispatches/stalls/retraces
+            from paddle_tpu import observability as _obs
+
+            _obs.reset()
             r = fn()
+            r.setdefault("details", {})["observability"] = _obs.summary()
             pinned = baselines.get(name)
             if pinned:
                 r["vs_baseline"] = round(r["value"] / pinned, 4)

@@ -176,6 +176,12 @@ def synchronize():
 
 
 # Subpackages (populated as the framework grows; see SURVEY.md §7 build plan) -
+from . import observability  # noqa: F401, E402  (flight recorder + metrics)
+
+# SIGUSR1 -> flight-recorder dump: a hung process can be inspected with
+# `kill -USR1 <pid>` (no-op when not installable, e.g. non-main thread)
+observability.install_signal_handler()
+
 from . import autograd  # noqa: F401, E402
 from . import nn  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402

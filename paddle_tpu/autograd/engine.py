@@ -181,6 +181,9 @@ def run_backward(
 
         span = RecordEvent(f"backward::{len(nodes)}nodes")
         span.begin()
+    import time as _time
+
+    _bwd_t0 = _time.perf_counter()
     ready = [n for n in nodes.values() if indeg[id(n)] == 0]
     processed = 0
     while ready:
@@ -233,6 +236,10 @@ def run_backward(
     # fine (they were not on a path from the seeds).
     if span is not None:
         span.end()
+    from ..observability import emit as _emit
+
+    _emit("backward", dur_s=_time.perf_counter() - _bwd_t0,
+          nodes=len(nodes), processed=processed)
     return processed
 
 

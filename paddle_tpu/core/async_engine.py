@@ -28,6 +28,7 @@ Reference analog: the dygraph async executor / GC queue depth
 """
 from __future__ import annotations
 
+import time
 import threading
 from collections import deque
 from typing import Any, Iterable, Optional
@@ -37,13 +38,18 @@ from . import flags
 _lock = threading.Lock()
 _queue: deque = deque()  # (tag, [arrays]) step groups in dispatch order
 
-_stats = {
-    "steps_marked": 0,
-    "backpressure_waits": 0,
-    "sync_fetches": 0,
-    "drains": 0,
-    "max_depth_seen": 0,
+# counters live in the unified metrics registry (observability.emit is the
+# only writer); stats() is a view translating to the legacy key names
+_STATS_METRICS = {
+    "steps_marked": "paddle_eager_steps_marked_total",
+    "backpressure_waits": "paddle_eager_backpressure_waits_total",
+    "sync_fetches": "paddle_eager_sync_fetches_total",
+    "drains": "paddle_eager_drains_total",
+    "max_depth_seen": "paddle_eager_inflight_depth_max",
 }
+
+
+from ..observability import emit as _emit  # noqa: E402
 
 
 def depth() -> int:
@@ -61,15 +67,28 @@ def in_flight() -> int:
 
 
 def stats() -> dict:
-    out = dict(_stats)
+    """Pipeline counters: a view over the metrics registry."""
+    from ..observability import registry
+
+    reg = registry()
+    out = {k: int(reg.value(name)) for k, name in _STATS_METRICS.items()}
     out["in_flight"] = len(_queue)
     out["depth"] = depth()
     return out
 
 
 def reset_stats():
-    for k in _stats:
-        _stats[k] = 0
+    from ..observability import registry
+
+    reg = registry()
+    for name in _STATS_METRICS.values():
+        m = reg.get(name)
+        if m is not None:
+            m.reset()
+    # the stall histogram feeds p50/p99 in summaries; reset alongside
+    h = reg.get("paddle_fetch_stall_seconds")
+    if h is not None:
+        h.reset()
 
 
 def _block_on(arrays: Iterable[Any]):
@@ -97,26 +116,36 @@ def mark_step(arrays: Iterable[Any], tag: str = "step"):
     arrays = [a for a in arrays if hasattr(a, "block_until_ready")]
     d = depth()
     if d == 0:
+        t0 = time.perf_counter()
         _block_on(arrays)
-        _stats["steps_marked"] += 1
+        _emit("async.enqueue", tag=tag, depth=0)
+        _emit("async.sync_wait", dur_s=time.perf_counter() - t0,
+              tag=tag, n_buffers=len(arrays))
         return
     with _lock:
         _queue.append((tag, arrays))
-        _stats["steps_marked"] += 1
         overflow = []
         while len(_queue) > d:
             overflow.append(_queue.popleft())
-        _stats["max_depth_seen"] = max(_stats["max_depth_seen"], len(_queue))
+        n = len(_queue)
+    _emit("async.enqueue", tag=tag, depth=n)
     for tag_o, arrs in overflow:
-        _stats["backpressure_waits"] += 1
+        t0 = time.perf_counter()
         _with_span(f"wait::{tag_o}", _block_on, arrs)
+        _emit("async.backpressure", dur_s=time.perf_counter() - t0,
+              tag=tag_o, n_buffers=len(arrs))
 
 
 def _retire_ready():
     """Pop already-finished steps off the head of the queue (non-blocking)."""
+    retired = 0
     with _lock:
         while _queue and all(_is_ready(a) for a in _queue[0][1]):
             _queue.popleft()
+            retired += 1
+        n = len(_queue)
+    if retired:
+        _emit("async.depth", depth=n)
 
 
 def _with_span(name: str, fn, *args):
@@ -133,11 +162,21 @@ def _with_span(name: str, fn, *args):
 def scalar_fetch(arr, tag: str = "tensor"):
     """The D2H sync point: block until ``arr`` is computed, under a
     ``fetch::<tag>`` profiler span. Only the requested value is waited on —
-    younger in-flight steps keep running; already-finished steps retire."""
+    younger in-flight steps keep running; already-finished steps retire.
+
+    Every fetch lands in the ``paddle_fetch_stall_seconds`` histogram and
+    the flight recorder with the blocked buffer's identity (tag = the op
+    that produced it, plus shape/dtype), so a slow eager loop can be
+    attributed to the exact value that forced the host to wait."""
     if not hasattr(arr, "block_until_ready") or hasattr(arr, "_trace"):
         return arr  # tracer or non-array: preserve the eager error path
-    _stats["sync_fetches"] += 1
+    was_ready = _is_ready(arr)
+    t0 = time.perf_counter()
     _with_span(f"fetch::{tag}", _block_on, (arr,))
+    _emit("async.fetch_stall", dur_s=time.perf_counter() - t0, tag=tag,
+          shape=tuple(getattr(arr, "shape", ())),
+          dtype=str(getattr(arr, "dtype", "")),
+          was_ready=was_ready, in_flight=len(_queue))
     if _queue:
         _retire_ready()
     return arr
@@ -148,9 +187,11 @@ def drain():
     with _lock:
         groups = list(_queue)
         _queue.clear()
-    _stats["drains"] += 1
+    t0 = time.perf_counter()
     for _tag, arrs in groups:
         _block_on(arrs)
+    _emit("async.drain", dur_s=time.perf_counter() - t0, n_steps=len(groups))
+    _emit("async.depth", depth=0)
 
 
 def synchronize():

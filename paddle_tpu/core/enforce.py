@@ -6,8 +6,24 @@ PADDLE_ENFORCE_*): raise rich, typed errors with an error-summary header.
 from __future__ import annotations
 
 
+# distress hook injected by paddle_tpu.observability (kept injectable so
+# this module stays dependency-free): fn(exc_type_name, message) — may
+# dump the flight recorder under FLAGS_dump_on_enforce
+_distress_hook = [None]
+
+
+def set_distress_hook(fn):
+    _distress_hook[0] = fn
+
+
 class EnforceNotMet(RuntimeError):
     """Base framework error (reference: phi::enforce::EnforceNotMet)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        hook = _distress_hook[0]
+        if hook is not None:
+            hook(type(self).__name__, str(args[0]) if args else "")
 
 
 class InvalidArgumentError(EnforceNotMet, ValueError):

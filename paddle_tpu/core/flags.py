@@ -14,6 +14,15 @@ import os
 from typing import Any, Dict, Iterable, Optional
 
 _REGISTRY: Dict[str, dict] = {}
+# change watchers: fn(name, value) called after every set_flags update —
+# lets hot paths cache flag values instead of dict-looking-up per call
+# (the observability emit() fast path relies on this)
+_WATCHERS: list = []
+
+
+def on_change(fn):
+    _WATCHERS.append(fn)
+    return fn
 
 
 def _coerce(value, proto):
@@ -59,6 +68,8 @@ def set_flags(flags: Dict[str, Any]):
         if key not in _REGISTRY:
             raise ValueError(f"Flag FLAGS_{key} is not registered")
         _REGISTRY[key]["value"] = _coerce(v, _REGISTRY[key]["default"])
+        for fn in _WATCHERS:
+            fn(key, _REGISTRY[key]["value"])
 
 
 def flag_value(name: str):

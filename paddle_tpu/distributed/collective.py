@@ -37,10 +37,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import time
+
 from ..core.tensor import Tensor
 from ..core import flags
+from ..observability import emit as _obs_emit
 from .env import get_rank, get_world_size
-from .comm_watchdog import comm_task
+from .comm_watchdog import comm_task, note_issue
 
 
 class ReduceOp:
@@ -431,6 +434,10 @@ def _run_multiproc(g: Group, fn_name: str, x, **kw):
     gshape = (x.shape[0] * g.nranks,) + tuple(x.shape[1:])
     gx = jax.make_array_from_single_device_arrays(gshape, sh, arrs)
     exe = _eager_collective(g._mesh, g.axis_name, fn_name, g.nranks, **kw)
+    _obs_emit("collective.issue", op=fn_name, group=g.id,
+              rank=max(g.rank, 0), shape=tuple(x.shape),
+              dtype=str(x.dtype), multiproc=True)
+    t0 = time.perf_counter()
     with comm_task(fn_name, g.id, max(g.rank, 0), tuple(x.shape),
                    str(x.dtype)):
         out = exe(gx)
@@ -443,6 +450,8 @@ def _run_multiproc(g: Group, fn_name: str, x, **kw):
                 res.block_until_ready()
             except AttributeError:
                 pass
+    _obs_emit("collective.complete", dur_s=time.perf_counter() - t0,
+              op=fn_name, group=g.id, rank=max(g.rank, 0))
     if squeeze and getattr(res, "ndim", 0) == 1 and res.shape[0] == 1:
         res = jnp.reshape(res, ())
     return res, Task([res])
@@ -458,14 +467,29 @@ def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
     if _multiproc(g):
         return _run_multiproc(g, fn_name, x, **kw)
     if not _shardable(x, g):
+        note_issue(fn_name, g.id, max(g.rank, 0))
+        _obs_emit("collective.issue", op=fn_name, group=g.id,
+                  rank=max(g.rank, 0),
+                  shape=tuple(getattr(x, "shape", ())),
+                  dtype=str(getattr(x, "dtype", "")), replicated=True)
+        t0 = time.perf_counter()
         out = _replicated(fn_name, x, g, **kw)
+        _obs_emit("collective.complete", dur_s=time.perf_counter() - t0,
+                  op=fn_name, group=g.id, rank=max(g.rank, 0))
         return out, None
     # Lay the operand out over the group's device axis (rank-major on dim 0).
     # Already-sharded arrays are a no-op move.
     x = jax.device_put(x, NamedSharding(g._mesh, P(g.axis_name)))
     exe = _eager_collective(g._mesh, g.axis_name, fn_name, g.nranks,
                             **{k: v for k, v in kw.items()})
+    note_issue(fn_name, g.id, max(g.rank, 0))
+    _obs_emit("collective.issue", op=fn_name, group=g.id,
+              rank=max(g.rank, 0), shape=tuple(getattr(x, "shape", ())),
+              dtype=str(getattr(x, "dtype", "")), multiproc=False)
+    t0 = time.perf_counter()
     out = exe(x)
+    _obs_emit("collective.complete", dur_s=time.perf_counter() - t0,
+              op=fn_name, group=g.id, rank=max(g.rank, 0))
     return out, Task([out])
 
 

@@ -37,6 +37,19 @@ flags.define_flag("comm_watchdog_abort", True,
 
 _counter = itertools.count()
 
+# the most recently ISSUED collective (op, group_id, rank) — kept even for
+# retired tasks so a timeout report can say what the runtime last did
+# (comm_task records it whether or not the watchdog is armed)
+_last_issued = [None]
+
+
+def note_issue(op: str, group_id, rank):
+    _last_issued[0] = (op, group_id, rank)
+
+
+def last_issued():
+    return _last_issued[0]
+
 
 class CommTask:
     __slots__ = ("id", "op", "group_id", "rank", "shape", "dtype", "start",
@@ -132,6 +145,26 @@ class CommTaskManager:
             lines.append("  TIMED OUT: " + task.describe())
         for task in self.in_flight():
             lines.append("  also in flight: " + task.describe())
+        last = _last_issued[0]
+        if last is not None:
+            lines.append(f"  last issued collective: op={last[0]} "
+                         f"group={last[1]} rank={last[2]}")
+        # hang-time post-mortem: serialize the flight recorder + metrics
+        # BEFORE any abort so the artifact survives the SIGABRT
+        dump_path = ""
+        try:
+            from .. import observability
+
+            observability.emit("watchdog.timeout",
+                               ops=[t.op for t in expired])
+            dump_path = observability.dump_distress(
+                "comm_watchdog_timeout",
+                extra={"timed_out": [t.describe() for t in expired],
+                       "last_issued": list(last) if last else None})
+        except Exception:  # noqa: BLE001 — diagnostics must not mask a hang
+            pass
+        if dump_path:
+            lines.append(f"  flight recorder dumped to: {dump_path}")
         msg = "\n".join(lines)
         print(msg, file=sys.stderr, flush=True)
         if flags.flag_value("comm_watchdog_abort") and not self._fired:
@@ -151,6 +184,7 @@ def comm_task_manager() -> CommTaskManager:
 @contextlib.contextmanager
 def comm_task(op: str, group_id=0, rank=0, shape=(), dtype="", extra=""):
     """Wrap a blocking communication call site."""
+    note_issue(op, group_id, rank)
     tid = _manager.start_task(op, group_id, rank, shape, dtype, extra=extra)
     try:
         yield

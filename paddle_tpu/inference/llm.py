@@ -17,6 +17,7 @@ cache for all prompt tokens in one pass.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models import llama as L
+from ..observability import emit as _obs_emit
 
 __all__ = ["LLMPredictor", "init_cache"]
 
@@ -264,7 +266,10 @@ class LLMPredictor:
         if temperature is not None and temperature <= 0.0:
             top_k, top_p = 0, None   # temperature<=0 = greedy by convention
         cache = init_cache(self.cfg, B, self.max_len, self.cache_dtype)
+        t0 = time.perf_counter()
         last_logits, cache = self._prefill(self.params, tokens, cache)
+        _obs_emit("serving.prefill", dur_s=time.perf_counter() - t0,
+                  tokens=B * T, batch=B, prompt_len=T)
         if return_scores:
             if sample:
                 raise NotImplementedError(
@@ -280,6 +285,7 @@ class LLMPredictor:
         out = [tokens]
         done = 0
         for C in _chunk_plan(max_new_tokens):
+            t0 = time.perf_counter()
             if sample:
                 fn = self._decode_chunk_fn(C, top_k=int(top_k),
                                            use_top_p=top_p is not None,
@@ -292,6 +298,9 @@ class LLMPredictor:
                 last_logits, cache, finished, toks = fn(
                     self.params, last_logits, cache, jnp.int32(T + done),
                     finished, eos)
+            _obs_emit("serving.decode_chunk",
+                      dur_s=time.perf_counter() - t0, tokens=B * C,
+                      chunk=C, pos=T + done)
             out.append(toks)
             done += C
             if eos_token_id is not None and bool(finished.all()):

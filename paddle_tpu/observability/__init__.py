@@ -1,0 +1,325 @@
+"""paddle_tpu.observability — flight recorder + unified metrics registry.
+
+One choke point, ``emit(kind, dur_s=None, **fields)``, feeds BOTH:
+
+- the **flight recorder** (recorder.py): lock-free ring of the last
+  ``FLAGS_flight_recorder_size`` events, serialized by dump-on-distress
+  (watchdog timeout / fatal enforce / SIGUSR1) for post-mortem debugging;
+- the **metrics registry** (metrics.py): counters/gauges/histograms with
+  Prometheus text exposition and a JSON snapshot — the numbers behind
+  ``profiler.dispatch_cache_stats()`` / ``async_stats()``, perf_probe,
+  bench.py artifacts and the ci_op_benchmark overhead gate.
+
+Fast path: ``FLAGS_metrics_sampling=0`` turns ``emit`` into a single
+cached-int check and return (no tuple, no dict, no timestamps) — the
+instrumented hot loops run at no-op-level overhead (budget: ≤3%, gated
+by tools/ci_op_benchmark.py). ``=1`` (default) records everything;
+``N>1`` keeps every metric EXACT but ring-records only every Nth
+high-frequency event (dispatch hits, fetch stalls), bounding recorder
+write traffic on multi-million-op runs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import flags
+from .metrics import Registry, DEFAULT_BUCKETS  # noqa: F401
+from .recorder import FlightRecorder
+
+__all__ = ["emit", "enabled", "registry", "recorder", "reset", "summary",
+           "prometheus_text", "metrics_snapshot", "dump_distress",
+           "install_signal_handler", "Registry", "FlightRecorder"]
+
+flags.define_flag("metrics_sampling", 1,
+                  "Observability sampling: 0 disables emit() entirely "
+                  "(metrics views freeze), 1 records everything, N>1 "
+                  "ring-records 1/N of high-frequency events (metrics "
+                  "stay exact)")
+flags.define_flag("flight_recorder_size", 4096,
+                  "Ring-buffer capacity (events) of the always-on flight "
+                  "recorder")
+flags.define_flag("log_retraces", False,
+                  "Log the field-level signature diff explaining every "
+                  "post-warmup dispatch-cache retrace to stderr")
+flags.define_flag("distress_dir", "",
+                  "Directory for dump-on-distress artifacts (default: "
+                  "$PADDLE_DISTRESS_DIR, else the system temp dir)")
+flags.define_flag("dump_on_enforce", False,
+                  "Dump the flight recorder + metrics on EnforceNotMet "
+                  "construction (rate-limited to 1/s)")
+
+_registry = Registry()
+_recorder = FlightRecorder(int(flags.flag_value("flight_recorder_size")))
+# cached sampling knob: [0] = off, [1] = everything, [N] = 1/N ring writes
+_sampling = [max(0, int(flags.flag_value("metrics_sampling")))]
+_ring_tick = [0]
+
+# high-frequency kinds subject to >1 ring sampling (metrics stay exact)
+_HIGH_FREQ = frozenset({"dispatch.hit", "async.fetch_stall",
+                        "async.enqueue"})
+
+
+def registry() -> Registry:
+    return _registry
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def enabled() -> bool:
+    return _sampling[0] > 0
+
+
+def _on_flag_change(name: str, value):
+    if name == "metrics_sampling":
+        _sampling[0] = max(0, int(value))
+    elif name == "flight_recorder_size":
+        _recorder.resize(int(value))
+
+
+flags.on_change(_on_flag_change)
+
+
+# ---------------------------------------------------------------------------
+# Metric fan-out: kind -> handler(dur_s, fields). Handlers close over their
+# metric objects so a dispatch hit costs one dict lookup + one int add.
+# ---------------------------------------------------------------------------
+
+_C = _registry.counter
+_G = _registry.gauge
+_H = _registry.histogram
+
+_c_hits = _C("paddle_dispatch_cache_hits_total",
+             "Eager dispatch signature-cache hits")
+_c_misses = _C("paddle_dispatch_cache_misses_total",
+               "Eager dispatch signature-cache misses (probe runs)")
+_c_bypasses = _C("paddle_dispatch_cache_bypasses_total",
+                 "Dispatches that bypassed signature keying")
+_c_neg = _C("paddle_dispatch_cache_negative_hits_total",
+            "Dispatches short-circuited by the negative cache")
+_c_evict = _C("paddle_dispatch_cache_evictions_total",
+              "LRU evictions from the dispatch cache")
+_c_poison = _C("paddle_dispatch_cache_poisoned_total",
+               "Cached executables poisoned after a runtime failure")
+_c_compiles = _C("paddle_compiles_total",
+                 "Kernel (re)traces through the cached-executable builder")
+_c_retraces = _C("paddle_retraces_total",
+                 "Post-warmup dispatch-cache misses, by diffed reason")
+_g_inflight = _G("paddle_eager_inflight_depth",
+                 "Steps currently in flight in the async pipeline")
+_g_maxdepth = _G("paddle_eager_inflight_depth_max",
+                 "High-water mark of the in-flight queue")
+_c_steps = _C("paddle_eager_steps_marked_total",
+              "Step boundaries enqueued on the async pipeline")
+_c_bp = _C("paddle_eager_backpressure_waits_total",
+           "Host blocks caused by pipeline-depth backpressure")
+_h_bp = _H("paddle_backpressure_wait_seconds",
+           "Duration of pipeline backpressure waits")
+_c_fetches = _C("paddle_eager_sync_fetches_total",
+                "D2H scalar fetches (Tensor.numpy/.item sync points)")
+_h_stall = _H("paddle_fetch_stall_seconds",
+              "Host blocked time per D2H fetch, by stall")
+_c_drains = _C("paddle_eager_drains_total",
+               "Full pipeline drains (paddle.synchronize)")
+_c_bwd = _C("paddle_backward_runs_total", "Autograd backward passes")
+_h_bwd = _H("paddle_backward_seconds",
+            "Host-side tape-walk time per backward pass")
+_c_coll = _C("paddle_collectives_total", "Collectives issued, by op")
+_h_coll = _H("paddle_collective_seconds",
+             "Dispatch-to-complete duration of eager collectives")
+_c_opt = _C("paddle_optimizer_steps_total",
+            "Optimizer.step calls, by execution mode")
+_h_opt = _H("paddle_optimizer_step_seconds", "Optimizer.step host time")
+_c_nan = _C("paddle_nan_check_trips_total",
+            "FLAGS_check_nan_inf trips, by op")
+_c_tokens = _C("paddle_serving_tokens_total",
+               "Tokens produced by the serving engine, by phase")
+_h_chunk = _H("paddle_serving_chunk_seconds",
+              "Serving prefill/decode-chunk dispatch durations")
+_c_wd = _C("paddle_watchdog_timeouts_total",
+           "Comm-watchdog timeout reports")
+_c_enf = _C("paddle_enforce_errors_total",
+            "EnforceNotMet errors raised, by type")
+_c_dumps = _C("paddle_distress_dumps_total",
+              "Dump-on-distress artifacts written, by reason")
+
+
+# hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
+# Counter.reset() clears _values in place, so the bound dict stays live.
+_hits_values = _c_hits._values
+
+
+def _h_dispatch_hit(dur_s, f):
+    _hits_values[()] = _hits_values.get((), 0) + 1
+
+
+def _h_dispatch_miss(dur_s, f):
+    _c_misses.inc()
+
+
+def _h_retrace(dur_s, f):
+    _c_retraces.inc(labels={"op": f.get("op", ""),
+                            "reason": f.get("reason", "unknown")})
+
+
+def _h_enqueue(dur_s, f):
+    d = f.get("depth", 0)
+    _g_inflight.set(d)
+    _g_maxdepth.set_max(d)
+    _c_steps.inc()
+
+
+def _h_backpressure(dur_s, f):
+    _c_bp.inc()
+    if dur_s is not None:
+        _h_bp.observe(dur_s)
+
+
+def _h_fetch(dur_s, f):
+    _c_fetches.inc()
+    if dur_s is not None:
+        _h_stall.observe(dur_s)
+
+
+def _h_depth(dur_s, f):
+    _g_inflight.set(f.get("depth", 0))
+
+
+def _h_backward(dur_s, f):
+    _c_bwd.inc()
+    if dur_s is not None:
+        _h_bwd.observe(dur_s)
+
+
+def _h_collective(dur_s, f):
+    _c_coll.inc(labels={"op": f.get("op", "")})
+    if dur_s is not None:
+        _h_coll.observe(dur_s)
+
+
+def _h_optimizer(dur_s, f):
+    _c_opt.inc(labels={"mode": f.get("mode", "")})
+    if dur_s is not None:
+        _h_opt.observe(dur_s)
+
+
+def _h_serving(phase):
+    def h(dur_s, f):
+        _c_tokens.inc(f.get("tokens", 0), labels={"phase": phase})
+        if dur_s is not None:
+            _h_chunk.observe(dur_s)
+    return h
+
+
+_HANDLERS = {
+    "dispatch.hit": _h_dispatch_hit,
+    "dispatch.miss": _h_dispatch_miss,
+    "dispatch.bypass": lambda d, f: _c_bypasses.inc(),
+    "dispatch.negative_hit": lambda d, f: _c_neg.inc(),
+    "dispatch.eviction": lambda d, f: _c_evict.inc(),
+    "dispatch.poisoned": lambda d, f: _c_poison.inc(),
+    "dispatch.compile": lambda d, f: _c_compiles.inc(),
+    "dispatch.retrace": _h_retrace,
+    "async.enqueue": _h_enqueue,
+    "async.depth": _h_depth,
+    "async.backpressure": _h_backpressure,
+    "async.fetch_stall": _h_fetch,
+    # depth-0 forced-sync block: stalls the host like a fetch (feeds the
+    # stall histogram) but is not a D2H scalar fetch (no counter bump)
+    "async.sync_wait": lambda d, f: (_h_stall.observe(d)
+                                     if d is not None else None),
+    "async.drain": lambda d, f: _c_drains.inc(),
+    "backward": _h_backward,
+    "collective.complete": _h_collective,
+    "optimizer.step": _h_optimizer,
+    "nan_check.trip": lambda d, f: _c_nan.inc(
+        labels={"op": f.get("op", "")}),
+    "serving.prefill": _h_serving("prefill"),
+    "serving.decode_chunk": _h_serving("decode"),
+    "watchdog.timeout": lambda d, f: _c_wd.inc(),
+    "enforce.error": lambda d, f: _c_enf.inc(
+        labels={"type": f.get("type", "")}),
+    "distress.dump": lambda d, f: _c_dumps.inc(
+        labels={"reason": f.get("reason", "")}),
+}
+
+
+def emit(kind: str, dur_s: Optional[float] = None,
+         # default-arg bindings skip global lookups on the hot path; all
+         # referenced objects are mutated in place, never rebound
+         _s=_sampling, _get=_HANDLERS.get, _record=_recorder.record,
+         _hf=_HIGH_FREQ, _tick=_ring_tick, **fields):
+    """The single instrumentation choke point. See module docstring for
+    the FLAGS_metrics_sampling fast path."""
+    s = _s[0]
+    if not s:
+        return
+    h = _get(kind)
+    if h is not None:
+        h(dur_s, fields)
+    if s > 1 and kind in _hf:
+        _tick[0] += 1
+        if _tick[0] % s:
+            return
+    _record(kind, dur_s, fields or None)
+
+
+# ---------------------------------------------------------------------------
+# Views / exports
+# ---------------------------------------------------------------------------
+
+def metrics_snapshot() -> dict:
+    return _registry.snapshot()
+
+
+def prometheus_text() -> str:
+    return _registry.prometheus_text()
+
+
+def summary() -> dict:
+    """The perf-triage digest printed by tools and embedded in BENCH_*.json:
+    dispatch hit-rate, retrace count, fetch-stall p50/p99."""
+    hits = _c_hits.value()
+    misses = _c_misses.value()
+    neg = _c_neg.value()
+    total = hits + misses + neg
+    return {
+        "dispatch_hit_rate": round(hits / total, 4) if total else 0.0,
+        "dispatch_hits": int(hits),
+        "dispatch_misses": int(misses),
+        "retraces_total": int(_c_retraces.value()),
+        "compiles_total": int(_c_compiles.value()),
+        "fetch_stalls_total": int(_c_fetches.value()),
+        "fetch_stall_p50_s": round(_h_stall.percentile(50), 6),
+        "fetch_stall_p99_s": round(_h_stall.percentile(99), 6),
+        "backpressure_waits": int(_c_bp.value()),
+        "max_inflight_depth": int(_g_maxdepth.value()),
+        "events_recorded": _recorder.written(),
+    }
+
+
+def reset():
+    """Zero every metric and clear the ring (bench/test isolation)."""
+    _registry.reset()
+    _recorder.clear()
+
+
+def dump_distress(reason: str, extra: dict = None,
+                  directory: str = None) -> str:
+    from . import distress
+
+    return distress.dump(reason, extra=extra, directory=directory)
+
+
+def install_signal_handler() -> bool:
+    from . import distress
+
+    return distress.install_signal_handler()
+
+
+# enforce's distress hook is injected here (not imported by enforce) so
+# core/enforce.py keeps zero observability dependencies
+from . import distress as _distress  # noqa: E402
+
+_distress.install_enforce_hook()

@@ -1,0 +1,123 @@
+"""Dump-on-distress: serialize the flight recorder + metrics on trouble.
+
+Reference analog: the NCCL watchdog's CommTask dump + FLAGS_enable
+_async_trace; production runtimes additionally wire SIGUSR1 (and
+faulthandler) so a live hang can be inspected without killing the job.
+
+Triggers wired here:
+- ``comm_watchdog`` timeout (distributed/comm_watchdog.py calls ``dump``)
+- fatal ``enforce`` errors, gated by ``FLAGS_dump_on_enforce`` (the
+  hook is injected into core/enforce.py to avoid an import cycle)
+- ``SIGUSR1`` — kill -USR1 <pid> snapshots a *running* process
+- any caller via ``observability.dump_distress(reason)``
+
+Each dump is one timestamped JSON file holding the ring-buffer events,
+the full metrics snapshot, and a chrome-trace rendering of the recorder
+window (load the ``chrome_trace`` object in perfetto / chrome://tracing).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+
+from ..core import flags
+
+# enforce-triggered dumps are rate-limited so a hot error loop cannot
+# fill the disk; watchdog/signal/manual dumps always fire
+_MIN_ENFORCE_INTERVAL_S = 1.0
+_last_enforce_dump = [0.0]
+_signal_installed = [False]
+_prev_handler = [None]
+
+
+def distress_dir() -> str:
+    d = str(flags.flag_value("distress_dir") or "")
+    if not d:
+        d = os.environ.get("PADDLE_DISTRESS_DIR", "")
+    return d or tempfile.gettempdir()
+
+
+def dump(reason: str, extra: dict = None, directory: str = None,
+         path: str = None) -> str:
+    """Write the post-mortem artifact; returns its path.
+
+    Never raises: distress handling runs on error/signal paths where a
+    secondary failure must not mask the original one.
+    """
+    from . import recorder, registry, emit
+
+    try:
+        emit("distress.dump", reason=reason)
+        rec = recorder()
+        doc = {
+            "reason": reason,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "pid": os.getpid(),
+            "events_recorded_total": rec.written(),
+            "extra": extra or {},
+            "metrics": registry().snapshot(),
+            "events": rec.to_json_events(),
+            "chrome_trace": rec.to_chrome_trace(),
+        }
+        if path is None:
+            d = directory or distress_dir()
+            os.makedirs(d, exist_ok=True)
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            path = os.path.join(
+                d, f"paddle_distress_{reason}_{os.getpid()}_{stamp}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        return path
+    except Exception:  # noqa: BLE001 — see docstring
+        return ""
+
+
+def _on_enforce_error(exc_type: str, msg: str):
+    """Hook called from EnforceNotMet.__init__ (core/enforce.py)."""
+    try:
+        from . import emit
+
+        emit("enforce.error", type=exc_type)
+        if not flags.flag_value("dump_on_enforce"):
+            return
+        now = time.monotonic()
+        if now - _last_enforce_dump[0] < _MIN_ENFORCE_INTERVAL_S:
+            return
+        _last_enforce_dump[0] = now
+        dump("enforce", extra={"exc_type": exc_type, "message": msg[:2000]})
+    except Exception:  # noqa: BLE001 — never break the original raise
+        pass
+
+
+def install_signal_handler() -> bool:
+    """SIGUSR1 -> distress dump. Main-thread only (signal module rule);
+    returns False when installation was not possible."""
+    if _signal_installed[0]:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _handler(signum, frame):
+        path = dump("sigusr1")
+        print(f"[observability] SIGUSR1: flight recorder dumped to {path}",
+              flush=True)
+        prev = _prev_handler[0]
+        if callable(prev):
+            prev(signum, frame)
+
+    try:
+        _prev_handler[0] = signal.signal(signal.SIGUSR1, _handler)
+        _signal_installed[0] = True
+        return True
+    except (ValueError, OSError, AttributeError):
+        return False
+
+
+def install_enforce_hook():
+    from ..core import enforce
+
+    enforce.set_distress_hook(_on_enforce_error)

@@ -1,0 +1,104 @@
+"""Flight recorder: a lock-free ring buffer of the last N runtime events.
+
+Mega-kernel runtimes (MPK) and the XLA profiling literature both treat
+per-event runtime visibility as the prerequisite for optimizing
+dispatch-bound paths; the reference's closest analog is the NCCL comm
+task trace dump. Here EVERY runtime subsystem feeds one ring through
+``observability.emit()``: dispatch cache hits/misses/retraces (with the
+diffed signature fields), async queue depth transitions, fetch-stall
+begin/end, compile events, collective issue/complete, nan-check trips.
+
+Lock-free by construction: writers claim a slot with ``next(itertools
+.count())`` (atomic under the GIL) and store one tuple — no lock, no
+allocation beyond the event itself. Readers (``events()``, the distress
+dump) take a consistent-enough snapshot; a slot being overwritten during
+a read loses that one event, which is the standard flight-recorder trade.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Event = (seq, ts_ns, kind, dur_s | None, fields dict | None)
+Event = Tuple[int, int, str, Optional[float], Optional[Dict[str, Any]]]
+
+
+class FlightRecorder:
+    def __init__(self, size: int = 4096):
+        self._init(size)
+
+    def _init(self, size: int):
+        self.size = max(int(size), 1)
+        self._buf: List[Optional[Event]] = [None] * self.size
+        self._seq = itertools.count()
+
+    def record(self, kind: str, dur_s: Optional[float] = None,
+               fields: Optional[Dict[str, Any]] = None):
+        i = next(self._seq)
+        self._buf[i % self.size] = (i, time.perf_counter_ns(), kind,
+                                    dur_s, fields)
+
+    def __len__(self) -> int:
+        return min(self.written(), self.size)
+
+    def written(self) -> int:
+        """Total events ever recorded (monotonic, survives wraparound)."""
+        # peek the counter without consuming: count.__reduce__ -> (count, (n,))
+        return self._seq.__reduce__()[1][0]
+
+    def resize(self, size: int):
+        """Reconfigure capacity; drops buffered events."""
+        self._init(size)
+
+    def clear(self):
+        self._init(self.size)
+
+    def events(self) -> List[Event]:
+        """Buffered events, oldest first."""
+        out = [e for e in self._buf if e is not None]
+        out.sort(key=lambda e: e[0])
+        return out
+
+    def to_json_events(self) -> List[dict]:
+        out = []
+        for seq, ts_ns, kind, dur_s, fields in self.events():
+            ev = {"seq": seq, "ts_ns": ts_ns, "kind": kind}
+            if dur_s is not None:
+                ev["dur_s"] = round(dur_s, 9)
+            if fields:
+                ev.update({k: _json_safe(v) for k, v in fields.items()})
+            out.append(ev)
+        return out
+
+    def to_chrome_trace(self, pid: Optional[int] = None) -> dict:
+        """Chrome-trace doc for the recorder window: events carrying a
+        duration become complete ('X') spans ending at their record time;
+        the rest are instant ('i') marks."""
+        import os
+
+        pid = pid if pid is not None else os.getpid()
+        trace = []
+        for seq, ts_ns, kind, dur_s, fields in self.events():
+            args = {k: str(_json_safe(v)) for k, v in (fields or {}).items()}
+            name = kind
+            if fields and "op" in fields:
+                name = f"{kind}::{fields['op']}"
+            if dur_s is not None:
+                trace.append({"name": name, "ph": "X", "pid": pid, "tid": 0,
+                              "ts": (ts_ns / 1e3) - dur_s * 1e6,
+                              "dur": dur_s * 1e6, "args": args})
+            else:
+                trace.append({"name": name, "ph": "i", "s": "t", "pid": pid,
+                              "tid": 0, "ts": ts_ns / 1e3, "args": args})
+        return {"traceEvents": trace}
+
+
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    return str(v)

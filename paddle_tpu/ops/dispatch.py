@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import sys
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict
@@ -22,6 +23,7 @@ import numpy as np
 
 from ..core import dtype as dtype_mod, flags, rng as rng_mod
 from ..core.tensor import Tensor
+from ..observability import emit as _emit, registry as _obs_registry
 
 
 def _grad_node_cls():
@@ -189,9 +191,18 @@ _BYPASS = object()  # negative-cache sentinel: signature proven uncacheable
 
 _cache: "OrderedDict[Any, Any]" = OrderedDict()
 _cache_lock = threading.Lock()
-_cache_stats = {
-    "hits": 0, "misses": 0, "bypasses": 0, "negative_hits": 0,
-    "evictions": 0, "traces": 0, "poisoned": 0,
+
+# stats live in the unified metrics registry (observability.emit is the
+# only writer); this maps the legacy dispatch_cache_stats() keys to it
+_STATS_METRICS = {
+    "hits": "paddle_dispatch_cache_hits_total",
+    "misses": "paddle_dispatch_cache_misses_total",
+    "bypasses": "paddle_dispatch_cache_bypasses_total",
+    "negative_hits": "paddle_dispatch_cache_negative_hits_total",
+    "evictions": "paddle_dispatch_cache_evictions_total",
+    "traces": "paddle_compiles_total",
+    "poisoned": "paddle_dispatch_cache_poisoned_total",
+    "retraces": "paddle_retraces_total",
 }
 
 
@@ -205,8 +216,10 @@ class _CacheEntry:
 
 
 def dispatch_cache_stats() -> dict:
-    """Hit/miss/trace counters for the profiler and perf tooling."""
-    out = dict(_cache_stats)
+    """Hit/miss/trace counters: a view over the metrics registry (the
+    profiler and perf tooling read the same numbers Prometheus would)."""
+    reg = _obs_registry()
+    out = {k: int(reg.value(name)) for k, name in _STATS_METRICS.items()}
     with _cache_lock:
         out["entries"] = len(_cache)
     total = out["hits"] + out["misses"] + out["negative_hits"]
@@ -215,8 +228,11 @@ def dispatch_cache_stats() -> dict:
 
 
 def reset_dispatch_cache_stats():
-    for k in _cache_stats:
-        _cache_stats[k] = 0
+    reg = _obs_registry()
+    for name in _STATS_METRICS.values():
+        m = reg.get(name)
+        if m is not None:
+            m.reset()
 
 
 def clear_dispatch_cache():
@@ -258,6 +274,84 @@ def _make_key(name, kernel, treedef, leaves, t_slots, arrays, needs_grad):
     return key
 
 
+# ---------------------------------------------------------------------------
+# Retrace explanation: when a signature misses AFTER this op already has
+# cached signatures, the miss is a RETRACE — the expensive event round-5
+# flagged as unattributable. Diff the new key against the nearest cached
+# one field-by-field so the reason (shape/dtype/sharding/static-kwarg/...)
+# is tagged on paddle_retraces_total and, under FLAGS_log_retraces,
+# printed with the exact offending fields.
+# ---------------------------------------------------------------------------
+
+# key layout (see _make_key): (name, kernel_id, treedef, static, avals,
+#                              needs_grad, default_dtype)
+_REASON_PRIORITY = ("shape", "dtype", "sharding", "static_kwarg",
+                    "structure", "arity", "needs_grad", "default_dtype")
+
+
+def _key_diff(new, old):
+    """[(category, human detail)] for every differing key field."""
+    diffs = []
+    if new[2] != old[2]:
+        diffs.append(("structure", f"args tree {old[2]} -> {new[2]}"))
+    if new[3] != old[3]:
+        o, n = dict(old[3]), dict(new[3])
+        for slot in sorted(set(o) | set(n)):
+            ov, nv = o.get(slot, "<absent>"), n.get(slot, "<absent>")
+            if ov != nv:
+                diffs.append(("static_kwarg",
+                              f"static[{slot}] {ov!r} -> {nv!r}"))
+    if len(new[4]) != len(old[4]):
+        diffs.append(("arity",
+                      f"{len(old[4])} tensor inputs -> {len(new[4])}"))
+    else:
+        fields = ("shape", "dtype", "weak_type", "sharding")
+        for i, (na, oa) in enumerate(zip(new[4], old[4])):
+            for fname, nv, ov in zip(fields, na, oa):
+                if nv != ov:
+                    cat = "dtype" if fname == "weak_type" else fname
+                    diffs.append((cat, f"input[{i}].{fname} {ov} -> {nv}"))
+    if new[5] != old[5]:
+        diffs.append(("needs_grad", f"{old[5]} -> {new[5]}"))
+    if new[6] != old[6]:
+        diffs.append(("default_dtype", f"{old[6]} -> {new[6]}"))
+    return diffs
+
+
+def _explain_miss(key, name):
+    """(reason, diff lines) vs the nearest cached signature of the same
+    op+kernel, or None when this is a first-signature warmup miss."""
+    with _cache_lock:
+        cands = [k for k in _cache if k[0] == name and k[1] == key[1]]
+    if not cands:
+        return None
+    best_diffs = None
+    for k in cands:
+        d = _key_diff(key, k)
+        if best_diffs is None or len(d) < len(best_diffs):
+            best_diffs = d
+            if len(d) <= 1:
+                break
+    if not best_diffs:
+        return None
+    cats = {c for c, _ in best_diffs}
+    reason = next((c for c in _REASON_PRIORITY if c in cats), "unknown")
+    return reason, [detail for _, detail in best_diffs]
+
+
+def _note_miss(key, name):
+    """Record a cache miss; post-warmup misses get a retrace explanation."""
+    _emit("dispatch.miss", op=name)
+    explain = _explain_miss(key, name)
+    if explain is None:
+        return
+    reason, diff = explain
+    _emit("dispatch.retrace", op=name, reason=reason, diff=diff)
+    if flags.flag_value("log_retraces"):
+        print(f"[retrace] op={name} reason={reason}: " + "; ".join(diff),
+              file=sys.stderr, flush=True)
+
+
 def _cache_get(key):
     with _cache_lock:
         entry = _cache.get(key)
@@ -273,10 +367,10 @@ def _cache_put(key, entry):
         _cache.move_to_end(key)
         while len(_cache) > limit > 0:
             _cache.popitem(last=False)
-            _cache_stats["evictions"] += 1
+            _emit("dispatch.eviction")
 
 
-def _build_entry(kernel, treedef, leaves, t_slots, needs_grad):
+def _build_entry(name, kernel, treedef, leaves, t_slots, needs_grad):
     """Compile-once executable for this signature. Static leaves are frozen
     from the probe call (they are part of the cache key, so every hit passes
     identical values); tensor slots are overwritten with the live arrays."""
@@ -285,7 +379,7 @@ def _build_entry(kernel, treedef, leaves, t_slots, needs_grad):
 
     if needs_grad:
         def fwd(*arrs):
-            _cache_stats["traces"] += 1
+            _emit("dispatch.compile", op=name, needs_grad=True)
 
             def pure(*xs):
                 ls = list(static_leaves)
@@ -303,7 +397,7 @@ def _build_entry(kernel, treedef, leaves, t_slots, needs_grad):
             return tuple(out_leaves) + tuple(res_leaves)
     else:
         def fwd(*arrs):
-            _cache_stats["traces"] += 1
+            _emit("dispatch.compile", op=name, needs_grad=False)
             ls = list(static_leaves)
             for slot, x in zip(t_slots, arrs):
                 ls[slot] = x
@@ -420,22 +514,24 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
                     needs_grad)
     result = None
     if key is None:
-        _cache_stats["bypasses"] += 1
+        _emit("dispatch.bypass", op=name)
     else:
         entry = _cache_get(key)
         if entry is _BYPASS:
-            _cache_stats["negative_hits"] += 1
+            _emit("dispatch.negative_hit", op=name)
         elif entry is not None:
             try:
                 result = _run_cached(entry, name, kernel, treedef, leaves,
                                      t_slots, in_tensors, arrays)
-                _cache_stats["hits"] += 1
+                # no fields on the hit event: this is the hot path, and a
+                # kwargs dict per dispatch is measurable (3% budget)
+                _emit("dispatch.hit")
             except Exception:  # noqa: BLE001 — a signature that traces
                 # eagerly but fails under jit (concretization, leaked
                 # tracer in the residual treedef) is poisoned and re-run
                 # on the always-correct eager path
                 _cache_put(key, _BYPASS)
-                _cache_stats["poisoned"] += 1
+                _emit("dispatch.poisoned", op=name)
                 result = None
 
     if result is None:
@@ -444,9 +540,9 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
                                            t_slots, in_tensors, arrays,
                                            needs_grad)
         if key is not None and _cache_get(key) is None:
-            _cache_stats["misses"] += 1
+            _note_miss(key, name)
             if cacheable and rng_mod.consumption_count() == rng_before:
-                _cache_put(key, _build_entry(kernel, treedef, leaves,
+                _cache_put(key, _build_entry(name, kernel, treedef, leaves,
                                              t_slots, needs_grad))
             else:
                 _cache_put(key, _BYPASS)
@@ -516,6 +612,8 @@ def _check_nan_inf(name, result):
             if hasattr(arr, "aval") and not hasattr(arr, "devices"):
                 continue  # tracer: skip eager check inside traces
             if bool(jnp.any(~jnp.isfinite(arr))):
+                _emit("nan_check.trip", op=name,
+                      shape=tuple(arr.shape), dtype=str(arr.dtype))
                 raise FloatingPointError(f"Operator {name} output contains Inf/Nan")
 
 

@@ -8,6 +8,7 @@ ready for jitted/sharded training steps and for checkpointing.
 """
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Dict, List, Optional
 
@@ -135,10 +136,16 @@ class Optimizer:
         if not pg:
             self._step_count += 1
             return
+        t0 = time.perf_counter()
         if self._fused_disabled or not flags.flag_value("fused_optimizer"):
             self._eager_step(pg, lr)
+            mode = "eager"
         else:
-            self._try_fused(pg, lr)
+            mode = self._try_fused(pg, lr)
+        from ..observability import emit as _obs_emit
+
+        _obs_emit("optimizer.step", dur_s=time.perf_counter() - t0,
+                  mode=mode, optimizer=type(self).__name__, params=len(pg))
         self._step_count += 1
         # step boundary for the pipeline: enqueue this step's param buffers;
         # blocks the host only once > FLAGS_eager_async_depth are in flight
@@ -169,17 +176,18 @@ class Optimizer:
 
     def _try_fused(self, pg, lr):
         """Apply this step via the fused donated executable, warming up or
-        falling back to the plain per-parameter loop as needed."""
+        falling back to the plain per-parameter loop as needed. Returns the
+        execution mode actually taken (the optimizer.step metric label)."""
         key = self._fused_key(pg)
         if key is None:
             self._eager_step(pg, lr)
-            return
+            return "fallback_unkeyable"
         if key not in self._fused_seen:
             # warmup occurrence: the plain loop materializes accumulators
             # (their init expressions are host-side) and validates _update
             self._fused_seen.add(key)
             self._eager_step(pg, lr)
-            return
+            return "warmup"
         try:
             fn = self._fused_cache.get(key)
             if fn is None:
@@ -197,12 +205,14 @@ class Optimizer:
             for (p, _), arr in zip(pg, new_params):
                 p._data = arr
             self._accumulators = new_accs
+            return "fused"
         except Exception:  # noqa: BLE001 — host-side control flow in
             # _update (RAdam's rho_t branch, LBFGS) cannot trace; run this
             # instance eagerly forever
             self._fused_disabled = True
             self._fused_cache.clear()
             self._eager_step(pg, lr)
+            return "fallback_error"
 
     def _build_fused(self, pg):
         """One executable for the whole parameter group: the per-parameter

@@ -27,12 +27,14 @@ from ..core import native as _native
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark", "dispatch_cache_stats", "async_stats"]
+           "benchmark", "dispatch_cache_stats", "async_stats",
+           "metrics_snapshot", "prometheus_text", "flight_recorder",
+           "export_flight_recorder"]
 
 
 def dispatch_cache_stats() -> dict:
-    """Eager dispatch-cache counters (hits/misses/traces/hit_rate): the
-    profiler-facing view of the signature-keyed executable cache."""
+    """Eager dispatch-cache counters (hits/misses/traces/hit_rate): a view
+    over the unified metrics registry (paddle_dispatch_cache_* metrics)."""
     from ..ops.dispatch import dispatch_cache_stats as _stats
 
     return _stats()
@@ -40,10 +42,40 @@ def dispatch_cache_stats() -> dict:
 
 def async_stats() -> dict:
     """Pipelined-execution counters (in-flight depth, sync fetches,
-    backpressure waits) from the async engine."""
+    backpressure waits): a view over the unified metrics registry."""
     from ..core import async_engine
 
     return async_engine.stats()
+
+
+def metrics_snapshot() -> dict:
+    """JSON snapshot of EVERY runtime metric (dispatch cache, async
+    pipeline, retraces, collectives, optimizer, serving, distress)."""
+    from .. import observability
+
+    return observability.metrics_snapshot()
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the unified metrics registry."""
+    from .. import observability
+
+    return observability.prometheus_text()
+
+
+def flight_recorder():
+    """The always-on runtime flight recorder (last N events ring)."""
+    from .. import observability
+
+    return observability.recorder()
+
+
+def export_flight_recorder(path: str) -> str:
+    """Serialize the flight-recorder window + metrics snapshot to `path`
+    (same artifact format as dump-on-distress). Returns the written path."""
+    from ..observability import distress
+
+    return distress.dump("manual_export", path=path)
 
 
 class ProfilerState(Enum):

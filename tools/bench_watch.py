@@ -86,6 +86,14 @@ def capture() -> int:
         decode = bench.bench_llama_decode()
     except Exception as e:  # noqa: BLE001 — decode is secondary evidence
         decode = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # observability tax on the eager hot path, measured on this chip's
+    # host — gated against the same budget as the CPU CI gate
+    try:
+        import ci_op_benchmark
+
+        obs = ci_op_benchmark.measure_observability_overhead()
+    except Exception as e:  # noqa: BLE001 — secondary evidence
+        obs = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
                           capture_output=True, text=True).stdout.strip()
     out = {
@@ -97,6 +105,7 @@ def capture() -> int:
         "flagship": {**flagship, "metric": "llama_train_tokens_per_sec_per_chip",
                      "wall_s": flag_wall},
         "decode": {**decode, "wall_s": round(time.perf_counter() - t0, 1)},
+        "observability_overhead": obs,
     }
     print(json.dumps(out), flush=True)
     return 0
@@ -176,6 +185,11 @@ def _capture_locked(capture_timeout: float) -> bool:
         log(f"RED: flagship vs_baseline="
             f"{payload['flagship'].get('vs_baseline')} < 1.0 — perf "
             f"regression against the pinned floor (BENCH_BASELINE.json)")
+    obs = payload.get("observability_overhead") or {}
+    if obs.get("exceeded"):
+        log(f"RED: observability overhead {obs.get('overhead_pct'):.2f}% "
+            f"> {obs.get('budget_pct'):.0f}% budget on the eager hot path "
+            f"(ci_op_benchmark.measure_observability_overhead)")
     paths = [ATTEST_PATH]
     if _pin_op_bench():
         paths.append(OP_BASE_PATH)

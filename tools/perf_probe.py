@@ -46,11 +46,11 @@ def probe(B, remat, steps, warmup, M=1):
         sp, opt, loss = step(sp, opt, tokens, targets)
     if loss is not None:
         float(loss)
+    from paddle_tpu import observability
     from paddle_tpu.core import async_engine
     from paddle_tpu.ops import dispatch
 
-    async_engine.reset_stats()
-    dispatch.reset_dispatch_cache_stats()
+    observability.reset()  # also zeroes the async/dispatch stats views
     t0 = time.perf_counter()
     for i in range(steps):
         sp, opt, loss = step(sp, opt, tokens, targets)
@@ -64,6 +64,11 @@ def probe(B, remat, steps, warmup, M=1):
     mfu = cfg.flops_per_token() * tps / bench.chip_peak_flops(jax.devices()[0])
     a_s = async_engine.stats()
     c_s = dispatch.dispatch_cache_stats()
+    obs = observability.summary()
+    print(f"  obs: hit_rate={obs['dispatch_hit_rate']} "
+          f"retraces={obs['retraces_total']} "
+          f"stall_p50={obs['fetch_stall_p50_s']}s "
+          f"p99={obs['fetch_stall_p99_s']}s", flush=True)
     return {"tokens_per_s": round(tps, 1), "mfu": round(mfu, 4),
             "step_s": round(dt / steps, 4), "loss": float(loss),
             "async": {"depth": a_s["depth"],
@@ -71,7 +76,8 @@ def probe(B, remat, steps, warmup, M=1):
                       "backpressure_waits": a_s["backpressure_waits"],
                       "sync_fetches": a_s["sync_fetches"]},
             "dispatch_cache": {"hit_rate": c_s["hit_rate"],
-                               "traces": c_s["traces"]}}
+                               "traces": c_s["traces"]},
+            "observability": obs}
 
 
 def main():
