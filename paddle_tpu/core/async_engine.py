@@ -51,6 +51,14 @@ _STATS_METRICS = {
 
 from ..observability import emit as _emit  # noqa: E402
 
+# chaos choke point: installed by distributed/fault_tolerance/chaos.py only
+# while FLAGS_chaos_spec is active — (tag) -> None, may stall a fetch
+_chaos_hook = [None]
+
+
+def set_chaos_hook(fn):
+    _chaos_hook[0] = fn
+
 
 def depth() -> int:
     """Effective pipeline depth. 0 = synchronous (flag, or a static-graph
@@ -170,6 +178,9 @@ def scalar_fetch(arr, tag: str = "tensor"):
     attributed to the exact value that forced the host to wait."""
     if not hasattr(arr, "block_until_ready") or hasattr(arr, "_trace"):
         return arr  # tracer or non-array: preserve the eager error path
+    ch = _chaos_hook[0]
+    if ch is not None:
+        ch(tag)
     was_ready = _is_ready(arr)
     t0 = time.perf_counter()
     _with_span(f"fetch::{tag}", _block_on, (arr,))

@@ -46,6 +46,11 @@ class UnavailableError(EnforceNotMet):
     pass
 
 
+class DataLossError(EnforceNotMet):
+    """Persisted data failed an integrity check (truncated/corrupted file,
+    CRC mismatch). Reference: phi error code DATALOSS."""
+
+
 def enforce(cond, msg: str = "Enforce condition failed", *args, exc=InvalidArgumentError):
     if not cond:
         raise exc(msg % args if args else msg)

@@ -42,6 +42,10 @@ from .parallel import (  # noqa: F401
 )
 from .store import TCPStore  # noqa: F401
 from .spawn import spawn  # noqa: F401
+# eager so FLAGS_chaos_spec / checkpoint flags are registered (and an env
+# FLAGS_chaos_spec activates) without requiring an explicit submodule import
+from . import fault_tolerance  # noqa: F401
+from .fault_tolerance import CheckpointManager  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,
@@ -71,9 +75,14 @@ def __getattr__(name):
         mod = importlib.import_module(".communication.stream", __name__)
         globals()[name] = mod
         return mod
+    if name == "CheckpointManager":
+        from .fault_tolerance import CheckpointManager
+
+        globals()[name] = CheckpointManager
+        return CheckpointManager
     if name in ("fleet", "auto_parallel", "checkpoint", "launch", "sharding",
                 "parallel", "hybrid", "rpc", "utils", "communication",
-                "passes"):
+                "passes", "fault_tolerance"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ImportError as e:

@@ -20,11 +20,13 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 from typing import Dict, List, Tuple
 
 import jax
 import numpy as np
 
+from ...core.enforce import DataLossError
 from ...core.tensor import Tensor
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
@@ -76,17 +78,45 @@ def _shards_of(arr) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
     return out
 
 
+def _atomic_write(path: str, write_body) -> int:
+    """Write via `<path>.tmp.<pid>` + fsync + os.replace; `write_body(f)`
+    returns the running CRC32 of everything it wrote. A writer killed at
+    any instant leaves either the old file or nothing — never a half-file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            crc = write_body(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return crc
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_state_dict(state_dict: dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
                     async_save: bool = False):
-    """Write shard files + global metadata (reference: save_state_dict.py:145)."""
+    """Write shard files + global metadata (reference: save_state_dict.py:145).
+
+    Both files are written atomically (tmp + fsync + rename) and the data
+    file's CRC32 lands in the metadata, so `load_state_dict` can detect
+    truncation/corruption instead of silently reading garbage shards."""
+    from ..fault_tolerance import chaos
+
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     flat = _flatten(state_dict)
     meta = Metadata()
     data_file = f"{rank}_0.distcp"
-    offset = 0
-    with open(os.path.join(path, data_file), "wb") as f:
+
+    def _write_data(f):
+        crc = 0
+        offset = 0
         for key, val in flat.items():
             if val is None:
                 continue
@@ -103,8 +133,15 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
                 meta.storage_metadata[
                     LocalTensorIndex(key, goff)] = (data_file, offset)
                 f.write(raw)
+                crc = zlib.crc32(raw, crc)
                 offset += len(raw)
+                # the kill -9 drill's io-level choke point: mid-data-file
+                chaos.maybe_crash_save("distcp")
             meta.state_dict_metadata[key] = metas
+        return crc
+
+    meta.file_crcs[data_file] = _atomic_write(
+        os.path.join(path, data_file), _write_data)
     # every tensor also records its GLOBAL (shape, dtype) for load-time checks
     meta.flat_mapping = {
         k: (tuple(int(x) for x in
@@ -112,10 +149,43 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
             str((v._data if isinstance(v, Tensor) else np.asarray(v)).dtype))
         for k, v in flat.items() if v is not None
     }
+
+    def _write_meta(f):
+        raw = pickle.dumps(meta)
+        f.write(raw)
+        return zlib.crc32(raw)
+
+    chaos.maybe_crash_save("metadata")
     # every rank writes its own metadata (covering the shards IT owns);
     # load merges all .metadata files, so multi-host checkpoints assemble
-    with open(os.path.join(path, f"{rank}.metadata"), "wb") as f:
-        pickle.dump(meta, f)
+    _atomic_write(os.path.join(path, f"{rank}.metadata"), _write_meta)
+
+
+def _verify_file_crcs(path: str, meta: Metadata):
+    """Check each data file against the CRC recorded at save time; a
+    truncated or bit-rotted shard file fails loudly here instead of being
+    silently reassembled into a wrong tensor."""
+    for fn, want in meta.file_crcs.items():
+        fpath = os.path.join(path, fn)
+        try:
+            crc = 0
+            with open(fpath, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+        except FileNotFoundError:
+            raise DataLossError(
+                f"load_state_dict({path!r}): data file {fn!r} referenced "
+                f"by the checkpoint metadata is missing — the checkpoint "
+                f"is incomplete; restore from a good one") from None
+        if crc != want:
+            raise DataLossError(
+                f"load_state_dict({path!r}): CRC mismatch for {fn!r} "
+                f"(stored {want:#010x}, computed {crc:#010x}) — the file "
+                f"is truncated or corrupted; restore from a good "
+                f"checkpoint")
 
 
 def _read_shard(path, file, byte_off, shape, dtype) -> np.ndarray:
@@ -136,8 +206,14 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
         raise FileNotFoundError(f"no .metadata file under {path}")
     meta = Metadata()
     for fn in sorted(metas):
-        with open(os.path.join(path, fn), "rb") as f:
-            m = pickle.load(f)
+        try:
+            with open(os.path.join(path, fn), "rb") as f:
+                m = pickle.load(f)
+        except Exception as e:
+            raise DataLossError(
+                f"load_state_dict({path!r}): unreadable metadata file "
+                f"{fn!r} ({type(e).__name__}: {e}) — the checkpoint is "
+                f"truncated or corrupted; restore from a good one") from e
         # Each rank's metadata covers only the shards IT owns: extend the
         # per-key shard lists (dedup replicas by global_offset) — a plain
         # dict.update would keep only the last rank's shards and silently
@@ -151,6 +227,10 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
                     seen.add(tuple(sm.global_offset))
         meta.storage_metadata.update(m.storage_metadata)
         meta.flat_mapping.update(m.flat_mapping)
+        # metadata pickles from before CRC recording lack the field
+        meta.file_crcs.update(getattr(m, "file_crcs", {}))
+
+    _verify_file_crcs(path, meta)
 
     flat = _flatten(state_dict)
     updates = {}

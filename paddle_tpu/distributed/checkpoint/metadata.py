@@ -38,3 +38,7 @@ class Metadata:
     storage_metadata: Dict[LocalTensorIndex, Tuple[str, int]] = field(
         default_factory=dict)
     flat_mapping: Dict[str, List[str]] = field(default_factory=dict)
+    # file_name -> CRC32 of the whole data file, for load-time integrity
+    # verification (read with getattr(..., "file_crcs", {}): metadata
+    # pickles from before this field existed unpickle without it)
+    file_crcs: Dict[str, int] = field(default_factory=dict)

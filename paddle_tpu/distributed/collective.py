@@ -43,7 +43,7 @@ from ..core.tensor import Tensor
 from ..core import flags
 from ..observability import emit as _obs_emit
 from .env import get_rank, get_world_size
-from .comm_watchdog import comm_task, note_issue
+from .comm_watchdog import comm_task, note_issue, set_restart_hook
 
 
 class ReduceOp:
@@ -457,13 +457,66 @@ def _run_multiproc(g: Group, fn_name: str, x, **kw):
     return res, Task([res])
 
 
+# chaos choke point: installed by distributed/fault_tolerance/chaos.py only
+# while FLAGS_chaos_spec is active — (op_name, rank) -> None, may delay or
+# raise ChaosCollectiveTimeout (a TimeoutError, so the retry wrapper below
+# exercises the same path a real hang-detected error would)
+_chaos_hook = [None]
+
+
+def set_chaos_hook(fn):
+    _chaos_hook[0] = fn
+
+
+flags.define_flag("collective_retries", 2,
+                  "Retries for an eager collective that fails with a "
+                  "retryable transport error (TimeoutError/ConnectionError) "
+                  "before the error propagates; 0 disables")
+flags.define_flag("collective_retry_backoff", 0.05,
+                  "Base seconds for exponential backoff between collective "
+                  "retries (doubles per attempt)")
+
+# what the retry wrapper backs off on: declared-dead collectives (incl.
+# injected ChaosCollectiveTimeout) and transport drops. Programming errors
+# (shape/dtype/ValueError) propagate immediately.
+_RETRYABLE = (TimeoutError, ConnectionError)
+
+
 def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
-    """Dispatch a collective: traced → lax op; eager → cached executable."""
+    """Dispatch a collective: traced → lax op; eager → cached executable.
+
+    Eager dispatch runs under a bounded retry wrapper: a retryable
+    transport error (declared-dead collective, dropped store connection,
+    injected chaos timeout) is retried with exponential backoff up to
+    ``FLAGS_collective_retries`` times, each retry emitted as
+    ``collective.retry`` (→ paddle_collective_retries_total{op})."""
     g = group or _get_or_init_default()
     x = _unwrap(tensor)
     if _is_traced(x) and _axis_in_scope(g.axis_name):
         out = _SHARD_FNS[fn_name](x, g.axis_name, g.nranks, **kw)
         return out, None
+    retries = max(0, int(flags.flag_value("collective_retries")))
+    attempt = 0
+    while True:
+        try:
+            ch = _chaos_hook[0]
+            if ch is not None:
+                ch(fn_name, max(g.rank, 0))
+            return _run_once(g, fn_name, x, **kw)
+        except _RETRYABLE as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = (float(flags.flag_value("collective_retry_backoff"))
+                     * (2 ** (attempt - 1)))
+            _obs_emit("collective.retry", op=fn_name, group=g.id,
+                      rank=max(g.rank, 0), attempt=attempt,
+                      error=f"{type(e).__name__}: {e}")
+            time.sleep(delay)
+
+
+def _run_once(g: Group, fn_name: str, x, **kw):
+    """One eager dispatch attempt (everything below the retry wrapper)."""
     if _multiproc(g):
         return _run_multiproc(g, fn_name, x, **kw)
     if not _shardable(x, g):
@@ -636,6 +689,25 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
         if isinstance(tensor, Tensor):
             tensor._data = _unwrap(tensor_list[r])
     return None
+
+
+def gang_restart_barrier(timeout: float = 60.0) -> bool:
+    """The watchdog ladder's 'restart' stage: rendezvous every rank at a
+    TCPStore barrier so survivors of a detected hang re-align (and a truly
+    dead peer turns the hang into a clean barrier timeout) before resuming.
+    Returns True when the gang reached the barrier."""
+    _obs_emit("collective.gang_restart", world=get_world_size())
+    client = _store_client()
+    if client is None:
+        return True  # single process: nothing to rendezvous with
+    try:
+        client.barrier("_gang_restart", timeout=timeout)
+        return True
+    except Exception:  # noqa: BLE001 — a failed rendezvous means the gang
+        return False   # is really gone; the ladder falls through to abort
+
+
+set_restart_hook(gang_restart_barrier)
 
 
 def barrier(group: Optional[Group] = None):

@@ -34,8 +34,50 @@ flags.define_flag("comm_timeout", 0.0,
 flags.define_flag("comm_watchdog_abort", True,
                   "On comm timeout: abort the process (SIGABRT) after "
                   "dumping diagnostics; False = dump only")
+flags.define_flag("watchdog_policy", "",
+                  "Comm-watchdog escalation ladder: comma-separated stages "
+                  "from {warn,dump,retry,restart,abort}, applied one per "
+                  "successive expiry of the same hung task (the task is "
+                  "re-armed between stages; 'retry' also doubles its "
+                  "timeout). Empty = legacy single-shot report honoring "
+                  "FLAGS_comm_watchdog_abort")
+
+_STAGES = ("warn", "dump", "retry", "restart", "abort")
 
 _counter = itertools.count()
+
+# gang-restart hook for the ladder's 'restart' stage — collective.py
+# registers its store-barrier rendezvous here at import time (the watchdog
+# must not import collective: collective imports this module)
+_restart_hook = [None]
+
+
+def set_restart_hook(fn):
+    _restart_hook[0] = fn
+
+
+_policy_warned = [False]
+
+
+def _parse_policy(spec: str):
+    """Ladder stages from FLAGS_watchdog_policy; unknown stages are dropped
+    with a one-time stderr warning (the watchdog thread must never die on a
+    typo'd flag — worst case it degrades to the legacy report)."""
+    out = []
+    for raw in (spec or "").split(","):
+        raw = raw.strip().lower()
+        if not raw:
+            continue
+        if raw not in _STAGES:
+            if not _policy_warned[0]:
+                _policy_warned[0] = True
+                print(f"[comm watchdog] ignoring unknown "
+                      f"FLAGS_watchdog_policy stage {raw!r} "
+                      f"(valid: {', '.join(_STAGES)})",
+                      file=sys.stderr, flush=True)
+            continue
+        out.append(raw)
+    return out
 
 # the most recently ISSUED collective (op, group_id, rank) — kept even for
 # retired tasks so a timeout report can say what the runtime last did
@@ -53,7 +95,7 @@ def last_issued():
 
 class CommTask:
     __slots__ = ("id", "op", "group_id", "rank", "shape", "dtype", "start",
-                 "timeout", "extra")
+                 "timeout", "extra", "escalations")
 
     def __init__(self, op, group_id, rank, shape, dtype, timeout, extra=""):
         self.id = next(_counter)
@@ -65,6 +107,7 @@ class CommTask:
         self.start = time.monotonic()
         self.timeout = timeout
         self.extra = extra
+        self.escalations = 0  # ladder stages already applied to this task
 
     def describe(self) -> str:
         elapsed = time.monotonic() - self.start
@@ -119,6 +162,9 @@ class CommTaskManager:
             time.sleep(0.2)
             now = time.monotonic()
             expired = []
+            staged = []  # (task, ladder stage) when a policy is active
+            policy = _parse_policy(
+                str(flags.flag_value("watchdog_policy") or ""))
             with self._lock:
                 if not self._tasks:
                     # park the thread once nothing is in flight for a while
@@ -132,13 +178,93 @@ class CommTaskManager:
                 for task in self._tasks.values():
                     if now - task.start > task.timeout:
                         expired.append(task)
-                for task in expired:
-                    self._tasks.pop(task.id, None)
-            if expired:
+                if not policy:
+                    for task in expired:
+                        self._tasks.pop(task.id, None)
+                else:
+                    for task in expired:
+                        stage = policy[min(task.escalations,
+                                           len(policy) - 1)]
+                        task.escalations += 1
+                        staged.append((task, stage))
+                        if stage == "abort":
+                            self._tasks.pop(task.id, None)
+                        else:
+                            task.start = now  # re-arm for the next stage
+                            if stage == "retry":
+                                task.timeout *= 2
+            if staged:
+                self._escalate(staged, len(policy))
+            elif expired:
                 # every expiry is reported; _fired only guards double-ABORT
                 self._report_and_maybe_abort(expired)
 
-    def _report_and_maybe_abort(self, expired):
+    def _escalate(self, staged, n_stages):
+        """Apply one ladder stage per expired task (FLAGS_watchdog_policy).
+
+        warn    — one-line stderr notice, nothing else.
+        dump    — distress dump (flight recorder + metrics artifact).
+        retry   — the task was re-armed with a doubled timeout, giving the
+                  in-flight collective another window; exception-level
+                  retries (the backoff loop in collective.py) are the
+                  mechanism that actually re-issues work — this stage keeps
+                  the watchdog from declaring death while they run.
+        restart — gang-restart rendezvous: every rank meets at a store
+                  barrier (hook registered by collective.py) so survivors
+                  re-align before resuming.
+        abort   — full legacy report + SIGABRT (the ladder's floor).
+        """
+        for task, stage in staged:
+            try:
+                from .. import observability
+
+                observability.emit("watchdog.escalate", stage=stage,
+                                   op=task.op, rank=task.rank,
+                                   escalation=task.escalations)
+            except Exception:  # noqa: BLE001 — diagnostics never mask a hang
+                pass
+            head = (f"[comm watchdog] escalation "
+                    f"{min(task.escalations, n_stages)}/{n_stages} "
+                    f"stage={stage}: ")
+            if stage == "warn":
+                print(head + "suspected hang — " + task.describe(),
+                      file=sys.stderr, flush=True)
+            elif stage == "dump":
+                dump_path = ""
+                try:
+                    from .. import observability
+
+                    dump_path = observability.dump_distress(
+                        "comm_watchdog_escalate",
+                        extra={"stage": stage,
+                               "task": task.describe(),
+                               "escalation": task.escalations})
+                except Exception:  # noqa: BLE001
+                    pass
+                print(head + "still hung — " + task.describe()
+                      + (f"\n  flight recorder dumped to: {dump_path}"
+                         if dump_path else ""),
+                      file=sys.stderr, flush=True)
+            elif stage == "retry":
+                print(head + f"re-armed with doubled timeout "
+                      f"({task.timeout:.1f}s) — " + task.describe(),
+                      file=sys.stderr, flush=True)
+            elif stage == "restart":
+                hook = _restart_hook[0]
+                ok = None
+                if hook is not None:
+                    try:
+                        ok = bool(hook())
+                    except Exception:  # noqa: BLE001 — a failed rendezvous
+                        ok = False     # falls through to the next stage
+                print(head + f"gang-restart barrier "
+                      f"{'reached' if ok else 'FAILED' if ok is False else 'unavailable'}"
+                      f" — " + task.describe(),
+                      file=sys.stderr, flush=True)
+            elif stage == "abort":
+                self._report_and_maybe_abort([task], force_abort=True)
+
+    def _report_and_maybe_abort(self, expired, force_abort=False):
         lines = ["[comm watchdog] COLLECTIVE TIMEOUT — probable hang. "
                  "In-flight communication exceeded FLAGS_comm_timeout:"]
         for task in expired:
@@ -167,7 +293,8 @@ class CommTaskManager:
             lines.append(f"  flight recorder dumped to: {dump_path}")
         msg = "\n".join(lines)
         print(msg, file=sys.stderr, flush=True)
-        if flags.flag_value("comm_watchdog_abort") and not self._fired:
+        if ((force_abort or flags.flag_value("comm_watchdog_abort"))
+                and not self._fired):
             self._fired = True
             # SIGABRT, like the NCCL watchdog: the launcher's pod watcher
             # sees the non-zero exit and applies its restart policy

@@ -1,0 +1,24 @@
+"""Fault-tolerant training runtime: chaos harness + recovery machinery.
+
+- :mod:`.chaos` — deterministic, flag-driven fault injection
+  (``FLAGS_chaos_spec``) with choke points in the collective, store,
+  dispatch, fetch and checkpoint-save paths.
+- :class:`.CheckpointManager` — every-N-steps snapshots (in-memory
+  last-good + atomic CRC-verified disk checkpoints), NaN/Inf rollback
+  guard, SIGTERM preemption flush.
+
+The escalating comm-watchdog ladder lives in
+``distributed/comm_watchdog.py`` (``FLAGS_watchdog_policy``) and the
+collective retry wrapper in ``distributed/collective.py``.
+"""
+from . import chaos
+from .chaos import ChaosCollectiveTimeout, ChaosError, parse_spec
+from .checkpoint_manager import CheckpointManager
+
+__all__ = [
+    "chaos",
+    "ChaosError",
+    "ChaosCollectiveTimeout",
+    "parse_spec",
+    "CheckpointManager",
+]

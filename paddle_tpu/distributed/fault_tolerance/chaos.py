@@ -1,0 +1,344 @@
+"""Chaos harness: deterministic, flag-driven fault injection.
+
+A production gang must survive preempted hosts, hung collectives, flaky
+stores and NaN'd steps — and the only way to *prove* it survives them is
+to inject those faults on demand and assert on the observed recovery.
+Reference frame: the fault-injection hooks production NCCL stacks grow
+around `comm_task_manager` (forced-timeout test modes), and chaos-mesh
+style choke points, collapsed into one seeded, spec-driven injector.
+
+``FLAGS_chaos_spec`` is a comma-separated list of injections::
+
+    site:kind[@sel=val[;sel=val...]]
+
+Sites and kinds (each site is a hook the runtime module exposes; the
+hooks are installed only while a spec is active, so an empty spec costs
+one pointer check on the hot paths):
+
+- ``collective`` — ``delay`` (sleep ``delay=`` s before issuing),
+  ``timeout`` (raise :class:`ChaosCollectiveTimeout`, the retryable
+  hang-detected error the retry wrapper in collective.py catches),
+  ``hang`` (sleep ``delay=`` s *inside* the armed comm_task, so the real
+  watchdog fires).
+- ``store`` — ``drop`` (kill the client socket mid-request), ``garble``
+  (corrupt the reply length so the client detects an implausible frame),
+  ``delay`` (sleep before the request).
+- ``dispatch`` — ``nan`` / ``inf`` (poison the op's first floating
+  output leaf).
+- ``fetch`` — ``stall`` (sleep ``delay=`` s inside scalar_fetch).
+- ``save`` — ``crash`` (``os._exit(137)`` mid-write: the kill -9
+  atomicity drill).
+
+Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``,
+``step=<int>`` (the value of the chaos step clock — ticked by
+``CheckpointManager.on_step`` / ``note_step``), ``call=<int>`` (the Nth
+call matching op/rank at this site, 0-based), ``count=<int>`` (max
+firings, default 1; 0 = unlimited), ``delay=<float>`` seconds,
+``prob=<float>`` (fire with probability, seeded by ``FLAGS_chaos_seed``
+so runs are reproducible).
+
+Every injection lands in the flight recorder and the
+``paddle_chaos_injections_total{site,kind}`` counter via
+``observability.emit("chaos.inject", ...)`` — tests assert on *observed*
+injections and *observed* recovery, never on luck.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional
+
+from ...core import flags
+from ...observability import emit as _emit
+
+flags.define_flag("chaos_spec", "",
+                  "Fault-injection spec: comma-separated "
+                  "'site:kind@sel=val;...' entries (see "
+                  "distributed/fault_tolerance/chaos.py); empty disables "
+                  "the harness entirely")
+flags.define_flag("chaos_seed", 0,
+                  "Seed for probabilistic (prob=) chaos injections")
+
+
+class ChaosError(RuntimeError):
+    """Base of all injected faults (so tests can catch the family)."""
+
+
+class ChaosCollectiveTimeout(ChaosError, TimeoutError):
+    """Injected 'this collective hung and was declared dead' — the
+    retryable error class the collective retry wrapper backs off on."""
+
+
+_SITES = ("collective", "store", "dispatch", "fetch", "save")
+_KINDS = {
+    "collective": ("delay", "timeout", "hang"),
+    "store": ("drop", "garble", "delay"),
+    "dispatch": ("nan", "inf"),
+    "fetch": ("stall",),
+    "save": ("crash",),
+}
+
+_FLOAT_SELECTORS = ("delay", "prob")
+_INT_SELECTORS = ("rank", "step", "call", "count")
+
+
+class Injection:
+    __slots__ = ("site", "kind", "op", "rank", "step", "call", "count",
+                 "delay", "prob", "seen", "fired")
+
+    def __init__(self, site, kind, op=None, rank=None, step=None, call=None,
+                 count=1, delay=0.05, prob=None):
+        self.site = site
+        self.kind = kind
+        self.op = op
+        self.rank = rank
+        self.step = step
+        self.call = call
+        self.count = count
+        self.delay = delay
+        self.prob = prob
+        self.seen = 0    # calls that matched op/rank filters
+        self.fired = 0   # injections actually applied
+
+    def __repr__(self):
+        sel = {k: getattr(self, k) for k in
+               ("op", "rank", "step", "call", "count", "delay", "prob")
+               if getattr(self, k) is not None}
+        return f"Injection({self.site}:{self.kind} {sel} fired={self.fired})"
+
+
+def parse_spec(spec: str) -> List[Injection]:
+    """Parse FLAGS_chaos_spec; raises ValueError on malformed entries so a
+    typo'd spec fails the run loudly instead of silently injecting nothing."""
+    out = []
+    for raw in (spec or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, _, selpart = raw.partition("@")
+        site, sep, kind = head.partition(":")
+        site, kind = site.strip(), kind.strip()
+        if not sep or site not in _SITES or kind not in _KINDS[site]:
+            raise ValueError(
+                f"chaos_spec entry {raw!r}: want site:kind with site in "
+                f"{_SITES} and kind in {_KINDS.get(site, ())}")
+        kw = {}
+        for pair in selpart.split(";"):
+            pair = pair.strip()
+            if not pair:
+                continue
+            k, sep, v = pair.partition("=")
+            k = k.strip()
+            if not sep or k not in ("op",) + _INT_SELECTORS + _FLOAT_SELECTORS:
+                raise ValueError(f"chaos_spec entry {raw!r}: bad selector "
+                                 f"{pair!r}")
+            if k == "op":
+                kw[k] = v.strip()
+            elif k in _INT_SELECTORS:
+                kw[k] = int(v)
+            else:
+                kw[k] = float(v)
+        out.append(Injection(site, kind, **kw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Live state. _injections is rebuilt whenever FLAGS_chaos_spec changes;
+# the per-module hooks are installed only while a spec is active.
+# ---------------------------------------------------------------------------
+
+_injections: List[Injection] = []
+_rng = random.Random(0)
+_STEP = [0]  # the chaos step clock (note_step)
+_installed = [False]
+
+
+def note_step(step: int):
+    """Advance the chaos step clock (CheckpointManager.on_step ticks this;
+    ``step=`` selectors match against it)."""
+    _STEP[0] = int(step)
+
+
+def current_step() -> int:
+    return _STEP[0]
+
+
+def active() -> bool:
+    return bool(_injections)
+
+
+def injections() -> List[Injection]:
+    return list(_injections)
+
+
+def _match(site: str, op: Optional[str] = None,
+           rank: Optional[int] = None) -> Optional[Injection]:
+    for inj in _injections:
+        if inj.site != site:
+            continue
+        if inj.op is not None and inj.op != op:
+            continue
+        if inj.rank is not None and rank is not None and inj.rank != rank:
+            continue
+        idx = inj.seen
+        inj.seen += 1
+        if inj.count and inj.fired >= inj.count:
+            continue
+        if inj.call is not None and idx != inj.call:
+            continue
+        if inj.step is not None and _STEP[0] != inj.step:
+            continue
+        if inj.prob is not None and _rng.random() >= inj.prob:
+            continue
+        inj.fired += 1
+        _emit("chaos.inject", site=site, fault=inj.kind, op=op or "",
+              rank=rank if rank is not None else -1, step=_STEP[0],
+              call=idx)
+        return inj
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Site hooks (installed into the runtime modules while a spec is active)
+# ---------------------------------------------------------------------------
+
+def _collective_hook(op: str, rank: int = 0):
+    """Called by collective.py inside the retry wrapper, before each
+    attempt. May sleep (delay/hang) or raise (timeout)."""
+    inj = _match("collective", op=op, rank=rank)
+    if inj is None:
+        return
+    if inj.kind == "delay" or inj.kind == "hang":
+        time.sleep(inj.delay)
+        return
+    raise ChaosCollectiveTimeout(
+        f"[chaos] injected collective timeout: op={op} rank={rank} "
+        f"step={_STEP[0]}")
+
+
+def _store_hook(op: str) -> Optional[str]:
+    """Called by the TCPStore client per request; returns the fault kind
+    the client should apply ('drop' / 'garble'), or None."""
+    inj = _match("store", op=op)
+    if inj is None:
+        return None
+    if inj.kind == "delay":
+        time.sleep(inj.delay)
+        return None
+    return inj.kind
+
+
+def _dispatch_hook(name: str, result):
+    """Called by ops/dispatch.py on every op result while active: poison
+    the first floating-point output leaf with NaN/Inf."""
+    inj = _match("dispatch", op=name)
+    if inj is None:
+        return result
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import dtype as dtype_mod
+    from ...core.tensor import Tensor
+
+    fill = float("nan") if inj.kind == "nan" else float("inf")
+
+    def is_t(x):
+        return isinstance(x, Tensor)
+
+    poisoned = [False]
+
+    def poison(leaf):
+        if (not poisoned[0] and isinstance(leaf, Tensor)
+                and dtype_mod.is_floating_dtype(leaf._data.dtype)):
+            poisoned[0] = True
+            leaf._data = jnp.full_like(leaf._data, fill)
+        return leaf
+
+    jax.tree.map(poison, result, is_leaf=is_t)
+    return result
+
+
+def _fetch_hook(tag: str):
+    inj = _match("fetch", op=tag)
+    if inj is not None and inj.kind == "stall":
+        time.sleep(inj.delay)
+
+
+def _save_hook(phase: str):
+    """Called by the checkpoint writers mid-write; 'crash' hard-kills the
+    process (the kill -9 atomicity drill)."""
+    import os
+
+    inj = _match("save", op=phase)
+    if inj is not None and inj.kind == "crash":
+        os._exit(137)
+
+
+# ---------------------------------------------------------------------------
+# Activation: install/uninstall the hooks on the runtime modules
+# ---------------------------------------------------------------------------
+
+def _install():
+    if _installed[0]:
+        return
+    from ...core import async_engine
+    from ...ops import dispatch
+    from .. import collective, store
+
+    dispatch.set_chaos_hook(_dispatch_hook)
+    collective.set_chaos_hook(_collective_hook)
+    store.set_chaos_hook(_store_hook)
+    async_engine.set_chaos_hook(_fetch_hook)
+    _installed[0] = True
+
+
+def _uninstall():
+    if not _installed[0]:
+        return
+    from ...core import async_engine
+    from ...ops import dispatch
+    from .. import collective, store
+
+    dispatch.set_chaos_hook(None)
+    collective.set_chaos_hook(None)
+    store.set_chaos_hook(None)
+    async_engine.set_chaos_hook(None)
+    _installed[0] = False
+
+
+def save_hook_active() -> bool:
+    return any(i.site == "save" for i in _injections)
+
+
+def maybe_crash_save(phase: str):
+    """Checkpoint writers call this at their choke point (cheap no-op when
+    no save-site injection is configured)."""
+    if _injections and save_hook_active():
+        _save_hook(phase)
+
+
+def reconfigure(spec: Optional[str] = None):
+    """(Re)build the injection set from the flag (or an explicit spec) and
+    install/uninstall the runtime hooks accordingly."""
+    global _injections
+    if spec is None:
+        spec = str(flags.flag_value("chaos_spec") or "")
+    _injections = parse_spec(spec)
+    _rng.seed(int(flags.flag_value("chaos_seed")))
+    _STEP[0] = 0
+    if _injections:
+        _install()
+    else:
+        _uninstall()
+
+
+def _on_flag_change(name, value):
+    if name in ("chaos_spec", "chaos_seed"):
+        reconfigure()
+
+
+flags.on_change(_on_flag_change)
+
+# honor a FLAGS_chaos_spec env var present at import time
+if flags.flag_value("chaos_spec"):
+    reconfigure()

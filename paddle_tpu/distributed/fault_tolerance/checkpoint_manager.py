@@ -1,0 +1,391 @@
+"""CheckpointManager: every-N-steps snapshots, NaN rollback, preemption.
+
+The recovery half of the fault-tolerance story (chaos.py is the attack
+half). Reference frame: the auto-checkpoint managers production trainers
+grow around `paddle.distributed.checkpoint` (save-interval + keep-K GC +
+preemption flush), combined with the "last-good in-memory copy" trick
+from elastic/fault-tolerant training systems: because jax arrays are
+immutable, an in-memory snapshot is a handful of device-buffer
+references (copied on capture so later buffer donation cannot free
+them), which makes every-step snapshots affordable.
+
+Three services:
+
+- **Periodic snapshots** — ``on_step()`` captures an in-memory last-good
+  copy and, every ``FLAGS_ckpt_interval`` steps, writes a disk
+  checkpoint through ``distributed.checkpoint.save_state_dict`` using an
+  atomic protocol: write into a ``.tmp`` dir, per-file CRC32 recorded in
+  the metadata, fsync, then a directory rename publishes it and a
+  ``latest`` pointer file is replaced atomically. Keep-K GC bounds disk.
+  ``async_save=True`` runs the disk half on a background thread (the
+  captured buffers are immutable, so no quiesce is needed).
+- **NaN/Inf step guard** — ``on_step(loss)`` with a non-finite loss
+  rolls model + optimizer state back to the last-good snapshot and
+  reports the step as poisoned so the training loop re-runs it; bounded
+  by ``FLAGS_rollback_budget`` consecutive rollbacks before the error is
+  re-raised as fatal (a persistently-NaN model must not loop forever).
+- **Preemption flush** — ``install_preemption_handler()`` wires SIGTERM
+  to flush a final checkpoint before the default handling proceeds, so
+  a preempted host loses at most the in-flight step.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ...core import flags
+from ...core.enforce import UnavailableError
+from ...core.tensor import Tensor
+from ...observability import emit as _emit
+from . import chaos
+
+flags.define_flag("ckpt_interval", 50,
+                  "CheckpointManager default: write a disk checkpoint every "
+                  "N optimizer steps (0 = in-memory snapshots only)")
+flags.define_flag("ckpt_keep", 2,
+                  "CheckpointManager default: keep the newest K disk "
+                  "checkpoints (older ones are GC'd after each save)")
+flags.define_flag("rollback_budget", 3,
+                  "Max consecutive NaN/Inf rollbacks before the step guard "
+                  "gives up and raises (a persistently-broken model must "
+                  "not retry forever)")
+
+
+def _dev_copy(a):
+    """A buffer the training loop can never donate/mutate from under us."""
+    import jax.numpy as jnp
+
+    try:
+        return jnp.array(a, copy=True)
+    except Exception:  # noqa: BLE001 — non-array leaf (int step count etc.)
+        return np.asarray(a).copy()
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # platforms/filesystems without directory fsync
+
+
+class CheckpointManager:
+    """Coordinates in-memory last-good state, disk checkpoints and the
+    NaN rollback guard for one (model, optimizer) pair."""
+
+    def __init__(self, directory: Optional[str] = None, model=None,
+                 optimizer=None, interval: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 rollback_budget: Optional[int] = None,
+                 async_save: bool = True):
+        self.directory = directory
+        self.model = model
+        self.optimizer = optimizer
+        self.interval = int(flags.flag_value("ckpt_interval")
+                            if interval is None else interval)
+        self.keep = int(flags.flag_value("ckpt_keep")
+                        if keep is None else keep)
+        self.rollback_budget = int(flags.flag_value("rollback_budget")
+                                   if rollback_budget is None
+                                   else rollback_budget)
+        self.async_save = bool(async_save)
+        self._step = 0
+        self._last_good = None          # snapshot dict (see _capture)
+        self._consecutive_rollbacks = 0
+        self.rollbacks_total = 0
+        self.saves_total = 0
+        self._save_thread: Optional[threading.Thread] = None
+        self._save_lock = threading.Lock()
+        self._prev_sigterm = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        # step 0 is a valid rollback target: a NaN on the very first step
+        # must restore the initialization, not crash
+        self.snapshot()
+
+    # -- state capture / restore -------------------------------------------
+
+    def _capture(self) -> dict:
+        snap = {"step": self._step, "model": {}, "opt_accs": None,
+                "opt_step": None}
+        if self.model is not None:
+            for k, t in self.model.state_dict().items():
+                snap["model"][k] = _dev_copy(t._data)
+        if self.optimizer is not None:
+            snap["opt_accs"] = {
+                pn: {an: _dev_copy(a) for an, a in accs.items()}
+                for pn, accs in self.optimizer._accumulators.items()}
+            snap["opt_step"] = int(self.optimizer._step_count)
+        return snap
+
+    def snapshot(self):
+        """Capture the in-memory last-good copy (cheap: device-side buffer
+        copies, no host sync)."""
+        self._last_good = self._capture()
+
+    def _restore(self, snap: dict):
+        # install COPIES: the training loop will donate/rebind whatever we
+        # hand it, and the snapshot must survive a second rollback
+        if self.model is not None:
+            live = self.model.state_dict()
+            for k, arr in snap["model"].items():
+                if k in live:
+                    live[k]._data = _dev_copy(arr)
+        if self.optimizer is not None and snap["opt_accs"] is not None:
+            self.optimizer._accumulators = {
+                pn: {an: _dev_copy(a) for an, a in accs.items()}
+                for pn, accs in snap["opt_accs"].items()}
+            self.optimizer._step_count = snap["opt_step"]
+            # cached fused executables bound the OLD accumulator buffers;
+            # drop them so the next step re-fuses against the restored state
+            self.optimizer._fused_cache.clear()
+
+    # -- the per-step entry point ------------------------------------------
+
+    def on_step(self, loss=None) -> bool:
+        """Call once per completed optimizer step, with the step's loss.
+
+        Returns True when the step was judged poisoned (non-finite loss)
+        and state was rolled back to last-good — the caller should re-run
+        the step. Returns False on a healthy step (after ticking the
+        snapshot/checkpoint schedule)."""
+        if loss is not None and not self._finite(loss):
+            return self._rollback()
+        self._consecutive_rollbacks = 0
+        self._step += 1
+        chaos.note_step(self._step)
+        if self.interval and self._step % self.interval == 0:
+            self.save()
+        else:
+            self.snapshot()
+        return False
+
+    @staticmethod
+    def _finite(loss) -> bool:
+        arr = loss._data if isinstance(loss, Tensor) else loss
+        try:
+            return bool(np.isfinite(np.asarray(arr)).all())
+        except TypeError:
+            return True  # tracers/non-numerics: the guard only runs eagerly
+
+    def _rollback(self) -> bool:
+        self._consecutive_rollbacks += 1
+        self.rollbacks_total += 1
+        _emit("ckpt.rollback", step=self._step,
+              consecutive=self._consecutive_rollbacks,
+              to_step=self._last_good["step"] if self._last_good else -1)
+        if self._consecutive_rollbacks > self.rollback_budget:
+            raise UnavailableError(
+                f"NaN/Inf step guard: {self._consecutive_rollbacks} "
+                f"consecutive rollbacks exceed FLAGS_rollback_budget="
+                f"{self.rollback_budget}; model state is persistently "
+                f"non-finite")
+        if self._last_good is None:
+            raise UnavailableError(
+                "NaN/Inf step guard tripped with no last-good snapshot")
+        self._restore(self._last_good)
+        self._step = self._last_good["step"]
+        chaos.note_step(self._step)
+        return True
+
+    # -- disk protocol ------------------------------------------------------
+
+    def _state_for_disk(self, snap: dict) -> dict:
+        state = {"model": {k: Tensor._from_data(a)
+                           for k, a in snap["model"].items()}}
+        if snap["opt_accs"] is not None:
+            opt = {f"{pn}.{an}": Tensor._from_data(a)
+                   for pn, accs in snap["opt_accs"].items()
+                   for an, a in accs.items()}
+            opt["@step"] = snap["opt_step"]
+            state["optimizer"] = opt
+        state["@manager_step"] = snap["step"]
+        return state
+
+    def save(self, wait: bool = False):
+        """Snapshot now and publish a disk checkpoint for it (background
+        thread unless ``wait`` or ``async_save=False``)."""
+        self.snapshot()
+        if not self.directory:
+            return
+        snap = self._last_good
+        self._join_save()
+        if self.async_save and not wait:
+            self._save_thread = threading.Thread(
+                target=self._write_disk, args=(snap,),
+                name="ckpt-writer", daemon=True)
+            self._save_thread.start()
+        else:
+            self._write_disk(snap)
+
+    def _join_save(self):
+        t = self._save_thread
+        if t is not None and t.is_alive():
+            t.join()
+        self._save_thread = None
+
+    def _write_disk(self, snap: dict):
+        from .. import checkpoint as dckpt
+
+        t0 = time.perf_counter()
+        step = snap["step"]
+        final = os.path.join(self.directory, f"step_{step}")
+        tmp = os.path.join(self.directory, f".tmp_step_{step}_{os.getpid()}")
+        try:
+            with self._save_lock:
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp)
+                dckpt.save_state_dict(self._state_for_disk(snap), tmp)
+                # the kill -9 drill fires here: data written, not yet
+                # published — the previous checkpoint must stay loadable
+                chaos.maybe_crash_save("finalize")
+                if os.path.isdir(final):
+                    shutil.rmtree(final)
+                os.rename(tmp, final)
+                _fsync_dir(self.directory)
+                self._publish_latest(step)
+                self._gc()
+            self.saves_total += 1
+            _emit("ckpt.save", dur_s=time.perf_counter() - t0, step=step,
+                  path=final)
+        except Exception as e:  # noqa: BLE001 — a failed background save
+            # must not kill training; the in-memory last-good still stands
+            _emit("ckpt.save_error", step=step,
+                  error=f"{type(e).__name__}: {e}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not self.async_save:
+                raise
+
+    def _publish_latest(self, step: int):
+        ptr = os.path.join(self.directory, "latest")
+        tmp = ptr + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"step_{step}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, ptr)
+        _fsync_dir(self.directory)
+
+    def _gc(self):
+        steps = sorted(self._finalized_steps())
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"),
+                          ignore_errors=True)
+            _emit("ckpt.gc", step=s)
+        # stale tmp dirs from a crashed writer (other pids included)
+        for fn in os.listdir(self.directory):
+            if fn.startswith(".tmp_step_"):
+                shutil.rmtree(os.path.join(self.directory, fn),
+                              ignore_errors=True)
+
+    def _finalized_steps(self):
+        out = []
+        if not self.directory or not os.path.isdir(self.directory):
+            return out
+        for fn in os.listdir(self.directory):
+            if fn.startswith("step_"):
+                try:
+                    s = int(fn[5:])
+                except ValueError:
+                    continue
+                d = os.path.join(self.directory, fn)
+                if any(m.endswith(".metadata") for m in os.listdir(d)):
+                    out.append(s)
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        """Newest finalized checkpoint step (honors the ``latest`` pointer,
+        falls back to a directory scan)."""
+        steps = self._finalized_steps()
+        if not steps:
+            return None
+        ptr = os.path.join(self.directory, "latest")
+        try:
+            with open(ptr) as f:
+                name = f.read().strip()
+            s = int(name[5:])
+            if s in steps:
+                return s
+        except (OSError, ValueError):
+            pass
+        return max(steps)
+
+    def load_latest(self) -> Optional[int]:
+        """Restore model+optimizer from the newest finalized checkpoint
+        (CRC-verified by the checkpoint loader). Returns the restored step,
+        or None when no checkpoint exists."""
+        from .. import checkpoint as dckpt
+
+        step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self.directory, f"step_{step}")
+        target = {}
+        if self.model is not None:
+            target["model"] = self.model.state_dict()
+        opt_sd = None
+        if self.optimizer is not None:
+            opt_sd = self.optimizer.state_dict()
+            target["optimizer"] = opt_sd
+        dckpt.load_state_dict(target, path)
+        if self.optimizer is not None and opt_sd is not None:
+            # load mutated the wrapper Tensors; push arrays back into the
+            # optimizer's live accumulator store
+            self.optimizer.set_state_dict(opt_sd)
+        self._step = step
+        chaos.note_step(step)
+        self.snapshot()
+        _emit("ckpt.load", step=step, path=path)
+        return step
+
+    # -- preemption ---------------------------------------------------------
+
+    def install_preemption_handler(self) -> bool:
+        """SIGTERM -> flush a final checkpoint, then proceed with the
+        previous/default handling. Main-thread only; returns False when
+        installation was not possible."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handler(signum, frame):
+            _emit("ckpt.preempt", step=self._step)
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — preemption path must exit
+                pass
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+            return True
+        except (ValueError, OSError):
+            return False
+
+    def flush(self):
+        """Synchronously publish a checkpoint of the current state (final
+        flush on preemption/shutdown)."""
+        self._join_save()
+        self.save(wait=True)
+
+    def close(self):
+        self._join_save()
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigterm = None

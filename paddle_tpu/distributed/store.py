@@ -18,8 +18,33 @@ import threading
 import time
 from typing import Optional
 
+from ..core import flags
 from ..core.native import (NativeStoreClient, NativeStoreServer,
                            available as _native_available)
+from ..observability import emit as _emit
+
+flags.define_flag("store_retries", 2,
+                  "Bounded reconnect+retry attempts for idempotent TCPStore "
+                  "ops (get/check/wait) after a transport error; 0 disables")
+flags.define_flag("store_retry_backoff", 0.05,
+                  "Base seconds for exponential backoff between TCPStore "
+                  "retries (doubles per attempt)")
+
+# chaos choke point: installed by distributed/fault_tolerance/chaos.py only
+# while FLAGS_chaos_spec is active — (op_name) -> 'drop' | 'garble' | None
+_chaos_hook = [None]
+
+
+def set_chaos_hook(fn):
+    _chaos_hook[0] = fn
+
+
+_OP_NAMES = {0: "set", 1: "get", 2: "add", 3: "check", 4: "delete",
+             5: "ping"}
+
+# replies larger than this are corruption, not data — the server frames
+# every reply with a <Q length, and a garbled frame shows up here first
+_MAX_PLAUSIBLE_REPLY = 1 << 30
 
 
 class _PyStoreServer:
@@ -110,14 +135,14 @@ class _PyStoreServer:
 
 class _PyStoreClient:
     def __init__(self, host: str, port: int, timeout_ms: int = 30000):
+        self._host = host
+        self._port = port
+        self._lock = threading.Lock()
         deadline = time.time() + timeout_ms / 1000.0
         last = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
-                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._sock.settimeout(None)
-                self._lock = threading.Lock()
+                self._connect()
                 return
             except OSError as e:
                 last = e
@@ -125,6 +150,22 @@ class _PyStoreClient:
                     raise ConnectionError(
                         f"cannot connect TCPStore {host}:{port}") from last
                 time.sleep(0.05)
+
+    def _connect(self):
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=5)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+
+    def _reconnect(self):
+        """Drop the (possibly poisoned) socket and dial a fresh one — one
+        dropped recv must not poison the client forever."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._connect()
 
     def _read(self, n):
         buf = b""
@@ -136,11 +177,34 @@ class _PyStoreClient:
         return buf
 
     def _req(self, op: int, key: str, val: bytes = b"") -> bytes:
+        fault = None
+        ch = _chaos_hook[0]
+        if ch is not None:
+            fault = ch(_OP_NAMES.get(op, str(op)))
+            if fault == "drop":
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    "[chaos] injected TCPStore connection drop")
         with self._lock:
             k = key.encode()
             self._sock.sendall(bytes([op]) + struct.pack("<I", len(k)) + k
                                + struct.pack("<Q", len(val)) + val)
             rlen = struct.unpack("<Q", self._read(8))[0]
+            if fault == "garble":
+                rlen |= 1 << 40  # corrupt the frame length in flight
+            if rlen > _MAX_PLAUSIBLE_REPLY:
+                # desynced/corrupt frame: poison the socket so a retry
+                # reconnects instead of reading garbage as data
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    f"TCPStore reply length {rlen} is implausible "
+                    f"(corrupt frame)")
             return self._read(rlen) if rlen else b""
 
     def set(self, key, value):
@@ -205,7 +269,38 @@ class TCPStore:
         else:
             self._client = _PyStoreClient(host, port, int(timeout * 1000))
         self.native = isinstance(self._client, NativeStoreClient)
+        self._timeout_ms = int(timeout * 1000)
         self._barrier_gen = 0
+
+    def _reconnect(self):
+        c = self._client
+        if hasattr(c, "_reconnect"):
+            c._reconnect()
+        else:
+            # native client has no reconnect entry point: rebuild it
+            self._client = type(c)(self.host, self.port, self._timeout_ms)
+
+    def _retry_idempotent(self, opname: str, fn):
+        """Bounded reconnect+retry with backoff. ONLY for idempotent ops
+        (get/check/wait): retrying a set/add after an ambiguous failure
+        could double-apply it."""
+        retries = max(0, int(flags.flag_value("store_retries")))
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except (ConnectionError, OSError) as e:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                _emit("store.retry", op=opname, attempt=attempt,
+                      error=f"{type(e).__name__}: {e}")
+                time.sleep(float(flags.flag_value("store_retry_backoff"))
+                           * (2 ** (attempt - 1)))
+                try:
+                    self._reconnect()
+                except (ConnectionError, OSError):
+                    pass  # next attempt surfaces the failure
 
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
@@ -213,13 +308,14 @@ class TCPStore:
         self._client.set(key, bytes(value))
 
     def get(self, key: str) -> bytes:
-        return self._client.get(key)
+        return self._retry_idempotent("get", lambda: self._client.get(key))
 
     def add(self, key: str, amount: int = 1) -> int:
         return self._client.add(key, amount)
 
     def check(self, key: str) -> bool:
-        return self._client.check(key)
+        return self._retry_idempotent("check",
+                                      lambda: self._client.check(key))
 
     def delete_key(self, key: str):
         self._client.delete(key)

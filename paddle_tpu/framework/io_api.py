@@ -3,18 +3,33 @@
 Reference: python/paddle/framework/io.py:773 (save) /:1020 (load) — pickle of
 state_dict-like nested containers with tensors converted to numpy. Same
 format idea here: portable numpy payloads, Tensors restored on load.
+
+Durability: `save` is atomic (write to `<path>.tmp.<pid>`, fsync,
+`os.replace`) and appends a CRC32 footer after the pickle payload —
+`pickle.load` ignores trailing bytes, so files stay readable by plain
+pickle and pre-footer files stay loadable here. `load` verifies the
+footer and raises a clear `DataLossError` on truncation/corruption
+instead of an opaque pickle explosion (a kill -9 mid-save can no longer
+leave a half-file behind at all; a corrupted disk is *detected*).
 """
 from __future__ import annotations
 
 import os
 import pickle
+import struct
+import zlib
 from typing import Any
 
 import numpy as np
 
+from ..core.enforce import DataLossError
 from ..core.tensor import Tensor
 
 _SENTINEL = "__paddle_tpu_tensor__"
+
+# footer = magic + <I crc32-of-payload>; appended after the pickle payload
+_CRC_MAGIC = b"PTCK1\x00"
+_CRC_FOOTER_LEN = len(_CRC_MAGIC) + 4
 
 
 def _pack(obj: Any):
@@ -44,14 +59,58 @@ def _unpack(obj: Any, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    from ..distributed.fault_tolerance import chaos
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    payload = pickle.dumps(_pack(obj), protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            chaos.maybe_crash_save("paddle_save")
+            f.write(_CRC_MAGIC + struct.pack("<I", zlib.crc32(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _verify_crc(path: str, raw: bytes) -> bytes:
+    """Strip + check the CRC footer; returns the pickle payload. Files
+    written before the footer existed pass through unverified."""
+    if len(raw) >= _CRC_FOOTER_LEN and \
+            raw[-_CRC_FOOTER_LEN:-4] == _CRC_MAGIC:
+        payload = raw[:-_CRC_FOOTER_LEN]
+        want = struct.unpack("<I", raw[-4:])[0]
+        got = zlib.crc32(payload)
+        if got != want:
+            raise DataLossError(
+                f"paddle.load({path!r}): CRC mismatch (stored "
+                f"{want:#010x}, computed {got:#010x}) — the file is "
+                f"corrupted (truncated write, bit rot, or a concurrent "
+                f"writer); restore from a good checkpoint")
+        return payload
+    return raw
 
 
 def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
-        payload = pickle.load(f)
-    return _unpack(payload, return_numpy=return_numpy)
+        raw = f.read()
+    payload = _verify_crc(path, raw)
+    try:
+        obj = pickle.loads(payload)
+    except Exception as e:
+        raise DataLossError(
+            f"paddle.load({path!r}): unreadable payload "
+            f"({type(e).__name__}: {e}) — the file is truncated or "
+            f"corrupted (e.g. a writer was killed mid-save with a "
+            f"pre-atomic-save build); restore from a good checkpoint"
+        ) from e
+    return _unpack(obj, return_numpy=return_numpy)

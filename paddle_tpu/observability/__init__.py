@@ -143,6 +143,28 @@ _c_enf = _C("paddle_enforce_errors_total",
             "EnforceNotMet errors raised, by type")
 _c_dumps = _C("paddle_distress_dumps_total",
               "Dump-on-distress artifacts written, by reason")
+_c_chaos = _C("paddle_chaos_injections_total",
+              "Chaos-harness faults injected, by site and kind")
+_c_store_retry = _C("paddle_store_retries_total",
+                    "TCPStore reconnect+retry attempts, by op")
+_c_coll_retry = _C("paddle_collective_retries_total",
+                   "Collective retry attempts after retryable errors, by op")
+_c_escalate = _C("paddle_watchdog_escalations_total",
+                 "Watchdog policy-ladder stages applied, by stage")
+_c_ckpt_saves = _C("paddle_ckpt_saves_total",
+                   "Checkpoints published by CheckpointManager")
+_c_ckpt_save_err = _C("paddle_ckpt_save_errors_total",
+                      "CheckpointManager disk saves that failed")
+_h_ckpt_save = _H("paddle_ckpt_save_seconds",
+                  "Wall time of CheckpointManager disk saves")
+_g_ckpt_step = _G("paddle_ckpt_last_step",
+                  "Step of the newest published checkpoint")
+_c_rollbacks = _C("paddle_ckpt_rollbacks_total",
+                  "NaN/Inf step-guard rollbacks to last-good state")
+_c_ckpt_loads = _C("paddle_ckpt_loads_total",
+                   "CheckpointManager restores from disk")
+_c_preempt = _C("paddle_preemption_flushes_total",
+                "Final checkpoint flushes triggered by SIGTERM")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -238,6 +260,22 @@ _HANDLERS = {
     "serving.prefill": _h_serving("prefill"),
     "serving.decode_chunk": _h_serving("decode"),
     "watchdog.timeout": lambda d, f: _c_wd.inc(),
+    "watchdog.escalate": lambda d, f: _c_escalate.inc(
+        labels={"stage": f.get("stage", "")}),
+    "chaos.inject": lambda d, f: _c_chaos.inc(
+        labels={"site": f.get("site", ""), "kind": f.get("fault", "")}),
+    "store.retry": lambda d, f: _c_store_retry.inc(
+        labels={"op": f.get("op", "")}),
+    "collective.retry": lambda d, f: _c_coll_retry.inc(
+        labels={"op": f.get("op", "")}),
+    "ckpt.save": lambda d, f: (_c_ckpt_saves.inc(),
+                               _g_ckpt_step.set(f.get("step", 0)),
+                               _h_ckpt_save.observe(d)
+                               if d is not None else None),
+    "ckpt.save_error": lambda d, f: _c_ckpt_save_err.inc(),
+    "ckpt.rollback": lambda d, f: _c_rollbacks.inc(),
+    "ckpt.load": lambda d, f: _c_ckpt_loads.inc(),
+    "ckpt.preempt": lambda d, f: _c_preempt.inc(),
     "enforce.error": lambda d, f: _c_enf.inc(
         labels={"type": f.get("type", "")}),
     "distress.dump": lambda d, f: _c_dumps.inc(

@@ -43,36 +43,67 @@ def distress_dir() -> str:
 
 def dump(reason: str, extra: dict = None, directory: str = None,
          path: str = None) -> str:
-    """Write the post-mortem artifact; returns its path.
+    """Write the post-mortem artifact; returns its path ("" on failure).
 
-    Never raises: distress handling runs on error/signal paths where a
-    secondary failure must not mask the original one.
+    Never raises: distress handling runs on error/signal paths (watchdog
+    timeout, enforce, SIGUSR1) where a secondary failure must not mask the
+    original report. Each artifact section is guarded independently — a
+    serialization bug in one section degrades that section to an error
+    string instead of losing the whole dump — and a total failure is
+    announced on stderr so the operator knows the artifact is missing,
+    while the caller continues with the original message/abort.
     """
-    from . import recorder, registry, emit
+    import sys
 
     try:
-        emit("distress.dump", reason=reason)
-        rec = recorder()
+        from . import recorder, registry, emit
+
+        try:
+            emit("distress.dump", reason=reason)
+        except Exception:  # noqa: BLE001
+            pass
         doc = {
             "reason": reason,
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "pid": os.getpid(),
-            "events_recorded_total": rec.written(),
             "extra": extra or {},
-            "metrics": registry().snapshot(),
-            "events": rec.to_json_events(),
-            "chrome_trace": rec.to_chrome_trace(),
         }
+        rec = recorder()
+        for section, build in (
+                ("events_recorded_total", rec.written),
+                ("metrics", registry().snapshot),
+                ("events", rec.to_json_events),
+                ("chrome_trace", rec.to_chrome_trace)):
+            try:
+                doc[section] = build()
+            except Exception as e:  # noqa: BLE001 — keep the other sections
+                doc[section] = (f"<unserializable: "
+                                f"{type(e).__name__}: {e}>")
         if path is None:
             d = directory or distress_dir()
             os.makedirs(d, exist_ok=True)
             stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
             path = os.path.join(
                 d, f"paddle_distress_{reason}_{os.getpid()}_{stamp}.json")
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1, default=str)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+            os.replace(tmp, path)  # never leave a half-written artifact
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return path
-    except Exception:  # noqa: BLE001 — see docstring
+    except Exception as e:  # noqa: BLE001 — see docstring
+        try:
+            print(f"[observability] WARNING: distress dump failed "
+                  f"({type(e).__name__}: {e}); continuing with the "
+                  f"original {reason!r} report", file=sys.stderr, flush=True)
+        except Exception:  # noqa: BLE001
+            pass
         return ""
 
 

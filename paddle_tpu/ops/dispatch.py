@@ -125,6 +125,16 @@ def set_amp_hook(fn):
     _amp_hook = fn
 
 
+# chaos choke point: installed by distributed/fault_tolerance/chaos.py only
+# while FLAGS_chaos_spec is active — (op_name, result) -> result, may poison
+# outputs. One list-slot check on the hot path when inactive (3% budget).
+_chaos_hook = [None]
+
+
+def set_chaos_hook(fn):
+    _chaos_hook[0] = fn
+
+
 _op_profiling = [False]
 
 
@@ -547,6 +557,9 @@ def _call_op_impl(name: str, kernel: Callable, args, kwargs,
             else:
                 _cache_put(key, _BYPASS)
 
+    ch = _chaos_hook[0]
+    if ch is not None:
+        result = ch(name, result)
     if flags.flag_value("benchmark"):
         for t in jax.tree.leaves(result, is_leaf=_is_tensor):
             if isinstance(t, Tensor) and hasattr(t._data,

@@ -24,3 +24,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests driven by the chaos harness "
+        "(FLAGS_chaos_spec)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")

@@ -1,0 +1,28 @@
+"""Worker for the kill-9-mid-save atomicity drill.
+
+Publishes a good step-0 checkpoint, then starts a second save with a
+``save:crash`` chaos injection armed — the process hard-exits (os._exit
+137, the kill -9 analog) inside the data write, before the tmp directory
+is renamed into place. The parent test asserts the step-0 checkpoint is
+still the published ``latest`` and loads with CRC verification intact.
+"""
+import sys
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fault_tolerance import CheckpointManager, chaos
+
+directory = sys.argv[1]
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 4))
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+cm = CheckpointManager(directory=directory, model=model, optimizer=opt,
+                       interval=0, async_save=False)
+cm.save(wait=True)
+print("FIRST_SAVED", cm.latest_step(), flush=True)
+
+cm._step = 1
+chaos.reconfigure("save:crash@op=distcp")
+cm.save(wait=True)  # os._exit(137) fires inside the shard write
+print("UNREACHABLE", flush=True)

@@ -1,0 +1,622 @@
+"""Fault-tolerance tests: chaos harness, retry paths, watchdog ladder,
+CheckpointManager rollback/atomicity, CRC-verified IO.
+
+Every recovery claim is asserted against an *observed* injection (the
+chaos.inject counter) — never against luck.
+"""
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu import observability
+from paddle_tpu.core import flags
+from paddle_tpu.core.enforce import DataLossError, UnavailableError
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.distributed import comm_watchdog as cw
+from paddle_tpu.distributed.fault_tolerance import (ChaosCollectiveTimeout,
+                                                    CheckpointManager, chaos)
+from paddle_tpu.distributed.store import TCPStore
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """Chaos specs and watchdog policies must never leak between tests."""
+    yield
+    chaos.reconfigure("")
+    flags.set_flags({"watchdog_policy": "", "comm_timeout": 0.0,
+                     "comm_watchdog_abort": True})
+
+
+def _metric(name, labels=None):
+    return observability.registry().value(name, labels or {})
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 4))
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_selectors():
+    injs = chaos.parse_spec(
+        "dispatch:nan@op=mean;step=3;count=2, collective:timeout, "
+        "store:garble@op=get;prob=0.5, fetch:stall@delay=0.2")
+    assert [(i.site, i.kind) for i in injs] == [
+        ("dispatch", "nan"), ("collective", "timeout"),
+        ("store", "garble"), ("fetch", "stall")]
+    assert injs[0].op == "mean" and injs[0].step == 3 and injs[0].count == 2
+    assert injs[2].prob == 0.5
+    assert injs[3].delay == 0.2
+    assert chaos.parse_spec("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "dispatch",                # no kind
+    "dispatch:frobnicate",     # unknown kind
+    "warp:nan",                # unknown site
+    "dispatch:nan@bogus=1",    # unknown selector
+    "dispatch:nan@step=x",     # non-int selector value
+])
+def test_parse_spec_malformed_raises(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_flag_activation_installs_and_removes_hooks():
+    from paddle_tpu.ops import dispatch
+
+    flags.set_flags({"chaos_spec": "dispatch:nan@op=nosuchop"})
+    try:
+        assert dispatch._chaos_hook[0] is not None
+        assert chaos.active()
+    finally:
+        flags.set_flags({"chaos_spec": ""})
+    assert dispatch._chaos_hook[0] is None
+    assert not chaos.active()
+
+
+# ---------------------------------------------------------------------------
+# Dispatch poisoning
+# ---------------------------------------------------------------------------
+
+def test_dispatch_nan_poison_op_and_count():
+    before = _metric("paddle_chaos_injections_total",
+                     {"site": "dispatch", "kind": "nan"})
+    chaos.reconfigure("dispatch:nan@op=add;count=1")
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    poisoned = a + a
+    clean = a + a  # count=1: second call untouched
+    assert np.isnan(poisoned.numpy()).all()
+    np.testing.assert_allclose(clean.numpy(), 2.0)
+    assert _metric("paddle_chaos_injections_total",
+                   {"site": "dispatch", "kind": "nan"}) == before + 1
+
+
+def test_dispatch_inf_poison():
+    chaos.reconfigure("dispatch:inf@op=subtract")
+    a = paddle.to_tensor(np.ones(3, np.float32))
+    assert np.isinf((a - a).numpy()).all()
+
+
+def test_step_selector_uses_chaos_clock():
+    chaos.reconfigure("dispatch:nan@op=add;step=2")
+    a = paddle.to_tensor(np.ones(2, np.float32))
+    assert np.isfinite((a + a).numpy()).all()   # clock at 0
+    chaos.note_step(2)
+    assert np.isnan((a + a).numpy()).all()      # clock at 2 → fires
+
+
+def test_prob_injection_is_seeded_deterministic():
+    def pattern():
+        flags.set_flags({"chaos_seed": 1234})
+        chaos.reconfigure("dispatch:nan@op=add;prob=0.5;count=0")
+        a = paddle.to_tensor(np.ones(2, np.float32))
+        return [bool(np.isnan((a + a).numpy()).any()) for _ in range(12)]
+
+    first, second = pattern(), pattern()
+    assert first == second
+    assert any(first) and not all(first)  # prob strictly between 0 and 1
+
+
+def test_fetch_stall_delays_scalar_fetch():
+    a = paddle.to_tensor(np.ones((), np.float32))
+    chaos.reconfigure("fetch:stall@delay=0.2")
+    t0 = time.perf_counter()
+    float(a + a)
+    assert time.perf_counter() - t0 >= 0.15
+
+
+# ---------------------------------------------------------------------------
+# Collective retry
+# ---------------------------------------------------------------------------
+
+def test_collective_timeout_retried_once():
+    before = _metric("paddle_collective_retries_total", {"op": "all_reduce"})
+    chaos.reconfigure("collective:timeout@op=all_reduce;count=1")
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), 1.0)  # world=1 identity
+    assert _metric("paddle_collective_retries_total",
+                   {"op": "all_reduce"}) == before + 1
+
+
+def test_collective_retries_exhausted_raises():
+    flags.set_flags({"collective_retries": 1,
+                     "collective_retry_backoff": 0.01})
+    try:
+        chaos.reconfigure("collective:timeout@op=all_reduce;count=0")
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        with pytest.raises(ChaosCollectiveTimeout):
+            dist.all_reduce(t)
+    finally:
+        flags.set_flags({"collective_retries": 2,
+                         "collective_retry_backoff": 0.05})
+
+
+# ---------------------------------------------------------------------------
+# TCPStore resilience
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def store_pair():
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True, world_size=1,
+                      use_native=False)
+    client = TCPStore("127.0.0.1", port, is_master=False, world_size=1,
+                      use_native=False)
+    yield master, client
+    chaos.reconfigure("")
+    client.stop()
+    master.stop()
+
+
+def test_store_drop_reconnects_and_retries(store_pair):
+    _, client = store_pair
+    client.set("k", b"v1")
+    before = _metric("paddle_store_retries_total", {"op": "get"})
+    chaos.reconfigure("store:drop@op=get;count=1")
+    assert client.get("k") == b"v1"
+    assert _metric("paddle_store_retries_total",
+                   {"op": "get"}) == before + 1
+
+
+def test_store_garbled_reply_detected_and_retried(store_pair):
+    _, client = store_pair
+    client.set("k", b"payload")
+    chaos.reconfigure("store:garble@op=get;count=1")
+    assert client.get("k") == b"payload"
+
+
+def test_store_wait_survives_drop(store_pair):
+    master, client = store_pair
+    chaos.reconfigure("store:drop@op=check;count=1")
+    master.set("ready", b"1")
+    client.wait("ready", timeout=10.0)  # check() path retries internally
+
+
+def test_store_non_idempotent_set_not_retried(store_pair):
+    _, client = store_pair
+    chaos.reconfigure("store:drop@op=set;count=1")
+    with pytest.raises((ConnectionError, OSError)):
+        client.set("k2", b"x")  # ambiguous failure must propagate
+
+
+# ---------------------------------------------------------------------------
+# Watchdog escalation ladder
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def no_abort(monkeypatch):
+    killed = []
+    monkeypatch.setattr(cw.os, "kill", lambda pid, sig: killed.append(sig))
+    return killed
+
+
+def _expire_once(mgr, timeout=0.25, deadline=8.0, stop=None):
+    tid = mgr.start_task("all_reduce", 0, 0, (4,), "float32",
+                         timeout=timeout)
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        if stop is not None and stop():
+            break
+        time.sleep(0.1)
+    mgr.end_task(tid)
+
+
+def test_ladder_runs_every_stage_then_aborts(no_abort, capfd):
+    flags.set_flags({"watchdog_policy": "warn,dump,retry,restart,abort",
+                     "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    before = {s: _metric("paddle_watchdog_escalations_total", {"stage": s})
+              for s in ("warn", "dump", "retry", "restart", "abort")}
+    _expire_once(mgr, timeout=0.25, deadline=15.0,
+                 stop=lambda: bool(no_abort))
+    assert no_abort == [signal.SIGABRT]
+    for s in ("warn", "dump", "retry", "restart", "abort"):
+        assert _metric("paddle_watchdog_escalations_total",
+                       {"stage": s}) == before[s] + 1, s
+    err = capfd.readouterr().err
+    assert "stage=warn" in err
+    assert "stage=dump" in err
+    assert "doubled timeout" in err
+    assert "gang-restart barrier" in err
+    assert "COLLECTIVE TIMEOUT" in err
+    assert not mgr.in_flight()
+
+
+def test_ladder_warn_only_policy_never_aborts(no_abort):
+    flags.set_flags({"watchdog_policy": "warn",
+                     "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    before = _metric("paddle_watchdog_escalations_total", {"stage": "warn"})
+    _expire_once(mgr, timeout=0.25, deadline=1.2)
+    assert not no_abort
+    # last-stage clamp: warn repeats on every successive expiry
+    assert _metric("paddle_watchdog_escalations_total",
+                   {"stage": "warn"}) >= before + 2
+
+
+def test_ladder_retry_stage_doubles_timeout(no_abort):
+    flags.set_flags({"watchdog_policy": "retry",
+                     "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    tid = mgr.start_task("all_gather", 0, 0, (2,), "float32", timeout=0.25)
+    t0 = time.time()
+    while time.time() - t0 < 5.0 and not any(
+            t.timeout > 0.3 for t in mgr.in_flight()):
+        time.sleep(0.1)
+    tasks = mgr.in_flight()
+    assert tasks and tasks[0].timeout >= 0.5
+    mgr.end_task(tid)
+
+
+def test_legacy_empty_policy_single_report(no_abort, capfd):
+    flags.set_flags({"watchdog_policy": "", "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    _expire_once(mgr, timeout=0.25, deadline=1.0)
+    err = capfd.readouterr().err
+    assert err.count("COLLECTIVE TIMEOUT") == 1  # popped on first expiry
+    assert not no_abort  # abort flag honored
+
+
+def test_legacy_abort_flag_fires_sigabrt(no_abort):
+    flags.set_flags({"watchdog_policy": "", "comm_watchdog_abort": True})
+    mgr = cw.CommTaskManager()
+    _expire_once(mgr, timeout=0.25, deadline=8.0,
+                 stop=lambda: bool(no_abort))
+    assert no_abort == [signal.SIGABRT]
+
+
+def test_unknown_policy_stage_ignored(no_abort, capfd):
+    cw._policy_warned[0] = False
+    flags.set_flags({"watchdog_policy": "frobnicate,warn",
+                     "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    before = _metric("paddle_watchdog_escalations_total", {"stage": "warn"})
+    _expire_once(mgr, timeout=0.25, deadline=1.0)
+    err = capfd.readouterr().err
+    assert "frobnicate" in err
+    assert _metric("paddle_watchdog_escalations_total",
+                   {"stage": "warn"}) > before
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: rollback, disk protocol, preemption
+# ---------------------------------------------------------------------------
+
+def _train(model, opt, cm, x, y, steps, all_reduce_loss=False):
+    losses = []
+    done = 0
+    guard = 0
+    while done < steps:
+        guard += 1
+        assert guard < steps * 5, "rollback loop did not converge"
+        out = model(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if all_reduce_loss:
+            # stand-in for gradient sync: one collective per step
+            sync = paddle.to_tensor(np.ones(2, np.float32))
+            dist.all_reduce(sync)
+        if cm.on_step(loss):
+            continue  # poisoned step rolled back: re-run it
+        losses.append(float(loss))
+        done += 1
+    return losses
+
+
+def test_e2e_chaos_training_loop(tmp_path):
+    """The acceptance drill: one injected collective timeout + one NaN step
+    in a short training loop → finite loss, exactly one rollback and one
+    collective retry observed, final checkpoint loads with CRC verify."""
+    model = _mlp(seed=0)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    cm = CheckpointManager(directory=str(tmp_path), model=model,
+                           optimizer=opt, interval=2, async_save=False)
+    rb_before = _metric("paddle_ckpt_rollbacks_total")
+    cr_before = _metric("paddle_collective_retries_total",
+                        {"op": "all_reduce"})
+    chaos.reconfigure("dispatch:nan@op=mean;step=3;count=1, "
+                      "collective:timeout@op=all_reduce;count=1")
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    losses = _train(model, opt, cm, x, y, steps=8, all_reduce_loss=True)
+
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # it actually trained
+    assert _metric("paddle_ckpt_rollbacks_total") == rb_before + 1
+    assert _metric("paddle_collective_retries_total",
+                   {"op": "all_reduce"}) == cr_before + 1
+    assert cm.rollbacks_total == 1
+
+    # final checkpoint loads cleanly (CRC verified inside load_state_dict)
+    trained = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    model2 = _mlp(seed=9)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.05,
+                                parameters=model2.parameters())
+    cm2 = CheckpointManager(directory=str(tmp_path), model=model2,
+                            optimizer=opt2, interval=2, async_save=False)
+    step = cm2.load_latest()
+    assert step == 8
+    for k, v in model2.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), trained[k], rtol=1e-6,
+                                   err_msg=k)
+
+
+def test_rollback_restores_exact_state():
+    model = _mlp(seed=1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    cm = CheckpointManager(model=model, optimizer=opt, interval=0)
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    _train(model, opt, cm, x, y, steps=2)
+    good = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+    good_opt_step = opt._step_count
+
+    chaos.reconfigure("dispatch:nan@op=mean;count=1")
+    out = model(x)
+    loss = ((out - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert cm.on_step(loss) is True  # rolled back
+    for k, v in model.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), good[k], err_msg=k)
+    assert opt._step_count == good_opt_step
+
+
+def test_rollback_budget_exhausted_raises():
+    model = _mlp(seed=2)
+    cm = CheckpointManager(model=model, interval=0, rollback_budget=2)
+    bad = paddle.to_tensor(np.float32(np.nan))
+    assert cm.on_step(bad) is True
+    assert cm.on_step(bad) is True
+    with pytest.raises(UnavailableError, match="rollback"):
+        cm.on_step(bad)
+
+
+def test_keep_k_gc_and_latest_pointer(tmp_path):
+    model = _mlp(seed=3)
+    cm = CheckpointManager(directory=str(tmp_path), model=model,
+                           interval=1, keep=2, async_save=False)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for _ in range(5):
+        model(x)
+        cm.on_step(paddle.to_tensor(np.float32(0.5)))
+    steps = sorted(cm._finalized_steps())
+    assert steps == [4, 5]  # keep=2
+    assert cm.latest_step() == 5
+    assert (tmp_path / "latest").read_text().strip() == "step_5"
+
+
+def test_async_save_publishes(tmp_path):
+    model = _mlp(seed=4)
+    cm = CheckpointManager(directory=str(tmp_path), model=model,
+                           interval=0, async_save=True)
+    cm.save()
+    cm._join_save()
+    assert cm.latest_step() == 0
+    cm2 = CheckpointManager(directory=str(tmp_path), model=_mlp(seed=5),
+                            interval=0)
+    assert cm2.load_latest() == 0
+
+
+def test_sigterm_flushes_final_checkpoint(tmp_path):
+    model = _mlp(seed=6)
+    cm = CheckpointManager(directory=str(tmp_path), model=model,
+                           interval=0, async_save=False)
+    caught = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: caught.append(a))
+    try:
+        assert cm.install_preemption_handler()
+        cm._step = 7
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.1)
+        assert caught  # chained to the pre-existing handler
+        assert cm.latest_step() == 7  # final flush published
+    finally:
+        cm.close()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_kill9_mid_save_previous_checkpoint_loadable(tmp_path):
+    """The atomicity drill: a writer hard-killed mid-save (chaos save:crash
+    = os._exit inside the data write) must leave the previous checkpoint
+    fully loadable and the directory GC-able."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "multiproc", "ckpt_crash_worker.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, worker, str(tmp_path)],
+        capture_output=True, text=True, timeout=180, cwd=repo, env=env)
+    assert "FIRST_SAVED 0" in proc.stdout, proc.stderr
+    assert proc.returncode == 137, (proc.returncode, proc.stderr)
+    assert "UNREACHABLE" not in proc.stdout
+
+    model = _mlp(seed=0)
+    cm = CheckpointManager(directory=str(tmp_path), model=model,
+                           interval=0, async_save=False)
+    assert cm.latest_step() == 0  # the crashed step-1 save never published
+    assert cm.load_latest() == 0  # and the survivor passes CRC verification
+
+
+# ---------------------------------------------------------------------------
+# Atomic + CRC-verified IO (paddle.save / distributed.checkpoint)
+# ---------------------------------------------------------------------------
+
+def test_paddle_save_roundtrip_with_crc(tmp_path):
+    path = str(tmp_path / "model.pdparams")
+    obj = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32)),
+           "meta": {"epoch": 3}}
+    paddle.save(obj, path)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), np.arange(6))
+    assert loaded["meta"]["epoch"] == 3
+
+
+def test_paddle_load_detects_corruption(tmp_path):
+    path = str(tmp_path / "m.pdparams")
+    paddle.save({"w": paddle.ones([8])}, path)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(DataLossError, match="CRC mismatch"):
+        paddle.load(path)
+
+
+def test_paddle_load_detects_truncation(tmp_path):
+    path = str(tmp_path / "m.pdparams")
+    paddle.save({"w": paddle.ones([128])}, path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(DataLossError):
+        paddle.load(path)
+
+
+def test_paddle_load_pre_crc_files_still_load(tmp_path):
+    """Files written by older builds (no CRC footer) stay loadable."""
+    path = str(tmp_path / "old.pdparams")
+    with open(path, "wb") as f:
+        pickle.dump({"x": 1}, f, protocol=4)
+    assert paddle.load(path) == {"x": 1}
+
+
+def test_dist_checkpoint_corruption_fails_loudly(tmp_path):
+    dckpt.save_state_dict({"w": paddle.ones([16])}, str(tmp_path))
+    data_file = next(f for f in os.listdir(tmp_path)
+                     if f.endswith(".distcp"))
+    p = tmp_path / data_file
+    raw = bytearray(p.read_bytes())
+    raw[3] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    with pytest.raises(DataLossError, match="CRC mismatch"):
+        dckpt.load_state_dict({"w": paddle.zeros([16])}, str(tmp_path))
+
+
+def test_dist_checkpoint_truncated_metadata_fails_loudly(tmp_path):
+    dckpt.save_state_dict({"w": paddle.ones([4])}, str(tmp_path))
+    meta_file = next(f for f in os.listdir(tmp_path)
+                     if f.endswith(".metadata"))
+    p = tmp_path / meta_file
+    p.write_bytes(p.read_bytes()[:10])
+    with pytest.raises(DataLossError, match="metadata"):
+        dckpt.load_state_dict({"w": paddle.zeros([4])}, str(tmp_path))
+
+
+def test_reshard_on_load_after_simulated_rank_loss(tmp_path):
+    """A checkpoint written under a 4-way sharding loads into a 2-way
+    sharded target — the reshard-on-load path a shrunken gang uses after
+    losing ranks (CRC verified along the way)."""
+    mesh4 = dist.ProcessMesh([0, 1, 2, 3], dim_names=["mp"])
+    w = paddle.to_tensor(
+        np.arange(64, dtype=np.float32).reshape(16, 4))
+    ref = w.numpy().copy()
+    sharded = dist.shard_tensor(w, mesh4, [dist.Shard(0)])
+    dckpt.save_state_dict({"w": sharded}, str(tmp_path))
+
+    mesh2 = dist.ProcessMesh([0, 1], dim_names=["mp"])  # the survivors
+    target = dist.shard_tensor(paddle.zeros([16, 4]), mesh2,
+                               [dist.Shard(0)])
+    sd = {"w": target}
+    dckpt.load_state_dict(sd, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(sd["w"]._data), ref)
+    assert not sd["w"]._data.sharding.is_fully_replicated
+
+
+# ---------------------------------------------------------------------------
+# Distress path exception-proofing
+# ---------------------------------------------------------------------------
+
+def test_distress_dump_never_raises_and_warns(monkeypatch, tmp_path, capfd):
+    from paddle_tpu.observability import distress
+
+    def boom(*a, **k):
+        raise RuntimeError("serializer exploded")
+
+    monkeypatch.setattr(distress.json, "dump", boom)
+    path = distress.dump("unit_test", directory=str(tmp_path))
+    assert path == ""  # swallowed, not raised
+    assert "distress dump failed" in capfd.readouterr().err
+    assert not list(tmp_path.iterdir())  # no half-written artifact
+
+
+def test_distress_dump_section_failure_degrades_gracefully(tmp_path):
+    from paddle_tpu.observability import distress
+
+    rec = observability.recorder()
+    orig = rec.to_chrome_trace
+    rec.to_chrome_trace = lambda: (_ for _ in ()).throw(ValueError("nope"))
+    try:
+        path = distress.dump("unit_test_sections", directory=str(tmp_path))
+    finally:
+        rec.to_chrome_trace = orig
+    assert path
+    doc = json.loads(open(path).read())
+    assert "unserializable" in doc["chrome_trace"]
+    assert isinstance(doc["metrics"], dict)  # other sections intact
+
+
+def test_watchdog_report_survives_dump_failure(no_abort, capfd, monkeypatch):
+    """The original timeout report must print even when the distress dump
+    machinery is completely broken."""
+    monkeypatch.setattr(observability, "dump_distress",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("dump broken")))
+    flags.set_flags({"watchdog_policy": "", "comm_watchdog_abort": False})
+    mgr = cw.CommTaskManager()
+    _expire_once(mgr, timeout=0.25, deadline=1.0)
+    err = capfd.readouterr().err
+    assert "COLLECTIVE TIMEOUT" in err
+    assert "op=all_reduce" in err

@@ -135,6 +135,46 @@ def _commit(paths, msg) -> bool:
     return False
 
 
+_last_chaos_smoke = [0.0]
+
+
+def maybe_chaos_smoke(min_interval: float = 3600.0) -> None:
+    """Run the CPU chaos smoke (tools/chaos_smoke.py) at most once per
+    min_interval and log a RED line on regression — the fault-tolerance
+    drill (NaN rollback + collective retry + CRC'd checkpoint reload) is
+    build-signal the same way the perf floor is."""
+    now = time.monotonic()
+    if _last_chaos_smoke[0] and now - _last_chaos_smoke[0] < min_interval:
+        return
+    _last_chaos_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: chaos smoke hung >600s — fault-tolerance drill broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"chaos smoke GREEN ({payload.get('wall_s')}s: "
+            f"{payload.get('rollbacks')} rollback, "
+            f"{payload.get('collective_retries')} collective retry, "
+            f"reload step {payload.get('loaded_step')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: chaos smoke regression rc={out.returncode} — {detail} "
+        f"(tools/chaos_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -238,12 +278,14 @@ def main() -> None:
     if args.capture:
         sys.exit(capture())
     if args.once:
+        maybe_chaos_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
         f"capture timeout {args.capture_timeout:.0f}s")
     while True:
         try:
+            maybe_chaos_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
