@@ -125,6 +125,7 @@ def run_backward(
     # 1. Seed gradients.
     roots: List[GradNode] = []
     seeded = set()
+    seed_leaves: List[Any] = []
     for t, g in zip(tensors, grad_tensors):
         garr = g._data if hasattr(g, "_data") else g
         if garr is None:
@@ -142,6 +143,8 @@ def run_backward(
         node = t._grad_node
         if node is None:
             _leaf_accumulate(t, garr, capture)
+            if t._grad_final_hooks:
+                seed_leaves.append(t)
         else:
             if node.vjp_fn is None:
                 raise RuntimeError(
@@ -169,6 +172,35 @@ def run_backward(
                 if id(tgt) not in nodes:
                     nodes[id(tgt)] = tgt
                     stack.append(tgt)
+
+    # 2b. Grad-final accounting: count the pending contributions of every
+    # leaf that registered a grad-final hook, so the hook fires the instant
+    # the leaf's accumulation completes — this is what lets the DataParallel
+    # reducer issue a bucket's collective while backward is still running
+    # (reference: EagerReducer's per-param accumulation-done hooks).
+    final_pending: Dict[int, int] = {}
+    for n in nodes.values():
+        for e in n.edges:
+            if e is not None and e[0] == "leaf" and e[1]._grad_final_hooks:
+                final_pending[id(e[1])] = final_pending.get(id(e[1]), 0) + 1
+
+    def _note_leaf_contribution(t):
+        k = id(t)
+        c = final_pending.get(k)
+        if c is None:
+            return
+        if c <= 1:
+            del final_pending[k]
+            for hook in t._grad_final_hooks:
+                hook(t)
+        else:
+            final_pending[k] = c - 1
+
+    for t in seed_leaves:
+        # a seeded bare leaf with no in-graph contributions is final already
+        if id(t) not in final_pending:
+            for hook in t._grad_final_hooks:
+                hook(t)
 
     # 3. Process queue. Like forward dispatch, the whole pass only ENQUEUES
     # device work (each vjp is itself async under JAX); the span makes the
@@ -232,6 +264,7 @@ def run_backward(
                     ready.append(tgt)
             else:
                 _leaf_accumulate(e[1], g, capture)
+                _note_leaf_contribution(e[1])
     # Any nodes not processed had unreachable contributions pending; that is
     # fine (they were not on a path from the seeds).
     if span is not None:

@@ -193,6 +193,22 @@ def scalar_fetch(arr, tag: str = "tensor"):
     return arr
 
 
+def wait_for(arrays: Iterable[Any], tag: str = "wait"):
+    """Block until the given buffers are computed, under a ``fetch::<tag>``
+    span with an ``async.fetch_stall``-style record — the attribution point
+    the DataParallel reducer drains its outstanding bucket collectives
+    through at step boundaries. Returns the exposed wait seconds."""
+    arrays = [a for a in arrays if hasattr(a, "block_until_ready")]
+    t0 = time.perf_counter()
+    _with_span(f"fetch::{tag}", _block_on, arrays)
+    dur = time.perf_counter() - t0
+    _emit("async.fetch_stall", dur_s=dur, tag=tag, shape=(), dtype="",
+          was_ready=dur < 1e-5, in_flight=len(_queue))
+    if _queue:
+        _retire_ready()
+    return dur
+
+
 def drain():
     """Block until every in-flight step completes and clear the queue."""
     with _lock:

@@ -61,6 +61,7 @@ class Tensor:
         "name",
         "persistable",
         "_backward_hooks",
+        "_grad_final_hooks",
         "is_parameter",
         "trainable",
         "_dist_mesh",
@@ -93,6 +94,7 @@ class Tensor:
         self._dist_mesh = None
         self._dist_partials = ()
         self._backward_hooks: List = []
+        self._grad_final_hooks: List = []
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -110,6 +112,7 @@ class Tensor:
         t._dist_mesh = None
         t._dist_partials = ()
         t._backward_hooks = []
+        t._grad_final_hooks = []
         return t
 
     # -- metadata ------------------------------------------------------------
@@ -208,6 +211,23 @@ class Tensor:
             return _Handle()
         self._backward_hooks.append(hook)
         hooks = self._backward_hooks
+
+        class _Handle:
+            def remove(self_h):
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def register_grad_final_hook(self, hook):
+        """Fires ``hook(self)`` inside ``run_backward`` once THIS leaf's grad
+        has received its last contribution of the pass — the primitive the
+        DataParallel reducer builds bucket-ready notifications on (reference:
+        the EagerReducer's GradNodeAccumulation reduce hooks)."""
+        self._grad_final_hooks.append(hook)
+        hooks = self._grad_final_hooks
 
         class _Handle:
             def remove(self_h):
@@ -517,6 +537,7 @@ class Parameter(Tensor):
         p._dist_mesh = getattr(t, "_dist_mesh", None)
         p._dist_partials = getattr(t, "_dist_partials", ())
         p._backward_hooks = []
+        p._grad_final_hooks = []
         p.optimize_attr = {"learning_rate": 1.0}
         p.regularizer = None
         p.need_clip = True

@@ -37,6 +37,8 @@ from .collective import (  # noqa: F401
 )
 from .parallel import (  # noqa: F401
     DataParallel,
+    ShardedUpdate,
+    sharded_update,
     sync_param_grads,
     sync_params_buffers,
 )

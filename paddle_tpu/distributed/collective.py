@@ -341,6 +341,8 @@ _SHARD_FNS = {
     "all_gather_tiled": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=True),
     "reduce_scatter": lambda x, ax, n: lax.psum_scatter(
         x, ax, scatter_dimension=0, tiled=True),
+    "reduce_scatter_avg": lambda x, ax, n: lax.psum_scatter(
+        x, ax, scatter_dimension=0, tiled=True) / n,
     "all_to_all": lambda x, ax, n: lax.all_to_all(
         x, ax, split_axis=0, concat_axis=0, tiled=True),
     "broadcast": lambda x, ax, n, src: jax.tree.map(
@@ -352,6 +354,7 @@ _OUT_SPEC = {
     "all_gather": lambda ax: P(),            # gathered: replicated full copy
     "all_gather_tiled": lambda ax: P(),
     "reduce_scatter": lambda ax: P(ax),
+    "reduce_scatter_avg": lambda ax: P(ax),
     "all_to_all": lambda ax: P(ax),
     "broadcast": lambda ax: P(ax),
     "reduce": lambda ax: P(ax),
@@ -559,10 +562,10 @@ def _replicated(fn_name, x, g, **kw):
             return x ** n
         return x  # max/min/avg of identical copies
     if fn_name in ("broadcast", "all_to_all", "all_gather_tiled",
-                   "reduce_scatter"):
+                   "reduce_scatter", "reduce_scatter_avg"):
         if fn_name == "reduce_scatter" and n > 1:
             return x * n  # sum of n identical shards... caller keeps full
-        return x
+        return x  # AVG of identical shards is identity; caller keeps full
     if fn_name == "all_gather":
         return jnp.stack([x] * n, axis=0) if n > 1 else x[None]
     raise ValueError(fn_name)
@@ -619,7 +622,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         src = Tensor(jnp.concatenate([_unwrap(t) for t in src], axis=0))
-    out, task = _run(group, "reduce_scatter", src)
+    fn = "reduce_scatter_avg" if op == ReduceOp.AVG else "reduce_scatter"
+    out, task = _run(group, fn, src)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return task

@@ -1,26 +1,83 @@
-"""DataParallel wrapper + parallel env bootstrap.
+"""DataParallel wrapper + overlapped bucket reducer + sharded update.
 
 Reference: python/paddle/distributed/parallel.py:219 `DataParallel` — wraps a
 Layer, broadcasts params from rank 0, and registers backward hooks feeding an
 `EagerReducer` (reducer.h:88) that bucketizes grads and fires fused NCCL
 allreduces overlapped with backward.
 
-TPU-native: grad sync is ONE bucketed allreduce per step. Under the compiled
-train-step path XLA already fuses/overlaps the psum with backward compute; in
-eager mode we flat-pack grads into buckets (comm-efficient large transfers on
-ICI, the reducer's bucketing idea) and dispatch cached all-reduce executables
-at sync time. Param broadcast-from-src uses the same collective path.
+TPU-native rebuild of that hot path, in three pieces:
+
+1. **Overlap** (``FLAGS_dp_overlap``): every trainable param registers a
+   grad-final hook (``Tensor.register_grad_final_hook``); the moment a
+   bucket's last grad is final the bucket's collective is ISSUED — packed by
+   a cached jitted flat-pack executable and dispatched asynchronously — while
+   backward keeps walking the tape. ``sync_gradients()`` (and a pre-step hook
+   inside ``Optimizer.step``) merely drains the outstanding ``Task`` handles
+   instead of running a post-backward barrier.
+2. **Cross-replica sharded update** (``FLAGS_dp_shard_update``, ZeRO-1 per
+   Xu et al. arXiv:2004.13336): grads are reduce-scattered so each rank owns
+   a contiguous shard of the flat buffer, the fused buffer-donated optimizer
+   step runs on only the owned shard (1/N update FLOPs, 1/N optimizer-state
+   memory), and the updated flat params are tiled-all-gathered back. Bind an
+   optimizer with :func:`sharded_update`.
+3. **Caching**: the bucket layout and the jitted pack/unpack/scatter
+   executables are keyed on the param-set signature (name/shape/dtype/lr
+   multiplier + comm dtype + group), so steady-state steps run zero per-step
+   ``jnp.concatenate``/re-bucketing Python work — every step is cache-hit
+   executable dispatch.
+
+``FLAGS_dp_grad_comm_dtype`` optionally compresses the gradient collective
+(bf16/fp16 on the wire, params and update math stay in the param dtype).
 """
 from __future__ import annotations
 
-from typing import List, Optional
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import flags
+from ..core import async_engine
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
+from ..observability import emit as _obs_emit
 from . import collective as coll
+from .comm_watchdog import comm_task
 from .env import get_rank, get_world_size
+
+flags.define_flag("dp_overlap", True,
+                  "Issue each DP bucket's gradient collective from autograd "
+                  "grad-final hooks, overlapped with backward; 0 restores "
+                  "the post-backward barrier (all buckets issued at "
+                  "sync_gradients)")
+flags.define_flag("dp_shard_update", False,
+                  "ZeRO-1 cross-replica sharded weight update: "
+                  "reduce-scatter grads, run the optimizer on the owned "
+                  "1/N flat shard, all-gather updated params (requires "
+                  "binding the optimizer with "
+                  "paddle.distributed.sharded_update)")
+flags.define_flag("dp_grad_comm_dtype", "",
+                  "Wire dtype for DP gradient collectives: '' keeps the "
+                  "param dtype; 'bfloat16'/'bf16' or 'float16'/'fp16' "
+                  "compress the reduce, unpacking casts back")
+
+_COMM_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+                "fp16": "float16", "float16": "float16"}
+
+
+def _comm_dtype_name() -> Optional[str]:
+    raw = str(flags.flag_value("dp_grad_comm_dtype") or "").strip().lower()
+    if not raw:
+        return None
+    if raw not in _COMM_DTYPES:
+        raise ValueError(
+            f"FLAGS_dp_grad_comm_dtype={raw!r}: want '', 'bfloat16' or "
+            "'float16'")
+    return _COMM_DTYPES[raw]
 
 
 def _bucket_params(params: List[Parameter], bucket_mb: float = 32.0):
@@ -49,8 +106,9 @@ def _bucket_params(params: List[Parameter], bucket_mb: float = 32.0):
 def sync_param_grads(params: List[Parameter], group: Optional[coll.Group],
                      bucket_mb: float = 32.0):
     """Shared grad-sync: bucketed flat-pack AVG allreduce over `group`,
-    written back shard-for-shard. Used by DataParallel.sync_gradients and
-    HybridParallelOptimizer._sync_grads."""
+    written back shard-for-shard. Used by HybridParallelOptimizer._sync_grads
+    and as the reducer's fallback for partially-ready buckets (unused
+    params)."""
     if group is None or group.nranks <= 1:
         return
     with_grad = [p for p in params if getattr(p, "_grad", None) is not None]
@@ -73,6 +131,427 @@ def sync_params_buffers(model: Layer, comm_group: Optional[coll.Group] = None,
         coll.broadcast(p, src=src_rank, group=comm_group)
 
 
+# ---------------------------------------------------------------------------
+# Bucket plan: persistent layout + signature-keyed executable cache
+# ---------------------------------------------------------------------------
+
+class _Bucket:
+    __slots__ = ("index", "params", "shapes", "sizes", "offsets", "numel",
+                 "padded", "dtype", "comm_dtype", "lr_mult", "nbytes",
+                 # lazily built jitted executables
+                 "pack", "unpack_grads", "pack_params", "unpack_params",
+                 # per-step reducer state
+                 "ready", "issued", "task", "out_ref", "t_issue", "op",
+                 # sharded-update state
+                 "flat_grad", "flat_param", "out_ids", "pseudo")
+
+    def __init__(self, index, params, nranks, comm_dtype):
+        self.index = index
+        self.params = params
+        self.shapes = [tuple(p._data.shape) for p in params]
+        self.sizes = [int(jnp.size(p._data)) for p in params]
+        self.offsets = []
+        off = 0
+        for n in self.sizes:
+            self.offsets.append(off)
+            off += n
+        self.numel = off
+        n = max(1, nranks)
+        self.padded = -(-off // n) * n  # ceil to a multiple of nranks
+        self.dtype = str(params[0]._data.dtype)
+        self.comm_dtype = comm_dtype or self.dtype
+        self.lr_mult = float(getattr(params[0], "optimize_attr", {})
+                             .get("learning_rate", 1.0))
+        self.nbytes = self.padded * np.dtype(self.comm_dtype).itemsize
+        self.pack = None
+        self.unpack_grads = None
+        self.pack_params = None
+        self.unpack_params = None
+        self.ready = set()
+        self.issued = False
+        self.task = None
+        self.out_ref = None
+        self.t_issue = 0.0
+        self.op = ""
+        self.flat_grad = None
+        self.flat_param = None
+        self.out_ids = None
+        self.pseudo = None
+
+
+class _Plan:
+    __slots__ = ("signature", "buckets", "by_param")
+
+    def __init__(self, signature, buckets):
+        self.signature = signature
+        self.buckets = buckets
+        self.by_param: Dict[int, _Bucket] = {}
+        for b in buckets:
+            for p in b.params:
+                self.by_param[id(p)] = b
+
+
+_PLAN_CACHE_CAP = 8  # per-reducer: signatures only change on flag flips
+
+
+def _plan_signature(params, group, comm_mb, last_mb, comm_dtype):
+    gid = getattr(group, "id", -1) if group is not None else -1
+    nranks = getattr(group, "nranks", 1) if group is not None else 1
+    # id(p) is part of the key: a plan holds live references to its params,
+    # so a rebuild after a param is replaced must not reuse the old plan
+    return (tuple((id(p), p.name, tuple(p._data.shape), str(p._data.dtype),
+                   float(getattr(p, "optimize_attr", {})
+                         .get("learning_rate", 1.0)))
+                  for p in params),
+            gid, nranks, float(comm_mb), float(last_mb), comm_dtype or "")
+
+
+def _build_plan(params, group, comm_mb, last_mb, comm_dtype,
+                cache: "Optional[OrderedDict]" = None) -> _Plan:
+    """Bucket layout, signature-keyed. Params are grouped in REVERSE
+    declaration order (the order their grads become final during backward,
+    reference reducer.cc) and split by (dtype, lr multiplier) so each flat
+    buffer never promotes and maps to one fused-optimizer pseudo-param; the
+    last-built bucket is tail-split to ``last_comm_buffer_size_MB``
+    (reference's small final buffer, which flushes the stragglers early).
+
+    ``cache`` is the owning reducer's plan cache — scoped to the reducer
+    (not module-global) so a dead model's params are not pinned for the
+    process lifetime."""
+    sig = _plan_signature(params, group, comm_mb, last_mb, comm_dtype)
+    if cache is not None:
+        plan = cache.get(sig)
+        if plan is not None:
+            cache.move_to_end(sig)
+            return plan
+    nranks = getattr(group, "nranks", 1) if group is not None else 1
+    groups: "OrderedDict[tuple, list]" = OrderedDict()
+    for p in reversed(params):
+        key = (str(p._data.dtype),
+               float(getattr(p, "optimize_attr", {})
+                     .get("learning_rate", 1.0)))
+        groups.setdefault(key, []).append(p)
+    raw: List[List[Parameter]] = []
+    cap = int(float(comm_mb) * 1024 * 1024)
+    for (dt, _mult), ps in groups.items():
+        item = np.dtype(dt).itemsize
+        cur, cur_bytes = [], 0
+        for p in ps:
+            nbytes = int(jnp.size(p._data)) * item
+            if cur and cur_bytes + nbytes > cap:
+                raw.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            raw.append(cur)
+    if raw:
+        last_cap = int(float(last_mb) * 1024 * 1024)
+        tail_bucket = raw[-1]
+        item = np.dtype(str(tail_bucket[0]._data.dtype)).itemsize
+        if len(tail_bucket) > 1:
+            tail, tail_bytes = [], 0
+            while len(tail_bucket) > 1:
+                nbytes = int(jnp.size(tail_bucket[-1]._data)) * item
+                if tail and tail_bytes + nbytes > last_cap:
+                    break
+                tail.insert(0, tail_bucket.pop())
+                tail_bytes += nbytes
+            if tail and tail_bucket:
+                raw.append(tail)
+    buckets = [_Bucket(i, ps, nranks, comm_dtype)
+               for i, ps in enumerate(raw)]
+    plan = _Plan(sig, buckets)
+    if cache is not None:
+        cache[sig] = plan
+        while len(cache) > _PLAN_CACHE_CAP:
+            cache.popitem(last=False)
+    _obs_emit("dp.pack_build", buckets=len(buckets), params=len(params))
+    return plan
+
+
+def _make_pack(b: _Bucket):
+    """flat-pack executable: per-param grads -> padded flat comm-dtype
+    vector. Traced once per plan; every later call is a cache hit."""
+    comm = np.dtype(b.comm_dtype)
+    pad = b.padded - b.numel
+
+    def pack(arrs):
+        flat = jnp.concatenate([jnp.ravel(a).astype(comm) for a in arrs])
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), comm)])
+        return flat
+
+    return jax.jit(pack)
+
+
+def _make_unpack(b: _Bucket, out_sharding=None):
+    """flat -> per-param arrays (param dtype/shape), pad dropped."""
+    dtype = np.dtype(b.dtype)
+    offsets, sizes, shapes = b.offsets, b.sizes, b.shapes
+
+    def unpack(flat):
+        return tuple(
+            flat[off:off + n].reshape(shape).astype(dtype)
+            for off, n, shape in zip(offsets, sizes, shapes))
+
+    if out_sharding is not None:
+        return jax.jit(unpack, out_shardings=out_sharding)
+    return jax.jit(unpack)
+
+
+def _make_pack_params(b: _Bucket, sharding):
+    """params -> padded flat buffer in the PARAM dtype, laid out as this
+    group's owned shards (the reduce-scatter layout of the weight buffer)."""
+    dtype = np.dtype(b.dtype)
+    pad = b.padded - b.numel
+
+    def pack(arrs):
+        flat = jnp.concatenate([jnp.ravel(a).astype(dtype) for a in arrs])
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+        return flat
+
+    if sharding is not None:
+        return jax.jit(pack, out_shardings=sharding)
+    return jax.jit(pack)
+
+
+# ---------------------------------------------------------------------------
+# The reducer
+# ---------------------------------------------------------------------------
+
+_LIVE_REDUCERS = []  # weakrefs; drained by the Optimizer pre-step hook
+
+
+def _drain_live_reducers():
+    dead = []
+    for ref in _LIVE_REDUCERS:
+        r = ref()
+        if r is None:
+            dead.append(ref)
+        else:
+            # full flush, not just a wait: in barrier mode (or for hook
+            # stragglers) nothing has been issued yet, and step() promises
+            # the same drain as sync_gradients()
+            r.flush_and_drain()
+    for ref in dead:
+        _LIVE_REDUCERS.remove(ref)
+
+
+_hook_registered = [False]
+
+
+def _register_pre_step_hook():
+    if _hook_registered[0]:
+        return
+    from ..optimizer import optimizer as _opt_mod
+
+    _opt_mod.register_pre_step_hook(_drain_live_reducers)
+    _hook_registered[0] = True
+
+
+class _Reducer:
+    """Hook-driven bucket reducer (reference: EagerReducer, reducer.cc).
+
+    Owns the persistent bucket plan and the per-step issue/drain state.
+    ``shard_bound`` is set by :func:`sharded_update`; together with
+    ``FLAGS_dp_shard_update`` it switches the bucket collective from
+    allreduce-AVG (grads written straight back) to reduce-scatter-AVG (the
+    flat shard is kept for the sharded optimizer step)."""
+
+    def __init__(self, dp: "DataParallel"):
+        import weakref
+
+        self._dp = weakref.ref(dp)
+        self._group = dp._group
+        self._comm_mb = float(dp._comm_buffer_mb)
+        self._last_mb = float(dp._last_comm_buffer_mb)
+        self._plan: Optional[_Plan] = None
+        self._plan_cache: "OrderedDict[tuple, _Plan]" = OrderedDict()
+        self._outstanding: List[_Bucket] = []
+        self._exposed_s = 0.0
+        # set by the grad-final hooks, cleared by flush_and_drain: the
+        # pre-step auto-drain only issues when fresh grads arrived, so an
+        # explicit sync_gradients() followed by step() reduces once
+        self._dirty = False
+        self.shard_bound = False
+        self._handles = []
+        for p in dp._layers.parameters():
+            if not p.stop_gradient:
+                self._handles.append(p.register_grad_final_hook(self._on_grad_final))
+        _register_pre_step_hook()
+        _LIVE_REDUCERS.append(weakref.ref(self))
+
+    # -- plan ------------------------------------------------------------
+    def _trainable(self):
+        dp = self._dp()
+        if dp is None:
+            return []
+        return [p for p in dp._layers.parameters() if not p.stop_gradient]
+
+    def _ensure_plan(self) -> Optional[_Plan]:
+        if self._plan is not None:
+            return self._plan
+        params = self._trainable()
+        if not params:
+            return None
+        self._plan = _build_plan(params, self._group, self._comm_mb,
+                                 self._last_mb, _comm_dtype_name(),
+                                 cache=self._plan_cache)
+        return self._plan
+
+    def rebuild(self):
+        """Drop the cached plan (param set / comm dtype changed)."""
+        self._plan = None
+
+    def shard_active(self) -> bool:
+        return (self.shard_bound
+                and bool(flags.flag_value("dp_shard_update"))
+                and self._group is not None and self._group.nranks > 1)
+
+    def _sync_enabled(self) -> bool:
+        dp = self._dp()
+        return dp is not None and dp._sync_enabled
+
+    # -- hook-driven issue ----------------------------------------------
+    def _on_grad_final(self, t):
+        if not self._sync_enabled():
+            return
+        if self._group is None or self._group.nranks <= 1:
+            return
+        self._dirty = True
+        if not flags.flag_value("dp_overlap"):
+            return
+        plan = self._ensure_plan()
+        if plan is None:
+            return
+        b = plan.by_param.get(id(t))
+        if b is None or id(t) in b.ready:
+            return
+        b.ready.add(id(t))
+        if len(b.ready) == len(b.params) and all(
+                p._grad is not None for p in b.params):
+            self._issue(b)
+
+    def _issue(self, b: _Bucket):
+        """Pack the bucket and dispatch its collective asynchronously.
+        Called from inside run_backward (overlap) or from the drain flush
+        (barrier mode / stragglers)."""
+        g = self._group
+        shard = self.shard_active()
+        if b.pack is None:
+            b.pack = _make_pack(b)
+            _obs_emit("dp.pack_build", bucket=b.index)
+        flat = b.pack([p._grad for p in b.params])
+        _obs_emit("dp.pack_call", bucket=b.index)
+        fn = "reduce_scatter_avg" if shard else "all_reduce"
+        b.op = fn
+        b.t_issue = time.perf_counter()
+        kw = {} if shard else {"op": coll.ReduceOp.AVG}
+        rank = max(getattr(g, "rank", 0), 0)
+        with comm_task(f"dp:{fn}:bucket{b.index}", getattr(g, "id", 0),
+                       rank, (b.padded,), b.comm_dtype):
+            out, task = coll._run(g, fn, flat, **kw)
+        if shard:
+            mesh = getattr(g, "_mesh", None)
+            if (mesh is not None
+                    and tuple(getattr(out, "shape", ())) == (b.padded,)):
+                # single-controller replicated fallback returned the full
+                # reduced buffer: take ownership layout — each rank's shard
+                # of the flat buffer lands on its device (ZeRO-1 partition)
+                out = jax.device_put(
+                    out, NamedSharding(mesh, P(g.axis_name)))
+            b.flat_grad = out
+        else:
+            if b.unpack_grads is None:
+                b.unpack_grads = _make_unpack(b)
+                _obs_emit("dp.pack_build", bucket=b.index)
+            outs = b.unpack_grads(out)
+            _obs_emit("dp.pack_call", bucket=b.index)
+            for p, o in zip(b.params, outs):
+                p._grad = o
+        b.out_ref = out
+        b.task = task
+        b.issued = True
+        b.ready.clear()
+        self._outstanding.append(b)
+
+    # -- drain -----------------------------------------------------------
+    def flush_and_drain(self, force: bool = False):
+        """The sync point: issue anything not yet issued (barrier mode,
+        partially-ready buckets), then wait the outstanding Task handles and
+        publish the overlap-efficiency gauge.
+
+        Without ``force``, the issue pass only runs when grads arrived since
+        the last flush (``_dirty``) — the pre-step auto-drain after an
+        explicit ``sync_gradients()`` must wait, not re-reduce. ``force``
+        (the explicit ``sync_gradients()`` call) keeps legacy semantics:
+        every call reduces."""
+        if not self._sync_enabled():
+            return
+        g = self._group
+        if g is None or g.nranks <= 1:
+            return
+        if not (force or self._dirty):
+            self._wait_outstanding()
+            return
+        plan = self._ensure_plan()
+        if plan is None:
+            return
+        self._dirty = False
+        for b in plan.buckets:
+            if b.issued:
+                continue
+            ps = [p for p in b.params if p._grad is not None]
+            if not ps:
+                b.ready.clear()
+                continue
+            if len(ps) == len(b.params):
+                self._issue(b)
+            else:
+                # unused params this step: the flat layout doesn't apply;
+                # reduce the present subset via the legacy bucketed path
+                sync_param_grads(ps, g, self._comm_mb)
+                b.ready.clear()
+        self._wait_outstanding()
+
+    def _wait_outstanding(self):
+        if not self._outstanding:
+            return
+        exposed = 0.0
+        span = 0.0
+        t_drain = time.perf_counter()
+        for b in self._outstanding:
+            pre_ready = True
+            task = b.task
+            if task is not None:
+                try:
+                    pre_ready = bool(task.is_completed())
+                except Exception:  # noqa: BLE001 — absent/odd handle: wait
+                    pre_ready = False
+            w = async_engine.wait_for(
+                [b.out_ref] if b.out_ref is not None else [],
+                tag=f"dp_bucket{b.index}")
+            t_done = time.perf_counter()
+            if not pre_ready:
+                exposed += w
+            span += max(t_done - b.t_issue, 1e-9)
+            _obs_emit("dp.bucket_comm", dur_s=t_done - b.t_issue, op=b.op,
+                      bucket=b.index, bytes=b.nbytes,
+                      hidden=pre_ready)
+            b.task = None
+            b.out_ref = None
+            b.issued = False
+            b.ready.clear()
+        self._outstanding = []
+        eff = 1.0 - (exposed / span) if span > 0 else 1.0
+        eff = min(max(eff, 0.0), 1.0)
+        self._exposed_s = exposed
+        _obs_emit("dp.overlap", dur_s=time.perf_counter() - t_drain,
+                  efficiency=round(eff, 4))
+
+
 class DataParallel(Layer):
     """Reference: python/paddle/distributed/parallel.py:219."""
 
@@ -84,31 +563,26 @@ class DataParallel(Layer):
         self._layers = layers
         self._group = group or coll.get_group(0)
         self._comm_buffer_mb = comm_buffer_size_MB
+        self._last_comm_buffer_mb = last_comm_buffer_size_MB
         self.find_unused_parameters = find_unused_parameters
+        self._sync_enabled = True
         if self._group is not None and self._group.nranks > 1:
             sync_params_buffers(layers, self._group)
-        self._buckets = None
+        self._reducer = _Reducer(self)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     # -- reducer ---------------------------------------------------------
-    def _ensure_buckets(self):
-        if self._buckets is None:
-            ps = [p for p in self._layers.parameters() if not p.stop_gradient]
-            self._buckets = _bucket_params(ps, self._comm_buffer_mb)
-        return self._buckets
-
     def sync_gradients(self):
-        """Bucketed grad allreduce over the dp group (mean).
+        """Drain the hook-issued bucket collectives (and, in barrier mode
+        or for partially-ready buckets, issue them now).
 
-        Reference fires this from autograd hooks; here it runs post-backward
-        (the optimizer wrapper calls it) — same comm volume, XLA/PJRT still
-        overlaps buckets with each other via async dispatch.
-        """
-        sync_param_grads(
-            [p for p in self._layers.parameters() if not p.stop_gradient],
-            self._group, self._comm_buffer_mb)
+        Reference fires the collectives from autograd hooks; so do we (see
+        _Reducer._on_grad_final) — this call is the step-boundary drain, and
+        Optimizer.step() performs the same drain via its pre-step hook, so
+        explicit calls are optional."""
+        self._reducer.flush_and_drain(force=True)
 
     # -- Layer protocol passthrough -------------------------------------
     def parameters(self, include_sublayers=True):
@@ -132,19 +606,202 @@ class DataParallel(Layer):
         return super().eval()
 
     def no_sync(self):
-        """Context: skip grad sync (gradient accumulation)."""
+        """Context: skip grad sync (gradient accumulation). Suppresses the
+        hook-issued collectives too — grads accumulate locally and the next
+        synced backward reduces the k-step total (AVG is linear, so this
+        matches a k-step accumulated allreduce exactly)."""
         import contextlib
 
         @contextlib.contextmanager
         def ctx():
-            saved = self._group
-            self._group = None
+            self._sync_enabled = False
             try:
                 yield
             finally:
-                self._group = saved
+                self._sync_enabled = True
 
         return ctx()
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded update (FLAGS_dp_shard_update)
+# ---------------------------------------------------------------------------
+
+class ShardedUpdate:
+    """Optimizer wrapper running the cross-replica sharded weight update
+    (Xu et al. arXiv:2004.13336): reduce-scattered flat gradient shards feed
+    the fused buffer-donated optimizer step over flat pseudo-params (1/N
+    FLOPs and 1/N optimizer-state bytes per device), and the updated flat
+    buffers are all-gathered back to replicated per-param arrays.
+
+    Falls back to the replicated update (with a one-time warning) for
+    optimizers whose math is not elementwise over the flat buffer — Lamb
+    (per-param trust ratio), LBFGS (closure line search), AdamW with
+    ``apply_decay_param_fun`` (per-param name predicate) — and whenever a
+    grad_clip is configured (clipping needs per-param grads)."""
+
+    def __init__(self, optimizer, model: DataParallel,
+                 group: Optional[coll.Group] = None):
+        if not isinstance(model, DataParallel):
+            raise TypeError(
+                "sharded_update needs a DataParallel-wrapped model "
+                f"(got {type(model).__name__})")
+        self._opt = optimizer
+        self._model = model
+        self._reducer = model._reducer
+        self._group = group or model._group
+        self._warned = False
+        self._flat_ok = (
+            getattr(optimizer, "_flat_shardable", False)
+            and getattr(optimizer, "_grad_clip", None) is None
+            and getattr(optimizer, "_apply_decay_param_fun", None) is None)
+        self._reducer.shard_bound = self._flat_ok
+
+    # -- passthrough -----------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.__dict__["_opt"], name)
+
+    @property
+    def inner(self):
+        return self._opt
+
+    def _shard_on(self) -> bool:
+        return (bool(flags.flag_value("dp_shard_update"))
+                and self._group is not None and self._group.nranks > 1)
+
+    def step(self):
+        r = self._reducer
+        if not self._shard_on():
+            r.flush_and_drain()
+            return self._opt.step()
+        if not self._flat_ok:
+            if not self._warned:
+                self._warned = True
+                import warnings
+
+                warnings.warn(
+                    f"{type(self._opt).__name__} cannot run the flat-shard "
+                    "update (non-elementwise math or grad_clip/"
+                    "apply_decay_param_fun configured); falling back to the "
+                    "replicated update", stacklevel=2)
+            r.flush_and_drain()
+            return self._opt.step()
+        r.flush_and_drain()
+        plan = r._ensure_plan()
+        if plan is None:
+            return self._opt.step()
+        mesh = getattr(self._group, "_mesh", None)
+        axis = getattr(self._group, "axis_name", None)
+        shard_sh = (NamedSharding(mesh, P(axis)) if mesh is not None else None)
+        repl_sh = NamedSharding(mesh, P()) if mesh is not None else None
+        pseudo = []
+        leftover: List[Parameter] = []
+        for b in plan.buckets:
+            if b.flat_grad is None:
+                # bucket never reduce-scattered (e.g. sync ran while the
+                # shard flag was off, or legacy-path stragglers): pack the
+                # already-reduced per-param grads — pack(avg) == avg(pack)
+                if any(p._grad is None for p in b.params):
+                    # partially-used bucket (find_unused_parameters): its
+                    # present grads were reduced by the flush fallback —
+                    # step them replicated so no param misses its update
+                    leftover.extend(
+                        p for p in b.params if p._grad is not None)
+                    continue
+                if b.pack is None:
+                    b.pack = _make_pack(b)
+                fg = b.pack([p._grad for p in b.params])
+                if shard_sh is not None:
+                    fg = jax.device_put(fg, shard_sh)
+                b.flat_grad = fg
+            if b.flat_param is None or b.out_ids != [
+                    id(p._data) for p in b.params]:
+                if b.pack_params is None:
+                    b.pack_params = _make_pack_params(b, shard_sh)
+                    _obs_emit("dp.pack_build", bucket=b.index)
+                b.flat_param = b.pack_params([p._data for p in b.params])
+                _obs_emit("dp.pack_call", bucket=b.index)
+            if b.pseudo is None:
+                b.pseudo = Parameter.from_tensor(
+                    b.flat_param, name=f"_dp_flat_b{b.index}")
+                b.pseudo.optimize_attr = {"learning_rate": b.lr_mult}
+            b.pseudo._data = b.flat_param
+            # comm compression: the wire dtype may differ from the param
+            # dtype; the update math sees the param dtype (legacy parity)
+            fg = b.flat_grad
+            if str(fg.dtype) != b.dtype:
+                fg = fg.astype(np.dtype(b.dtype))
+            b.pseudo._grad = fg
+            pseudo.append(b)
+        if not pseudo and not leftover:
+            return self._opt.step()
+        saved = self._opt._parameter_list
+        self._opt._parameter_list = [b.pseudo for b in pseudo] + leftover
+        try:
+            self._opt.step()
+        finally:
+            self._opt._parameter_list = saved
+        # tiled all-gather of the updated flat shards back to per-param
+        # replicated arrays (one cached executable per bucket)
+        for b in pseudo:
+            b.flat_param = b.pseudo._data
+            if b.unpack_params is None:
+                b.unpack_params = _make_unpack(b, out_sharding=repl_sh)
+                _obs_emit("dp.pack_build", bucket=b.index)
+            outs = b.unpack_params(b.flat_param)
+            _obs_emit("dp.pack_call", bucket=b.index)
+            for p, o in zip(b.params, outs):
+                p._data = o
+            b.out_ids = [id(p._data) for p in b.params]
+            _obs_emit("dp.gather", bucket=b.index,
+                      bytes=b.padded * np.dtype(b.dtype).itemsize)
+            b.flat_grad = None
+            b.pseudo._grad = None
+        return None
+
+    def optimizer_state_bytes_per_device(self) -> int:
+        """Max optimizer-state bytes resident on any single device — the
+        1/N memory claim of the sharded update, measurable."""
+        per_dev: Dict[object, int] = {}
+        for store in self._opt._accumulators.values():
+            for a in store.values():
+                shards = getattr(a, "addressable_shards", None)
+                if shards:
+                    for s in shards:
+                        per_dev[s.device] = (per_dev.get(s.device, 0)
+                                             + int(s.data.nbytes))
+                else:
+                    per_dev[None] = per_dev.get(None, 0) + int(
+                        getattr(a, "nbytes", 0))
+        return max(per_dev.values()) if per_dev else 0
+
+    def clear_grad(self, set_to_zero=True):
+        self._opt.clear_grad(set_to_zero)
+        plan = self._reducer._plan
+        if plan is not None:
+            for b in plan.buckets:
+                b.flat_grad = None
+                if b.pseudo is not None:
+                    b.pseudo._grad = None
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._opt.set_state_dict(state)
+
+    load_state_dict = set_state_dict
+
+
+def sharded_update(optimizer, model: DataParallel,
+                   group: Optional[coll.Group] = None) -> ShardedUpdate:
+    """Bind ``optimizer`` to ``model``'s reducer for the ZeRO-1 sharded
+    weight update (active while ``FLAGS_dp_shard_update`` is on)."""
+    return ShardedUpdate(optimizer, model, group)
 
 
 def init_parallel_env():

@@ -165,6 +165,21 @@ _c_ckpt_loads = _C("paddle_ckpt_loads_total",
                    "CheckpointManager restores from disk")
 _c_preempt = _C("paddle_preemption_flushes_total",
                 "Final checkpoint flushes triggered by SIGTERM")
+_c_dp_comms = _C("paddle_dp_bucket_comms_total",
+                 "DataParallel bucket collectives issued, by op")
+_h_dp_comm = _H("paddle_dp_bucket_comm_seconds",
+                "Issue-to-ready duration of DP bucket collectives")
+_c_dp_reduced = _C("paddle_dp_bytes_reduced_total",
+                   "Gradient bytes reduced (comm dtype) by the DP reducer")
+_c_dp_gathered = _C("paddle_dp_bytes_gathered_total",
+                    "Updated-param bytes all-gathered by the sharded update")
+_g_dp_overlap = _G("paddle_dp_overlap_efficiency",
+                   "Fraction of DP comm time hidden under backward "
+                   "(1.0 = fully overlapped), last drain")
+_c_dp_packs = _C("paddle_dp_flat_pack_calls_total",
+                 "Cached flat pack/unpack executable invocations")
+_c_dp_builds = _C("paddle_dp_flat_pack_builds_total",
+                  "Bucket-plan/executable builds (steady state: constant)")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -276,6 +291,14 @@ _HANDLERS = {
     "ckpt.rollback": lambda d, f: _c_rollbacks.inc(),
     "ckpt.load": lambda d, f: _c_ckpt_loads.inc(),
     "ckpt.preempt": lambda d, f: _c_preempt.inc(),
+    "dp.bucket_comm": lambda d, f: (
+        _c_dp_comms.inc(labels={"op": f.get("op", "")}),
+        _c_dp_reduced.inc(f.get("bytes", 0)),
+        _h_dp_comm.observe(d) if d is not None else None),
+    "dp.gather": lambda d, f: _c_dp_gathered.inc(f.get("bytes", 0)),
+    "dp.overlap": lambda d, f: _g_dp_overlap.set(f.get("efficiency", 0.0)),
+    "dp.pack_call": lambda d, f: _c_dp_packs.inc(),
+    "dp.pack_build": lambda d, f: _c_dp_builds.inc(),
     "enforce.error": lambda d, f: _c_enf.inc(
         labels={"type": f.get("type", "")}),
     "distress.dump": lambda d, f: _c_dumps.inc(
@@ -333,6 +356,11 @@ def summary() -> dict:
         "fetch_stall_p99_s": round(_h_stall.percentile(99), 6),
         "backpressure_waits": int(_c_bp.value()),
         "max_inflight_depth": int(_g_maxdepth.value()),
+        "dp_bucket_comms": int(_c_dp_comms.value()),
+        "dp_bytes_reduced": int(_c_dp_reduced.value()),
+        "dp_bytes_gathered": int(_c_dp_gathered.value()),
+        "dp_overlap_efficiency": round(float(_g_dp_overlap.value()), 4),
+        "dp_flat_pack_builds": int(_c_dp_builds.value()),
         "events_recorded": _recorder.written(),
     }
 

@@ -35,7 +35,28 @@ class _ParamProxy:
         self.optimize_attr = {"learning_rate": lr_mult}
 
 
+# Pre-step hooks: callables fired at the top of every Optimizer.step().
+# The DataParallel reducer registers its drain here, so outstanding hook-
+# issued bucket collectives are waited on exactly at the step boundary
+# instead of a post-backward barrier. Registration is module-global and
+# idempotent by function identity.
+_pre_step_hooks: List = []
+
+
+def register_pre_step_hook(fn):
+    if fn not in _pre_step_hooks:
+        _pre_step_hooks.append(fn)
+    return fn
+
+
 class Optimizer:
+    # Whether the math in _update is elementwise over the flat parameter
+    # buffer — the condition for the ZeRO-1 sharded update (each rank may
+    # update only its contiguous shard). Lamb's per-PARAM trust ratio and
+    # LBFGS's closure-driven line search are not; they fall back to the
+    # replicated update under FLAGS_dp_shard_update.
+    _flat_shardable = True
+
     def __init__(
         self,
         learning_rate=0.001,
@@ -128,6 +149,8 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        for hook in _pre_step_hooks:
+            hook()
         pg = self._collect_params_grads()
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)
@@ -421,6 +444,9 @@ class Adamax(Optimizer):
 class Lamb(Optimizer):
     """Reference: python/paddle/optimizer/lamb.py."""
 
+    # trust ratio is a per-PARAMETER norm — wrong over a fused flat buffer
+    _flat_shardable = False
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
                  name=None):
@@ -458,6 +484,8 @@ class LBFGS(Optimizer):
     fixed-step line search. All state is host-driven (L-BFGS is inherently
     sequential); the closure's forward/backward is the compiled part.
     """
+
+    _flat_shardable = False  # closure-driven line search over real params
 
     def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
                  tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,

@@ -1,0 +1,471 @@
+"""Overlapped DP gradient sync + ZeRO-1 sharded update (parallel.py reducer).
+
+Covers the three pillars of the rebuilt data-parallel hot path on the
+8-virtual-device CPU mesh (conftest.py):
+
+- overlap: grad-final hooks issue each bucket's collective during backward;
+  step() drains Task handles instead of running a post-backward barrier
+- sharded update (FLAGS_dp_shard_update, ZeRO-1): reduce-scattered flat grad
+  shards + fused optimizer step on the owned shard + all-gather back, bit
+  exact vs the replicated path for every optimizer
+- caching: persistent bucket plan + jitted flat pack/unpack executables,
+  zero rebuilds in steady state
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _env():
+    os.environ["PADDLE_TRAINERS_NUM"] = "8"
+    dist.collective.destroy_process_group()
+    dist.init_parallel_env()
+    yield
+    os.environ.pop("PADDLE_TRAINERS_NUM", None)
+    dist.collective.destroy_process_group()
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    flags.set_flags({"dp_overlap": True, "dp_shard_update": False,
+                     "dp_grad_comm_dtype": "", "chaos_spec": "",
+                     "comm_timeout": 0.0, "watchdog_policy": "",
+                     "comm_watchdog_abort": False})
+
+
+def _metric(name, labels=None):
+    return obs.registry().value(name, labels or {})
+
+
+class _MLP(nn.Layer):
+    def __init__(self, din=8, dhid=16, dout=4):
+        super().__init__()
+        self.l1 = nn.Linear(din, dhid)
+        self.l2 = nn.Linear(dhid, dout)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.l2(F.relu(self.l1(x)))
+
+
+def _train(opt_cls, shard, steps=3, group=None, seed=7, accumulate=0,
+           okw=None, comm_mb=25, last_mb=1):
+    """One training run; returns (final param arrays, wrapper, dp)."""
+    flags.set_flags({"dp_shard_update": shard})
+    paddle.seed(seed)
+    m = _MLP()
+    d = dist.DataParallel(m, group=group or dist.get_group(0),
+                          comm_buffer_size_MB=comm_mb,
+                          last_comm_buffer_size_MB=last_mb)
+    o = opt_cls(learning_rate=0.05, parameters=m.parameters(), **(okw or {}))
+    so = dist.sharded_update(o, d) if shard else o
+    for i in range(steps):
+        x = paddle.to_tensor(
+            np.random.RandomState(i).randn(8, 8).astype(np.float32))
+        if accumulate:
+            with d.no_sync():
+                for j in range(accumulate):
+                    xa = paddle.to_tensor(np.random.RandomState(100 + i * 10 + j)
+                                          .randn(8, 8).astype(np.float32))
+                    d(xa).mean().backward()
+        d(x).mean().backward()
+        so.step()
+        so.clear_grad()
+    flags.set_flags({"dp_shard_update": False})
+    return [np.asarray(p._data) for p in m.parameters()], so, d
+
+
+# the 13 optimizers whose sharded update must match the replicated path
+# bit for bit (Lamb goes through the documented replicated fallback)
+PARITY_OPTIMIZERS = [opt.SGD, opt.Momentum, opt.Adam, opt.AdamW, opt.Adagrad,
+                     opt.RMSProp, opt.Adadelta, opt.Adamax, opt.Lamb,
+                     opt.ASGD, opt.NAdam, opt.RAdam, opt.Rprop]
+
+
+class TestShardedUpdateParity:
+    @pytest.mark.parametrize(
+        "opt_cls", PARITY_OPTIMIZERS, ids=lambda c: c.__name__)
+    def test_bit_exact_vs_replicated(self, opt_cls, recwarn):
+        w_ref, _, _ = _train(opt_cls, shard=False)
+        w_sh, _, _ = _train(opt_cls, shard=True)
+        for i, (a, b) in enumerate(zip(w_ref, w_sh)):
+            assert np.array_equal(a, b), (
+                f"{opt_cls.__name__} param {i}: "
+                f"maxdiff {np.max(np.abs(a - b))}")
+
+    @pytest.mark.parametrize("nranks", [2, 4])
+    def test_parity_on_subgroup(self, nranks):
+        g = dist.new_group(list(range(nranks)))
+        assert g.nranks == nranks
+        w_ref, _, _ = _train(opt.Adam, shard=False, group=g)
+        w_sh, _, _ = _train(opt.Adam, shard=True, group=g)
+        for a, b in zip(w_ref, w_sh):
+            assert np.array_equal(a, b)
+
+    def test_lamb_falls_back_with_one_warning(self):
+        with pytest.warns(UserWarning, match="flat-shard"):
+            _, so, _ = _train(opt.Lamb, shard=True)
+        assert so._flat_ok is False
+
+    def test_optimizer_state_is_sharded(self):
+        _, so_ref, _ = _train(opt.Adam, shard=False)
+        _, so, _ = _train(opt.Adam, shard=True)
+        sharded_bytes = so.optimizer_state_bytes_per_device()
+        # replicated: every device holds the full moment1+moment2
+        full_bytes = sum(
+            int(getattr(a, "nbytes", 0))
+            for store in so_ref._accumulators.values()
+            for a in store.values())
+        assert 0 < sharded_bytes < full_bytes
+        # flat pseudo-param accumulators, one pair per bucket
+        keys = sorted(so.state_dict().keys())
+        assert any(k.startswith("_dp_flat_b") for k in keys)
+
+    def test_state_dict_roundtrip_under_sharding(self):
+        flags.set_flags({"dp_shard_update": True})
+        g = dist.get_group(0)
+
+        def run(steps, state=None):
+            paddle.seed(11)
+            m = _MLP()
+            d = dist.DataParallel(m, group=g)
+            o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
+            so = dist.sharded_update(o, d)
+            for i in range(steps):
+                x = paddle.to_tensor(
+                    np.random.RandomState(i).randn(8, 8).astype(np.float32))
+                d(x).mean().backward()
+                if state is not None and i == 2:
+                    so.set_state_dict(state)
+                so.step()
+                so.clear_grad()
+            return [np.asarray(p._data) for p in m.parameters()], so
+
+        w_full, so = run(4)
+        sd = so.state_dict()
+        # round-trip: loading the snapshot reproduces the same trajectory
+        np_sd = {k: np.asarray(v) for k, v in sd.items()
+                 if not np.isscalar(v) and hasattr(v, "shape")}
+        w_again, so2 = run(4)
+        sd2 = so2.state_dict()
+        assert sorted(sd.keys()) == sorted(sd2.keys())
+        for k, v in np_sd.items():
+            assert np.array_equal(v, np.asarray(sd2[k])), k
+        for a, b in zip(w_full, w_again):
+            assert np.array_equal(a, b)
+
+
+class TestOverlap:
+    def test_hooks_issue_during_backward(self):
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        d(x).mean().backward()
+        # collectives were issued from grad-final hooks, before any explicit
+        # sync: the Task handles are outstanding right after backward
+        assert d._reducer._outstanding
+        d.sync_gradients()
+        assert not d._reducer._outstanding
+
+    def test_barrier_mode_issues_at_sync(self):
+        flags.set_flags({"dp_overlap": False})
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        d(x).mean().backward()
+        assert not d._reducer._outstanding
+        d.sync_gradients()
+        assert m.l1.weight._grad is not None
+        flags.set_flags({"dp_overlap": True})
+
+    def test_overlap_matches_barrier(self):
+        w_overlap, _, _ = _train(opt.Adam, shard=False)
+        flags.set_flags({"dp_overlap": False})
+        try:
+            w_barrier, _, _ = _train(opt.Adam, shard=False)
+        finally:
+            flags.set_flags({"dp_overlap": True})
+        for a, b in zip(w_overlap, w_barrier):
+            assert np.array_equal(a, b)
+
+    def test_step_drains_without_explicit_sync(self):
+        """Optimizer.step's pre-step hook is the drain; no sync_gradients."""
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        before = [np.asarray(p._data).copy() for p in m.parameters()]
+        d(paddle.to_tensor(np.ones((4, 8), np.float32))).mean().backward()
+        o.step()
+        assert not d._reducer._outstanding
+        after = [np.asarray(p._data) for p in m.parameters()]
+        assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+
+    def test_overlap_efficiency_gauge_published(self):
+        _train(opt.SGD, shard=False, steps=2)
+        s = obs.summary()
+        assert 0.0 <= s["dp_overlap_efficiency"] <= 1.0
+        assert s["dp_bytes_reduced"] > 0
+
+
+class TestNoSync:
+    def test_no_sync_suppresses_hook_collectives(self):
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        before = _metric("paddle_dp_bucket_comms_total",
+                         {"op": "all_reduce"})
+        with d.no_sync():
+            d(paddle.to_tensor(np.ones((4, 8), np.float32))).mean().backward()
+            assert not d._reducer._outstanding
+            d.sync_gradients()  # also suppressed inside the context
+        assert _metric("paddle_dp_bucket_comms_total",
+                       {"op": "all_reduce"}) == before
+
+    def test_accumulation_parity(self):
+        # k accumulated backwards under no_sync + one synced backward must
+        # match the same schedule on the sharded path bit for bit (AVG is
+        # linear, so reducing the k-step total is exact)
+        w_ref, _, _ = _train(opt.Momentum, shard=False, accumulate=2)
+        w_sh, _, _ = _train(opt.Momentum, shard=True, accumulate=2)
+        for a, b in zip(w_ref, w_sh):
+            assert np.array_equal(a, b)
+
+
+class TestStepDrain:
+    def test_barrier_mode_step_issues_reduction(self):
+        """Vanilla backward(); step() with FLAGS_dp_overlap=0 must reduce:
+        the pre-step hook issues the unissued buckets, not just wait."""
+        flags.set_flags({"dp_overlap": False})
+        try:
+            paddle.seed(3)
+            m = _MLP()
+            d = dist.DataParallel(m)
+            o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+            before = _metric("paddle_dp_bucket_comms_total",
+                             {"op": "all_reduce"})
+            d(paddle.to_tensor(np.ones((4, 8), np.float32))).mean().backward()
+            assert not d._reducer._outstanding  # nothing issued in backward
+            o.step()
+            assert _metric("paddle_dp_bucket_comms_total",
+                           {"op": "all_reduce"}) > before
+        finally:
+            flags.set_flags({"dp_overlap": True})
+
+    def test_explicit_sync_then_step_reduces_once(self):
+        """sync_gradients() followed by step() must not re-issue the
+        bucket collectives from the pre-step drain."""
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        d(paddle.to_tensor(np.ones((4, 8), np.float32))).mean().backward()
+        d.sync_gradients()
+        after_sync = _metric("paddle_dp_bucket_comms_total",
+                             {"op": "all_reduce"})
+        o.step()
+        assert _metric("paddle_dp_bucket_comms_total",
+                       {"op": "all_reduce"}) == after_sync
+
+
+class TestPartialBuckets:
+    """Partially-used buckets (find_unused_parameters-style steps where
+    only a sub-path of the model ran backward)."""
+
+    def _partial_backward(self, m):
+        # only l1 participates: l2's params never get grads this step
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        F.relu(m.l1(x)).mean().backward()
+
+    def test_fallback_clears_ready_state(self):
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m, find_unused_parameters=True)
+        self._partial_backward(m)
+        d.sync_gradients()
+        plan = d._reducer._ensure_plan()
+        # no stale per-step state may survive the fallback reduction
+        for b in plan.buckets:
+            assert not b.ready and not b.issued
+        # a following full step is unaffected by the partial one
+        d(paddle.to_tensor(np.ones((4, 8), np.float32))).mean().backward()
+        d.sync_gradients()
+        for p in m.parameters():
+            assert p._grad is not None
+        for b in plan.buckets:
+            assert not b.ready and not b.issued
+
+    def test_sharded_partial_bucket_params_still_step(self):
+        """Under FLAGS_dp_shard_update, params WITH grads in a
+        partially-used bucket get their optimizer update (replicated),
+        matching what the legacy replicated path does."""
+        flags.set_flags({"dp_shard_update": True})
+        try:
+            paddle.seed(3)
+            m = _MLP()
+            d = dist.DataParallel(m, find_unused_parameters=True)
+            o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+            so = dist.sharded_update(o, d)
+            l1_before = [np.asarray(p._data).copy()
+                         for p in (m.l1.weight, m.l1.bias)]
+            l2_before = [np.asarray(p._data).copy()
+                         for p in (m.l2.weight, m.l2.bias)]
+            self._partial_backward(m)
+            so.step()
+            l1_after = [np.asarray(p._data)
+                        for p in (m.l1.weight, m.l1.bias)]
+            l2_after = [np.asarray(p._data)
+                       for p in (m.l2.weight, m.l2.bias)]
+            assert any(not np.array_equal(a, b)
+                       for a, b in zip(l1_before, l1_after))
+            for a, b in zip(l2_before, l2_after):
+                assert np.array_equal(a, b)
+        finally:
+            flags.set_flags({"dp_shard_update": False})
+
+
+class TestBucketLayout:
+    def test_comm_buffer_size_honored(self):
+        paddle.seed(5)
+        m = nn.Sequential(*[nn.Linear(64, 64) for _ in range(4)])
+        # 64*64 fp32 weights = 16 KiB each; a 0.02 MB cap forces multiple
+        # buckets, each within the cap (down to single-param granularity)
+        d = dist.DataParallel(m, comm_buffer_size_MB=0.02,
+                              last_comm_buffer_size_MB=0.001)
+        plan = d._reducer._ensure_plan()
+        assert len(plan.buckets) >= 4
+        cap = int(0.02 * 1024 * 1024)
+        for b in plan.buckets:
+            assert b.numel * np.dtype(b.dtype).itemsize <= max(
+                cap, max(b.sizes) * np.dtype(b.dtype).itemsize)
+        # every trainable param is in exactly one bucket
+        counted = [id(p) for b in plan.buckets for p in b.params]
+        assert sorted(counted) == sorted(
+            id(p) for p in m.parameters() if not p.stop_gradient)
+
+    def test_last_comm_buffer_tail_split(self):
+        paddle.seed(5)
+        m = nn.Sequential(*[nn.Linear(64, 64) for _ in range(4)])
+        # everything fits one 1 MB bucket; the 0.02 MB tail cap splits off a
+        # small straggler bucket holding the FIRST layer's params — the last
+        # grads to become final in backward, flushed without waiting for a
+        # full-size buffer (reference last_comm_buffer_size_MB semantics)
+        d = dist.DataParallel(m, comm_buffer_size_MB=1,
+                              last_comm_buffer_size_MB=0.02)
+        plan = d._reducer._ensure_plan()
+        assert len(plan.buckets) == 2
+        tail = plan.buckets[-1]
+        assert tail.numel * np.dtype(tail.dtype).itemsize <= int(
+            0.02 * 1024 * 1024)
+        first_layer = {id(m[0].weight), id(m[0].bias)}
+        assert first_layer == {id(p) for p in tail.params}
+
+    def test_dead_prebucket_api_removed(self):
+        d = dist.DataParallel(_MLP())
+        assert not hasattr(d, "_ensure_buckets")
+        assert not hasattr(d, "_buckets")
+
+    def test_zero_rebuild_steady_state(self):
+        flags.set_flags({"dp_shard_update": True})
+        paddle.seed(9)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
+        so = dist.sharded_update(o, d)
+
+        def step(i):
+            x = paddle.to_tensor(
+                np.random.RandomState(i).randn(8, 8).astype(np.float32))
+            d(x).mean().backward()
+            so.step()
+            so.clear_grad()
+
+        step(0)
+        step(1)  # warm: plan built, executables traced, fused jit built
+        builds = _metric("paddle_dp_flat_pack_builds_total")
+        calls = _metric("paddle_dp_flat_pack_calls_total")
+        for i in range(2, 5):
+            step(i)
+        assert _metric("paddle_dp_flat_pack_builds_total") == builds
+        assert _metric("paddle_dp_flat_pack_calls_total") > calls
+        flags.set_flags({"dp_shard_update": False})
+
+
+class TestCommDtype:
+    def test_bf16_wire_dtype(self):
+        w_ref, _, _ = _train(opt.SGD, shard=False, steps=2)
+        flags.set_flags({"dp_grad_comm_dtype": "bf16"})
+        try:
+            before = _metric("paddle_dp_bytes_reduced_total")
+            w_bf, _, d = _train(opt.SGD, shard=True, steps=2)
+            reduced = _metric("paddle_dp_bytes_reduced_total") - before
+        finally:
+            flags.set_flags({"dp_grad_comm_dtype": ""})
+        # params stay fp32; update approximates the fp32 trajectory
+        for a, b in zip(w_ref, w_bf):
+            assert str(b.dtype) == "float32"
+            assert np.allclose(a, b, atol=5e-2)
+        # the wire moved 2-byte elements: per step, sum of padded*2 bytes
+        plan = d._reducer._ensure_plan()
+        per_step = sum(b.padded * 2 for b in plan.buckets)
+        assert reduced == 2 * per_step
+
+    def test_bad_comm_dtype_rejected(self):
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        try:
+            paddle.seed(3)
+            d = dist.DataParallel(_MLP())
+            with pytest.raises(ValueError, match="dp_grad_comm_dtype"):
+                d(paddle.to_tensor(
+                    np.ones((4, 8), np.float32))).mean().backward()
+        finally:
+            flags.set_flags({"dp_grad_comm_dtype": ""})
+
+
+class TestChaosDrill:
+    def test_watchdog_names_inflight_bucket(self, capfd):
+        """Kill one bucket's collective mid-backward: the chaos hook hangs
+        the reduce-scatter inside the armed comm_task past the watchdog
+        timeout; the warn escalation must name the bucket op."""
+        flags.set_flags({"chaos_spec":
+                         "collective:hang@op=reduce_scatter_avg;delay=1.0",
+                         "comm_timeout": 0.3,
+                         "watchdog_policy": "warn",
+                         "comm_watchdog_abort": False,
+                         "dp_shard_update": True})
+        try:
+            before = _metric("paddle_watchdog_escalations_total",
+                             {"stage": "warn"})
+            paddle.seed(3)
+            m = _MLP()
+            d = dist.DataParallel(m)
+            o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+            so = dist.sharded_update(o, d)
+            d(paddle.to_tensor(
+                np.ones((4, 8), np.float32))).mean().backward()
+            so.step()
+            assert _metric("paddle_watchdog_escalations_total",
+                           {"stage": "warn"}) >= before + 1
+            err = capfd.readouterr().err
+            assert "stage=warn" in err
+            # the escalation names the exact in-flight bucket collective
+            assert "dp:reduce_scatter_avg:bucket0" in err
+        finally:
+            flags.set_flags({"chaos_spec": "", "comm_timeout": 0.0,
+                             "watchdog_policy": "",
+                             "dp_shard_update": False})

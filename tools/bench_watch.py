@@ -175,6 +175,49 @@ def maybe_chaos_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/chaos_smoke.py)")
 
 
+_last_dp_smoke = [0.0]
+
+
+def maybe_dp_overlap_smoke(min_interval: float = 3600.0) -> None:
+    """Run the DP overlap/sharding smoke (tools/dp_overlap_smoke.py) at most
+    once per min_interval and log a RED line on regression — overlap
+    efficiency falling through the floor, parity breakage, or the hooks no
+    longer issuing collectives during backward are build-signal the same way
+    the perf floor is."""
+    now = time.monotonic()
+    if _last_dp_smoke[0] and now - _last_dp_smoke[0] < min_interval:
+        return
+    _last_dp_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "dp_overlap_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: dp overlap smoke hung >600s — DP gradient sync broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"dp overlap smoke GREEN ({payload.get('wall_s')}s: "
+            f"barrier={payload.get('barrier_ms')}ms "
+            f"overlap={payload.get('overlap_ms')}ms "
+            f"shard={payload.get('shard_ms')}ms "
+            f"eff={payload.get('overlap_efficiency')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: dp overlap smoke regression rc={out.returncode} — {detail} "
+        f"(tools/dp_overlap_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -279,6 +322,7 @@ def main() -> None:
         sys.exit(capture())
     if args.once:
         maybe_chaos_smoke()
+        maybe_dp_overlap_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -286,6 +330,7 @@ def main() -> None:
     while True:
         try:
             maybe_chaos_smoke()
+            maybe_dp_overlap_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing

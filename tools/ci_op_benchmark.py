@@ -135,6 +135,24 @@ def _basket():
         finally:
             _flags.set_flags({"eager_dispatch_cache": True})
 
+    # DP flat-pack: the reducer's cached jitted pack executable (steady
+    # state) vs tracing a fresh one every call (what each step paid before
+    # the signature-keyed plan cache)
+    from paddle_tpu.core.tensor import Parameter
+    from paddle_tpu.distributed import parallel as _par
+
+    pack_ps = [Parameter.from_tensor(
+        Tensor(jnp.asarray(RS.randn(64, 64).astype(np.float32))),
+        name=f"_ci_pack_{i}") for i in range(4)]
+    pack_bucket = _par._Bucket(0, pack_ps, nranks=1, comm_dtype=None)
+    pack_bucket.pack = _par._make_pack(pack_bucket)
+    pack_arrs = [p._data for p in pack_ps]
+    pack_bucket.pack(pack_arrs)  # trace once outside the clock
+
+    def _pack_uncached():
+        b = _par._Bucket(0, pack_ps, nranks=1, comm_dtype=None)
+        return _par._make_pack(b)(pack_arrs)
+
     # eager entries run the PUBLIC api (dispatch + tape), not raw kernels;
     # they are marked so measure() skips jitting them
     eager = {
@@ -142,6 +160,8 @@ def _basket():
         "eager_dispatch_add_grad": lambda: OPS["add"](
             t_tiny_g, t_tiny_g)._data,
         "eager_dispatch_add_uncached": _add_uncached,
+        "dp_flat_pack_cached": lambda: pack_bucket.pack(pack_arrs),
+        "dp_flat_pack_uncached": _pack_uncached,
     }
     jitted = {
         "matmul_256": lambda: K["matmul"](a, b),

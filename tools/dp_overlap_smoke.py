@@ -1,0 +1,136 @@
+"""DP overlap/sharding smoke: barrier vs overlap vs sharded step time on the
+8-virtual-device CPU mesh. Prints ONE JSON line; exit 0 iff ok.
+
+The drill behind bench_watch's RED line for the data-parallel hot path:
+- parity: overlapped and sharded updates must match the barrier baseline
+- overlap: grad collectives issue from backward hooks (Task handles
+  outstanding before the drain) and the overlap-efficiency gauge holds
+- sharding: optimizer state is 1/N per device under FLAGS_dp_shard_update
+
+Timing ratios on a CPU host are noisy, so `ok` gates on correctness and the
+efficiency floor; the ms numbers are reported for trend logging only.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+N_DEV = 8
+os.environ["JAX_PLATFORMS"] = "cpu"
+flag = f"--xla_force_host_platform_device_count={N_DEV}"
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " " + flag).strip()
+
+import numpy as np  # noqa: E402
+
+EFFICIENCY_FLOOR = 0.5  # CPU fallback collectives are cheap; a healthy
+                        # overlap drain hides nearly all of the wait
+
+
+def _median_step_ms(d, so, steps=6):
+    import paddle_tpu as paddle
+
+    times = []
+    for i in range(steps):
+        x = paddle.to_tensor(
+            np.random.RandomState(i).randn(16, 64).astype(np.float32))
+        t0 = time.perf_counter()
+        d(x).mean().backward()
+        so.step()
+        so.clear_grad()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times[1:]) * 1e3
+
+
+def run() -> dict:
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import observability as obs
+    from paddle_tpu.core import flags
+
+    os.environ["PADDLE_TRAINERS_NUM"] = str(N_DEV)
+    dist.init_parallel_env()
+    g = dist.get_group(0)
+    assert g is not None and g.nranks == N_DEV, "8-rank group unavailable"
+
+    def build():
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                             nn.Linear(128, 64), nn.ReLU(),
+                             nn.Linear(64, 8))
+
+    def train(overlap, shard):
+        flags.set_flags({"dp_overlap": overlap, "dp_shard_update": shard})
+        m = build()
+        d = dist.DataParallel(m, group=g)
+        o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+        so = dist.sharded_update(o, d) if shard else o
+        ms = _median_step_ms(d, so)
+        w = [np.asarray(p._data) for p in m.parameters()]
+        return ms, w, d, so
+
+    barrier_ms, w_barrier, _, _ = train(False, False)
+    overlap_ms, w_overlap, d_ov, _ = train(True, False)
+    # hook issue evidence: one extra backward with no drain yet
+    d_ov(paddle.to_tensor(np.ones((4, 64), np.float32))).mean().backward()
+    issued_in_backward = bool(d_ov._reducer._outstanding)
+    d_ov.sync_gradients()
+    shard_ms, w_shard, _, so = train(True, True)
+    opt_bytes = so.optimizer_state_bytes_per_device()
+    eff = obs.summary().get("dp_overlap_efficiency", 0.0)
+    flags.set_flags({"dp_overlap": True, "dp_shard_update": False})
+
+    parity_overlap = all(np.array_equal(a, b)
+                         for a, b in zip(w_barrier, w_overlap))
+    parity_shard = all(np.array_equal(a, b)
+                       for a, b in zip(w_barrier, w_shard))
+    full_bytes = sum(
+        int(getattr(a, "nbytes", 0))
+        for store in so.inner._accumulators.values()
+        for a in store.values())
+    checks = {
+        "parity_overlap": parity_overlap,
+        "parity_shard": parity_shard,
+        "hooks_issue_in_backward": issued_in_backward,
+        "overlap_efficiency_floor": bool(eff >= EFFICIENCY_FLOOR),
+        "opt_state_sharded": bool(0 < opt_bytes < full_bytes),
+    }
+    return {
+        "ok": all(checks.values()),
+        "checks": checks,
+        "barrier_ms": round(barrier_ms, 3),
+        "overlap_ms": round(overlap_ms, 3),
+        "shard_ms": round(shard_ms, 3),
+        "ratio": round(overlap_ms / barrier_ms, 3) if barrier_ms else None,
+        "overlap_efficiency": eff,
+        "opt_state_bytes_per_dev": opt_bytes,
+        "devices": len(jax.devices()),
+    }
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    try:
+        payload = run()
+    except Exception as e:  # noqa: BLE001 — the artifact must exist
+        payload = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                   "trace": traceback.format_exc()[-800:]}
+    payload["wall_s"] = round(time.perf_counter() - t0, 1)
+    print(json.dumps(payload))
+    return 0 if payload.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
