@@ -28,6 +28,10 @@ one pointer check on the hot paths):
 - ``fetch`` — ``stall`` (sleep ``delay=`` s inside scalar_fetch).
 - ``save`` — ``crash`` (``os._exit(137)`` mid-write: the kill -9
   atomicity drill).
+- ``serving`` — ``stall`` (sleep ``delay=`` s before the paged engine's
+  fused step, driving in-flight requests past their deadlines so the
+  deadline/shed path fires), ``reject`` (raise the engine's
+  ``RejectedError`` load-shed signal at the step choke point).
 
 Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``,
 ``step=<int>`` (the value of the chaos step clock — ticked by
@@ -69,13 +73,14 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
     retryable error class the collective retry wrapper backs off on."""
 
 
-_SITES = ("collective", "store", "dispatch", "fetch", "save")
+_SITES = ("collective", "store", "dispatch", "fetch", "save", "serving")
 _KINDS = {
     "collective": ("delay", "timeout", "hang"),
     "store": ("drop", "garble", "delay"),
     "dispatch": ("nan", "inf"),
     "fetch": ("stall",),
     "save": ("crash",),
+    "serving": ("stall", "reject"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
@@ -264,6 +269,23 @@ def _fetch_hook(tag: str):
         time.sleep(inj.delay)
 
 
+def _serving_hook(phase: str):
+    """Called by PagedServingEngine.step per tick: 'stall' sleeps before
+    the fused step (drives requests past their deadlines so the shed path
+    is exercised); 'reject' raises the engine's load-shed error."""
+    inj = _match("serving", op=phase)
+    if inj is None:
+        return
+    if inj.kind == "stall":
+        time.sleep(inj.delay)
+        return
+    from ...inference.serving.scheduler import RejectedError
+
+    raise RejectedError(
+        f"[chaos] injected serving rejection: phase={phase} "
+        f"step={_STEP[0]}")
+
+
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
     process (the kill -9 atomicity drill)."""
@@ -289,6 +311,9 @@ def _install():
     collective.set_chaos_hook(_collective_hook)
     store.set_chaos_hook(_store_hook)
     async_engine.set_chaos_hook(_fetch_hook)
+    from ...inference.serving import engine as serving_engine
+
+    serving_engine.set_chaos_hook(_serving_hook)
     _installed[0] = True
 
 
@@ -303,6 +328,9 @@ def _uninstall():
     collective.set_chaos_hook(None)
     store.set_chaos_hook(None)
     async_engine.set_chaos_hook(None)
+    from ...inference.serving import engine as serving_engine
+
+    serving_engine.set_chaos_hook(None)
     _installed[0] = False
 
 

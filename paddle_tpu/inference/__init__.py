@@ -23,10 +23,13 @@ from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "LLMPredictor", "init_cache", "ServingEngine",
-           "Request", "Completion"]
+           "Request", "Completion", "PagedServingEngine", "TokenEvent",
+           "BlockManager", "RejectedError"]
 
 from .llm import LLMPredictor, init_cache  # noqa: E402,F401
-from .serving import Completion, Request, ServingEngine  # noqa: E402,F401
+from .serving import (BlockManager, Completion,  # noqa: E402,F401
+                      PagedServingEngine, RejectedError, Request,
+                      ServingEngine, TokenEvent)
 
 
 class PrecisionType:

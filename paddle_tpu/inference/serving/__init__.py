@@ -1,0 +1,26 @@
+"""LLM serving subsystem.
+
+Two engines share this package:
+
+- :class:`PagedServingEngine` (``engine.py``) — the production path: a
+  paged KV block pool with prefix caching (``block_manager.py``), a
+  continuous-batching scheduler with chunked prefill, preemption,
+  deadlines and load shedding (``scheduler.py``), and one jitted
+  fixed-shape mixed prefill+decode step over
+  ``block_multihead_attention_`` with streaming token delivery;
+- :class:`ServingEngine` (``slot_engine.py``) — the dense per-slot
+  baseline the smoke gate compares against.
+
+Both report SLO metrics through ``observability.summary()["serving"]``.
+"""
+from .block_manager import BlockManager, NoFreeBlocksError
+from .engine import PagedServingEngine, TokenEvent
+from .scheduler import RejectedError, ScheduledBatch, Scheduler, Sequence
+from .slot_engine import Completion, Request, ServingEngine
+
+__all__ = [
+    "BlockManager", "NoFreeBlocksError",
+    "PagedServingEngine", "TokenEvent",
+    "RejectedError", "ScheduledBatch", "Scheduler", "Sequence",
+    "Completion", "Request", "ServingEngine",
+]

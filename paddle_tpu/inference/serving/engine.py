@@ -1,0 +1,470 @@
+"""Paged-KV continuous-batching serving engine.
+
+The integration layer the block manager (memory), scheduler (policy) and
+`block_multihead_attention_` (compute) were built toward: ONE jitted
+fixed-shape program serves every step of a mixed prefill+decode batch.
+
+TPU-native shape (the MPK argument, PAPERS.md arxiv 2512.22219): instead
+of per-request kernel launches over ragged inputs, every scheduler tick
+packs its chunk mix into a `[token_budget]` token vector + `[max_batch]`
+length/table rows and runs the SAME compiled executable — prefill chunks,
+decode steps and any blend of the two share one signature, so the steady
+state performs **zero retraces** (executables are cached keyed by the
+(token-budget, batch-slots) signature, counted by
+``paddle_serving_step_builds_total``). The KV cache is a donated carry
+([L, num_blocks, KV, block_size, hd] per side), so XLA updates pages in
+place; prefix-cache sharing and preemption are pure block-table edits.
+
+Client surface:
+
+- ``submit(...) -> rid`` with admission control (:class:`RejectedError`
+  on queue overflow), per-request priority/deadline/sampling knobs;
+- ``step()`` — one scheduler tick + one fused device step, returning
+  :class:`TokenEvent` records (the streaming unit);
+- ``stream(rid)`` — iterator of tokens as they are produced;
+- ``run()`` — drain everything, return :class:`Completion` list (API
+  parity with the dense-slot :class:`~.slot_engine.ServingEngine` and
+  greedy/sampling parity with ``LLMPredictor``).
+
+SLO metrics (TTFT/TPOT histograms, queue-depth and KV-block-utilization
+gauges, admit/preempt/shed counters + flight-recorder events) flow
+through ``observability.emit`` — ``observability.summary()["serving"]``
+is the operator digest.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...models import llama as L
+from ...observability import emit as _emit
+from ...ops.kernels.serving_attention import block_multihead_attention_
+from .block_manager import BlockManager
+from .scheduler import (RejectedError, ScheduledBatch, Scheduler, Sequence)
+from .slot_engine import Completion
+
+__all__ = ["PagedServingEngine", "TokenEvent", "RejectedError"]
+
+# chaos harness hook (site "serving"): installed by
+# distributed/fault_tolerance/chaos.py while a spec is active
+_CHAOS_HOOK = [None]
+
+
+def set_chaos_hook(fn):
+    _CHAOS_HOOK[0] = fn
+
+
+@dataclass
+class TokenEvent:
+    """One streamed token (or a terminal event with token < 0)."""
+    rid: int
+    token: int                 # -1 for compute-free terminal events
+    finished: bool
+    reason: Optional[str] = None   # stop | length | deadline | cancelled
+
+
+def _sample_rows(logits, keys, temps, top_ps, top_k: int):
+    """Per-row temperature/top-k/top-p sampling on f32 logits [B, V] —
+    the batched form of llm.py's `_sample_next` (same masking math, so
+    the paged engine's sampling distribution matches LLMPredictor's).
+    temps/top_ps [B]; keys [B, 2] uint32; top_k static (0 = off)."""
+    l = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k:
+        vals = jax.lax.top_k(l, int(top_k))[0]
+        l = jnp.where(l < vals[..., -1:], -jnp.inf, l)
+    sl = jnp.sort(l, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sl, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_ps[:, None]          # exclusive prefix mass
+    cutoff = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
+    l = jnp.where(l < cutoff, -jnp.inf, l)
+    return jax.vmap(lambda k, row: jax.random.categorical(
+        jax.random.wrap_key_data(k), row))(keys, l).astype(jnp.int32)
+
+
+def _key_bits(key) -> np.ndarray:
+    """Raw uint32[2] view of a PRNG key (typed or legacy)."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return np.asarray(key)
+
+
+class PagedServingEngine:
+    """Continuous batching over a paged KV cache. Typical use::
+
+        eng = PagedServingEngine(cfg, params, num_blocks=64, block_size=16,
+                                 max_batch=8, token_budget=64)
+        rid = eng.submit([1, 2, 3], max_new_tokens=32, eos_token_id=2)
+        for tok in eng.stream(rid):   # streaming
+            ...
+        done = eng.run()              # or drain everything
+    """
+
+    def __init__(self, cfg: L.LlamaConfig, params: Dict[str, Any],
+                 num_blocks: Optional[int] = None, block_size: int = 16,
+                 max_batch: int = 8, token_budget: int = 64,
+                 max_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None, top_k: int = 0,
+                 max_queue: Optional[int] = None, cache_dtype=None,
+                 weight_dtype=None):
+        if cfg.num_experts:
+            raise NotImplementedError(
+                "PagedServingEngine serves dense LLaMA; route MoE decode "
+                "through LLMPredictor until the paged MoE step lands")
+        self.cfg = cfg
+        if weight_dtype is not None:
+            params = jax.tree.map(
+                lambda a: a.astype(weight_dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                params)
+        self.params = params
+        self.max_len = int(max_len or cfg.max_seq_len)
+        self.block_size = int(block_size)
+        self.max_batch = int(max_batch)
+        self.token_budget = int(token_budget)
+        self.top_k = int(top_k)
+        self.cache_dtype = cache_dtype or cfg.dtype
+        self.max_blocks_per_seq = -(-self.max_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = self.max_batch * self.max_blocks_per_seq
+        self.num_blocks = int(num_blocks)
+
+        self.blocks = BlockManager(self.num_blocks, self.block_size)
+        self.scheduler = Scheduler(self.blocks, self.token_budget,
+                                   self.max_batch,
+                                   prefill_chunk=prefill_chunk,
+                                   max_queue=max_queue)
+        self._next_rid = 0
+        self._completions: List[Completion] = []
+        self._events_by_rid: Dict[int, List[TokenEvent]] = {}
+        self.stats = {"steps": 0, "step_builds": 0, "tokens_computed": 0,
+                      "cow_block_copies": 0}
+
+        # device state: stacked per-layer paged caches (scanned with the
+        # layer axis, like llm.py's init_cache)
+        kvh, hd = cfg.num_kv_heads, cfg.head_dim
+        shape = (cfg.num_layers, self.num_blocks, kvh, self.block_size, hd)
+        self._key_cache = jnp.zeros(shape, self.cache_dtype)
+        self._value_cache = jnp.zeros(shape, self.cache_dtype)
+        # rope table in the kernel's stacked [2, 1, S, hd] layout (only the
+        # first hd//2 lanes of each are read)
+        cos, sin = L.rope_cos_sin(jnp.arange(self.max_len), hd,
+                                  cfg.rope_theta)
+        self._rope_emb = jnp.stack([
+            jnp.concatenate([cos, cos], -1)[None],
+            jnp.concatenate([sin, sin], -1)[None]])
+        # executables keyed by (token-budget, batch-slots) signature
+        self._step_fns: Dict[Tuple[int, int], Any] = {}
+        self._copy_fn = None
+
+    # -- client API -------------------------------------------------------
+    def submit(self, tokens, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               seed: int = 0) -> int:
+        """Enqueue a request. Raises ValueError when it cannot ever fit,
+        RejectedError (load shed) when the wait queue is full."""
+        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        total = len(tokens) + max(int(max_new_tokens), 0)
+        if total > self.max_len:
+            raise ValueError(f"prompt {len(tokens)} + new {max_new_tokens} "
+                             f"exceeds max_len {self.max_len}")
+        if self.blocks.blocks_needed(total) > self.num_blocks:
+            raise ValueError(
+                f"request needs {self.blocks.blocks_needed(total)} KV "
+                f"blocks but the pool has {self.num_blocks}; raise "
+                f"num_blocks or lower max_new_tokens")
+        if top_k is not None and int(top_k) != self.top_k:
+            raise ValueError(
+                f"per-request top_k={top_k} != engine top_k={self.top_k}: "
+                "top_k is static in the fused step (one executable); build "
+                "the engine with the top_k you serve")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._events_by_rid[rid] = []
+        if max_new_tokens <= 0:   # parity with generate(max_new_tokens=0)
+            self._finish_event(Sequence(rid, tokens, 0), "length")
+            return rid
+        if temperature is None and (self.top_k or top_p is not None):
+            temperature = 1.0      # top-k/top-p imply sampling
+        sample = temperature is not None and float(temperature) > 0.0
+        seq = Sequence(
+            rid, tokens, int(max_new_tokens),
+            eos=-1 if eos_token_id is None else int(eos_token_id),
+            priority=int(priority),
+            deadline=(time.monotonic() + float(deadline_s)
+                      if deadline_s is not None else None),
+            temperature=float(temperature) if sample else 0.0,
+            top_p=float(top_p) if top_p is not None else 1.0,
+            seed=int(seed))
+        seq._key = jax.random.PRNGKey(int(seed)) if sample else None
+        self.scheduler.add_request(seq)   # raises RejectedError on overflow
+        self._update_gauges()
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        seq = self.scheduler.get(rid)
+        if seq is None or seq.status == "finished":
+            return False
+        self.scheduler.cancel(rid)
+        self._finish_event(seq, "cancelled", already_finished=True)
+        return True
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def run(self) -> List[Completion]:
+        """Drive until queue and batch drain; completions in finish order."""
+        while self.has_work():
+            self.step()
+        out, self._completions = self._completions, []
+        return out
+
+    def stream(self, rid: int) -> Iterator[int]:
+        """Yield rid's tokens as they are produced, driving the engine
+        while the request is live (other requests progress too)."""
+        events = self._events_by_rid.get(rid)
+        if events is None:
+            raise KeyError(f"unknown rid {rid}")
+        i = 0
+        while True:
+            while i < len(events):
+                ev = events[i]
+                i += 1
+                if ev.token >= 0:
+                    yield ev.token
+                if ev.finished:
+                    return
+            if not self.has_work():
+                return
+            self.step()
+
+    # -- the fused step ---------------------------------------------------
+    def _build_step(self, tok_pad: int, B: int):
+        """Trace+compile the fixed-shape mixed prefill+decode executable
+        for the (token-budget, batch-slots) signature."""
+        cfg = self.cfg
+        top_k = self.top_k
+        bs = self.block_size
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step_fn(params, key_cache, value_cache, tokens, block_tables,
+                    cu_seqlens_q, seq_lens_decoder, seq_lens_this_time,
+                    rope_emb, temps, top_ps, keys, greedy):
+            x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+            zeros_b = jnp.zeros((B,), jnp.int32)
+
+            def body(carry, layer):
+                x = carry
+                lp, kc, vc = layer
+                h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+                q = h @ lp["wq"].astype(h.dtype)
+                k = h @ lp["wk"].astype(h.dtype)
+                v = h @ lp["wv"].astype(h.dtype)
+                qkv = jnp.concatenate([q, k, v], axis=-1)
+                o, _, kc, vc = block_multihead_attention_.__wrapped__(
+                    qkv, kc, vc, zeros_b, seq_lens_decoder,
+                    seq_lens_this_time, cu_seqlens_q=cu_seqlens_q,
+                    block_tables=block_tables, rope_emb=rope_emb,
+                    use_neox_style=True, block_size=bs,
+                    rope_theta=cfg.rope_theta)
+                x = x + o @ lp["wo"].astype(o.dtype)
+                h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+                gate = (jax.nn.silu(h @ lp["w1"].astype(h.dtype))
+                        * (h @ lp["w3"].astype(h.dtype)))
+                x = x + gate @ lp["w2"].astype(h.dtype)
+                return x, (kc, vc)
+
+            x, (kcs, vcs) = lax.scan(
+                body, x, (params["blocks"], key_cache, value_cache))
+            # last-token hidden state per slot (cu[1:]-1; idle slots gather
+            # garbage the host never reads)
+            last_idx = jnp.clip(cu_seqlens_q[1:] - 1, 0, tok_pad - 1)
+            hlast = x[last_idx]                                # [B, d]
+            hlast = L.rms_norm(hlast, params["final_norm"], cfg.rms_eps)
+            logits = (hlast @ params["lm_head"].astype(hlast.dtype)
+                      ).astype(jnp.float32)                    # [B, V]
+            nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt_sampled = _sample_rows(logits, keys, temps, top_ps, top_k)
+            nxt = jnp.where(greedy, nxt_greedy, nxt_sampled)
+            return nxt, kcs, vcs
+
+        return step_fn
+
+    def _get_step_fn(self, tok_pad: int, B: int):
+        key = (tok_pad, B)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._build_step(tok_pad, B)
+            self._step_fns[key] = fn
+            self.stats["step_builds"] += 1
+            _emit("serving.step_build", tok_pad=tok_pad, batch=B)
+        return fn
+
+    def _copy_blocks(self, pairs: List[Tuple[int, int]]):
+        """Execute COW page copies on the device caches (padded to a fixed
+        pair count so the copy executable compiles once)."""
+        PAD = 8
+        if self._copy_fn is None:
+            nb = self.num_blocks
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def copy_fn(kc, vc, src, dst):
+                # one-hot selects, statically unrolled over the pad width —
+                # the scatter-free page copy the tunnel backend supports
+                for i in range(PAD):
+                    s = jnp.maximum(src[i], 0)
+                    sel = (jnp.arange(nb) == dst[i])[None, :, None, None,
+                                                     None]
+                    blk_k = lax.dynamic_slice_in_dim(kc, s, 1, axis=1)
+                    blk_v = lax.dynamic_slice_in_dim(vc, s, 1, axis=1)
+                    kc = jnp.where(sel, blk_k, kc)
+                    vc = jnp.where(sel, blk_v, vc)
+                return kc, vc
+
+            self._copy_fn = copy_fn
+        for i in range(0, len(pairs), PAD):
+            chunk = pairs[i:i + PAD]
+            src = np.full((PAD,), -1, np.int32)
+            dst = np.full((PAD,), -1, np.int32)   # -1 never matches arange
+            for j, (s, d) in enumerate(chunk):
+                src[j], dst[j] = s, d
+            self._key_cache, self._value_cache = self._copy_fn(
+                self._key_cache, self._value_cache, jnp.asarray(src),
+                jnp.asarray(dst))
+            self.stats["cow_block_copies"] += len(chunk)
+            _emit("serving.cow", copies=len(chunk))
+
+    # -- scheduler tick ---------------------------------------------------
+    def step(self) -> List[TokenEvent]:
+        """One tick: schedule a mixed batch, run the fused step, harvest
+        tokens. Returns this tick's streamed events."""
+        hook = _CHAOS_HOOK[0]
+        if hook is not None:
+            hook("step")
+        batch, expired = self.scheduler.schedule()
+        events: List[TokenEvent] = []
+        for seq in expired:
+            events.append(self._finish_event(seq, "deadline",
+                                             already_finished=True))
+        if not batch:
+            self._update_gauges()
+            return events
+
+        pairs = self.blocks.take_copies()
+        if pairs:
+            self._copy_blocks(pairs)
+
+        tok_pad, B = self.token_budget, self.max_batch
+        tokens = np.zeros((tok_pad,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        dec_lens = np.zeros((B,), np.int32)
+        this_lens = np.zeros((B,), np.int32)
+        tables = np.full((B, self.max_blocks_per_seq), -1, np.int32)
+        temps = np.ones((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        greedy = np.ones((B,), bool)
+        pos = 0
+        for i, (seq, n) in enumerate(batch.items):
+            chunk = seq.tokens[seq.num_computed:seq.num_computed + n]
+            tokens[pos:pos + n] = chunk
+            pos += n
+            cu[i + 1] = pos
+            dec_lens[i] = seq.num_computed
+            this_lens[i] = n
+            row = self.blocks.block_table(seq.rid)
+            tables[i, :len(row)] = row
+            if seq.temperature > 0.0:
+                greedy[i] = False
+                temps[i] = seq.temperature
+                top_ps[i] = seq.top_p
+                seq._key, sub = jax.random.split(seq._key)
+                keys[i] = _key_bits(sub)
+        cu[len(batch.items) + 1:] = pos
+
+        fn = self._get_step_fn(tok_pad, B)
+        t0 = time.perf_counter()
+        nxt, self._key_cache, self._value_cache = fn(
+            self.params, self._key_cache, self._value_cache,
+            jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(cu),
+            jnp.asarray(dec_lens), jnp.asarray(this_lens), self._rope_emb,
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(keys),
+            jnp.asarray(greedy))
+        nxt = np.asarray(nxt)     # the step's one sync point
+        dur = time.perf_counter() - t0
+        n_prefill = sum(n for s, n in batch.items
+                        if s.num_computed + n < len(s.tokens))
+        _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
+              batch=len(batch.items), prefill_tokens=n_prefill)
+        self.stats["steps"] += 1
+        self.stats["tokens_computed"] += batch.total_tokens
+
+        # harvest: a slot yields a token iff its chunk reached the end of
+        # the sequence's current tokens (final prefill chunk or decode row)
+        for i, (seq, n) in enumerate(batch.items):
+            self.scheduler.on_computed(seq, n)
+            if seq.num_computed < len(seq.tokens):
+                continue   # mid-prefill: logits row is not a next token
+            tok = int(nxt[i])
+            now = time.monotonic()
+            first = seq.first_token_at is None
+            if seq.eos >= 0 and tok == seq.eos:
+                self.scheduler.append_token(seq, tok)  # timestamps
+                seq.generated.pop()                    # eos not surfaced
+                seq.tokens.pop()
+                events.append(self._finish_event(seq, "stop"))
+                continue
+            self.scheduler.append_token(seq, tok)
+            _emit("serving.token", rid=seq.rid, first=first,
+                  ttft_s=(now - seq.arrival) if first else None,
+                  tpot_s=None if first else now - seq._prev_token_at)
+            seq._prev_token_at = now
+            if len(seq.generated) >= seq.max_new_tokens:
+                ev = TokenEvent(seq.rid, tok, True, "length")
+                self._record_completion(seq, "length")
+                self.scheduler.finish(seq, "length")
+            else:
+                ev = TokenEvent(seq.rid, tok, False)
+            events.append(ev)
+            self._events_by_rid[seq.rid].append(ev)
+        self._update_gauges()
+        return events
+
+    # -- bookkeeping ------------------------------------------------------
+    def _finish_event(self, seq: Sequence, reason: str,
+                      already_finished: bool = False) -> TokenEvent:
+        if not already_finished:
+            self.scheduler.finish(seq, reason)
+        self._record_completion(seq, reason)
+        ev = TokenEvent(seq.rid, -1, True, reason)
+        self._events_by_rid.setdefault(seq.rid, []).append(ev)
+        return ev
+
+    def _record_completion(self, seq: Sequence, reason: str):
+        self._completions.append(Completion(seq.rid, list(seq.prompt),
+                                            list(seq.generated), reason))
+        _emit("serving.complete", rid=seq.rid, reason=reason,
+              generated=len(seq.generated),
+              preemptions=seq.preemptions)
+
+    def _update_gauges(self):
+        _emit("serving.gauges", queue_depth=self.scheduler.queue_depth(),
+              running=self.scheduler.num_running(),
+              kv_utilization=self.blocks.utilization())
+
+    @property
+    def engine_stats(self) -> dict:
+        """One merged host-side view (engine + scheduler + block pool)."""
+        return {**self.stats, **self.scheduler.stats,
+                "kv_utilization": round(self.blocks.utilization(), 4),
+                **{f"blocks_{k}": v for k, v in self.blocks.stats.items()}}

@@ -20,6 +20,13 @@ decode:
 
 Greedy decoding only (parity with `LLMPredictor.generate()` per request
 is exact and tested); sampling policies live in LLMPredictor.
+
+This dense-slot engine is the serving BASELINE: every slot pre-reserves
+`max_len` KV memory and there is no prefix sharing, preemption or
+admission control. The paged subsystem (:mod:`.engine`'s
+:class:`PagedServingEngine` over :mod:`.block_manager` /
+:mod:`.scheduler`) supersedes it for production serving;
+``tools/serving_smoke.py`` gates paged throughput against this engine.
 """
 from __future__ import annotations
 
@@ -33,8 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..models import llama as L
-from .llm import init_cache
+from ...models import llama as L
+from ...observability import emit as _emit
+from ..llm import init_cache
 
 __all__ = ["Request", "Completion", "ServingEngine"]
 
@@ -172,7 +180,7 @@ class ServingEngine:
         self._eos = jnp.full((self.num_slots,), -1, jnp.int32)
 
         cfg_, impl = cfg, attn_impl
-        from .llm import _forward_cached
+        from ..llm import _forward_cached
 
         @jax.jit
         def prefill_one(params, tokens, cache, length):
@@ -278,6 +286,8 @@ class ServingEngine:
                                    generated=[], budget=req.max_new_tokens,
                                    eos=eos, active=True)
             self.stats["admitted"] += 1
+            _emit("serving.admit", rid=req.rid, prompt_len=T,
+                  queue_depth=len(self._queue), engine="slot")
 
     def _harvest(self, toks: np.ndarray):
         for b, slot in enumerate(self._slots):
@@ -299,6 +309,8 @@ class ServingEngine:
                                             slot.generated, reason))
         self._slots[b] = _Slot()
         self.stats["completed"] += 1
+        _emit("serving.complete", rid=slot.rid, reason=reason,
+              generated=len(slot.generated), engine="slot")
 
     def step(self):
         """One scheduler tick: admit into free slots, decode one chunk,
@@ -306,9 +318,15 @@ class ServingEngine:
         self._admit()
         if not any(s.active for s in self._slots):
             return
+        import time as _time
+        t0 = _time.perf_counter()
         self._last_logits, self._cache, self._pos, toks = self._decode_chunk(
             self.params, self._cache, self._last_logits, self._pos,
             self._eos)
+        toks = np.asarray(toks)   # sync before timing
         self.stats["decode_chunks"] += 1
         self.stats["decode_steps"] += self.chunk
-        self._harvest(np.asarray(toks))
+        _emit("serving.step", dur_s=_time.perf_counter() - t0,
+              tokens=self.chunk * sum(s.active for s in self._slots),
+              batch=sum(s.active for s in self._slots), engine="slot")
+        self._harvest(toks)

@@ -180,6 +180,31 @@ _c_dp_packs = _C("paddle_dp_flat_pack_calls_total",
                  "Cached flat pack/unpack executable invocations")
 _c_dp_builds = _C("paddle_dp_flat_pack_builds_total",
                   "Bucket-plan/executable builds (steady state: constant)")
+_c_srv_req = _C("paddle_serving_requests_total",
+                "Serving request lifecycle events, by event (admitted/"
+                "completed/preempted/shed/deadline/cancelled)")
+_h_srv_ttft = _H("paddle_serving_ttft_seconds",
+                 "Time-to-first-token: submit to first streamed token")
+_h_srv_tpot = _H("paddle_serving_tpot_seconds",
+                 "Time-per-output-token: inter-token gap after the first")
+_h_srv_step = _H("paddle_serving_step_seconds",
+                 "Fused mixed prefill+decode step dispatch durations")
+_g_srv_queue = _G("paddle_serving_queue_depth",
+                  "Requests waiting for admission")
+_g_srv_running = _G("paddle_serving_running",
+                    "Requests currently holding KV blocks / batch slots")
+_g_srv_util = _G("paddle_serving_kv_block_utilization",
+                 "Fraction of the paged KV block pool in use")
+_c_srv_steps = _C("paddle_serving_steps_total",
+                  "Fused serving steps dispatched")
+_c_srv_builds = _C("paddle_serving_step_builds_total",
+                   "Serving step executable (re)builds — steady state: "
+                   "constant (zero retraces)")
+_c_srv_prefix = _C("paddle_serving_prefix_cached_tokens_total",
+                   "Prompt tokens served from the paged prefix cache "
+                   "instead of recompute")
+_c_srv_cow = _C("paddle_serving_cow_copies_total",
+                "Copy-on-write KV page copies executed on device")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -249,6 +274,39 @@ def _h_serving(phase):
     return h
 
 
+def _h_srv_event(event):
+    def h(dur_s, f):
+        _c_srv_req.inc(labels={"event": event})
+    return h
+
+
+def _h_srv_shed(dur_s, f):
+    # one kind covers both shed flavors: queue overflow and deadline expiry
+    event = "deadline" if f.get("reason") == "deadline" else "shed"
+    _c_srv_req.inc(labels={"event": event})
+
+
+def _h_srv_step_h(dur_s, f):
+    _c_srv_steps.inc()
+    _c_tokens.inc(f.get("tokens", 0), labels={"phase": "mixed"})
+    if dur_s is not None:
+        _h_srv_step.observe(dur_s)
+
+
+def _h_srv_token(dur_s, f):
+    ttft, tpot = f.get("ttft_s"), f.get("tpot_s")
+    if ttft is not None:
+        _h_srv_ttft.observe(ttft)
+    if tpot is not None:
+        _h_srv_tpot.observe(tpot)
+
+
+def _h_srv_gauges(dur_s, f):
+    _g_srv_queue.set(f.get("queue_depth", 0))
+    _g_srv_running.set(f.get("running", 0))
+    _g_srv_util.set(f.get("kv_utilization", 0.0))
+
+
 _HANDLERS = {
     "dispatch.hit": _h_dispatch_hit,
     "dispatch.miss": _h_dispatch_miss,
@@ -274,6 +332,18 @@ _HANDLERS = {
         labels={"op": f.get("op", "")}),
     "serving.prefill": _h_serving("prefill"),
     "serving.decode_chunk": _h_serving("decode"),
+    "serving.admit": _h_srv_event("admitted"),
+    "serving.complete": _h_srv_event("completed"),
+    "serving.preempt": _h_srv_event("preempted"),
+    "serving.cancel": _h_srv_event("cancelled"),
+    "serving.shed": _h_srv_shed,
+    "serving.step": _h_srv_step_h,
+    "serving.step_build": lambda d, f: _c_srv_builds.inc(),
+    "serving.prefix_hit": lambda d, f: _c_srv_prefix.inc(
+        f.get("tokens", 0)),
+    "serving.cow": lambda d, f: _c_srv_cow.inc(f.get("copies", 1)),
+    "serving.token": _h_srv_token,
+    "serving.gauges": _h_srv_gauges,
     "watchdog.timeout": lambda d, f: _c_wd.inc(),
     "watchdog.escalate": lambda d, f: _c_escalate.inc(
         labels={"stage": f.get("stage", "")}),
@@ -362,6 +432,26 @@ def summary() -> dict:
         "dp_overlap_efficiency": round(float(_g_dp_overlap.value()), 4),
         "dp_flat_pack_builds": int(_c_dp_builds.value()),
         "events_recorded": _recorder.written(),
+        "serving": {
+            "admitted": int(_c_srv_req.value({"event": "admitted"})),
+            "completed": int(_c_srv_req.value({"event": "completed"})),
+            "preempted": int(_c_srv_req.value({"event": "preempted"})),
+            "shed": int(_c_srv_req.value({"event": "shed"})),
+            "deadline_expired": int(_c_srv_req.value(
+                {"event": "deadline"})),
+            "cancelled": int(_c_srv_req.value({"event": "cancelled"})),
+            "ttft_p50_s": round(_h_srv_ttft.percentile(50), 6),
+            "ttft_p99_s": round(_h_srv_ttft.percentile(99), 6),
+            "tpot_p50_s": round(_h_srv_tpot.percentile(50), 6),
+            "tpot_p99_s": round(_h_srv_tpot.percentile(99), 6),
+            "queue_depth": int(_g_srv_queue.value()),
+            "running": int(_g_srv_running.value()),
+            "kv_block_utilization": round(float(_g_srv_util.value()), 4),
+            "steps_total": int(_c_srv_steps.value()),
+            "step_builds": int(_c_srv_builds.value()),
+            "prefix_cached_tokens": int(_c_srv_prefix.value()),
+            "cow_copies": int(_c_srv_cow.value()),
+        },
     }
 
 

@@ -365,15 +365,29 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
                       qkv_out_scale=qkv_out_scale, out_shift=out_shift,
                       out_smooth=out_smooth)
     if pre_key_cache is not None or pre_value_cache is not None:
-        raise NotImplementedError("pre-cache (system prompt cache): "
-                                  "concatenate into the paged cache instead")
+        raise NotImplementedError(
+            "block_multihead_attention_: pre_key_cache/pre_value_cache "
+            "(system-prompt pre-cache) is not wired. Shared prompt prefixes "
+            "are served by the paged prefix cache instead: submit through "
+            "paddle_tpu.inference.PagedServingEngine and its BlockManager "
+            "deduplicates the shared blocks (copy-on-write); for a dense "
+            "cache use fused_multi_transformer_ without pre_caches")
     if mask is not None or tgt_mask is not None:
         raise NotImplementedError(
             "block_multihead_attention_ mask/tgt_mask: only right-padded "
             "causal batches are supported; custom masks not wired yet")
     if block_tables is None or cu_seqlens_q is None:
-        raise ValueError("block_multihead_attention_ needs block_tables and "
-                         "cu_seqlens_q")
+        missing = [n for n, v in (("block_tables", block_tables),
+                                  ("cu_seqlens_q", cu_seqlens_q))
+                   if v is None]
+        raise ValueError(
+            f"block_multihead_attention_ needs {' and '.join(missing)}: "
+            "this is the paged-KV kernel and both come from the serving "
+            "subsystem (paddle_tpu.inference.PagedServingEngine packs them "
+            "from its BlockManager block tables each step). For a dense "
+            "per-slot cache without block tables use the dense fallbacks: "
+            "masked_multihead_attention_ (one decode step) or "
+            "fused_multi_transformer_ (whole stack)")
     num_blocks, KV, bs, hd = key_cache.shape
     B, max_blocks = block_tables.shape
     token_num = qkv.shape[0]

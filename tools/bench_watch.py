@@ -218,6 +218,49 @@ def maybe_dp_overlap_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/dp_overlap_smoke.py)")
 
 
+_last_serving_smoke = [0.0]
+
+
+def maybe_serving_smoke(min_interval: float = 3600.0) -> None:
+    """Run the paged-serving smoke (tools/serving_smoke.py) at most once
+    per min_interval and log a RED line on regression — paged/dense parity
+    breakage, paged throughput falling below the dense-slot baseline, a
+    retrace in the steady-state step, or a dead prefix cache are
+    build-signal the same way the perf floor is."""
+    now = time.monotonic()
+    if _last_serving_smoke[0] and now - _last_serving_smoke[0] < min_interval:
+        return
+    _last_serving_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "serving_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: serving smoke hung >600s — paged serving engine broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"serving smoke GREEN ({payload.get('wall_s')}s: "
+            f"paged={payload.get('paged_tokens_per_s')}tok/s "
+            f"dense={payload.get('dense_tokens_per_s')}tok/s "
+            f"ratio={payload.get('throughput_ratio')} "
+            f"ttft={payload.get('paged_ttft_ms')}ms)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: serving smoke regression rc={out.returncode} — {detail} "
+        f"(tools/serving_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -323,6 +366,7 @@ def main() -> None:
     if args.once:
         maybe_chaos_smoke()
         maybe_dp_overlap_smoke()
+        maybe_serving_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -331,6 +375,7 @@ def main() -> None:
         try:
             maybe_chaos_smoke()
             maybe_dp_overlap_smoke()
+            maybe_serving_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
