@@ -209,6 +209,23 @@ def wait_for(arrays: Iterable[Any], tag: str = "wait"):
     return dur
 
 
+def abort_in_flight(reason: str = "") -> int:
+    """Drop every queued step WITHOUT waiting on its buffers.
+
+    The elastic runtime calls this when the world is reconfigured: steps
+    dispatched in the old epoch may reference collectives that will never
+    complete (their mesh includes a dead rank), so waiting — what
+    ``drain()`` does — could block forever. The buffers are simply
+    forgotten; PJRT retires or poisons them on its own. Returns how many
+    in-flight steps were discarded."""
+    with _lock:
+        n = len(_queue)
+        _queue.clear()
+    _emit("async.abort", n_steps=n, reason=reason)
+    _emit("async.depth", depth=0)
+    return n
+
+
 def drain():
     """Block until every in-flight step completes and clear the queue."""
     with _lock:

@@ -51,6 +51,10 @@ def _build_and_load():
                             ctypes.c_uint64]
     lib.pts_add.restype = ctypes.c_int64
     lib.pts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.pts_add_token.restype = ctypes.c_int64
+    lib.pts_add_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int64, ctypes.c_char_p,
+                                  ctypes.c_uint64]
     lib.pts_check.restype = ctypes.c_int
     lib.pts_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.pts_delete.restype = ctypes.c_int
@@ -149,6 +153,15 @@ class NativeStoreClient:
 
     def add(self, key: str, delta: int) -> int:
         v = self._lib.pts_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise IOError("TCPStore add failed")
+        return v
+
+    def add_token(self, key: str, delta: int, token: bytes) -> int:
+        """ADD with a per-call idempotency token (see store.py): replaying
+        the same token returns the recorded result instead of re-adding."""
+        v = self._lib.pts_add_token(self._h, key.encode(), delta, token,
+                                    len(token))
         if v == -(2 ** 63):
             raise IOError("TCPStore add failed")
         return v

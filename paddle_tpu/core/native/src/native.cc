@@ -32,6 +32,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <string>
@@ -46,6 +47,9 @@ extern "C" {
 // Wire protocol: [1 byte op][u32 keylen][key][u64 vallen][val]
 //   op: 0=SET 1=GET(blocking til present, 2s poll) 2=ADD(i64 delta)
 //       3=WAIT(present?) 4=DELETE 5=PING
+//       6=ADD_TOKEN(val = i64 delta + idempotency token bytes; the server
+//         remembers token->result so a retried call after an ambiguous
+//         failure returns the recorded result instead of re-adding)
 // Reply: [u64 vallen][val] (ADD replies the new counter as i64; WAIT replies
 // 1 byte 0/1)
 
@@ -58,8 +62,14 @@ struct StoreServer {
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
+  // ADD_TOKEN dedup: applied token -> result, FIFO-bounded (a token only
+  // needs to survive its own retry window)
+  std::map<std::string, int64_t> applied;
+  std::deque<std::string> applied_order;
   std::vector<std::thread> workers;
 };
+
+constexpr size_t kTokenWindow = 4096;
 
 bool read_all(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -128,6 +138,38 @@ void serve_client(StoreServer* s, int fd) {
         std::string nv(8, '\0');
         memcpy(&nv[0], &now, 8);
         s->kv[key] = nv;
+      }
+      s->cv.notify_all();
+      uint64_t n = 8;
+      if (!write_all(fd, &n, 8) || !write_all(fd, &now, 8)) break;
+    } else if (op == 6) {  // ADD_TOKEN: val = i64 delta + token bytes
+      int64_t delta = 0;
+      memcpy(&delta, val.data(), std::min<size_t>(8, val.size()));
+      std::string token = val.size() > 8 ? val.substr(8) : std::string();
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto a = token.empty() ? s->applied.end() : s->applied.find(token);
+        if (a != s->applied.end()) {
+          now = a->second;  // replayed call: return the recorded result
+        } else {
+          int64_t cur = 0;
+          auto it = s->kv.find(key);
+          if (it != s->kv.end() && it->second.size() >= 8)
+            memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string nv(8, '\0');
+          memcpy(&nv[0], &now, 8);
+          s->kv[key] = nv;
+          if (!token.empty()) {
+            s->applied.emplace(token, now);
+            s->applied_order.push_back(token);
+            while (s->applied_order.size() > kTokenWindow) {
+              s->applied.erase(s->applied_order.front());
+              s->applied_order.pop_front();
+            }
+          }
+        }
       }
       s->cv.notify_all();
       uint64_t n = 8;
@@ -268,6 +310,21 @@ int64_t pts_get(void* handle, const char* key, void* buf, uint64_t maxlen) {
 int64_t pts_add(void* handle, const char* key, int64_t delta) {
   std::string r;
   if (!request(static_cast<StoreClient*>(handle), 2, key, &delta, 8, &r) ||
+      r.size() < 8)
+    return INT64_MIN;
+  int64_t v;
+  memcpy(&v, r.data(), 8);
+  return v;
+}
+
+int64_t pts_add_token(void* handle, const char* key, int64_t delta,
+                      const char* token, uint64_t token_len) {
+  std::string payload(8, '\0');
+  memcpy(&payload[0], &delta, 8);
+  payload.append(token, token_len);
+  std::string r;
+  if (!request(static_cast<StoreClient*>(handle), 6, key, payload.data(),
+               payload.size(), &r) ||
       r.size() < 8)
     return INT64_MIN;
   int64_t v;

@@ -82,9 +82,15 @@ def __getattr__(name):
 
         globals()[name] = CheckpointManager
         return CheckpointManager
+    if name in ("ElasticRuntime", "EpochChangedError"):
+        from . import elastic as _el
+
+        obj = getattr(_el, name)
+        globals()[name] = obj
+        return obj
     if name in ("fleet", "auto_parallel", "checkpoint", "launch", "sharding",
                 "parallel", "hybrid", "rpc", "utils", "communication",
-                "passes", "fault_tolerance"):
+                "passes", "fault_tolerance", "elastic"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ImportError as e:

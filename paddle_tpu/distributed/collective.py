@@ -44,6 +44,7 @@ from ..core import flags
 from ..observability import emit as _obs_emit
 from .env import get_rank, get_world_size
 from .comm_watchdog import comm_task, note_issue, set_restart_hook
+from .elastic.epoch import EpochChangedError, current as _epoch_current
 
 
 class ReduceOp:
@@ -99,6 +100,9 @@ class Group:
                  devices=None, mesh: Optional[Mesh] = None):
         self.ranks = list(ranks)
         self.id = gid
+        # group-generation fence: a reconfiguration bumps the global epoch,
+        # making every group built before it stale (elastic/epoch.py)
+        self.epoch = _epoch_current()
         self.axis_name = axis_name or f"_pg{gid}"
         self._mesh = mesh
         if mesh is None and devices is not None and len(devices) == len(ranks):
@@ -481,8 +485,21 @@ flags.define_flag("collective_retry_backoff", 0.05,
 
 # what the retry wrapper backs off on: declared-dead collectives (incl.
 # injected ChaosCollectiveTimeout) and transport drops. Programming errors
-# (shape/dtype/ValueError) propagate immediately.
+# (shape/dtype/ValueError) propagate immediately. EpochChangedError is a
+# plain RuntimeError, deliberately NOT retryable.
 _RETRYABLE = (TimeoutError, ConnectionError)
+
+# elastic verdict hook: fn(op, gid, rank, exc) -> bool, installed by the
+# ElasticRuntime. Called when a collective fails with a retryable error;
+# returning True means the failure resolved to a world change (membership
+# shrank, reconfiguration ran) so retrying on the old group is pointless.
+_world_changed_hook = [None]
+
+
+def set_world_changed_hook(fn):
+    prev = _world_changed_hook[0]
+    _world_changed_hook[0] = fn
+    return prev
 
 
 def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
@@ -498,6 +515,12 @@ def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
     if _is_traced(x) and _axis_in_scope(g.axis_name):
         out = _SHARD_FNS[fn_name](x, g.axis_name, g.nranks, **kw)
         return out, None
+    start_epoch = _epoch_current()
+    if getattr(g, "epoch", start_epoch) != start_epoch:
+        raise EpochChangedError(
+            f"{fn_name} issued on stale group {g.id} (epoch {g.epoch}, "
+            f"current {start_epoch}); rebuild the group and re-run the "
+            f"step on the post-reconfiguration world")
     retries = max(0, int(flags.flag_value("collective_retries")))
     attempt = 0
     while True:
@@ -507,6 +530,26 @@ def _run(group: Optional[Group], fn_name: str, tensor, sync_op=True, **kw):
                 ch(fn_name, max(g.rank, 0))
             return _run_once(g, fn_name, x, **kw)
         except _RETRYABLE as e:
+            # epoch fence: never retry across a reconfiguration — the old
+            # group's mesh no longer matches the live world
+            if _epoch_current() != start_epoch:
+                raise EpochChangedError(
+                    f"{fn_name} on group {g.id} failed and the world was "
+                    f"reconfigured (epoch {start_epoch} -> "
+                    f"{_epoch_current()}); re-run the step on the new "
+                    f"group") from e
+            verdict = _world_changed_hook[0]
+            if verdict is not None:
+                try:
+                    changed = bool(verdict(fn_name, g.id, max(g.rank, 0), e))
+                except Exception:  # noqa: BLE001 — a broken verdict hook
+                    changed = False  # must not mask the transport error
+                if changed:
+                    raise EpochChangedError(
+                        f"{fn_name} on group {g.id} resolved to a world "
+                        f"change (epoch {start_epoch} -> "
+                        f"{_epoch_current()}); re-run the step on the new "
+                        f"group") from e
             attempt += 1
             if attempt > retries:
                 raise
@@ -695,17 +738,57 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     return None
 
 
+# live-world provider: fn() -> int, installed by the ElasticRuntime so
+# post-reconfiguration code paths (gang-restart barrier) count the CURRENT
+# world, not the launch-time world a dead rank can never rejoin.
+_live_world_fn = [None]
+
+
+def set_live_world_fn(fn):
+    prev = _live_world_fn[0]
+    _live_world_fn[0] = fn
+    return prev
+
+
+def current_world_size() -> int:
+    """Live world size when an elastic runtime is active, else launch-time."""
+    fn = _live_world_fn[0]
+    if fn is not None:
+        try:
+            n = int(fn())
+            if n > 0:
+                return n
+        except Exception:  # noqa: BLE001 — fall back to the static world
+            pass
+    return get_world_size()
+
+
+def replace_default_group(group: Group):
+    """Adopt `group` as the default after an in-job elastic reconfiguration
+    so code that resolves groups lazily (get_group(0), barrier(None), ...)
+    sees the post-reconfiguration world."""
+    global _default_group
+    with _lock:
+        _group_registry[0] = group
+        _default_group = group
+
+
 def gang_restart_barrier(timeout: float = 60.0) -> bool:
     """The watchdog ladder's 'restart' stage: rendezvous every rank at a
     TCPStore barrier so survivors of a detected hang re-align (and a truly
     dead peer turns the hang into a clean barrier timeout) before resuming.
-    Returns True when the gang reached the barrier."""
-    _obs_emit("collective.gang_restart", world=get_world_size())
+    Returns True when the gang reached the barrier.
+
+    The barrier counts the CURRENT world size (live-world provider): after
+    an elastic shrink the launch-time count would wait forever for a rank
+    that is never coming back."""
+    ws = current_world_size()
+    _obs_emit("collective.gang_restart", world=ws)
     client = _store_client()
     if client is None:
         return True  # single process: nothing to rendezvous with
     try:
-        client.barrier("_gang_restart", timeout=timeout)
+        client.barrier("_gang_restart", timeout=timeout, world_size=ws)
         return True
     except Exception:  # noqa: BLE001 — a failed rendezvous means the gang
         return False   # is really gone; the ladder falls through to abort

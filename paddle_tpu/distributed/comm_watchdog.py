@@ -36,13 +36,15 @@ flags.define_flag("comm_watchdog_abort", True,
                   "dumping diagnostics; False = dump only")
 flags.define_flag("watchdog_policy", "",
                   "Comm-watchdog escalation ladder: comma-separated stages "
-                  "from {warn,dump,retry,restart,abort}, applied one per "
-                  "successive expiry of the same hung task (the task is "
+                  "from {warn,dump,retry,elastic,restart,abort}, applied one "
+                  "per successive expiry of the same hung task (the task is "
                   "re-armed between stages; 'retry' also doubles its "
-                  "timeout). Empty = legacy single-shot report honoring "
+                  "timeout; 'elastic' asks the elastic runtime to resolve "
+                  "the hang into an in-job world reconfiguration). Empty = "
+                  "legacy single-shot report honoring "
                   "FLAGS_comm_watchdog_abort")
 
-_STAGES = ("warn", "dump", "retry", "restart", "abort")
+_STAGES = ("warn", "dump", "retry", "elastic", "restart", "abort")
 
 _counter = itertools.count()
 
@@ -54,6 +56,40 @@ _restart_hook = [None]
 
 def set_restart_hook(fn):
     _restart_hook[0] = fn
+
+
+# elastic-reconfigure hook for the ladder's 'elastic' stage — fn() -> bool,
+# registered by the ElasticRuntime. True = the hang resolved to a world
+# change and was reconfigured away, so the hung task is retired; False/None
+# = membership is intact (or no runtime), fall through to the next stage.
+_elastic_hook = [None]
+
+
+def set_elastic_hook(fn):
+    prev = _elastic_hook[0]
+    _elastic_hook[0] = fn
+    return prev
+
+
+# live-membership provider for distress dumps — fn() -> dict snapshot,
+# registered by the ElasticRuntime
+_membership_fn = [None]
+
+
+def set_membership_fn(fn):
+    prev = _membership_fn[0]
+    _membership_fn[0] = fn
+    return prev
+
+
+def _membership_snapshot():
+    fn = _membership_fn[0]
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 — diagnostics never mask a hang
+        return None
 
 
 _policy_warned = [False]
@@ -209,6 +245,11 @@ class CommTaskManager:
                   retries (the backoff loop in collective.py) are the
                   mechanism that actually re-issues work — this stage keeps
                   the watchdog from declaring death while they run.
+        elastic — ask the elastic runtime (hook) to resolve the hang into
+                  an in-job reconfiguration: if membership shrank, the
+                  world is rebuilt without this rank's peer and the hung
+                  task is retired (its collective belongs to a dead epoch);
+                  otherwise fall through to the next stage.
         restart — gang-restart rendezvous: every rank meets at a store
                   barrier (hook registered by collective.py) so survivors
                   re-align before resuming.
@@ -238,7 +279,8 @@ class CommTaskManager:
                         "comm_watchdog_escalate",
                         extra={"stage": stage,
                                "task": task.describe(),
-                               "escalation": task.escalations})
+                               "escalation": task.escalations,
+                               "membership": _membership_snapshot()})
                 except Exception:  # noqa: BLE001
                     pass
                 print(head + "still hung — " + task.describe()
@@ -248,6 +290,23 @@ class CommTaskManager:
             elif stage == "retry":
                 print(head + f"re-armed with doubled timeout "
                       f"({task.timeout:.1f}s) — " + task.describe(),
+                      file=sys.stderr, flush=True)
+            elif stage == "elastic":
+                hook = _elastic_hook[0]
+                ok = None
+                if hook is not None:
+                    try:
+                        ok = bool(hook())
+                    except Exception:  # noqa: BLE001 — a failed reconfigure
+                        ok = False     # falls through to the next stage
+                if ok:
+                    # the hang belonged to the pre-reconfiguration epoch;
+                    # the blocked call unwinds via the epoch fence
+                    self.end_task(task.id)
+                print(head + "elastic reconfigure "
+                      + ("succeeded — hung task retired" if ok
+                         else "FAILED" if ok is False else "unavailable")
+                      + " — " + task.describe(),
                       file=sys.stderr, flush=True)
             elif stage == "restart":
                 hook = _restart_hook[0]
@@ -286,7 +345,8 @@ class CommTaskManager:
             dump_path = observability.dump_distress(
                 "comm_watchdog_timeout",
                 extra={"timed_out": [t.describe() for t in expired],
-                       "last_issued": list(last) if last else None})
+                       "last_issued": list(last) if last else None,
+                       "membership": _membership_snapshot()})
         except Exception:  # noqa: BLE001 — diagnostics must not mask a hang
             pass
         if dump_path:

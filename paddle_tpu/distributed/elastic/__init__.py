@@ -1,0 +1,41 @@
+"""TPU-native elastic training runtime.
+
+Turns rank failure from a job-killer into a bounded in-job reconfiguration:
+
+* :mod:`.epoch` — group-generation fencing for collectives (imported by
+  ``collective.py``; must stay dependency-free).
+* :mod:`.membership` — TTL-leased heartbeat membership (in-process for the
+  single-controller simulation, TCPStore-backed for real jobs).
+* :mod:`.runtime` — :class:`ElasticRuntime`: failure verdicts, world
+  reconfiguration (epoch bump → queue flush → new group → DP rebind →
+  ZeRO-1 reshard), and step-boundary rejoin.
+
+Everything except ``epoch`` is imported lazily: ``collective.py`` imports
+this package at module-init time, and ``runtime`` imports ``collective``
+back — eager imports here would cycle.
+"""
+from .epoch import EpochChangedError  # noqa: F401 — dependency-free
+
+_LAZY = {
+    "LocalMembership": "membership",
+    "StoreMembership": "membership",
+    "ElasticRuntime": "runtime",
+    "maybe_start": "runtime",
+    "epoch": None,
+    "membership": None,
+    "runtime": None,
+}
+
+__all__ = ["EpochChangedError", "ElasticRuntime", "LocalMembership",
+           "StoreMembership", "maybe_start", "epoch", "membership",
+           "runtime"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod_name = _LAZY[name] or name
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        return mod if _LAZY[name] is None else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

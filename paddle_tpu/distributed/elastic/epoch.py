@@ -1,0 +1,59 @@
+"""Group-generation (epoch) fencing for collectives.
+
+Every :class:`~paddle_tpu.distributed.collective.Group` is stamped with the
+epoch that was current when it was built. An elastic reconfiguration bumps
+the epoch, which makes every pre-existing group *stale*: the collective
+retry wrapper refuses to issue on a stale group and refuses to retry a
+failed collective across an epoch boundary — both raise
+:class:`EpochChangedError` so the training loop can re-run the step on the
+post-reconfiguration world instead of silently mixing results from two
+different worlds.
+
+Kept dependency-free (observability only) so ``collective.py`` can import
+it without a cycle.
+"""
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_epoch = [0]
+
+
+class EpochChangedError(RuntimeError):
+    """The world was reconfigured under this collective.
+
+    Deliberately NOT a TimeoutError/ConnectionError: the collective retry
+    wrapper treats those as retryable, while an epoch change must surface
+    to the training loop (re-issue the whole step on the new group).
+    """
+
+
+def current() -> int:
+    return _epoch[0]
+
+
+def bump() -> int:
+    """Advance the group generation. Called only by the elastic runtime
+    (and tests) at the start of a reconfiguration."""
+    with _lock:
+        _epoch[0] += 1
+        e = _epoch[0]
+    from ...observability import emit
+    emit("elastic.event", event="epoch_bump", epoch=e)
+    return e
+
+
+def check(stamp: int, what: str = "collective"):
+    """Raise EpochChangedError if `stamp` is no longer the current epoch."""
+    cur = _epoch[0]
+    if stamp != cur:
+        raise EpochChangedError(
+            f"{what} belongs to epoch {stamp} but the world was "
+            f"reconfigured (current epoch {cur}); rebuild the group and "
+            f"re-run the step on the new world")
+
+
+def _reset_for_tests():
+    with _lock:
+        _epoch[0] = 0

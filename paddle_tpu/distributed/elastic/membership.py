@@ -1,0 +1,142 @@
+"""Membership + failure detection for the elastic runtime.
+
+Two implementations of the same small surface — ``live()``, ``beat()``,
+``kill()``, ``revive()`` — so the runtime code is identical in the
+single-controller CPU simulation and in a real store-backed multi-node job:
+
+* :class:`LocalMembership` — in-process TTL leases, one per virtual rank.
+  The single-controller test mode runs all N ranks in one process, so
+  their "heartbeats" live in a dict; chaos ``rank_dead`` kills a lease the
+  same way a dead process would stop refreshing an etcd lease.
+* :class:`StoreMembership` — TTL-leased heartbeat keys on the TCPStore,
+  absorbing the ``fleet.elastic.manager.ElasticManager`` mechanics
+  (atomic slot allocation via ``add``, beat keys younger than ``ttl`` =
+  live, every node running the same pure ``live()`` so survivors agree
+  on the new world without a consensus round).
+
+A rank id here is the rank's position in the ORIGINAL (launch-time)
+world; the runtime maps live rank ids to devices when it rebuilds the
+group, so survivors keep their relative order across a reconfiguration.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..fleet.elastic.manager import ElasticManager
+
+
+class LocalMembership:
+    """TTL-leased membership for the single-controller simulation.
+
+    Every virtual rank holds a lease refreshed by :meth:`beat` (the
+    training loop ticks it once per step, standing in for each rank's
+    heartbeat thread). ``kill(rank)`` revokes the lease — immediately by
+    default (modeling a deleted etcd lease / closed connection), or
+    silently (``immediate=False``) so death is only discovered when the
+    TTL lapses, like a wedged host.
+    """
+
+    def __init__(self, world_size: int, ttl: float = 1.0):
+        self.ttl = float(ttl)
+        self._lock = threading.Lock()
+        now = time.monotonic()
+        self._beats: Dict[int, float] = {r: now for r in range(world_size)}
+        self._alive = set(range(world_size))
+
+    def beat(self, rank: Optional[int] = None):
+        now = time.monotonic()
+        with self._lock:
+            ranks = self._alive if rank is None else [rank]
+            for r in ranks:
+                if r in self._alive:
+                    self._beats[r] = now
+
+    def kill(self, rank: int, immediate: bool = True):
+        with self._lock:
+            self._alive.discard(rank)
+            if immediate:
+                self._beats.pop(rank, None)
+
+    def revive(self, rank: int):
+        with self._lock:
+            self._alive.add(rank)
+            self._beats[rank] = time.monotonic()
+
+    def live(self) -> List[int]:
+        # liveness is judged by beat freshness alone: a silently-killed
+        # rank (wedged host) keeps its stale beat until the TTL lapses,
+        # an immediate kill (revoked lease) has no beat at all
+        now = time.monotonic()
+        with self._lock:
+            return sorted(r for r, t in self._beats.items()
+                          if now - t <= self.ttl)
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "live": sorted(r for r, t in self._beats.items()
+                               if now - t <= self.ttl),
+                "ttl": self.ttl,
+                "beat_age_s": {
+                    str(r): round(now - t, 3)
+                    for r, t in sorted(self._beats.items())},
+            }
+
+    def close(self):
+        pass
+
+
+class StoreMembership:
+    """TTL-leased heartbeat keys on the TCPStore (ElasticManager engine).
+
+    One instance per rank process. Registration claims a slot with the
+    store's atomic ``add``; a daemon thread refreshes the beat key. The
+    live set is recomputed from the store on every call, so all survivors
+    run the same pure function and agree on the new world.
+    """
+
+    def __init__(self, store, job_id: str = "default", nnodes: str = "1:64",
+                 node_id: Optional[str] = None, ttl: float = 6.0,
+                 rank: int = 0):
+        self._mgr = ElasticManager(store, job_id, nnodes=nnodes,
+                                   node_id=node_id or f"rank{rank}", ttl=ttl)
+        self.ttl = self._mgr.ttl
+        self._mgr.register()
+
+    def beat(self, rank: Optional[int] = None):
+        self._mgr._beat()
+
+    def kill(self, rank: int, immediate: bool = True):
+        """Revoke a peer's lease (chaos / fencing a known-dead rank).
+
+        With ``immediate`` the beat key is deleted so every survivor sees
+        the death on its next poll instead of after a TTL.
+        """
+        if not immediate:
+            return
+        for _, node in self._mgr.live_nodes():
+            if node == f"rank{rank}" or node.endswith(f":{rank}"):
+                try:
+                    self._mgr.store.delete_key(self._mgr._key("beat", node))
+                except Exception:
+                    pass
+
+    def revive(self, rank: int):
+        # a real rejoin is a fresh registration by the restarted process;
+        # nothing to do on the survivor side
+        self._mgr._beat()
+
+    def live(self) -> List[int]:
+        return [slot for slot, _ in self._mgr.live_nodes()]
+
+    def snapshot(self) -> dict:
+        live = self._mgr.live_nodes()
+        return {"live": [s for s, _ in live],
+                "nodes": [n for _, n in live],
+                "ttl": self.ttl}
+
+    def close(self):
+        self._mgr.exit()

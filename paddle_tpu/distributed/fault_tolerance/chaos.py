@@ -19,21 +19,30 @@ one pointer check on the hot paths):
   ``timeout`` (raise :class:`ChaosCollectiveTimeout`, the retryable
   hang-detected error the retry wrapper in collective.py catches),
   ``hang`` (sleep ``delay=`` s *inside* the armed comm_task, so the real
-  watchdog fires).
+  watchdog fires), ``rank_dead`` (kill rank ``victim=`` mid-collective:
+  its membership lease is revoked via the elastic runtime's kill hook,
+  then the call hangs ``delay=`` s and dies with
+  :class:`ChaosCollectiveTimeout` — the full dead-peer experience).
 - ``store`` — ``drop`` (kill the client socket mid-request), ``garble``
   (corrupt the reply length so the client detects an implausible frame),
-  ``delay`` (sleep before the request).
+  ``delay`` (sleep before the request), ``partition`` (open a
+  ``delay=``-second network-partition window: every request in the
+  window fails with ConnectionError).
 - ``dispatch`` — ``nan`` / ``inf`` (poison the op's first floating
-  output leaf).
+  output leaf), ``rank_dead`` (kill rank ``victim=`` mid-step; the op
+  itself completes — death is discovered by membership/collectives).
 - ``fetch`` — ``stall`` (sleep ``delay=`` s inside scalar_fetch).
 - ``save`` — ``crash`` (``os._exit(137)`` mid-write: the kill -9
-  atomicity drill).
+  atomicity drill), ``rank_dead`` (kill rank ``victim=``
+  mid-checkpoint; the local write still completes).
 - ``serving`` — ``stall`` (sleep ``delay=`` s before the paged engine's
   fused step, driving in-flight requests past their deadlines so the
   deadline/shed path fires), ``reject`` (raise the engine's
   ``RejectedError`` load-shed signal at the step choke point).
 
-Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``,
+Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``
+(filter on the *calling* rank), ``victim=<int>`` (which rank a
+``rank_dead`` injection kills; default = the calling rank),
 ``step=<int>`` (the value of the chaos step clock — ticked by
 ``CheckpointManager.on_step`` / ``note_step``), ``call=<int>`` (the Nth
 call matching op/rank at this site, 0-based), ``count=<int>`` (max
@@ -75,28 +84,29 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
 
 _SITES = ("collective", "store", "dispatch", "fetch", "save", "serving")
 _KINDS = {
-    "collective": ("delay", "timeout", "hang"),
-    "store": ("drop", "garble", "delay"),
-    "dispatch": ("nan", "inf"),
+    "collective": ("delay", "timeout", "hang", "rank_dead"),
+    "store": ("drop", "garble", "delay", "partition"),
+    "dispatch": ("nan", "inf", "rank_dead"),
     "fetch": ("stall",),
-    "save": ("crash",),
+    "save": ("crash", "rank_dead"),
     "serving": ("stall", "reject"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
-_INT_SELECTORS = ("rank", "step", "call", "count")
+_INT_SELECTORS = ("rank", "victim", "step", "call", "count")
 
 
 class Injection:
-    __slots__ = ("site", "kind", "op", "rank", "step", "call", "count",
-                 "delay", "prob", "seen", "fired")
+    __slots__ = ("site", "kind", "op", "rank", "victim", "step", "call",
+                 "count", "delay", "prob", "seen", "fired")
 
-    def __init__(self, site, kind, op=None, rank=None, step=None, call=None,
-                 count=1, delay=0.05, prob=None):
+    def __init__(self, site, kind, op=None, rank=None, victim=None,
+                 step=None, call=None, count=1, delay=0.05, prob=None):
         self.site = site
         self.kind = kind
         self.op = op
         self.rank = rank
+        self.victim = victim
         self.step = step
         self.call = call
         self.count = count
@@ -107,7 +117,8 @@ class Injection:
 
     def __repr__(self):
         sel = {k: getattr(self, k) for k in
-               ("op", "rank", "step", "call", "count", "delay", "prob")
+               ("op", "rank", "victim", "step", "call", "count", "delay",
+                "prob")
                if getattr(self, k) is not None}
         return f"Injection({self.site}:{self.kind} {sel} fired={self.fired})"
 
@@ -156,6 +167,31 @@ _injections: List[Injection] = []
 _rng = random.Random(0)
 _STEP = [0]  # the chaos step clock (note_step)
 _installed = [False]
+
+# rank-kill hook: fn(victim_rank, site) installed by the ElasticRuntime —
+# a rank_dead injection revokes the victim's membership lease through it
+# (without a runtime, rank_dead degrades to its site's base fault)
+_rank_kill_hook = [None]
+
+# store-partition window: while monotonic() is below this, every store
+# request fails (set by a store:partition injection, delay= seconds wide)
+_partition_until = [0.0]
+
+
+def set_rank_kill_hook(fn):
+    prev = _rank_kill_hook[0]
+    _rank_kill_hook[0] = fn
+    return prev
+
+
+def _kill_victim(inj: Injection, rank: int, site: str):
+    kill = _rank_kill_hook[0]
+    victim = inj.victim if inj.victim is not None else rank
+    if kill is not None:
+        try:
+            kill(victim, site)
+        except Exception:  # noqa: BLE001 — the drill must not crash the job
+            pass
 
 
 def note_step(step: int):
@@ -209,13 +245,25 @@ def _match(site: str, op: Optional[str] = None,
 
 def _collective_hook(op: str, rank: int = 0):
     """Called by collective.py inside the retry wrapper, before each
-    attempt. May sleep (delay/hang) or raise (timeout)."""
+    attempt. May sleep (delay/hang), raise (timeout), or kill a rank's
+    membership lease and then die (rank_dead)."""
     inj = _match("collective", op=op, rank=rank)
     if inj is None:
         return
     if inj.kind == "delay" or inj.kind == "hang":
         time.sleep(inj.delay)
         return
+    if inj.kind == "rank_dead":
+        # the victim drops dead mid-collective: its lease is revoked, the
+        # call hangs long enough for a watchdog (if armed) to notice, then
+        # dies with the same error a declared-dead collective produces
+        _kill_victim(inj, rank, "collective")
+        if inj.delay:
+            time.sleep(inj.delay)
+        raise ChaosCollectiveTimeout(
+            f"[chaos] injected rank death: victim="
+            f"{inj.victim if inj.victim is not None else rank} op={op} "
+            f"step={_STEP[0]}")
     raise ChaosCollectiveTimeout(
         f"[chaos] injected collective timeout: op={op} rank={rank} "
         f"step={_STEP[0]}")
@@ -223,13 +271,21 @@ def _collective_hook(op: str, rank: int = 0):
 
 def _store_hook(op: str) -> Optional[str]:
     """Called by the TCPStore client per request; returns the fault kind
-    the client should apply ('drop' / 'garble'), or None."""
+    the client should apply ('drop' / 'garble'), or None. A 'partition'
+    injection opens a delay=-second window in which EVERY request drops
+    (one injection, many failures — a real partition, not a flaky
+    packet)."""
+    if time.monotonic() < _partition_until[0]:
+        return "drop"
     inj = _match("store", op=op)
     if inj is None:
         return None
     if inj.kind == "delay":
         time.sleep(inj.delay)
         return None
+    if inj.kind == "partition":
+        _partition_until[0] = time.monotonic() + inj.delay
+        return "drop"
     return inj.kind
 
 
@@ -238,6 +294,11 @@ def _dispatch_hook(name: str, result):
     the first floating-point output leaf with NaN/Inf."""
     inj = _match("dispatch", op=name)
     if inj is None:
+        return result
+    if inj.kind == "rank_dead":
+        # mid-step death: the op result is untouched — the victim's lease
+        # is gone and the next collective/membership poll discovers it
+        _kill_victim(inj, 0, "dispatch")
         return result
     import jax
     import jax.numpy as jnp
@@ -288,11 +349,17 @@ def _serving_hook(phase: str):
 
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
-    process (the kill -9 atomicity drill)."""
+    process (the kill -9 atomicity drill); 'rank_dead' revokes the
+    victim's lease mid-checkpoint (the local write still completes)."""
     import os
 
     inj = _match("save", op=phase)
-    if inj is not None and inj.kind == "crash":
+    if inj is None:
+        return
+    if inj.kind == "rank_dead":
+        _kill_victim(inj, 0, "save")
+        return
+    if inj.kind == "crash":
         os._exit(137)
 
 
@@ -354,6 +421,7 @@ def reconfigure(spec: Optional[str] = None):
     _injections = parse_spec(spec)
     _rng.seed(int(flags.flag_value("chaos_seed")))
     _STEP[0] = 0
+    _partition_until[0] = 0.0
     if _injections:
         _install()
     else:

@@ -57,6 +57,21 @@ flags.define_flag("rollback_budget", 3,
                   "not retry forever)")
 
 
+# Called (with the new step number) after every HEALTHY on_step() — i.e. at
+# a step boundary, once the snapshot/checkpoint schedule has ticked. The
+# elastic runtime hangs off this to apply deferred world grows (rank rejoin
+# is only admitted between steps, never mid-step).
+_step_boundary_hook = [None]
+
+
+def set_step_boundary_hook(fn):
+    """Register ``fn(step:int)`` to run after each healthy ``on_step``.
+    Pass None to clear. Returns the previous hook."""
+    prev = _step_boundary_hook[0]
+    _step_boundary_hook[0] = fn
+    return prev
+
+
 def _dev_copy(a):
     """A buffer the training loop can never donate/mutate from under us."""
     import jax.numpy as jnp
@@ -167,6 +182,14 @@ class CheckpointManager:
             self.save()
         else:
             self.snapshot()
+        hook = _step_boundary_hook[0]
+        if hook is not None:
+            try:
+                hook(self._step)
+            except Exception as e:  # noqa: BLE001 — a boundary hook must
+                # never poison the training loop's step accounting
+                _emit("ckpt.hook_error", step=self._step,
+                      error=f"{type(e).__name__}: {e}")
         return False
 
     @staticmethod
@@ -301,6 +324,24 @@ class CheckpointManager:
                 if any(m.endswith(".metadata") for m in os.listdir(d)):
                     out.append(s)
         return out
+
+    def last_good(self) -> Optional[dict]:
+        """The in-memory last-good snapshot (``{"step", "model",
+        "opt_accs", "opt_step"}``) — the elastic reshard fallback reads
+        optimizer state from here when a lost rank's shard cannot be
+        reconstructed in place."""
+        return self._last_good
+
+    def restore_last_good(self) -> Optional[int]:
+        """Roll model+optimizer back to the in-memory last-good snapshot
+        without counting it against the NaN rollback budget (elastic
+        reconfiguration fallback). Returns the restored step, or None."""
+        if self._last_good is None:
+            return None
+        self._restore(self._last_good)
+        self._step = self._last_good["step"]
+        chaos.note_step(self._step)
+        return self._step
 
     def latest_step(self) -> Optional[int]:
         """Newest finalized checkpoint step (honors the ``latest`` pointer,

@@ -405,6 +405,24 @@ class _Reducer:
         """Drop the cached plan (param set / comm dtype changed)."""
         self._plan = None
 
+    def rebind_group(self, group: Optional[coll.Group]):
+        """Point the reducer at a new process group (elastic
+        reconfiguration). In-flight bucket state belongs to the old
+        world and is dropped; the plan rebuilds lazily against the new
+        group — its signature includes gid+nranks, so the pack/unpack
+        executables for the new world size are traced fresh."""
+        self._group = group
+        self._dirty = False
+        self._outstanding = []
+        if self._plan is not None:
+            for b in self._plan.buckets:
+                b.ready.clear()
+                b.issued = False
+                b.task = None
+                b.out_ref = None
+                b.flat_grad = None
+        self._plan = None
+
     def shard_active(self) -> bool:
         return (self.shard_bound
                 and bool(flags.flag_value("dp_shard_update"))
@@ -584,6 +602,30 @@ class DataParallel(Layer):
         explicit calls are optional."""
         self._reducer.flush_and_drain(force=True)
 
+    def rebind_group(self, group: Optional[coll.Group]):
+        """Rebind to a new process group after an elastic
+        reconfiguration (see ``paddle_tpu.distributed.elastic``). Bucket
+        plans and collective executables for the old world are dropped
+        and rebuilt lazily on the next backward; params (and any
+        lingering grads) committed to the OLD mesh are re-placed
+        replicated on the new mesh — executables traced for the new
+        world refuse inputs pinned to departed devices."""
+        self._group = group
+        self._reducer.rebind_group(group)
+        mesh = getattr(group, "_mesh", None) if group is not None else None
+        if mesh is not None:
+            repl = NamedSharding(mesh, P())
+            for t in self._layers.state_dict().values():
+                try:
+                    t._data = jax.device_put(t._data, repl)
+                except Exception:  # noqa: BLE001 — non-array leaf
+                    pass
+                if getattr(t, "_grad", None) is not None:
+                    try:
+                        t._grad = jax.device_put(t._grad, repl)
+                    except Exception:  # noqa: BLE001
+                        t._grad = None
+
     # -- Layer protocol passthrough -------------------------------------
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)
@@ -760,6 +802,74 @@ class ShardedUpdate:
             b.flat_grad = None
             b.pseudo._grad = None
         return None
+
+    def reshard(self, new_group: coll.Group):
+        """Re-partition the ZeRO-1 flat optimizer-state shards for a new
+        world size (elastic reconfiguration, no restart).
+
+        Each flat accumulator (moment1/moment2/velocity over a bucket's
+        pseudo-param) is sliced back to its true ``numel``, re-padded to
+        the new group's multiple-of-nranks length, and re-placed with
+        the new mesh's shard sharding. Bit-exact for the elementwise
+        optimizers (Adam/AdamW/Momentum): the pad region holds zero
+        grads and zero state by construction, so dropping and re-adding
+        it changes no owned element. Scalar accumulators (beta-pow,
+        step counters) are carried over untouched."""
+        r = self._reducer
+        # (re)build the OLD world's layout before rebinding: the padded
+        # sizes of the existing accumulators come from the old group, and
+        # a back-to-back reshard (shrink then grow with no step between)
+        # arrives with the plan already dropped
+        old_plan = r._ensure_plan()
+        self._group = new_group
+        self._model.rebind_group(new_group)  # drops the reducer plan
+        if old_plan is None:
+            return
+        new_n = max(1, getattr(new_group, "nranks", 1))
+        mesh = getattr(new_group, "_mesh", None)
+        axis = getattr(new_group, "axis_name", None)
+        shard_sh = NamedSharding(mesh, P(axis)) if mesh is not None else None
+        accs = getattr(self._opt, "_accumulators", {})
+        moved = 0
+        for b in old_plan.buckets:
+            store = accs.get(f"_dp_flat_b{b.index}")
+            if store:
+                new_padded = -(-b.numel // new_n) * new_n
+                repl_sh = (NamedSharding(mesh, P())
+                           if mesh is not None else None)
+                for name, a in list(store.items()):
+                    if tuple(getattr(a, "shape", ())) != (b.padded,):
+                        # scalar accumulator (beta-pow etc.) — world-size
+                        # free, but still pinned to the old mesh
+                        if repl_sh is not None:
+                            try:
+                                store[name] = jax.device_put(
+                                    jnp.asarray(a), repl_sh)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        continue
+                    flat = jnp.asarray(a)[:b.numel]
+                    if new_padded > b.numel:
+                        flat = jnp.concatenate(
+                            [flat,
+                             jnp.zeros((new_padded - b.numel,), flat.dtype)])
+                    if shard_sh is not None:
+                        flat = jax.device_put(flat, shard_sh)
+                    store[name] = flat
+                    moved += 1
+            # per-bucket sharded state was packed for the OLD padded size
+            b.flat_grad = None
+            b.flat_param = None
+            b.out_ids = None
+            b.pseudo = None
+        # fused-step executables are keyed on accumulator shapes; the
+        # old-world entries can never hit again
+        if hasattr(self._opt, "_fused_cache"):
+            self._opt._fused_cache.clear()
+        if hasattr(self._opt, "_fused_seen"):
+            self._opt._fused_seen.clear()
+        _obs_emit("dp.reshard", buckets=len(old_plan.buckets),
+                  accumulators=moved, nranks=new_n)
 
     def optimizer_state_bytes_per_device(self) -> int:
         """Max optimizer-state bytes resident on any single device — the

@@ -12,10 +12,12 @@ service.
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from ..core import flags
@@ -25,7 +27,11 @@ from ..observability import emit as _emit
 
 flags.define_flag("store_retries", 2,
                   "Bounded reconnect+retry attempts for idempotent TCPStore "
-                  "ops (get/check/wait) after a transport error; 0 disables")
+                  "ops after a transport error; 0 disables. get/check/wait "
+                  "and set are value-idempotent; add rides a per-call "
+                  "idempotency token the server deduplicates, so a replayed "
+                  "increment returns the recorded result instead of "
+                  "double-counting")
 flags.define_flag("store_retry_backoff", 0.05,
                   "Base seconds for exponential backoff between TCPStore "
                   "retries (doubles per attempt)")
@@ -40,7 +46,11 @@ def set_chaos_hook(fn):
 
 
 _OP_NAMES = {0: "set", 1: "get", 2: "add", 3: "check", 4: "delete",
-             5: "ping"}
+             5: "ping", 6: "add"}  # 6 = ADD_TOKEN: add w/ idempotency token
+
+# server-side dedup: how many applied idempotency tokens to remember (FIFO;
+# a token only needs to survive its own retry window)
+_TOKEN_WINDOW = 4096
 
 # replies larger than this are corruption, not data — the server frames
 # every reply with a <Q length, and a garbled frame shows up here first
@@ -52,6 +62,7 @@ class _PyStoreServer:
 
     def __init__(self, port: int):
         self._kv = {}
+        self._applied = OrderedDict()  # idempotency token -> ADD result
         self._cv = threading.Condition()
         self._stop = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -106,6 +117,23 @@ class _PyStoreServer:
                             "<q", self._kv.get(key, b"\0" * 8)[:8])[0]
                         now = cur + delta
                         self._kv[key] = struct.pack("<q", now)
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<Q", 8) + struct.pack("<q", now))
+                elif op == 6:  # ADD_TOKEN: val = <q delta + idempotency token
+                    delta = struct.unpack("<q", val[:8])[0]
+                    token = val[8:]
+                    with self._cv:
+                        if token and token in self._applied:
+                            now = self._applied[token]  # replayed: no-op
+                        else:
+                            cur = struct.unpack(
+                                "<q", self._kv.get(key, b"\0" * 8)[:8])[0]
+                            now = cur + delta
+                            self._kv[key] = struct.pack("<q", now)
+                            if token:
+                                self._applied[token] = now
+                                while len(self._applied) > _TOKEN_WINDOW:
+                                    self._applied.popitem(last=False)
                         self._cv.notify_all()
                     conn.sendall(struct.pack("<Q", 8) + struct.pack("<q", now))
                 elif op == 3:  # CHECK
@@ -217,6 +245,13 @@ class _PyStoreClient:
         return struct.unpack("<q", self._req(2, key,
                                              struct.pack("<q", delta)))[0]
 
+    def add_token(self, key, delta, token: bytes):
+        """ADD with a per-call idempotency token: the server applies the
+        increment once and records token -> result, so a retried call after
+        an ambiguous failure returns the recorded result."""
+        return struct.unpack(
+            "<q", self._req(6, key, struct.pack("<q", delta) + token))[0]
+
     def check(self, key):
         return self._req(3, key) == b"\x01"
 
@@ -281,9 +316,10 @@ class TCPStore:
             self._client = type(c)(self.host, self.port, self._timeout_ms)
 
     def _retry_idempotent(self, opname: str, fn):
-        """Bounded reconnect+retry with backoff. ONLY for idempotent ops
-        (get/check/wait): retrying a set/add after an ambiguous failure
-        could double-apply it."""
+        """Bounded reconnect+retry with backoff, for idempotent ops only:
+        get/check/wait and set are value-idempotent, and add goes through
+        ADD_TOKEN (the server deduplicates the per-call token, so a replay
+        can't double-count)."""
         retries = max(0, int(flags.flag_value("store_retries")))
         attempt = 0
         while True:
@@ -303,15 +339,25 @@ class TCPStore:
                     pass  # next attempt surfaces the failure
 
     def set(self, key: str, value) -> None:
+        # last-writer-wins makes set value-idempotent: replaying the same
+        # write after an ambiguous failure converges to the same state
         if isinstance(value, str):
             value = value.encode()
-        self._client.set(key, bytes(value))
+        value = bytes(value)
+        self._retry_idempotent("set", lambda: self._client.set(key, value))
 
     def get(self, key: str) -> bytes:
         return self._retry_idempotent("get", lambda: self._client.get(key))
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._client.add(key, amount)
+        add_token = getattr(self._client, "add_token", None)
+        if add_token is None:
+            # client without token support (e.g. a stale native lib):
+            # replaying could double-count, so don't retry
+            return self._client.add(key, amount)
+        token = os.urandom(16)  # per-call identity survives the retry window
+        return self._retry_idempotent(
+            "add", lambda: self._client.add_token(key, amount, token))
 
     def check(self, key: str) -> bool:
         return self._retry_idempotent("check",
@@ -331,13 +377,18 @@ class TCPStore:
                     raise TimeoutError(f"TCPStore wait({key!r}) timed out")
                 time.sleep(0.02)
 
-    def barrier(self, key: str = "_barrier", timeout: float = 300.0):
+    def barrier(self, key: str = "_barrier", timeout: float = 300.0,
+                world_size: Optional[int] = None):
         # per-generation keys make the barrier reusable (every rank calls
-        # barrier the same number of times, so generations stay aligned)
+        # barrier the same number of times, so generations stay aligned).
+        # `world_size` overrides the launch-time count — after an elastic
+        # shrink the barrier must count the CURRENT world, not wait for a
+        # rank that is never coming back.
+        ws = int(world_size) if world_size else self.world_size
         gen = self._barrier_gen
         self._barrier_gen += 1
         n = self.add(f"{key}/{gen}/count", 1)
-        if n == self.world_size:
+        if n >= ws:
             self.set(f"{key}/{gen}/done", b"1")
         self.wait(f"{key}/{gen}/done", timeout)
 

@@ -205,6 +205,14 @@ _c_srv_prefix = _C("paddle_serving_prefix_cached_tokens_total",
                    "instead of recompute")
 _c_srv_cow = _C("paddle_serving_cow_copies_total",
                 "Copy-on-write KV page copies executed on device")
+_c_elastic = _C("paddle_elastic_events_total",
+                "Elastic-runtime lifecycle events, by kind (start/"
+                "rank_dead/epoch_bump/reconfigure/rejoin/refuse/...)")
+_g_elastic_world = _G("paddle_elastic_world_size",
+                      "Live world size as of the last elastic event")
+_h_elastic_reconf = _H("paddle_elastic_reconfigure_seconds",
+                       "Wall time of elastic world reconfigurations "
+                       "(epoch bump to resharded state published)")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -369,6 +377,14 @@ _HANDLERS = {
     "dp.overlap": lambda d, f: _g_dp_overlap.set(f.get("efficiency", 0.0)),
     "dp.pack_call": lambda d, f: _c_dp_packs.inc(),
     "dp.pack_build": lambda d, f: _c_dp_builds.inc(),
+    "dp.reshard": lambda d, f: _c_elastic.inc(labels={"kind": "reshard"}),
+    "elastic.event": lambda d, f: _c_elastic.inc(
+        labels={"kind": f.get("event", "")}),
+    "elastic.world": lambda d, f: _g_elastic_world.set(f.get("world", 0)),
+    "elastic.reconfigure": lambda d, f: (
+        _c_elastic.inc(labels={"kind": "reconfigure"}),
+        _g_elastic_world.set(f.get("world", 0)),
+        _h_elastic_reconf.observe(d) if d is not None else None),
     "enforce.error": lambda d, f: _c_enf.inc(
         labels={"type": f.get("type", "")}),
     "distress.dump": lambda d, f: _c_dumps.inc(
@@ -432,6 +448,15 @@ def summary() -> dict:
         "dp_overlap_efficiency": round(float(_g_dp_overlap.value()), 4),
         "dp_flat_pack_builds": int(_c_dp_builds.value()),
         "events_recorded": _recorder.written(),
+        "elastic": {
+            "reconfigurations": int(_c_elastic.value(
+                {"kind": "reconfigure"})),
+            "rank_deaths": int(_c_elastic.value({"kind": "rank_dead"})),
+            "rejoins": int(_c_elastic.value({"kind": "rejoin"})),
+            "world_size": int(_g_elastic_world.value()),
+            "reconfigure_p50_s": round(_h_elastic_reconf.percentile(50), 6),
+            "reconfigure_p99_s": round(_h_elastic_reconf.percentile(99), 6),
+        },
         "serving": {
             "admitted": int(_c_srv_req.value({"event": "admitted"})),
             "completed": int(_c_srv_req.value({"event": "completed"})),

@@ -219,11 +219,41 @@ def test_store_wait_survives_drop(store_pair):
     client.wait("ready", timeout=10.0)  # check() path retries internally
 
 
-def test_store_non_idempotent_set_not_retried(store_pair):
+def test_store_set_retried_value_idempotent(store_pair):
+    """set is last-writer-wins, so replaying the same write after an
+    ambiguous failure converges — it rides the retry path now."""
     _, client = store_pair
+    before = _metric("paddle_store_retries_total", {"op": "set"})
     chaos.reconfigure("store:drop@op=set;count=1")
-    with pytest.raises((ConnectionError, OSError)):
-        client.set("k2", b"x")  # ambiguous failure must propagate
+    client.set("k2", b"x")
+    assert client.get("k2") == b"x"
+    assert _metric("paddle_store_retries_total",
+                   {"op": "set"}) == before + 1
+
+
+def test_store_add_idempotent_token_no_double_count(store_pair):
+    """add carries a per-call idempotency token: a retry after a lost
+    reply must not double-count (the server replays the recorded
+    result)."""
+    _, client = store_pair
+    assert client.add("ctr", 5) == 5
+    before = _metric("paddle_store_retries_total", {"op": "add"})
+    chaos.reconfigure("store:drop@op=add;count=1")
+    v = client.add("ctr", 3)
+    assert v == 8  # exactly one application across the retry
+    assert client.add("ctr", 1) == 9
+    assert _metric("paddle_store_retries_total",
+                   {"op": "add"}) == before + 1
+
+
+def test_store_add_token_replay_returns_recorded_result(store_pair):
+    """The wire-level dedup contract: replaying the same token returns
+    the recorded result instead of re-applying the delta."""
+    _, client = store_pair
+    token = b"\x01" * 16
+    assert client._client.add_token("tok", 7, token) == 7
+    assert client._client.add_token("tok", 7, token) == 7  # replay
+    assert client._client.add_token("tok", 7, b"\x02" * 16) == 14
 
 
 # ---------------------------------------------------------------------------
@@ -555,19 +585,25 @@ def test_dist_checkpoint_truncated_metadata_fails_loudly(tmp_path):
         dckpt.load_state_dict({"w": paddle.zeros([4])}, str(tmp_path))
 
 
-def test_reshard_on_load_after_simulated_rank_loss(tmp_path):
-    """A checkpoint written under a 4-way sharding loads into a 2-way
-    sharded target — the reshard-on-load path a shrunken gang uses after
-    losing ranks (CRC verified along the way)."""
-    mesh4 = dist.ProcessMesh([0, 1, 2, 3], dim_names=["mp"])
+@pytest.mark.parametrize("save_ranks,load_ranks", [
+    ([0, 1, 2, 3], [0, 1]),        # shrink: survivors after a rank loss
+    ([0, 1], [0, 1, 2, 3]),        # grow: rejoined ranks widen the mesh
+    ([0, 1, 2, 3], [0, 1, 2, 3, 4, 5, 6, 7]),  # grow past launch world
+], ids=["shrink-4to2", "grow-2to4", "grow-4to8"])
+def test_reshard_on_load_after_world_change(tmp_path, save_ranks,
+                                            load_ranks):
+    """A checkpoint written under one sharding loads into a differently
+    sized mesh — the reshard-on-load path used after losing ranks
+    (shrink) or re-admitting them (grow), CRC verified along the way."""
+    save_mesh = dist.ProcessMesh(save_ranks, dim_names=["mp"])
     w = paddle.to_tensor(
         np.arange(64, dtype=np.float32).reshape(16, 4))
     ref = w.numpy().copy()
-    sharded = dist.shard_tensor(w, mesh4, [dist.Shard(0)])
+    sharded = dist.shard_tensor(w, save_mesh, [dist.Shard(0)])
     dckpt.save_state_dict({"w": sharded}, str(tmp_path))
 
-    mesh2 = dist.ProcessMesh([0, 1], dim_names=["mp"])  # the survivors
-    target = dist.shard_tensor(paddle.zeros([16, 4]), mesh2,
+    load_mesh = dist.ProcessMesh(load_ranks, dim_names=["mp"])
+    target = dist.shard_tensor(paddle.zeros([16, 4]), load_mesh,
                                [dist.Shard(0)])
     sd = {"w": target}
     dckpt.load_state_dict(sd, str(tmp_path))

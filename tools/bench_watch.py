@@ -261,6 +261,49 @@ def maybe_serving_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/serving_smoke.py)")
 
 
+_last_elastic_smoke = [0.0]
+
+
+def maybe_elastic_smoke(min_interval: float = 3600.0) -> None:
+    """Run the elastic drill smoke (tools/elastic_smoke.py) at most once
+    per min_interval and log a RED line on regression — a kill-one-rank
+    drill that doesn't reconfigure exactly once, diverges from the
+    uninterrupted N-1 run, or retraces in steady state is build-signal
+    the same way the perf floor is."""
+    now = time.monotonic()
+    if _last_elastic_smoke[0] and now - _last_elastic_smoke[0] < min_interval:
+        return
+    _last_elastic_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "elastic_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: elastic smoke hung >600s — elastic runtime broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"elastic smoke GREEN ({payload.get('wall_s')}s: "
+            f"{payload.get('reconfigures')} reconfigure, "
+            f"world {payload.get('world')}, "
+            f"loss_gap={payload.get('loss_gap')}, "
+            f"steady retraces={payload.get('fused_builds_steady_state')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: elastic smoke regression rc={out.returncode} — {detail} "
+        f"(tools/elastic_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -367,6 +410,7 @@ def main() -> None:
         maybe_chaos_smoke()
         maybe_dp_overlap_smoke()
         maybe_serving_smoke()
+        maybe_elastic_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -376,6 +420,7 @@ def main() -> None:
             maybe_chaos_smoke()
             maybe_dp_overlap_smoke()
             maybe_serving_smoke()
+            maybe_elastic_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
