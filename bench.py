@@ -612,6 +612,73 @@ def _serving_paged_details():
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
+def _serving_router_details():
+    """Sub-config: the multi-replica router under a chaos replica kill —
+    one of two replicas dies mid-decode, every stream must fail over and
+    finish bit-exact vs a single replica-shaped engine on the same trace.
+    red_signal fires on a dropped stream, a replay-confirm divergence, or
+    a survivor retrace (tools/router_smoke.py is the full gate with the
+    throughput floor)."""
+    from paddle_tpu.distributed.fault_tolerance import chaos
+    from paddle_tpu.inference.serving import (PagedServingEngine,
+                                              ServingRouter)
+    from paddle_tpu.models import llama as L
+
+    try:
+        cfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=4, max_seq_len=96, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        n_req, new = 8, 8
+        rs = np.random.RandomState(0)
+        shared = rs.randint(1, cfg.vocab_size, size=16).tolist()
+        prompts = [shared + rs.randint(1, cfg.vocab_size, size=4).tolist()
+                   for _ in range(n_req)]
+
+        def factory():
+            return PagedServingEngine(cfg, params, num_blocks=96,
+                                      block_size=8, max_batch=8,
+                                      token_budget=32,
+                                      max_len=cfg.max_seq_len)
+
+        eng = factory()
+        rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        ref = {c.rid: c.output_tokens for c in eng.run()}
+        single_out = [ref[r] for r in rids]
+
+        chaos.reconfigure("replica:kill@victim=0;call=5")
+        try:
+            t0 = time.perf_counter()
+            router = ServingRouter(factory, num_replicas=2,
+                                   probation_s=1e9,
+                                   tenant_weights={"default": n_req})
+            rids = [router.submit(p, max_new_tokens=new) for p in prompts]
+            done = {c.rid: c for c in router.run()}
+            wall = time.perf_counter() - t0
+        finally:
+            chaos.reconfigure("")
+        outs = [done[r].output_tokens if r in done else None for r in rids]
+        dropped = sum(1 for r in rids
+                      if r not in done or done[r].finish_reason != "length")
+        survivor = router.replicas[1].engine
+        return {
+            "requests": n_req, "new_tokens": new,
+            "parity_through_failover": outs == single_out,
+            "dropped_streams": dropped,
+            "failovers": router.stats["failovers"],
+            "mismatches": router.stats["mismatches"],
+            "survivor_step_builds": (survivor.stats["step_builds"]
+                                     if survivor is not None else None),
+            "drill_tokens_per_s": round(n_req * new / wall, 1),
+            "red_signal": bool(outs != single_out or dropped
+                               or router.stats["mismatches"]
+                               or (survivor is not None
+                                   and survivor.stats["step_builds"] != 1)),
+        }
+    except Exception as e:  # noqa: BLE001 — keep the config measurable
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def bench_llama_decode():
     """tokens/s of the jitted cached decode step (inference/llm.py) — the
     serving-path analog of the reference's block/masked-MHA decode loop."""
@@ -670,6 +737,7 @@ def bench_llama_decode():
             details["throughput_b32"] = {"error": f"{type(e).__name__}: "
                                                   f"{str(e)[:160]}"}
     details["llama_serving_paged"] = _serving_paged_details()
+    details["llama_serving_router"] = _serving_router_details()
     return {
         "value": round(tps, 2), "unit": "decode_tokens/s/chip",
         "details": details,

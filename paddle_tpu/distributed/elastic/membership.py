@@ -27,6 +27,18 @@ from typing import Dict, List, Optional
 from ..fleet.elastic.manager import ElasticManager
 
 
+def live_by_beat(beats: Dict[int, float], ttl: float,
+                 now: Optional[float] = None) -> List[int]:
+    """THE liveness judgment, as a pure function: a member is live iff its
+    last beat is at most ``ttl`` seconds old. Both membership classes and
+    the serving router's replica health checks
+    (inference/serving/replica.py) run this same function, so "dead"
+    means the same thing for a training rank and a serving replica."""
+    if now is None:
+        now = time.monotonic()
+    return sorted(m for m, t in beats.items() if now - t <= ttl)
+
+
 class LocalMembership:
     """TTL-leased membership for the single-controller simulation.
 
@@ -68,17 +80,14 @@ class LocalMembership:
         # liveness is judged by beat freshness alone: a silently-killed
         # rank (wedged host) keeps its stale beat until the TTL lapses,
         # an immediate kill (revoked lease) has no beat at all
-        now = time.monotonic()
         with self._lock:
-            return sorted(r for r, t in self._beats.items()
-                          if now - t <= self.ttl)
+            return live_by_beat(self._beats, self.ttl)
 
     def snapshot(self) -> dict:
         now = time.monotonic()
         with self._lock:
             return {
-                "live": sorted(r for r, t in self._beats.items()
-                               if now - t <= self.ttl),
+                "live": live_by_beat(self._beats, self.ttl, now),
                 "ttl": self.ttl,
                 "beat_age_s": {
                     str(r): round(now - t, 3)

@@ -39,10 +39,18 @@ one pointer check on the hot paths):
   fused step, driving in-flight requests past their deadlines so the
   deadline/shed path fires), ``reject`` (raise the engine's
   ``RejectedError`` load-shed signal at the step choke point).
+- ``replica`` — router-level replica faults at the ReplicaHandle's
+  guarded-step choke point, filtered by ``victim=<replica_id>``:
+  ``kill`` (raise ``ReplicaKilledError`` — the replica is dead, its
+  streams fail over), ``stall`` (sleep ``delay=`` s and report a stall
+  strike: healthy → degraded → dead), ``flap`` (a transient strike with
+  no sleep — recovers on the next good step unless it strikes out).
 
 Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``
 (filter on the *calling* rank), ``victim=<int>`` (which rank a
-``rank_dead`` injection kills; default = the calling rank),
+``rank_dead`` injection kills — and, at the ``replica`` site, which
+replica id the injection applies to: other replicas don't even count
+toward ``call=``; default = the calling rank),
 ``step=<int>`` (the value of the chaos step clock — ticked by
 ``CheckpointManager.on_step`` / ``note_step``), ``call=<int>`` (the Nth
 call matching op/rank at this site, 0-based), ``count=<int>`` (max
@@ -82,7 +90,8 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
     retryable error class the collective retry wrapper backs off on."""
 
 
-_SITES = ("collective", "store", "dispatch", "fetch", "save", "serving")
+_SITES = ("collective", "store", "dispatch", "fetch", "save", "serving",
+          "replica")
 _KINDS = {
     "collective": ("delay", "timeout", "hang", "rank_dead"),
     "store": ("drop", "garble", "delay", "partition"),
@@ -90,6 +99,7 @@ _KINDS = {
     "fetch": ("stall",),
     "save": ("crash", "rank_dead"),
     "serving": ("stall", "reject"),
+    "replica": ("kill", "stall", "flap"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
@@ -213,13 +223,20 @@ def injections() -> List[Injection]:
 
 
 def _match(site: str, op: Optional[str] = None,
-           rank: Optional[int] = None) -> Optional[Injection]:
+           rank: Optional[int] = None,
+           victim: Optional[int] = None) -> Optional[Injection]:
     for inj in _injections:
         if inj.site != site:
             continue
         if inj.op is not None and inj.op != op:
             continue
         if inj.rank is not None and rank is not None and inj.rank != rank:
+            continue
+        # victim= as a FILTER (replica site): a non-matching caller does
+        # not even count toward call= — `call=3` means the victim's 4th
+        # own step, deterministic regardless of fleet interleaving
+        if (victim is not None and inj.victim is not None
+                and inj.victim != victim):
             continue
         idx = inj.seen
         inj.seen += 1
@@ -347,6 +364,26 @@ def _serving_hook(phase: str):
         f"step={_STEP[0]}")
 
 
+def _replica_hook(phase: str, replica_id: int):
+    """Called by ReplicaHandle.guarded_step before each engine tick.
+    'kill' raises ReplicaKilledError (the handle declares itself dead
+    and the router fails its streams over); 'stall'/'flap' return the
+    kind for the handle's breaker to judge as a strike ('stall' also
+    sleeps ``delay=`` so in-flight deadlines really burn)."""
+    inj = _match("replica", op=phase, victim=replica_id)
+    if inj is None:
+        return None
+    if inj.kind == "kill":
+        from ...inference.serving.replica import ReplicaKilledError
+
+        raise ReplicaKilledError(
+            f"[chaos] injected replica kill: replica={replica_id} "
+            f"phase={phase} step={_STEP[0]}")
+    if inj.kind == "stall" and inj.delay:
+        time.sleep(inj.delay)
+    return inj.kind
+
+
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
     process (the kill -9 atomicity drill); 'rank_dead' revokes the
@@ -379,8 +416,10 @@ def _install():
     store.set_chaos_hook(_store_hook)
     async_engine.set_chaos_hook(_fetch_hook)
     from ...inference.serving import engine as serving_engine
+    from ...inference.serving import replica as serving_replica
 
     serving_engine.set_chaos_hook(_serving_hook)
+    serving_replica.set_chaos_hook(_replica_hook)
     _installed[0] = True
 
 
@@ -396,8 +435,10 @@ def _uninstall():
     store.set_chaos_hook(None)
     async_engine.set_chaos_hook(None)
     from ...inference.serving import engine as serving_engine
+    from ...inference.serving import replica as serving_replica
 
     serving_engine.set_chaos_hook(None)
+    serving_replica.set_chaos_hook(None)
     _installed[0] = False
 
 

@@ -1,6 +1,6 @@
 """LLM serving subsystem.
 
-Two engines share this package:
+Two engines and a fleet router share this package:
 
 - :class:`PagedServingEngine` (``engine.py``) — the production path: a
   paged KV block pool with prefix caching (``block_manager.py``), a
@@ -9,18 +9,30 @@ Two engines share this package:
   fixed-shape mixed prefill+decode step over
   ``block_multihead_attention_`` with streaming token delivery;
 - :class:`ServingEngine` (``slot_engine.py``) — the dense per-slot
-  baseline the smoke gate compares against.
+  baseline the smoke gate compares against;
+- :class:`ServingRouter` (``router.py``) + :class:`ReplicaHandle`
+  (``replica.py``) — resilient multi-replica serving: health-checked
+  circuit breakers over N identical engines, mid-stream failover with
+  bit-exact replay confirmation, prefix-affinity routing, per-tenant
+  weighted fair admission, graceful drain.
 
-Both report SLO metrics through ``observability.summary()["serving"]``.
+All report SLO metrics through ``observability.summary()`` (sections
+``"serving"`` and ``"router"``).
 """
 from .block_manager import BlockManager, NoFreeBlocksError
 from .engine import PagedServingEngine, TokenEvent
-from .scheduler import RejectedError, ScheduledBatch, Scheduler, Sequence
+from .replica import ReplicaDeadError, ReplicaHandle, ReplicaKilledError
+from .router import FailoverMismatchError, RouterRequest, ServingRouter
+from .scheduler import (DeadlineExceededError, RejectedError,
+                        ScheduledBatch, Scheduler, Sequence)
 from .slot_engine import Completion, Request, ServingEngine
 
 __all__ = [
     "BlockManager", "NoFreeBlocksError",
     "PagedServingEngine", "TokenEvent",
-    "RejectedError", "ScheduledBatch", "Scheduler", "Sequence",
+    "RejectedError", "DeadlineExceededError",
+    "ScheduledBatch", "Scheduler", "Sequence",
     "Completion", "Request", "ServingEngine",
+    "ServingRouter", "RouterRequest", "FailoverMismatchError",
+    "ReplicaHandle", "ReplicaKilledError", "ReplicaDeadError",
 ]

@@ -64,11 +64,15 @@ class BlockManager:
         # per-sequence block tables
         self._tables: Dict[int, List[int]] = {}
         # pending device copies (src, dst) the engine must execute before
-        # the next step touches dst
+        # the next step touches dst. src pages are ref-pinned while a copy
+        # is pending so allocation pressure cannot reclaim (and another
+        # sequence reuse) the source before the device copy runs; the pin
+        # is released by take_copies() or by purging the pair when the
+        # owning sequence is freed first (cancel mid-chunked-prefill).
         self._pending_copies: List[Tuple[int, int]] = []
         self.stats = {"allocs": 0, "frees": 0, "prefix_hit_blocks": 0,
                       "prefix_hit_tokens": 0, "cow_copies": 0,
-                      "cache_evictions": 0}
+                      "cache_evictions": 0, "cow_purged": 0}
 
     # -- capacity ---------------------------------------------------------
     def num_free(self) -> int:
@@ -170,6 +174,7 @@ class BlockManager:
                 if best is not None:
                     src, n_match = best
                     dst = self._alloc_block()
+                    self._incref(src)          # pin until the copy executes
                     new_copies.append((src, dst))
                     table.append(dst)
                     self.stats["cow_copies"] += 1
@@ -185,11 +190,12 @@ class BlockManager:
             if full_run and i >= len(tokens):
                 src = table[-1]
                 dst = self._alloc_block()
-                new_copies.append((src, dst))
+                new_copies.append((src, dst))   # table drop keeps src's ref
                 table[-1] = dst
-                self._decref(src)
                 self.stats["cow_copies"] += 1
         except NoFreeBlocksError:
+            for src, _ in new_copies:
+                self._decref(src)              # release the copy pins
             for b in table:
                 self._decref(b)
             raise
@@ -263,9 +269,26 @@ class BlockManager:
 
     def free_sequence(self, seq_id: int):
         table = self._tables.pop(seq_id, None)
-        if table:
-            for blk in table:
-                self._decref(blk)
+        if not table:
+            return
+        if self._pending_copies:
+            # drop not-yet-executed COW copies whose destination dies with
+            # this table (cancel mid-chunked-prefill): the dst page is
+            # about to be freed and may be handed to another sequence — a
+            # stale device copy into it would corrupt that sequence's KV.
+            # Destinations are private (ref==1, exactly one table), so
+            # membership in this table identifies this sequence's pairs.
+            dsts = set(table)
+            kept: List[Tuple[int, int]] = []
+            for src, dst in self._pending_copies:
+                if dst in dsts:
+                    self._decref(src)          # release the copy pin
+                    self.stats["cow_purged"] += 1
+                else:
+                    kept.append((src, dst))
+            self._pending_copies = kept
+        for blk in table:
+            self._decref(blk)
 
     def block_table(self, seq_id: int) -> List[int]:
         return list(self._tables[seq_id])
@@ -278,6 +301,29 @@ class BlockManager:
 
     def take_copies(self) -> List[Tuple[int, int]]:
         """Drain the pending (src, dst) COW page copies; the engine must
-        execute them on the device cache before its next step."""
+        execute them on the device cache before its next step (the src
+        pin is released here, so the copy must run before any further
+        allocation can recycle the page)."""
         out, self._pending_copies = self._pending_copies, []
+        for src, _ in out:
+            self._decref(src)
         return out
+
+    def lookup_prefix(self, tokens: Sequence[int]) -> int:
+        """How many leading tokens of `tokens` the pool could serve from
+        the prefix cache right now (full-block chain hits only), WITHOUT
+        allocating — the router's prefix-affinity signal. Capped at
+        len(tokens)-1 like allocate_sequence's `cached`."""
+        tokens = [int(t) for t in tokens]
+        bs = self.block_size
+        prev_h, i, n = 0, 0, 0
+        while i + bs <= len(tokens):
+            h = _chain_hash(prev_h, tuple(tokens[i:i + bs]))
+            blk = self._hash_to_block.get(h)
+            if blk is None or (blk not in self._refs
+                               and blk not in self._cached_free):
+                break
+            n += bs
+            prev_h = h
+            i += bs
+        return min(n, max(len(tokens) - 1, 0))

@@ -47,10 +47,12 @@ from ...models import llama as L
 from ...observability import emit as _emit
 from ...ops.kernels.serving_attention import block_multihead_attention_
 from .block_manager import BlockManager
-from .scheduler import (RejectedError, ScheduledBatch, Scheduler, Sequence)
+from .scheduler import (DeadlineExceededError, RejectedError, ScheduledBatch,
+                        Scheduler, Sequence)
 from .slot_engine import Completion
 
-__all__ = ["PagedServingEngine", "TokenEvent", "RejectedError"]
+__all__ = ["PagedServingEngine", "TokenEvent", "RejectedError",
+           "DeadlineExceededError"]
 
 # chaos harness hook (site "serving"): installed by
 # distributed/fault_tolerance/chaos.py while a spec is active
@@ -231,7 +233,13 @@ class PagedServingEngine:
 
     def stream(self, rid: int) -> Iterator[int]:
         """Yield rid's tokens as they are produced, driving the engine
-        while the request is live (other requests progress too)."""
+        while the request is live (other requests progress too).
+
+        Mid-flight failures are TYPED, never a silently truncated stream:
+        a deadline expiry raises :class:`DeadlineExceededError`, a shed
+        raises :class:`RejectedError` (including chaos ``serving:reject``
+        injections surfacing through ``step()``). Normal termination
+        (stop / length / client cancel) ends the iterator."""
         events = self._events_by_rid.get(rid)
         if events is None:
             raise KeyError(f"unknown rid {rid}")
@@ -243,6 +251,14 @@ class PagedServingEngine:
                 if ev.token >= 0:
                     yield ev.token
                 if ev.finished:
+                    if ev.reason == "deadline":
+                        raise DeadlineExceededError(
+                            f"request {rid} expired mid-stream after "
+                            f"{i - 1} tokens (reason=deadline)")
+                    if ev.reason == "shed":
+                        raise RejectedError(
+                            f"request {rid} shed mid-stream after "
+                            f"{i - 1} tokens")
                     return
             if not self.has_work():
                 return

@@ -35,7 +35,8 @@ from ...core import flags
 from ...observability import emit as _emit
 from .block_manager import BlockManager, NoFreeBlocksError
 
-__all__ = ["RejectedError", "Sequence", "ScheduledBatch", "Scheduler"]
+__all__ = ["RejectedError", "DeadlineExceededError", "Sequence",
+           "ScheduledBatch", "Scheduler"]
 
 flags.define_flag("serving_max_queue", 128,
                   "Serving admission control: submissions beyond this many "
@@ -45,6 +46,14 @@ flags.define_flag("serving_max_queue", 128,
 class RejectedError(RuntimeError):
     """Load-shed signal: the serving queue is full. Clients should back
     off and retry; the request was NOT enqueued."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request's deadline expired mid-flight: the scheduler freed its
+    pages and finished it with reason ``"deadline"``. Raised through
+    ``stream(rid)`` so streaming clients see a typed failure instead of a
+    silently truncated token stream (``run()`` still returns the
+    completion with ``finish_reason == "deadline"``)."""
 
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"

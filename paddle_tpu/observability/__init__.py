@@ -28,7 +28,8 @@ from .recorder import FlightRecorder
 
 __all__ = ["emit", "enabled", "registry", "recorder", "reset", "summary",
            "prometheus_text", "metrics_snapshot", "dump_distress",
-           "install_signal_handler", "Registry", "FlightRecorder"]
+           "register_distress_section", "install_signal_handler",
+           "Registry", "FlightRecorder"]
 
 flags.define_flag("metrics_sampling", 1,
                   "Observability sampling: 0 disables emit() entirely "
@@ -213,6 +214,40 @@ _g_elastic_world = _G("paddle_elastic_world_size",
 _h_elastic_reconf = _H("paddle_elastic_reconfigure_seconds",
                        "Wall time of elastic world reconfigurations "
                        "(epoch bump to resharded state published)")
+_c_rt_admit = _C("paddle_router_admitted_total",
+                 "Streams admitted by the serving router, by tenant")
+_c_rt_shed = _C("paddle_router_shed_total",
+                "Streams shed by the router, by tenant and reason")
+_c_rt_complete = _C("paddle_router_completed_total",
+                    "Router streams finished, by tenant and reason")
+_c_rt_assign = _C("paddle_router_assignments_total",
+                  "Stream placements onto replicas (failover replays "
+                  "and drain migrations place again)")
+_c_rt_prefix = _C("paddle_router_prefix_routed_total",
+                  "Placements chosen by prompt-prefix affinity rather "
+                  "than least-loaded fallback")
+_c_rt_failover = _C("paddle_router_failovers_total",
+                    "Streams failed over after a replica death, by "
+                    "tenant")
+_c_rt_migrate = _C("paddle_router_migrations_total",
+                   "Streams migrated off a draining replica, by tenant")
+_c_rt_readmit = _C("paddle_router_readmits_total",
+                   "Dead replicas re-admitted on probation")
+_c_rt_drain = _C("paddle_router_drains_total",
+                 "Graceful replica drains initiated")
+_c_rt_mismatch = _C("paddle_router_failover_mismatches_total",
+                    "Failover replays that diverged from the already-"
+                    "streamed prefix (determinism violations)")
+_c_rt_state = _C("paddle_router_replica_state_changes_total",
+                 "Replica circuit-breaker transitions, by new state")
+_g_rt_replicas = _G("paddle_router_replicas",
+                    "Replica count by circuit-breaker state")
+_g_rt_util = _G("paddle_router_replica_kv_utilization",
+                "Per-replica paged KV pool utilization")
+_g_rt_pending = _G("paddle_router_pending_requests",
+                   "Router-side requests awaiting placement")
+_g_rt_live = _G("paddle_router_live_streams",
+                "Streams admitted and not yet finished")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -315,6 +350,19 @@ def _h_srv_gauges(dur_s, f):
     _g_srv_util.set(f.get("kv_utilization", 0.0))
 
 
+def _h_rt_assign(dur_s, f):
+    _c_rt_assign.inc()
+    if f.get("prefix_hit", 0) > 0:
+        _c_rt_prefix.inc()
+
+
+def _h_rt_gauges(dur_s, f):
+    _g_rt_pending.set(f.get("pending", 0))
+    _g_rt_live.set(f.get("live_streams", 0))
+    for state in ("healthy", "degraded", "dead", "draining", "drained"):
+        _g_rt_replicas.set(f.get(state, 0), labels={"state": state})
+
+
 _HANDLERS = {
     "dispatch.hit": _h_dispatch_hit,
     "dispatch.miss": _h_dispatch_miss,
@@ -352,6 +400,28 @@ _HANDLERS = {
     "serving.cow": lambda d, f: _c_srv_cow.inc(f.get("copies", 1)),
     "serving.token": _h_srv_token,
     "serving.gauges": _h_srv_gauges,
+    "router.admit": lambda d, f: _c_rt_admit.inc(
+        labels={"tenant": f.get("tenant", "")}),
+    "router.shed": lambda d, f: _c_rt_shed.inc(
+        labels={"tenant": f.get("tenant", ""),
+                "reason": f.get("reason", "queue_full")}),
+    "router.complete": lambda d, f: _c_rt_complete.inc(
+        labels={"tenant": f.get("tenant", ""),
+                "reason": f.get("reason", "")}),
+    "router.assign": _h_rt_assign,
+    "router.failover": lambda d, f: _c_rt_failover.inc(
+        labels={"tenant": f.get("tenant", "")}),
+    "router.migrate": lambda d, f: _c_rt_migrate.inc(
+        labels={"tenant": f.get("tenant", "")}),
+    "router.readmit": lambda d, f: _c_rt_readmit.inc(),
+    "router.drain": lambda d, f: _c_rt_drain.inc(),
+    "router.mismatch": lambda d, f: _c_rt_mismatch.inc(),
+    "router.replica_state": lambda d, f: _c_rt_state.inc(
+        labels={"state": f.get("state", "")}),
+    "router.replica": lambda d, f: _g_rt_util.set(
+        f.get("kv_utilization", 0.0),
+        labels={"replica": str(f.get("replica", ""))}),
+    "router.gauges": _h_rt_gauges,
     "watchdog.timeout": lambda d, f: _c_wd.inc(),
     "watchdog.escalate": lambda d, f: _c_escalate.inc(
         labels={"stage": f.get("stage", "")}),
@@ -477,6 +547,31 @@ def summary() -> dict:
             "prefix_cached_tokens": int(_c_srv_prefix.value()),
             "cow_copies": int(_c_srv_cow.value()),
         },
+        "router": {
+            "admitted": int(_c_rt_admit.value()),
+            "completed": int(_c_rt_complete.value()),
+            "shed": int(_c_rt_shed.value()),
+            "assignments": int(_c_rt_assign.value()),
+            "prefix_routed": int(_c_rt_prefix.value()),
+            "failovers": int(_c_rt_failover.value()),
+            "failover_mismatches": int(_c_rt_mismatch.value()),
+            "migrations": int(_c_rt_migrate.value()),
+            "readmits": int(_c_rt_readmit.value()),
+            "drains": int(_c_rt_drain.value()),
+            "pending": int(_g_rt_pending.value()),
+            "live_streams": int(_g_rt_live.value()),
+            "replicas": {
+                s: int(_g_rt_replicas.value({"state": s}))
+                for s in ("healthy", "degraded", "dead", "draining",
+                          "drained")},
+            # fleet-aggregate SLOs: every replica engine feeds the same
+            # process-wide serving histograms, so these ARE the
+            # cross-replica percentiles
+            "ttft_p50_s": round(_h_srv_ttft.percentile(50), 6),
+            "ttft_p99_s": round(_h_srv_ttft.percentile(99), 6),
+            "tpot_p50_s": round(_h_srv_tpot.percentile(50), 6),
+            "tpot_p99_s": round(_h_srv_tpot.percentile(99), 6),
+        },
     }
 
 
@@ -491,6 +586,15 @@ def dump_distress(reason: str, extra: dict = None,
     from . import distress
 
     return distress.dump(reason, extra=extra, directory=directory)
+
+
+def register_distress_section(name: str, fn) -> None:
+    """Register fn() -> json-serializable as an extra section of every
+    distress dump (e.g. the serving router snapshots its fleet state
+    into post-mortems). fn=None unregisters."""
+    from . import distress
+
+    distress.register_section(name, fn)
 
 
 def install_signal_handler() -> bool:

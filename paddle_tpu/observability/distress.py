@@ -26,6 +26,21 @@ import time
 
 from ..core import flags
 
+# extra artifact sections registered by subsystems (serving router fleet
+# snapshot, etc.) — each guarded like the built-ins, so a bad section
+# degrades to an error string instead of losing the dump
+_sections = {}
+
+
+def register_section(name: str, fn):
+    """Register fn() as an extra dump section under `name` (latest
+    registration wins); fn=None unregisters."""
+    if fn is None:
+        _sections.pop(name, None)
+    else:
+        _sections[name] = fn
+
+
 # enforce-triggered dumps are rate-limited so a hot error loop cannot
 # fill the disk; watchdog/signal/manual dumps always fire
 _MIN_ENFORCE_INTERVAL_S = 1.0
@@ -73,7 +88,8 @@ def dump(reason: str, extra: dict = None, directory: str = None,
                 ("events_recorded_total", rec.written),
                 ("metrics", registry().snapshot),
                 ("events", rec.to_json_events),
-                ("chrome_trace", rec.to_chrome_trace)):
+                ("chrome_trace", rec.to_chrome_trace),
+                *_sections.items()):
             try:
                 doc[section] = build()
             except Exception as e:  # noqa: BLE001 — keep the other sections

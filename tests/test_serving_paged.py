@@ -22,7 +22,9 @@ import pytest
 from paddle_tpu import observability as obs
 from paddle_tpu.distributed.fault_tolerance import chaos
 from paddle_tpu.inference.llm import LLMPredictor
-from paddle_tpu.inference.serving import (BlockManager, NoFreeBlocksError,
+from paddle_tpu.inference.serving import (BlockManager,
+                                          DeadlineExceededError,
+                                          NoFreeBlocksError,
                                           PagedServingEngine, RejectedError)
 from paddle_tpu.models import llama as L
 
@@ -134,6 +136,53 @@ class TestBlockManager:
         assert bm.stats["cache_evictions"] >= 1
         bm.free_sequence(3)
         assert bm.allocate_sequence(4, toks + [7]) == 0   # hash gone
+
+    def test_cancel_with_pending_cow_purges_copies(self):
+        """A sequence freed while its COW copies are still pending must
+        take those pairs with it: a stale (src, dst) surviving the free
+        would clobber dst after the page is reallocated."""
+        bm = BlockManager(num_blocks=8, block_size=4)
+        toks = list(range(8))
+        bm.allocate_sequence(1, toks)
+        bm.register_computed(1, toks, 8)
+        bm.allocate_sequence(2, toks)            # whole-hit → pending COW
+        assert bm.stats["cow_copies"] == 1
+        bm.free_sequence(2)                      # cancelled pre-step
+        assert bm.stats["cow_purged"] == 1
+        assert bm.take_copies() == []            # nothing stale survives
+        bm.free_sequence(1)
+        assert bm.num_free() == 8                # every pin released
+
+    def test_pending_cow_pins_shared_source(self):
+        """The src of a pending copy holds an extra ref until the copy
+        executes, so neither a free nor LRU reclaim can retire the page
+        out from under the device copy."""
+        bm = BlockManager(num_blocks=8, block_size=4)
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        bm.allocate_sequence(1, toks)
+        bm.register_computed(1, toks, 8)
+        bm.allocate_sequence(2, [1, 2, 3, 4, 5, 6, 7, 77])
+        t1 = bm.block_table(1)
+        assert bm.ref_count(t1[1]) == 2          # seq 1's table + the pin
+        assert bm.take_copies() == [(t1[1], bm.block_table(2)[1])]
+        assert bm.ref_count(t1[1]) == 1          # pin released on drain
+
+    def test_pending_cow_src_not_reclaimed_from_cache(self):
+        """Partial-hit src living only in the parked LRU cache must be
+        revived by the pin — under pool pressure the fresh-page loop in
+        the SAME allocate call would otherwise reclaim it before the
+        copy ran."""
+        bm = BlockManager(num_blocks=3, block_size=4)
+        toks = [1, 2, 3, 4, 5, 6, 7]
+        bm.allocate_sequence(1, toks)
+        bm.register_computed(1, toks, 7)
+        bm.free_sequence(1)                      # both pages parked
+        cached = bm.allocate_sequence(2, [1, 2, 3, 99, 100, 101, 102, 103])
+        assert cached == 3                       # partial hit on block 0
+        (src, dst), = bm.take_copies()
+        assert src not in bm.block_table(2)      # src survived as src,
+        assert dst == bm.block_table(2)[0]       # not recycled into the
+        #                                          new table
 
     def test_exhaustion_raises_and_leaves_no_state(self):
         bm = BlockManager(num_blocks=2, block_size=4)
@@ -299,6 +348,43 @@ class TestSchedulingPolicies:
         assert eng.blocks.num_allocated() == 0
         done = {c.rid: c for c in eng.run()}
         assert done[r1].finish_reason == "cancelled"
+
+    def test_stream_raises_typed_deadline(self, tiny):
+        """An expiry mid-stream surfaces as DeadlineExceededError from the
+        iterator, not a silent empty stream (the router relies on this to
+        propagate typed failures through its own stream())."""
+        cfg, params = tiny
+        eng = PagedServingEngine(cfg, params, num_blocks=32, block_size=4,
+                                 max_batch=2, token_budget=16)
+        rid = eng.submit(_prompts(cfg, 1, [4], seed=17)[0],
+                         max_new_tokens=6, deadline_s=-1.0)   # born dead
+        with pytest.raises(DeadlineExceededError):
+            list(eng.stream(rid))
+
+    def test_cancel_storm_releases_pool_exactly(self, tiny, hostloop_ref):
+        """Cancelling a pile of prefix-sharing in-flight requests (COW
+        pages, shared blocks, chunked prefills) must return the pool to
+        utilization 0 with no stale pending copies, and the engine must
+        still serve a fresh request exactly."""
+        cfg, params = tiny
+        eng = PagedServingEngine(cfg, params, num_blocks=48, block_size=4,
+                                 max_batch=4, token_budget=8)
+        base = _prompts(cfg, 1, [8], seed=18)[0]
+        eng.submit(base, max_new_tokens=2)
+        eng.run()                                 # seeds the prefix cache
+        rids = [eng.submit(base + extra, max_new_tokens=20)
+                for extra in ([7], [11, 12], list(range(20)))]
+        eng.step()                                # mid-flight: COW + chunks
+        for r in rids:
+            assert eng.cancel(r)
+        assert eng.blocks.num_allocated() == 0
+        assert eng.blocks.take_copies() == []
+        done = {c.rid: c for c in eng.run()}
+        assert all(done[r].finish_reason == "cancelled" for r in rids)
+        fresh = _prompts(cfg, 1, [5], seed=19)[0]
+        r2 = eng.submit(fresh, max_new_tokens=6)
+        out = {c.rid: c for c in eng.run()}[r2]
+        assert out.output_tokens == hostloop_ref(fresh, 6)
 
     def test_streaming_iterator_delivers_incrementally(self, tiny,
                                                        hostloop_ref):

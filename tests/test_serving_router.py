@@ -1,0 +1,501 @@
+"""Resilient multi-replica serving router tests.
+
+The contract under test: replica death is a RETRY, never a dropped or
+corrupted stream. Failover replays the full request (same prompt, same
+sampling knobs, same seed) onto a healthy replica and CONFIRMS the
+regenerated prefix bit-exactly against what the client already saw —
+the merged stream must equal the single-engine `LLMPredictor` host-loop
+reference token for token, and the client iterator must never observe
+the switch.
+
+Also covers: ReplicaHandle breaker transitions (strike ladder, lease
+expiry, probation re-admit), chaos `replica:{kill,stall,flap}` with
+victim targeting, prefix-affinity placement, per-tenant queue caps and
+weighted-round-robin admission, graceful drain with prefill migration,
+typed error propagation through `router.stream()`, the
+`summary()["router"]` fleet digest and the distress-dump section.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.fault_tolerance import chaos
+from paddle_tpu.inference.llm import LLMPredictor
+from paddle_tpu.inference.serving import (DeadlineExceededError,
+                                          PagedServingEngine, RejectedError,
+                                          ServingRouter)
+from paddle_tpu.inference.serving.replica import (DEAD, DEGRADED, DRAINED,
+                                                  DRAINING, HEALTHY,
+                                                  ReplicaDeadError,
+                                                  ReplicaHandle,
+                                                  ReplicaKilledError)
+from paddle_tpu.models import llama as L
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LlamaConfig(vocab_size=97, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, max_seq_len=96, dtype=jnp.float32)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def hostloop_ref(tiny):
+    """Greedy single-request reference (the parity target every merged
+    router stream must match, failover or not); memoized."""
+    cfg, params = tiny
+    pred = LLMPredictor(cfg, params, max_len=96, attn_impl="xla")
+    memo = {}
+
+    def ref(tokens, max_new, eos=None):
+        key = (tuple(tokens), max_new, eos)
+        if key not in memo:
+            seq, _ = pred.generate(jnp.asarray(tokens, jnp.int32)[None, :],
+                                   max_new_tokens=max_new, eos_token_id=eos,
+                                   return_scores=True)
+            gen = [int(t) for t in np.asarray(seq)[0, len(tokens):]]
+            if eos is not None and eos in gen:
+                gen = gen[:gen.index(eos)]
+            memo[key] = gen
+        return memo[key]
+
+    return ref
+
+
+def _prompts(cfg, n, lens, seed=1):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, cfg.vocab_size, (ln,)).tolist()
+            for ln, _ in zip((lens * n)[:n], range(n))]
+
+
+def _factory(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("token_budget", 16)
+
+    def build():
+        return PagedServingEngine(cfg, params, **kw)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# ReplicaHandle breaker unit tests (fake engine, no model)
+# ---------------------------------------------------------------------------
+
+class _FakeEngine:
+    """Steps in `delay` seconds, never finishes anything — just enough
+    surface for the handle's judgment paths."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.work = True
+        self.stats = {"step_builds": 1}   # constant: never 'compiling'
+
+    def step(self):
+        if self.delay:
+            time.sleep(self.delay)
+        return []
+
+    def has_work(self):
+        return self.work
+
+
+class TestReplicaHandle:
+    def test_strike_ladder_healthy_degraded_dead(self):
+        h = ReplicaHandle(0, _FakeEngine, ttl=60.0, stall_timeout_s=0.0,
+                          dead_after=2)
+        assert h.state == HEALTHY and h.accepts_new()
+        assert h.guarded_step() == []            # any duration > 0.0 stalls
+        assert h.state == DEGRADED and h.strikes == 1
+        with pytest.raises(ReplicaKilledError):
+            h.guarded_step()
+        assert h.state == DEAD and h.engine is None
+        assert h.death_reason.startswith("strikes")
+        assert not h.accepts_new() and not h.steppable()
+        with pytest.raises(ReplicaDeadError):
+            h.guarded_step()
+
+    def test_good_step_recovers_and_resets_strikes(self):
+        h = ReplicaHandle(0, _FakeEngine, ttl=60.0, stall_timeout_s=0.05,
+                          dead_after=2)
+        h.engine.delay = 0.08
+        h.guarded_step()                          # one stall strike
+        assert h.state == DEGRADED and h.stats["stalls"] == 1
+        h.engine.delay = 0.0
+        h.guarded_step()                          # good step heals
+        assert h.state == HEALTHY and h.strikes == 0
+
+    def test_lease_expiry_kills_replica_with_work(self):
+        h = ReplicaHandle(3, _FakeEngine, ttl=0.02, stall_timeout_s=60.0)
+        time.sleep(0.06)
+        assert not h.lease_live()
+        with pytest.raises(ReplicaKilledError):
+            h.check_lease()
+        assert h.state == DEAD and h.death_reason == "lease_expired"
+
+    def test_lease_idle_replica_is_not_killed(self):
+        h = ReplicaHandle(4, _FakeEngine, ttl=0.02, stall_timeout_s=60.0)
+        h.engine.work = False                     # idle: nothing owed
+        time.sleep(0.06)
+        h.check_lease()                           # no raise
+        assert h.state == HEALTHY
+
+    def test_probation_readmit_then_heal(self):
+        built = [0]
+
+        def factory():
+            built[0] += 1
+            return _FakeEngine()
+
+        h = ReplicaHandle(0, factory, ttl=60.0, stall_timeout_s=0.05,
+                          dead_after=2, probation_s=0.0)
+        h.engine.delay = 0.08
+        h.guarded_step()
+        with pytest.raises(ReplicaKilledError):
+            h.guarded_step()
+        assert h.state == DEAD and built[0] == 1
+        assert h.maybe_readmit()
+        assert built[0] == 2                      # FRESH engine, not revived
+        assert h.state == DEGRADED and h.probation
+        assert not h.maybe_readmit()              # idempotent while alive
+        h.guarded_step()                          # first good step
+        assert h.state == HEALTHY and not h.probation
+        assert h.stats["readmits"] == 1
+
+    def test_probation_strike_rekills_immediately(self):
+        h = ReplicaHandle(0, _FakeEngine, ttl=60.0, stall_timeout_s=0.05,
+                          dead_after=3, probation_s=0.0)
+        h.engine.delay = 0.08
+        h.guarded_step()
+        h.guarded_step()
+        with pytest.raises(ReplicaKilledError):
+            h.guarded_step()                      # 3 strikes: dead
+        assert h.maybe_readmit()
+        h.engine.delay = 0.08
+        with pytest.raises(ReplicaKilledError):
+            h.guarded_step()                      # ONE probation strike
+        assert h.state == DEAD
+
+    def test_drain_lifecycle(self):
+        h = ReplicaHandle(0, _FakeEngine, ttl=60.0, stall_timeout_s=60.0)
+        h.start_drain()
+        assert h.state == DRAINING
+        assert not h.accepts_new() and h.steppable()
+        h.drain_tick()
+        assert h.state == DRAINING                # still has work
+        h.engine.work = False
+        h.drain_tick()
+        assert h.state == DRAINED and not h.steppable()
+
+
+# ---------------------------------------------------------------------------
+# Router: placement, parity, fairness
+# ---------------------------------------------------------------------------
+
+class TestRouterPlacement:
+    def test_multi_replica_parity(self, tiny, hostloop_ref):
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        prompts = _prompts(tiny[0], 4, [5, 9, 3, 7], seed=21)
+        budgets = [6, 4, 8, 5]
+        rids = [router.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        done = {c.rid: c for c in router.run()}
+        assert len(done) == 4
+        for rid, p, b in zip(rids, prompts, budgets):
+            assert done[rid].output_tokens == hostloop_ref(p, b)
+        # least-loaded placement spread the work across both replicas
+        used = {router._reqs[r].replica for r in rids}
+        assert used == {0, 1}
+        assert router.stats["failovers"] == 0
+
+    def test_prefix_affinity_routes_to_warm_replica(self, tiny,
+                                                    hostloop_ref):
+        obs.reset()
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        p = _prompts(tiny[0], 1, [9], seed=22)[0]     # 2 full blocks
+        r1 = router.submit(p, max_new_tokens=4)
+        out1 = {c.rid: c for c in router.run()}[r1]
+        first_home = router._reqs[r1].replica
+        r2 = router.submit(p, max_new_tokens=4)
+        out2 = {c.rid: c for c in router.run()}[r2]
+        # the warm replica won placement despite equal load
+        assert router._reqs[r2].replica == first_home
+        assert out1.output_tokens == out2.output_tokens \
+            == hostloop_ref(p, 4)
+        reg = obs.registry()
+        assert reg.value("paddle_router_prefix_routed_total") >= 1
+
+    def test_tenant_queue_cap_sheds_only_that_tenant(self, tiny):
+        router = ServingRouter(_factory(tiny), num_replicas=1,
+                               tenant_max_queue=2)
+        p = _prompts(tiny[0], 1, [3], seed=23)[0]
+        for _ in range(2):
+            router.submit(p, max_new_tokens=2, tenant="storm")
+        with pytest.raises(RejectedError):
+            router.submit(p, max_new_tokens=2, tenant="storm")
+        # the well-behaved tenant is untouched by the storm's cap
+        rid = router.submit(p, max_new_tokens=2, tenant="calm")
+        assert router.stats["shed"] == 1
+        done = {c.rid: c for c in router.run()}
+        assert rid in done and len(done) == 3
+
+    def test_wrr_weights_split_one_admission_pass(self, tiny):
+        router = ServingRouter(_factory(tiny, max_batch=4, num_blocks=48),
+                               num_replicas=2,
+                               tenant_weights={"gold": 3, "free": 1})
+        p = _prompts(tiny[0], 1, [3], seed=24)[0]
+        for _ in range(4):
+            router.submit(p, max_new_tokens=2, tenant="gold")
+            router.submit(p, max_new_tokens=2, tenant="free")
+        router.step()
+        # one WRR pass: gold placed weight=3 requests, free placed 1
+        assert len(router._pending["gold"]) == 1
+        assert len(router._pending["free"]) == 3
+        done = router.run()
+        assert len(done) == 8                     # nobody starves
+
+    def test_zero_new_tokens_completes_without_engine(self, tiny):
+        router = ServingRouter(_factory(tiny), num_replicas=1)
+        rid = router.submit([1, 2, 3], max_new_tokens=0)
+        assert list(router.stream(rid)) == []
+        (done,) = router.run()
+        assert done.rid == rid and done.finish_reason == "length"
+
+    def test_oversized_request_rejected_upfront(self, tiny):
+        router = ServingRouter(_factory(tiny), num_replicas=1)
+        with pytest.raises(ValueError):
+            router.submit(list(range(90)), max_new_tokens=10)
+
+
+# ---------------------------------------------------------------------------
+# Failover: the chaos drills
+# ---------------------------------------------------------------------------
+
+class TestRouterFailover:
+    def test_chaos_kill_midstream_failover_bitexact(self, tiny,
+                                                    hostloop_ref):
+        """THE resilience drill: replica 0 is chaos-killed on its 4th
+        step, mid-decode. The stream must complete on the survivor with
+        the merged output bit-exact vs the single-engine reference,
+        exactly one failover observed, zero mismatches, and the survivor
+        never retracing its step executable."""
+        obs.reset()
+        chaos.reconfigure("replica:kill@victim=0;call=3")
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2,
+                                   probation_s=60.0)   # stays dead
+            prompt = _prompts(tiny[0], 1, [6], seed=31)[0]
+            rid = router.submit(prompt, max_new_tokens=12)
+            tokens = list(router.stream(rid))
+        finally:
+            chaos.reconfigure("")
+        assert tokens == hostloop_ref(prompt, 12)
+        assert router.replicas[0].state == DEAD
+        assert router.replicas[0].death_reason == "chaos_kill"
+        assert router._reqs[rid].failovers == 1
+        assert router.stats["mismatches"] == 0
+        # the survivor compiled once and kept that executable through the
+        # replayed stream (fleet steady state stays zero-retrace)
+        assert router.replicas[1].engine.stats["step_builds"] == 1
+        reg = obs.registry()
+        assert reg.value("paddle_router_failovers_total") == 1
+        assert reg.value("paddle_chaos_injections_total",
+                         {"site": "replica", "kind": "kill"}) == 1
+        assert reg.value("paddle_router_failover_mismatches_total") == 0
+
+    def test_chaos_kill_multiple_streams_all_survive(self, tiny,
+                                                     hostloop_ref):
+        """Every admitted stream on the dead replica fails over; none
+        drop, all stay exact."""
+        obs.reset()
+        chaos.reconfigure("replica:kill@victim=0;call=2")
+        try:
+            router = ServingRouter(
+                _factory(tiny, max_batch=4, num_blocks=48),
+                num_replicas=2, probation_s=60.0)
+            prompts = _prompts(tiny[0], 4, [5, 4, 6, 3], seed=32)
+            rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+            done = {c.rid: c for c in router.run()}
+        finally:
+            chaos.reconfigure("")
+        assert len(done) == 4                     # zero dropped streams
+        for rid, p in zip(rids, prompts):
+            assert done[rid].output_tokens == hostloop_ref(p, 8)
+            assert done[rid].finish_reason == "length"
+        # the two streams living on replica 0 both failed over
+        assert router.stats["failovers"] == 2
+        assert router.stats["mismatches"] == 0
+
+    def test_stall_strikeout_fails_over(self, tiny, hostloop_ref):
+        """Two chaos stalls strike replica 0 out (healthy -> degraded ->
+        dead); its stream replays on replica 1, still exact."""
+        obs.reset()
+        chaos.reconfigure("replica:stall@victim=0;count=2;delay=0")
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2,
+                                   dead_after=2, probation_s=60.0)
+            prompt = _prompts(tiny[0], 1, [5], seed=33)[0]
+            rid = router.submit(prompt, max_new_tokens=7)
+            tokens = list(router.stream(rid))
+        finally:
+            chaos.reconfigure("")
+        assert tokens == hostloop_ref(prompt, 7)
+        assert router.replicas[0].state == DEAD
+        assert router.replicas[0].stats["stalls"] == 2
+        assert router.stats["failovers"] == 1
+
+    def test_flap_recovers_without_failover(self, tiny, hostloop_ref):
+        """A single transient flap degrades the replica; the next good
+        step heals it — no failover, no stream interruption."""
+        chaos.reconfigure("replica:flap@victim=0;count=1")
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2)
+            prompt = _prompts(tiny[0], 1, [4], seed=34)[0]
+            rid = router.submit(prompt, max_new_tokens=6)
+            tokens = list(router.stream(rid))
+        finally:
+            chaos.reconfigure("")
+        assert tokens == hostloop_ref(prompt, 6)
+        assert router.replicas[0].state == HEALTHY
+        assert router.replicas[0].stats["flaps"] == 1
+        assert router.stats["failovers"] == 0
+
+    def test_probation_readmit_rejoins_fleet(self, tiny, hostloop_ref):
+        """A dead replica re-admits after probation_s with a fresh engine
+        and serves again once it proves a good step."""
+        chaos.reconfigure("replica:kill@victim=0;call=0")
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2,
+                                   probation_s=0.0)
+            p1 = _prompts(tiny[0], 1, [5], seed=35)[0]
+            r1 = router.submit(p1, max_new_tokens=6)
+            done = {c.rid: c for c in router.run()}
+            assert done[r1].output_tokens == hostloop_ref(p1, 6)
+        finally:
+            chaos.reconfigure("")
+        assert router.replicas[0].stats["readmits"] == 1
+        p2 = _prompts(tiny[0], 1, [4], seed=36)[0]
+        r2 = router.submit(p2, max_new_tokens=5)
+        done = {c.rid: c for c in router.run()}
+        assert done[r2].output_tokens == hostloop_ref(p2, 5)
+        # the readmitted replica took the work and healed on it
+        assert router._reqs[r2].replica == 0
+        assert router.replicas[0].state == HEALTHY
+
+    def test_failover_exhaustion_sheds_typed(self, tiny):
+        """A stream that keeps landing on dying replicas is shed with a
+        typed RejectedError after max_failovers, not retried forever."""
+        obs.reset()
+        chaos.reconfigure("replica:kill@count=0")   # kill EVERY step
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2,
+                                   probation_s=0.0, max_failovers=2)
+            rid = router.submit(_prompts(tiny[0], 1, [4], seed=37)[0],
+                                max_new_tokens=6)
+            with pytest.raises(RejectedError):
+                list(router.stream(rid))
+        finally:
+            chaos.reconfigure("")
+        assert router.stats["failover_exhausted"] == 1
+        assert router._reqs[rid].finish_reason == "failover_exhausted"
+
+    def test_deadline_typed_through_router_stream(self, tiny):
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        rid = router.submit(_prompts(tiny[0], 1, [4], seed=38)[0],
+                            max_new_tokens=6, deadline_s=-1.0)
+        with pytest.raises(DeadlineExceededError):
+            list(router.stream(rid))
+
+
+# ---------------------------------------------------------------------------
+# Drain, observability, distress
+# ---------------------------------------------------------------------------
+
+class TestRouterDrainAndObs:
+    def test_drain_migrates_prefill_decodes_finish_in_place(self, tiny,
+                                                            hostloop_ref):
+        """drain(): the decoding stream finishes on the draining replica,
+        the mid-prefill stream (nothing emitted) migrates and replays
+        elsewhere; both stay exact and the replica reads DRAINED."""
+        router = ServingRouter(
+            _factory(tiny, token_budget=8, num_blocks=48, max_batch=2),
+            num_replicas=2)
+        cfg = tiny[0]
+        a = _prompts(cfg, 1, [6], seed=41)[0]     # 1 full cacheable block
+        long_b = a + _prompts(cfg, 1, [22], seed=42)[0]   # shared prefix
+        ra = router.submit(a, max_new_tokens=6)
+        for _ in range(3):            # a placed (replica 0) and decoding
+            router.step()
+            if router._reqs[ra].emitted:
+                break
+        assert router._reqs[ra].replica == 0
+        assert len(router._reqs[ra].emitted) >= 1
+        rb = router.submit(long_b, max_new_tokens=5)
+        router.step()                 # b follows its prefix to replica 0,
+        #                               prefill spans steps: nothing out
+        assert router._reqs[rb].replica == 0
+        assert router._reqs[rb].emitted == []
+        router.drain(0)
+        assert router.stats["migrations"] == 1
+        assert router._reqs[rb].migrations == 1
+        done = {c.rid: c for c in router.run()}
+        assert done[ra].output_tokens == hostloop_ref(a, 6)
+        assert done[rb].output_tokens == hostloop_ref(long_b, 5)
+        assert router._reqs[rb].replica == 1      # replayed off-replica
+        router.step()                             # idle tick settles state
+        assert router.replicas[0].state == DRAINED
+        # post-drain placements avoid the drained replica
+        rc = router.submit(a, max_new_tokens=2)
+        router.run()
+        assert router._reqs[rc].replica == 1
+
+    def test_summary_router_section(self, tiny):
+        obs.reset()
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        for p in _prompts(tiny[0], 3, [4, 6], seed=43):
+            router.submit(p, max_new_tokens=4)
+        router.run()
+        s = obs.summary()["router"]
+        assert s["admitted"] == 3 and s["completed"] == 3
+        assert s["assignments"] == 3 and s["failovers"] == 0
+        assert s["pending"] == 0 and s["live_streams"] == 0
+        assert s["replicas"]["healthy"] == 2
+        assert s["replicas"]["dead"] == 0
+        # fleet SLO aggregates flow from the shared serving histograms
+        assert s["ttft_p50_s"] > 0 and s["tpot_p50_s"] > 0
+
+    def test_distress_dump_carries_router_section(self, tiny, tmp_path):
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        router.submit(_prompts(tiny[0], 1, [3], seed=44)[0],
+                      max_new_tokens=2)
+        router.run()
+        path = obs.dump_distress("router_test", directory=str(tmp_path))
+        assert path
+        with open(path) as f:
+            doc = json.load(f)
+        fleet = doc["router"]
+        assert fleet["live_streams"] == 0
+        assert set(fleet["replicas"]) == {"0", "1"}
+        assert fleet["replicas"]["0"]["state"] == "healthy"
+
+    def test_cancel_mid_stream(self, tiny):
+        router = ServingRouter(_factory(tiny), num_replicas=2)
+        rid = router.submit(_prompts(tiny[0], 1, [4], seed=45)[0],
+                            max_new_tokens=30)
+        router.step()
+        assert router.cancel(rid)
+        assert not router.cancel(rid)             # idempotent
+        (done,) = router.run()
+        assert done.finish_reason == "cancelled"

@@ -261,6 +261,50 @@ def maybe_serving_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/serving_smoke.py)")
 
 
+_last_router_smoke = [0.0]
+
+
+def maybe_router_smoke(min_interval: float = 3600.0) -> None:
+    """Run the resilient-serving smoke (tools/router_smoke.py) at most
+    once per min_interval and log a RED line on regression — a replica
+    kill that drops or corrupts a stream, a replay-confirm mismatch, a
+    survivor retrace, or router overhead pushing fleet throughput below
+    0.9x the single-replica-sum baseline are build-signal the same way
+    the perf floor is."""
+    now = time.monotonic()
+    if _last_router_smoke[0] and now - _last_router_smoke[0] < min_interval:
+        return
+    _last_router_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "router_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: router smoke hung >600s — multi-replica serving broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"router smoke GREEN ({payload.get('wall_s')}s: "
+            f"{payload.get('failovers')} failover, "
+            f"{payload.get('tokens_confirmed_on_replay')} tokens "
+            f"replay-confirmed, "
+            f"ratio={payload.get('throughput_ratio_vs_share')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: router smoke regression rc={out.returncode} — {detail} "
+        f"(tools/router_smoke.py)")
+
+
 _last_elastic_smoke = [0.0]
 
 
@@ -410,6 +454,7 @@ def main() -> None:
         maybe_chaos_smoke()
         maybe_dp_overlap_smoke()
         maybe_serving_smoke()
+        maybe_router_smoke()
         maybe_elastic_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
@@ -420,6 +465,7 @@ def main() -> None:
             maybe_chaos_smoke()
             maybe_dp_overlap_smoke()
             maybe_serving_smoke()
+            maybe_router_smoke()
             maybe_elastic_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
