@@ -1,0 +1,23 @@
+"""tpu-lint: whole-repo static analysis for paddle_tpu runtime invariants.
+
+The package is intentionally stdlib-only (ast, json, re, pathlib) so the
+CLI (``tools/tpu_lint.py``) can load it without importing paddle_tpu (and
+therefore without importing jax), keeping a full-tree run well under the
+10s pre-commit budget.
+
+Rules
+-----
+TPL001  trace-purity: host syncs / RNG / clock / flag reads inside jitted code
+TPL002  collective-order: data-dependent or fence-bypassing collective issue
+TPL003  blocking-under-lock: blocking ops lexically inside ``with ..lock:``
+TPL004  flags-drift: flag reads vs ``define_flag`` registry vs MIGRATION.md
+TPL005  metrics-drift: emit() kinds / paddle_* names vs registry, docs, ops.yaml
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Repo,
+    Baseline,
+    RULES,
+    run_all,
+)

@@ -1,0 +1,132 @@
+"""Shared AST plumbing: scope/parent indexing, name resolution, call walking.
+
+Resolution is intra-module only. That is deliberate: the invariants the
+checkers enforce live at module boundaries (a jitted entry and its helper
+closures sit in one file; a lock and the code under it sit in one class),
+and staying intra-module keeps the whole-tree run fast and the findings
+explainable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Module)
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class ModuleIndex:
+    """Parent map + scope tree for one parsed source file."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        nodes = sf.walk() if hasattr(sf, "walk") else list(ast.walk(sf.tree))
+        self.parent = {}
+        for node in nodes:
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        # scope node -> {name: FunctionDef} for functions defined directly in it
+        self.local_funcs = {}
+        for node in nodes:
+            if isinstance(node, _FUNCS):
+                scope = self.enclosing_scope(node)
+                self.local_funcs.setdefault(scope, {})[node.name] = node
+
+    def enclosing_scope(self, node):
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, _SCOPES):
+            cur = self.parent.get(cur)
+        return cur if cur is not None else self.sf.tree
+
+    def enclosing_function(self, node):
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNCS):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def enclosing_class(self, node):
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def qualname(self, node) -> str:
+        parts = []
+        cur = node
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, _SCOPES):
+                parts.append(cur.name)
+            cur = self.parent.get(cur)
+        return ".".join(reversed(parts))
+
+    def resolve_name(self, name: str, from_node):
+        """Resolve a bare function name lexically outward from ``from_node``."""
+        scope = self.enclosing_scope(from_node)
+        while scope is not None:
+            fn = self.local_funcs.get(scope, {}).get(name)
+            if fn is not None:
+                return fn
+            if isinstance(scope, ast.Module):
+                return None
+            scope = self.enclosing_scope(scope)
+        return None
+
+    def resolve_call(self, call: ast.Call):
+        """FunctionDef a call lands on, if it is local to this module."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_name(func.id, call)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+        ):
+            cls = self.enclosing_class(call)
+            if cls is not None:
+                # method lookup on the enclosing class only (no MRO walk)
+                for node in cls.body:
+                    if isinstance(node, _FUNCS) and node.name == func.attr:
+                        return node
+        return None
+
+    def ancestors(self, node):
+        cur = self.parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(cur)
+
+
+def walk_traced(index: ModuleIndex, entry, max_depth: int = 12):
+    """Yield (function_def, call_node_or_None) pairs for the traced region
+    rooted at ``entry``: the entry itself plus every intra-module function
+    reachable through resolvable calls. Nested defs inside a visited function
+    are part of its region (ast.walk descends into them)."""
+    visited = set()
+    stack = [(entry, 0)]
+    while stack:
+        fn, depth = stack.pop()
+        if id(fn) in visited or depth > max_depth:
+            continue
+        visited.add(id(fn))
+        yield fn
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = index.resolve_call(node)
+                if target is not None and id(target) not in visited:
+                    stack.append((target, depth + 1))

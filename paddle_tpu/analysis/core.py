@@ -1,0 +1,304 @@
+"""Checker framework: findings, pragmas, baseline, repo file model.
+
+Stdlib-only on purpose — see package docstring.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# Rule registry (id -> title, severity, --explain text)
+# ---------------------------------------------------------------------------
+
+RULES = {
+    "TPL001": (
+        "trace-purity",
+        "error",
+        "Host-side reads inside a jitted/traced function. `.numpy()`, `.item()`,\n"
+        "`float()`/`int()` on a traced value, Python `random`, `time.time()`,\n"
+        "`os.environ` and `flag_value()` all execute at *trace* time: the value is\n"
+        "frozen into the compiled executable (silent staleness) or forces a host\n"
+        "sync / retrace per step. Hoist the read to the caller and pass the result\n"
+        "in as an operand or a static argument.",
+    ),
+    "TPL002": (
+        "collective-order",
+        "error",
+        "Collectives must be issued in the same order on every rank. A collective\n"
+        "under a data-dependent branch (`if float(loss) > k: all_reduce(...)`),\n"
+        "inside an `except` handler, `.wait()`ed inside `no_sync()`, or issued via\n"
+        "the raw internals instead of the epoch-fenced `Group` path can interleave\n"
+        "differently across ranks and deadlock the gang. Issue unconditionally and\n"
+        "branch on the (replicated) result, and always go through the fenced\n"
+        "`collective.*` entry points.",
+    ),
+    "TPL003": (
+        "blocking-under-lock",
+        "error",
+        "A blocking operation (store RPC, `task.wait()`, `time.sleep`, queue /\n"
+        "subprocess / socket waits, collective issue) lexically inside a\n"
+        "`with <lock>:` body stalls every other thread contending for that lock —\n"
+        "heartbeats miss, routers stop routing, watchdogs fire. Snapshot state\n"
+        "under the lock, release it, then block.",
+    ),
+    "TPL004": (
+        "flags-drift",
+        "warning",
+        "Every flag read (`flag_value`, `get_flags`, `FLAGS_*` env) must resolve to\n"
+        "a `define_flag` registration with non-empty help, and the MIGRATION.md\n"
+        "flag tables must match the registry in both directions. Unregistered\n"
+        "reads raise at runtime; undocumented flags are invisible to migrating\n"
+        "users; documented-but-unregistered flags are broken promises.",
+    ),
+    "TPL005": (
+        "metrics-drift",
+        "warning",
+        "Every `emit(kind, ...)` kind must have a handler in the observability\n"
+        "`_HANDLERS` table (else the event is silently dropped), every `paddle_*`\n"
+        "metric name referenced in code/docs must exist in the registry, and every\n"
+        "op declared in `ops.yaml` must have a generated binding (and vice versa).",
+    ),
+}
+
+_PRAGMA_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+# ---------------------------------------------------------------------------
+# Finding
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    hint: str = ""
+    col: int = 0
+    symbol: str = ""  # enclosing function/class qualname, "" at module scope
+    tag: str = ""  # stable machine slug for baseline identity
+    extra_anchor_lines: tuple = ()  # pragma also honored on these lines
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule][1]
+
+    @property
+    def key(self) -> str:
+        """Line-number-free stable identity used by the baseline file."""
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.tag}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "key": self.key,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Source files and the repo model
+# ---------------------------------------------------------------------------
+
+
+class SourceFile:
+    def __init__(self, root: Path, path: Path):
+        self.abspath = path
+        self.relpath = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        try:
+            self.tree = ast.parse(self.text)
+            self.parse_error = None
+        except SyntaxError as exc:  # surfaced as a finding by run_all
+            self.tree = ast.Module(body=[], type_ignores=[])
+            self.parse_error = f"{exc.msg} (line {exc.lineno})"
+        self.pragmas = self._scan_pragmas(self.text)
+        self._nodes = None
+        self._index = None
+
+    def walk(self):
+        """Cached flat node list — checkers share one full-tree walk."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def index(self):
+        """Cached ModuleIndex — checkers share one parent/scope map."""
+        if self._index is None:
+            from .callgraph import ModuleIndex
+
+            self._index = ModuleIndex(self)
+        return self._index
+
+    @staticmethod
+    def _scan_pragmas(text: str) -> dict:
+        out = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            spec = m.group(1).strip()
+            if spec == "all":
+                out[i] = set(RULES)
+            else:
+                out[i] = {r.strip().upper() for r in spec.split(",") if r.strip()}
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        anchors = (finding.line,) + tuple(finding.extra_anchor_lines)
+        for ln in anchors:
+            for candidate in (ln, ln - 1):
+                rules = self.pragmas.get(candidate)
+                if rules and finding.rule in rules:
+                    return True
+        return False
+
+
+_SKIP_DIR_NAMES = {"__pycache__", ".git", "tests", ".pytest_cache"}
+
+
+class Repo:
+    """The set of files tpu-lint looks at.
+
+    ``files`` covers python sources under the scan roots (tests/ excluded so
+    rule fixtures there never trip the live-tree gate). ``doc_paths`` are the
+    markdown files cross-checked by the drift rules.
+    """
+
+    def __init__(self, root, py_paths=None):
+        self.root = Path(root).resolve()
+        if py_paths is None:
+            py_paths = self._default_py_paths(self.root)
+        self.files = [SourceFile(self.root, p) for p in sorted(py_paths)]
+        self.readme = self._read_doc("README.md")
+        self.migration = self._read_doc("MIGRATION.md")
+
+    def _read_doc(self, name: str):
+        p = self.root / name
+        return p.read_text(encoding="utf-8", errors="replace") if p.is_file() else None
+
+    @staticmethod
+    def _default_py_paths(root: Path):
+        out = []
+        for sub in ("paddle_tpu", "tools"):
+            base = root / sub
+            if not base.is_dir():
+                continue
+            for p in base.rglob("*.py"):
+                if not _SKIP_DIR_NAMES.intersection(p.relative_to(root).parts):
+                    out.append(p)
+        out.extend(p for p in root.glob("*.py"))
+        return out
+
+    def file(self, relpath: str):
+        for f in self.files:
+            if f.relpath == relpath:
+                return f
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Baseline (tools/lint_baseline.json)
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """Suppression file: [{"key": <finding.key>, "justification": <why>}]."""
+
+    def __init__(self, entries=None):
+        self.entries = list(entries or [])
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        path = Path(path)
+        if not path.is_file():
+            return cls()
+        data = json.loads(path.read_text(encoding="utf-8"))
+        return cls(data.get("suppressions", []))
+
+    def save(self, path) -> None:
+        payload = {
+            "_comment": "tpu-lint suppressions; keys are stable rule:path:symbol:tag "
+            "identities (line-free). Every entry needs a justification.",
+            "suppressions": sorted(self.entries, key=lambda e: e["key"]),
+        }
+        Path(path).write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+    @property
+    def keys(self):
+        return {e["key"] for e in self.entries}
+
+    def split(self, findings):
+        """-> (unbaselined findings, baselined findings, stale baseline keys)."""
+        keys = self.keys
+        hit, miss = [], []
+        seen = set()
+        for f in findings:
+            if f.key in keys:
+                hit.append(f)
+                seen.add(f.key)
+            else:
+                miss.append(f)
+        stale = sorted(keys - seen)
+        return miss, hit, stale
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def run_all(repo: Repo, rules=None):
+    """Run every checker over the repo; returns pragma-filtered findings."""
+    from . import (
+        tpl001_trace_purity,
+        tpl002_collective_order,
+        tpl003_lock_discipline,
+        tpl004_flags_drift,
+        tpl005_metrics_drift,
+    )
+
+    checkers = {
+        "TPL001": tpl001_trace_purity.check,
+        "TPL002": tpl002_collective_order.check,
+        "TPL003": tpl003_lock_discipline.check,
+        "TPL004": tpl004_flags_drift.check,
+        "TPL005": tpl005_metrics_drift.check,
+    }
+    wanted = set(rules or RULES)
+    findings = []
+    for f in repo.files:
+        if f.parse_error:
+            findings.append(
+                Finding(
+                    rule="TPL001",
+                    path=f.relpath,
+                    line=1,
+                    message=f"file does not parse: {f.parse_error}",
+                    hint="fix the syntax error so the tree is analyzable",
+                    tag="syntax-error",
+                )
+            )
+    for rule, fn in checkers.items():
+        if rule in wanted:
+            findings.extend(fn(repo))
+    out = []
+    for f in findings:
+        sf = repo.file(f.path)
+        if sf is not None and sf.suppressed(f):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.tag))
+    return out

@@ -1,0 +1,170 @@
+"""TPL001: trace purity.
+
+Finds jitted entry points (``jax.jit(fn)`` / ``@jax.jit`` /
+``@functools.partial(jax.jit, ...)`` / ``jax.shard_map(fn, ...)``), walks the
+intra-module call graph under each, and flags host-side reads inside the
+traced region: ``.numpy()``/``.item()``-style syncs, ``float()``/``int()`` on
+traced parameters, Python / numpy RNG, wall clocks, ``os.environ`` and flag
+reads. Each one either forces a device sync per step or freezes a
+trace-time value into the executable (silent staleness on retrace-miss).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import ModuleIndex, dotted, walk_traced
+
+_HOST_SYNC_ATTRS = {"numpy", "item", "tolist"}
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic", "time.time_ns"}
+_FLAG_READS = {"flag_value", "get_flags", "set_flags"}
+_JIT_WRAPPERS = {"jax.jit", "jax.shard_map", "shard_map.shard_map"}
+_PARTIALS = {"partial", "functools.partial"}
+
+
+def _is_jit_dec(dec) -> bool:
+    if dotted(dec) in _JIT_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        d = dotted(dec.func)
+        if d in _JIT_WRAPPERS:
+            return True
+        if d in _PARTIALS and any(dotted(a) in _JIT_WRAPPERS for a in dec.args):
+            return True
+    return False
+
+
+def _entries(index: ModuleIndex):
+    """Yield (FunctionDef|Lambda, entry_name) for every jitted entry point."""
+    for node in index.sf.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_dec(d) for d in node.decorator_list):
+                yield node, index.qualname(node)
+        elif isinstance(node, ast.Call) and dotted(node.func) in _JIT_WRAPPERS:
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                fn = index.resolve_name(arg.id, node)
+                if fn is not None:
+                    yield fn, index.qualname(fn)
+            elif isinstance(arg, ast.Lambda):
+                yield arg, f"<lambda@{arg.lineno}>"
+
+
+def _rng_slug(d: str) -> str:
+    parts = d.split(".")
+    if parts[0] == "random" and len(parts) > 1:
+        return d
+    if len(parts) > 2 and parts[0] in ("np", "numpy") and parts[1] == "random":
+        return d
+    return ""
+
+
+def _violation(node, params) -> tuple:
+    """-> (slug, message, hint) or None for one AST node in traced code."""
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_ATTRS:
+            return (
+                f"host-sync:{node.func.attr}",
+                f"`.{node.func.attr}()` host sync inside traced code",
+                "compute on-device; pull values to host only outside the jitted fn",
+            )
+        if d in _CLOCKS:
+            return (
+                f"clock:{d}",
+                f"`{d}()` inside traced code reads the wall clock at trace time",
+                "time around the jitted call from the host side",
+            )
+        rng = _rng_slug(d)
+        if rng:
+            return (
+                f"rng:{rng}",
+                f"Python/numpy RNG `{rng}` inside traced code is frozen at trace time",
+                "use jax.random with an explicit key operand",
+            )
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf in _FLAG_READS:
+            return (
+                f"flag-read:{leaf}",
+                f"`{leaf}()` inside traced code pins the flag value at trace time",
+                "read the flag in the caller and close over / pass the value",
+            )
+        if d == "os.getenv" or d.startswith("os.environ"):
+            return (
+                "env-read:os",
+                "`os.environ` read inside traced code is frozen at trace time",
+                "read the environment outside the jitted fn",
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in params
+        ):
+            return (
+                f"host-cast:{node.func.id}:{node.args[0].id}",
+                f"`{node.func.id}({node.args[0].id})` on a traced argument forces a host sync",
+                "keep the value as a jax array; branch with lax.cond / jnp.where",
+            )
+    elif isinstance(node, ast.Subscript) and dotted(node.value) == "os.environ":
+        return (
+            "env-read:os",
+            "`os.environ[...]` read inside traced code is frozen at trace time",
+            "read the environment outside the jitted fn",
+        )
+    return None
+
+
+def check(repo):
+    findings = []
+    for sf in repo.files:
+        if "jax" not in sf.text:
+            continue
+        index = sf.index()
+        seen_entries = set()
+        for entry, entry_name in _entries(index):
+            if id(entry) in seen_entries:
+                continue
+            seen_entries.add(id(entry))
+            if isinstance(entry, ast.Lambda):
+                region = [entry]
+            else:
+                region = walk_traced(index, entry)
+            for fn in region:
+                params = {
+                    a.arg
+                    for a in getattr(fn.args, "args", [])
+                    + getattr(fn.args, "posonlyargs", [])
+                    + getattr(fn.args, "kwonlyargs", [])
+                }
+                for node in ast.walk(fn):
+                    hit = _violation(node, params)
+                    if hit is None:
+                        continue
+                    slug, message, hint = hit
+                    sym = (
+                        index.qualname(fn)
+                        if not isinstance(fn, ast.Lambda)
+                        else entry_name
+                    )
+                    findings.append(
+                        Finding(
+                            rule="TPL001",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            symbol=sym,
+                            tag=slug,
+                            message=f"{message} (traced via jitted entry `{entry_name}`)",
+                            hint=hint,
+                        )
+                    )
+    # de-dup: one node can be reached from several entries
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.path, f.line, f.col, f.tag), f)
+    return list(uniq.values())

@@ -1,0 +1,186 @@
+"""TPL002: collective issue order.
+
+Cross-rank deadlocks come from ranks disagreeing on *whether* or *in what
+order* a collective is issued. Flagged shapes:
+
+- a collective call under an ``if``/``while`` whose test reads tensor data
+  (``.numpy()``, ``.item()``, ``float(x)``) — ranks can branch differently;
+- a collective call inside an ``except`` handler — only the failing rank
+  issues it;
+- ``.wait()`` on a communication task inside a ``no_sync()`` block — the
+  gradient-sync elision contract says no collective completion in there;
+- calls to the raw issue internals (``_run_once`` / ``_run_multiproc`` /
+  ``_eager_collective``) from outside ``distributed/collective.py`` — those
+  bypass the epoch fence that makes issue order restart-safe.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import ModuleIndex, dotted
+
+_COLLECTIVES = {
+    "all_reduce",
+    "all_gather",
+    "all_gather_tiled",
+    "reduce_scatter",
+    "reduce_scatter_avg",
+    "all_to_all",
+    "broadcast",
+    "reduce",
+    "scatter",
+    "send",
+    "recv",
+    "barrier",
+}
+_COLLECTIVE_RECEIVERS = {"coll", "dist", "collective", "distributed", "group", "g"}
+_FENCE_INTERNALS = {"_run_once", "_run_multiproc", "_eager_collective", "_replicated"}
+_FENCED_MODULE = "paddle_tpu/distributed/collective.py"
+
+
+def is_collective_call(node: ast.Call) -> str:
+    """Collective op name if this call issues one, else ''."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in _COLLECTIVES:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _COLLECTIVES:
+        recv = dotted(func.value)
+        leaf = recv.rsplit(".", 1)[-1].lower() if recv else ""
+        if leaf in _COLLECTIVE_RECEIVERS or recv.endswith("paddle.distributed"):
+            return func.attr
+    return ""
+
+
+def _test_reads_tensor(test) -> str:
+    """Expression fragment proving the branch test is data-dependent, or ''."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "numpy",
+                "item",
+                "any",
+                "all",
+            ):
+                return f".{node.func.attr}()"
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and isinstance(node.args[0], (ast.Name, ast.Attribute, ast.Subscript))
+            ):
+                return f"{node.func.id}(...)"
+    return ""
+
+
+def check(repo):
+    findings = []
+    for sf in repo.files:
+        index = sf.index()
+        in_fenced_module = sf.relpath == _FENCED_MODULE
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            sym = ""
+            fn = index.enclosing_function(node)
+            if fn is not None:
+                sym = index.qualname(fn)
+
+            op = is_collective_call(node)
+            if op:
+                for anc in index.ancestors(node):
+                    if isinstance(anc, (ast.If, ast.While)):
+                        frag = _test_reads_tensor(anc.test)
+                        if frag:
+                            findings.append(
+                                Finding(
+                                    rule="TPL002",
+                                    path=sf.relpath,
+                                    line=node.lineno,
+                                    col=node.col_offset,
+                                    symbol=sym,
+                                    tag=f"data-dep-branch:{op}",
+                                    message=(
+                                        f"collective `{op}` issued under a data-dependent "
+                                        f"branch (test reads tensor data via {frag}); "
+                                        "ranks can disagree and deadlock"
+                                    ),
+                                    hint="issue unconditionally, branch on the replicated result",
+                                    extra_anchor_lines=(anc.lineno,),
+                                )
+                            )
+                            break
+                    if isinstance(anc, ast.ExceptHandler):
+                        findings.append(
+                            Finding(
+                                rule="TPL002",
+                                path=sf.relpath,
+                                line=node.lineno,
+                                col=node.col_offset,
+                                symbol=sym,
+                                tag=f"except-issue:{op}",
+                                message=(
+                                    f"collective `{op}` issued inside an `except` handler: "
+                                    "only the failing rank issues it, peers hang"
+                                ),
+                                hint="recover via the epoch fence / gang restart, not an ad-hoc collective",
+                                extra_anchor_lines=(anc.lineno,),
+                            )
+                        )
+                        break
+
+            # .wait() inside a no_sync() block
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"
+                and not node.args
+            ):
+                for anc in index.ancestors(node):
+                    if isinstance(anc, ast.With):
+                        for item in anc.items:
+                            ctx = item.context_expr
+                            d = dotted(ctx.func) if isinstance(ctx, ast.Call) else dotted(ctx)
+                            if d.rsplit(".", 1)[-1] == "no_sync":
+                                findings.append(
+                                    Finding(
+                                        rule="TPL002",
+                                        path=sf.relpath,
+                                        line=node.lineno,
+                                        col=node.col_offset,
+                                        symbol=sym,
+                                        tag="wait-in-no-sync",
+                                        message=(
+                                            "`.wait()` inside `no_sync()`: gradient-sync "
+                                            "elision must not complete comm tasks"
+                                        ),
+                                        hint="wait after the no_sync block closes",
+                                        extra_anchor_lines=(anc.lineno,),
+                                    )
+                                )
+                                break
+
+            # fence bypass from outside the fenced module
+            if not in_fenced_module:
+                leaf = ""
+                if isinstance(node.func, ast.Attribute):
+                    leaf = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    leaf = node.func.id
+                if leaf in _FENCE_INTERNALS:
+                    findings.append(
+                        Finding(
+                            rule="TPL002",
+                            path=sf.relpath,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            symbol=sym,
+                            tag=f"fence-bypass:{leaf}",
+                            message=(
+                                f"`{leaf}` called outside distributed/collective.py "
+                                "bypasses the epoch-fenced issue path"
+                            ),
+                            hint="go through the public collective.* wrappers (they stamp and check the epoch)",
+                        )
+                    )
+    return findings

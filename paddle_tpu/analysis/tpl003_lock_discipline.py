@@ -1,0 +1,190 @@
+"""TPL003: no blocking work under a lock.
+
+Finds ``with <something named *lock*>:`` bodies and flags blocking
+operations lexically inside them — directly, or one/two calls away through
+functions and methods in the same module (``self._helper()`` under the lock
+where ``_helper`` blocks counts; that is how the real bugs hide).
+
+Blocking primitives recognized: ``time.sleep``, subprocess waits, thread /
+task / worker ``.join()``, ``.wait()``, barriers, socket I/O, queue
+``get``/``put``, coordination-store RPCs, and collective issue (via the
+TPL002 matcher).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import ModuleIndex, dotted
+from .tpl002_collective_order import is_collective_call
+
+_SUBPROCESS = {"run", "call", "check_call", "check_output"}
+_STORE_METHODS = {
+    "get",
+    "set",
+    "add",
+    "wait",
+    "check",
+    "barrier",
+    "delete_key",
+    "compare_set",
+    "multi_get",
+    "multi_set",
+}
+_SOCKETY = {"recv", "recv_into", "accept", "connect", "sendall", "makefile"}
+_JOIN_RECEIVER_HINTS = ("thread", "proc", "task", "worker", "writer", "loop")
+
+
+def _recv_leaf(func: ast.Attribute) -> str:
+    """Lower-cased last segment of the receiver expression, '' if opaque."""
+    d = dotted(func.value)
+    if d:
+        return d.rsplit(".", 1)[-1].lower()
+    # e.g. self._locks[i].foo, (x or y).foo — fall back to unparse
+    try:
+        return ast.unparse(func.value).rsplit(".", 1)[-1].lower()
+    except Exception:
+        return ""
+
+
+def blocking_reason(node: ast.Call) -> str:
+    """Why this call blocks, or '' if it does not (by our heuristics)."""
+    d = dotted(node.func)
+    if d == "time.sleep":
+        return "time.sleep"
+    op = is_collective_call(node)
+    if op:
+        return f"collective `{op}` issue"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        recv = _recv_leaf(node.func)
+        if d.startswith("subprocess.") and attr in _SUBPROCESS:
+            return f"subprocess.{attr}"
+        if attr == "communicate":
+            return "subprocess communicate()"
+        if attr == "wait" and not d.startswith("os."):
+            # Condition.wait releases the lock it wraps — not a hold-and-block
+            if "cond" in recv or recv == "cv":
+                return ""
+            return f"{recv or 'task'}.wait()"
+        if attr == "join" and any(h in recv for h in _JOIN_RECEIVER_HINTS):
+            return f"{recv}.join()"
+        if attr == "barrier":
+            return f"{recv or 'group'}.barrier()"
+        if attr == "block_until_ready":
+            return "device sync (block_until_ready)"
+        if attr in _SOCKETY and ("sock" in recv or "conn" in recv):
+            return f"socket {attr}()"
+        if attr in ("get", "put") and ("queue" in recv or recv == "q"):
+            return f"queue {attr}()"
+        if "store" in recv and attr in _STORE_METHODS:
+            return f"store RPC {attr}()"
+    return ""
+
+
+def _lock_name(with_item) -> str:
+    """The lock expression text if this ``with`` item acquires a lock."""
+    ctx = with_item.context_expr
+    try:
+        text = ast.unparse(ctx)
+    except Exception:
+        return ""
+    head = text.split("(")[0]
+    return text if "lock" in head.lower() else ""
+
+
+def _fn_blocking_sites(fn) -> list:
+    """(call node, reason) for direct blocking calls anywhere in ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            reason = blocking_reason(node)
+            if reason:
+                out.append((node, reason))
+    return out
+
+
+def check(repo):
+    findings = []
+    for sf in repo.files:
+        if "lock" not in sf.text.lower():
+            continue
+        index = sf.index()
+        for node in sf.walk():
+            if not isinstance(node, ast.With):
+                continue
+            lock = ""
+            for item in node.items:
+                lock = _lock_name(item)
+                if lock:
+                    break
+            if not lock:
+                continue
+            sym_fn = index.enclosing_function(node)
+            sym = index.qualname(sym_fn) if sym_fn is not None else ""
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                reason = blocking_reason(inner)
+                if reason:
+                    findings.append(
+                        Finding(
+                            rule="TPL003",
+                            path=sf.relpath,
+                            line=inner.lineno,
+                            col=inner.col_offset,
+                            symbol=sym,
+                            tag=f"direct:{reason}",
+                            message=f"blocking op ({reason}) inside `with {lock}:`",
+                            hint="snapshot state under the lock, release it, then block",
+                            extra_anchor_lines=(node.lineno,),
+                        )
+                    )
+                    continue
+                # transitive: a local function/method called under the lock
+                # that itself blocks (depth 2 through one more local hop)
+                target = index.resolve_call(inner)
+                if target is None or target is sym_fn:
+                    continue
+                chain = _transitive_reason(index, target, depth=2)
+                if chain:
+                    findings.append(
+                        Finding(
+                            rule="TPL003",
+                            path=sf.relpath,
+                            line=inner.lineno,
+                            col=inner.col_offset,
+                            symbol=sym,
+                            tag=f"via:{target.name}:{chain[-1]}",
+                            message=(
+                                f"call under `with {lock}:` reaches blocking op "
+                                f"({chain[-1]}) via {' -> '.join(chain[:-1]) or target.name}"
+                            ),
+                            hint="move the blocking call out from under the lock",
+                            extra_anchor_lines=(node.lineno,),
+                        )
+                    )
+    return findings
+
+
+def _transitive_reason(index, fn, depth, _seen=None):
+    """['hop', ..., reason] if ``fn`` reaches a blocking call, else None."""
+    if _seen is None:
+        _seen = set()
+    if id(fn) in _seen or depth < 0:
+        return None
+    _seen.add(id(fn))
+    sites = _fn_blocking_sites(fn)
+    if sites:
+        return [fn.name, sites[0][1]]
+    if depth == 0:
+        return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            target = index.resolve_call(node)
+            if target is not None and target is not fn:
+                sub = _transitive_reason(index, target, depth - 1, _seen)
+                if sub:
+                    return [fn.name] + sub
+    return None

@@ -1,0 +1,162 @@
+"""TPL004: flags drift.
+
+Three drift directions, all machine-checked:
+
+- a flag *read* (``flag_value``/``get_flags``/``set_flags`` with a constant
+  name, or a ``FLAGS_*`` environment access) that does not resolve to a
+  ``define_flag`` registration — raises at runtime;
+- a ``define_flag`` with empty ``help`` — invisible to users;
+- registry vs MIGRATION.md flag tables: registered-but-undocumented and
+  documented-but-unregistered both fire (doc findings anchor to
+  MIGRATION.md and can only be baselined, not pragma'd).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+from .callgraph import ModuleIndex, dotted
+
+_FLAGS_TOKEN = re.compile(r"FLAGS_([A-Za-z0-9_]+)")
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and isinstance(node.value, str) else None
+
+
+def _norm(name: str) -> str:
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
+def collect_registrations(repo):
+    """{flag name: (SourceFile, define_flag call node, help text or None)}."""
+    regs = {}
+    for sf in repo.files:
+        if "define_flag" not in sf.text:
+            continue
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dotted(node.func).rsplit(".", 1)[-1]
+            if leaf != "define_flag" or not node.args:
+                continue
+            name = _const_str(node.args[0])
+            if name is None:
+                continue
+            help_text = None
+            if len(node.args) >= 3:
+                help_text = _const_str(node.args[2])
+            for kw in node.keywords:
+                if kw.arg == "help":
+                    help_text = _const_str(kw.value)
+            regs[name] = (sf, node, help_text)
+    return regs
+
+
+def collect_reads(repo):
+    """Yield (SourceFile, node, flag name) for every constant-name flag read."""
+    for sf in repo.files:
+        for node in sf.walk():
+            if isinstance(node, ast.Call):
+                leaf = dotted(node.func).rsplit(".", 1)[-1]
+                if leaf == "flag_value" and node.args:
+                    name = _const_str(node.args[0])
+                    if name is not None:
+                        yield sf, node, _norm(name)
+                elif leaf in ("get_flags", "set_flags") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, (ast.List, ast.Tuple)):
+                        for el in arg.elts:
+                            name = _const_str(el)
+                            if name is not None:
+                                yield sf, node, _norm(name)
+                    elif isinstance(arg, ast.Dict):
+                        for k in arg.keys:
+                            name = _const_str(k)
+                            if name is not None:
+                                yield sf, node, _norm(name)
+                    else:
+                        name = _const_str(arg)
+                        if name is not None:
+                            yield sf, node, _norm(name)
+                elif dotted(node.func) in ("os.getenv", "os.environ.get") and node.args:
+                    name = _const_str(node.args[0])
+                    if name and name.startswith("FLAGS_"):
+                        yield sf, node, _norm(name)
+            elif isinstance(node, ast.Subscript) and dotted(node.value) == "os.environ":
+                name = _const_str(node.slice)
+                if name and name.startswith("FLAGS_"):
+                    yield sf, node, _norm(name)
+
+
+def _doc_mentions(text):
+    """{flag name: first line number} for FLAGS_* tokens in a markdown doc."""
+    out = {}
+    for ln, line in enumerate(text.splitlines(), start=1):
+        for m in _FLAGS_TOKEN.finditer(line):
+            out.setdefault(m.group(1), ln)
+    return out
+
+
+def check(repo):
+    findings = []
+    regs = collect_registrations(repo)
+
+    for name, (sf, node, help_text) in regs.items():
+        if not (help_text or "").strip():
+            findings.append(
+                Finding(
+                    rule="TPL004",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    tag=f"empty-help:{name}",
+                    message=f"define_flag(\"{name}\", ...) has empty help text",
+                    hint="say what the flag does and when to flip it",
+                )
+            )
+
+    for sf, node, name in collect_reads(repo):
+        if name not in regs:
+            findings.append(
+                Finding(
+                    rule="TPL004",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    tag=f"unregistered-read:{name}",
+                    message=f"flag `{name}` is read here but never registered via define_flag",
+                    hint="register it (with help text) or fix the name",
+                )
+            )
+
+    if repo.migration is not None:
+        doc = _doc_mentions(repo.migration)
+        for name, (sf, node, _h) in sorted(regs.items()):
+            if name not in doc:
+                findings.append(
+                    Finding(
+                        rule="TPL004",
+                        path=sf.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        tag=f"undocumented:{name}",
+                        message=f"flag `{name}` is registered but absent from the MIGRATION.md flag tables",
+                        hint="add a row to the MIGRATION.md flags table",
+                    )
+                )
+        for name, ln in sorted(doc.items()):
+            if name not in regs:
+                findings.append(
+                    Finding(
+                        rule="TPL004",
+                        path="MIGRATION.md",
+                        line=ln,
+                        tag=f"unregistered-doc:{name}",
+                        message=f"MIGRATION.md mentions FLAGS_{name} but no define_flag registers it",
+                        hint="register the flag or mark the row as reference-only",
+                    )
+                )
+    return findings

@@ -1,0 +1,257 @@
+"""TPL005: metrics drift.
+
+Observability has one choke point — ``emit(kind, dur_s, **fields)`` routed
+through the ``_HANDLERS`` table — and one namespace: ``paddle_*`` metric
+names in the registry. Drift shapes flagged:
+
+- ``emit("kind", ...)`` with no ``_HANDLERS`` entry: the event is silently
+  dropped (the bug class this rule exists for);
+- a ``_HANDLERS`` entry no code emits: dead handler;
+- a ``paddle_*`` metric name referenced in code or README that the registry
+  never registers (README wildcards like ``paddle_router_*`` match by
+  prefix);
+- ops.yaml vs generated bindings: an op declared in the YAML manifest with
+  no generated binding, or a generated binding with no YAML entry (the
+  reference's op-YAML generator consistency check, statically enforced).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+from .callgraph import dotted
+
+_METRIC_RE = re.compile(r"^paddle_[a-z0-9_]+$")
+_DOC_METRIC_RE = re.compile(r"\bpaddle_[a-z0-9_*]+")
+# not metric families: the package name, the C-API artifact names, and
+# anything with fewer than three segments (real metrics are
+# paddle_<subsystem>_<what>[_unit]; two-segment paddle_* strings are API
+# names like "paddle_save")
+_NOT_METRICS = ("paddle_tpu", "paddle_c_api", "paddle_distress")
+_REG_LEAVES = {"_C", "_G", "_H", "counter", "gauge", "histogram"}
+_OPS_YAML = "paddle_tpu/ops/ops.yaml"
+_BINDINGS = "paddle_tpu/ops/generated_bindings.py"
+_HANDLERS_FILE = "paddle_tpu/observability/__init__.py"
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and isinstance(node.value, str) else None
+
+
+def _is_metric_name(s: str) -> bool:
+    return (
+        bool(_METRIC_RE.match(s))
+        and not s.startswith(_NOT_METRICS)
+        and not s.endswith("_")
+        and s.count("_") >= 2
+    )
+
+
+def _emit_kinds_used(repo):
+    """{kind: (SourceFile, node)} for every constant-kind emit() call."""
+    out = {}
+    for sf in repo.files:
+        for node in sf.walk():
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            leaf = dotted(node.func).rsplit(".", 1)[-1]
+            if leaf != "emit" and not leaf.endswith("_emit"):
+                continue
+            kind = _const_str(node.args[0])
+            if kind:
+                out.setdefault(kind, (sf, node))
+    return out
+
+
+def _handler_kinds(repo):
+    """{kind: (SourceFile, lineno)} from `_HANDLERS = {...}` dict literals
+    plus later `_HANDLERS["kind"] = ...` assignments. Returns None when no
+    handler table exists in the scanned tree (fixture mode without one)."""
+    found = False
+    out = {}
+    files = sorted(repo.files, key=lambda f: f.relpath != _HANDLERS_FILE)
+    for sf in files:
+        if "_HANDLERS" not in sf.text:
+            continue
+        for node in sf.walk():
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "_HANDLERS" and isinstance(
+                        node.value, ast.Dict
+                    ):
+                        found = True
+                        for k in node.value.keys:
+                            kind = _const_str(k)
+                            if kind:
+                                out.setdefault(kind, (sf, k.lineno))
+                    elif (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "_HANDLERS"
+                    ):
+                        kind = _const_str(tgt.slice)
+                        if kind:
+                            found = True
+                            out.setdefault(kind, (sf, node.lineno))
+    return out if found else None
+
+
+def _registered_metrics(repo):
+    names = set()
+    for sf in repo.files:
+        for node in sf.walk():
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            leaf = dotted(node.func).rsplit(".", 1)[-1]
+            if leaf in _REG_LEAVES:
+                name = _const_str(node.args[0])
+                if name and _is_metric_name(name):
+                    names.add(name)
+    return names
+
+
+def _metric_uses(repo, registered):
+    """(SourceFile, node, name) for paddle_* string constants outside
+    registration calls."""
+    for sf in repo.files:
+        reg_arg_ids = set()
+        for node in sf.walk():
+            if isinstance(node, ast.Call) and node.args:
+                leaf = dotted(node.func).rsplit(".", 1)[-1]
+                if leaf in _REG_LEAVES:
+                    reg_arg_ids.add(id(node.args[0]))
+        for node in sf.walk():
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if id(node) in reg_arg_ids:
+                    continue
+                if _is_metric_name(node.value):
+                    yield sf, node, node.value
+
+
+def _check_ops_yaml(repo, findings):
+    yaml_path = repo.root / _OPS_YAML
+    bindings = repo.file(_BINDINGS)
+    if not yaml_path.is_file() or bindings is None:
+        return
+    yaml_ops = {}
+    for ln, line in enumerate(
+        yaml_path.read_text(encoding="utf-8", errors="replace").splitlines(), start=1
+    ):
+        m = re.match(r"-\s*op\s*:\s*([A-Za-z0-9_]+)", line.strip())
+        if m:
+            yaml_ops.setdefault(m.group(1), ln)
+    gen_ops = {}
+    for node in bindings.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and not node.name.startswith("_"):
+            gen_ops.setdefault(node.name, node.lineno)
+    for op, ln in sorted(yaml_ops.items()):
+        if op not in gen_ops:
+            findings.append(
+                Finding(
+                    rule="TPL005",
+                    path=_OPS_YAML,
+                    line=ln,
+                    tag=f"op-missing-binding:{op}",
+                    message=f"op `{op}` declared in ops.yaml has no generated binding",
+                    hint="re-run tools/gen_op_bindings.py",
+                )
+            )
+    for op, ln in sorted(gen_ops.items()):
+        if op not in yaml_ops:
+            findings.append(
+                Finding(
+                    rule="TPL005",
+                    path=_BINDINGS,
+                    line=ln,
+                    symbol=op,
+                    tag=f"binding-missing-op:{op}",
+                    message=f"generated binding `{op}` has no ops.yaml entry",
+                    hint="declare the op in ops.yaml and regenerate, or delete the stale binding",
+                )
+            )
+
+
+def check(repo):
+    findings = []
+
+    used = _emit_kinds_used(repo)
+    handled = _handler_kinds(repo)
+    if handled is not None:
+        for kind, (sf, node) in sorted(used.items()):
+            if kind not in handled:
+                findings.append(
+                    Finding(
+                        rule="TPL005",
+                        path=sf.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        tag=f"unhandled-kind:{kind}",
+                        message=f"emit kind `{kind}` has no _HANDLERS entry; the event is silently dropped",
+                        hint="add a handler (and a metric) in observability/__init__.py",
+                    )
+                )
+        for kind, (sf, ln) in sorted(handled.items()):
+            if kind not in used:
+                findings.append(
+                    Finding(
+                        rule="TPL005",
+                        path=sf.relpath,
+                        line=ln,
+                        tag=f"unused-kind:{kind}",
+                        message=f"_HANDLERS entry `{kind}` is never emitted by any scanned code",
+                        hint="delete the dead handler or emit the kind",
+                    )
+                )
+
+    registered = _registered_metrics(repo)
+    if registered:
+        seen = set()
+        for sf, node, name in _metric_uses(repo, registered):
+            if name in registered or name in seen:
+                continue
+            seen.add(name)
+            findings.append(
+                Finding(
+                    rule="TPL005",
+                    path=sf.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    tag=f"unregistered-metric:{name}",
+                    message=f"metric name `{name}` referenced but not registered",
+                    hint="register it in observability/__init__.py or fix the name",
+                )
+            )
+        if repo.readme is not None:
+            for ln, line in enumerate(repo.readme.splitlines(), start=1):
+                for m in _DOC_METRIC_RE.finditer(line):
+                    token = m.group(0).rstrip("*_")
+                    if not token or token.startswith(_NOT_METRICS):
+                        continue
+                    if "*" in m.group(0):
+                        if not any(r.startswith(token) for r in registered):
+                            findings.append(
+                                Finding(
+                                    rule="TPL005",
+                                    path="README.md",
+                                    line=ln,
+                                    tag=f"doc-metric-wildcard:{token}",
+                                    message=f"README documents `{m.group(0)}` but no registered metric matches that prefix",
+                                    hint="fix the README or register the family",
+                                )
+                            )
+                    elif _is_metric_name(m.group(0)) and m.group(0) not in registered:
+                        findings.append(
+                            Finding(
+                                rule="TPL005",
+                                path="README.md",
+                                line=ln,
+                                tag=f"doc-metric:{m.group(0)}",
+                                message=f"README documents metric `{m.group(0)}` but the registry never registers it",
+                                hint="fix the README or register the metric",
+                            )
+                        )
+
+    _check_ops_yaml(repo, findings)
+    return findings

@@ -10,6 +10,7 @@ surface the reference exposes via pybind
 """
 from __future__ import annotations
 
+import difflib
 import os
 from typing import Any, Dict, Iterable, Optional
 
@@ -48,6 +49,14 @@ def define_flag(name: str, default: Any, help: str = "", env: bool = True):
     return value
 
 
+def _unknown_flag(key: str) -> ValueError:
+    msg = f"Flag FLAGS_{key} is not registered"
+    close = difflib.get_close_matches(key, list(_REGISTRY), n=3, cutoff=0.6)
+    if close:
+        msg += "; did you mean " + ", ".join(f"FLAGS_{c}" for c in close) + "?"
+    return ValueError(msg)
+
+
 def get_flags(flags) -> Dict[str, Any]:
     """paddle.get_flags parity."""
     if isinstance(flags, str):
@@ -56,7 +65,7 @@ def get_flags(flags) -> Dict[str, Any]:
     for f in flags:
         key = f[6:] if f.startswith("FLAGS_") else f
         if key not in _REGISTRY:
-            raise ValueError(f"Flag FLAGS_{key} is not registered")
+            raise _unknown_flag(key)
         out[f"FLAGS_{key}"] = _REGISTRY[key]["value"]
     return out
 
@@ -66,7 +75,7 @@ def set_flags(flags: Dict[str, Any]):
     for f, v in flags.items():
         key = f[6:] if f.startswith("FLAGS_") else f
         if key not in _REGISTRY:
-            raise ValueError(f"Flag FLAGS_{key} is not registered")
+            raise _unknown_flag(key)
         _REGISTRY[key]["value"] = _coerce(v, _REGISTRY[key]["default"])
         for fn in _WATCHERS:
             fn(key, _REGISTRY[key]["value"])

@@ -115,7 +115,8 @@ def _sample_next(logits, key, temperature, top_p, top_k):
     traced scalar or None (static off); temperature a traced scalar."""
     l = logits / temperature
     if top_k:
-        vals = jax.lax.top_k(l, int(top_k))[0]
+        # top_k is a static python int (see docstring) — int() is trace-free
+        vals = jax.lax.top_k(l, int(top_k))[0]  # tpu-lint: disable=TPL001
         l = jnp.where(l < vals[..., -1:], -jnp.inf, l)
     if top_p is not None:
         sl = jnp.sort(l, axis=-1)[..., ::-1]

@@ -79,7 +79,8 @@ def _sample_rows(logits, keys, temps, top_ps, top_k: int):
     temps/top_ps [B]; keys [B, 2] uint32; top_k static (0 = off)."""
     l = logits / jnp.maximum(temps, 1e-6)[:, None]
     if top_k:
-        vals = jax.lax.top_k(l, int(top_k))[0]
+        # top_k is a static python int (see docstring) — int() is trace-free
+        vals = jax.lax.top_k(l, int(top_k))[0]  # tpu-lint: disable=TPL001
         l = jnp.where(l < vals[..., -1:], -jnp.inf, l)
     sl = jnp.sort(l, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sl, axis=-1)

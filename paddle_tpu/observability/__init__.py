@@ -166,6 +166,16 @@ _c_ckpt_loads = _C("paddle_ckpt_loads_total",
                    "CheckpointManager restores from disk")
 _c_preempt = _C("paddle_preemption_flushes_total",
                 "Final checkpoint flushes triggered by SIGTERM")
+_c_coll_issue = _C("paddle_collective_issues_total",
+                   "Collectives issued (pre-completion), by op; the gap "
+                   "against paddle_collectives_total is in-flight or failed")
+_c_aborts = _C("paddle_eager_aborts_total",
+               "In-flight steps discarded by async-engine abort()")
+_c_ckpt_gc = _C("paddle_ckpt_gc_total",
+                "Old checkpoints removed by CheckpointManager retention GC")
+_c_ckpt_hook_err = _C("paddle_ckpt_hook_errors_total",
+                      "Step-boundary hook exceptions swallowed by "
+                      "CheckpointManager")
 _c_dp_comms = _C("paddle_dp_bucket_comms_total",
                  "DataParallel bucket collectives issued, by op")
 _h_dp_comm = _H("paddle_dp_bucket_comm_seconds",
@@ -381,8 +391,13 @@ _HANDLERS = {
     "async.sync_wait": lambda d, f: (_h_stall.observe(d)
                                      if d is not None else None),
     "async.drain": lambda d, f: _c_drains.inc(),
+    "async.abort": lambda d, f: _c_aborts.inc(f.get("n_steps", 0)),
     "backward": _h_backward,
     "collective.complete": _h_collective,
+    "collective.issue": lambda d, f: _c_coll_issue.inc(
+        labels={"op": f.get("op", "")}),
+    "collective.gang_restart": lambda d, f: _c_elastic.inc(
+        labels={"kind": "gang_restart"}),
     "optimizer.step": _h_optimizer,
     "nan_check.trip": lambda d, f: _c_nan.inc(
         labels={"op": f.get("op", "")}),
@@ -439,6 +454,8 @@ _HANDLERS = {
     "ckpt.rollback": lambda d, f: _c_rollbacks.inc(),
     "ckpt.load": lambda d, f: _c_ckpt_loads.inc(),
     "ckpt.preempt": lambda d, f: _c_preempt.inc(),
+    "ckpt.gc": lambda d, f: _c_ckpt_gc.inc(),
+    "ckpt.hook_error": lambda d, f: _c_ckpt_hook_err.inc(),
     "dp.bucket_comm": lambda d, f: (
         _c_dp_comms.inc(labels={"op": f.get("op", "")}),
         _c_dp_reduced.inc(f.get("bytes", 0)),

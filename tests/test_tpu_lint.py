@@ -1,0 +1,236 @@
+"""tpu-lint: per-rule firing fixtures, pragma + baseline round-trip, and the
+live-tree gate (zero unbaselined findings, <10s runtime budget)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import tpu_lint  # noqa: E402
+
+an = tpu_lint.load_analysis()
+
+
+# ---------------------------------------------------------------------------
+# fixture repo: one file per rule, each fires exactly once
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "mod001.py": """
+        import time
+        import jax
+
+        def make(scale):
+            def step(x):
+                t = time.time()
+                return x * scale + t
+            return jax.jit(step)
+        """,
+    "mod002.py": """
+        def sync(coll, loss):
+            if float(loss) > 0:
+                coll.all_reduce(loss)
+        """,
+    "mod003.py": """
+        import time
+
+        class Worker:
+            def poke(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """,
+    "mod004.py": """
+        def f(flag_value):
+            return flag_value("does_not_exist")
+        """,
+    "mod005.py": """
+        _HANDLERS = {"good.kind": None}
+
+        def emit(kind, **fields):
+            pass
+
+        def use():
+            emit("good.kind")
+            emit("bad.kind")
+        """,
+}
+
+
+def _write_fixture_repo(root, sources):
+    pkg = root / "paddle_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    for name, src in sources.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return root
+
+
+@pytest.fixture()
+def fixture_repo(tmp_path):
+    return _write_fixture_repo(tmp_path, FIXTURES)
+
+
+def _run(root, rules=None):
+    return an.run_all(an.Repo(root), rules=rules)
+
+
+def test_each_rule_fires_exactly_once(fixture_repo):
+    findings = _run(fixture_repo)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule in ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005"):
+        assert len(by_rule.get(rule, [])) == 1, (
+            rule, [f.to_dict() for f in findings])
+    assert len(findings) == 5
+
+
+def test_finding_shape_and_keys(fixture_repo):
+    findings = _run(fixture_repo)
+    for f in findings:
+        assert f.path.startswith("paddle_tpu/mod")
+        assert f.line > 0
+        assert f.message and f.hint
+        assert f.severity in ("error", "warning")
+        # stable identity: rule:path:symbol:tag, no line numbers
+        assert f.key.startswith(f"{f.rule}:{f.path}:")
+        assert str(f.line) not in f.key.split(":", 2)[2]
+    t1 = next(f for f in findings if f.rule == "TPL001")
+    assert t1.tag == "clock:time.time"
+    t3 = next(f for f in findings if f.rule == "TPL003")
+    assert "time.sleep" in t3.tag
+
+
+def test_pragma_suppresses_only_that_rule(tmp_path):
+    src = dict(FIXTURES)
+    src["mod003.py"] = """
+        import time
+
+        class Worker:
+            def poke(self):
+                with self._lock:
+                    time.sleep(0.1)  # tpu-lint: disable=TPL003
+        """
+    findings = _run(_write_fixture_repo(tmp_path, src))
+    assert not [f for f in findings if f.rule == "TPL003"]
+    assert len(findings) == 4  # other rules unaffected
+
+
+def test_pragma_on_line_above_and_with_anchor(tmp_path):
+    src = dict(FIXTURES)
+    src["mod001.py"] = """
+        import time
+        import jax
+
+        def make(scale):
+            def step(x):
+                # tpu-lint: disable=TPL001
+                t = time.time()
+                return x * scale + t
+            return jax.jit(step)
+        """
+    src["mod003.py"] = """
+        import time
+
+        class Worker:
+            def poke(self):
+                with self._lock:  # tpu-lint: disable=TPL003
+                    time.sleep(0.1)
+        """
+    findings = _run(_write_fixture_repo(tmp_path, src))
+    assert not [f for f in findings if f.rule in ("TPL001", "TPL003")]
+
+
+def test_baseline_round_trip(fixture_repo, tmp_path):
+    baseline_path = tmp_path / "baseline.json"
+    findings = _run(fixture_repo)
+    target = next(f for f in findings if f.rule == "TPL003")
+
+    # add: baselining the finding suppresses exactly it
+    an.Baseline([{"key": target.key, "justification": "fixture"}]).save(
+        baseline_path)
+    bl = an.Baseline.load(baseline_path)
+    unbaselined, baselined, stale = bl.split(_run(fixture_repo))
+    assert target.key not in {f.key for f in unbaselined}
+    assert {f.key for f in baselined} == {target.key}
+    assert not stale
+
+    # remove: it fires again
+    an.Baseline([]).save(baseline_path)
+    unbaselined, baselined, stale = an.Baseline.load(baseline_path).split(
+        _run(fixture_repo))
+    assert target.key in {f.key for f in unbaselined}
+    assert not baselined
+
+    # stale: an entry that stops firing is reported
+    an.Baseline([{"key": "TPL003:gone.py::via:nothing",
+                  "justification": "stale"}]).save(baseline_path)
+    _, _, stale = an.Baseline.load(baseline_path).split(_run(fixture_repo))
+    assert stale == ["TPL003:gone.py::via:nothing"]
+
+
+def test_rule_filter(fixture_repo):
+    findings = _run(fixture_repo, rules=["TPL003"])
+    assert {f.rule for f in findings} == {"TPL003"}
+
+
+def test_explain_has_every_rule():
+    for rule, (title, severity, text) in an.RULES.items():
+        assert title and text
+        assert severity in ("error", "warning")
+
+
+def test_flags_near_miss_suggestions():
+    from paddle_tpu.core import flags
+    with pytest.raises(ValueError, match="did you mean.*FLAGS_jit_cache_size"):
+        flags.get_flags("jit_cache_sz")
+    with pytest.raises(ValueError, match="did you mean"):
+        flags.set_flags({"FLAGS_fused_optimiser": True})
+    with pytest.raises(ValueError) as ei:
+        flags.get_flags("zzzz_no_such_flag_at_all")
+    assert "did you mean" not in str(ei.value)  # no close match, no noise
+
+
+# ---------------------------------------------------------------------------
+# the live tree is the real fixture: lint-clean, in budget
+# ---------------------------------------------------------------------------
+
+def test_live_tree_is_lint_clean_within_budget():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["unbaselined"] == 0, payload["findings"]
+    assert payload["stale_baseline"] == []
+    assert payload["files_scanned"] > 100
+    assert payload["wall_s"] < 10.0, payload["wall_s"]
+
+
+def test_live_baseline_entries_are_justified():
+    with open(os.path.join(REPO, "tools", "lint_baseline.json")) as f:
+        data = json.load(f)
+    for entry in data["suppressions"]:
+        assert entry["key"].split(":")[0] in an.RULES
+        just = entry.get("justification", "")
+        assert len(just) > 20 and "TODO" not in just, entry
+
+
+def test_ops_yaml_cross_check_fires_on_drift(tmp_path):
+    root = _write_fixture_repo(tmp_path, {})
+    ops_dir = root / "paddle_tpu" / "ops"
+    ops_dir.mkdir()
+    (ops_dir / "ops.yaml").write_text(
+        "- op: relu\n  args: (Tensor x)\n- op: phantom\n  args: (Tensor x)\n")
+    (ops_dir / "generated_bindings.py").write_text(
+        "def relu(x):\n    return x\n\ndef stale(x):\n    return x\n")
+    findings = [f for f in _run(root) if f.rule == "TPL005"]
+    tags = {f.tag for f in findings}
+    assert "op-missing-binding:phantom" in tags
+    assert "binding-missing-op:stale" in tags
+    assert not any(t.endswith(":relu") for t in tags)

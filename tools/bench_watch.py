@@ -305,6 +305,54 @@ def maybe_router_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/router_smoke.py)")
 
 
+_last_tpu_lint = [0.0]
+
+
+def maybe_tpu_lint(min_interval: float = 3600.0) -> None:
+    """Run the static-analysis gate (tools/tpu_lint.py) at most once per
+    min_interval and log a RED line on any unbaselined finding, stale
+    baseline entry, or a blown runtime budget — an invariant violation
+    (trace purity, collective order, lock discipline, flags/metrics
+    drift) is build-signal before any benchmark ever runs."""
+    now = time.monotonic()
+    if _last_tpu_lint[0] and now - _last_tpu_lint[0] < min_interval:
+        return
+    _last_tpu_lint[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
+             "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log("RED: tpu-lint hung >120s — static analysis broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    wall = payload.get("wall_s")
+    stale = payload.get("stale_baseline") or []
+    if out.returncode == 0 and wall is not None and wall <= 10.0:
+        log(f"tpu-lint GREEN ({payload.get('files_scanned')} files, "
+            f"{payload.get('baselined')} baselined, {wall}s)")
+        return
+    if wall is not None and wall > 10.0 and out.returncode == 0:
+        log(f"RED: tpu-lint runtime budget blown — {wall}s > 10s "
+            "(tools/tpu_lint.py)")
+        return
+    heads = [f"{f['rule']} {f['path']}:{f['line']}"
+             for f in (payload.get("findings") or [])[:3]]
+    detail = ("; ".join(heads) or
+              (f"{len(stale)} stale baseline entries" if stale else
+               (out.stderr or "").strip()[-200:]))
+    log(f"RED: tpu-lint rc={out.returncode} "
+        f"{payload.get('unbaselined', '?')} unbaselined — {detail} "
+        f"(tools/tpu_lint.py)")
+
+
 _last_elastic_smoke = [0.0]
 
 
@@ -451,6 +499,7 @@ def main() -> None:
     if args.capture:
         sys.exit(capture())
     if args.once:
+        maybe_tpu_lint()
         maybe_chaos_smoke()
         maybe_dp_overlap_smoke()
         maybe_serving_smoke()
@@ -462,6 +511,7 @@ def main() -> None:
         f"capture timeout {args.capture_timeout:.0f}s")
     while True:
         try:
+            maybe_tpu_lint()
             maybe_chaos_smoke()
             maybe_dp_overlap_smoke()
             maybe_serving_smoke()

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""tpu-lint CLI: whole-repo static analysis gate.
+
+Runs the five TPL rules over the tree and exits non-zero on any unbaselined
+finding (or stale baseline entry, on a full-rule run). Loads
+``paddle_tpu/analysis`` standalone — without importing ``paddle_tpu`` and
+therefore without importing jax — so a full-tree run stays well inside the
+10s pre-commit budget.
+
+Usage:
+  python tools/tpu_lint.py                  # human output, exit 0/1
+  python tools/tpu_lint.py --json           # machine output (bench_watch)
+  python tools/tpu_lint.py --explain TPL003
+  python tools/tpu_lint.py --rules TPL001,TPL005
+  python tools/tpu_lint.py --update-baseline   # absorb current findings
+
+Suppression: inline `# tpu-lint: disable=TPL00x` on (or above) the
+offending line, or a justified entry in tools/lint_baseline.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = ROOT / "tools" / "lint_baseline.json"
+
+
+def load_analysis():
+    """Load paddle_tpu/analysis as a standalone package (no jax import)."""
+    if "tpu_analysis" in sys.modules:
+        return sys.modules["tpu_analysis"]
+    pkg_dir = ROOT / "paddle_tpu" / "analysis"
+    spec = importlib.util.spec_from_file_location(
+        "tpu_analysis",
+        pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tpu_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu_lint", description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=str(ROOT), help="repo root to scan")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE), help="suppression file")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--rules", default="", help="comma-separated subset, e.g. TPL001,TPL003")
+    ap.add_argument("--explain", metavar="RULE", help="print what a rule enforces and exit")
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline: keep still-matching justified entries, "
+        "add current unbaselined findings with a TODO justification, drop stale keys",
+    )
+    args = ap.parse_args(argv)
+
+    an = load_analysis()
+
+    if args.explain:
+        rule = args.explain.upper()
+        if rule not in an.RULES:
+            print(f"unknown rule {rule}; known: {', '.join(sorted(an.RULES))}")
+            return 2
+        title, severity, text = an.RULES[rule]
+        print(f"{rule} ({title}, {severity})\n\n{text}")
+        return 0
+
+    rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()] or None
+    full_run = rules is None
+
+    t0 = time.time()
+    repo = an.Repo(args.root)
+    findings = an.run_all(repo, rules=rules)
+    baseline = an.Baseline.load(args.baseline)
+    unbaselined, baselined, stale = baseline.split(findings)
+    if not full_run:
+        stale = []  # a rule-filtered run cannot judge other rules' entries
+    wall_s = time.time() - t0
+
+    if args.update_baseline:
+        kept = [e for e in baseline.entries if e["key"] not in stale]
+        known = {e["key"] for e in kept}
+        added = 0
+        for f in unbaselined:
+            if f.key not in known:
+                kept.append({"key": f.key, "justification": "TODO: justify or fix"})
+                known.add(f.key)
+                added += 1
+        an.Baseline(kept).save(args.baseline)
+        print(
+            f"baseline updated: {len(kept)} entries "
+            f"(+{added} new, -{len(stale)} stale)"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "tool": "tpu_lint",
+                    "files_scanned": len(repo.files),
+                    "wall_s": round(wall_s, 3),
+                    "unbaselined": len(unbaselined),
+                    "baselined": len(baselined),
+                    "stale_baseline": stale,
+                    "findings": [f.to_dict() for f in unbaselined],
+                }
+            )
+        )
+    else:
+        for f in unbaselined:
+            print(f"{f.path}:{f.line}: {f.rule} {f.severity}: {f.message}")
+            if f.hint:
+                print(f"    hint: {f.hint}")
+            print(f"    key:  {f.key}")
+        for key in stale:
+            print(f"stale baseline entry (no longer fires): {key}")
+        print(
+            f"tpu-lint: {len(repo.files)} files, {len(unbaselined)} unbaselined, "
+            f"{len(baselined)} baselined, {len(stale)} stale, {wall_s:.2f}s"
+        )
+        if unbaselined or stale:
+            print(
+                "fix the findings, add `# tpu-lint: disable=RULE` where justified "
+                "inline, or run with --update-baseline and justify each entry."
+            )
+    return 1 if (unbaselined or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
