@@ -745,7 +745,83 @@ def bench_llama_decode():
 
 
 # ---------------------------------------------------------------------------
-# Config 7: raw eager dispatch latency (the hot path itself)
+# Config 7: MPMD pipeline schedules (distributed.pipeline)
+# ---------------------------------------------------------------------------
+
+def bench_pipeline_schedules():
+    """Pipeline-engine step time: naive-sequential (pp=1 microbatch
+    accumulation, no pipelining) vs 1F1B (pp=2) vs interleaved (pp=2, two
+    virtual chunks per group). Wall-clock overlap only manifests with
+    genuinely parallel stage devices, so the headline value is 1F1B
+    steps/s and the details carry the trio plus the simulated bubble
+    fractions (which ARE platform-independent: the closed forms
+    (pp-1)/(m+pp-1) and (pp-1)/(v*m+pp-1))."""
+    import statistics
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers import (
+        pp_layers)
+    from paddle_tpu.distributed.pipeline import (
+        PipelineEngine, closed_form_bubble)
+
+    M, D = 8, 256
+
+    def _mse(out, label):
+        return ((out - label) ** 2).mean()
+
+    def _descs():
+        return [pp_layers.LayerDesc(nn.Linear, D, D),
+                pp_layers.LayerDesc(nn.ReLU),
+                pp_layers.LayerDesc(nn.Linear, D, D),
+                pp_layers.LayerDesc(nn.ReLU),
+                pp_layers.LayerDesc(nn.Linear, D, D),
+                pp_layers.LayerDesc(nn.ReLU),
+                pp_layers.LayerDesc(nn.Linear, D, D)]
+
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(M * 4, D).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(M * 4, D).astype(np.float32))
+
+    def timed(pp, schedule, v=1, steps=5):
+        model = pp_layers.PipelineLayer(layers=_descs(), loss_fn=_mse,
+                                        num_stages=pp,
+                                        num_virtual_pipeline_stages=v)
+        engine = PipelineEngine(model, accumulate_steps=M,
+                                schedule=schedule)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = engine.run(x, y, train=True)
+            jax.block_until_ready(loss._data)
+            times.append(time.perf_counter() - t0)
+            for p in model.parameters():
+                p._grad = None
+        return statistics.median(times[1:]) * 1e3, engine
+
+    seq_ms, _ = timed(1, "gpipe")  # one stage: a plain accumulation loop
+    f1b_ms, eng = timed(2, "1F1B")
+    il_ms, eng_il = timed(2, "interleave", v=2)
+    bubble = eng.schedule_stats["bubble_fraction"]
+    bubble_il = eng_il.schedule_stats["bubble_fraction"]
+    return {
+        "value": round(1e3 / f1b_ms, 2), "unit": "1f1b_steps/s",
+        "details": {
+            "microbatches": M,
+            "sequential_ms": round(seq_ms, 3),
+            "f1b_ms": round(f1b_ms, 3),
+            "interleave_ms": round(il_ms, 3),
+            "bubble_1f1b": round(bubble, 6),
+            "bubble_interleave": round(bubble_il, 6),
+            "red_signal": bool(
+                abs(bubble - closed_form_bubble(2, M)) > 1e-9
+                or abs(bubble_il - closed_form_bubble(2, M, 2)) > 1e-9),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Config 8: raw eager dispatch latency (the hot path itself)
 # ---------------------------------------------------------------------------
 
 def bench_eager_dispatch_add():
@@ -787,6 +863,7 @@ CONFIGS = [
     ("bert_dp_sharding", bench_bert_dp_sharding),
     ("ppyoloe_style_detector_infer", bench_detection_infer),
     ("llama_decode_serving", bench_llama_decode),
+    ("pipeline_1f1b", bench_pipeline_schedules),
     ("eager_dispatch_add", bench_eager_dispatch_add),
 ]
 

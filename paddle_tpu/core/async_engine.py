@@ -193,6 +193,24 @@ def scalar_fetch(arr, tag: str = "tensor"):
     return arr
 
 
+def p2p_transfer(arr, put, tag: str = "p2p"):
+    """Issue an async device-to-device copy (pipeline stage handoff).
+
+    ``put`` maps the source buffer onto the destination placement —
+    ``jax.device_put`` under PJRT enqueues the copy and returns
+    immediately, so the caller's next dispatch (stage k's forward of
+    microbatch i+1) overlaps this transfer of microbatch i. The consumer
+    only blocks when it dereferences the returned in-flight buffer. Every
+    handoff lands in ``paddle_eager_p2p_transfers_total`` with its issue
+    latency, so transfer pressure is attributable per tag."""
+    t0 = time.perf_counter()
+    out = put(arr)
+    _emit("async.p2p", dur_s=time.perf_counter() - t0, tag=tag,
+          nbytes=int(getattr(arr, "nbytes", 0) or 0),
+          in_flight=len(_queue))
+    return out
+
+
 def wait_for(arrays: Iterable[Any], tag: str = "wait"):
     """Block until the given buffers are computed, under a ``fetch::<tag>``
     span with an ``async.fetch_stall``-style record — the attribution point

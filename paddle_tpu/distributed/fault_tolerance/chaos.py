@@ -45,6 +45,11 @@ one pointer check on the hot paths):
   streams fail over), ``stall`` (sleep ``delay=`` s and report a stall
   strike: healthy → degraded → dead), ``flap`` (a transient strike with
   no sleep — recovers on the next good step unless it strikes out).
+- ``pipeline`` — ``hang`` (sleep ``delay=`` s inside the watchdog
+  comm_task the pipeline engine arms around a stage dispatch, filtered
+  by ``stage=``/``microbatch=``: e.g. ``pipeline:hang@stage=1`` hangs
+  stage 1 so the ladder escalates and the distress dump names the
+  stage/microbatch).
 
 Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``
 (filter on the *calling* rank), ``victim=<int>`` (which rank a
@@ -56,7 +61,9 @@ toward ``call=``; default = the calling rank),
 call matching op/rank at this site, 0-based), ``count=<int>`` (max
 firings, default 1; 0 = unlimited), ``delay=<float>`` seconds,
 ``prob=<float>`` (fire with probability, seeded by ``FLAGS_chaos_seed``
-so runs are reproducible).
+so runs are reproducible), ``stage=<int>`` / ``microbatch=<int>``
+(pipeline-site filters: dispatches for other stages/microbatches do not
+count toward ``call=``).
 
 Every injection lands in the flight recorder and the
 ``paddle_chaos_injections_total{site,kind}`` counter via
@@ -91,7 +98,7 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
 
 
 _SITES = ("collective", "store", "dispatch", "fetch", "save", "serving",
-          "replica")
+          "replica", "pipeline")
 _KINDS = {
     "collective": ("delay", "timeout", "hang", "rank_dead"),
     "store": ("drop", "garble", "delay", "partition"),
@@ -100,18 +107,22 @@ _KINDS = {
     "save": ("crash", "rank_dead"),
     "serving": ("stall", "reject"),
     "replica": ("kill", "stall", "flap"),
+    "pipeline": ("hang",),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
-_INT_SELECTORS = ("rank", "victim", "step", "call", "count")
+_INT_SELECTORS = ("rank", "victim", "step", "call", "count", "stage",
+                  "microbatch")
 
 
 class Injection:
     __slots__ = ("site", "kind", "op", "rank", "victim", "step", "call",
-                 "count", "delay", "prob", "seen", "fired")
+                 "count", "delay", "prob", "stage", "microbatch", "seen",
+                 "fired")
 
     def __init__(self, site, kind, op=None, rank=None, victim=None,
-                 step=None, call=None, count=1, delay=0.05, prob=None):
+                 step=None, call=None, count=1, delay=0.05, prob=None,
+                 stage=None, microbatch=None):
         self.site = site
         self.kind = kind
         self.op = op
@@ -122,13 +133,15 @@ class Injection:
         self.count = count
         self.delay = delay
         self.prob = prob
+        self.stage = stage
+        self.microbatch = microbatch
         self.seen = 0    # calls that matched op/rank filters
         self.fired = 0   # injections actually applied
 
     def __repr__(self):
         sel = {k: getattr(self, k) for k in
                ("op", "rank", "victim", "step", "call", "count", "delay",
-                "prob")
+                "prob", "stage", "microbatch")
                if getattr(self, k) is not None}
         return f"Injection({self.site}:{self.kind} {sel} fired={self.fired})"
 
@@ -224,7 +237,9 @@ def injections() -> List[Injection]:
 
 def _match(site: str, op: Optional[str] = None,
            rank: Optional[int] = None,
-           victim: Optional[int] = None) -> Optional[Injection]:
+           victim: Optional[int] = None,
+           stage: Optional[int] = None,
+           microbatch: Optional[int] = None) -> Optional[Injection]:
     for inj in _injections:
         if inj.site != site:
             continue
@@ -237,6 +252,14 @@ def _match(site: str, op: Optional[str] = None,
         # own step, deterministic regardless of fleet interleaving
         if (victim is not None and inj.victim is not None
                 and inj.victim != victim):
+            continue
+        # stage=/microbatch= filter the pipeline site the same way: other
+        # stages' dispatches don't count toward call=
+        if (stage is not None and inj.stage is not None
+                and inj.stage != stage):
+            continue
+        if (microbatch is not None and inj.microbatch is not None
+                and inj.microbatch != microbatch):
             continue
         idx = inj.seen
         inj.seen += 1
@@ -384,6 +407,18 @@ def _replica_hook(phase: str, replica_id: int):
     return inj.kind
 
 
+def _pipeline_hook(phase: str, stage: int, microbatch: int):
+    """Called by pipeline.runtime at every action dispatch (only while a
+    spec is active — the runtime arms a watchdog comm_task around the
+    dispatch whenever this hook is installed). 'hang' sleeps ``delay=``
+    seconds inside that armed task, so the REAL watchdog expires it and
+    the escalation ladder's distress dump names the hung stage and
+    microbatch via the task's description."""
+    inj = _match("pipeline", op=phase, stage=stage, microbatch=microbatch)
+    if inj is not None and inj.kind == "hang":
+        time.sleep(inj.delay)
+
+
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
     process (the kill -9 atomicity drill); 'rank_dead' revokes the
@@ -420,6 +455,9 @@ def _install():
 
     serving_engine.set_chaos_hook(_serving_hook)
     serving_replica.set_chaos_hook(_replica_hook)
+    from ..pipeline import runtime as pp_runtime
+
+    pp_runtime.set_chaos_hook(_pipeline_hook)
     _installed[0] = True
 
 
@@ -439,6 +477,9 @@ def _uninstall():
 
     serving_engine.set_chaos_hook(None)
     serving_replica.set_chaos_hook(None)
+    from ..pipeline import runtime as pp_runtime
+
+    pp_runtime.set_chaos_hook(None)
     _installed[0] = False
 
 

@@ -388,6 +388,33 @@ class CheckpointManager:
         _emit("ckpt.load", step=step, path=path)
         return step
 
+    # -- pipeline-degree resharding ----------------------------------------
+
+    @staticmethod
+    def reshard_pp(state: dict, to_pp: int) -> dict:
+        """Re-express a stage-stacked param pytree for a different pipeline
+        degree: blocks leaves ``[pp, L/pp, ...]`` are unstacked to the flat
+        layer axis and restacked as ``[to_pp, L/to_pp, ...]`` (stage-major),
+        so a checkpoint written at one pp degree restores under another.
+        Non-block leaves (embed / lm_head / norms) are pp-invariant and pass
+        through. The total layer count must divide ``to_pp``; the round trip
+        pp -> pp' -> pp is bitwise (pure reshapes)."""
+        from .. import hybrid
+        import jax
+
+        if to_pp < 1:
+            raise ValueError(f"to_pp must be >= 1, got {to_pp}")
+        leaves = jax.tree.leaves(state.get("blocks", {}))
+        if not leaves:
+            raise ValueError("reshard_pp needs a stage-stacked state with a "
+                             "'blocks' subtree")
+        from_pp = int(leaves[0].shape[0])
+        t0 = time.perf_counter()
+        out = hybrid.stack_pipeline(hybrid.unstack_pipeline(state), to_pp)
+        _emit("ckpt.reshard_pp", dur_s=time.perf_counter() - t0,
+              from_pp=from_pp, to_pp=to_pp, n_leaves=len(leaves))
+        return out
+
     # -- preemption ---------------------------------------------------------
 
     def install_preemption_handler(self) -> bool:

@@ -53,10 +53,11 @@ class SharedLayerDesc(LayerDesc):
 
 
 class SegmentLayers:
-    """Reference: pp_layers.py:93."""
+    """Reference: pp_layers.py:93 — now a thin front end over
+    :mod:`....pipeline.partition`, which owns uniform / ``layer:<Class>`` /
+    parameter- and FLOP-balanced segmentation."""
 
-    def __init__(self, layers_desc, num_parts: int, method: str = "uniform",
-                 num_virtual_pipeline_stage=None):
+    def __init__(self, layers_desc, num_parts: int, method: str = "uniform"):
         self._layers_desc = layers_desc
         self.method = method
         self.num_parts = num_parts
@@ -65,29 +66,16 @@ class SegmentLayers:
             "layer number should be greater than number of segments")
 
     def do_segment(self) -> List[int]:
-        if self.method == "uniform":
-            return self.uniform(self.num_items, self.num_parts)
-        if self.method.startswith("layer:"):
-            # cut at instances of a named layer class
-            # (reference supports e.g. seg_method='layer:TransformerBlock')
-            name = self.method.split(":", 1)[1]
-            named_idx = [
-                i for i, d in enumerate(self._layers_desc)
-                if type(d).__name__ == name
-                or (isinstance(d, LayerDesc) and d.layer_func.__name__ == name)]
-            assert len(named_idx) >= self.num_parts
-            cuts = self.uniform(len(named_idx), self.num_parts)
-            return [0] + [named_idx[c] for c in cuts[1:-1]] + [self.num_items]
-        raise ValueError(f"unknown segment method {self.method}")
+        from ....pipeline import partition
+
+        return partition.segment(self._layers_desc, self.num_parts,
+                                 self.method)
 
     @staticmethod
     def uniform(num_items: int, num_parts: int) -> List[int]:
-        result = [0] * (num_parts + 1)
-        part_size = num_items // num_parts
-        extra = num_items % num_parts
-        for i in range(1, num_parts + 1):
-            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
-        return result
+        from ....pipeline import partition
+
+        return partition.uniform(num_items, num_parts)
 
 
 class PipelineLayer(Layer):
@@ -98,6 +86,8 @@ class PipelineLayer(Layer):
                  recompute_interval: int = 0, num_virtual_pipeline_stages=None,
                  **kwargs):
         super().__init__()
+        from .....core import flags
+        from .... import pipeline  # noqa: F401 — registers FLAGS_pp_*
         from ...base.topology import get_hcg
 
         self._loss_fn = loss_fn
@@ -111,6 +101,9 @@ class PipelineLayer(Layer):
         # interleaved VPP (reference pipeline_parallel.py:1174): segment into
         # num_stages * V chunks; chunk v of device d is GLOBAL stage
         # v * num_stages + d, so each device group interleaves V chunks
+        if num_virtual_pipeline_stages is None:
+            num_virtual_pipeline_stages = int(
+                flags.flag_value("pp_virtual_degree") or 1)
         self._num_virtual = max(1, int(num_virtual_pipeline_stages or 1))
 
         self._layers_desc = list(layers)

@@ -28,10 +28,22 @@ class PipelineParallel(MetaParallelBase):
     """Reference: pipeline_parallel.py:255."""
 
     def _prepare_for_model(self):
+        from ....core import flags
+        from ... import pipeline  # noqa: F401 — registers FLAGS_pp_*
+
         cfgs = self._strategy.pipeline_configs or {}
-        self.micro_batch_size = int(cfgs.get("micro_batch_size", 1))
-        self.accumulate_steps = int(cfgs.get("accumulate_steps", 1))
-        self.schedule = str(cfgs.get("schedule_mode", "1F1B"))
+        # precedence: explicit pipeline_configs > FLAGS_pp_* defaults (the
+        # MIGRATION.md mapping of the reference knobs)
+        self.micro_batch_size = int(
+            cfgs.get("micro_batch_size",
+                     flags.flag_value("pp_micro_batch_size") or 1) or 1)
+        acc = cfgs.get("accumulate_steps")
+        if acc is None:
+            acc = int(flags.flag_value("pp_accumulate_steps") or 1)
+        self.accumulate_steps = int(acc)
+        self.schedule = str(cfgs.get("schedule_mode",
+                                     flags.flag_value("pp_schedule")
+                                     or "1F1B"))
         self.total_loss = None
         hcg = self._hcg
         self.num_stages = (hcg.get_pipe_parallel_world_size() if hcg else 1)
@@ -69,7 +81,7 @@ class PipelineParallel(MetaParallelBase):
 
     def _get_engine(self):
         if self._engine is None:
-            from .pp_schedule import PipelineEngine
+            from ...pipeline.runtime import PipelineEngine
 
             if not isinstance(self._layers, PipelineLayer):
                 raise TypeError(
@@ -119,6 +131,15 @@ class PipelineParallel(MetaParallelBase):
         """1F1B/GPipe staged schedule over the pp device groups (reference
         :575); grad accumulation only in the pp=1 degenerate case."""
         inputs, labels = data
+        # reference micro_batch_size semantics: when accumulate_steps was
+        # not configured, the microbatch count is batch // micro_batch_size
+        if self.accumulate_steps == 1 and self.micro_batch_size > 1:
+            b = int(getattr(inputs, "shape", [0])[0])
+            if b and b % self.micro_batch_size == 0:
+                derived = b // self.micro_batch_size
+                if derived > 1:
+                    self.accumulate_steps = derived
+                    self._engine = None
         if self.num_stages <= 1:
             loss = self._accumulate_only(data, scaler)
             self.total_loss = loss

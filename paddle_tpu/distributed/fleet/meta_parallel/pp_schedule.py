@@ -1,454 +1,14 @@
-"""Generic pipeline-parallel engine: per-stage executables + 1F1B/GPipe.
+"""Compat shim: the pipeline engine moved to ``distributed.pipeline``.
 
-Reference: fleet/meta_parallel/pipeline_parallel.py:575 (1F1B
-forward_backward_pipeline) and :1174 (interleaved), built on NCCL p2p between
-per-rank stage submodels.
-
-TPU-native redesign (SURVEY.md §7 "hard parts", option (a)): JAX is
-single-controller, so instead of per-rank processes each owning a stage, the
-engine
-
-- consumes the `SegmentLayers` partition of a `PipelineLayer` and
-  functionalizes each stage's layer list into a pure jax function
-  (params/buffers in → activations/new buffers out, the StaticFunction swap
-  pattern from jit/api.py);
-- commits each stage's parameters to THAT STAGE'S devices (a per-stage
-  submesh; extra devices per stage form a data-parallel axis), so weights and
-  optimizer states are pp-partitioned exactly like the reference's per-rank
-  placement;
-- moves microbatch activations/cotangents between consecutive stages with
-  `jax.device_put` onto the next stage's sharding — the PJRT device-to-device
-  copy that plays the role of `p2p_communication.py` send/recv;
-- dispatches per-stage fwd/bwd executables in 1F1B (or GPipe F-then-B) order.
-  Dispatch is async: stage k's work for microbatch m overlaps stage k+1's
-  work for microbatch m-1 on disjoint devices, which is exactly the pipeline
-  bubble structure of the reference schedule;
-- backward recomputes the stage forward under `jax.vjp` (per-stage
-  rematerialization — the activation-memory behavior flash of the reference's
-  `recompute_interval`), accumulates param grads on the stage's devices, and
-  chains input cotangents to the previous stage.
-
-The fully-compiled single-executable path (GPipe via ppermute-in-scan) lives
-in `distributed.hybrid` and remains the perf tier for homogeneous stacks.
+The generic per-stage-executable engine, the 1F1B/GPipe/ZB-H1 schedules and
+the async P2P handoff now live in :mod:`paddle_tpu.distributed.pipeline`
+(partition / schedule / runtime). This module keeps the historical fleet
+import surface — ``PipelineEngine`` and ``_stage_op_sequence`` — stable.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from ...pipeline.runtime import (  # noqa: F401
+    PipelineEngine, _Stage, _collect_state, set_chaos_hook)
+from ...pipeline.schedule import stage_op_sequence as _stage_op_sequence  # noqa: F401
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ....core import rng
-from ....core.tensor import Tensor
-from ....nn.layer.layers import Layer
-
-
-def _collect_state(layers: Sequence[Any]) -> Tuple[List, List]:
-    params, buffers = [], []
-    for l in layers:
-        if isinstance(l, Layer):
-            params.extend(p for _, p in l.named_parameters())
-            buffers.extend(b for _, b in l.named_buffers() if b is not None)
-    return params, buffers
-
-
-class _Stage:
-    """One pipeline stage: functionalized forward + device placement."""
-
-    def __init__(self, layers: Sequence[Any], device_list: List, *,
-                 loss_fn: Optional[Callable] = None):
-        self.layers = list(layers)
-        self.params, self.buffers = _collect_state(self.layers)
-        self.loss_fn = loss_fn  # set only on the last stage
-        self.mesh = Mesh(np.asarray(device_list), ("dp",))
-        self.repl = NamedSharding(self.mesh, P())
-        self.batch_sharding = NamedSharding(self.mesh, P("dp"))
-        self.dp = len(device_list)
-        self._exec: Dict[Any, Tuple] = {}
-
-    # -- placement ---------------------------------------------------------
-    def commit(self):
-        """Move this stage's params/buffers onto its devices (replicated over
-        the stage's dp submesh)."""
-        for p in self.params + self.buffers:
-            p._data = jax.device_put(p._data, self.repl)
-
-    def put_input(self, arr):
-        if arr.ndim and self.dp > 1 and arr.shape[0] % self.dp == 0:
-            return jax.device_put(arr, self.batch_sharding)
-        return jax.device_put(arr, self.repl)
-
-    # -- functionalization -------------------------------------------------
-    def _run_layers(self, x: Tensor) -> Tensor:
-        for fn in self.layers:
-            x = fn(x)
-        return x
-
-    def _kernel(self, param_arrays, buffer_arrays, x_arr, key_data, label_arr):
-        """Pure stage function (the jit/api.py swap pattern)."""
-        from ....ops import dispatch
-
-        snap_p = [p._data for p in self.params]
-        snap_b = [b._data for b in self.buffers]
-        try:
-            for p, a in zip(self.params, param_arrays):
-                p._data = a
-            for b, a in zip(self.buffers, buffer_arrays):
-                b._data = a
-            with rng.scoped_rng_key(key_data), dispatch.no_grad():
-                out = self._run_layers(Tensor._from_data(x_arr))
-                if self.loss_fn is not None:
-                    loss = self.loss_fn(out, Tensor._from_data(label_arr))
-                    if getattr(loss, "ndim", 0):
-                        loss = loss.mean()
-                    out = loss
-            new_buffers = [b._data for b in self.buffers]
-            return out._data, new_buffers
-        finally:
-            for p, a in zip(self.params, snap_p):
-                p._data = a
-            for b, a in zip(self.buffers, snap_b):
-                b._data = a
-
-    # -- executables (cached per input signature + train mode) -------------
-    def _sig(self, x_arr, label_arr, train):
-        lbl = None if label_arr is None else (label_arr.shape,
-                                              str(label_arr.dtype))
-        return (x_arr.shape, str(x_arr.dtype), lbl, train)
-
-    def _build(self, x_arr, label_arr, train):
-        n_p = len(self.params)
-
-        def fwd_fn(pa, ba, x, key, lbl):
-            return self._kernel(pa, ba, x, key, lbl)
-
-        grad_shardings = [self.repl] * n_p
-        x_sharding = getattr(x_arr, "sharding", self.repl)
-
-        def bwd_both(pa, ba, x, gy, key, lbl):
-            def f(pa_, x_):
-                y, _ = self._kernel(pa_, ba, x_, key, lbl)
-                return y
-            _, vjp = jax.vjp(f, pa, x)
-            gp, gx = vjp(gy)
-            return list(gp), gx
-
-        def bwd_params(pa, ba, x, gy, key, lbl):
-            def f(pa_):
-                y, _ = self._kernel(pa_, ba, x, key, lbl)
-                return y
-            _, vjp = jax.vjp(f, pa)
-            (gp,) = vjp(gy)
-            return list(gp)
-
-        def bwd_input(pa, ba, x, gy, key, lbl):
-            """dx ONLY — the zero-bubble split (reference
-            pipeline_zero_bubble.py ZB-H1: B is divided into input-grad and
-            weight-grad phases so dw can fill the cooldown bubble). Note:
-            with per-stage rematerialization the split costs one extra
-            forward recompute (dx and dw each replay the stage) — the
-            bubble saving pays for it at pp >= 4."""
-            def f(x_):
-                y, _ = self._kernel(pa, ba, x_, key, lbl)
-                return y
-            _, vjp = jax.vjp(f, x)
-            (gx,) = vjp(gy)
-            return gx
-
-        fwd = jax.jit(fwd_fn)
-        bwd_b = jax.jit(bwd_both,
-                        out_shardings=(grad_shardings, x_sharding))
-        bwd_p = jax.jit(bwd_params, out_shardings=grad_shardings)
-        bwd_x = jax.jit(bwd_input, out_shardings=x_sharding)
-        return fwd, bwd_b, bwd_p, bwd_x
-
-    def executables(self, x_arr, label_arr, train):
-        key = self._sig(x_arr, label_arr, train)
-        if key not in self._exec:
-            self._exec[key] = self._build(x_arr, label_arr, train)
-        return self._exec[key]
-
-
-# ---------------------------------------------------------------------------
-# Schedules (dependency-driven dispatch)
-# ---------------------------------------------------------------------------
-
-def _stage_op_sequence(schedule: str, s: int, P_: int, M: int):
-    """Per-stage op order. 1F1B: warmup fwds then alternate (the reference's
-    forward_backward_pipeline:575 structure); gpipe: all F then all B;
-    zbh1: 1F1B with B split into BX (input grad, critical path) and BW
-    (weight grad) — BW ops are queued late so the dependency dispatcher
-    slides them into slots where the stage would otherwise wait for a
-    downstream cotangent (reference:
-    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py)."""
-    if schedule == "gpipe":
-        return [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
-    w = min(M, P_ - s - 1)
-    seq = [("F", m) for m in range(w)]
-    if schedule == "zbh1":
-        fm, xm, wm = w, 0, 0
-        while fm < M:             # steady state: F / BX pairs
-            seq.append(("F", fm)); fm += 1
-            seq.append(("BX", xm)); xm += 1
-        while xm < M:             # cooldown: BX chain + BW bubble-fill
-            seq.append(("BX", xm)); xm += 1
-            if wm < xm - 1:       # keep one BW in reserve for reordering
-                seq.append(("BW", wm)); wm += 1
-        while wm < M:
-            seq.append(("BW", wm)); wm += 1
-        return seq
-    fm, bm = w, 0
-    while fm < M or bm < M:
-        if fm < M:
-            seq.append(("F", fm))
-            fm += 1
-        if bm < M:
-            seq.append(("B", bm))
-            bm += 1
-    return seq
-
-
-class PipelineEngine:
-    """Drives a segmented PipelineLayer across per-stage device groups."""
-
-    def __init__(self, pipe_layer, accumulate_steps: int,
-                 stage_devices: Optional[List[List]] = None,
-                 schedule: str = "1F1B"):
-        from .parallel_layers.pp_layers import PipelineLayer
-
-        assert isinstance(pipe_layer, PipelineLayer)
-        self.model = pipe_layer
-        self.M = int(accumulate_steps)
-        # P = GLOBAL stages; with interleaved VPP (V chunks per device
-        # group, reference pipeline_parallel.py:1174) the engine runs the
-        # same dependency schedule over P_phys*V stages, with global stage g
-        # placed on device group g % P_phys — chunk placement IS the
-        # interleave; the dependency-driven dispatcher then overlaps each
-        # group's chunks exactly like the reference's per-rank interleave.
-        self.P = pipe_layer.get_num_stages()
-        self.P_phys = pipe_layer.get_num_physical_stages()
-        self.V = self.P // self.P_phys
-        self.schedule = schedule.lower().replace("-", "").replace("_", "")
-        if self.schedule in ("zb", "zerobubble", "zbh1"):
-            self.schedule = "zbh1"
-        if self.schedule not in ("1f1b", "gpipe", "fthenb", "interleave",
-                                 "zbh1"):
-            raise ValueError(f"unknown pipeline schedule {schedule!r}")
-        if self.schedule == "fthenb":
-            self.schedule = "gpipe"
-        if self.schedule == "interleave" and self.V == 1:
-            raise ValueError(
-                "schedule='interleave' needs num_virtual_pipeline_stages > 1 "
-                "on the PipelineLayer")
-        if self.schedule == "interleave":
-            self.schedule = "1f1b"  # same per-stage order over global stages
-        if stage_devices is None:
-            devs = jax.devices()
-            per = max(1, len(devs) // self.P_phys)
-            groups = [devs[d * per:(d + 1) * per]
-                      for d in range(self.P_phys)]
-            stage_devices = [groups[pipe_layer.device_group_of_stage(g)]
-                             for g in range(self.P)]
-        elif len(stage_devices) == self.P_phys and self.P != self.P_phys:
-            stage_devices = [stage_devices[pipe_layer.device_group_of_stage(g)]
-                             for g in range(self.P)]
-        loss_fn = getattr(pipe_layer, "_loss_fn", None)
-        if loss_fn is None:
-            raise ValueError(
-                "pipeline parallelism needs PipelineLayer(loss_fn=...): the "
-                "last stage computes the loss whose cotangent seeds the "
-                "backward schedule")
-        self.stages = [
-            _Stage(pipe_layer.get_stage_layers(s), stage_devices[s],
-                   loss_fn=loss_fn if s == self.P - 1 else None)
-            for s in range(self.P)
-        ]
-        for st in self.stages:
-            st.commit()
-
-    # ------------------------------------------------------------------
-    def _split_micro(self, arr) -> List:
-        b = arr.shape[0]
-        assert b % self.M == 0, (
-            f"batch {b} not divisible by accumulate_steps {self.M}")
-        mb = b // self.M
-        return [arr[i * mb:(i + 1) * mb] for i in range(self.M)]
-
-    def run(self, inputs, labels, train: bool = True,
-            loss_scale: float = 1.0):
-        """One global batch: schedule M microbatches over P stages; grads are
-        ACCUMULATED into each stage param's ._grad. Returns the mean loss
-        (a jax scalar on the last stage's devices)."""
-        P_, M = self.P, self.M
-        x_arr = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
-        y_arr = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
-        mb_x = self._split_micro(x_arr)
-        mb_y = self._split_micro(y_arr)
-
-        seqs = {s: list(_stage_op_sequence(self.schedule if self.schedule in
-                                           ("gpipe", "zbh1") else "1f1b",
-                                           s, P_, M))
-            for s in range(P_)}
-        done = set()
-        # per-(stage, mb) saved state for backward recompute
-        x_in: Dict[Tuple[int, int], Any] = {}
-        buf_in: Dict[Tuple[int, int], List] = {}
-        keys: Dict[Tuple[int, int], Any] = {}
-        gy_buf: Dict[Tuple[int, int], Any] = {}
-        gy_saved: Dict[Tuple[int, int], Any] = {}
-        y_dtype: Dict[Tuple[int, int], Any] = {}
-        grad_acc: List[Optional[List]] = [None] * P_
-        buf_state = [[b._data for b in st.buffers] for st in self.stages]
-        losses = []
-        self.last_dispatch_order: List[Tuple[int, str, int]] = []
-
-        def deps_met(s, kind, m):
-            if kind == "F":
-                return s == 0 or ("F", s - 1, m) in done
-            if kind == "BW":
-                # dw only needs this stage's saved activations + cotangent;
-                # BX (the critical path) must have consumed gy first
-                return ("BX", s, m) in done
-            # B / BX need this stage's forward and the downstream cotangent
-            ok = ("F", s, m) in done
-            if s < P_ - 1:
-                ok = ok and (("B", s + 1, m) in done
-                             or ("BX", s + 1, m) in done)
-            return ok
-
-        def run_fwd(s, m):
-            st = self.stages[s]
-            if s == 0:
-                x = st.put_input(mb_x[m])
-            else:
-                x = x_in[(s, m)]  # transferred by the producer
-            lbl = st.put_input(mb_y[m]) if st.loss_fn is not None else None
-            if st.loss_fn is not None:
-                mb_y[m] = lbl  # reuse the transferred copy in backward
-            key = jax.random.key_data(rng.next_key())
-            x_in[(s, m)] = x
-            buf_in[(s, m)] = buf_state[s]
-            keys[(s, m)] = key
-            fwd, _, _, _ = st.executables(x, lbl, train)
-            y, new_buf = fwd(list(p._data for p in st.params),
-                             buf_state[s], x, key, lbl)
-            buf_state[s] = new_buf
-            y_dtype[(s, m)] = y.dtype
-            if st.loss_fn is not None:
-                losses.append(y)
-            elif s + 1 < P_:
-                x_in[(s + 1, m)] = self.stages[s + 1].put_input(y)
-            return y
-
-        def _gy_of(s, m):
-            st = self.stages[s]
-            if st.loss_fn is not None:
-                return jnp.asarray(loss_scale / M, y_dtype[(s, m)])
-            return gy_buf[(s, m)]
-
-        def run_bwd(s, m):
-            """Monolithic B (1F1B/GPipe): dx + dw in one recompute."""
-            st = self.stages[s]
-            x = x_in.pop((s, m))
-            bufs = buf_in.pop((s, m))
-            key = keys.pop((s, m))
-            lbl = mb_y[m] if st.loss_fn is not None else None
-            gy = _gy_of(s, m)
-            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
-            _, bwd_b, bwd_p, _ = st.executables(x, lbl, train)
-            pa = list(p._data for p in st.params)
-            if s == 0:
-                gp = bwd_p(pa, bufs, x, gy, key, lbl)
-            else:
-                gp, gx = bwd_b(pa, bufs, x, gy, key, lbl)
-                gy_buf[(s - 1, m)] = self.stages[s - 1].put_input(gx)
-            if grad_acc[s] is None:
-                grad_acc[s] = list(gp)
-            else:
-                grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
-
-        def run_bx(s, m):
-            """ZB input-grad phase: unblocks stage s-1 as early as possible;
-            activations/gy stay saved for the BW phase."""
-            st = self.stages[s]
-            x = x_in[(s, m)]
-            bufs = buf_in[(s, m)]
-            key = keys[(s, m)]
-            lbl = mb_y[m] if st.loss_fn is not None else None
-            gy = _gy_of(s, m)
-            gy_saved[(s, m)] = gy
-            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
-            if s > 0:
-                _, _, _, bwd_x = st.executables(x, lbl, train)
-                gx = bwd_x(list(p._data for p in st.params), bufs, x, gy,
-                           key, lbl)
-                gy_buf[(s - 1, m)] = self.stages[s - 1].put_input(gx)
-
-        def run_bw(s, m):
-            """ZB weight-grad phase: fills former-bubble slots."""
-            st = self.stages[s]
-            x = x_in.pop((s, m))
-            bufs = buf_in.pop((s, m))
-            key = keys.pop((s, m))
-            lbl = mb_y[m] if st.loss_fn is not None else None
-            gy = gy_saved.pop((s, m))
-            _, _, bwd_p, _ = st.executables(x, lbl, train)
-            gp = bwd_p(list(p._data for p in st.params), bufs, x, gy, key,
-                       lbl)
-            if grad_acc[s] is None:
-                grad_acc[s] = list(gp)
-            else:
-                grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
-
-        RUN = {"F": run_fwd, "B": run_bwd, "BX": run_bx, "BW": run_bw}
-
-        def dispatch(s, i):
-            kind, m = seqs[s].pop(i)
-            if kind == "F" or train:
-                RUN[kind](s, m)
-            done.add((kind, s, m))
-            self.last_dispatch_order.append((s, kind, m))
-
-        # dependency-driven round-robin dispatch (deadlock-free for every
-        # order: each stage's head op becomes runnable once its producer
-        # ran). ZB twist: when a stage's head op is blocked (waiting on a
-        # downstream cotangent), a queued BW whose deps are met runs
-        # instead — dw genuinely fills the bubble slot.
-        remaining = sum(len(v) for v in seqs.values())
-        while remaining:
-            progressed = False
-            for s in range(P_ - 1, -1, -1):
-                if not seqs[s]:
-                    continue
-                kind, m = seqs[s][0]
-                if deps_met(s, kind, m):
-                    dispatch(s, 0)
-                    remaining -= 1
-                    progressed = True
-                    continue
-                # head blocked: opportunistic BW fill (zbh1 only)
-                for i, (k2, m2) in enumerate(seqs[s]):
-                    if k2 == "BW" and deps_met(s, k2, m2):
-                        dispatch(s, i)
-                        remaining -= 1
-                        progressed = True
-                        break
-            if not progressed:
-                raise RuntimeError("pipeline schedule deadlocked (bug)")
-
-        # write back buffers + accumulate grads into the framework tensors
-        for s, st in enumerate(self.stages):
-            for b, a in zip(st.buffers, buf_state[s]):
-                b._data = a
-            if train and grad_acc[s] is not None:
-                for p, g in zip(st.params, grad_acc[s]):
-                    if p.stop_gradient or not getattr(p, "trainable", True):
-                        continue
-                    g = g.astype(p._data.dtype) if g.dtype != p._data.dtype else g
-                    p._grad = g if p._grad is None else p._grad + g
-        total = losses[0]
-        for l in losses[1:]:
-            total = total + l
-        return Tensor._from_data(total / M, stop_gradient=True)
+__all__ = ["PipelineEngine", "_stage_op_sequence"]

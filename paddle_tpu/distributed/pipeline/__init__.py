@@ -1,0 +1,23 @@
+"""TPU-native MPMD pipeline parallelism.
+
+Three layers (arXiv 2412.14374's partition / schedule / runtime split):
+
+- :mod:`.partition` — split a layer sequence (or ``LayerDesc`` descriptors)
+  into ``pp`` contiguous stages: uniform, ``layer:<Class>`` or
+  parameter/FLOP-balanced, with ``seg_method`` as the manual override;
+- :mod:`.schedule` — 1F1B / GPipe / ZB-H1 / interleaved schedules as
+  explicit (stage, microbatch, phase) action lists, deterministically
+  validated and unit-time simulated (closed-form bubble accounting);
+- :mod:`.runtime` — the engine: per-stage jitted executables
+  (signature-keyed, zero steady-state retraces), async P2P stage handoff
+  through ``core.async_engine``, dependency-driven dispatch, dp x pp x
+  sharding composition, and pipeline.* observability.
+
+``fleet.meta_parallel.pp_schedule`` / ``PipelineParallel`` are the
+Paddle-API front ends over this package.
+"""
+from . import partition, schedule  # noqa: F401
+from .runtime import PipelineEngine, set_chaos_hook  # noqa: F401
+from .schedule import (  # noqa: F401
+    Action, ScheduleError, build_schedule, closed_form_bubble, simulate,
+    stage_op_sequence, validate)

@@ -1,0 +1,144 @@
+"""Stage partitioner: split a layer sequence into ``pp`` contiguous stages.
+
+The reference's ``SegmentLayers`` (fleet/meta_parallel/parallel_layers/
+pp_layers.py) supports ``uniform`` and ``layer:ClassName`` segmentation.
+This module is the single implementation of both, plus cost-balanced
+segmentation (``param`` / ``flops``) that the reference reserves for its
+auto-parallel pass: estimate a per-item cost, then pick cut points that
+minimize the maximum stage cost (classic contiguous-partition DP).
+``PipelineLayer``/``SegmentLayers`` route here; an explicit ``seg_method``
+is the manual override of the balance heuristic.
+
+Costs come from :func:`estimate_cost`. Built ``Layer`` instances report
+their true parameter count; ``LayerDesc`` items are built once under a
+saved/restored RNG state (so probing never perturbs training streams) and
+discarded. FLOP cost is modeled as 2*params — exact for the dense layers
+the pipeline stages here are made of, and monotone-equivalent for ranking
+in general.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+from ...core import rng
+
+
+def uniform(num_items: int, num_parts: int) -> List[int]:
+    """Even split: cut points of ``num_items`` items into ``num_parts``
+    contiguous runs (len == num_parts + 1, starts at 0, ends at num_items)."""
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    if num_items < num_parts:
+        raise ValueError(
+            f"cannot split {num_items} layers into {num_parts} stages")
+    result = [0]
+    base, extra = divmod(num_items, num_parts)
+    for i in range(num_parts):
+        result.append(result[-1] + base + (1 if i < extra else 0))
+    return result
+
+
+def segment_by_class(descs: Sequence, num_parts: int,
+                     class_name: str) -> List[int]:
+    """Cut so that each stage holds an equal share of layers whose class is
+    ``class_name`` (the reference's ``seg_method='layer:Linear'``)."""
+    idx = [i for i, d in enumerate(descs)
+           if _class_name_of(d) == class_name]
+    if len(idx) < num_parts:
+        raise ValueError(
+            f"only {len(idx)} {class_name!r} layers for {num_parts} stages")
+    marks = uniform(len(idx), num_parts)
+    cuts = [0]
+    for p in range(1, num_parts):
+        cuts.append(idx[marks[p]])
+    cuts.append(len(descs))
+    return cuts
+
+
+def _class_name_of(d) -> str:
+    from ..fleet.meta_parallel.parallel_layers.pp_layers import LayerDesc
+    if isinstance(d, LayerDesc):
+        return d.layer_func.__name__
+    return type(d).__name__
+
+
+def estimate_cost(d) -> float:
+    """Per-item cost for balanced segmentation: parameter count (FLOPs are
+    modeled as 2*params, so both rank identically). LayerDesc items are
+    built once with the RNG stream saved and restored; parameter-free items
+    (activations, callables) get a small epsilon so empty stages lose
+    ties deterministically."""
+    from ...nn import Layer
+    from ..fleet.meta_parallel.parallel_layers.pp_layers import LayerDesc
+    if isinstance(d, LayerDesc):
+        state = rng.get_rng_state()
+        try:
+            built = d.build_layer()
+        finally:
+            rng.set_rng_state(state)
+        return estimate_cost(built)
+    if isinstance(d, Layer):
+        n = 0
+        for p in d.parameters():
+            n += int(math.prod(p.shape)) if p.shape else 1
+        return float(n) if n else 1e-3
+    return 1e-3  # bare callable / activation
+
+
+def balanced_partition(costs: Sequence[float], num_parts: int) -> List[int]:
+    """Cut points minimizing the maximum stage cost over contiguous runs
+    (O(n^2 * k) DP — layer counts are small). Every stage gets >= 1 item."""
+    n = len(costs)
+    if n < num_parts:
+        raise ValueError(
+            f"cannot split {n} layers into {num_parts} stages")
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def run_cost(i: int, j: int) -> float:
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # best[k][j] = minimal max-stage-cost splitting items [0, j) into k runs
+    best = [[INF] * (n + 1) for _ in range(num_parts + 1)]
+    cut = [[0] * (n + 1) for _ in range(num_parts + 1)]
+    best[0][0] = 0.0
+    for k in range(1, num_parts + 1):
+        for j in range(k, n + 1):
+            for i in range(k - 1, j):
+                cand = max(best[k - 1][i], run_cost(i, j))
+                if cand < best[k][j]:
+                    best[k][j] = cand
+                    cut[k][j] = i
+    cuts = [n]
+    k, j = num_parts, n
+    while k > 0:
+        j = cut[k][j]
+        cuts.append(j)
+        k -= 1
+    cuts.reverse()
+    return cuts
+
+
+def segment(descs: Sequence, num_parts: int,
+            method: str = "uniform") -> List[int]:
+    """Split ``descs`` into ``num_parts`` contiguous stages.
+
+    method: 'uniform' | 'layer:<ClassName>' | 'param' | 'flops'.
+    Returns cut points (len == num_parts + 1). 'param'/'flops' balance the
+    estimated per-stage cost; an explicit 'uniform'/'layer:' seg_method is
+    the manual override."""
+    if method == "uniform":
+        return uniform(len(descs), num_parts)
+    if method.startswith("layer:"):
+        return segment_by_class(descs, num_parts, method.split(":", 1)[1])
+    if method in ("param", "flops"):
+        costs = [estimate_cost(d) for d in descs]
+        if method == "flops":
+            costs = [2.0 * c for c in costs]
+        return balanced_partition(costs, num_parts)
+    raise ValueError(
+        f"unknown seg_method {method!r} (expected 'uniform', "
+        f"'layer:<ClassName>', 'param' or 'flops')")

@@ -1,0 +1,567 @@
+"""MPMD pipeline runtime: stage executables + validated schedules + async P2P.
+
+This is the canonical engine behind ``fleet.meta_parallel`` pipeline
+parallelism (``pp_schedule`` is a compat shim over this module). Reference:
+fleet/meta_parallel/pipeline_parallel.py 1F1B/interleaved loops built on
+NCCL p2p between per-rank stage submodels.
+
+TPU-native redesign (SURVEY.md §7 "hard parts", option (a)): JAX is
+single-controller, so instead of per-rank processes each owning a stage,
+the engine
+
+- consumes the :mod:`.partition` split of a `PipelineLayer` and
+  functionalizes each stage's layer list into a pure jax function
+  (params/buffers in → activations/new buffers out, the StaticFunction swap
+  pattern from jit/api.py);
+- commits each stage's parameters to THAT STAGE'S devices (a per-stage
+  submesh; extra devices per stage form a data-parallel axis), so weights
+  and optimizer states are pp-partitioned exactly like the reference's
+  per-rank placement — and per-stage batch sharding makes XLA insert the
+  within-stage dp grad reduction (grads jit out replicated), so dp x pp is
+  exact with zero extra wiring;
+- runs the :mod:`.schedule` action lists — built and VALIDATED before any
+  execution — with a dependency-driven dispatcher;
+- moves microbatch activations/cotangents between consecutive stages with
+  :func:`core.async_engine.p2p_transfer` (`jax.device_put` onto the next
+  stage's sharding — the PJRT device-to-device copy playing the role of
+  `p2p_communication.py` send/recv). Dispatch is async: stage k's forward
+  of microbatch i+1 overlaps the transfer of microbatch i on disjoint
+  devices;
+- backward recomputes the stage forward under `jax.vjp` (per-stage
+  rematerialization), accumulates param grads on the stage's devices, and
+  chains input cotangents to the previous stage;
+- emits ``pipeline.send`` / ``pipeline.recv`` / ``pipeline.stall`` /
+  ``pipeline.build`` per action and ``pipeline.gauges`` (bubble fraction +
+  stage skew) per batch; a chaos hook (installed by fault_tolerance.chaos
+  only while a ``pipeline:`` spec is active) arms a watchdog comm task
+  around each dispatch so a hung stage escalates the ladder with its
+  stage/microbatch named in the distress dump.
+
+The fully-compiled single-executable path (GPipe via ppermute-in-scan)
+lives in `distributed.hybrid` and remains the perf tier for homogeneous
+stacks.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import async_engine, flags, rng
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...observability import emit as _emit
+from ..comm_watchdog import comm_task
+from . import schedule as pschedule
+
+flags.define_flag(
+    "pp_schedule", "1F1B",
+    "Default pipeline schedule when pipeline_configs omits schedule_mode: "
+    "1F1B, GPipe (alias FThenB), ZBH1 (zero-bubble H1) or interleave "
+    "(needs virtual stages).")
+flags.define_flag(
+    "pp_accumulate_steps", 1,
+    "Default microbatch count per pipeline batch (gradient accumulation "
+    "steps) when pipeline_configs omits accumulate_steps.")
+flags.define_flag(
+    "pp_micro_batch_size", 0,
+    "If > 0 and accumulate_steps is unset, derive the microbatch count as "
+    "batch_size // pp_micro_batch_size (the reference micro_batch_size "
+    "knob).")
+flags.define_flag(
+    "pp_virtual_degree", 1,
+    "Default virtual pipeline chunks per physical stage (the reference "
+    "virtual_pp_degree) when PipelineLayer is built without "
+    "num_virtual_pipeline_stages.")
+flags.define_flag(
+    "pp_p2p_cache", True,
+    "Reuse signature-keyed per-stage jitted executables across batches "
+    "(supersedes the reference p2p_cache_shape buffer reuse). Off drops "
+    "every stage cache at each run — a retrace-forcing debugging aid.")
+
+# chaos choke point: installed by distributed/fault_tolerance/chaos.py only
+# while a `pipeline:` FLAGS_chaos_spec is active — (phase, stage,
+# microbatch) -> None, may stall a dispatch (the watchdog task around it is
+# armed only when a hook is present, so the steady state pays nothing)
+_chaos_hook = [None]
+
+
+def set_chaos_hook(fn):
+    _chaos_hook[0] = fn
+
+
+def _collect_state(layers: Sequence[Any]) -> Tuple[List, List]:
+    params, buffers = [], []
+    for l in layers:
+        if isinstance(l, Layer):
+            params.extend(p for _, p in l.named_parameters())
+            buffers.extend(b for _, b in l.named_buffers() if b is not None)
+    return params, buffers
+
+
+class _Stage:
+    """One pipeline stage: functionalized forward + device placement."""
+
+    def __init__(self, layers: Sequence[Any], device_list: List, *,
+                 loss_fn: Optional[Callable] = None, index: int = 0):
+        self.layers = list(layers)
+        self.params, self.buffers = _collect_state(self.layers)
+        self.loss_fn = loss_fn  # set only on the last stage
+        self.index = index
+        self.mesh = Mesh(np.asarray(device_list), ("dp",))
+        self.repl = NamedSharding(self.mesh, P())
+        self.batch_sharding = NamedSharding(self.mesh, P("dp"))
+        self.dp = len(device_list)
+        self._exec: Dict[Any, Tuple] = {}
+
+    # -- placement ---------------------------------------------------------
+    def commit(self):
+        """Move this stage's params/buffers onto its devices (replicated over
+        the stage's dp submesh). A no-op re-put when already placed, so the
+        engine may call it each run to undo optimizer-side moves (ZeRO-1
+        sharded update gathers params back on the update group's mesh)."""
+        for p in self.params + self.buffers:
+            p._data = jax.device_put(p._data, self.repl)
+
+    def put_input(self, arr):
+        if arr.ndim and self.dp > 1 and arr.shape[0] % self.dp == 0:
+            return jax.device_put(arr, self.batch_sharding)
+        return jax.device_put(arr, self.repl)
+
+    # -- functionalization -------------------------------------------------
+    def _run_layers(self, x: Tensor) -> Tensor:
+        for fn in self.layers:
+            x = fn(x)
+        return x
+
+    def _kernel(self, param_arrays, buffer_arrays, x_arr, key_data, label_arr):
+        """Pure stage function (the jit/api.py swap pattern)."""
+        from ...ops import dispatch
+
+        snap_p = [p._data for p in self.params]
+        snap_b = [b._data for b in self.buffers]
+        try:
+            for p, a in zip(self.params, param_arrays):
+                p._data = a
+            for b, a in zip(self.buffers, buffer_arrays):
+                b._data = a
+            with rng.scoped_rng_key(key_data), dispatch.no_grad():
+                out = self._run_layers(Tensor._from_data(x_arr))
+                if self.loss_fn is not None:
+                    loss = self.loss_fn(out, Tensor._from_data(label_arr))
+                    if getattr(loss, "ndim", 0):
+                        loss = loss.mean()
+                    out = loss
+            new_buffers = [b._data for b in self.buffers]
+            return out._data, new_buffers
+        finally:
+            for p, a in zip(self.params, snap_p):
+                p._data = a
+            for b, a in zip(self.buffers, snap_b):
+                b._data = a
+
+    # -- executables (cached per input signature + train mode) -------------
+    def _sig(self, x_arr, label_arr, train):
+        lbl = None if label_arr is None else (label_arr.shape,
+                                              str(label_arr.dtype))
+        return (x_arr.shape, str(x_arr.dtype), lbl, train)
+
+    def _build(self, x_arr, label_arr, train):
+        n_p = len(self.params)
+
+        def fwd_fn(pa, ba, x, key, lbl):
+            return self._kernel(pa, ba, x, key, lbl)
+
+        grad_shardings = [self.repl] * n_p
+        x_sharding = getattr(x_arr, "sharding", self.repl)
+
+        def bwd_both(pa, ba, x, gy, key, lbl):
+            def f(pa_, x_):
+                y, _ = self._kernel(pa_, ba, x_, key, lbl)
+                return y
+            _, vjp = jax.vjp(f, pa, x)
+            gp, gx = vjp(gy)
+            return list(gp), gx
+
+        def bwd_params(pa, ba, x, gy, key, lbl):
+            def f(pa_):
+                y, _ = self._kernel(pa_, ba, x, key, lbl)
+                return y
+            _, vjp = jax.vjp(f, pa)
+            (gp,) = vjp(gy)
+            return list(gp)
+
+        def bwd_input(pa, ba, x, gy, key, lbl):
+            """dx ONLY — the zero-bubble split (reference
+            pipeline_zero_bubble.py ZB-H1: B is divided into input-grad and
+            weight-grad phases so dw can fill the cooldown bubble). Note:
+            with per-stage rematerialization the split costs one extra
+            forward recompute (dx and dw each replay the stage) — the
+            bubble saving pays for it at pp >= 4."""
+            def f(x_):
+                y, _ = self._kernel(pa, ba, x_, key, lbl)
+                return y
+            _, vjp = jax.vjp(f, x)
+            (gx,) = vjp(gy)
+            return gx
+
+        fwd = jax.jit(fwd_fn)
+        bwd_b = jax.jit(bwd_both,
+                        out_shardings=(grad_shardings, x_sharding))
+        bwd_p = jax.jit(bwd_params, out_shardings=grad_shardings)
+        bwd_x = jax.jit(bwd_input, out_shardings=x_sharding)
+        return fwd, bwd_b, bwd_p, bwd_x
+
+    def executables(self, x_arr, label_arr, train):
+        key = self._sig(x_arr, label_arr, train)
+        if key not in self._exec:
+            t0 = time.perf_counter()
+            self._exec[key] = self._build(x_arr, label_arr, train)
+            _emit("pipeline.build", dur_s=time.perf_counter() - t0,
+                  stage=self.index, signatures=len(self._exec))
+        return self._exec[key]
+
+
+class PipelineEngine:
+    """Drives a segmented PipelineLayer across per-stage device groups."""
+
+    def __init__(self, pipe_layer, accumulate_steps: int,
+                 stage_devices: Optional[List[List]] = None,
+                 schedule: str = "1F1B"):
+        from ..fleet.meta_parallel.parallel_layers.pp_layers import (
+            PipelineLayer)
+
+        assert isinstance(pipe_layer, PipelineLayer)
+        self.model = pipe_layer
+        self.M = int(accumulate_steps)
+        # P = GLOBAL stages; with interleaved VPP (V chunks per device
+        # group, reference pipeline_parallel.py interleaved loop) the engine
+        # runs the same dependency schedule over P_phys*V stages, with
+        # global stage g placed on device group g % P_phys — chunk placement
+        # IS the interleave; the dependency-driven dispatcher then overlaps
+        # each group's chunks exactly like the reference's per-rank
+        # interleave.
+        self.P = pipe_layer.get_num_stages()
+        self.P_phys = pipe_layer.get_num_physical_stages()
+        self.V = self.P // self.P_phys
+        self.schedule = pschedule.normalize(schedule)
+        self.schedule_name = self.schedule
+        if self.schedule == "interleave" and self.V == 1:
+            raise ValueError(
+                "schedule='interleave' needs num_virtual_pipeline_stages > 1 "
+                "on the PipelineLayer")
+        if self.schedule == "interleave":
+            self.schedule = "1f1b"  # same per-stage order over global stages
+        # the full schedule as explicit action lists, validated
+        # deterministically BEFORE anything executes
+        self.actions = pschedule.build_schedule(self.schedule, self.P, self.M)
+        self.schedule_stats = pschedule.simulate(self.actions, self.P,
+                                                 groups=self.P_phys)
+        if stage_devices is None:
+            devs = jax.devices()
+            per = max(1, len(devs) // self.P_phys)
+            groups = [devs[d * per:(d + 1) * per]
+                      for d in range(self.P_phys)]
+            stage_devices = [groups[pipe_layer.device_group_of_stage(g)]
+                             for g in range(self.P)]
+        elif len(stage_devices) == self.P_phys and self.P != self.P_phys:
+            stage_devices = [stage_devices[pipe_layer.device_group_of_stage(g)]
+                             for g in range(self.P)]
+        loss_fn = getattr(pipe_layer, "_loss_fn", None)
+        if loss_fn is None:
+            raise ValueError(
+                "pipeline parallelism needs PipelineLayer(loss_fn=...): the "
+                "last stage computes the loss whose cotangent seeds the "
+                "backward schedule")
+        self.stages = [
+            _Stage(pipe_layer.get_stage_layers(s), stage_devices[s],
+                   loss_fn=loss_fn if s == self.P - 1 else None, index=s)
+            for s in range(self.P)
+        ]
+        for st in self.stages:
+            st.commit()
+
+    # ------------------------------------------------------------------
+    def _split_micro(self, arr) -> List:
+        b = arr.shape[0]
+        assert b % self.M == 0, (
+            f"batch {b} not divisible by accumulate_steps {self.M}")
+        mb = b // self.M
+        return [arr[i * mb:(i + 1) * mb] for i in range(self.M)]
+
+    def _send(self, arr, dest_stage: int, kind: str, m: int):
+        """Async P2P handoff to ``dest_stage``'s sharding through the eager
+        pipeline: device_put enqueues under PJRT and returns; the consumer's
+        dispatch chains on the in-flight buffer, so stage k's compute of
+        microbatch i+1 overlaps this transfer of microbatch i."""
+        dst = self.stages[dest_stage]
+        t0 = time.perf_counter()
+        out = async_engine.p2p_transfer(
+            arr, dst.put_input, tag=f"pp:{kind}:{dest_stage}")
+        _emit("pipeline.send", dur_s=time.perf_counter() - t0, payload=kind,
+              stage=dest_stage, microbatch=m,
+              nbytes=int(getattr(arr, "nbytes", 0) or 0))
+        return out
+
+    @staticmethod
+    def _recv(arr, stage: int, kind: str, m: int):
+        """Consume a transferred buffer; records whether the copy had
+        already landed (overlap hit) or is still in flight."""
+        _emit("pipeline.recv", payload=kind, stage=stage, microbatch=m,
+              ready=async_engine._is_ready(arr))
+        return arr
+
+    def run(self, inputs, labels, train: bool = True,
+            loss_scale: float = 1.0, dp=None):
+        """One global batch: schedule M microbatches over P stages; grads are
+        ACCUMULATED into each stage param's ._grad. Returns the mean loss
+        (a jax scalar on the last stage's devices).
+
+        ``dp``: an optional DataParallel wrapper whose bucket reducer is
+        fired EXACTLY ONCE, after the backward of the last microbatch — the
+        k-step accumulation contract (`no_sync` inside the wrapper is
+        honored; the microbatch loop itself never triggers a collective).
+        """
+        P_, M = self.P, self.M
+        if not flags.flag_value("pp_p2p_cache"):
+            for st in self.stages:
+                st._exec.clear()
+        run_t0 = time.perf_counter()
+        x_arr = inputs._data if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        y_arr = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        mb_x = self._split_micro(x_arr)
+        mb_y = self._split_micro(y_arr)
+
+        seqs = {s: [(a.phase, a.microbatch) for a in self.actions[s]]
+                for s in range(P_)}
+        done = set()
+        # per-(stage, mb) saved state for backward recompute
+        x_in: Dict[Tuple[int, int], Any] = {}
+        buf_in: Dict[Tuple[int, int], List] = {}
+        keys: Dict[Tuple[int, int], Any] = {}
+        gy_buf: Dict[Tuple[int, int], Any] = {}
+        gy_saved: Dict[Tuple[int, int], Any] = {}
+        y_dtype: Dict[Tuple[int, int], Any] = {}
+        grad_acc: List[Optional[List]] = [None] * P_
+        buf_state = [[b._data for b in st.buffers] for st in self.stages]
+        losses = []
+        stage_host = [0.0] * P_
+        stalled = set()
+        self.last_dispatch_order: List[Tuple[int, str, int]] = []
+
+        def deps_met(s, kind, m):
+            if kind == "F":
+                return s == 0 or ("F", s - 1, m) in done
+            if kind == "BW":
+                # dw only needs this stage's saved activations + cotangent;
+                # BX (the critical path) must have consumed gy first
+                return ("BX", s, m) in done
+            # B / BX need this stage's forward and the downstream cotangent
+            ok = ("F", s, m) in done
+            if s < P_ - 1:
+                ok = ok and (("B", s + 1, m) in done
+                             or ("BX", s + 1, m) in done)
+            return ok
+
+        def run_fwd(s, m):
+            st = self.stages[s]
+            if s == 0:
+                x = st.put_input(mb_x[m])
+            else:
+                x = self._recv(x_in[(s, m)], s, "act", m)
+            lbl = st.put_input(mb_y[m]) if st.loss_fn is not None else None
+            if st.loss_fn is not None:
+                mb_y[m] = lbl  # reuse the transferred copy in backward
+            key = jax.random.key_data(rng.next_key())
+            x_in[(s, m)] = x
+            buf_in[(s, m)] = buf_state[s]
+            keys[(s, m)] = key
+            fwd, _, _, _ = st.executables(x, lbl, train)
+            y, new_buf = fwd(list(p._data for p in st.params),
+                             buf_state[s], x, key, lbl)
+            buf_state[s] = new_buf
+            y_dtype[(s, m)] = y.dtype
+            if st.loss_fn is not None:
+                losses.append(y)
+            elif s + 1 < P_:
+                x_in[(s + 1, m)] = self._send(y, s + 1, "act", m)
+            return y
+
+        def _gy_of(s, m):
+            st = self.stages[s]
+            if st.loss_fn is not None:
+                return jnp.asarray(loss_scale / M, y_dtype[(s, m)])
+            return self._recv(gy_buf[(s, m)], s, "grad", m)
+
+        def run_bwd(s, m):
+            """Monolithic B (1F1B/GPipe): dx + dw in one recompute."""
+            st = self.stages[s]
+            x = x_in.pop((s, m))
+            bufs = buf_in.pop((s, m))
+            key = keys.pop((s, m))
+            lbl = mb_y[m] if st.loss_fn is not None else None
+            gy = _gy_of(s, m)
+            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
+            _, bwd_b, bwd_p, _ = st.executables(x, lbl, train)
+            pa = list(p._data for p in st.params)
+            if s == 0:
+                gp = bwd_p(pa, bufs, x, gy, key, lbl)
+            else:
+                gp, gx = bwd_b(pa, bufs, x, gy, key, lbl)
+                gy_buf[(s - 1, m)] = self._send(gx, s - 1, "grad", m)
+            if grad_acc[s] is None:
+                grad_acc[s] = list(gp)
+            else:
+                grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
+
+        def run_bx(s, m):
+            """ZB input-grad phase: unblocks stage s-1 as early as possible;
+            activations/gy stay saved for the BW phase."""
+            st = self.stages[s]
+            x = x_in[(s, m)]
+            bufs = buf_in[(s, m)]
+            key = keys[(s, m)]
+            lbl = mb_y[m] if st.loss_fn is not None else None
+            gy = _gy_of(s, m)
+            gy_saved[(s, m)] = gy
+            y_dtype.pop((s, m), None); gy_buf.pop((s, m), None)
+            if s > 0:
+                _, _, _, bwd_x = st.executables(x, lbl, train)
+                gx = bwd_x(list(p._data for p in st.params), bufs, x, gy,
+                           key, lbl)
+                gy_buf[(s - 1, m)] = self._send(gx, s - 1, "grad", m)
+
+        def run_bw(s, m):
+            """ZB weight-grad phase: fills former-bubble slots."""
+            st = self.stages[s]
+            x = x_in.pop((s, m))
+            bufs = buf_in.pop((s, m))
+            key = keys.pop((s, m))
+            lbl = mb_y[m] if st.loss_fn is not None else None
+            gy = gy_saved.pop((s, m))
+            _, _, bwd_p, _ = st.executables(x, lbl, train)
+            gp = bwd_p(list(p._data for p in st.params), bufs, x, gy, key,
+                       lbl)
+            if grad_acc[s] is None:
+                grad_acc[s] = list(gp)
+            else:
+                grad_acc[s] = [a + g for a, g in zip(grad_acc[s], gp)]
+
+        RUN = {"F": run_fwd, "B": run_bwd, "BX": run_bx, "BW": run_bw}
+
+        def dispatch(s, i):
+            kind, m = seqs[s].pop(i)
+            hook = _chaos_hook[0]
+            t0 = time.perf_counter()
+            if hook is not None:
+                # arm the comm watchdog around the (possibly stalled)
+                # dispatch: a hang injected here expires the task and the
+                # escalation ladder's distress dump carries the stage and
+                # microbatch in the task description (extra=)
+                with comm_task(f"pp:{kind}", rank=s, shape=(),
+                               dtype="", extra=f"stage={s} microbatch={m}"):
+                    hook(kind, s, m)
+                    if kind == "F" or train:
+                        RUN[kind](s, m)
+            elif kind == "F" or train:
+                RUN[kind](s, m)
+            stage_host[s] += time.perf_counter() - t0
+            done.add((kind, s, m))
+            self.last_dispatch_order.append((s, kind, m))
+
+        # dependency-driven round-robin dispatch (deadlock-free for every
+        # order: each stage's head op becomes runnable once its producer
+        # ran — the action lists were validated for exactly this discipline
+        # in __init__). ZB twist: when a stage's head op is blocked (waiting
+        # on a downstream cotangent), a queued BW whose deps are met runs
+        # instead — dw genuinely fills the bubble slot.
+        remaining = sum(len(v) for v in seqs.values())
+        while remaining:
+            progressed = False
+            for s in range(P_ - 1, -1, -1):
+                if not seqs[s]:
+                    continue
+                kind, m = seqs[s][0]
+                if deps_met(s, kind, m):
+                    dispatch(s, 0)
+                    remaining -= 1
+                    progressed = True
+                    continue
+                if (s, kind, m) not in stalled:
+                    stalled.add((s, kind, m))
+                    _emit("pipeline.stall", stage=s, microbatch=m,
+                          phase=kind)
+                # head blocked: opportunistic BW fill (zbh1 only)
+                for i, (k2, m2) in enumerate(seqs[s]):
+                    if k2 == "BW" and deps_met(s, k2, m2):
+                        dispatch(s, i)
+                        remaining -= 1
+                        progressed = True
+                        break
+            if not progressed:
+                raise RuntimeError("pipeline schedule deadlocked (bug)")
+
+        # write back buffers + accumulate grads into the framework tensors
+        for s, st in enumerate(self.stages):
+            for b, a in zip(st.buffers, buf_state[s]):
+                b._data = a
+            if train and grad_acc[s] is not None:
+                for p, g in zip(st.params, grad_acc[s]):
+                    if p.stop_gradient or not getattr(p, "trainable", True):
+                        continue
+                    g = g.astype(p._data.dtype) if g.dtype != p._data.dtype else g
+                    p._grad = g if p._grad is None else p._grad + g
+        if dp is not None and train:
+            self._dp_sync(dp)
+        mean_host = sum(stage_host) / max(1, len(stage_host))
+        skew = ((max(stage_host) - mean_host) / mean_host
+                if mean_host > 0 else 0.0)
+        _emit("pipeline.gauges",
+              bubble_fraction=self.schedule_stats["bubble_fraction"],
+              stage_skew=skew, makespan=self.schedule_stats["makespan"])
+        _emit("pipeline.run", dur_s=time.perf_counter() - run_t0,
+              schedule=self.schedule_name, stages=P_, microbatches=M)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return Tensor._from_data(total / M, stop_gradient=True)
+
+    # ------------------------------------------------------------------
+    def _dp_sync(self, dp):
+        """Fire the PR-4 bucket reducer exactly once, after the last
+        microbatch's grads landed — the k-step accumulation contract.
+
+        Stage grads live on per-stage submeshes; a bucket's jitted flat
+        pack would reject mixed-mesh operands, so grads hop to the dp
+        group's (or default) devices for the collective and return to their
+        stage sharding afterwards — two PJRT copies per param, amortized
+        over the whole accumulated batch."""
+        if not getattr(dp, "_sync_enabled", True):
+            return
+        g = getattr(dp, "_group", None)
+        mesh = getattr(g, "_mesh", None) if g is not None else None
+        if mesh is not None:
+            common = NamedSharding(mesh, P())
+        else:
+            common = jax.devices()[0]
+        moved: List[Tuple[Any, Any]] = []
+        for st in self.stages:
+            for p in st.params:
+                if p._grad is not None:
+                    moved.append((p, st.repl))
+                    p._grad = jax.device_put(p._grad, common)
+        dp.sync_gradients()
+        for p, sh in moved:
+            if p._grad is not None:
+                p._grad = jax.device_put(p._grad, sh)
+
+    def recommit(self):
+        """Re-place every stage's params/buffers on its devices (no-op when
+        already there). Call after an optimizer step that moved params —
+        e.g. ZeRO-1 `sharded_update`, which updates on the dp group mesh."""
+        for st in self.stages:
+            st.commit()

@@ -1,0 +1,299 @@
+"""Pipeline schedules as explicit, validated (stage, microbatch, phase) lists.
+
+The reference builds its 1F1B order imperatively inside
+``forward_backward_pipeline`` and its zero-bubble variant as a scheduler
+pass. The MPMD-pipelining literature (arXiv 2412.14374) instead treats a
+schedule as *data*: a per-stage list of (stage, microbatch, phase) actions
+that can be validated, simulated and compared before anything executes.
+That is what this module provides:
+
+- :func:`stage_op_sequence` — the canonical per-stage op order for
+  ``1f1b`` / ``gpipe`` / ``zbh1`` (single source of truth; the fleet shim
+  ``pp_schedule._stage_op_sequence`` delegates here);
+- :func:`build_schedule` — all stages' actions, **validated
+  deterministically before any execution** (:func:`validate`): every
+  microbatch gets exactly one forward and one complete backward per stage,
+  BX precedes its BW, the 1F1B activation-memory bound holds, and a
+  dependency-driven dry run proves the lists are deadlock-free;
+- :func:`simulate` — unit-time dependency-timed execution of the lists
+  (device-group contention included, so interleaved virtual chunks compete
+  for their physical group), yielding makespan / per-group busy time /
+  bubble fraction. For synchronous 1F1B with equal-cost F and B this
+  reproduces the closed form exactly:
+
+      bubble(pp, m) = (pp - 1) / (m + pp - 1)
+
+  and for interleaving (v virtual chunks per group over pp groups) the
+  generalized ``(pp - 1) / (v*m + pp - 1)`` — the v-fold bubble shrink
+  that motivates virtual stages.
+
+Phases: ``F`` forward, ``B`` monolithic backward, ``BX`` input-grad half,
+``BW`` weight-grad half (ZB-H1 split).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+
+class Action(NamedTuple):
+    stage: int        # GLOBAL stage (physical stage or virtual chunk)
+    microbatch: int
+    phase: str        # F | B | BX | BW
+
+
+class ScheduleError(ValueError):
+    """A schedule failed pre-execution validation."""
+
+
+_PHASES = ("F", "B", "BX", "BW")
+
+
+def normalize(schedule: str) -> str:
+    """Canonical schedule name: '1f1b' | 'gpipe' | 'zbh1' | 'interleave'."""
+    s = schedule.lower().replace("-", "").replace("_", "")
+    if s in ("zb", "zerobubble", "zbh1"):
+        return "zbh1"
+    if s == "fthenb":
+        return "gpipe"
+    if s not in ("1f1b", "gpipe", "interleave", "zbh1"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    return s
+
+
+def stage_op_sequence(schedule: str, s: int, P_: int, M: int
+                      ) -> List[Tuple[str, int]]:
+    """Per-stage op order as (phase, microbatch) pairs.
+
+    1f1b: warmup of min(M, P-s-1) forwards then strict F/B alternation;
+    gpipe: all F then all B; zbh1: 1F1B with B split into BX (input grad,
+    critical path) and BW (weight grad), BWs queued late so the dependency
+    dispatcher slides them into former bubble slots."""
+    if schedule == "gpipe":
+        return [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
+    w = min(M, P_ - s - 1)
+    seq = [("F", m) for m in range(w)]
+    if schedule == "zbh1":
+        fm, xm, wm = w, 0, 0
+        while fm < M:             # steady state: F / BX pairs
+            seq.append(("F", fm)); fm += 1
+            seq.append(("BX", xm)); xm += 1
+        while xm < M:             # cooldown: BX chain + BW bubble-fill
+            seq.append(("BX", xm)); xm += 1
+            if wm < xm - 1:       # keep one BW in reserve for reordering
+                seq.append(("BW", wm)); wm += 1
+        while wm < M:
+            seq.append(("BW", wm)); wm += 1
+        return seq
+    fm, bm = w, 0
+    while fm < M or bm < M:
+        if fm < M:
+            seq.append(("F", fm))
+            fm += 1
+        if bm < M:
+            seq.append(("B", bm))
+            bm += 1
+    return seq
+
+
+def stage_actions(schedule: str, s: int, P_: int, M: int) -> List[Action]:
+    return [Action(s, m, k) for k, m in stage_op_sequence(schedule, s, P_, M)]
+
+
+# ---------------------------------------------------------------------------
+# Validation — deterministic, before any execution
+# ---------------------------------------------------------------------------
+
+def validate(actions: Dict[int, List[Action]], P_: int, M: int,
+             schedule: str = "1f1b") -> None:
+    """Raise :class:`ScheduleError` unless the per-stage action lists form a
+    complete, deadlock-free, memory-bounded pipeline schedule."""
+    if sorted(actions) != list(range(P_)):
+        raise ScheduleError(f"stages {sorted(actions)} != 0..{P_ - 1}")
+    for s, seq in actions.items():
+        fs = [a.microbatch for a in seq if a.phase == "F"]
+        bs = [a.microbatch for a in seq if a.phase == "B"]
+        xs = [a.microbatch for a in seq if a.phase == "BX"]
+        ws = [a.microbatch for a in seq if a.phase == "BW"]
+        if any(a.stage != s for a in seq):
+            raise ScheduleError(f"stage {s}: action with foreign stage id")
+        if any(a.phase not in _PHASES for a in seq):
+            raise ScheduleError(f"stage {s}: unknown phase")
+        if sorted(fs) != list(range(M)):
+            raise ScheduleError(
+                f"stage {s}: forwards cover {sorted(fs)} != 0..{M - 1}")
+        if bs and (xs or ws):
+            raise ScheduleError(
+                f"stage {s}: mixes monolithic B with split BX/BW")
+        if bs:
+            if sorted(bs) != list(range(M)):
+                raise ScheduleError(
+                    f"stage {s}: backwards cover {sorted(bs)} != 0..{M - 1}")
+        else:
+            if sorted(xs) != list(range(M)) or sorted(ws) != list(range(M)):
+                raise ScheduleError(
+                    f"stage {s}: split backward does not cover every "
+                    f"microbatch (BX={sorted(xs)}, BW={sorted(ws)})")
+            pos = {(a.phase, a.microbatch): i for i, a in enumerate(seq)}
+            for m in range(M):
+                if pos[("BX", m)] > pos[("BW", m)]:
+                    raise ScheduleError(
+                        f"stage {s}: BW({m}) scheduled before its BX")
+        # activation-memory bound: in-flight forwards never exceed warmup+1
+        # for 1f1b/zbh1 (gpipe holds all M by design)
+        if schedule in ("1f1b", "zbh1", "interleave"):
+            w = min(M, P_ - s - 1)
+            inflight = peak = 0
+            for a in seq:
+                if a.phase == "F":
+                    inflight += 1
+                elif a.phase in ("B", "BX"):
+                    inflight -= 1
+                peak = max(peak, inflight)
+            if peak > w + 1:
+                raise ScheduleError(
+                    f"stage {s}: {peak} in-flight activations exceed the "
+                    f"1F1B bound {w + 1}")
+    # deadlock freedom: the dependency-driven dry run must drain every list
+    _dry_run(actions, P_)
+
+
+def _deps_met(done, s: int, phase: str, m: int, P_: int) -> bool:
+    """The runtime's exact dependency predicate (kept in lockstep with
+    runtime.PipelineEngine.run's deps_met)."""
+    if phase == "F":
+        return s == 0 or ("F", s - 1, m) in done
+    if phase == "BW":
+        return ("BX", s, m) in done
+    ok = ("F", s, m) in done
+    if s < P_ - 1:
+        ok = ok and (("B", s + 1, m) in done or ("BX", s + 1, m) in done)
+    return ok
+
+
+def _dry_run(actions: Dict[int, List[Action]], P_: int) -> List[Action]:
+    """Execute the lists under the runtime's dispatch discipline (head-first
+    per stage, highest stage first, opportunistic BW fill) with no actual
+    work. Raises on deadlock; returns the dispatch order."""
+    seqs = {s: list(v) for s, v in actions.items()}
+    done = set()
+    order: List[Action] = []
+    remaining = sum(len(v) for v in seqs.values())
+    while remaining:
+        progressed = False
+        for s in range(P_ - 1, -1, -1):
+            if not seqs[s]:
+                continue
+            for i, a in enumerate(seqs[s]):
+                if i > 0 and a.phase != "BW":
+                    break  # only the head, or a later BW, may run
+                if _deps_met(done, s, a.phase, a.microbatch, P_):
+                    seqs[s].pop(i)
+                    done.add((a.phase, s, a.microbatch))
+                    order.append(a)
+                    remaining -= 1
+                    progressed = True
+                    break
+        if not progressed:
+            stuck = {s: seqs[s][0] for s in seqs if seqs[s]}
+            raise ScheduleError(f"schedule deadlocks; blocked heads: {stuck}")
+    return order
+
+
+def build_schedule(schedule: str, P_: int, M: int
+                   ) -> Dict[int, List[Action]]:
+    """All stages' validated action lists. ``schedule`` is a normalized
+    name; 'interleave' uses the 1f1b per-stage order over the GLOBAL
+    (physical x virtual) stage count — chunk placement is the interleave."""
+    schedule = normalize(schedule)
+    base = "1f1b" if schedule == "interleave" else schedule
+    actions = {s: stage_actions(base, s, P_, M) for s in range(P_)}
+    validate(actions, P_, M, schedule=base)
+    return actions
+
+
+# ---------------------------------------------------------------------------
+# Simulation + closed-form bubble accounting
+# ---------------------------------------------------------------------------
+
+def closed_form_bubble(pp: int, m: int, v: int = 1) -> float:
+    """Synchronous-1F1B bubble fraction with equal unit-cost F and B:
+    (pp-1)/(m+pp-1); interleaved over v virtual chunks per group:
+    (pp-1)/(v*m+pp-1)."""
+    return (pp - 1) / (v * m + pp - 1)
+
+
+def _dep_keys(a: Action, P_: int) -> List[Tuple[str, int, int]]:
+    s, m = a.stage, a.microbatch
+    if a.phase == "F":
+        return [("F", s - 1, m)] if s > 0 else []
+    if a.phase == "BW":
+        return [("BX", s, m)]
+    deps = [("F", s, m)]
+    if s < P_ - 1:
+        deps.append(("B*", s + 1, m))  # either downstream backward flavor
+    return deps
+
+
+def _dep_ready(done, finish, key, t) -> bool:
+    phase, s, m = key
+    if phase != "B*":
+        return key in done and finish[key] <= t
+    for p in ("B", "BX"):
+        k = (p, s, m)
+        if k in done and finish[k] <= t:
+            return True
+    return False
+
+
+def simulate(actions: Dict[int, List[Action]], P_: int,
+             groups: int = 0) -> dict:
+    """Dependency-timed unit-cost execution of the action lists.
+
+    Each action costs one time unit; an action starts when its producer
+    results exist AND its device group is free. Global stage g occupies
+    device group ``g % groups`` (interleaved virtual chunks contend for
+    their physical group). Returns makespan, per-group busy time and the
+    bubble fraction ``1 - busy/(groups*makespan)`` — the quantity the
+    closed form predicts."""
+    G = groups or P_
+    seqs = {s: list(v) for s, v in actions.items()}
+    finish: Dict[Tuple[str, int, int], int] = {}
+    group_free = [0] * G
+    done = set()
+    remaining = sum(len(v) for v in seqs.values())
+    busy = [0] * G
+    makespan = 0
+    t = 0
+    guard = 8 * remaining + 64
+    while remaining and guard:
+        guard -= 1
+        progressed = False
+        for s in range(P_ - 1, -1, -1):
+            if not seqs[s]:
+                continue
+            grp = s % G
+            if group_free[grp] > t:
+                continue
+            for i, a in enumerate(seqs[s]):
+                if i > 0 and a.phase != "BW":
+                    break  # only the head, or a later BW, may run
+                if all(_dep_ready(done, finish, k, t)
+                       for k in _dep_keys(a, P_)):
+                    seqs[s].pop(i)
+                    key = (a.phase, s, a.microbatch)
+                    done.add(key)
+                    finish[key] = t + 1
+                    group_free[grp] = t + 1
+                    busy[grp] += 1
+                    makespan = max(makespan, t + 1)
+                    remaining -= 1
+                    progressed = True
+                    break
+        if not progressed:
+            t += 1
+    if remaining:
+        raise ScheduleError("simulation did not drain (deadlocked lists)")
+    total_busy = sum(busy)
+    bubble = 1.0 - total_busy / (G * makespan) if makespan else 0.0
+    return {"makespan": makespan, "busy": busy,
+            "bubble_fraction": bubble, "groups": G}

@@ -57,7 +57,8 @@ _ring_tick = [0]
 
 # high-frequency kinds subject to >1 ring sampling (metrics stay exact)
 _HIGH_FREQ = frozenset({"dispatch.hit", "async.fetch_stall",
-                        "async.enqueue"})
+                        "async.enqueue", "async.p2p", "pipeline.send",
+                        "pipeline.recv"})
 
 
 def registry() -> Registry:
@@ -258,6 +259,30 @@ _g_rt_pending = _G("paddle_router_pending_requests",
                    "Router-side requests awaiting placement")
 _g_rt_live = _G("paddle_router_live_streams",
                 "Streams admitted and not yet finished")
+_c_pp_sends = _C("paddle_pp_sends_total",
+                 "Pipeline stage handoffs issued (activation/grad), by kind")
+_h_pp_send = _H("paddle_pp_send_seconds",
+                "Host-side issue latency of pipeline P2P handoffs")
+_c_pp_recvs = _C("paddle_pp_recvs_total",
+                 "Pipeline stage inputs consumed, by kind and readiness")
+_c_pp_stalls = _C("paddle_pp_stalls_total",
+                  "Stage actions that had to wait for an upstream producer")
+_c_pp_builds = _C("paddle_pp_stage_builds_total",
+                  "Per-stage executable builds (signature-cache misses); "
+                  "constant after warmup = zero steady-state retraces")
+_c_pp_runs = _C("paddle_pp_runs_total",
+                "Pipeline engine batch runs, by schedule")
+_g_pp_bubble = _G("paddle_pp_bubble_fraction",
+                  "Schedule bubble fraction of the last pipeline run "
+                  "(idle device-slots / total device-slots)")
+_g_pp_skew = _G("paddle_pp_stage_skew",
+                "Stage host-dispatch-time imbalance of the last run "
+                "((max - mean) / mean)")
+_c_p2p = _C("paddle_eager_p2p_transfers_total",
+            "Async device-to-device transfers issued through the eager "
+            "pipeline")
+_c_ckpt_reshard = _C("paddle_ckpt_pp_reshards_total",
+                     "Checkpoint reshards across a changed pipeline degree")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -360,6 +385,22 @@ def _h_srv_gauges(dur_s, f):
     _g_srv_util.set(f.get("kv_utilization", 0.0))
 
 
+def _h_pp_send_h(dur_s, f):
+    _c_pp_sends.inc(labels={"kind": f.get("payload", "act")})
+    if dur_s is not None:
+        _h_pp_send.observe(dur_s)
+
+
+def _h_pp_recv(dur_s, f):
+    _c_pp_recvs.inc(labels={"kind": f.get("payload", "act"),
+                            "ready": str(bool(f.get("ready", True)))})
+
+
+def _h_pp_gauges(dur_s, f):
+    _g_pp_bubble.set(f.get("bubble_fraction", 0.0))
+    _g_pp_skew.set(f.get("stage_skew", 0.0))
+
+
 def _h_rt_assign(dur_s, f):
     _c_rt_assign.inc()
     if f.get("prefix_hit", 0) > 0:
@@ -437,6 +478,15 @@ _HANDLERS = {
         f.get("kv_utilization", 0.0),
         labels={"replica": str(f.get("replica", ""))}),
     "router.gauges": _h_rt_gauges,
+    "async.p2p": lambda d, f: _c_p2p.inc(),
+    "pipeline.send": _h_pp_send_h,
+    "pipeline.recv": _h_pp_recv,
+    "pipeline.stall": lambda d, f: _c_pp_stalls.inc(),
+    "pipeline.build": lambda d, f: _c_pp_builds.inc(),
+    "pipeline.run": lambda d, f: _c_pp_runs.inc(
+        labels={"schedule": f.get("schedule", "")}),
+    "pipeline.gauges": _h_pp_gauges,
+    "ckpt.reshard_pp": lambda d, f: _c_ckpt_reshard.inc(),
     "watchdog.timeout": lambda d, f: _c_wd.inc(),
     "watchdog.escalate": lambda d, f: _c_escalate.inc(
         labels={"stage": f.get("stage", "")}),
@@ -563,6 +613,18 @@ def summary() -> dict:
             "step_builds": int(_c_srv_builds.value()),
             "prefix_cached_tokens": int(_c_srv_prefix.value()),
             "cow_copies": int(_c_srv_cow.value()),
+        },
+        "pipeline": {
+            "runs": int(_c_pp_runs.value()),
+            "sends": int(_c_pp_sends.value()),
+            "recvs": int(_c_pp_recvs.value()),
+            "stalls": int(_c_pp_stalls.value()),
+            "stage_builds": int(_c_pp_builds.value()),
+            "p2p_transfers": int(_c_p2p.value()),
+            "bubble_fraction": round(float(_g_pp_bubble.value()), 6),
+            "stage_skew": round(float(_g_pp_skew.value()), 4),
+            "send_p50_s": round(_h_pp_send.percentile(50), 6),
+            "send_p99_s": round(_h_pp_send.percentile(99), 6),
         },
         "router": {
             "admitted": int(_c_rt_admit.value()),

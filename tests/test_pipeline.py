@@ -1,0 +1,370 @@
+"""MPMD pipeline subsystem (distributed.pipeline): schedules as validated
+data, closed-form bubble accounting, dp x pp composition, retrace-free
+steady state, pp-degree checkpoint resharding, and the stage-hang chaos
+drill.
+
+Complements tests/test_pipeline_parallel.py (fleet-level parity runs);
+this file targets the subsystem's own contracts from the MPMD-pipelining
+design (arXiv 2412.14374): a schedule is an explicit per-stage action
+list that is validated and simulated BEFORE anything executes.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.pipeline import (
+    Action, PipelineEngine, ScheduleError, build_schedule,
+    closed_form_bubble, partition, schedule as psched, simulate, validate)
+
+D_IN, D_HID, D_OUT = 16, 32, 4
+
+
+def _descs():
+    return [
+        LayerDesc(nn.Linear, D_IN, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_HID),
+        LayerDesc(nn.ReLU),
+        LayerDesc(nn.Linear, D_HID, D_OUT),
+    ]
+
+
+def _mse(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _seed_params(model):
+    rs = np.random.RandomState(0)
+    for p in model.parameters():
+        p.set_value(paddle.to_tensor(
+            rs.normal(scale=0.3, size=p.shape).astype(np.float32)))
+
+
+def _data(batch=8):
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.normal(size=(batch, D_IN)).astype(np.float32))
+    y = paddle.to_tensor(rs.normal(size=(batch, D_OUT)).astype(np.float32))
+    return x, y
+
+
+def _metric(name, labels=None):
+    # labels=None sums a counter over all label sets (the dp bucket counter
+    # is labeled by op)
+    return obs.registry().value(name, labels)
+
+
+def _engine_run(pp, M=8, steps=2, stage_devices=None, v=1):
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=pp,
+                          num_virtual_pipeline_stages=v)
+    _seed_params(model)
+    engine = PipelineEngine(model, accumulate_steps=M,
+                            stage_devices=stage_devices,
+                            schedule="interleave" if v > 1 else "1F1B")
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        loss = engine.run(x, y, train=True)
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    return losses, [p.numpy().copy() for p in model.parameters()], engine
+
+
+# ---------------------------------------------------------------------------
+# Schedules as data: closed-form bubble + validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,m", [(2, 8), (4, 8), (2, 4), (4, 16), (3, 6)])
+def test_1f1b_simulation_matches_closed_form(pp, m):
+    """Unit-cost dependency simulation of the generated 1F1B action lists
+    reproduces bubble = (pp-1)/(m+pp-1) EXACTLY — the schedule the engine
+    executes is the one the closed form describes."""
+    stats = simulate(build_schedule("1f1b", pp, m), pp)
+    assert stats["bubble_fraction"] == pytest.approx(
+        closed_form_bubble(pp, m), abs=1e-12)
+    # every group does 2 units (F+B) per microbatch
+    assert all(b == 2 * m for b in stats["busy"])
+
+
+@pytest.mark.parametrize("pp,v,m", [(2, 2, 8), (2, 2, 4), (4, 2, 8)])
+def test_interleave_simulation_matches_closed_form(pp, v, m):
+    """v virtual chunks per group shrink the bubble to (pp-1)/(v*m+pp-1):
+    simulate the global-stage lists with device-group contention."""
+    stats = simulate(build_schedule("interleave", pp * v, m), pp * v,
+                     groups=pp)
+    assert stats["bubble_fraction"] == pytest.approx(
+        closed_form_bubble(pp, m, v), abs=1e-12)
+    assert stats["bubble_fraction"] < closed_form_bubble(pp, m)
+
+
+def test_zbh1_beats_the_1f1b_bound():
+    """Zero-bubble H1 schedules strictly below the synchronous-1F1B bubble
+    (BW fills cooldown slots) at pp >= 2."""
+    for pp, m in [(2, 8), (4, 8)]:
+        stats = simulate(build_schedule("zbh1", pp, m), pp)
+        assert stats["bubble_fraction"] < closed_form_bubble(pp, m)
+
+
+def test_validate_rejects_broken_schedules():
+    P_, M = 2, 2
+    good = build_schedule("1f1b", P_, M)
+    # missing forward coverage
+    broken = {s: [a for a in seq if not (a.phase == "F" and a.microbatch == 1)]
+              for s, seq in good.items()}
+    with pytest.raises(ScheduleError, match="forwards cover"):
+        validate(broken, P_, M)
+    # monolithic B mixed with the split phases
+    mixed = {s: list(seq) for s, seq in good.items()}
+    mixed[0] = mixed[0] + [Action(0, 0, "BW")]
+    with pytest.raises(ScheduleError, match="mixes monolithic B"):
+        validate(mixed, P_, M)
+    # wrong stage count
+    with pytest.raises(ScheduleError, match="stages"):
+        validate({0: good[0]}, P_, M)
+    # deadlock: stage 1 demands its backward before its forward ran
+    dead = {0: good[0],
+            1: [Action(1, 0, "B"), Action(1, 0, "F"),
+                Action(1, 1, "F"), Action(1, 1, "B")]}
+    with pytest.raises(ScheduleError, match="deadlock"):
+        validate(dead, P_, M)
+    # 1F1B activation-memory bound: gpipe-shaped lists claim to be 1f1b
+    hoggy = {s: psched.stage_actions("gpipe", s, 4, 8) for s in range(4)}
+    with pytest.raises(ScheduleError, match="in-flight activations"):
+        validate(hoggy, 4, 8, schedule="1f1b")
+
+
+def test_engine_validates_before_execution():
+    """build_schedule runs in __init__ — a bad schedule name dies before any
+    stage executable exists."""
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        PipelineEngine(model, accumulate_steps=2, schedule="wavefront")
+    eng = PipelineEngine(model, accumulate_steps=8)
+    assert eng.schedule_stats["bubble_fraction"] == pytest.approx(
+        closed_form_bubble(2, 8), abs=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Partitioner
+# ---------------------------------------------------------------------------
+
+def test_partitioner_param_balance_beats_uniform():
+    """'param' segmentation balances parameter cost across stages better
+    than blind uniform on a lopsided stack (big layers up front)."""
+    descs = [LayerDesc(nn.Linear, 256, 256), LayerDesc(nn.Linear, 256, 256),
+             LayerDesc(nn.Linear, 256, 8), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 8, 4), LayerDesc(nn.ReLU)]
+    costs = [partition.estimate_cost(d) for d in descs]
+
+    def worst(parts):
+        return max(sum(costs[parts[i]:parts[i + 1]])
+                   for i in range(len(parts) - 1))
+
+    uni = partition.uniform(len(descs), 2)
+    bal = partition.segment(descs, 2, "param")
+    assert bal[0] == 0 and bal[-1] == len(descs)
+    assert worst(bal) < worst(uni)
+    # manual override still wins: layer:<Class> cuts at class boundaries
+    byclass = partition.segment(descs, 2, "layer:Linear")
+    assert byclass[0] == 0 and byclass[-1] == len(descs)
+
+
+def test_partitioner_drives_pipelinelayer_segments():
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2,
+                          seg_method="param")
+    assert model.segment_parts == partition.segment(_descs(), 2, "param")
+
+
+# ---------------------------------------------------------------------------
+# Parity: pp vs pp=1 through the same engine path (identical microbatch
+# accumulation order) — float32-ulp tight
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_parity_vs_pp1_same_accumulation(pp):
+    ref_losses, ref_params, _ = _engine_run(1)
+    losses, params, _ = _engine_run(pp)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6, atol=1e-6)
+    for p, rp in zip(params, ref_params):
+        np.testing.assert_allclose(p, rp, rtol=1e-6, atol=1e-6)
+
+
+def test_dp_pp_2x2_parity_on_4_devices():
+    """2 stages x 2 devices each: the stage submesh shards the microbatch
+    over its dp axis and GSPMD inserts the within-stage grad reduction
+    (grads jit out replicated) — numerically the same training run."""
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 4
+    ref_losses, ref_params, _ = _engine_run(1)
+    losses, params, engine = _engine_run(
+        2, stage_devices=[[devs[0], devs[1]], [devs[2], devs[3]]])
+    assert [st.dp for st in engine.stages] == [2, 2]
+    s0, s1 = (set(d.id for p in st.params
+                  for d in p._data.sharding.device_set)
+              for st in engine.stages)
+    assert s0 == {devs[0].id, devs[1].id}
+    assert s1 == {devs[2].id, devs[3].id}
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6, atol=1e-6)
+    for p, rp in zip(params, ref_params):
+        np.testing.assert_allclose(p, rp, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Explicit DP reducer composition: fires once per batch, honors no_sync
+# ---------------------------------------------------------------------------
+
+def test_dp_reducer_fires_once_after_last_microbatch():
+    import paddle_tpu.distributed as dist
+
+    os.environ["PADDLE_TRAINERS_NUM"] = "8"
+    dist.collective.destroy_process_group()
+    dist.init_parallel_env()
+    try:
+        model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+        _seed_params(model)
+        d = dist.DataParallel(model, group=dist.get_group(0))
+        engine = PipelineEngine(model, accumulate_steps=4)
+        x, y = _data()
+
+        before = _metric("paddle_dp_bucket_comms_total")
+        engine.run(x, y, train=True, dp=d)
+        per_batch = _metric("paddle_dp_bucket_comms_total") - before
+        # the reducer ran (at least one bucket) but NOT once per microbatch
+        assert per_batch >= 1
+        for p in model.parameters():
+            p._grad = None
+        engine.run(x, y, train=True, dp=d)
+        assert (_metric("paddle_dp_bucket_comms_total")
+                == before + 2 * per_batch)
+        # no_sync suppresses the collective entirely (pure accumulation)
+        for p in model.parameters():
+            p._grad = None
+        with d.no_sync():
+            engine.run(x, y, train=True, dp=d)
+        assert (_metric("paddle_dp_bucket_comms_total")
+                == before + 2 * per_batch)
+        for p in model.parameters():
+            p._grad = None
+    finally:
+        os.environ.pop("PADDLE_TRAINERS_NUM", None)
+        dist.collective.destroy_process_group()
+
+
+# ---------------------------------------------------------------------------
+# Zero steady-state retraces
+# ---------------------------------------------------------------------------
+
+def test_zero_steady_state_retraces():
+    """paddle_pp_stage_builds_total counts signature-cache misses; after the
+    first batch it must not move."""
+    model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+    _seed_params(model)
+    engine = PipelineEngine(model, accumulate_steps=4)
+    x, y = _data()
+    engine.run(x, y, train=True)  # warmup: builds happen here
+    after_warmup = _metric("paddle_pp_stage_builds_total")
+    assert after_warmup >= 2  # at least one executable set per stage
+    for _ in range(3):
+        for p in model.parameters():
+            p._grad = None
+        engine.run(x, y, train=True)
+    assert _metric("paddle_pp_stage_builds_total") == after_warmup
+    # the debugging escape hatch really retraces
+    flags.set_flags({"pp_p2p_cache": False})
+    try:
+        for p in model.parameters():
+            p._grad = None
+        engine.run(x, y, train=True)
+        assert _metric("paddle_pp_stage_builds_total") > after_warmup
+    finally:
+        flags.set_flags({"pp_p2p_cache": True})
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager pp-degree resharding
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_reshard_pp_round_trip():
+    from paddle_tpu.distributed.fault_tolerance.checkpoint_manager import (
+        CheckpointManager)
+
+    rs = np.random.RandomState(0)
+    L = 8  # total layers, stacked at pp=2 -> [2, 4, ...]
+    state = {
+        "embed": rs.normal(size=(16, 8)).astype(np.float32),
+        "blocks": {
+            "w": rs.normal(size=(2, L // 2, 4, 4)).astype(np.float32),
+            "b": rs.normal(size=(2, L // 2, 4)).astype(np.float32),
+        },
+    }
+    before = _metric("paddle_ckpt_pp_reshards_total")
+    wide = CheckpointManager.reshard_pp(state, 4)
+    assert wide["blocks"]["w"].shape == (4, L // 4, 4, 4)
+    assert wide["blocks"]["b"].shape == (4, L // 4, 4)
+    assert wide["embed"] is state["embed"]  # pp-invariant passthrough
+    back = CheckpointManager.reshard_pp(wide, 2)
+    np.testing.assert_array_equal(np.asarray(back["blocks"]["w"]),
+                                  state["blocks"]["w"])
+    np.testing.assert_array_equal(np.asarray(back["blocks"]["b"]),
+                                  state["blocks"]["b"])
+    assert _metric("paddle_ckpt_pp_reshards_total") == before + 2
+    # stage-major layout: new stage 0 holds the first L//4 layers
+    np.testing.assert_array_equal(np.asarray(wide["blocks"]["w"][0]),
+                                  state["blocks"]["w"][0, :2])
+    with pytest.raises(Exception):  # L=8 does not divide pp=3
+        CheckpointManager.reshard_pp(state, 3)
+    with pytest.raises(ValueError, match="blocks"):
+        CheckpointManager.reshard_pp({"embed": state["embed"]}, 2)
+
+
+# ---------------------------------------------------------------------------
+# Chaos drill: a hung stage escalates the watchdog and is NAMED
+# ---------------------------------------------------------------------------
+
+def test_chaos_stage_hang_names_stage_in_distress_dump(tmp_path, capfd):
+    """pipeline:hang@stage=1 stalls stage 1's first dispatch past the comm
+    timeout; the ladder must warn AND write a distress dump whose task
+    description carries stage=1 (the extra= channel through comm_task)."""
+    flags.set_flags({"chaos_spec": "pipeline:hang@stage=1;delay=2.0",
+                     "comm_timeout": 0.25,
+                     "watchdog_policy": "warn,dump",
+                     "comm_watchdog_abort": False,
+                     "distress_dir": str(tmp_path)})
+    try:
+        model = PipelineLayer(layers=_descs(), loss_fn=_mse, num_stages=2)
+        _seed_params(model)
+        engine = PipelineEngine(model, accumulate_steps=2)
+        x, y = _data()
+        before = _metric("paddle_chaos_injections_total",
+                         {"site": "pipeline", "kind": "hang"})
+        loss = engine.run(x, y, train=True)
+        assert np.isfinite(float(np.asarray(loss._data)))
+        assert _metric("paddle_chaos_injections_total",
+                       {"site": "pipeline", "kind": "hang"}) == before + 1
+        err = capfd.readouterr().err
+        assert "stage=warn" in err
+        assert "stage=1 microbatch=0" in err  # the hung dispatch is named
+        dumps = glob.glob(str(tmp_path / "*.json"))
+        assert dumps, "watchdog dump stage wrote no distress file"
+        blob = "".join(open(f).read() for f in dumps)
+        assert "stage=1 microbatch=0" in blob
+        assert "pp:" in blob  # the op name carries the pipeline phase
+    finally:
+        flags.set_flags({"chaos_spec": "", "comm_timeout": 0.0,
+                         "watchdog_policy": "", "distress_dir": "",
+                         "comm_watchdog_abort": False})

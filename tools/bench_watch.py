@@ -396,6 +396,49 @@ def maybe_elastic_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/elastic_smoke.py)")
 
 
+_last_pp_smoke = [0.0]
+
+
+def maybe_pp_smoke(min_interval: float = 3600.0) -> None:
+    """Run the pipeline-parallel smoke (tools/pp_smoke.py) at most once
+    per min_interval and log a RED line on regression — 1F1B at pp=2 that
+    drifts from the pp=1 run, a bubble fraction off the closed-form
+    (pp-1)/(m+pp-1), or a steady-state retrace is build-signal the same
+    way the perf floor is."""
+    now = time.monotonic()
+    if _last_pp_smoke[0] and now - _last_pp_smoke[0] < min_interval:
+        return
+    _last_pp_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "pp_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: pipeline smoke hung >600s — pipeline runtime broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"pipeline smoke GREEN ({payload.get('wall_s')}s: "
+            f"pp={payload.get('pp')} m={payload.get('microbatches')}, "
+            f"bubble={payload.get('bubble_fraction')} "
+            f"(bound {payload.get('closed_form_bound')}), "
+            f"loss_err={payload.get('loss_err')}, "
+            f"1f1b={payload.get('f1b_ms')}ms)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: pipeline smoke regression rc={out.returncode} — {detail} "
+        f"(tools/pp_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -505,6 +548,7 @@ def main() -> None:
         maybe_serving_smoke()
         maybe_router_smoke()
         maybe_elastic_smoke()
+        maybe_pp_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -517,6 +561,7 @@ def main() -> None:
             maybe_serving_smoke()
             maybe_router_smoke()
             maybe_elastic_smoke()
+            maybe_pp_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
