@@ -679,6 +679,77 @@ def _serving_router_details():
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
+def _serving_quant_details():
+    """Sub-config: w8 weights + int8 paged KV vs the fp paged engine on
+    one shared-prefix trace (both warmed). red_signal fires when greedy
+    token agreement drops below 90%, the effective KV capacity ratio
+    falls under 1.8x, or the quant engine retraces in steady state
+    (tools/quant_smoke.py is the full gate with logit parity and the
+    preemption bit-exactness drill)."""
+    from paddle_tpu.inference import quant as Q
+    from paddle_tpu.inference.serving import PagedServingEngine
+    from paddle_tpu.models import llama as L
+
+    try:
+        cfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=4, max_seq_len=96, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        n_req, new = 16, 6
+        rs = np.random.RandomState(0)
+        shared = rs.randint(1, cfg.vocab_size, size=40).tolist()
+        prompts = [shared + rs.randint(1, cfg.vocab_size, size=4).tolist()
+                   for _ in range(n_req)]
+        manifest = Q.calibrate(
+            cfg, params,
+            [rs.randint(1, cfg.vocab_size, (2, 16)) for _ in range(2)])
+
+        def timed(eng):
+            [eng.submit(p, max_new_tokens=new) for p in prompts]
+            eng.run()                       # warm pass (+ prefix cache seed)
+            best, outs = 0.0, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+                out = {c.rid: c.output_tokens for c in eng.run()}
+                dt = time.perf_counter() - t0
+                best, outs = max(best, n_req * new / dt), [out[r]
+                                                           for r in rids]
+            return outs, best
+
+        def make(**kw):
+            return PagedServingEngine(cfg, params, num_blocks=160,
+                                      block_size=8, max_batch=n_req,
+                                      token_budget=32,
+                                      max_len=cfg.max_seq_len, **kw)
+
+        fp_eng = make()
+        fp_out, fp_tps = timed(fp_eng)
+        q_eng = make(quant_mode="w8", quant_kv=True,
+                     quant_manifest=manifest)
+        builds0 = None
+        q_out, q_tps = timed(q_eng)
+        builds0 = q_eng.stats["step_builds"]
+        pairs = [(x, y) for a, b in zip(q_out, fp_out)
+                 for x, y in zip(a, b)]
+        agreement = (sum(x == y for x, y in pairs) / max(len(pairs), 1))
+        capacity = fp_eng.kv_page_bytes / q_eng.kv_page_bytes
+        return {
+            "requests": n_req, "new_tokens": new,
+            "quant_tokens_per_s": round(q_tps, 1),
+            "fp_tokens_per_s": round(fp_tps, 1),
+            "token_agreement": round(agreement, 4),
+            "kv_capacity_ratio": round(capacity, 3),
+            "quant_page_bytes": q_eng.kv_page_bytes,
+            "fp_page_bytes": fp_eng.kv_page_bytes,
+            "step_builds": builds0,
+            "red_signal": bool(agreement < 0.9 or capacity < 1.8
+                               or builds0 != 1),
+        }
+    except Exception as e:  # noqa: BLE001 — keep the config measurable
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def bench_llama_decode():
     """tokens/s of the jitted cached decode step (inference/llm.py) — the
     serving-path analog of the reference's block/masked-MHA decode loop."""
@@ -738,6 +809,7 @@ def bench_llama_decode():
                                                   f"{str(e)[:160]}"}
     details["llama_serving_paged"] = _serving_paged_details()
     details["llama_serving_router"] = _serving_router_details()
+    details["llama_serving_quant"] = _serving_quant_details()
     return {
         "value": round(tps, 2), "unit": "decode_tokens/s/chip",
         "details": details,

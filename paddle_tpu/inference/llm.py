@@ -26,6 +26,7 @@ from jax import lax
 
 from ..models import llama as L
 from ..observability import emit as _obs_emit
+from . import quant as Q
 
 __all__ = ["LLMPredictor", "init_cache"]
 
@@ -64,9 +65,9 @@ def _block_cached(x, lp, cfg: L.LlamaConfig, cache_k, cache_v, pos,
     B, T, d = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, T, nh, hd)
-    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, T, nkv, hd)
-    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, T, nkv, hd)
+    q = Q.matmul_param(h, lp, "wq").reshape(B, T, nh, hd)
+    k = Q.matmul_param(h, lp, "wk").reshape(B, T, nkv, hd)
+    v = Q.matmul_param(h, lp, "wv").reshape(B, T, nkv, hd)
     cos, sin = L.rope_cos_sin(pos + jnp.arange(T), hd, cfg.rope_theta)
     q = L.apply_rope(q, cos, sin)
     k = L.apply_rope(k, cos, sin)
@@ -80,13 +81,14 @@ def _block_cached(x, lp, cfg: L.LlamaConfig, cache_k, cache_v, pos,
         o = L.attention(q, k, v, impl=attn_impl)
     else:
         o = _cached_attention(q, cache_k, cache_v, pos)
-    x = x + o.reshape(B, T, nh * hd) @ lp["wo"].astype(o.dtype)
+    x = x + Q.matmul_param(o.reshape(B, T, nh * hd), lp, "wo")
     h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     if cfg.num_experts:
         x = x + L.moe_mlp(h, lp, cfg)
     else:
-        gate = jax.nn.silu(h @ lp["w1"].astype(h.dtype)) * (h @ lp["w3"].astype(h.dtype))
-        x = x + gate @ lp["w2"].astype(h.dtype)
+        gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
+                * Q.matmul_param(h, lp, "w3"))
+        x = x + Q.matmul_param(gate, lp, "w2")
     return x, cache_k, cache_v
 
 
@@ -104,7 +106,7 @@ def _forward_cached(params, tokens, cache, pos, cfg: L.LlamaConfig,
 
     x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
     x = L.rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = Q.matmul_param(x, params, "lm_head").astype(jnp.float32)
     return logits, {"k": ks, "v": vs}
 
 
@@ -159,13 +161,28 @@ class LLMPredictor:
 
     def __init__(self, cfg: L.LlamaConfig, params: Dict[str, Any],
                  max_len: Optional[int] = None, attn_impl: str = "auto",
-                 cache_dtype=None, weight_dtype=None):
+                 cache_dtype=None, weight_dtype=None,
+                 quant_mode: Optional[str] = None, quant_manifest=None):
         self.cfg = cfg
         if weight_dtype is not None:
             params = jax.tree.map(
                 lambda a: a.astype(weight_dtype)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 params)
+        # quantized weight path (inference.quant): the transform swaps the
+        # matmul leaves, matmul_param dispatches on pytree structure, so
+        # quant vs fp compile to distinct executables with no traced branch
+        self.quant_mode = Q.resolve_quant_mode(quant_mode)
+        if self.quant_mode and cfg.num_experts:
+            raise NotImplementedError(
+                "quantized LLMPredictor covers dense LLaMA; MoE expert "
+                "matmuls stay fp (drop quant_mode for MoE configs)")
+        if self.quant_mode:
+            manifest = Q.resolve_manifest(quant_manifest)
+            if manifest is not None:
+                manifest.validate_for(cfg)
+            params = Q.quantize_llama_params(params, self.quant_mode,
+                                             manifest)
         self.params = params
         self.max_len = int(max_len or cfg.max_seq_len)
         self.attn_impl = attn_impl

@@ -1,0 +1,81 @@
+"""paddle_tpu.inference.quant — the quantized inference subsystem.
+
+Three pieces (ROADMAP item 5):
+
+- **calibration** (calibrate.py): one PTQ observer pass over a sample
+  workload → a versioned, CRC'd :class:`QuantManifest` of per-layer
+  weight / activation / KV scales;
+- **model transform** (transform.py): ``quantize_llama_params`` swaps
+  the transformer matmuls for weight-only int8 (w8), static-activation
+  int8×int8→int32 (w8a8) or weight-only fp8 executables, dispatched
+  statically by ``matmul_param`` — pytree structure keys the jit
+  signature, so quantization never retraces in steady state;
+- **manifest** (manifest.py): the portable artifact both
+  ``LLMPredictor`` and ``PagedServingEngine`` load.
+
+The int8 paged-KV layout itself lives where the pages live — the
+quantize/dequantize math in ``ops.kernels.serving_attention`` and the
+per-page scale arrays in ``inference.serving.engine`` — driven by the
+KV scales this package calibrates.
+
+Flag surface (reference PTQ / weight_quantize knobs → here, see the
+MIGRATION.md "Quantized inference" table)::
+
+    FLAGS_quant_mode      '' | 'w8' | 'w8a8' | 'fp8'
+    FLAGS_quant_kv_cache  int8 paged KV pages with per-page scales
+    FLAGS_quant_manifest  calibration manifest path
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core import flags
+from .calibrate import calibrate, ACT_NAMES
+from .manifest import (MANIFEST_VERSION, QuantManifest, load_manifest,
+                       model_signature, save_manifest)
+from .transform import (FP8_MAX, QMAX, QUANT_MODES, WEIGHT_NAMES,
+                        fp8_dtype, matmul_param, quantize_llama_params)
+
+__all__ = ["calibrate", "QuantManifest", "save_manifest", "load_manifest",
+           "model_signature", "quantize_llama_params", "matmul_param",
+           "fp8_dtype", "resolve_quant_mode", "resolve_manifest",
+           "QUANT_MODES", "WEIGHT_NAMES", "ACT_NAMES", "QMAX", "FP8_MAX",
+           "MANIFEST_VERSION"]
+
+flags.define_flag(
+    "quant_mode", "",
+    "Inference weight quantization for LLMPredictor/PagedServingEngine "
+    "when not passed explicitly: '' serves fp weights, 'w8' weight-only "
+    "int8 with per-channel scales, 'w8a8' adds static int8 activations "
+    "(needs a calibration manifest), 'fp8' weight-only float8_e4m3 where "
+    "the platform supports it")
+flags.define_flag(
+    "quant_kv_cache", False,
+    "Store paged serving KV-cache pages as int8 with per-page, per-head "
+    "scales: quantize-on-append inside the fused step, dequantize inside "
+    "the paged attention kernel (~3.9x effective KV capacity vs f32). "
+    "Needs a calibration manifest for the KV scales")
+flags.define_flag(
+    "quant_manifest", "",
+    "Path to a quantization manifest (inference.quant.calibrate + "
+    "save_manifest) holding calibrated activation and KV scales; loaded "
+    "at predictor/engine construction when quantization needs it")
+
+
+def resolve_quant_mode(mode: Optional[str] = None) -> str:
+    """Explicit arg wins; None falls back to FLAGS_quant_mode."""
+    if mode is None:
+        mode = str(flags.flag_value("quant_mode"))
+    if mode not in QUANT_MODES:
+        raise ValueError(f"quant mode {mode!r} not in {QUANT_MODES}")
+    return mode
+
+
+def resolve_manifest(manifest=None) -> Optional[QuantManifest]:
+    """Accept a QuantManifest, a path, or None (falls back to
+    FLAGS_quant_manifest; empty flag → None)."""
+    if isinstance(manifest, QuantManifest):
+        return manifest
+    path = manifest if manifest is not None \
+        else str(flags.flag_value("quant_manifest"))
+    return load_manifest(path) if path else None

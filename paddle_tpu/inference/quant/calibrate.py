@@ -1,0 +1,123 @@
+"""PTQ calibration over a sample workload.
+
+Runs the LLaMA forward eagerly, layer by layer, with absmax observers at
+every quantized-matmul input and at the post-rope K / V projections —
+the same running-absmax statistic ``quantization.AbsmaxObserver``
+collects in the reference-shaped PTQ flow, applied here to the
+functional stacked-params model the serving engines execute. One pass
+over a handful of sample batches yields a :class:`~.manifest.QuantManifest`:
+
+- ``weight_scales`` — per-output-channel absmax per layer (recorded for
+  audit; the transform recomputes them from the weights it quantizes,
+  since weights need no calibration data);
+- ``act_scales`` — per-layer absmax of each matmul's input activations
+  (the w8a8 static activation quant scales);
+- ``kv_scales`` — per-layer, per-kv-head absmax of the post-rope keys
+  and of the values (the int8 paged-cache scales; keys are observed
+  AFTER rope because that is what the paged kernel stores).
+
+Everything here is host-side eager math (no jit): calibration runs once
+per deployment, correctness and observability beat speed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models import llama as L
+from ...observability import emit as _emit
+from .manifest import QuantManifest, model_signature
+
+__all__ = ["calibrate", "ACT_NAMES", "WEIGHT_NAMES"]
+
+# matmul weights of one block, in forward order; each has an activation
+# observer at its input
+WEIGHT_NAMES = ("wq", "wk", "wv", "wo", "w1", "w3", "w2")
+ACT_NAMES = WEIGHT_NAMES + ("lm_head",)
+
+
+def _absmax(x) -> float:
+    return float(jnp.max(jnp.abs(x)))
+
+
+def calibrate(cfg: L.LlamaConfig, params: Dict,
+              batches: Iterable[Sequence[Sequence[int]]]) -> QuantManifest:
+    """Observe scales over ``batches`` (iterable of [B, T] int token
+    arrays) and return the manifest. Raises on MoE configs (the quant
+    transform covers the dense LLaMA the serving engines execute)."""
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "quant calibration covers dense LLaMA; MoE expert matmuls "
+            "are not routed through the quantized transform")
+    t0 = time.perf_counter()
+    nl, nh, nkv, hd = (cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                       cfg.head_dim)
+    act = {n: np.zeros((nl,), np.float64) for n in WEIGHT_NAMES}
+    act_lm = 0.0
+    kv_k = np.zeros((nl, nkv), np.float64)
+    kv_v = np.zeros((nl, nkv), np.float64)
+    n_batches = 0
+
+    for tokens in batches:
+        tokens = jnp.asarray(np.asarray(tokens), jnp.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        B, T = tokens.shape
+        n_batches += 1
+        x = jnp.take(params["embed"], tokens, axis=0).astype(jnp.float32)
+        cos, sin = L.rope_cos_sin(jnp.arange(T), hd, cfg.rope_theta)
+        for li in range(nl):
+            lp = {k: jnp.asarray(v[li], jnp.float32)
+                  for k, v in params["blocks"].items()}
+            h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+            a = _absmax(h)
+            for n in ("wq", "wk", "wv"):
+                act[n][li] = max(act[n][li], a)
+            q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+            k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+            v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+            q = L.apply_rope(q, cos, sin)
+            k = L.apply_rope(k, cos, sin)
+            kh = np.asarray(jnp.max(jnp.abs(k), axis=(0, 1, 3)))  # [nkv]
+            vh = np.asarray(jnp.max(jnp.abs(v), axis=(0, 1, 3)))
+            kv_k[li] = np.maximum(kv_k[li], kh)
+            kv_v[li] = np.maximum(kv_v[li], vh)
+            o = L.attention(q, k, v, impl="xla").reshape(B, T, nh * hd)
+            act["wo"][li] = max(act["wo"][li], _absmax(o))
+            x = x + o @ lp["wo"]
+            h2 = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+            a2 = _absmax(h2)
+            act["w1"][li] = max(act["w1"][li], a2)
+            act["w3"][li] = max(act["w3"][li], a2)
+            gate = jax.nn.silu(h2 @ lp["w1"]) * (h2 @ lp["w3"])
+            act["w2"][li] = max(act["w2"][li], _absmax(gate))
+            x = x + gate @ lp["w2"]
+        xf = L.rms_norm(x, jnp.asarray(params["final_norm"], jnp.float32),
+                        cfg.rms_eps)
+        act_lm = max(act_lm, _absmax(xf))
+    if n_batches == 0:
+        raise ValueError("calibrate needs at least one sample batch")
+
+    eps = 1e-8
+    weight_scales = {}
+    for n in WEIGHT_NAMES:
+        w = jnp.asarray(params["blocks"][n], jnp.float32)  # [L, in, out]
+        weight_scales[n] = np.maximum(
+            np.asarray(jnp.max(jnp.abs(w), axis=1)), eps).tolist()
+    lm = jnp.asarray(params["lm_head"], jnp.float32)       # [in, out]
+    weight_scales["lm_head"] = np.maximum(
+        np.asarray(jnp.max(jnp.abs(lm), axis=0)), eps).tolist()
+
+    act_scales = {n: np.maximum(act[n], eps).tolist() for n in WEIGHT_NAMES}
+    act_scales["lm_head"] = [max(act_lm, eps)]
+    kv_scales = {"k": np.maximum(kv_k, eps).tolist(),
+                 "v": np.maximum(kv_v, eps).tolist()}
+    _emit("quant.calibrate", dur_s=time.perf_counter() - t0,
+          layers=nl, batches=n_batches)
+    return QuantManifest(model=model_signature(cfg),
+                         weight_scales=weight_scales,
+                         act_scales=act_scales, kv_scales=kv_scales)

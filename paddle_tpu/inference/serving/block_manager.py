@@ -45,12 +45,18 @@ def _chain_hash(prev_hash: int, tokens: Tuple[int, ...]) -> int:
 
 
 class BlockManager:
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 page_bytes: int = 0):
         if num_blocks < 1 or block_size < 1:
             raise ValueError(f"need num_blocks>=1 and block_size>=1, got "
                              f"{num_blocks}/{block_size}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        # dtype-aware device footprint of one page across all layers, both
+        # cache sides (+ per-page scales when quantized) — supplied by the
+        # engine so byte gauges and router placement stay truthful when
+        # int8 pages make a "block" 2-4x cheaper than its fp32 twin
+        self.page_bytes = int(page_bytes)
         self._free: List[int] = list(range(num_blocks))[::-1]  # pop() = lowest
         self._refs: Dict[int, int] = {}
         # content-addressed full blocks: chain hash -> block id, the inverse
@@ -83,6 +89,15 @@ class BlockManager:
 
     def utilization(self) -> float:
         return self.num_allocated() / self.num_blocks
+
+    def bytes_total(self) -> int:
+        """Device bytes of the whole page pool (0 when the engine did not
+        report a page size — e.g. unit tests building bare managers)."""
+        return self.num_blocks * self.page_bytes
+
+    def bytes_in_use(self) -> int:
+        """Device bytes behind allocated pages, dtype-aware."""
+        return self.num_allocated() * self.page_bytes
 
     def blocks_needed(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)

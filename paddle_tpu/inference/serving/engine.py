@@ -43,9 +43,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ...core import flags
 from ...models import llama as L
 from ...observability import emit as _emit
 from ...ops.kernels.serving_attention import block_multihead_attention_
+from .. import quant as Q
 from .block_manager import BlockManager
 from .scheduler import (DeadlineExceededError, RejectedError, ScheduledBatch,
                         Scheduler, Sequence)
@@ -116,7 +118,8 @@ class PagedServingEngine:
                  max_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None, top_k: int = 0,
                  max_queue: Optional[int] = None, cache_dtype=None,
-                 weight_dtype=None):
+                 weight_dtype=None, quant_mode: Optional[str] = None,
+                 quant_kv: Optional[bool] = None, quant_manifest=None):
         if cfg.num_experts:
             raise NotImplementedError(
                 "PagedServingEngine serves dense LLaMA; route MoE decode "
@@ -127,19 +130,53 @@ class PagedServingEngine:
                 lambda a: a.astype(weight_dtype)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 params)
-        self.params = params
+        # quantized serving (inference.quant): weight transform + int8
+        # paged KV. None = read the FLAGS_quant_* surface.
+        self.quant_mode = Q.resolve_quant_mode(quant_mode)
+        if quant_kv is None:
+            quant_kv = bool(flags.flag_value("quant_kv_cache"))
+        self.quant_kv = bool(quant_kv)
+        manifest = Q.resolve_manifest(quant_manifest)
+        if self.quant_kv and manifest is None:
+            raise ValueError(
+                "quant_kv needs calibrated KV scales: run "
+                "inference.quant.calibrate over a sample workload, "
+                "save_manifest it, and pass quant_manifest (or set "
+                "FLAGS_quant_manifest)")
+        if manifest is not None:
+            manifest.validate_for(cfg)
+        self.params = Q.quantize_llama_params(params, self.quant_mode,
+                                              manifest)
         self.max_len = int(max_len or cfg.max_seq_len)
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
         self.token_budget = int(token_budget)
         self.top_k = int(top_k)
-        self.cache_dtype = cache_dtype or cfg.dtype
+        if self.quant_kv:
+            if (cache_dtype is not None
+                    and np.dtype(cache_dtype) != np.dtype(np.int8)):
+                raise ValueError(
+                    f"quant_kv serves int8 pages; cache_dtype="
+                    f"{np.dtype(cache_dtype)} conflicts (drop it or "
+                    f"disable quant_kv)")
+            self.cache_dtype = jnp.int8
+        else:
+            self.cache_dtype = cache_dtype or cfg.dtype
         self.max_blocks_per_seq = -(-self.max_len // self.block_size)
         if num_blocks is None:
             num_blocks = self.max_batch * self.max_blocks_per_seq
         self.num_blocks = int(num_blocks)
 
-        self.blocks = BlockManager(self.num_blocks, self.block_size)
+        # dtype-aware page footprint (both cache sides, all layers, plus
+        # the per-page f32 scale rows when quantized) — keeps the byte
+        # gauges and the router's least-loaded placement truthful
+        kvh, hd = cfg.num_kv_heads, cfg.head_dim
+        self.kv_page_bytes = (2 * cfg.num_layers * kvh * self.block_size
+                              * hd * np.dtype(self.cache_dtype).itemsize)
+        if self.quant_kv:
+            self.kv_page_bytes += 2 * cfg.num_layers * kvh * 4
+        self.blocks = BlockManager(self.num_blocks, self.block_size,
+                                   page_bytes=self.kv_page_bytes)
         self.scheduler = Scheduler(self.blocks, self.token_budget,
                                    self.max_batch,
                                    prefill_chunk=prefill_chunk,
@@ -152,10 +189,32 @@ class PagedServingEngine:
 
         # device state: stacked per-layer paged caches (scanned with the
         # layer axis, like llm.py's init_cache)
-        kvh, hd = cfg.num_kv_heads, cfg.head_dim
         shape = (cfg.num_layers, self.num_blocks, kvh, self.block_size, hd)
         self._key_cache = jnp.zeros(shape, self.cache_dtype)
         self._value_cache = jnp.zeros(shape, self.cache_dtype)
+        if self.quant_kv:
+            # static calibrated absmax per (layer, kv head) -> per-head
+            # quant multipliers [L, KV] for the append path and GENUINELY
+            # per-page dequant arrays [L, num_blocks, KV] for the read
+            # path (COW copies move scale rows with their pages; today
+            # every page of a layer shares the calibrated value, but the
+            # layout is the per-page contract the kernel consumes)
+            kab = jnp.asarray(np.asarray(manifest.kv_scales.get("k"),
+                                         np.float32))
+            vab = jnp.asarray(np.asarray(manifest.kv_scales.get("v"),
+                                         np.float32))
+            want = (cfg.num_layers, kvh)
+            if kab.shape != want or vab.shape != want:
+                raise ValueError(
+                    f"manifest kv_scales must be [num_layers, num_kv_heads]"
+                    f"={want}; got k={kab.shape} v={vab.shape} — re-run "
+                    f"calibration against this model")
+            self._kv_scales = (
+                Q.QMAX / kab, Q.QMAX / vab,
+                jnp.tile((kab / Q.QMAX)[:, None, :], (1, self.num_blocks, 1)),
+                jnp.tile((vab / Q.QMAX)[:, None, :], (1, self.num_blocks, 1)))
+        else:
+            self._kv_scales = None
         # rope table in the kernel's stacked [2, 1, S, hd] layout (only the
         # first hd//2 lanes of each are read)
         cos, sin = L.rope_cos_sin(jnp.arange(self.max_len), hd,
@@ -272,44 +331,54 @@ class PagedServingEngine:
         cfg = self.cfg
         top_k = self.top_k
         bs = self.block_size
+        quant_kv = self.quant_kv   # static: selects the int8-cache trace
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step_fn(params, key_cache, value_cache, tokens, block_tables,
-                    cu_seqlens_q, seq_lens_decoder, seq_lens_this_time,
-                    rope_emb, temps, top_ps, keys, greedy):
+        def step_fn(params, key_cache, value_cache, kv_scales, tokens,
+                    block_tables, cu_seqlens_q, seq_lens_decoder,
+                    seq_lens_this_time, rope_emb, temps, top_ps, keys,
+                    greedy):
             x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
             zeros_b = jnp.zeros((B,), jnp.int32)
 
             def body(carry, layer):
                 x = carry
-                lp, kc, vc = layer
+                if quant_kv:
+                    lp, kc, vc, kq, vq, kdq, vdq = layer
+                else:
+                    (lp, kc, vc), kq, vq, kdq, vdq = layer, *([None] * 4)
                 h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-                q = h @ lp["wq"].astype(h.dtype)
-                k = h @ lp["wk"].astype(h.dtype)
-                v = h @ lp["wv"].astype(h.dtype)
+                q = Q.matmul_param(h, lp, "wq")
+                k = Q.matmul_param(h, lp, "wk")
+                v = Q.matmul_param(h, lp, "wv")
                 qkv = jnp.concatenate([q, k, v], axis=-1)
                 o, _, kc, vc = block_multihead_attention_.__wrapped__(
                     qkv, kc, vc, zeros_b, seq_lens_decoder,
                     seq_lens_this_time, cu_seqlens_q=cu_seqlens_q,
                     block_tables=block_tables, rope_emb=rope_emb,
+                    cache_k_quant_scales=kq, cache_v_quant_scales=vq,
+                    cache_k_dequant_scales=kdq,
+                    cache_v_dequant_scales=vdq,
                     use_neox_style=True, block_size=bs,
                     rope_theta=cfg.rope_theta)
-                x = x + o @ lp["wo"].astype(o.dtype)
+                x = x + Q.matmul_param(o, lp, "wo")
                 h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-                gate = (jax.nn.silu(h @ lp["w1"].astype(h.dtype))
-                        * (h @ lp["w3"].astype(h.dtype)))
-                x = x + gate @ lp["w2"].astype(h.dtype)
+                gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
+                        * Q.matmul_param(h, lp, "w3"))
+                x = x + Q.matmul_param(gate, lp, "w2")
                 return x, (kc, vc)
 
-            x, (kcs, vcs) = lax.scan(
-                body, x, (params["blocks"], key_cache, value_cache))
+            xs = (params["blocks"], key_cache, value_cache)
+            if quant_kv:
+                xs = xs + tuple(kv_scales)   # kq, vq [L,KV]; kdq,vdq [L,nb,KV]
+            x, (kcs, vcs) = lax.scan(body, x, xs)
             # last-token hidden state per slot (cu[1:]-1; idle slots gather
             # garbage the host never reads)
             last_idx = jnp.clip(cu_seqlens_q[1:] - 1, 0, tok_pad - 1)
             hlast = x[last_idx]                                # [B, d]
             hlast = L.rms_norm(hlast, params["final_norm"], cfg.rms_eps)
-            logits = (hlast @ params["lm_head"].astype(hlast.dtype)
-                      ).astype(jnp.float32)                    # [B, V]
+            logits = Q.matmul_param(hlast, params, "lm_head"
+                                    ).astype(jnp.float32)      # [B, V]
             nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             nxt_sampled = _sample_rows(logits, keys, temps, top_ps, top_k)
             nxt = jnp.where(greedy, nxt_greedy, nxt_sampled)
@@ -333,11 +402,15 @@ class PagedServingEngine:
         PAD = 8
         if self._copy_fn is None:
             nb = self.num_blocks
+            quant_kv = self.quant_kv
 
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def copy_fn(kc, vc, src, dst):
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def copy_fn(kc, vc, kdq, vdq, src, dst):
                 # one-hot selects, statically unrolled over the pad width —
-                # the scatter-free page copy the tunnel backend supports
+                # the scatter-free page copy the tunnel backend supports.
+                # When quantized, a page's dequant-scale rows move WITH the
+                # page (per-page layout contract; numerically a no-op while
+                # scales are calibration-static).
                 for i in range(PAD):
                     s = jnp.maximum(src[i], 0)
                     sel = (jnp.arange(nb) == dst[i])[None, :, None, None,
@@ -346,7 +419,13 @@ class PagedServingEngine:
                     blk_v = lax.dynamic_slice_in_dim(vc, s, 1, axis=1)
                     kc = jnp.where(sel, blk_k, kc)
                     vc = jnp.where(sel, blk_v, vc)
-                return kc, vc
+                    if quant_kv:
+                        sel3 = (jnp.arange(nb) == dst[i])[None, :, None]
+                        kdq = jnp.where(sel3, lax.dynamic_slice_in_dim(
+                            kdq, s, 1, axis=1), kdq)
+                        vdq = jnp.where(sel3, lax.dynamic_slice_in_dim(
+                            vdq, s, 1, axis=1), vdq)
+                return kc, vc, kdq, vdq
 
             self._copy_fn = copy_fn
         for i in range(0, len(pairs), PAD):
@@ -355,9 +434,14 @@ class PagedServingEngine:
             dst = np.full((PAD,), -1, np.int32)   # -1 never matches arange
             for j, (s, d) in enumerate(chunk):
                 src[j], dst[j] = s, d
-            self._key_cache, self._value_cache = self._copy_fn(
-                self._key_cache, self._value_cache, jnp.asarray(src),
-                jnp.asarray(dst))
+            kdq = vdq = None
+            if self.quant_kv:
+                kq, vq, kdq, vdq = self._kv_scales
+            self._key_cache, self._value_cache, kdq, vdq = self._copy_fn(
+                self._key_cache, self._value_cache, kdq, vdq,
+                jnp.asarray(src), jnp.asarray(dst))
+            if self.quant_kv:
+                self._kv_scales = (kq, vq, kdq, vdq)
             self.stats["cow_block_copies"] += len(chunk)
             _emit("serving.cow", copies=len(chunk))
 
@@ -413,16 +497,20 @@ class PagedServingEngine:
         t0 = time.perf_counter()
         nxt, self._key_cache, self._value_cache = fn(
             self.params, self._key_cache, self._value_cache,
-            jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(cu),
-            jnp.asarray(dec_lens), jnp.asarray(this_lens), self._rope_emb,
-            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(keys),
-            jnp.asarray(greedy))
+            self._kv_scales, jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(cu), jnp.asarray(dec_lens), jnp.asarray(this_lens),
+            self._rope_emb, jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(keys), jnp.asarray(greedy))
         nxt = np.asarray(nxt)     # the step's one sync point
         dur = time.perf_counter() - t0
         n_prefill = sum(n for s, n in batch.items
                         if s.num_computed + n < len(s.tokens))
         _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
               batch=len(batch.items), prefill_tokens=n_prefill)
+        if self.quant_kv:
+            _emit("quant.kv_step",
+                  tokens=batch.total_tokens * self.cfg.num_layers,
+                  pages=int((tables >= 0).sum()) * self.cfg.num_layers)
         self.stats["steps"] += 1
         self.stats["tokens_computed"] += batch.total_tokens
 
@@ -477,11 +565,15 @@ class PagedServingEngine:
     def _update_gauges(self):
         _emit("serving.gauges", queue_depth=self.scheduler.queue_depth(),
               running=self.scheduler.num_running(),
-              kv_utilization=self.blocks.utilization())
+              kv_utilization=self.blocks.utilization(),
+              kv_bytes_in_use=self.blocks.bytes_in_use(),
+              kv_bytes_total=self.blocks.bytes_total())
 
     @property
     def engine_stats(self) -> dict:
         """One merged host-side view (engine + scheduler + block pool)."""
         return {**self.stats, **self.scheduler.stats,
                 "kv_utilization": round(self.blocks.utilization(), 4),
+                "kv_page_bytes": self.kv_page_bytes,
+                "kv_bytes_in_use": self.blocks.bytes_in_use(),
                 **{f"blocks_{k}": v for k, v in self.blocks.stats.items()}}

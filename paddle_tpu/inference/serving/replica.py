@@ -245,6 +245,7 @@ class ReplicaHandle:
         if self.engine is not None:
             out["kv_utilization"] = round(self.engine.blocks.utilization(),
                                           4)
+            out["kv_bytes_in_use"] = self.engine.blocks.bytes_in_use()
             out["queue_depth"] = self.engine.scheduler.queue_depth()
             out["running"] = self.engine.scheduler.num_running()
             out["step_builds"] = self.engine.stats["step_builds"]

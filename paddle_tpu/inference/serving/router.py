@@ -362,9 +362,17 @@ class ServingRouter:
         if not cands:
             return False
 
+        # On a mixed int8/fp fleet, equal outstanding work can hide very
+        # different device pressure (an int8-cache replica's pages are
+        # 2-4x cheaper than an fp replica's), so actual KV bytes break
+        # the tie. Homogeneous fleets keep the pure depth ordering —
+        # bytes would add no information, only placement churn.
+        mixed = len({h.engine.kv_page_bytes for h in cands}) > 1
+
         def load(h):
             return (h.engine.scheduler.queue_depth()
-                    + h.engine.scheduler.num_running())
+                    + h.engine.scheduler.num_running(),
+                    h.engine.blocks.bytes_in_use() if mixed else 0)
 
         scored = [(h.engine.blocks.lookup_prefix(req.prompt), h)
                   for h in cands]
@@ -530,8 +538,10 @@ class ServingRouter:
             counts[h.state] += 1
             util = (h.engine.blocks.utilization()
                     if h.engine is not None else 0.0)
+            kv_bytes = (h.engine.blocks.bytes_in_use()
+                        if h.engine is not None else 0)
             _emit("router.replica", replica=h.replica_id, state=h.state,
-                  kv_utilization=util)
+                  kv_utilization=util, kv_bytes_in_use=kv_bytes)
         _emit("router.gauges",
               pending=sum(len(q) for q in self._pending.values()),
               live_streams=len(self._live), **counts)

@@ -283,6 +283,24 @@ _c_p2p = _C("paddle_eager_p2p_transfers_total",
             "pipeline")
 _c_ckpt_reshard = _C("paddle_ckpt_pp_reshards_total",
                      "Checkpoint reshards across a changed pipeline degree")
+_c_q_calib = _C("paddle_quant_calibration_runs_total",
+                "PTQ calibration passes completed (quant manifests built)")
+_c_q_mm = _C("paddle_quant_matmuls_total",
+             "Transformer matmuls swapped to quantized executables by the "
+             "model transform, by mode (w8/w8a8/fp8)")
+_c_q_kv_q = _C("paddle_quant_kv_quant_tokens_total",
+               "Token-layer KV entries quantized to int8 pages on append")
+_c_q_kv_dq = _C("paddle_quant_kv_dequant_pages_total",
+                "Page-layer int8 KV reads dequantized inside the paged "
+                "attention step")
+_c_q_manifest = _C("paddle_quant_manifest_loads_total",
+                   "Quant manifest load attempts, by result (ok/"
+                   "crc_mismatch/bad_version/bad_format/parse_error)")
+_g_srv_bytes = _G("paddle_serving_kv_bytes_in_use",
+                  "Device bytes behind allocated KV pages (dtype-aware; "
+                  "int8 pages count their real footprint)")
+_g_srv_bytes_total = _G("paddle_serving_kv_bytes_total",
+                        "Device bytes of the whole KV page pool")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -383,6 +401,9 @@ def _h_srv_gauges(dur_s, f):
     _g_srv_queue.set(f.get("queue_depth", 0))
     _g_srv_running.set(f.get("running", 0))
     _g_srv_util.set(f.get("kv_utilization", 0.0))
+    if "kv_bytes_in_use" in f:
+        _g_srv_bytes.set(f.get("kv_bytes_in_use", 0))
+        _g_srv_bytes_total.set(f.get("kv_bytes_total", 0))
 
 
 def _h_pp_send_h(dur_s, f):
@@ -526,6 +547,13 @@ _HANDLERS = {
         labels={"type": f.get("type", "")}),
     "distress.dump": lambda d, f: _c_dumps.inc(
         labels={"reason": f.get("reason", "")}),
+    "quant.calibrate": lambda d, f: _c_q_calib.inc(),
+    "quant.convert": lambda d, f: _c_q_mm.inc(
+        f.get("matmuls", 0), labels={"mode": f.get("mode", "")}),
+    "quant.kv_step": lambda d, f: (_c_q_kv_q.inc(f.get("tokens", 0)),
+                                   _c_q_kv_dq.inc(f.get("pages", 0))),
+    "quant.manifest_load": lambda d, f: _c_q_manifest.inc(
+        labels={"result": f.get("result", "")}),
 }
 
 
@@ -613,6 +641,16 @@ def summary() -> dict:
             "step_builds": int(_c_srv_builds.value()),
             "prefix_cached_tokens": int(_c_srv_prefix.value()),
             "cow_copies": int(_c_srv_cow.value()),
+            "kv_bytes_in_use": int(_g_srv_bytes.value()),
+            "kv_bytes_total": int(_g_srv_bytes_total.value()),
+        },
+        "quant": {
+            "calibration_runs": int(_c_q_calib.value()),
+            "quantized_matmuls": int(_c_q_mm.value()),
+            "kv_quant_tokens": int(_c_q_kv_q.value()),
+            "kv_dequant_pages": int(_c_q_kv_dq.value()),
+            "manifest_loads_ok": int(_c_q_manifest.value(
+                {"result": "ok"})),
         },
         "pipeline": {
             "runs": int(_c_pp_runs.value()),

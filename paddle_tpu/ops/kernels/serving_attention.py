@@ -22,8 +22,13 @@ cache costs the same bytes as the CUDA kernel's bounded read when the
 cache is sized to the batch's max length) — while the varlen prefill path
 routes to the Pallas flash kernel's segment-id mode
 (ops/pallas/flash_attention.py) so the MXU sees one fused kernel.
-Quantized-cache arguments raise explicitly (PTQ int8 lives in
-paddle_tpu/quantization; cache quant is not wired yet).
+
+Cache quantization: `block_multihead_attention_` serves int8 paged caches
+— per-head quant multipliers on the append path, per-page dequant scales
+folded into the score/probability products on the read path (the scale is
+constant over head_dim, so it factors out of the dot; no fp copy of the
+cache is ever materialized). Output-side quant args (qkv_out_scale /
+out_shift / out_smooth) still raise explicitly.
 """
 from __future__ import annotations
 
@@ -357,12 +362,42 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     Returns (fmha_out [token_num, H·hd], qkv_out, key_cache_out,
     value_cache_out). Paged pages are written with a one-hot select over
     the row's pages (TPU-friendly scatter).
+
+    Int8 cache path: pass int8 key/value caches plus all four scale
+    tensors — `cache_{k,v}_quant_scales` [KV] per-head quant multipliers
+    (`quant_max_bound / absmax`) applied on append, and
+    `cache_{k,v}_dequant_scales` [num_blocks, KV] per-page dequant
+    multipliers (`absmax / quant_max_bound`) gathered alongside each
+    row's pages and applied to scores/probabilities (never to a
+    materialized fp cache copy). Scales must be STATIC (calibrated):
+    `dynamic_cachekv_quant=True` raises, because per-step scales would
+    make page contents depend on prefill chunking and break the
+    preemption recompute-on-resume bit-parity guarantee.
     """
-    _require_no_quant(cache_k_quant_scales=cache_k_quant_scales,
-                      cache_v_quant_scales=cache_v_quant_scales,
-                      cache_k_dequant_scales=cache_k_dequant_scales,
-                      cache_v_dequant_scales=cache_v_dequant_scales,
-                      qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+    quant_args = {"cache_k_quant_scales": cache_k_quant_scales,
+                  "cache_v_quant_scales": cache_v_quant_scales,
+                  "cache_k_dequant_scales": cache_k_dequant_scales,
+                  "cache_v_dequant_scales": cache_v_dequant_scales}
+    kv_quant = any(v is not None for v in quant_args.values())
+    if kv_quant:
+        missing = [k for k, v in quant_args.items() if v is None]
+        if missing:
+            raise ValueError(
+                f"int8 KV cache needs all four cache scale tensors; "
+                f"missing {missing}")
+        if key_cache.dtype != jnp.int8 or value_cache.dtype != jnp.int8:
+            raise ValueError(
+                f"cache quant scales passed but caches are "
+                f"{key_cache.dtype}/{value_cache.dtype}; allocate the "
+                f"paged caches as int8 (PagedServingEngine does this "
+                f"when quant_kv is enabled)")
+        if dynamic_cachekv_quant:
+            raise NotImplementedError(
+                "dynamic_cachekv_quant: per-step cache scales would make "
+                "page contents depend on write chunking and break "
+                "preemption recompute bit-parity; use static calibrated "
+                "scales (inference.quant.calibrate)")
+    _require_no_quant(qkv_out_scale=qkv_out_scale, out_shift=out_shift,
                       out_smooth=out_smooth)
     if pre_key_cache is not None or pre_value_cache is not None:
         raise NotImplementedError(
@@ -417,6 +452,21 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
         q_tok = _rope_pairwise(q_tok, cos[:, None], sin[:, None], use_neox_style)
         k_tok = _rope_pairwise(k_tok, cos[:, None], sin[:, None], use_neox_style)
 
+    # ---- quantize-on-append: per-head static multipliers, round+clip to
+    # the int8 page dtype. Quantization is per-token VALUE-based (no
+    # dependence on which chunk wrote the token), so a preemption resume
+    # that re-prefills with different chunk boundaries reproduces the
+    # int8 pages bit-for-bit.
+    if kv_quant:
+        kqs = cache_k_quant_scales.astype(jnp.float32).reshape(1, KV, 1)
+        vqs = cache_v_quant_scales.astype(jnp.float32).reshape(1, KV, 1)
+        k_store = jnp.clip(jnp.round(k_tok.astype(jnp.float32) * kqs),
+                           quant_min_bound, quant_max_bound).astype(jnp.int8)
+        v_store = jnp.clip(jnp.round(v_tok.astype(jnp.float32) * vqs),
+                           quant_min_bound, quant_max_bound).astype(jnp.int8)
+    else:
+        k_store, v_store = k_tok, v_tok
+
     # ---- paged cache write: token t -> page block_tables[b, pos//bs],
     # slot pos%bs. One-hot over the flat page table (pages are dense rows).
     tok_page = jnp.take_along_axis(
@@ -428,12 +478,21 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     kc = key_cache.transpose(0, 2, 1, 3).reshape(num_blocks * bs, KV, hd)
     vc = value_cache.transpose(0, 2, 1, 3).reshape(num_blocks * bs, KV, hd)
     onehot = (flat_idx[None, :] == jnp.arange(num_blocks * bs)[:, None])
-    wsel = onehot.astype(kc.dtype)                           # [slots, tok]
     written = onehot.any(axis=1, keepdims=True)[..., None]
-    kc = jnp.where(written, jnp.einsum("st,tkd->skd", wsel,
-                                       k_tok.astype(kc.dtype)), kc)
-    vc = jnp.where(written, jnp.einsum("st,tkd->skd", wsel,
-                                       v_tok.astype(vc.dtype)), vc)
+    if kv_quant:
+        # int8 one-hot select with int32 accumulation (each slot sums at
+        # most one non-zero term, so the astype back to int8 is exact)
+        wsel = onehot.astype(jnp.int8)                       # [slots, tok]
+        k_new = jnp.einsum("st,tkd->skd", wsel, k_store,
+                           preferred_element_type=jnp.int32).astype(jnp.int8)
+        v_new = jnp.einsum("st,tkd->skd", wsel, v_store,
+                           preferred_element_type=jnp.int32).astype(jnp.int8)
+    else:
+        wsel = onehot.astype(kc.dtype)                       # [slots, tok]
+        k_new = jnp.einsum("st,tkd->skd", wsel, k_store.astype(kc.dtype))
+        v_new = jnp.einsum("st,tkd->skd", wsel, v_store.astype(vc.dtype))
+    kc = jnp.where(written, k_new, kc)
+    vc = jnp.where(written, v_new, vc)
     key_cache_out = kc.reshape(num_blocks, bs, KV, hd).transpose(0, 2, 1, 3)
     value_cache_out = vc.reshape(num_blocks, bs, KV, hd).transpose(0, 2, 1, 3)
 
@@ -455,10 +514,27 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     v_tok_rows = rows_v[tok_b]
     s = jnp.einsum("tkgd,tskd->tkgs", q_g.astype(jnp.float32),
                    k_tok_rows.astype(jnp.float32)) / np.sqrt(hd)
+    if kv_quant:
+        # per-page dequant: gather each row's page scales like the pages
+        # themselves, expand to slots, apply on the SCORES — the scale is
+        # constant over hd so it factors out of the q·k dot, and the int8
+        # rows are consumed directly by the einsum (convert fused into
+        # the dot read; no dequantized cache copy exists)
+        def _page_scales(dq):                                # [nb, KV]
+            rows = dq.astype(jnp.float32)[block_tables]      # [B, mb, KV]
+            rows = jnp.broadcast_to(rows[:, :, None, :],
+                                    (B, max_blocks, bs, KV))
+            return rows.reshape(B, max_kv, KV)[tok_b]        # [tok, max_kv, KV]
+        kdq = jnp.swapaxes(_page_scales(cache_k_dequant_scales), 1, 2)
+        vdq = jnp.swapaxes(_page_scales(cache_v_dequant_scales), 1, 2)
+        s = s * kdq[:, :, None, :]                           # [tok, KV, 1, mkv]
     kv_pos = jnp.arange(max_kv)[None, :]
     ok = (kv_pos <= tok_pos[:, None]) & page_valid[tok_b]    # [tok, max_kv]
     s = jnp.where(ok[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if kv_quant:
+        # value dequant likewise factors out: fold into the probabilities
+        p = p * vdq[:, :, None, :]
     o = jnp.einsum("tkgs,tskd->tkgd", p, v_tok_rows.astype(jnp.float32))
     o = jnp.where(tok_valid[:, None, None, None], o, 0.0)
     fmha_out = o.astype(qkv.dtype).reshape(token_num, H * hd)

@@ -11,6 +11,7 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.quantization import (AVGObserver, AbsmaxObserver,
                                      AbsMaxChannelWiseWeightObserver,
+                                     FakeQuanterWithAbsMaxObserver,
                                      HistObserver, Int8Conv2D, Int8Linear,
                                      MSEObserver, PercentileObserver, PTQ,
                                      QuantConfig, convert_to_int8)
@@ -153,6 +154,75 @@ def test_int8_model_traces_and_state_dict():
     assert sf.graph_breaks == []  # int8 matmul compiles
     sd = int8_model.state_dict()
     assert any(np.asarray(v._data).dtype == np.int8 for v in sd.values())
+
+
+def test_int8_linear_state_dict_roundtrip():
+    """Converted int8 params survive state_dict -> set_state_dict into a
+    second converted model: int8 payloads and scales load bit-exact and
+    the loaded model reproduces the donor's outputs."""
+    donor, _, _ = _calibrated_int8_mlp()
+    target, _, _ = _calibrated_int8_mlp()   # different calib RNG draws
+    x = T(RS.randn(4, 16).astype(np.float32))
+    ref = donor(x).numpy()
+    assert not np.allclose(target(x).numpy(), ref)  # genuinely different
+    target.set_state_dict(donor.state_dict())
+    np.testing.assert_allclose(target(x).numpy(), ref, rtol=1e-6)
+    got = np.asarray(target.fc1.weight_int8._data)
+    want = np.asarray(donor.fc1.weight_int8._data)
+    assert got.dtype == np.int8 and (got == want).all()
+
+
+def test_int8_conv_state_dict_roundtrip():
+    def build():
+        net = ConvNet()
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=AbsmaxObserver()))
+        q = ptq.quantize(net)
+        for b in [RS.randn(2, 3, 4, 4).astype(np.float32)
+                  for _ in range(3)]:
+            q(T(b))
+        return convert_to_int8(q)
+
+    donor, target = build(), build()
+    target.set_state_dict(donor.state_dict())
+    x = T(RS.randn(2, 3, 4, 4).astype(np.float32))
+    np.testing.assert_allclose(target(x).numpy(), donor(x).numpy(),
+                               rtol=1e-6)
+    assert (np.asarray(target.conv.weight_int8._data)
+            == np.asarray(donor.conv.weight_int8._data)).all()
+
+
+def test_int8_model_jit_save_load(tmp_path):
+    int8_model, _, _ = _calibrated_int8_mlp()
+    int8_model.eval()
+    x = T(RS.randn(4, 16).astype(np.float32))
+    ref = int8_model(x).numpy()
+    path = str(tmp_path / "int8_model")
+    paddle.jit.save(int8_model, path)
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fake_quanter_observer_fails_loudly_under_trace():
+    """QAT observer in train mode refuses to observe under a trace (it
+    would silently freeze the scale at init). to_static catches the
+    refusal and demotes the signature to eager — the refusal must be
+    the recorded graph-break reason, never a silent capture."""
+    from paddle_tpu.jit import to_static
+
+    quanter = FakeQuanterWithAbsMaxObserver()
+    quanter.train()
+    sf = to_static(lambda x: quanter(x))
+    out = sf(T(RS.randn(4, 8).astype(np.float32)))   # eager fallback
+    assert out.numpy().shape == (4, 8)
+    breaks = sf.graph_breaks
+    assert len(breaks) == 1 and "cannot observe" in breaks[0][1]
+    # eval mode traces cleanly: the frozen scale is a concrete buffer
+    quanter.eval()
+    sf2 = to_static(lambda x: quanter(x))
+    sf2(T(RS.randn(4, 8).astype(np.float32)))
+    assert sf2.graph_breaks == []
 
 
 def test_conv_weight_only_int8():

@@ -305,6 +305,49 @@ def maybe_router_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/router_smoke.py)")
 
 
+_last_quant_smoke = [0.0]
+
+
+def maybe_quant_smoke(min_interval: float = 3600.0) -> None:
+    """Run the quantized-serving smoke (tools/quant_smoke.py) at most
+    once per min_interval and log a RED line on regression — quantized
+    logits drifting past tolerance, greedy agreement below 90%,
+    effective KV capacity dropping under 1.8x fp, a preemption that no
+    longer reproduces int8 pages bit-exactly, or a steady-state retrace
+    are build-signal the same way the perf floor is."""
+    now = time.monotonic()
+    if _last_quant_smoke[0] and now - _last_quant_smoke[0] < min_interval:
+        return
+    _last_quant_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "quant_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: quant smoke hung >600s — quantized serving broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"quant smoke GREEN ({payload.get('wall_s')}s: "
+            f"logit_rel={payload.get('logit_rel_err_w8')}, "
+            f"agreement={payload.get('token_agreement_vs_fp')}, "
+            f"kv_capacity={payload.get('kv_capacity_ratio')}x)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: quant smoke regression rc={out.returncode} — {detail} "
+        f"(tools/quant_smoke.py)")
+
+
 _last_tpu_lint = [0.0]
 
 
@@ -547,6 +590,7 @@ def main() -> None:
         maybe_dp_overlap_smoke()
         maybe_serving_smoke()
         maybe_router_smoke()
+        maybe_quant_smoke()
         maybe_elastic_smoke()
         maybe_pp_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
@@ -560,6 +604,7 @@ def main() -> None:
             maybe_dp_overlap_smoke()
             maybe_serving_smoke()
             maybe_router_smoke()
+            maybe_quant_smoke()
             maybe_elastic_smoke()
             maybe_pp_smoke()
             ok = try_capture(args.capture_timeout)
