@@ -1,9 +1,10 @@
 """TPL001: trace purity.
 
 Finds jitted entry points (``jax.jit(fn)`` / ``@jax.jit`` /
-``@functools.partial(jax.jit, ...)`` / ``jax.shard_map(fn, ...)``), walks the
-intra-module call graph under each, and flags host-side reads inside the
-traced region: ``.numpy()``/``.item()``-style syncs, ``float()``/``int()`` on
+``@functools.partial(jax.jit, ...)`` / ``jax.shard_map(fn, ...)``, plus
+Pallas kernel bodies — the first argument of ``pl.pallas_call``, including
+``functools.partial(kernel, ...)`` closures), walks the intra-module call
+graph under each, and flags host-side reads inside the traced region: ``.numpy()``/``.item()``-style syncs, ``float()``/``int()`` on
 traced parameters, Python / numpy RNG, wall clocks, ``os.environ`` and flag
 reads. Each one either forces a device sync per step or freezes a
 trace-time value into the executable (silent staleness on retrace-miss).
@@ -21,6 +22,10 @@ _CLOCKS = {"time.time", "time.perf_counter", "time.monotonic", "time.time_ns"}
 _FLAG_READS = {"flag_value", "get_flags", "set_flags"}
 _JIT_WRAPPERS = {"jax.jit", "jax.shard_map", "shard_map.shard_map"}
 _PARTIALS = {"partial", "functools.partial"}
+# a Pallas kernel body is traced code the same way a jitted fn is: the
+# first argument of pallas_call (possibly wrapped in functools.partial to
+# bind static config) is an entry point
+_PALLAS_CALLS = {"pl.pallas_call", "pallas.pallas_call", "pallas_call"}
 
 
 def _is_jit_dec(dec) -> bool:
@@ -33,6 +38,50 @@ def _is_jit_dec(dec) -> bool:
         if d in _PARTIALS and any(dotted(a) in _JIT_WRAPPERS for a in dec.args):
             return True
     return False
+
+
+def _unwrap_partial(call: ast.Call):
+    """Inner function Name of ``functools.partial(fn, ...)``, else None."""
+    if dotted(call.func) in _PARTIALS and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Name):
+            return inner
+    return None
+
+
+def _pallas_kernel(index: ModuleIndex, node: ast.Call):
+    """FunctionDef|Lambda behind the first arg of a pallas_call, or None.
+
+    Handles a direct kernel Name, an inline ``functools.partial(kernel, ...)``,
+    and a Name bound nearby to such a partial (the idiom used to bake static
+    config into the kernel before handing it to pallas_call).
+    """
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Call):
+        inner = _unwrap_partial(arg)
+        return index.resolve_name(inner.id, node) if inner is not None else None
+    if not isinstance(arg, ast.Name):
+        return None
+    fn = index.resolve_name(arg.id, node)
+    if fn is not None:
+        return fn
+    # not a def: look for ``name = functools.partial(kernel, ...)`` in the
+    # function (or module) the pallas_call sits in
+    scope = index.enclosing_function(node) or index.sf.tree
+    for stmt in ast.walk(scope):
+        if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == arg.id
+                   for t in stmt.targets):
+            continue
+        inner = _unwrap_partial(stmt.value)
+        if inner is not None:
+            return index.resolve_name(inner.id, stmt)
+    return None
 
 
 def _entries(index: ModuleIndex):
@@ -51,6 +100,14 @@ def _entries(index: ModuleIndex):
                     yield fn, index.qualname(fn)
             elif isinstance(arg, ast.Lambda):
                 yield arg, f"<lambda@{arg.lineno}>"
+        elif isinstance(node, ast.Call) and dotted(node.func) in _PALLAS_CALLS:
+            fn = _pallas_kernel(index, node)
+            if fn is None:
+                continue
+            if isinstance(fn, ast.Lambda):
+                yield fn, f"<lambda@{fn.lineno}>"
+            else:
+                yield fn, index.qualname(fn)
 
 
 def _rng_slug(d: str) -> str:

@@ -47,6 +47,7 @@ from ...core import flags
 from ...models import llama as L
 from ...observability import emit as _emit
 from ...ops.kernels.serving_attention import block_multihead_attention_
+from ...ops.pallas import paged_attention as PA
 from .. import quant as Q
 from .block_manager import BlockManager
 from .scheduler import (DeadlineExceededError, RejectedError, ScheduledBatch,
@@ -119,7 +120,8 @@ class PagedServingEngine:
                  prefill_chunk: Optional[int] = None, top_k: int = 0,
                  max_queue: Optional[int] = None, cache_dtype=None,
                  weight_dtype=None, quant_mode: Optional[str] = None,
-                 quant_kv: Optional[bool] = None, quant_manifest=None):
+                 quant_kv: Optional[bool] = None, quant_manifest=None,
+                 pallas: Optional[bool] = None):
         if cfg.num_experts:
             raise NotImplementedError(
                 "PagedServingEngine serves dense LLaMA; route MoE decode "
@@ -185,7 +187,20 @@ class PagedServingEngine:
         self._completions: List[Completion] = []
         self._events_by_rid: Dict[int, List[TokenEvent]] = {}
         self.stats = {"steps": 0, "step_builds": 0, "tokens_computed": 0,
-                      "cow_block_copies": 0}
+                      "cow_block_copies": 0, "pallas_steps": 0,
+                      "decode_fast_steps": 0}
+        # pallas attention read: None = FLAGS_serving_pallas_attention
+        # (re-read each tick, so flips retrace via the executable key);
+        # True = force (interpret mode off-TPU — how CPU CI drives it);
+        # False = stock. Forced mode fails loudly on bad geometry now.
+        self.pallas = pallas
+        if pallas and not PA.supported(cfg.num_heads, cfg.num_kv_heads,
+                                       cfg.head_dim, self.block_size):
+            raise ValueError(
+                f"pallas=True forced but geometry H={cfg.num_heads} "
+                f"KV={cfg.num_kv_heads} hd={cfg.head_dim} "
+                f"block_size={self.block_size} is not supported() by the "
+                f"paged-attention kernel")
 
         # device state: stacked per-layer paged caches (scanned with the
         # layer axis, like llm.py's init_cache)
@@ -222,8 +237,11 @@ class PagedServingEngine:
         self._rope_emb = jnp.stack([
             jnp.concatenate([cos, cos], -1)[None],
             jnp.concatenate([sin, sin], -1)[None]])
-        # executables keyed by (token-budget, batch-slots) signature
-        self._step_fns: Dict[Tuple[int, int], Any] = {}
+        # executables keyed by (token-budget, batch-slots, pallas-mode)
+        # signature; pallas-mode is False | True | "decode" (the max_q=1
+        # specialized launch), so a flag flip lands on a different key and
+        # retraces cleanly instead of serving a stale trace
+        self._step_fns: Dict[Tuple[int, int, Any], Any] = {}
         self._copy_fn = None
 
     # -- client API -------------------------------------------------------
@@ -325,9 +343,28 @@ class PagedServingEngine:
             self.step()
 
     # -- the fused step ---------------------------------------------------
-    def _build_step(self, tok_pad: int, B: int):
+    def _resolve_pallas(self) -> Tuple[Any, Optional[str]]:
+        """Host-side dispatch decision for this tick: (use_pallas value
+        for the op, fallback reason). Flag-driven mode re-reads the flag
+        every tick; the executable cache key carries the result, so flips
+        retrace instead of reusing a stale trace."""
+        if self.pallas is False:
+            return False, None
+        if self.pallas:          # forced (geometry validated at __init__)
+            return True, None
+        if not flags.flag_value("serving_pallas_attention"):
+            return False, None
+        cfg = self.cfg
+        if not PA.available():
+            return False, "unavailable"
+        if not PA.supported(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                            self.block_size):
+            return False, "unsupported"
+        return True, None
+
+    def _build_step(self, tok_pad: int, B: int, pallas_mode=False):
         """Trace+compile the fixed-shape mixed prefill+decode executable
-        for the (token-budget, batch-slots) signature."""
+        for the (token-budget, batch-slots, pallas-mode) signature."""
         cfg = self.cfg
         top_k = self.top_k
         bs = self.block_size
@@ -360,7 +397,7 @@ class PagedServingEngine:
                     cache_k_dequant_scales=kdq,
                     cache_v_dequant_scales=vdq,
                     use_neox_style=True, block_size=bs,
-                    rope_theta=cfg.rope_theta)
+                    rope_theta=cfg.rope_theta, use_pallas=pallas_mode)
                 x = x + Q.matmul_param(o, lp, "wo")
                 h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
                 gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
@@ -386,11 +423,11 @@ class PagedServingEngine:
 
         return step_fn
 
-    def _get_step_fn(self, tok_pad: int, B: int):
-        key = (tok_pad, B)
+    def _get_step_fn(self, tok_pad: int, B: int, pallas_mode=False):
+        key = (tok_pad, B, pallas_mode)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._build_step(tok_pad, B)
+            fn = self._build_step(tok_pad, B, pallas_mode)
             self._step_fns[key] = fn
             self.stats["step_builds"] += 1
             _emit("serving.step_build", tok_pad=tok_pad, batch=B)
@@ -465,7 +502,18 @@ class PagedServingEngine:
         if pairs:
             self._copy_blocks(pairs)
 
+        pallas_mode, pallas_fb = self._resolve_pallas()
+        if pallas_fb is not None:
+            _emit("serving.pallas_fallback", reason=pallas_fb)
         tok_pad, B = self.token_budget, self.max_batch
+        if pallas_mode and all(n == 1 for _, n in batch.items):
+            # decode fast path: every scheduled chunk is one token, so the
+            # step packs [max_batch] tokens instead of [token_budget] and
+            # the kernel runs its max_q=1 specialized launch — the
+            # steady-state executable (built once; the MPK-style single
+            # launch per decode step)
+            pallas_mode = "decode"
+            tok_pad = B
         tokens = np.zeros((tok_pad,), np.int32)
         cu = np.zeros((B + 1,), np.int32)
         dec_lens = np.zeros((B,), np.int32)
@@ -493,7 +541,7 @@ class PagedServingEngine:
                 keys[i] = _key_bits(sub)
         cu[len(batch.items) + 1:] = pos
 
-        fn = self._get_step_fn(tok_pad, B)
+        fn = self._get_step_fn(tok_pad, B, pallas_mode)
         t0 = time.perf_counter()
         nxt, self._key_cache, self._value_cache = fn(
             self.params, self._key_cache, self._value_cache,
@@ -507,6 +555,12 @@ class PagedServingEngine:
                         if s.num_computed + n < len(s.tokens))
         _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
               batch=len(batch.items), prefill_tokens=n_prefill)
+        if pallas_mode:
+            kind = "decode" if pallas_mode == "decode" else "mixed"
+            self.stats["pallas_steps"] += 1
+            if kind == "decode":
+                self.stats["decode_fast_steps"] += 1
+            _emit("serving.pallas_step", launch=kind)
         if self.quant_kv:
             _emit("quant.kv_step",
                   tokens=batch.total_tokens * self.cfg.num_layers,

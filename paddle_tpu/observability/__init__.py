@@ -217,6 +217,14 @@ _c_srv_prefix = _C("paddle_serving_prefix_cached_tokens_total",
                    "instead of recompute")
 _c_srv_cow = _C("paddle_serving_cow_copies_total",
                 "Copy-on-write KV page copies executed on device")
+_c_srv_pallas = _C("paddle_serving_pallas_steps_total",
+                   "Serving steps served through the Pallas paged-attention "
+                   "kernel, by kind (decode = max_q=1 specialized launch, "
+                   "mixed = generic ragged launch)")
+_c_srv_pallas_fb = _C("paddle_serving_pallas_fallback_total",
+                      "Steps that wanted FLAGS_serving_pallas_attention but "
+                      "served stock XLA instead, by reason (unavailable = "
+                      "no TPU, unsupported = head/page geometry)")
 _c_elastic = _C("paddle_elastic_events_total",
                 "Elastic-runtime lifecycle events, by kind (start/"
                 "rank_dead/epoch_bump/reconfigure/rejoin/refuse/...)")
@@ -475,6 +483,10 @@ _HANDLERS = {
     "serving.prefix_hit": lambda d, f: _c_srv_prefix.inc(
         f.get("tokens", 0)),
     "serving.cow": lambda d, f: _c_srv_cow.inc(f.get("copies", 1)),
+    "serving.pallas_step": lambda d, f: _c_srv_pallas.inc(
+        labels={"kind": f.get("launch", "mixed")}),
+    "serving.pallas_fallback": lambda d, f: _c_srv_pallas_fb.inc(
+        labels={"reason": f.get("reason", "")}),
     "serving.token": _h_srv_token,
     "serving.gauges": _h_srv_gauges,
     "router.admit": lambda d, f: _c_rt_admit.inc(
@@ -641,6 +653,12 @@ def summary() -> dict:
             "step_builds": int(_c_srv_builds.value()),
             "prefix_cached_tokens": int(_c_srv_prefix.value()),
             "cow_copies": int(_c_srv_cow.value()),
+            "pallas_steps": int(_c_srv_pallas.value(
+                {"kind": "decode"}) + _c_srv_pallas.value(
+                {"kind": "mixed"})),
+            "pallas_fallbacks": int(_c_srv_pallas_fb.value(
+                {"reason": "unavailable"}) + _c_srv_pallas_fb.value(
+                {"reason": "unsupported"})),
             "kv_bytes_in_use": int(_g_srv_bytes.value()),
             "kv_bytes_total": int(_g_srv_bytes_total.value()),
         },

@@ -342,8 +342,8 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
     return _OPS['blha_get_max_len'](seq_lens_encoder, seq_lens_decoder, batch_size)
 
 
-def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=None, cum_offsets=None, cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None, pre_key_cache=None, pre_value_cache=None, rope_emb=None, mask=None, tgt_mask=None, cache_k_quant_scales=None, cache_v_quant_scales=None, cache_k_dequant_scales=None, cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None, max_enc_len_this_time=None, max_dec_len_this_time=None, max_seq_len=-1, block_size=64, use_neox_style=False, dynamic_cachekv_quant=False, quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1.0, compute_dtype='default', rope_theta=10000.0):
-    return _OPS['block_multihead_attention_'](qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=padding_offsets, cum_offsets=cum_offsets, cu_seqlens_q=cu_seqlens_q, cu_seqlens_k=cu_seqlens_k, block_tables=block_tables, pre_key_cache=pre_key_cache, pre_value_cache=pre_value_cache, rope_emb=rope_emb, mask=mask, tgt_mask=tgt_mask, cache_k_quant_scales=cache_k_quant_scales, cache_v_quant_scales=cache_v_quant_scales, cache_k_dequant_scales=cache_k_dequant_scales, cache_v_dequant_scales=cache_v_dequant_scales, qkv_out_scale=qkv_out_scale, qkv_bias=qkv_bias, out_shift=out_shift, out_smooth=out_smooth, max_enc_len_this_time=max_enc_len_this_time, max_dec_len_this_time=max_dec_len_this_time, max_seq_len=max_seq_len, block_size=block_size, use_neox_style=use_neox_style, dynamic_cachekv_quant=dynamic_cachekv_quant, quant_round_type=quant_round_type, quant_max_bound=quant_max_bound, quant_min_bound=quant_min_bound, out_scale=out_scale, compute_dtype=compute_dtype, rope_theta=rope_theta)
+def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=None, cum_offsets=None, cu_seqlens_q=None, cu_seqlens_k=None, block_tables=None, pre_key_cache=None, pre_value_cache=None, rope_emb=None, mask=None, tgt_mask=None, cache_k_quant_scales=None, cache_v_quant_scales=None, cache_k_dequant_scales=None, cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None, max_enc_len_this_time=None, max_dec_len_this_time=None, max_seq_len=-1, block_size=64, use_neox_style=False, dynamic_cachekv_quant=False, quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1.0, compute_dtype='default', rope_theta=10000.0, use_pallas=None):
+    return _OPS['block_multihead_attention_'](qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder, seq_lens_this_time, padding_offsets=padding_offsets, cum_offsets=cum_offsets, cu_seqlens_q=cu_seqlens_q, cu_seqlens_k=cu_seqlens_k, block_tables=block_tables, pre_key_cache=pre_key_cache, pre_value_cache=pre_value_cache, rope_emb=rope_emb, mask=mask, tgt_mask=tgt_mask, cache_k_quant_scales=cache_k_quant_scales, cache_v_quant_scales=cache_v_quant_scales, cache_k_dequant_scales=cache_k_dequant_scales, cache_v_dequant_scales=cache_v_dequant_scales, qkv_out_scale=qkv_out_scale, qkv_bias=qkv_bias, out_shift=out_shift, out_smooth=out_smooth, max_enc_len_this_time=max_enc_len_this_time, max_dec_len_this_time=max_dec_len_this_time, max_seq_len=max_seq_len, block_size=block_size, use_neox_style=use_neox_style, dynamic_cachekv_quant=dynamic_cachekv_quant, quant_round_type=quant_round_type, quant_max_bound=quant_max_bound, quant_min_bound=quant_min_bound, out_scale=out_scale, compute_dtype=compute_dtype, rope_theta=rope_theta, use_pallas=use_pallas)
 
 
 def bmm(x, y):

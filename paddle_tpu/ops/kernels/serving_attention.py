@@ -39,7 +39,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ...core import flags
 from ..dispatch import register_op
+
+flags.define_flag(
+    "serving_pallas_attention", False,
+    help="Serve block_multihead_attention_ reads through the Pallas "
+         "paged-attention kernel (ops/pallas/paged_attention.py): the "
+         "block table is walked inside the kernel (no materialized KV "
+         "gather) and int8 pages dequantize in-register. Takes effect "
+         "when the kernel is available() and the head/page geometry is "
+         "supported(); otherwise the stock XLA path serves the step "
+         "(paddle_serving_pallas_fallback_total counts why). Read at "
+         "trace time — PagedServingEngine keys its step executables on "
+         "the value so flips retrace cleanly.")
 
 __all__ = [
     "masked_multihead_attention_", "block_multihead_attention_",
@@ -222,6 +235,11 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
     self-aligned (total_q == total_k, the training/prefill case) and tiling
     fits; otherwise the masked XLA path. Returns (out, softmax, lse, seed)
     per the phi signature (softmax None unless return_softmax).
+
+    Unsupported arguments are rejected HERE, before any compute or cache
+    write, so a bad call fails loudly at entry on every path (the
+    attn_mask rejection used to fire only after the fallback SDPA had
+    already run).
     """
     if return_softmax:
         raise NotImplementedError("flash_attn_unpadded return_softmax=True: "
@@ -230,6 +248,11 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
         raise NotImplementedError("flash_attn_unpadded dropout: pallas "
                                   "kernel has no in-kernel RNG; apply "
                                   "dropout outside or use is_test=True")
+    if attn_mask is not None:
+        raise NotImplementedError(
+            "flash_attn_unpadded attn_mask: neither the segment-id pallas "
+            "path nor the masked XLA fallback takes an additive mask over "
+            "packed sequences; use dense flash_attn")
     total_q, H, hd = q.shape
     total_k = k.shape[0]
     if scale is None:
@@ -250,7 +273,7 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
                                      == jnp.asarray(cu_seqlens_k)))
         except jax.errors.TracerBoolConversionError:
             same_pack = False
-    if (same_pack and attn_mask is None
+    if (same_pack
             and FA.supported((1, total_q, H, hd), (1, total_k, k.shape[1], hd))
             and FA.supports_segments((None, total_k))):
         o = FA.flash_attention(q[None], k[None], v[None], causal=causal,
@@ -264,9 +287,6 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
             v = jnp.repeat(v, H // kv_rep, axis=1)
         o = _xla_varlen_sdpa(q, k, v, cu_seqlens_q, cu_seqlens_k,
                              float(scale), causal)
-        if attn_mask is not None:
-            raise NotImplementedError(
-                "flash_attn_unpadded attn_mask: use dense flash_attn")
     return o, None, None, jnp.zeros((2,), jnp.int64)
 
 
@@ -300,9 +320,27 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                                                pre_cache_length=0):
     """Batched varlen SDPA. query [B, H, T, hd], key/value [B, KV, S, hd],
     seq_lens/kv_seq_lens [B(,1)] valid lengths. Reference:
-    fusion/cutlass/variable_length_memory_efficient_attention.cu."""
+    fusion/cutlass/variable_length_memory_efficient_attention.cu.
+
+    Argument validation happens at entry (same loud-rejection contract as
+    flash_attn_unpadded): a GQA layout that doesn't divide, or a
+    pre_cache_length that would be silently ignored, fails before any
+    compute."""
     B, H, T, hd = query.shape
     KV, S = key.shape[1], key.shape[2]
+    if KV <= 0 or H % KV != 0:
+        raise ValueError(
+            f"variable_length_memory_efficient_attention: {H} query heads "
+            f"do not divide over {KV} kv heads; GQA needs H % KV == 0")
+    pre_cache_length = int(pre_cache_length)
+    if pre_cache_length < 0:
+        raise ValueError(
+            f"pre_cache_length must be >= 0, got {pre_cache_length}")
+    if pre_cache_length and not causal:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention pre_cache_length "
+            "shifts the causal diagonal; without causal=True it would be "
+            "silently ignored — pass causal=True or drop it")
     if KV != H:
         key = jnp.repeat(key, H // KV, axis=1)
         value = jnp.repeat(value, H // KV, axis=1)
@@ -351,7 +389,8 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
                                dynamic_cachekv_quant=False,
                                quant_round_type=1, quant_max_bound=127.0,
                                quant_min_bound=-127.0, out_scale=-1.0,
-                               compute_dtype="default", rope_theta=10000.0):
+                               compute_dtype="default", rope_theta=10000.0,
+                               use_pallas=None):
     """Paged-KV-cache attention for a mixed prefill/decode batch.
 
     qkv [token_num, (H + 2·KV)·hd] packed by cu_seqlens_q; key_cache /
@@ -429,6 +468,25 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     H = qkv.shape[1] // hd - 2 * KV
     max_kv = max_blocks * bs
 
+    # ---- pallas dispatch (static, resolved at trace time):
+    #   None     -> FLAGS_serving_pallas_attention, gated on available()
+    #               (real TPU) and supported() (geometry)
+    #   True     -> force the kernel (interpret mode off-TPU; how CPU CI
+    #               exercises it bit-for-bit)
+    #   "decode" -> force, with the decode-specialized max_q=1 launch; the
+    #               CALLER guarantees every seq_lens_this_time <= 1
+    #   False    -> stock XLA path
+    from ..pallas import paged_attention as PA
+    if use_pallas is None:
+        use_pallas = (bool(flags.flag_value("serving_pallas_attention"))
+                      and PA.available()
+                      and PA.supported(H, KV, hd, bs))
+    if use_pallas and not PA.supported(H, KV, hd, bs):
+        raise ValueError(
+            f"use_pallas={use_pallas!r} forced but geometry H={H} KV={KV} "
+            f"hd={hd} block_size={bs} is not supported() by the pallas "
+            f"paged-attention kernel")
+
     qkv3 = qkv.reshape(token_num, H + 2 * KV, hd)
     if qkv_bias is not None:
         qkv3 = qkv3 + qkv_bias.reshape(1, H + 2 * KV, hd).astype(qkv3.dtype)
@@ -495,6 +553,31 @@ def block_multihead_attention_(qkv, key_cache, value_cache, seq_lens_encoder,
     vc = jnp.where(written, v_new, vc)
     key_cache_out = kc.reshape(num_blocks, bs, KV, hd).transpose(0, 2, 1, 3)
     value_cache_out = vc.reshape(num_blocks, bs, KV, hd).transpose(0, 2, 1, 3)
+
+    if use_pallas:
+        # ---- pallas read: pack q per sequence into [B, KV, max_q*G, hd]
+        # rows (row r = t*G + g) and let the kernel walk the block table —
+        # no dense gather ever exists. The freshly written caches go in
+        # untouched pool layout; int8 pages ride with their scale planes.
+        G = H // KV
+        maxq = 1 if use_pallas == "decode" else token_num
+        q_g = q_tok.reshape(token_num, KV, G, hd)            # head h = kv*G+g
+        t_off = jnp.arange(maxq, dtype=jnp.int32)
+        row_tok = jnp.clip(cu[:B, None] + t_off[None, :], 0, token_num - 1)
+        q_pack = q_g[row_tok]                                # [B, maxq, KV, G, hd]
+        q_pack = q_pack.transpose(0, 2, 1, 3, 4).reshape(B, KV, maxq * G, hd)
+        o_pack = PA.paged_attention(
+            q_pack, key_cache_out, value_cache_out, block_tables,
+            past, this, G, float(1.0 / np.sqrt(hd)),
+            k_dequant=cache_k_dequant_scales if kv_quant else None,
+            v_dequant=cache_v_dequant_scales if kv_quant else None)
+        o_pack = o_pack.reshape(B, KV, maxq, G, hd).transpose(0, 2, 1, 3, 4)
+        o = o_pack[tok_b, jnp.minimum(tok_local, maxq - 1)]  # [tok, KV, G, hd]
+        o = jnp.where(tok_valid[:, None, None, None],
+                      o.astype(jnp.float32), 0.0)
+        fmha_out = o.astype(qkv.dtype).reshape(token_num, H * hd)
+        return (fmha_out, qkv3.reshape(token_num, -1),
+                key_cache_out, value_cache_out)
 
     # ---- attention: gather each row's pages into a dense [B, max_kv] view
     rows_k = kc.reshape(num_blocks, bs, KV, hd)[block_tables]  # [B, mb, bs, KV, hd]

@@ -7,3 +7,4 @@ context parallelism), MoE dispatch, fused rotary/rmsnorm. Everything else
 stays on the XLA emission path.
 """
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

@@ -419,3 +419,72 @@ class TestFusedMultiTransformer:
         np.testing.assert_allclose(np.asarray(out)[:100],
                                    np.asarray(ref)[0].transpose(1, 0, 2),
                                    rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# entry-validation contract: unsupported args rejected before any compute
+# ---------------------------------------------------------------------------
+
+class TestEntryValidation:
+    def _varlen(self, total=12, H=2, hd=16):
+        rs = np.random.RandomState(11)
+        q = jnp.asarray(rs.randn(total, H, hd).astype(np.float32))
+        cu = jnp.asarray(np.array([0, 5, total], np.int32))
+        return q, cu
+
+    def test_unpadded_attn_mask_rejected_at_entry(self):
+        """The attn_mask rejection must fire immediately on BOTH routing
+        paths (it used to raise only after the fallback SDPA had run)."""
+        q, cu = self._varlen()
+        with pytest.raises(NotImplementedError, match="attn_mask"):
+            SA.flash_attn_unpadded.__wrapped__(
+                q, q, q, cu, cu, attn_mask=jnp.zeros((1, 1, 12, 12)))
+        # pallas-aligned shape rejects identically
+        q2, cu2 = self._varlen(total=256, H=4, hd=64)
+        with pytest.raises(NotImplementedError, match="attn_mask"):
+            SA.flash_attn_unpadded.__wrapped__(
+                q2, q2, q2, cu2, cu2, causal=True,
+                attn_mask=jnp.zeros((1, 1, 256, 256)))
+
+    def test_unpadded_dropout_rejected_at_entry(self):
+        q, cu = self._varlen()
+        with pytest.raises(NotImplementedError, match="dropout"):
+            SA.flash_attn_unpadded.__wrapped__(q, q, q, cu, cu, dropout=0.1)
+        # is_test=True disables dropout: accepted
+        out, _, _, _ = SA.flash_attn_unpadded.__wrapped__(
+            q, q, q, cu, cu, dropout=0.1, is_test=True)
+        assert out.shape == q.shape
+
+    def test_qkvpacked_inherits_entry_rejection(self):
+        rs = np.random.RandomState(12)
+        qkv = jnp.asarray(rs.randn(12, 4, 2, 16).astype(np.float32))
+        cu = jnp.asarray(np.array([0, 5, 12], np.int32))
+        with pytest.raises(NotImplementedError, match="attn_mask"):
+            SA.flash_attn_varlen_qkvpacked.__wrapped__(
+                qkv, cu, cu, attn_mask=jnp.zeros((1, 1, 12, 12)))
+
+    def test_varlen_mea_bad_gqa_rejected(self):
+        rs = np.random.RandomState(13)
+        q = jnp.asarray(rs.randn(1, 4, 6, 16).astype(np.float32))
+        kv = jnp.asarray(rs.randn(1, 3, 6, 16).astype(np.float32))
+        lens = jnp.asarray(np.array([6], np.int32))
+        with pytest.raises(ValueError, match="H % KV"):
+            SA.variable_length_memory_efficient_attention.__wrapped__(
+                q, kv, kv, lens, lens)
+
+    def test_varlen_mea_pre_cache_needs_causal(self):
+        rs = np.random.RandomState(14)
+        q = jnp.asarray(rs.randn(1, 2, 4, 16).astype(np.float32))
+        kv = jnp.asarray(rs.randn(1, 2, 10, 16).astype(np.float32))
+        ql = jnp.asarray(np.array([4], np.int32))
+        kl = jnp.asarray(np.array([10], np.int32))
+        with pytest.raises(NotImplementedError, match="pre_cache_length"):
+            SA.variable_length_memory_efficient_attention.__wrapped__(
+                q, kv, kv, ql, kl, causal=False, pre_cache_length=6)
+        with pytest.raises(ValueError, match=">= 0"):
+            SA.variable_length_memory_efficient_attention.__wrapped__(
+                q, kv, kv, ql, kl, causal=True, pre_cache_length=-1)
+        # the supported form still computes
+        out = SA.variable_length_memory_efficient_attention.__wrapped__(
+            q, kv, kv, ql, kl, causal=True, pre_cache_length=6)
+        assert out.shape == q.shape

@@ -492,3 +492,251 @@ class TestServingObservability:
             eng.run()                             # next tick recovers
         finally:
             chaos.reconfigure("")
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged-attention kernel (ops/pallas/paged_attention.py)
+# ---------------------------------------------------------------------------
+
+def _mha_args(past, this, KV=2, G=2, hd=8, bs=8, mb=4, nb=24, quant=False,
+              seed=0, shared_first_page=False):
+    """Build block_multihead_attention_ inputs for a ragged batch. With
+    shared_first_page, every sequence's table entry 0 points at the SAME
+    physical page (the COW/prefix-cache layout after a shared-prefix
+    admission)."""
+    rs = np.random.RandomState(seed)
+    H = KV * G
+    B = len(this)
+    tok = sum(this)
+    cu = np.zeros(B + 1, np.int32)
+    cu[1:] = np.cumsum(this)
+    tables = np.full((B, mb), -1, np.int32)
+    used = 1 if shared_first_page else 0
+    for b in range(B):
+        need = -(-max(past[b] + this[b], 0) // bs)
+        for p in range(need):
+            if shared_first_page and p == 0:
+                tables[b, 0] = 0
+                continue
+            tables[b, p] = used
+            used += 1
+    assert used <= nb
+    qkv = rs.randn(max(tok, 1), (H + 2 * KV) * hd).astype(np.float32)
+    if quant:
+        kc = rs.randint(-127, 128, (nb, KV, bs, hd)).astype(np.int8)
+        vc = rs.randint(-127, 128, (nb, KV, bs, hd)).astype(np.int8)
+        kq = rs.uniform(20, 60, (KV,)).astype(np.float32)
+        vq = rs.uniform(20, 60, (KV,)).astype(np.float32)
+        scales = dict(
+            cache_k_quant_scales=jnp.asarray(kq),
+            cache_v_quant_scales=jnp.asarray(vq),
+            cache_k_dequant_scales=jnp.asarray(
+                np.broadcast_to(1.0 / kq, (nb, KV)).copy()),
+            cache_v_dequant_scales=jnp.asarray(
+                np.broadcast_to(1.0 / vq, (nb, KV)).copy()))
+    else:
+        kc = rs.randn(nb, KV, bs, hd).astype(np.float32)
+        vc = rs.randn(nb, KV, bs, hd).astype(np.float32)
+        scales = {}
+    return dict(qkv=jnp.asarray(qkv), key_cache=jnp.asarray(kc),
+                value_cache=jnp.asarray(vc),
+                seq_lens_encoder=jnp.zeros(B, jnp.int32),
+                seq_lens_decoder=jnp.asarray(past, np.int32),
+                seq_lens_this_time=jnp.asarray(this, np.int32),
+                cu_seqlens_q=jnp.asarray(cu),
+                block_tables=jnp.asarray(tables), block_size=bs, **scales)
+
+
+def _mha_both(args, pallas_mode=True):
+    from paddle_tpu.ops.kernels.serving_attention import (
+        block_multihead_attention_)
+    stock = block_multihead_attention_.__wrapped__(use_pallas=False, **args)
+    pal = block_multihead_attention_.__wrapped__(use_pallas=pallas_mode,
+                                                 **args)
+    return stock, pal
+
+
+class TestPallasPagedAttention:
+    def test_supported_gates(self):
+        from paddle_tpu.ops.pallas import paged_attention as PA
+        assert PA.supported(4, 2, 64, 16)
+        assert PA.supported(4, 4, 8, 1)          # MHA, minimum geometry
+        assert not PA.supported(4, 3, 64, 16)    # H % KV != 0
+        assert not PA.supported(4, 0, 64, 16)    # no kv heads
+        assert not PA.supported(4, 2, 4, 16)     # head_dim floor
+        assert not PA.supported(4, 2, 64, 0)     # degenerate page
+
+    @pytest.mark.parametrize("bs", [8, 16])
+    def test_parity_across_page_sizes(self, bs):
+        """Interpret-mode kernel vs stock XLA on a ragged mixed batch:
+        chunked prefill resume (past>0), fresh prefill, decode rows."""
+        args = _mha_args(past=[8, 0, 15], this=[5, 9, 1], bs=bs, mb=4,
+                         nb=24, seed=1)
+        stock, pal = _mha_both(args)
+        np.testing.assert_allclose(np.asarray(pal[0]), np.asarray(stock[0]),
+                                   atol=5e-5, rtol=1e-5)
+        # cache writes are SHARED code, identical bit-for-bit
+        assert np.array_equal(np.asarray(pal[2]), np.asarray(stock[2]))
+        assert np.array_equal(np.asarray(pal[3]), np.asarray(stock[3]))
+
+    def test_parity_ragged_with_idle_slot(self):
+        args = _mha_args(past=[3, 0, 7, 0], this=[2, 0, 1, 4], seed=2)
+        stock, pal = _mha_both(args)
+        np.testing.assert_allclose(np.asarray(pal[0]), np.asarray(stock[0]),
+                                   atol=5e-5, rtol=1e-5)
+
+    def test_decode_mode_parity(self):
+        """The max_q=1 specialized launch on a pure-decode batch."""
+        args = _mha_args(past=[7, 0, 30, 12], this=[1, 1, 1, 1], seed=3)
+        stock, pal = _mha_both(args, pallas_mode="decode")
+        np.testing.assert_allclose(np.asarray(pal[0]), np.asarray(stock[0]),
+                                   atol=5e-5, rtol=1e-5)
+
+    def test_cow_shared_pages_parity(self):
+        """Two sequences reading the SAME physical first page (prefix-cache
+        sharing): the in-kernel table walk must dereference the shared
+        block for both without cross-talk."""
+        args = _mha_args(past=[8, 8, 8], this=[1, 3, 1],
+                         shared_first_page=True, seed=4)
+        stock, pal = _mha_both(args)
+        np.testing.assert_allclose(np.asarray(pal[0]), np.asarray(stock[0]),
+                                   atol=5e-5, rtol=1e-5)
+
+    def test_int8_pages_partial_last_page(self):
+        """In-register dequant with ragged lengths mid-page (partial last
+        pages on every sequence)."""
+        args = _mha_args(past=[10, 0, 33], this=[1, 13, 1], KV=2, G=3,
+                         hd=16, bs=16, quant=True, seed=5)
+        stock, pal = _mha_both(args)
+        np.testing.assert_allclose(np.asarray(pal[0]), np.asarray(stock[0]),
+                                   atol=5e-5, rtol=1e-5)
+        assert np.asarray(pal[2]).dtype == np.int8
+
+    def test_forced_bad_geometry_raises(self):
+        args = _mha_args(past=[0], this=[2], KV=1, G=2, hd=4, seed=6)
+        with pytest.raises(ValueError, match="not supported"):
+            _mha_both(args)
+
+    def test_kernel_rejects_one_sided_dequant(self):
+        from paddle_tpu.ops.pallas import paged_attention as PA
+        q = jnp.zeros((1, 1, 2, 8), jnp.float32)
+        kc = jnp.zeros((2, 1, 8, 8), jnp.float32)
+        bt = jnp.zeros((1, 2), jnp.int32)
+        z = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(ValueError, match="both"):
+            PA.paged_attention(q, kc, kc, bt, z, z, 2, 1.0,
+                               k_dequant=jnp.ones((2, 1)))
+
+    def test_pad_rows_come_back_zero(self):
+        from paddle_tpu.ops.pallas import paged_attention as PA
+        rs = np.random.RandomState(8)
+        q = jnp.asarray(rs.randn(2, 1, 8, 8).astype(np.float32))
+        kc = jnp.asarray(rs.randn(4, 1, 8, 8).astype(np.float32))
+        vc = jnp.asarray(rs.randn(4, 1, 8, 8).astype(np.float32))
+        bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        past = jnp.asarray([3, 0], jnp.int32)
+        this = jnp.asarray([1, 2], jnp.int32)   # rows 2..7 of seq 0 dead
+        o = np.asarray(PA.paged_attention(q, kc, vc, bt, past, this,
+                                          2, 0.35, interpret=True))
+        assert np.all(o[0, :, 2:] == 0.0)       # t >= this[0]
+        assert np.all(o[1, :, 4:] == 0.0)       # t >= this[1]
+        assert np.all(o[0, :, :2] != 0.0)
+
+
+class TestEnginePallas:
+    def _engine(self, tiny, pallas, **kw):
+        cfg, params = tiny
+        defaults = dict(num_blocks=48, block_size=4, max_batch=4,
+                        token_budget=16)
+        defaults.update(kw)
+        return PagedServingEngine(cfg, params, pallas=pallas, **defaults)
+
+    def test_token_parity_flag_on_vs_off(self, tiny):
+        prompts = _prompts(tiny[0], 4, [7, 2, 13, 5], seed=21)
+
+        def run(pallas):
+            eng = self._engine(tiny, pallas)
+            rids = [eng.submit(p, max_new_tokens=9) for p in prompts]
+            done = {c.rid: c.output_tokens for c in eng.run()}
+            return [done[r] for r in rids], eng.stats
+
+        off, s_off = run(False)
+        on, s_on = run(True)
+        assert on == off
+        assert s_on["pallas_steps"] == s_on["steps"] > 0
+        assert s_off["pallas_steps"] == 0
+
+    def test_preemption_recompute_bit_exact_flag_on(self, tiny):
+        """Starved pool forces eviction; the pallas path's recompute on
+        resume must reproduce the ample-pool pallas outputs exactly."""
+        prompts = _prompts(tiny[0], 3, [6, 4, 3], seed=22)
+
+        def run(num_blocks, max_batch):
+            eng = self._engine(tiny, True, num_blocks=num_blocks,
+                               max_batch=max_batch)
+            rids = [eng.submit(p, max_new_tokens=10, priority=i)
+                    for i, p in enumerate(prompts)]
+            done = {c.rid: c.output_tokens for c in eng.run()}
+            return [done[r] for r in rids], eng
+
+        ample, _ = run(48, 3)
+        starved, eng = run(6, 3)
+        assert eng.scheduler.stats["preemptions"] >= 1
+        assert starved == ample
+
+    def test_zero_steady_state_retraces_and_decode_fast_path(self, tiny):
+        eng = self._engine(tiny, True)
+        prompts = _prompts(tiny[0], 3, [5, 3, 8], seed=23)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=6)
+        eng.run()                                 # warm: builds happen here
+        builds = eng.stats["step_builds"]
+        assert builds <= 2                        # mixed + decode launches
+        for p in prompts:
+            eng.submit(p, max_new_tokens=6)
+        eng.run()
+        assert eng.stats["step_builds"] == builds  # steady state: zero
+        assert eng.stats["decode_fast_steps"] > 0
+        assert eng.stats["pallas_steps"] == eng.stats["steps"]
+
+    def test_flag_driven_falls_back_off_tpu(self, tiny):
+        """FLAGS_serving_pallas_attention on a host without the TPU kernel
+        path serves stock and counts the fallback reason."""
+        from paddle_tpu.core import flags
+        from paddle_tpu.ops.pallas import paged_attention as PA
+        if PA.available():
+            pytest.skip("real TPU: flag-driven mode would engage")
+        obs.reset()
+        flags.set_flags({"serving_pallas_attention": True})
+        try:
+            eng = self._engine(tiny, None)
+            eng.submit(_prompts(tiny[0], 1, [5], seed=24)[0],
+                       max_new_tokens=3)
+            eng.run()
+            assert eng.stats["pallas_steps"] == 0
+            assert obs.registry().value(
+                "paddle_serving_pallas_fallback_total",
+                {"reason": "unavailable"}) > 0
+            assert obs.summary()["serving"]["pallas_fallbacks"] > 0
+        finally:
+            flags.set_flags({"serving_pallas_attention": False})
+
+    def test_forced_bad_geometry_fails_at_init(self):
+        # head_dim 16/4 = 4 is under the kernel's floor: forced pallas
+        # must fail loudly at construction, not mid-serve
+        cfg = L.LlamaConfig(vocab_size=31, hidden_size=16,
+                            intermediate_size=32, num_layers=1, num_heads=4,
+                            num_kv_heads=2, max_seq_len=32,
+                            dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(1))
+        with pytest.raises(ValueError, match="not supported"):
+            PagedServingEngine(cfg, params, num_blocks=8, block_size=4,
+                               max_batch=2, token_budget=8, pallas=True)
+
+    def test_pallas_steps_flow_to_summary(self, tiny):
+        obs.reset()
+        eng = self._engine(tiny, True)
+        eng.submit(_prompts(tiny[0], 1, [6], seed=25)[0], max_new_tokens=4)
+        eng.run()
+        s = obs.summary()["serving"]
+        assert s["pallas_steps"] == eng.stats["pallas_steps"] > 0

@@ -145,6 +145,35 @@ def test_pragma_on_line_above_and_with_anchor(tmp_path):
     assert not [f for f in findings if f.rule in ("TPL001", "TPL003")]
 
 
+def test_pallas_kernels_are_walked(tmp_path):
+    # kernel bodies handed to pl.pallas_call are traced entries for TPL001,
+    # both as a bare name and through the functools.partial(config) idiom
+    src = {
+        "pk.py": """
+        import functools
+        import time
+
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, *, scale):
+            o_ref[...] = x_ref[...] * scale * time.time()
+
+        def _direct(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * time.perf_counter()
+
+        def run(x):
+            kernel = functools.partial(_kernel, scale=2.0)
+            y = pl.pallas_call(kernel, out_shape=x)(x)
+            return pl.pallas_call(_direct, out_shape=y)(y)
+        """,
+    }
+    findings = [f for f in _run(_write_fixture_repo(tmp_path, src))
+                if f.rule == "TPL001"]
+    tags = {f.tag for f in findings}
+    assert "clock:time.time" in tags, findings           # partial indirection
+    assert "clock:time.perf_counter" in tags, findings   # direct kernel name
+
+
 def test_baseline_round_trip(fixture_repo, tmp_path):
     baseline_path = tmp_path / "baseline.json"
     findings = _run(fixture_repo)

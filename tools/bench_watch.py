@@ -252,6 +252,7 @@ def maybe_serving_smoke(min_interval: float = 3600.0) -> None:
             f"paged={payload.get('paged_tokens_per_s')}tok/s "
             f"dense={payload.get('dense_tokens_per_s')}tok/s "
             f"ratio={payload.get('throughput_ratio')} "
+            f"pallas_ratio={payload.get('pallas_throughput_ratio')} "
             f"ttft={payload.get('paged_ttft_ms')}ms)")
         return
     failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
@@ -339,7 +340,8 @@ def maybe_quant_smoke(min_interval: float = 3600.0) -> None:
         log(f"quant smoke GREEN ({payload.get('wall_s')}s: "
             f"logit_rel={payload.get('logit_rel_err_w8')}, "
             f"agreement={payload.get('token_agreement_vs_fp')}, "
-            f"kv_capacity={payload.get('kv_capacity_ratio')}x)")
+            f"kv_capacity={payload.get('kv_capacity_ratio')}x, "
+            f"pallas_ratio={payload.get('pallas_throughput_ratio')})")
         return
     failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
     detail = (", ".join(failed) if failed

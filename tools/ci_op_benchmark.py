@@ -153,6 +153,66 @@ def _basket():
         b = _par._Bucket(0, pack_ps, nranks=1, comm_dtype=None)
         return _par._make_pack(b)(pack_arrs)
 
+    # pallas-vs-stock paged attention (fusion-paper methodology: measure
+    # what XLA already does before owning a kernel). Fixed tiny serving
+    # shapes — B=4 slots, 2 kv heads x group 2, hd=32, 16-token pages.
+    # Pallas entries run interpret mode on CPU (keyed per-platform, so the
+    # CPU pin gates interpret overhead and a TPU pin gates the real
+    # kernel); decode uses the max_q=1 specialized launch.
+    def _blk_mha(this, past, quant=False, use_pallas=False):
+        KVh, G, hd, bs, mb, nb = 2, 2, 32, 16, 4, 24
+        H = KVh * G
+        Bb = len(this)
+        tok = sum(this)
+        cu = np.zeros(Bb + 1, np.int32)
+        cu[1:] = np.cumsum(this)
+        tables = np.full((Bb, mb), -1, np.int32)
+        used = 0
+        for i in range(Bb):
+            for p_ in range(-(-(past[i] + this[i]) // bs)):
+                tables[i, p_] = used
+                used += 1
+        qkv_in = jnp.asarray(RS.randn(tok, (H + 2 * KVh) * hd)
+                             .astype(np.float32))
+        if quant:
+            kc = jnp.asarray(RS.randint(-127, 128, (nb, KVh, bs, hd))
+                             .astype(np.int8))
+            vc = jnp.asarray(RS.randint(-127, 128, (nb, KVh, bs, hd))
+                             .astype(np.int8))
+            kq = jnp.full((KVh,), 42.3, jnp.float32)
+            vq = jnp.full((KVh,), 37.1, jnp.float32)
+            scales = dict(cache_k_quant_scales=kq, cache_v_quant_scales=vq,
+                          cache_k_dequant_scales=jnp.broadcast_to(
+                              1.0 / kq, (nb, KVh)),
+                          cache_v_dequant_scales=jnp.broadcast_to(
+                              1.0 / vq, (nb, KVh)))
+        else:
+            kc = jnp.asarray(RS.randn(nb, KVh, bs, hd).astype(np.float32))
+            vc = jnp.asarray(RS.randn(nb, KVh, bs, hd).astype(np.float32))
+            scales = {}
+        fixed = dict(cu_seqlens_q=jnp.asarray(cu),
+                     block_tables=jnp.asarray(tables), block_size=bs,
+                     use_pallas=use_pallas, **scales)
+        zb = jnp.zeros(Bb, jnp.int32)
+        past_a = jnp.asarray(past, np.int32)
+        this_a = jnp.asarray(this, np.int32)
+        blk = K["block_multihead_attention_"]
+        return lambda: blk(qkv_in, kc, vc, zb, past_a, this_a, **fixed)
+
+    PRE, DEC = ([16, 16, 16, 16], [0, 0, 0, 0]), ([1, 1, 1, 1], [31, 17, 9, 40])
+    MIX = ([16, 1, 1, 8], [0, 12, 30, 16])
+    blk_entries = {
+        "block_mha_prefill_stock": _blk_mha(*PRE),
+        "block_mha_prefill_pallas": _blk_mha(*PRE, use_pallas=True),
+        "block_mha_decode_stock": _blk_mha(*DEC),
+        "block_mha_decode_pallas": _blk_mha(*DEC, use_pallas="decode"),
+        "block_mha_mixed_stock": _blk_mha(*MIX),
+        "block_mha_mixed_pallas": _blk_mha(*MIX, use_pallas=True),
+        "block_mha_int8_stock": _blk_mha(*DEC, quant=True),
+        "block_mha_int8_pallas": _blk_mha(*DEC, quant=True,
+                                          use_pallas="decode"),
+    }
+
     # eager entries run the PUBLIC api (dispatch + tape), not raw kernels;
     # they are marked so measure() skips jitting them
     eager = {
@@ -175,6 +235,7 @@ def _basket():
         "segment_sum": lambda: K["segment_pool"](seg_x, seg_id, "SUM", 64),
         "reduce_sum": lambda: K["sum"](img),
         "topk": lambda: K["topk"](a, 8),
+        **blk_entries,
     }
     return eager, jitted
 

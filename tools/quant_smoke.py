@@ -126,6 +126,25 @@ def run() -> dict:
     builds_timed = q_eng.stats["step_builds"] - builds_warm
     prefix_hit = q_eng.blocks.stats["prefix_hit_tokens"] - hits0
 
+    # pallas leg: int8 pages read through the paged-attention kernel
+    # (in-register dequant; interpret mode on CPU, real kernel on TPU).
+    # Token parity with the stock quant engine gates everywhere; the
+    # throughput ratio only REDs where the flag would actually enable the
+    # kernel (available() == real TPU).
+    from paddle_tpu.ops.pallas import paged_attention as PA
+    p_eng = _engine(cfg, params, manifest, num_blocks=160,
+                    quant_mode="w8", quant_kv=True, pallas=True)
+    p_out = _run_trace(p_eng, prompts)        # warm
+    p_builds_warm = p_eng.stats["step_builds"]
+    pallas_tps = 0.0
+    for _ in range(TIMED_REPEATS):
+        t0 = time.perf_counter()
+        p_out = _run_trace(p_eng, prompts)
+        wall = time.perf_counter() - t0
+        pallas_tps = max(pallas_tps, N_REQS * NEW_TOKENS / wall)
+    p_builds_timed = p_eng.stats["step_builds"] - p_builds_warm
+    pallas_ratio = pallas_tps / best_tps if best_tps else None
+
     # forced preemption on a starved pool must reproduce bit-for-bit
     tight = _engine(cfg, params, manifest, num_blocks=14,
                     quant_mode="w8", quant_kv=True)
@@ -143,6 +162,10 @@ def run() -> dict:
                                  and tight_out == q_out),
         "zero_retraces_steady_state": builds_timed == 0,
         "prefix_cache_served": prefix_hit > 0,
+        "pallas_parity": p_out == q_out,
+        "pallas_zero_retraces": p_builds_timed == 0,
+        "pallas_not_slower_when_enabled": bool(
+            not PA.available() or (pallas_ratio or 0.0) >= 1.0),
     }
     return {
         "ok": all(checks.values()),
@@ -159,6 +182,12 @@ def run() -> dict:
         "quant_tokens_per_s": round(best_tps, 1),
         "prefix_hit_tokens_timed": prefix_hit,
         "step_builds_timed": builds_timed,
+        "pallas_tokens_per_s": round(pallas_tps, 1),
+        "pallas_throughput_ratio": round(pallas_ratio, 3)
+        if pallas_ratio is not None else None,
+        "pallas_available": PA.available(),
+        "pallas_steps": p_eng.stats["pallas_steps"],
+        "pallas_decode_fast_steps": p_eng.stats["decode_fast_steps"],
     }
 
 

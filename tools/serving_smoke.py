@@ -77,7 +77,7 @@ def _run_dense(cfg, params, prompts):
     return outputs, best_tps, ttft_ms
 
 
-def _run_paged(cfg, params, prompts):
+def _run_paged(cfg, params, prompts, pallas=None):
     from paddle_tpu.inference.serving import PagedServingEngine
 
     # paged memory is why the batch can be wider than the dense engine's
@@ -85,7 +85,7 @@ def _run_paged(cfg, params, prompts):
     # is stored once — the whole trace decodes in one wave
     eng = PagedServingEngine(cfg, params, num_blocks=224, block_size=8,
                              max_batch=N_REQS, token_budget=32,
-                             max_len=cfg.max_seq_len)
+                             max_len=cfg.max_seq_len, pallas=pallas)
     _drain(eng, _submit_all(eng, prompts))            # warm + seed prefix cache
     builds_warm = eng.stats["step_builds"]
     hits0 = eng.blocks.stats["prefix_hit_tokens"]
@@ -105,7 +105,8 @@ def _run_paged(cfg, params, prompts):
                        else min(ttft_ms, ttft * 1e3))
     return (outputs, best_tps, ttft_ms,
             eng.stats["step_builds"] - builds_warm,
-            eng.blocks.stats["prefix_hit_tokens"] - hits0)
+            eng.blocks.stats["prefix_hit_tokens"] - hits0,
+            eng.stats)
 
 
 def run() -> dict:
@@ -122,7 +123,17 @@ def run() -> dict:
 
     dense_out, dense_tps, dense_ttft_ms = _run_dense(cfg, params, prompts)
     (paged_out, paged_tps, paged_ttft_ms,
-     builds_timed, prefix_hit_tokens) = _run_paged(cfg, params, prompts)
+     builds_timed, prefix_hit_tokens, _) = _run_paged(cfg, params, prompts)
+
+    # pallas leg: forced through the paged-attention kernel (interpret
+    # mode on CPU, real kernel on TPU). Token parity is gated everywhere;
+    # the throughput ratio only REDs where the flag would actually enable
+    # the kernel (available() == real TPU) — interpret-mode timing on CPU
+    # is an emulation artifact, reported for trend only.
+    from paddle_tpu.ops.pallas import paged_attention as PA
+    (pallas_out, pallas_tps, _, pallas_builds_timed, _,
+     pallas_stats) = _run_paged(cfg, params, prompts, pallas=True)
+    pallas_ratio = pallas_tps / paged_tps if paged_tps else None
 
     serving = obs.summary().get("serving", {})
     checks = {
@@ -130,6 +141,10 @@ def run() -> dict:
         "throughput_paged_ge_dense": bool(paged_tps >= dense_tps),
         "zero_retraces_steady_state": builds_timed == 0,
         "prefix_cache_served": prefix_hit_tokens > 0,
+        "pallas_parity": pallas_out == paged_out,
+        "pallas_zero_retraces": pallas_builds_timed == 0,
+        "pallas_not_slower_when_enabled": bool(
+            not PA.available() or (pallas_ratio or 0.0) >= 1.0),
     }
     return {
         "ok": all(checks.values()),
@@ -147,6 +162,12 @@ def run() -> dict:
         if dense_ttft_ms is not None else None,
         "prefix_hit_tokens_timed": prefix_hit_tokens,
         "step_builds_timed": builds_timed,
+        "pallas_tokens_per_s": round(pallas_tps, 1),
+        "pallas_throughput_ratio": round(pallas_ratio, 3)
+        if pallas_ratio is not None else None,
+        "pallas_available": PA.available(),
+        "pallas_steps": pallas_stats["pallas_steps"],
+        "pallas_decode_fast_steps": pallas_stats["decode_fast_steps"],
         "ttft_p50_s": serving.get("ttft_p50_s"),
         "tpot_p50_s": serving.get("tpot_p50_s"),
     }
