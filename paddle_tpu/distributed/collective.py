@@ -342,6 +342,10 @@ def _reduce_term(x, axis, op):
 _SHARD_FNS = {
     "all_reduce": lambda x, ax, n, op: _reduce_term(x, ax, op),
     "all_gather": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=False),
+    # quantized-gradient gather (quant_comm int8 wire): all_gather
+    # semantics under a distinct name so chaos/watchdog drills can
+    # target the quantized collective specifically
+    "q8_gather": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=False),
     "all_gather_tiled": lambda x, ax, n: lax.all_gather(x, ax, axis=0, tiled=True),
     "reduce_scatter": lambda x, ax, n: lax.psum_scatter(
         x, ax, scatter_dimension=0, tiled=True),
@@ -356,6 +360,7 @@ _SHARD_FNS = {
 _OUT_SPEC = {
     "all_reduce": lambda ax: P(ax),
     "all_gather": lambda ax: P(),            # gathered: replicated full copy
+    "q8_gather": lambda ax: P(),
     "all_gather_tiled": lambda ax: P(),
     "reduce_scatter": lambda ax: P(ax),
     "reduce_scatter_avg": lambda ax: P(ax),
@@ -609,7 +614,7 @@ def _replicated(fn_name, x, g, **kw):
         if fn_name == "reduce_scatter" and n > 1:
             return x * n  # sum of n identical shards... caller keeps full
         return x  # AVG of identical shards is identity; caller keeps full
-    if fn_name == "all_gather":
+    if fn_name in ("all_gather", "q8_gather"):
         return jnp.stack([x] * n, axis=0) if n > 1 else x[None]
     raise ValueError(fn_name)
 

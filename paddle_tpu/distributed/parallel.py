@@ -46,6 +46,7 @@ from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
 from ..observability import emit as _obs_emit
 from . import collective as coll
+from . import quant_comm as _qc
 from .comm_watchdog import comm_task
 from .env import get_rank, get_world_size
 
@@ -63,10 +64,13 @@ flags.define_flag("dp_shard_update", False,
 flags.define_flag("dp_grad_comm_dtype", "",
                   "Wire dtype for DP gradient collectives: '' keeps the "
                   "param dtype; 'bfloat16'/'bf16' or 'float16'/'fp16' "
-                  "compress the reduce, unpacking casts back")
+                  "compress the reduce, unpacking casts back; 'int8' "
+                  "selects the block-scaled codec with error feedback "
+                  "(quant_comm.py, FLAGS_dp_comm_block_size)")
 
 _COMM_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
-                "fp16": "float16", "float16": "float16"}
+                "fp16": "float16", "float16": "float16",
+                "int8": "int8"}
 
 
 def _comm_dtype_name() -> Optional[str]:
@@ -75,8 +79,8 @@ def _comm_dtype_name() -> Optional[str]:
         return None
     if raw not in _COMM_DTYPES:
         raise ValueError(
-            f"FLAGS_dp_grad_comm_dtype={raw!r}: want '', 'bfloat16' or "
-            "'float16'")
+            f"FLAGS_dp_grad_comm_dtype={raw!r}: want '', 'bfloat16', "
+            "'float16' or 'int8'")
     return _COMM_DTYPES[raw]
 
 
@@ -138,6 +142,10 @@ def sync_params_buffers(model: Layer, comm_group: Optional[coll.Group] = None,
 class _Bucket:
     __slots__ = ("index", "params", "shapes", "sizes", "offsets", "numel",
                  "padded", "dtype", "comm_dtype", "lr_mult", "nbytes",
+                 # block-scaled int8 wire (quant_comm): geometry,
+                 # executables, error-feedback carry
+                 "qblock", "qblocks", "qpadded", "qpack", "qdecode",
+                 "residual",
                  # lazily built jitted executables
                  "pack", "unpack_grads", "pack_params", "unpack_params",
                  # per-step reducer state
@@ -162,7 +170,20 @@ class _Bucket:
         self.comm_dtype = comm_dtype or self.dtype
         self.lr_mult = float(getattr(params[0], "optimize_attr", {})
                              .get("learning_rate", 1.0))
-        self.nbytes = self.padded * np.dtype(self.comm_dtype).itemsize
+        if self.comm_dtype == "int8":
+            # Block-scaled wire: quantize the nranks-aligned buffer, pad
+            # up to whole blocks; nbytes is the actual on-wire size
+            # (payload + one f32 scale per block).
+            self.qblock = _qc.block_size()
+            self.qpadded, self.qblocks, qwire = _qc.wire_layout(
+                self.padded, self.qblock)
+            self.nbytes = qwire
+        else:
+            self.qblock = self.qblocks = self.qpadded = 0
+            self.nbytes = self.padded * np.dtype(self.comm_dtype).itemsize
+        self.qpack = None
+        self.qdecode = None
+        self.residual = None
         self.pack = None
         self.unpack_grads = None
         self.pack_params = None
@@ -203,7 +224,8 @@ def _plan_signature(params, group, comm_mb, last_mb, comm_dtype):
                    float(getattr(p, "optimize_attr", {})
                          .get("learning_rate", 1.0)))
                   for p in params),
-            gid, nranks, float(comm_mb), float(last_mb), comm_dtype or "")
+            gid, nranks, float(comm_mb), float(last_mb), comm_dtype or "",
+            _qc.block_size() if comm_dtype == "int8" else 0)
 
 
 def _build_plan(params, group, comm_mb, last_mb, comm_dtype,
@@ -421,6 +443,7 @@ class _Reducer:
                 b.task = None
                 b.out_ref = None
                 b.flat_grad = None
+                b.residual = None
         self._plan = None
 
     def shard_active(self) -> bool:
@@ -458,6 +481,9 @@ class _Reducer:
         (barrier mode / stragglers)."""
         g = self._group
         shard = self.shard_active()
+        if b.comm_dtype == "int8":
+            self._issue_q8(b, g, shard)
+            return
         if b.pack is None:
             b.pack = _make_pack(b)
             _obs_emit("dp.pack_build", bucket=b.index)
@@ -471,6 +497,9 @@ class _Reducer:
         with comm_task(f"dp:{fn}:bucket{b.index}", getattr(g, "id", 0),
                        rank, (b.padded,), b.comm_dtype):
             out, task = coll._run(g, fn, flat, **kw)
+        _obs_emit("dp.wire", bytes=b.nbytes, dtype=b.comm_dtype,
+                  ref_bytes=b.padded * np.dtype(b.dtype).itemsize,
+                  bucket=b.index)
         if shard:
             mesh = getattr(g, "_mesh", None)
             if (mesh is not None
@@ -490,6 +519,69 @@ class _Reducer:
             for p, o in zip(b.params, outs):
                 p._grad = o
         b.out_ref = out
+        b.task = task
+        b.issued = True
+        b.ready.clear()
+        self._outstanding.append(b)
+
+    def _issue_q8(self, b: _Bucket, g, shard: bool):
+        """Block-scaled int8 wire (quant_comm, EQuARX arXiv 2506.17615):
+        error-feedback pack -> one ``q8_gather`` of the int8 buffer ->
+        mean-of-dequants decode. The residual carries this step's
+        quantization error into the next step's grads; under ``no_sync``
+        accumulation the codec runs once on the summed total, so k-step
+        accumulation is bit-exact vs quantizing the accumulated grads."""
+        if b.qpack is None:
+            b.qpack = _qc.make_pack_q8(b)
+            b.qdecode = _qc.make_decode_q8(b)
+            _obs_emit("dp.pack_build", bucket=b.index)
+        if b.residual is None:
+            b.residual = _qc.zeros_residual(b)
+        # the fused pack takes every grad plus the carried residual in one
+        # jit call, so they must share one device set. After the first
+        # sharded step the all-gather leaves weight grads committed
+        # replicated-over-mesh while small bias grads (and the residual)
+        # can still sit on a single device — align the stragglers to the
+        # mesh placement; once the layout settles this is a no-op.
+        shs = [getattr(p._grad, "sharding", None) for p in b.params]
+        target = next((s for s in shs if isinstance(s, NamedSharding)),
+                      shs[0])
+        if target is not None:
+            for p, s in zip(b.params, shs):
+                if s != target:
+                    p._grad = jax.device_put(p._grad, target)
+            if getattr(b.residual, "sharding", None) != target:
+                b.residual = jax.device_put(b.residual, target)
+        wire, b.residual = b.qpack([p._grad for p in b.params], b.residual)
+        _obs_emit("dp.pack_call", bucket=b.index)
+        fn = "q8_gather"
+        b.op = fn
+        b.t_issue = time.perf_counter()
+        rank = max(getattr(g, "rank", 0), 0)
+        with comm_task(f"dp:{fn}:bucket{b.index}", getattr(g, "id", 0),
+                       rank, (b.nbytes,), b.comm_dtype):
+            out, task = coll._run(g, fn, wire)
+        _obs_emit("dp.wire", bytes=b.nbytes, dtype="int8",
+                  ref_bytes=b.padded * np.dtype(b.dtype).itemsize,
+                  bucket=b.index)
+        flat = b.qdecode(out)
+        if shard:
+            mesh = getattr(g, "_mesh", None)
+            if mesh is not None:
+                # ZeRO-1 ownership layout: each rank's shard of the
+                # decoded flat buffer lands on its device
+                flat = jax.device_put(
+                    flat, NamedSharding(mesh, P(g.axis_name)))
+            b.flat_grad = flat
+        else:
+            if b.unpack_grads is None:
+                b.unpack_grads = _make_unpack(b)
+                _obs_emit("dp.pack_build", bucket=b.index)
+            outs = b.unpack_grads(flat)
+            _obs_emit("dp.pack_call", bucket=b.index)
+            for p, o in zip(b.params, outs):
+                p._grad = o
+        b.out_ref = flat
         b.task = task
         b.issued = True
         b.ready.clear()
@@ -752,12 +844,13 @@ class ShardedUpdate:
                     leftover.extend(
                         p for p in b.params if p._grad is not None)
                     continue
-                if b.pack is None:
-                    b.pack = _make_pack(b)
-                fg = b.pack([p._grad for p in b.params])
-                if shard_sh is not None:
-                    fg = jax.device_put(fg, shard_sh)
-                b.flat_grad = fg
+                # pack in the PARAM dtype (pack_params): no wire is
+                # involved here, and the int8 wire codec must never see
+                # this path — casting grads to int8 would truncate them
+                if b.pack_params is None:
+                    b.pack_params = _make_pack_params(b, shard_sh)
+                    _obs_emit("dp.pack_build", bucket=b.index)
+                b.flat_grad = b.pack_params([p._grad for p in b.params])
             if b.flat_param is None or b.out_ids != [
                     id(p._data) for p in b.params]:
                 if b.pack_params is None:

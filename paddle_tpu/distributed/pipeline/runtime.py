@@ -56,6 +56,7 @@ from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from ...observability import emit as _emit
 from ..comm_watchdog import comm_task
+from .. import quant_comm as _qc
 from . import schedule as pschedule
 
 flags.define_flag(
@@ -226,6 +227,17 @@ class _Stage:
         return self._exec[key]
 
 
+class _Wire:
+    """An in-flight encoded P2P buffer (``FLAGS_pp_p2p_comm_dtype``) plus
+    the cached decode executable that restores the stage payload."""
+
+    __slots__ = ("buf", "decode")
+
+    def __init__(self, buf, decode):
+        self.buf = buf
+        self.decode = decode
+
+
 class PipelineEngine:
     """Drives a segmented PipelineLayer across per-stage device groups."""
 
@@ -297,20 +309,42 @@ class PipelineEngine:
         """Async P2P handoff to ``dest_stage``'s sharding through the eager
         pipeline: device_put enqueues under PJRT and returns; the consumer's
         dispatch chains on the in-flight buffer, so stage k's compute of
-        microbatch i+1 overlaps this transfer of microbatch i."""
+        microbatch i+1 overlaps this transfer of microbatch i.
+
+        With ``FLAGS_pp_p2p_comm_dtype`` set, the payload is encoded onto
+        a compact wire (plain cast, or the block-scaled int8 codec from
+        quant_comm) before the transfer; only the wire bytes cross
+        devices, and :meth:`_recv` decodes on the consumer side."""
         dst = self.stages[dest_stage]
+        ref_nb = int(getattr(arr, "nbytes", 0) or 0)
         t0 = time.perf_counter()
-        out = async_engine.p2p_transfer(
-            arr, dst.put_input, tag=f"pp:{kind}:{dest_stage}")
+        wire, decode, wdt = _qc.p2p_encode(arr)
+        if decode is not None:
+            out = _Wire(async_engine.p2p_transfer(
+                wire, lambda a: jax.device_put(a, dst.repl),
+                tag=f"pp:{kind}:{dest_stage}"), decode)
+            nb = int(getattr(wire, "nbytes", 0) or 0)
+        else:
+            out = async_engine.p2p_transfer(
+                arr, dst.put_input, tag=f"pp:{kind}:{dest_stage}")
+            nb = ref_nb
+        _emit("pp.wire", bytes=nb, ref_bytes=ref_nb,
+              dtype=wdt or str(getattr(arr, "dtype", "")), payload=kind)
         _emit("pipeline.send", dur_s=time.perf_counter() - t0, payload=kind,
-              stage=dest_stage, microbatch=m,
-              nbytes=int(getattr(arr, "nbytes", 0) or 0))
+              stage=dest_stage, microbatch=m, nbytes=nb)
         return out
 
-    @staticmethod
-    def _recv(arr, stage: int, kind: str, m: int):
+    def _recv(self, arr, stage: int, kind: str, m: int):
         """Consume a transferred buffer; records whether the copy had
-        already landed (overlap hit) or is still in flight."""
+        already landed (overlap hit) or is still in flight. Quantized
+        wires decode here — on the consumer's devices — and re-enter
+        through ``put_input`` so the stage executables see the same
+        placement (batch-sharded or replicated) as an unquantized
+        handoff: the stage signatures don't change, so no retraces."""
+        if isinstance(arr, _Wire):
+            _emit("pipeline.recv", payload=kind, stage=stage, microbatch=m,
+                  ready=async_engine._is_ready(arr.buf))
+            return self.stages[stage].put_input(arr.decode(arr.buf))
         _emit("pipeline.recv", payload=kind, stage=stage, microbatch=m,
               ready=async_engine._is_ready(arr))
         return arr

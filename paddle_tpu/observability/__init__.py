@@ -188,6 +188,18 @@ _c_dp_gathered = _C("paddle_dp_bytes_gathered_total",
 _g_dp_overlap = _G("paddle_dp_overlap_efficiency",
                    "Fraction of DP comm time hidden under backward "
                    "(1.0 = fully overlapped), last drain")
+_c_dp_wire = _C("paddle_dp_wire_bytes_total",
+                "Actual bytes placed on the DP gradient wire, by wire "
+                "dtype (the int8 codec counts payload + block scales)")
+_c_dp_wire_ref = _C("paddle_dp_wire_bytes_ref_total",
+                    "Param-dtype-equivalent bytes of the same DP traffic; "
+                    "ref/actual is the wire compression ratio")
+_c_pp_wire = _C("paddle_pp_wire_bytes_total",
+                "Actual bytes handed to pipeline P2P transfers, by wire "
+                "dtype")
+_c_pp_wire_ref = _C("paddle_pp_wire_bytes_ref_total",
+                    "Payload-dtype-equivalent bytes of the same pipeline "
+                    "handoffs; ref/actual is the wire compression ratio")
 _c_dp_packs = _C("paddle_dp_flat_pack_calls_total",
                  "Cached flat pack/unpack executable invocations")
 _c_dp_builds = _C("paddle_dp_flat_pack_builds_total",
@@ -544,6 +556,14 @@ _HANDLERS = {
         _c_dp_reduced.inc(f.get("bytes", 0)),
         _h_dp_comm.observe(d) if d is not None else None),
     "dp.gather": lambda d, f: _c_dp_gathered.inc(f.get("bytes", 0)),
+    "dp.wire": lambda d, f: (
+        _c_dp_wire.inc(f.get("bytes", 0),
+                       labels={"dtype": f.get("dtype", "")}),
+        _c_dp_wire_ref.inc(f.get("ref_bytes", 0))),
+    "pp.wire": lambda d, f: (
+        _c_pp_wire.inc(f.get("bytes", 0),
+                       labels={"dtype": f.get("dtype", "")}),
+        _c_pp_wire_ref.inc(f.get("ref_bytes", 0))),
     "dp.overlap": lambda d, f: _g_dp_overlap.set(f.get("efficiency", 0.0)),
     "dp.pack_call": lambda d, f: _c_dp_packs.inc(),
     "dp.pack_build": lambda d, f: _c_dp_builds.inc(),
@@ -601,6 +621,11 @@ def prometheus_text() -> str:
     return _registry.prometheus_text()
 
 
+def _ratio(ref, actual) -> float:
+    """Wire compression ratio (ref/actual bytes); 0.0 before any traffic."""
+    return round(float(ref) / float(actual), 4) if actual else 0.0
+
+
 def summary() -> dict:
     """The perf-triage digest printed by tools and embedded in BENCH_*.json:
     dispatch hit-rate, retrace count, fetch-stall p50/p99."""
@@ -624,6 +649,12 @@ def summary() -> dict:
         "dp_bytes_gathered": int(_c_dp_gathered.value()),
         "dp_overlap_efficiency": round(float(_g_dp_overlap.value()), 4),
         "dp_flat_pack_builds": int(_c_dp_builds.value()),
+        "dp": {
+            "wire_bytes": int(_c_dp_wire.value()),
+            "wire_bytes_ref": int(_c_dp_wire_ref.value()),
+            "wire_compression_ratio": _ratio(
+                _c_dp_wire_ref.value(), _c_dp_wire.value()),
+        },
         "events_recorded": _recorder.written(),
         "elastic": {
             "reconfigurations": int(_c_elastic.value(
@@ -681,6 +712,10 @@ def summary() -> dict:
             "stage_skew": round(float(_g_pp_skew.value()), 4),
             "send_p50_s": round(_h_pp_send.percentile(50), 6),
             "send_p99_s": round(_h_pp_send.percentile(99), 6),
+            "wire_bytes": int(_c_pp_wire.value()),
+            "wire_bytes_ref": int(_c_pp_wire_ref.value()),
+            "wire_compression_ratio": _ratio(
+                _c_pp_wire_ref.value(), _c_pp_wire.value()),
         },
         "router": {
             "admitted": int(_c_rt_admit.value()),

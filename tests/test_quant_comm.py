@@ -1,0 +1,424 @@
+"""Block-scaled int8 quantized collectives (distributed/quant_comm.py).
+
+Covers the int8 wire end to end on the 8-virtual-device CPU mesh:
+
+- block codec round-trip and the scale edge cases (all-zero bucket,
+  single outlier, pad tail) with float32 scales riding in the wire
+- error feedback: the residual drains to zero on constant grads and the
+  delivered sum telescopes to the true gradient sum
+- `no_sync` k-step accumulation is bit-exact vs quantizing the
+  accumulated total once
+- the 13-optimizer sharded-update parity matrix at int8 tolerance, on
+  2/4/8-rank groups
+- the chaos hang drill names the quantized collective (`q8_gather`)
+- pipeline pp=2 loss parity with quantized stage handoffs
+"""
+import os
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+from paddle_tpu.core.tensor import Parameter, Tensor
+from paddle_tpu.distributed import parallel as par
+from paddle_tpu.distributed import quant_comm as qc
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _env():
+    os.environ["PADDLE_TRAINERS_NUM"] = "8"
+    dist.collective.destroy_process_group()
+    dist.init_parallel_env()
+    yield
+    os.environ.pop("PADDLE_TRAINERS_NUM", None)
+    dist.collective.destroy_process_group()
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    flags.set_flags({"dp_overlap": True, "dp_shard_update": False,
+                     "dp_grad_comm_dtype": "", "dp_comm_block_size": 256,
+                     "pp_p2p_comm_dtype": "", "chaos_spec": "",
+                     "comm_timeout": 0.0, "watchdog_policy": "",
+                     "comm_watchdog_abort": False})
+
+
+def _metric(name, labels=None):
+    return obs.registry().value(name, labels or {})
+
+
+class _MLP(nn.Layer):
+    def __init__(self, din=8, dhid=16, dout=4):
+        super().__init__()
+        self.l1 = nn.Linear(din, dhid)
+        self.l2 = nn.Linear(dhid, dout)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.l2(F.relu(self.l1(x)))
+
+
+def _train(opt_cls, shard, steps=2, group=None, seed=7):
+    flags.set_flags({"dp_shard_update": shard})
+    paddle.seed(seed)
+    m = _MLP()
+    d = dist.DataParallel(m, group=group or dist.get_group(0))
+    o = opt_cls(learning_rate=0.05, parameters=m.parameters())
+    so = dist.sharded_update(o, d) if shard else o
+    for i in range(steps):
+        x = paddle.to_tensor(
+            np.random.RandomState(i).randn(8, 8).astype(np.float32))
+        d(x).mean().backward()
+        so.step()
+        so.clear_grad()
+    flags.set_flags({"dp_shard_update": False})
+    return [np.asarray(p._data) for p in m.parameters()], so, d
+
+
+def _params(*shapes, seed=0, scale=1.0):
+    rs = np.random.RandomState(seed)
+    return [Parameter.from_tensor(
+        Tensor(jnp.asarray((rs.randn(*s) * scale).astype(np.float32))),
+        name=f"_qc_p{i}") for i, s in enumerate(shapes)]
+
+
+# ---------------------------------------------------------------------------
+# Block codec: round-trip + scale edge cases
+# ---------------------------------------------------------------------------
+
+class TestBlockCodec:
+    def test_wire_layout(self):
+        assert qc.wire_layout(256, 256) == (256, 1, 260)
+        assert qc.wire_layout(257, 256) == (512, 2, 520)
+        assert qc.wire_layout(0, 256) == (256, 1, 260)
+
+    def test_roundtrip_within_block_error_bound(self):
+        block = 64
+        flat = jnp.asarray(
+            (np.random.RandomState(3).randn(4 * block) * 5)
+            .astype(np.float32))
+        wire, residual = qc.encode_flat(flat, block)
+        assert wire.dtype == jnp.int8
+        assert wire.shape == (4 * block + 4 * 4,)
+        out = qc.decode_flat(wire, 4, block)
+        absmax = np.abs(np.asarray(flat)).reshape(4, block).max(axis=1)
+        bound = np.repeat(absmax / 254 + 1e-7, block)
+        err = np.abs(np.asarray(out) - np.asarray(flat))
+        assert np.all(err <= bound)
+        # the residual is exactly the round-trip error
+        assert np.allclose(np.asarray(residual), np.asarray(flat - out),
+                           atol=1e-6)
+
+    def test_all_zero_bucket_is_exact(self):
+        flat = jnp.zeros((128,), jnp.float32)
+        wire, residual = qc.encode_flat(flat, 128)
+        out = qc.decode_flat(wire, 1, 128)
+        assert np.array_equal(np.asarray(out), np.zeros(128, np.float32))
+        assert np.array_equal(np.asarray(residual),
+                              np.zeros(128, np.float32))
+
+    def test_single_outlier_block(self):
+        # f32 scales: an outlier that would overflow an f16 scale
+        # (absmax * 127 > 65504) must round-trip cleanly, and the other
+        # elements of its block quantize to exact zeros
+        flat = np.zeros(256, np.float32)
+        flat[17] = 1e4
+        wire, _ = qc.encode_flat(jnp.asarray(flat), 256)
+        out = np.asarray(qc.decode_flat(wire, 1, 256))
+        assert abs(out[17] - 1e4) / 1e4 < 1e-5
+        assert np.array_equal(np.delete(out, 17),
+                              np.zeros(255, np.float32))
+
+    def test_tiny_values_keep_nonzero_scale(self):
+        # f16 scale storage would flush absmax/127 ~ 8e-9 to zero and
+        # deliver nothing forever; f32 scales must keep quantizing
+        flat = jnp.full((64,), 1e-6, jnp.float32)
+        wire, residual = qc.encode_flat(flat, 64)
+        out = np.asarray(qc.decode_flat(wire, 1, 64))
+        assert np.all(out > 0)
+        assert np.max(np.abs(out - 1e-6)) <= 1e-6 / 254 + 1e-12
+
+    def test_pad_tail_through_bucket_executables(self):
+        flags.set_flags({"dp_comm_block_size": 16})
+        ps = _params((7, 3), (5,), seed=5)  # numel 26 -> 2 blocks of 16
+        b = par._Bucket(0, ps, nranks=1, comm_dtype="int8")
+        assert (b.qpadded, b.qblocks) == (32, 2)
+        assert b.nbytes == 32 + 4 * 2
+        qpack = qc.make_pack_q8(b)
+        qdecode = qc.make_decode_q8(b)
+        wire, _ = qpack([p._data for p in ps], qc.zeros_residual(b))
+        out = np.asarray(qdecode(jnp.stack([wire])))
+        flat = np.concatenate(
+            [np.asarray(p._data).ravel() for p in ps])
+        assert out.shape == (26,)  # pad tail sliced off
+        assert np.max(np.abs(out - flat)) <= np.abs(flat).max() / 254 + 1e-7
+
+    def test_bucket_wire_bytes_accounting(self):
+        ps = _params((64, 64), seed=1)
+        b8 = par._Bucket(0, ps, nranks=8, comm_dtype="int8")
+        qpadded, nblocks, wire = qc.wire_layout(b8.padded, b8.qblock)
+        assert b8.nbytes == wire == qpadded + 4 * nblocks
+        bf = par._Bucket(0, ps, nranks=8, comm_dtype="bfloat16")
+        assert bf.nbytes == bf.padded * 2  # non-int8 unchanged
+
+    def test_bad_block_size_rejected(self):
+        flags.set_flags({"dp_comm_block_size": 0})
+        with pytest.raises(ValueError, match="dp_comm_block_size"):
+            qc.block_size()
+
+    def test_block_size_keys_the_plan(self):
+        ps = _params((16, 16), (16,), seed=2)
+        cache = OrderedDict()
+        flags.set_flags({"dp_comm_block_size": 256})
+        p1 = par._build_plan(ps, None, 25, 1, "int8", cache=cache)
+        flags.set_flags({"dp_comm_block_size": 64})
+        p2 = par._build_plan(ps, None, 25, 1, "int8", cache=cache)
+        assert p1 is not p2
+        assert (p1.buckets[0].qblock, p2.buckets[0].qblock) == (256, 64)
+        flags.set_flags({"dp_comm_block_size": 256})
+        assert par._build_plan(ps, None, 25, 1, "int8", cache=cache) is p1
+
+
+# ---------------------------------------------------------------------------
+# Error feedback
+# ---------------------------------------------------------------------------
+
+class TestErrorFeedback:
+    def test_residual_drains_to_zero_on_constant_grads(self):
+        # c = 127 makes scale = 1.0, so dequant is exact and the
+        # residual hits exactly zero from the first step on
+        ps = _params((8, 16), seed=0)
+        b = par._Bucket(0, ps, nranks=1, comm_dtype="int8")
+        qpack = qc.make_pack_q8(b)
+        grads = [jnp.full((8, 16), 127.0, jnp.float32)]
+        residual = qc.zeros_residual(b)
+        for _ in range(3):
+            wire, residual = qpack(grads, residual)
+            assert np.array_equal(np.asarray(residual),
+                                  np.zeros(b.qpadded, np.float32))
+            out = np.asarray(qc.decode_flat(wire, b.qblocks, b.qblock))
+            assert np.array_equal(out[:b.numel],
+                                  np.full(128, 127.0, np.float32))
+
+    def test_delivered_sum_telescopes(self):
+        # generic constant c: per-step delivery wobbles by <= scale/2 but
+        # the error feedback telescopes — after T steps the summed
+        # deliveries differ from T*c by at most the final residual
+        ps = _params((8, 16), seed=0)
+        b = par._Bucket(0, ps, nranks=1, comm_dtype="int8")
+        qpack = qc.make_pack_q8(b)
+        c, T = 0.3, 10
+        grads = [jnp.full((8, 16), c, jnp.float32)]
+        residual = qc.zeros_residual(b)
+        delivered = np.zeros(b.numel, np.float32)
+        for _ in range(T):
+            wire, residual = qpack(grads, residual)
+            delivered += np.asarray(
+                qc.decode_flat(wire, b.qblocks, b.qblock))[:b.numel]
+        scale_bound = (c + abs(c) / 254) / 127  # absmax <= c + residual
+        assert np.max(np.abs(delivered - T * c)) <= scale_bound
+        assert np.max(np.abs(np.asarray(residual))) <= scale_bound
+
+    def test_no_sync_accumulation_bit_exact(self):
+        """k no_sync steps + one synced backward must deliver exactly
+        decode(encode(sum of grads)): the codec runs once on the
+        accumulated total, never on the partial sums."""
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        g = dist.get_group(0)
+        xs = [np.random.RandomState(40 + j).randn(8, 8).astype(np.float32)
+              for j in range(3)]
+
+        paddle.seed(23)
+        m = _MLP()
+        d = dist.DataParallel(m, group=g)
+        with d.no_sync():
+            for xa in xs[:-1]:
+                d(paddle.to_tensor(xa)).mean().backward()
+        d(paddle.to_tensor(xs[-1])).mean().backward()
+        got = [np.asarray(p._grad) for p in m.parameters()]
+
+        # twin model, same seed: accumulate the same grads with no DP
+        paddle.seed(23)
+        m2 = _MLP()
+        for xa in xs:
+            m2(paddle.to_tensor(xa)).mean().backward()
+        by_pos = {id(p): i for i, p in enumerate(m.parameters())}
+        totals = [p._grad for p in m2.parameters()]
+
+        plan = d._reducer._ensure_plan()
+        n = g.nranks
+        for b in plan.buckets:
+            arrs = [totals[by_pos[id(p)]] for p in b.params]
+            wire, _ = b.qpack(arrs, qc.zeros_residual(b))
+            flat = b.qdecode(jnp.stack([wire] * n))
+            expect = b.unpack_grads(flat)
+            for p, e in zip(b.params, expect):
+                a = got[by_pos[id(p)]]
+                assert np.array_equal(a, np.asarray(e)), (
+                    f"bucket {b.index} param {p.name}: "
+                    f"maxdiff {np.max(np.abs(a - np.asarray(e)))}")
+
+
+# ---------------------------------------------------------------------------
+# Sharded-update parity at int8 tolerance
+# ---------------------------------------------------------------------------
+
+# the same 13 optimizers as test_dp_overlap's fp32 matrix; with the int8
+# wire both paths see identical decoded grads, so sharded must still be
+# bit-exact vs replicated (Lamb via its documented replicated fallback)
+PARITY_OPTIMIZERS = [opt.SGD, opt.Momentum, opt.Adam, opt.AdamW, opt.Adagrad,
+                     opt.RMSProp, opt.Adadelta, opt.Adamax, opt.Lamb,
+                     opt.ASGD, opt.NAdam, opt.RAdam, opt.Rprop]
+
+INT8_TOL = 5e-2  # vs the fp32 wire (the bf16-wire test's tolerance)
+# Adam normalizes per element by sqrt(v): quantization noise on
+# near-zero grads can flip an element's direction outright, moving it a
+# full lr per step either way — bound is 2 * steps * lr = 0.2
+ADAM_TOL = 0.2
+
+
+class TestInt8ShardedParity:
+    @pytest.mark.parametrize(
+        "opt_cls", PARITY_OPTIMIZERS, ids=lambda c: c.__name__)
+    def test_sharded_bit_exact_vs_replicated(self, opt_cls, recwarn):
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        w_repl, _, _ = _train(opt_cls, shard=False)
+        w_sh, _, _ = _train(opt_cls, shard=True)
+        for i, (a, b) in enumerate(zip(w_repl, w_sh)):
+            assert np.array_equal(a, b), (
+                f"{opt_cls.__name__} param {i}: "
+                f"maxdiff {np.max(np.abs(a - b))}")
+
+    @pytest.mark.parametrize(
+        "opt_cls", [opt.SGD, opt.Momentum, opt.Adam],
+        ids=lambda c: c.__name__)
+    def test_tracks_fp32_within_tolerance(self, opt_cls):
+        w_ref, _, _ = _train(opt_cls, shard=False)
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        w_q, _, _ = _train(opt_cls, shard=True)
+        tol = ADAM_TOL if opt_cls is opt.Adam else INT8_TOL
+        for a, b in zip(w_ref, w_q):
+            assert str(b.dtype) == "float32"
+            assert np.allclose(a, b, atol=tol)
+
+    @pytest.mark.parametrize("nranks", [2, 4, 8])
+    def test_rank_groups(self, nranks):
+        g = (dist.get_group(0) if nranks == 8
+             else dist.new_group(list(range(nranks))))
+        assert g.nranks == nranks
+        w_ref, _, _ = _train(opt.Adam, shard=False, group=g)
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        w_repl, _, _ = _train(opt.Adam, shard=False, group=g)
+        w_sh, _, _ = _train(opt.Adam, shard=True, group=g)
+        for a, b, c in zip(w_ref, w_repl, w_sh):
+            assert np.array_equal(b, c)  # sharded == replicated, int8
+            assert np.allclose(a, c, atol=ADAM_TOL)  # tracks fp32
+
+    def test_wire_bytes_accounted(self):
+        before = _metric("paddle_dp_wire_bytes_total", {"dtype": "int8"})
+        before_ref = _metric("paddle_dp_wire_bytes_ref_total")
+        flags.set_flags({"dp_grad_comm_dtype": "int8"})
+        steps = 2
+        _, _, d = _train(opt.SGD, shard=False, steps=steps)
+        plan = d._reducer._ensure_plan()
+        wire = sum(b.nbytes for b in plan.buckets)
+        ref = sum(b.padded * 4 for b in plan.buckets)
+        assert (_metric("paddle_dp_wire_bytes_total", {"dtype": "int8"})
+                == before + steps * wire)
+        assert (_metric("paddle_dp_wire_bytes_ref_total")
+                == before_ref + steps * ref)
+        dp = obs.summary()["dp"]
+        assert dp["wire_bytes_ref"] >= dp["wire_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos drill: the hang names the quantized collective
+# ---------------------------------------------------------------------------
+
+class TestChaosDrill:
+    def test_watchdog_names_quantized_collective(self, capfd):
+        flags.set_flags({"chaos_spec":
+                         "collective:hang@op=q8_gather;delay=1.0",
+                         "comm_timeout": 0.3,
+                         "watchdog_policy": "warn",
+                         "comm_watchdog_abort": False,
+                         "dp_grad_comm_dtype": "int8"})
+        before = _metric("paddle_watchdog_escalations_total",
+                         {"stage": "warn"})
+        paddle.seed(3)
+        m = _MLP()
+        d = dist.DataParallel(m)
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        d(paddle.to_tensor(
+            np.ones((4, 8), np.float32))).mean().backward()
+        o.step()
+        assert _metric("paddle_watchdog_escalations_total",
+                       {"stage": "warn"}) >= before + 1
+        err = capfd.readouterr().err
+        assert "stage=warn" in err
+        assert "dp:q8_gather:bucket0" in err
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: pp=2 with quantized stage handoffs
+# ---------------------------------------------------------------------------
+
+class TestQuantizedPipeline:
+    def test_pp2_loss_parity_with_quantized_handoff(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+            import pp_layers
+        from paddle_tpu.distributed.pipeline import PipelineEngine
+
+        M, DIN, DHID, DOUT = 4, 16, 32, 4
+
+        def _mse(out, label):
+            return ((out - label) ** 2).mean()
+
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.normal(size=(M, DIN)).astype(np.float32))
+        y = paddle.to_tensor(rs.normal(size=(M, DOUT)).astype(np.float32))
+
+        def train(pp, wire, steps=3):
+            flags.set_flags({"pp_p2p_comm_dtype": wire})
+            model = pp_layers.PipelineLayer(
+                layers=[pp_layers.LayerDesc(nn.Linear, DIN, DHID),
+                        pp_layers.LayerDesc(nn.ReLU),
+                        pp_layers.LayerDesc(nn.Linear, DHID, DHID),
+                        pp_layers.LayerDesc(nn.ReLU),
+                        pp_layers.LayerDesc(nn.Linear, DHID, DOUT)],
+                loss_fn=_mse, num_stages=pp)
+            rs2 = np.random.RandomState(0)
+            for p in model.parameters():
+                p.set_value(paddle.to_tensor(
+                    rs2.normal(scale=0.3, size=p.shape)
+                    .astype(np.float32)))
+            engine = PipelineEngine(model, accumulate_steps=M)
+            o = opt.SGD(learning_rate=0.05,
+                        parameters=model.parameters())
+            losses = []
+            for _ in range(steps):
+                loss = engine.run(x, y, train=True)
+                o.step()
+                o.clear_grad()
+                losses.append(float(np.asarray(loss._data)))
+            flags.set_flags({"pp_p2p_comm_dtype": ""})
+            return losses
+
+        ref = train(1, "")
+        before = _metric("paddle_pp_wire_bytes_total", {"dtype": "int8"})
+        q = train(2, "int8")
+        err = max(abs(a - b) for a, b in zip(ref, q))
+        assert err <= 0.1, f"quantized pp losses {q} vs {ref}"
+        assert q[-1] < q[0]  # still trains
+        assert _metric("paddle_pp_wire_bytes_total",
+                       {"dtype": "int8"}) > before

@@ -153,6 +153,27 @@ def _basket():
         b = _par._Bucket(0, pack_ps, nranks=1, comm_dtype=None)
         return _par._make_pack(b)(pack_arrs)
 
+    # int8 wire codec (quant_comm): the error-feedback fused pack and the
+    # gather-decode, cached vs uncached, plus the bf16 cast pack — the
+    # codec's overhead vs the plain compressed wire
+    from paddle_tpu.distributed import quant_comm as _qcomm
+
+    q8_bucket = _par._Bucket(0, pack_ps, nranks=1, comm_dtype="int8")
+    q8_bucket.qpack = _qcomm.make_pack_q8(q8_bucket)
+    q8_bucket.qdecode = _qcomm.make_decode_q8(q8_bucket)
+    q8_res = _qcomm.zeros_residual(q8_bucket)
+    q8_wire = q8_bucket.qpack(pack_arrs, q8_res)[0]
+    q8_gathered = jnp.stack([q8_wire])
+    q8_bucket.qdecode(q8_gathered)  # trace once outside the clock
+
+    def _q8_pack_uncached():
+        b = _par._Bucket(0, pack_ps, nranks=1, comm_dtype="int8")
+        return _qcomm.make_pack_q8(b)(pack_arrs, q8_res)[0]
+
+    bf16_bucket = _par._Bucket(0, pack_ps, nranks=1, comm_dtype="bfloat16")
+    bf16_bucket.pack = _par._make_pack(bf16_bucket)
+    bf16_bucket.pack(pack_arrs)  # trace once outside the clock
+
     # pallas-vs-stock paged attention (fusion-paper methodology: measure
     # what XLA already does before owning a kernel). Fixed tiny serving
     # shapes — B=4 slots, 2 kv heads x group 2, hd=32, 16-token pages.
@@ -222,6 +243,10 @@ def _basket():
         "eager_dispatch_add_uncached": _add_uncached,
         "dp_flat_pack_cached": lambda: pack_bucket.pack(pack_arrs),
         "dp_flat_pack_uncached": _pack_uncached,
+        "dp_flat_pack_bf16_cached": lambda: bf16_bucket.pack(pack_arrs),
+        "dp_q8_pack_cached": lambda: q8_bucket.qpack(pack_arrs, q8_res)[0],
+        "dp_q8_pack_uncached": _q8_pack_uncached,
+        "dp_q8_decode_cached": lambda: q8_bucket.qdecode(q8_gathered),
     }
     jitted = {
         "matmul_256": lambda: K["matmul"](a, b),

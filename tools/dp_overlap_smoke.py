@@ -33,6 +33,11 @@ import numpy as np  # noqa: E402
 
 EFFICIENCY_FLOOR = 0.5  # CPU fallback collectives are cheap; a healthy
                         # overlap drain hides nearly all of the wait
+WIRE_RATIO_FLOOR = 3.5  # int8 + per-block f32 scale vs the fp32 wire
+                        # (4x minus scale overhead; block 256 -> 3.94x)
+INT8_CURVE_TOL = 0.01   # max per-step loss drift of the int8+error-feedback
+                        # curve vs fp32 after CURVE_STEPS steps
+CURVE_STEPS = 8
 
 
 def _median_step_ms(d, so, steps=6):
@@ -96,6 +101,66 @@ def run() -> dict:
                          for a, b in zip(w_barrier, w_overlap))
     parity_shard = all(np.array_equal(a, b)
                        for a, b in zip(w_barrier, w_shard))
+
+    # ---- int8 wire leg (quant_comm block codec + error feedback) -------
+    def grads_once(dtype):
+        flags.set_flags({"dp_overlap": True, "dp_shard_update": False,
+                         "dp_grad_comm_dtype": dtype})
+        m = build()
+        d = dist.DataParallel(m, group=g)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 64).astype(np.float32))
+        d(x).mean().backward()
+        d.sync_gradients()
+        return [np.asarray(p._grad) for p in m.parameters()]
+
+    def curve(dtype):
+        flags.set_flags({"dp_overlap": True, "dp_shard_update": False,
+                         "dp_grad_comm_dtype": dtype})
+        m = build()
+        d = dist.DataParallel(m, group=g)
+        o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+        losses = []
+        for i in range(CURVE_STEPS):
+            x = paddle.to_tensor(
+                np.random.RandomState(i).randn(16, 64).astype(np.float32))
+            loss = d(x).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        return losses, d
+
+    g_ref = grads_once("")
+    g_q8 = grads_once("int8")
+    # per-block error is bounded by blockwise absmax/254; gate at 1% of
+    # the global grad magnitude (a ~2.5x margin over the bound)
+    grad_tol = max(1e-6, max(float(np.max(np.abs(a))) for a in g_ref) / 100)
+    int8_grad_err = max(float(np.max(np.abs(a - b)))
+                        for a, b in zip(g_ref, g_q8))
+
+    curve_ref, _ = curve("")
+    obs.reset()  # isolate the wire-bytes counters to the int8 run
+    curve_q8, d_q8 = curve("int8")
+    int8_curve_err = max(abs(a - b) for a, b in zip(curve_ref, curve_q8))
+    wire = obs.summary()["dp"]
+    # steady state: two more steps must not build new pack executables
+    builds_now = obs.registry().value("paddle_dp_flat_pack_calls_total")
+    trace_now = obs.registry().value("paddle_dp_flat_pack_builds_total")
+    o_q8 = opt.Adam(learning_rate=1e-3,
+                    parameters=d_q8._layers.parameters())
+    for i in range(2):
+        x = paddle.to_tensor(
+            np.random.RandomState(i).randn(16, 64).astype(np.float32))
+        d_q8(x).mean().backward()
+        o_q8.step()
+        o_q8.clear_grad()
+    int8_zero_retraces = bool(
+        obs.registry().value("paddle_dp_flat_pack_builds_total")
+        == trace_now
+        and obs.registry().value("paddle_dp_flat_pack_calls_total")
+        > builds_now)
+    flags.set_flags({"dp_grad_comm_dtype": ""})
     full_bytes = sum(
         int(getattr(a, "nbytes", 0))
         for store in so.inner._accumulators.values()
@@ -106,6 +171,11 @@ def run() -> dict:
         "hooks_issue_in_backward": issued_in_backward,
         "overlap_efficiency_floor": bool(eff >= EFFICIENCY_FLOOR),
         "opt_state_sharded": bool(0 < opt_bytes < full_bytes),
+        "int8_grad_parity": bool(int8_grad_err <= grad_tol),
+        "int8_loss_curve": bool(int8_curve_err <= INT8_CURVE_TOL),
+        "int8_wire_ratio": bool(
+            wire["wire_compression_ratio"] >= WIRE_RATIO_FLOOR),
+        "int8_zero_retraces": int8_zero_retraces,
     }
     return {
         "ok": all(checks.values()),
@@ -116,6 +186,10 @@ def run() -> dict:
         "ratio": round(overlap_ms / barrier_ms, 3) if barrier_ms else None,
         "overlap_efficiency": eff,
         "opt_state_bytes_per_dev": opt_bytes,
+        "int8_grad_err": int8_grad_err,
+        "int8_curve_err": int8_curve_err,
+        "int8_wire_ratio": wire["wire_compression_ratio"],
+        "int8_wire_bytes": wire["wire_bytes"],
         "devices": len(jax.devices()),
     }
 

@@ -39,6 +39,11 @@ import numpy as np  # noqa: E402
 EPS = 1e-9          # the simulation reproduces the closed form exactly
 PARITY_TOL = 1e-5   # float32 ulp-level: stage-split XLA fusion may flip
                     # the last bit vs the single-kernel pp=1 run
+Q_TOL = 0.25        # int8 handoffs round every stage boundary and SGD
+                    # lr=0.1 amplifies the trajectory drift (observed
+                    # ~0.12 on CPU); the gate is a blowup/NaN tripwire,
+                    # with quantized_p2p_trains guarding the direction
+Q_RATIO_FLOOR = 3.0  # int8 payload + one f32 scale per (clamped) block
 PP, M = 2, 8
 D_IN, D_HID, D_OUT = 16, 32, 4
 
@@ -111,12 +116,30 @@ def run() -> dict:
 
     loss_err = max(abs(a - b) for a, b in zip(losses, ref_losses))
     w_err = max(float(np.max(np.abs(a - b))) for a, b in zip(w, ref_w))
+
+    # quantized-P2P leg: same pp=2 run with int8 stage handoffs
+    # (FLAGS_pp_p2p_comm_dtype); gates on loss parity vs pp=1 at the
+    # looser int8 tolerance plus the wire-bytes ratio from the metrics
+    from paddle_tpu.core import flags
+    obs.reset()  # isolate the pp wire counters to the quantized run
+    flags.set_flags({"pp_p2p_comm_dtype": "int8"})
+    try:
+        q_losses, _, _, _ = train(PP)
+    finally:
+        flags.set_flags({"pp_p2p_comm_dtype": ""})
+    q_loss_err = max(abs(a - b) for a, b in zip(q_losses, ref_losses))
+    q_wire = obs.summary()["pipeline"]
+
     checks = {
         "loss_parity_vs_pp1": bool(loss_err <= PARITY_TOL),
         "weight_parity_vs_pp1": bool(w_err <= PARITY_TOL),
         "bubble_matches_closed_form": bool(abs(bubble - bound) <= EPS),
         "zero_steady_state_retraces": bool(builds_after_warmup
                                            == builds_now),
+        "quantized_p2p_loss_parity": bool(q_loss_err <= Q_TOL),
+        "quantized_p2p_trains": bool(q_losses[-1] < q_losses[0]),
+        "quantized_p2p_wire_ratio": bool(
+            q_wire["wire_compression_ratio"] >= Q_RATIO_FLOOR),
     }
     return {
         "ok": all(checks.values()),
@@ -127,6 +150,9 @@ def run() -> dict:
         "closed_form_bound": round(bound, 6),
         "loss_err": loss_err,
         "weight_err": w_err,
+        "quantized_loss_err": q_loss_err,
+        "quantized_wire_ratio": q_wire["wire_compression_ratio"],
+        "quantized_wire_bytes": q_wire["wire_bytes"],
         "f1b_ms": round(f1b_ms, 3),
         "gpipe_ms": round(gpipe_ms, 3),
         "stage_builds": int(builds_now),
