@@ -3,7 +3,8 @@
 The package is intentionally stdlib-only (ast, json, re, pathlib) so the
 CLI (``tools/tpu_lint.py``) can load it without importing paddle_tpu (and
 therefore without importing jax), keeping a full-tree run well under the
-10s pre-commit budget.
+10s pre-commit budget — and under ~2s warm via the per-file findings
+cache in :func:`core.lint_tree` (keyed mtime+size+rules-hash).
 
 Rules
 -----
@@ -12,12 +13,28 @@ TPL002  collective-order: data-dependent or fence-bypassing collective issue
 TPL003  blocking-under-lock: blocking ops lexically inside ``with ..lock:``
 TPL004  flags-drift: flag reads vs ``define_flag`` registry vs MIGRATION.md
 TPL005  metrics-drift: emit() kinds / paddle_* names vs registry, docs, ops.yaml
+TPL006  retrace-hazard: unkeyed flag/env reads, loop-var capture, unsorted
+        dict iteration around signature-keyed executable caches
+TPL007  spmd-divergence: per-rank collective-sequence divergence through the
+        cross-module call graph; retry loops that skip the epoch verdict
+TPL008  use-after-donate: reads of a donated argument binding after the
+        donating jitted call
+TPL009  chaos-coverage: registered injections / watchdog ladder stages vs
+        drills, both directions
+TPL010  refcount-pairing: leak-on-raise between acquire and release for
+        page refcounts, COW pins, TTL leases
 """
 
 from .core import (  # noqa: F401
     Finding,
+    LintResult,
     Repo,
     Baseline,
     RULES,
+    PER_FILE_RULES,
+    GLOBAL_RULES,
+    lint_tree,
+    nearest_key,
     run_all,
+    rules_hash,
 )

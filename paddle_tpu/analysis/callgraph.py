@@ -1,10 +1,12 @@
 """Shared AST plumbing: scope/parent indexing, name resolution, call walking.
 
-Resolution is intra-module only. That is deliberate: the invariants the
-checkers enforce live at module boundaries (a jitted entry and its helper
-closures sit in one file; a lock and the code under it sit in one class),
-and staying intra-module keeps the whole-tree run fast and the findings
-explainable.
+Per-file rules resolve intra-module only (a jitted entry and its helper
+closures sit in one file; a lock and the code under it sit in one class) —
+that keeps those passes per-file cacheable. Cross-module resolution lives
+in :class:`ImportMap` + :func:`module_relpath`: TPL007 summarizes each
+function's issued-collective sequence through ``from x import y`` /
+``import x.y as z`` bindings so a collective issued three helper calls away
+in another module still counts toward a branch arm's sequence.
 """
 
 from __future__ import annotations
@@ -110,6 +112,76 @@ class ModuleIndex:
         while cur is not None:
             yield cur
             cur = self.parent.get(cur)
+
+
+def module_relpath(dotted_mod: str, known_paths) -> str:
+    """Repo-relative file for a dotted module name, '' when not in the tree.
+
+    ``paddle_tpu.distributed.collective`` -> paddle_tpu/distributed/
+    collective.py (or .../collective/__init__.py for packages).
+    """
+    base = dotted_mod.replace(".", "/")
+    for cand in (f"{base}.py", f"{base}/__init__.py"):
+        if cand in known_paths:
+            return cand
+    return ""
+
+
+class ImportMap:
+    """Name bindings one source file gets from imports, resolved to
+    repo-relative paths. ``bindings[local] = (target_relpath, symbol)`` —
+    symbol is None when the local name is a whole module."""
+
+    def __init__(self, sf, known_paths):
+        self.bindings = {}
+        # containing package, also the anchor for level-1 relative imports
+        # (for pkg/__init__.py the dir itself is the module's package)
+        own_pkg = sf.relpath.split("/")[:-1]
+        for node in sf.walk():
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    rel = module_relpath(alias.name, known_paths)
+                    if rel:
+                        local = alias.asname or alias.name.split(".")[0]
+                        # `import a.b.c` binds `a`; only an asname binds the
+                        # leaf module directly
+                        if alias.asname or "." not in alias.name:
+                            self.bindings[local] = (rel, None)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    anchor = own_pkg[: len(own_pkg) - (node.level - 1)]
+                    mod = ".".join(anchor + (node.module or "").split("."))
+                    mod = mod.strip(".")
+                else:
+                    mod = node.module or ""
+                if not mod:
+                    continue
+                mod_rel = module_relpath(mod, known_paths)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub_rel = module_relpath(f"{mod}.{alias.name}", known_paths)
+                    if sub_rel:
+                        # `from pkg import module`
+                        self.bindings[local] = (sub_rel, None)
+                    elif mod_rel:
+                        # `from module import symbol`
+                        self.bindings[local] = (mod_rel, alias.name)
+
+    def resolve(self, func_node):
+        """(target_relpath, symbol_name) for a call's func expression that
+        crosses a module boundary via this file's imports, else None."""
+        if isinstance(func_node, ast.Name):
+            hit = self.bindings.get(func_node.id)
+            if hit is not None and hit[1] is not None:
+                return hit
+            return None
+        if isinstance(func_node, ast.Attribute) and isinstance(
+            func_node.value, ast.Name
+        ):
+            hit = self.bindings.get(func_node.value.id)
+            if hit is not None and hit[1] is None:
+                return (hit[0], func_node.attr)
+        return None
 
 
 def walk_traced(index: ModuleIndex, entry, max_depth: int = 12):

@@ -1,14 +1,30 @@
-"""Checker framework: findings, pragmas, baseline, repo file model.
+"""Checker framework: findings, pragmas, baseline, repo model, engine.
 
 Stdlib-only on purpose — see package docstring.
+
+Engine shape (PR 13): rules split into two classes so a per-file findings
+cache can make warm runs O(changed files):
+
+* **per-file rules** (TPL001/002/003/006/008/010) — pure functions of one
+  source file; their findings are cached per file keyed mtime+size and the
+  rules-hash of this package.
+* **global rules** (TPL004/005/007/009) — cross-file drift checks. Each
+  extracts a small JSON-serializable *facts* blob per file (also cached)
+  and reduces over all blobs every run; a change in one module therefore
+  still updates findings anchored in another (TPL007's cross-module
+  collective summaries) without re-parsing the unchanged ones.
 """
 
 from __future__ import annotations
 
 import ast
+import difflib
+import hashlib
 import json
+import os
 import re
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass
 from pathlib import Path
 
 # ---------------------------------------------------------------------------
@@ -44,7 +60,8 @@ RULES = {
         "subprocess / socket waits, collective issue) lexically inside a\n"
         "`with <lock>:` body stalls every other thread contending for that lock —\n"
         "heartbeats miss, routers stop routing, watchdogs fire. Snapshot state\n"
-        "under the lock, release it, then block.",
+        "under the lock, release it, then block. Multi-item `with lock, cv:` and\n"
+        "`ExitStack.enter_context(lock)` anchor the same way.",
     ),
     "TPL004": (
         "flags-drift",
@@ -63,9 +80,70 @@ RULES = {
         "metric name referenced in code/docs must exist in the registry, and every\n"
         "op declared in `ops.yaml` must have a generated binding (and vice versa).",
     ),
+    "TPL006": (
+        "retrace-hazard",
+        "error",
+        "Signature-keyed executable caches (dispatch, bucket plans, stage\n"
+        "executables, serving step) must fold *everything* the built executable\n"
+        "depends on into the cache key. Flagged: a `flag_value()`/`os.environ`\n"
+        "read inside a cache-populating function whose value does not feed the\n"
+        "key (flipping the flag silently serves the stale executable); a jitted\n"
+        "closure capturing a loop variable (late binding — every cached program\n"
+        "sees the final iteration's value); unsorted dict iteration inside a\n"
+        "signature/key constructor (insertion order leaks into the key and\n"
+        "causes spurious steady-state retraces).",
+    ),
+    "TPL007": (
+        "spmd-divergence",
+        "error",
+        "Every rank must issue the same collective sequence in the same order.\n"
+        "This rule summarizes each function's issued collectives through the\n"
+        "cross-module call graph and flags: `if`/`else` arms issuing different\n"
+        "sequences under a rank-dependent test (`if rank == 0: all_reduce(...)`\n"
+        "deadlocks the other ranks), data-dependent branches whose *called\n"
+        "helpers* issue collectives (the lexical case is TPL002), and retry\n"
+        "loops / swallowing `except` handlers around a collective that never\n"
+        "consult the elastic world-changed verdict hook — a retry that crosses\n"
+        "a reconfiguration epoch hangs against the new gang.",
+    ),
+    "TPL008": (
+        "use-after-donate",
+        "error",
+        "`donate_argnums` hands the argument's buffer to XLA: after the call the\n"
+        "old binding is dead — reading it returns garbage on real hardware (CPU\n"
+        "interpret mode often hides it) or raises a deleted-buffer error. Flags\n"
+        "any read of a donated argument binding after the donating call and\n"
+        "before it is rebound. Rebind from the call's result (`state = step(x,\n"
+        "state)`) or drop the name.",
+    ),
+    "TPL009": (
+        "chaos-coverage",
+        "warning",
+        "Every registered chaos injection (`site:kind` in the chaos grammar) and\n"
+        "every watchdog escalation-ladder stage must be exercised by at least\n"
+        "one drill in the test tree / smoke tools, and every drill spec must\n"
+        "name a registered injection — both directions. An uninjectable failure\n"
+        "mode is an untested recovery path; a typo'd drill silently tests\n"
+        "nothing.",
+    ),
+    "TPL010": (
+        "refcount-pairing",
+        "error",
+        "Lexical acquire/release pairing for refcounted resources: BlockManager\n"
+        "page `_incref`/`_decref`, COW `pin`/`take_copies`, TTL-lease\n"
+        "acquire/drop. In a function that both acquires and releases, a `raise`\n"
+        "between the acquire and the matching release leaks the reference (the\n"
+        "PR-7 COW-pin leak class) unless a `try/finally` or a rollback release\n"
+        "on the raising path covers it.",
+    ),
 }
 
+PER_FILE_RULES = ("TPL001", "TPL002", "TPL003", "TPL006", "TPL008", "TPL010")
+GLOBAL_RULES = ("TPL004", "TPL005", "TPL007", "TPL009")
+
 _PRAGMA_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+_CACHE_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +185,28 @@ class Finding:
             "hint": self.hint,
         }
 
+    def to_cache(self) -> dict:
+        d = self.to_dict()
+        d["tag"] = self.tag
+        d["anchors"] = list(self.extra_anchor_lines)
+        d.pop("severity", None)
+        d.pop("key", None)
+        return d
+
+    @classmethod
+    def from_cache(cls, d: dict) -> "Finding":
+        return cls(
+            rule=d["rule"],
+            path=d["path"],
+            line=d["line"],
+            message=d["message"],
+            hint=d.get("hint", ""),
+            col=d.get("col", 0),
+            symbol=d.get("symbol", ""),
+            tag=d.get("tag", ""),
+            extra_anchor_lines=tuple(d.get("anchors", ())),
+        )
+
 
 # ---------------------------------------------------------------------------
 # Source files and the repo model
@@ -114,14 +214,15 @@ class Finding:
 
 
 class SourceFile:
-    def __init__(self, root: Path, path: Path):
+    def __init__(self, root: Path, path: Path, is_test: bool = False):
         self.abspath = path
         self.relpath = path.relative_to(root).as_posix()
+        self.is_test = is_test
         self.text = path.read_text(encoding="utf-8", errors="replace")
         try:
             self.tree = ast.parse(self.text)
             self.parse_error = None
-        except SyntaxError as exc:  # surfaced as a finding by run_all
+        except SyntaxError as exc:  # surfaced as a finding by the engine
             self.tree = ast.Module(body=[], type_ignores=[])
             self.parse_error = f"{exc.msg} (line {exc.lineno})"
         self.pragmas = self._scan_pragmas(self.text)
@@ -157,31 +258,65 @@ class SourceFile:
         return out
 
     def suppressed(self, finding: Finding) -> bool:
-        anchors = (finding.line,) + tuple(finding.extra_anchor_lines)
-        for ln in anchors:
-            for candidate in (ln, ln - 1):
-                rules = self.pragmas.get(candidate)
-                if rules and finding.rule in rules:
-                    return True
-        return False
+        return _suppressed_by(self.pragmas, finding)
+
+
+def _suppressed_by(pragmas: dict, finding: Finding) -> bool:
+    """Pragma check against a {line: {rules}} map (live or cached)."""
+    anchors = (finding.line,) + tuple(finding.extra_anchor_lines)
+    for ln in anchors:
+        for candidate in (ln, ln - 1):
+            rules = pragmas.get(candidate)
+            if rules and finding.rule in rules:
+                return True
+    return False
 
 
 _SKIP_DIR_NAMES = {"__pycache__", ".git", "tests", ".pytest_cache"}
 
 
+def _discover_paths(root: Path):
+    """-> (production py paths, test py paths) under the scan roots."""
+    prod = []
+    for sub in ("paddle_tpu", "tools"):
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for p in base.rglob("*.py"):
+            if not _SKIP_DIR_NAMES.intersection(p.relative_to(root).parts):
+                prod.append(p)
+    prod.extend(p for p in root.glob("*.py"))
+    tests = []
+    tbase = root / "tests"
+    if tbase.is_dir():
+        tests = [
+            p
+            for p in tbase.rglob("*.py")
+            if "__pycache__" not in p.relative_to(root).parts
+        ]
+    return sorted(prod), sorted(tests)
+
+
 class Repo:
     """The set of files tpu-lint looks at.
 
-    ``files`` covers python sources under the scan roots (tests/ excluded so
-    rule fixtures there never trip the live-tree gate). ``doc_paths`` are the
+    ``files`` covers python sources under the scan roots; per-file rules run
+    on these. ``test_files`` covers the test tree — scanned only by the
+    drift rules that cross-check it (TPL009's drill coverage), so rule
+    fixtures there never trip the live-tree gate. ``doc_paths`` are the
     markdown files cross-checked by the drift rules.
     """
 
     def __init__(self, root, py_paths=None):
         self.root = Path(root).resolve()
         if py_paths is None:
-            py_paths = self._default_py_paths(self.root)
-        self.files = [SourceFile(self.root, p) for p in sorted(py_paths)]
+            py_paths, test_paths = _discover_paths(self.root)
+        else:
+            py_paths, test_paths = sorted(py_paths), []
+        self.files = [SourceFile(self.root, p) for p in py_paths]
+        self.test_files = [
+            SourceFile(self.root, p, is_test=True) for p in test_paths
+        ]
         self.readme = self._read_doc("README.md")
         self.migration = self._read_doc("MIGRATION.md")
 
@@ -189,21 +324,8 @@ class Repo:
         p = self.root / name
         return p.read_text(encoding="utf-8", errors="replace") if p.is_file() else None
 
-    @staticmethod
-    def _default_py_paths(root: Path):
-        out = []
-        for sub in ("paddle_tpu", "tools"):
-            base = root / sub
-            if not base.is_dir():
-                continue
-            for p in base.rglob("*.py"):
-                if not _SKIP_DIR_NAMES.intersection(p.relative_to(root).parts):
-                    out.append(p)
-        out.extend(p for p in root.glob("*.py"))
-        return out
-
     def file(self, relpath: str):
-        for f in self.files:
+        for f in self.files + self.test_files:
             if f.relpath == relpath:
                 return f
         return None
@@ -255,50 +377,253 @@ class Baseline:
         return miss, hit, stale
 
 
+def nearest_key(stale: str, current_keys) -> str:
+    """Closest current finding key to a stale baseline entry, or ''.
+
+    Same near-miss pattern flags.get_flags uses for unknown flag names —
+    a stale entry is usually a finding whose symbol/tag shifted, and the
+    nearest live key says where it went.
+    """
+    hits = difflib.get_close_matches(stale, list(current_keys), n=1, cutoff=0.6)
+    return hits[0] if hits else ""
+
+
 # ---------------------------------------------------------------------------
-# Runner
+# Engine: per-file lint + global reduce, with an optional findings cache
 # ---------------------------------------------------------------------------
 
 
-def run_all(repo: Repo, rules=None):
-    """Run every checker over the repo; returns pragma-filtered findings."""
+def _checkers():
     from . import (
         tpl001_trace_purity,
         tpl002_collective_order,
         tpl003_lock_discipline,
         tpl004_flags_drift,
         tpl005_metrics_drift,
+        tpl006_retrace_hazard,
+        tpl007_spmd_divergence,
+        tpl008_use_after_donate,
+        tpl009_chaos_coverage,
+        tpl010_refcount_pairing,
     )
 
-    checkers = {
-        "TPL001": tpl001_trace_purity.check,
-        "TPL002": tpl002_collective_order.check,
-        "TPL003": tpl003_lock_discipline.check,
-        "TPL004": tpl004_flags_drift.check,
-        "TPL005": tpl005_metrics_drift.check,
+    per_file = {
+        "TPL001": tpl001_trace_purity.check_file,
+        "TPL002": tpl002_collective_order.check_file,
+        "TPL003": tpl003_lock_discipline.check_file,
+        "TPL006": tpl006_retrace_hazard.check_file,
+        "TPL008": tpl008_use_after_donate.check_file,
+        "TPL010": tpl010_refcount_pairing.check_file,
     }
-    wanted = set(rules or RULES)
+    # rule -> (extract, reduce, extracts_from_tests)
+    global_rules = {
+        "TPL004": (tpl004_flags_drift.extract, tpl004_flags_drift.reduce, False),
+        "TPL005": (tpl005_metrics_drift.extract, tpl005_metrics_drift.reduce, False),
+        "TPL007": (
+            tpl007_spmd_divergence.extract,
+            tpl007_spmd_divergence.reduce,
+            False,
+        ),
+        "TPL009": (
+            tpl009_chaos_coverage.extract,
+            tpl009_chaos_coverage.reduce,
+            True,
+        ),
+    }
+    return per_file, global_rules
+
+
+def rules_hash() -> str:
+    """Content hash of the analysis package — editing any checker (or this
+    engine) invalidates every cache entry."""
+    h = hashlib.sha1()
+    pkg = Path(__file__).resolve().parent
+    for p in sorted(pkg.glob("*.py")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def _lint_one(sf: SourceFile, known_paths, timings) -> dict:
+    """Full per-file pass -> cache record (raw findings + facts + pragmas)."""
+    per_file, global_rules = _checkers()
     findings = []
-    for f in repo.files:
-        if f.parse_error:
-            findings.append(
-                Finding(
-                    rule="TPL001",
-                    path=f.relpath,
-                    line=1,
-                    message=f"file does not parse: {f.parse_error}",
-                    hint="fix the syntax error so the tree is analyzable",
-                    tag="syntax-error",
-                )
+    if sf.parse_error:
+        findings.append(
+            Finding(
+                rule="TPL001",
+                path=sf.relpath,
+                line=1,
+                message=f"file does not parse: {sf.parse_error}",
+                hint="fix the syntax error so the tree is analyzable",
+                tag="syntax-error",
             )
-    for rule, fn in checkers.items():
-        if rule in wanted:
-            findings.extend(fn(repo))
+        )
+    if not sf.is_test:
+        for rule, fn in per_file.items():
+            t0 = time.perf_counter()
+            findings.extend(fn(sf))
+            timings[rule] = timings.get(rule, 0.0) + time.perf_counter() - t0
+    facts = {}
+    for rule, (extract, _reduce, from_tests) in global_rules.items():
+        if sf.is_test and not from_tests:
+            continue
+        t0 = time.perf_counter()
+        blob = extract(sf, known_paths)
+        timings[rule] = timings.get(rule, 0.0) + time.perf_counter() - t0
+        if blob:
+            facts[rule] = blob
+    return {
+        "is_test": sf.is_test,
+        "pragmas": {str(ln): sorted(rules) for ln, rules in sf.pragmas.items()},
+        "findings": [f.to_cache() for f in findings],
+        "facts": facts,
+    }
+
+
+class _DocsCtx:
+    """What global reducers need besides per-file facts."""
+
+    def __init__(self, root: Path, readme, migration):
+        self.root = root
+        self.readme = readme
+        self.migration = migration
+
+
+def _finish(records, ctx, rules, timings):
+    """Reduce globals, apply pragmas + rule filter, sort. -> findings list."""
+    _per_file, global_rules = _checkers()
+    findings = []
+    for rec in records.values():
+        findings.extend(Finding.from_cache(d) for d in rec["findings"])
+    for rule, (_extract, reduce_fn, _ft) in global_rules.items():
+        t0 = time.perf_counter()
+        findings.extend(reduce_fn(ctx, records))
+        timings[rule] = timings.get(rule, 0.0) + time.perf_counter() - t0
+    wanted = set(rules or RULES)
     out = []
     for f in findings:
-        sf = repo.file(f.path)
-        if sf is not None and sf.suppressed(f):
+        if f.rule not in wanted:
             continue
+        rec = records.get(f.path)
+        if rec is not None:
+            pragmas = {
+                int(ln): set(rs) for ln, rs in rec["pragmas"].items()
+            }
+            if _suppressed_by(pragmas, f):
+                continue
         out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.rule, f.tag))
     return out
+
+
+def run_all(repo: Repo, rules=None):
+    """Run every checker over an in-memory Repo (no cache); returns
+    pragma-filtered findings. Back-compat surface for tests and fixtures."""
+    timings = {}
+    known_paths = {sf.relpath for sf in repo.files + repo.test_files}
+    records = {
+        sf.relpath: _lint_one(sf, known_paths, timings)
+        for sf in repo.files + repo.test_files
+    }
+    ctx = _DocsCtx(repo.root, repo.readme, repo.migration)
+    return _finish(records, ctx, rules, timings)
+
+
+@dataclass
+class LintResult:
+    findings: list
+    timings: dict
+    files_scanned: int = 0
+    files_linted: int = 0
+    files_cached: int = 0
+    cache_state: str = "off"  # off | cold | warm | partial
+
+
+def lint_tree(root, cache_path=None, rules=None, only_paths=None) -> LintResult:
+    """Cached whole-tree lint. ``only_paths`` (repo-relative) restricts
+    *per-file* findings to that subset (--changed); global rules always
+    reduce over the whole tree's facts so cross-file drift stays sound."""
+    root = Path(root).resolve()
+    prod_paths, test_paths = _discover_paths(root)
+    all_paths = [(p, False) for p in prod_paths] + [(p, True) for p in test_paths]
+
+    cache = {}
+    rhash = rules_hash()
+    if cache_path is not None and Path(cache_path).is_file():
+        try:
+            raw = json.loads(Path(cache_path).read_text(encoding="utf-8"))
+            if raw.get("version") == _CACHE_VERSION and raw.get("rules_hash") == rhash:
+                cache = raw.get("files", {})
+        except (ValueError, OSError):
+            cache = {}
+
+    timings = {}
+    records = {}
+    meta = {}
+    linted = cached = 0
+    known_paths = {
+        p.relative_to(root).as_posix() for p, _t in all_paths
+    }
+    for p, is_test in all_paths:
+        rel = p.relative_to(root).as_posix()
+        st = p.stat()
+        ent = cache.get(rel)
+        if (
+            ent is not None
+            and ent.get("mtime") == st.st_mtime
+            and ent.get("size") == st.st_size
+        ):
+            records[rel] = ent["record"]
+            meta[rel] = {"mtime": st.st_mtime, "size": st.st_size}
+            cached += 1
+            continue
+        sf = SourceFile(root, p, is_test=is_test)
+        records[rel] = _lint_one(sf, known_paths, timings)
+        meta[rel] = {"mtime": st.st_mtime, "size": st.st_size}
+        linted += 1
+
+    if cache_path is not None:
+        payload = {
+            "version": _CACHE_VERSION,
+            "rules_hash": rhash,
+            "files": {
+                rel: {**meta[rel], "record": records[rel]} for rel in records
+            },
+        }
+        tmp = Path(str(cache_path) + ".tmp")
+        try:
+            tmp.write_text(json.dumps(payload), encoding="utf-8")
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+
+    ctx = _DocsCtx(
+        root,
+        _read_doc(root, "README.md"),
+        _read_doc(root, "MIGRATION.md"),
+    )
+    findings = _finish(records, ctx, rules, timings)
+    if only_paths is not None:
+        keep = set(only_paths)
+        findings = [
+            f
+            for f in findings
+            if f.path in keep or f.rule in GLOBAL_RULES
+        ]
+    state = "off"
+    if cache_path is not None:
+        state = "warm" if linted == 0 else ("cold" if cached == 0 else "partial")
+    return LintResult(
+        findings=findings,
+        timings={r: round(t, 4) for r, t in sorted(timings.items())},
+        files_scanned=len(records),
+        files_linted=linted,
+        files_cached=cached,
+        cache_state=state,
+    )
+
+
+def _read_doc(root: Path, name: str):
+    p = root / name
+    return p.read_text(encoding="utf-8", errors="replace") if p.is_file() else None

@@ -176,50 +176,49 @@ def _violation(node, params) -> tuple:
     return None
 
 
-def check(repo):
+def check_file(sf):
     findings = []
-    for sf in repo.files:
-        if "jax" not in sf.text:
+    if "jax" not in sf.text:
+        return findings
+    index = sf.index()
+    seen_entries = set()
+    for entry, entry_name in _entries(index):
+        if id(entry) in seen_entries:
             continue
-        index = sf.index()
-        seen_entries = set()
-        for entry, entry_name in _entries(index):
-            if id(entry) in seen_entries:
-                continue
-            seen_entries.add(id(entry))
-            if isinstance(entry, ast.Lambda):
-                region = [entry]
-            else:
-                region = walk_traced(index, entry)
-            for fn in region:
-                params = {
-                    a.arg
-                    for a in getattr(fn.args, "args", [])
-                    + getattr(fn.args, "posonlyargs", [])
-                    + getattr(fn.args, "kwonlyargs", [])
-                }
-                for node in ast.walk(fn):
-                    hit = _violation(node, params)
-                    if hit is None:
-                        continue
-                    slug, message, hint = hit
-                    sym = (
-                        index.qualname(fn)
-                        if not isinstance(fn, ast.Lambda)
-                        else entry_name
+        seen_entries.add(id(entry))
+        if isinstance(entry, ast.Lambda):
+            region = [entry]
+        else:
+            region = walk_traced(index, entry)
+        for fn in region:
+            params = {
+                a.arg
+                for a in getattr(fn.args, "args", [])
+                + getattr(fn.args, "posonlyargs", [])
+                + getattr(fn.args, "kwonlyargs", [])
+            }
+            for node in ast.walk(fn):
+                hit = _violation(node, params)
+                if hit is None:
+                    continue
+                slug, message, hint = hit
+                sym = (
+                    index.qualname(fn)
+                    if not isinstance(fn, ast.Lambda)
+                    else entry_name
+                )
+                findings.append(
+                    Finding(
+                        rule="TPL001",
+                        path=sf.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        symbol=sym,
+                        tag=slug,
+                        message=f"{message} (traced via jitted entry `{entry_name}`)",
+                        hint=hint,
                     )
-                    findings.append(
-                        Finding(
-                            rule="TPL001",
-                            path=sf.relpath,
-                            line=node.lineno,
-                            col=node.col_offset,
-                            symbol=sym,
-                            tag=slug,
-                            message=f"{message} (traced via jitted entry `{entry_name}`)",
-                            hint=hint,
-                        )
-                    )
+                )
     # de-dup: one node can be reached from several entries
     uniq = {}
     for f in findings:

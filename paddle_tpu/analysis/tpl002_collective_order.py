@@ -74,44 +74,24 @@ def _test_reads_tensor(test) -> str:
     return ""
 
 
-def check(repo):
+def check_file(sf):
     findings = []
-    for sf in repo.files:
-        index = sf.index()
-        in_fenced_module = sf.relpath == _FENCED_MODULE
-        for node in sf.walk():
-            if not isinstance(node, ast.Call):
-                continue
-            sym = ""
-            fn = index.enclosing_function(node)
-            if fn is not None:
-                sym = index.qualname(fn)
+    index = sf.index()
+    in_fenced_module = sf.relpath == _FENCED_MODULE
+    for node in sf.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        sym = ""
+        fn = index.enclosing_function(node)
+        if fn is not None:
+            sym = index.qualname(fn)
 
-            op = is_collective_call(node)
-            if op:
-                for anc in index.ancestors(node):
-                    if isinstance(anc, (ast.If, ast.While)):
-                        frag = _test_reads_tensor(anc.test)
-                        if frag:
-                            findings.append(
-                                Finding(
-                                    rule="TPL002",
-                                    path=sf.relpath,
-                                    line=node.lineno,
-                                    col=node.col_offset,
-                                    symbol=sym,
-                                    tag=f"data-dep-branch:{op}",
-                                    message=(
-                                        f"collective `{op}` issued under a data-dependent "
-                                        f"branch (test reads tensor data via {frag}); "
-                                        "ranks can disagree and deadlock"
-                                    ),
-                                    hint="issue unconditionally, branch on the replicated result",
-                                    extra_anchor_lines=(anc.lineno,),
-                                )
-                            )
-                            break
-                    if isinstance(anc, ast.ExceptHandler):
+        op = is_collective_call(node)
+        if op:
+            for anc in index.ancestors(node):
+                if isinstance(anc, (ast.If, ast.While)):
+                    frag = _test_reads_tensor(anc.test)
+                    if frag:
                         findings.append(
                             Finding(
                                 rule="TPL002",
@@ -119,55 +99,18 @@ def check(repo):
                                 line=node.lineno,
                                 col=node.col_offset,
                                 symbol=sym,
-                                tag=f"except-issue:{op}",
+                                tag=f"data-dep-branch:{op}",
                                 message=(
-                                    f"collective `{op}` issued inside an `except` handler: "
-                                    "only the failing rank issues it, peers hang"
+                                    f"collective `{op}` issued under a data-dependent "
+                                    f"branch (test reads tensor data via {frag}); "
+                                    "ranks can disagree and deadlock"
                                 ),
-                                hint="recover via the epoch fence / gang restart, not an ad-hoc collective",
+                                hint="issue unconditionally, branch on the replicated result",
                                 extra_anchor_lines=(anc.lineno,),
                             )
                         )
                         break
-
-            # .wait() inside a no_sync() block
-            if (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr == "wait"
-                and not node.args
-            ):
-                for anc in index.ancestors(node):
-                    if isinstance(anc, ast.With):
-                        for item in anc.items:
-                            ctx = item.context_expr
-                            d = dotted(ctx.func) if isinstance(ctx, ast.Call) else dotted(ctx)
-                            if d.rsplit(".", 1)[-1] == "no_sync":
-                                findings.append(
-                                    Finding(
-                                        rule="TPL002",
-                                        path=sf.relpath,
-                                        line=node.lineno,
-                                        col=node.col_offset,
-                                        symbol=sym,
-                                        tag="wait-in-no-sync",
-                                        message=(
-                                            "`.wait()` inside `no_sync()`: gradient-sync "
-                                            "elision must not complete comm tasks"
-                                        ),
-                                        hint="wait after the no_sync block closes",
-                                        extra_anchor_lines=(anc.lineno,),
-                                    )
-                                )
-                                break
-
-            # fence bypass from outside the fenced module
-            if not in_fenced_module:
-                leaf = ""
-                if isinstance(node.func, ast.Attribute):
-                    leaf = node.func.attr
-                elif isinstance(node.func, ast.Name):
-                    leaf = node.func.id
-                if leaf in _FENCE_INTERNALS:
+                if isinstance(anc, ast.ExceptHandler):
                     findings.append(
                         Finding(
                             rule="TPL002",
@@ -175,12 +118,68 @@ def check(repo):
                             line=node.lineno,
                             col=node.col_offset,
                             symbol=sym,
-                            tag=f"fence-bypass:{leaf}",
+                            tag=f"except-issue:{op}",
                             message=(
-                                f"`{leaf}` called outside distributed/collective.py "
-                                "bypasses the epoch-fenced issue path"
+                                f"collective `{op}` issued inside an `except` handler: "
+                                "only the failing rank issues it, peers hang"
                             ),
-                            hint="go through the public collective.* wrappers (they stamp and check the epoch)",
+                            hint="recover via the epoch fence / gang restart, not an ad-hoc collective",
+                            extra_anchor_lines=(anc.lineno,),
                         )
                     )
+                    break
+
+        # .wait() inside a no_sync() block
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait"
+            and not node.args
+        ):
+            for anc in index.ancestors(node):
+                if isinstance(anc, ast.With):
+                    for item in anc.items:
+                        ctx = item.context_expr
+                        d = dotted(ctx.func) if isinstance(ctx, ast.Call) else dotted(ctx)
+                        if d.rsplit(".", 1)[-1] == "no_sync":
+                            findings.append(
+                                Finding(
+                                    rule="TPL002",
+                                    path=sf.relpath,
+                                    line=node.lineno,
+                                    col=node.col_offset,
+                                    symbol=sym,
+                                    tag="wait-in-no-sync",
+                                    message=(
+                                        "`.wait()` inside `no_sync()`: gradient-sync "
+                                        "elision must not complete comm tasks"
+                                    ),
+                                    hint="wait after the no_sync block closes",
+                                    extra_anchor_lines=(anc.lineno,),
+                                )
+                            )
+                            break
+
+        # fence bypass from outside the fenced module
+        if not in_fenced_module:
+            leaf = ""
+            if isinstance(node.func, ast.Attribute):
+                leaf = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                leaf = node.func.id
+            if leaf in _FENCE_INTERNALS:
+                findings.append(
+                    Finding(
+                        rule="TPL002",
+                        path=sf.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        symbol=sym,
+                        tag=f"fence-bypass:{leaf}",
+                        message=(
+                            f"`{leaf}` called outside distributed/collective.py "
+                            "bypasses the epoch-fenced issue path"
+                        ),
+                        hint="go through the public collective.* wrappers (they stamp and check the epoch)",
+                    )
+                )
     return findings

@@ -94,6 +94,41 @@ def _lock_name(with_item) -> str:
     return text if "lock" in head.lower() else ""
 
 
+def _with_locks(node: ast.With):
+    """Every lock this ``with`` statement acquires -> [(name, anchor_line)].
+
+    Covers the single-item form, multi-item ``with self._lock, cv:`` (any
+    item position), and ``with contextlib.ExitStack() as st:`` bodies that
+    acquire via ``st.enter_context(<lock>)`` — the lock is held from the
+    enter_context call to the end of the with body, which for a lexical
+    checker is the whole body.
+    """
+    out = []
+    for item in node.items:
+        name = _lock_name(item)
+        if name:
+            out.append((name, node.lineno))
+    if not out and any(
+        isinstance(item.context_expr, ast.Call)
+        and dotted(item.context_expr.func).rsplit(".", 1)[-1] == "ExitStack"
+        for item in node.items
+    ):
+        for inner in ast.walk(node):
+            if (
+                isinstance(inner, ast.Call)
+                and isinstance(inner.func, ast.Attribute)
+                and inner.func.attr == "enter_context"
+                and inner.args
+            ):
+                try:
+                    text = ast.unparse(inner.args[0])
+                except Exception:
+                    continue
+                if "lock" in text.split("(")[0].lower():
+                    out.append((text, inner.lineno))
+    return out
+
+
 def _fn_blocking_sites(fn) -> list:
     """(call node, reason) for direct blocking calls anywhere in ``fn``."""
     out = []
@@ -105,66 +140,62 @@ def _fn_blocking_sites(fn) -> list:
     return out
 
 
-def check(repo):
+def check_file(sf):
     findings = []
-    for sf in repo.files:
-        if "lock" not in sf.text.lower():
+    if "lock" not in sf.text.lower():
+        return findings
+    index = sf.index()
+    for node in sf.walk():
+        if not isinstance(node, ast.With):
             continue
-        index = sf.index()
-        for node in sf.walk():
-            if not isinstance(node, ast.With):
+        locks = _with_locks(node)
+        if not locks:
+            continue
+        lock, anchor = locks[0]
+        sym_fn = index.enclosing_function(node)
+        sym = index.qualname(sym_fn) if sym_fn is not None else ""
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
                 continue
-            lock = ""
-            for item in node.items:
-                lock = _lock_name(item)
-                if lock:
-                    break
-            if not lock:
+            reason = blocking_reason(inner)
+            if reason:
+                findings.append(
+                    Finding(
+                        rule="TPL003",
+                        path=sf.relpath,
+                        line=inner.lineno,
+                        col=inner.col_offset,
+                        symbol=sym,
+                        tag=f"direct:{reason}",
+                        message=f"blocking op ({reason}) inside `with {lock}:`",
+                        hint="snapshot state under the lock, release it, then block",
+                        extra_anchor_lines=(node.lineno, anchor),
+                    )
+                )
                 continue
-            sym_fn = index.enclosing_function(node)
-            sym = index.qualname(sym_fn) if sym_fn is not None else ""
-            for inner in ast.walk(node):
-                if not isinstance(inner, ast.Call):
-                    continue
-                reason = blocking_reason(inner)
-                if reason:
-                    findings.append(
-                        Finding(
-                            rule="TPL003",
-                            path=sf.relpath,
-                            line=inner.lineno,
-                            col=inner.col_offset,
-                            symbol=sym,
-                            tag=f"direct:{reason}",
-                            message=f"blocking op ({reason}) inside `with {lock}:`",
-                            hint="snapshot state under the lock, release it, then block",
-                            extra_anchor_lines=(node.lineno,),
-                        )
+            # transitive: a local function/method called under the lock
+            # that itself blocks (depth 2 through one more local hop)
+            target = index.resolve_call(inner)
+            if target is None or target is sym_fn:
+                continue
+            chain = _transitive_reason(index, target, depth=2)
+            if chain:
+                findings.append(
+                    Finding(
+                        rule="TPL003",
+                        path=sf.relpath,
+                        line=inner.lineno,
+                        col=inner.col_offset,
+                        symbol=sym,
+                        tag=f"via:{target.name}:{chain[-1]}",
+                        message=(
+                            f"call under `with {lock}:` reaches blocking op "
+                            f"({chain[-1]}) via {' -> '.join(chain[:-1]) or target.name}"
+                        ),
+                        hint="move the blocking call out from under the lock",
+                        extra_anchor_lines=(node.lineno, anchor),
                     )
-                    continue
-                # transitive: a local function/method called under the lock
-                # that itself blocks (depth 2 through one more local hop)
-                target = index.resolve_call(inner)
-                if target is None or target is sym_fn:
-                    continue
-                chain = _transitive_reason(index, target, depth=2)
-                if chain:
-                    findings.append(
-                        Finding(
-                            rule="TPL003",
-                            path=sf.relpath,
-                            line=inner.lineno,
-                            col=inner.col_offset,
-                            symbol=sym,
-                            tag=f"via:{target.name}:{chain[-1]}",
-                            message=(
-                                f"call under `with {lock}:` reaches blocking op "
-                                f"({chain[-1]}) via {' -> '.join(chain[:-1]) or target.name}"
-                            ),
-                            hint="move the blocking call out from under the lock",
-                            extra_anchor_lines=(node.lineno,),
-                        )
-                    )
+                )
     return findings
 
 

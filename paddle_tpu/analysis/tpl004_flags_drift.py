@@ -9,6 +9,9 @@ Three drift directions, all machine-checked:
 - registry vs MIGRATION.md flag tables: registered-but-undocumented and
   documented-but-unregistered both fire (doc findings anchor to
   MIGRATION.md and can only be baselined, not pragma'd).
+
+Global rule: ``extract`` records registrations/reads per file (cacheable),
+``reduce`` cross-checks the union against MIGRATION.md every run.
 """
 
 from __future__ import annotations
@@ -17,7 +20,7 @@ import ast
 import re
 
 from .core import Finding
-from .callgraph import ModuleIndex, dotted
+from .callgraph import dotted
 
 _FLAGS_TOKEN = re.compile(r"FLAGS_([A-Za-z0-9_]+)")
 
@@ -30,65 +33,73 @@ def _norm(name: str) -> str:
     return name[6:] if name.startswith("FLAGS_") else name
 
 
-def collect_registrations(repo):
-    """{flag name: (SourceFile, define_flag call node, help text or None)}."""
-    regs = {}
-    for sf in repo.files:
-        if "define_flag" not in sf.text:
+def _file_registrations(sf):
+    """[(name, line, col, help text or None)] for define_flag calls."""
+    regs = []
+    if "define_flag" not in sf.text:
+        return regs
+    for node in sf.walk():
+        if not isinstance(node, ast.Call):
             continue
-        for node in sf.walk():
-            if not isinstance(node, ast.Call):
-                continue
-            leaf = dotted(node.func).rsplit(".", 1)[-1]
-            if leaf != "define_flag" or not node.args:
-                continue
-            name = _const_str(node.args[0])
-            if name is None:
-                continue
-            help_text = None
-            if len(node.args) >= 3:
-                help_text = _const_str(node.args[2])
-            for kw in node.keywords:
-                if kw.arg == "help":
-                    help_text = _const_str(kw.value)
-            regs[name] = (sf, node, help_text)
+        leaf = dotted(node.func).rsplit(".", 1)[-1]
+        if leaf != "define_flag" or not node.args:
+            continue
+        name = _const_str(node.args[0])
+        if name is None:
+            continue
+        help_text = None
+        if len(node.args) >= 3:
+            help_text = _const_str(node.args[2])
+        for kw in node.keywords:
+            if kw.arg == "help":
+                help_text = _const_str(kw.value)
+        regs.append((name, node.lineno, node.col_offset, help_text))
     return regs
 
 
-def collect_reads(repo):
-    """Yield (SourceFile, node, flag name) for every constant-name flag read."""
-    for sf in repo.files:
-        for node in sf.walk():
-            if isinstance(node, ast.Call):
-                leaf = dotted(node.func).rsplit(".", 1)[-1]
-                if leaf == "flag_value" and node.args:
-                    name = _const_str(node.args[0])
-                    if name is not None:
-                        yield sf, node, _norm(name)
-                elif leaf in ("get_flags", "set_flags") and node.args:
-                    arg = node.args[0]
-                    if isinstance(arg, (ast.List, ast.Tuple)):
-                        for el in arg.elts:
-                            name = _const_str(el)
-                            if name is not None:
-                                yield sf, node, _norm(name)
-                    elif isinstance(arg, ast.Dict):
-                        for k in arg.keys:
-                            name = _const_str(k)
-                            if name is not None:
-                                yield sf, node, _norm(name)
-                    else:
-                        name = _const_str(arg)
+def _file_reads(sf):
+    """[(flag name, line, col)] for every constant-name flag read."""
+    out = []
+    for node in sf.walk():
+        if isinstance(node, ast.Call):
+            leaf = dotted(node.func).rsplit(".", 1)[-1]
+            if leaf == "flag_value" and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    out.append((_norm(name), node.lineno, node.col_offset))
+            elif leaf in ("get_flags", "set_flags") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.List, ast.Tuple)):
+                    for el in arg.elts:
+                        name = _const_str(el)
                         if name is not None:
-                            yield sf, node, _norm(name)
-                elif dotted(node.func) in ("os.getenv", "os.environ.get") and node.args:
-                    name = _const_str(node.args[0])
-                    if name and name.startswith("FLAGS_"):
-                        yield sf, node, _norm(name)
-            elif isinstance(node, ast.Subscript) and dotted(node.value) == "os.environ":
-                name = _const_str(node.slice)
+                            out.append((_norm(name), node.lineno, node.col_offset))
+                elif isinstance(arg, ast.Dict):
+                    for k in arg.keys:
+                        name = _const_str(k)
+                        if name is not None:
+                            out.append((_norm(name), node.lineno, node.col_offset))
+                else:
+                    name = _const_str(arg)
+                    if name is not None:
+                        out.append((_norm(name), node.lineno, node.col_offset))
+            elif dotted(node.func) in ("os.getenv", "os.environ.get") and node.args:
+                name = _const_str(node.args[0])
                 if name and name.startswith("FLAGS_"):
-                    yield sf, node, _norm(name)
+                    out.append((_norm(name), node.lineno, node.col_offset))
+        elif isinstance(node, ast.Subscript) and dotted(node.value) == "os.environ":
+            name = _const_str(node.slice)
+            if name and name.startswith("FLAGS_"):
+                out.append((_norm(name), node.lineno, node.col_offset))
+    return out
+
+
+def extract(sf, known_paths):
+    regs = _file_registrations(sf)
+    reads = _file_reads(sf)
+    if not regs and not reads:
+        return {}
+    return {"regs": regs, "reads": reads}
 
 
 def _doc_mentions(text):
@@ -100,48 +111,57 @@ def _doc_mentions(text):
     return out
 
 
-def check(repo):
+def reduce(ctx, records):
     findings = []
-    regs = collect_registrations(repo)
+    regs = {}  # name -> (path, line, col, help)
+    reads = []  # (path, name, line, col)
+    for path, rec in sorted(records.items()):
+        facts = rec.get("facts", {}).get("TPL004")
+        if not facts:
+            continue
+        for name, line, col, help_text in facts["regs"]:
+            regs.setdefault(name, (path, line, col, help_text))
+        for name, line, col in facts["reads"]:
+            reads.append((path, name, line, col))
 
-    for name, (sf, node, help_text) in regs.items():
+    for name, (path, line, col, help_text) in sorted(regs.items()):
         if not (help_text or "").strip():
             findings.append(
                 Finding(
                     rule="TPL004",
-                    path=sf.relpath,
-                    line=node.lineno,
-                    col=node.col_offset,
+                    path=path,
+                    line=line,
+                    col=col,
                     tag=f"empty-help:{name}",
                     message=f"define_flag(\"{name}\", ...) has empty help text",
                     hint="say what the flag does and when to flip it",
                 )
             )
 
-    for sf, node, name in collect_reads(repo):
+    for path, name, line, col in reads:
         if name not in regs:
             findings.append(
                 Finding(
                     rule="TPL004",
-                    path=sf.relpath,
-                    line=node.lineno,
-                    col=node.col_offset,
+                    path=path,
+                    line=line,
+                    col=col,
                     tag=f"unregistered-read:{name}",
                     message=f"flag `{name}` is read here but never registered via define_flag",
                     hint="register it (with help text) or fix the name",
                 )
             )
 
-    if repo.migration is not None:
-        doc = _doc_mentions(repo.migration)
-        for name, (sf, node, _h) in sorted(regs.items()):
+    if ctx.migration is not None:
+        doc = _doc_mentions(ctx.migration)
+        for name, (path, line, col, _h) in sorted(regs.items()):
             if name not in doc:
                 findings.append(
                     Finding(
                         rule="TPL004",
-                        path=sf.relpath,
-                        line=node.lineno,
-                        col=node.col_offset,
+                        path=path,
+                        line=line,
+                        col=col,
                         tag=f"undocumented:{name}",
                         message=f"flag `{name}` is registered but absent from the MIGRATION.md flag tables",
                         hint="add a row to the MIGRATION.md flags table",

@@ -13,6 +13,10 @@ names in the registry. Drift shapes flagged:
 - ops.yaml vs generated bindings: an op declared in the YAML manifest with
   no generated binding, or a generated binding with no YAML entry (the
   reference's op-YAML generator consistency check, statically enforced).
+
+Global rule: ``extract`` records emits/handlers/registrations/uses per file
+(cacheable), ``reduce`` cross-checks the union against README and ops.yaml
+every run.
 """
 
 from __future__ import annotations
@@ -49,91 +53,102 @@ def _is_metric_name(s: str) -> bool:
     )
 
 
-def _emit_kinds_used(repo):
-    """{kind: (SourceFile, node)} for every constant-kind emit() call."""
-    out = {}
-    for sf in repo.files:
-        for node in sf.walk():
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            leaf = dotted(node.func).rsplit(".", 1)[-1]
-            if leaf != "emit" and not leaf.endswith("_emit"):
-                continue
-            kind = _const_str(node.args[0])
-            if kind:
-                out.setdefault(kind, (sf, node))
+def _file_emits(sf):
+    """[(kind, line, col)] for constant-kind emit() calls."""
+    out = []
+    for node in sf.walk():
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        leaf = dotted(node.func).rsplit(".", 1)[-1]
+        if leaf != "emit" and not leaf.endswith("_emit"):
+            continue
+        kind = _const_str(node.args[0])
+        if kind:
+            out.append((kind, node.lineno, node.col_offset))
     return out
 
 
-def _handler_kinds(repo):
-    """{kind: (SourceFile, lineno)} from `_HANDLERS = {...}` dict literals
-    plus later `_HANDLERS["kind"] = ...` assignments. Returns None when no
-    handler table exists in the scanned tree (fixture mode without one)."""
+def _file_handlers(sf):
+    """(-> found any table?, [(kind, line)]) from `_HANDLERS = {...}` dict
+    literals plus later `_HANDLERS["kind"] = ...` assignments."""
     found = False
-    out = {}
-    files = sorted(repo.files, key=lambda f: f.relpath != _HANDLERS_FILE)
-    for sf in files:
-        if "_HANDLERS" not in sf.text:
+    out = []
+    if "_HANDLERS" not in sf.text:
+        return False, out
+    for node in sf.walk():
+        if not isinstance(node, ast.Assign):
             continue
-        for node in sf.walk():
-            if isinstance(node, ast.Assign):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name) and tgt.id == "_HANDLERS" and isinstance(
-                        node.value, ast.Dict
-                    ):
-                        found = True
-                        for k in node.value.keys:
-                            kind = _const_str(k)
-                            if kind:
-                                out.setdefault(kind, (sf, k.lineno))
-                    elif (
-                        isinstance(tgt, ast.Subscript)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id == "_HANDLERS"
-                    ):
-                        kind = _const_str(tgt.slice)
-                        if kind:
-                            found = True
-                            out.setdefault(kind, (sf, node.lineno))
-    return out if found else None
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "_HANDLERS" and isinstance(
+                node.value, ast.Dict
+            ):
+                found = True
+                for k in node.value.keys:
+                    kind = _const_str(k)
+                    if kind:
+                        out.append((kind, k.lineno))
+            elif (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "_HANDLERS"
+            ):
+                kind = _const_str(tgt.slice)
+                if kind:
+                    found = True
+                    out.append((kind, node.lineno))
+    return found, out
 
 
-def _registered_metrics(repo):
-    names = set()
-    for sf in repo.files:
-        for node in sf.walk():
-            if not isinstance(node, ast.Call) or not node.args:
+def _file_metrics(sf):
+    """-> (registered names, [(used name, line, col)] outside registrations)."""
+    regs = []
+    reg_arg_ids = set()
+    for node in sf.walk():
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        leaf = dotted(node.func).rsplit(".", 1)[-1]
+        if leaf in _REG_LEAVES:
+            reg_arg_ids.add(id(node.args[0]))
+            name = _const_str(node.args[0])
+            if name and _is_metric_name(name):
+                regs.append(name)
+    uses = []
+    for node in sf.walk():
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) in reg_arg_ids:
                 continue
-            leaf = dotted(node.func).rsplit(".", 1)[-1]
-            if leaf in _REG_LEAVES:
-                name = _const_str(node.args[0])
-                if name and _is_metric_name(name):
-                    names.add(name)
-    return names
+            if _is_metric_name(node.value):
+                uses.append((node.value, node.lineno, node.col_offset))
+    return regs, uses
 
 
-def _metric_uses(repo, registered):
-    """(SourceFile, node, name) for paddle_* string constants outside
-    registration calls."""
-    for sf in repo.files:
-        reg_arg_ids = set()
-        for node in sf.walk():
-            if isinstance(node, ast.Call) and node.args:
-                leaf = dotted(node.func).rsplit(".", 1)[-1]
-                if leaf in _REG_LEAVES:
-                    reg_arg_ids.add(id(node.args[0]))
-        for node in sf.walk():
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                if id(node) in reg_arg_ids:
-                    continue
-                if _is_metric_name(node.value):
-                    yield sf, node, node.value
+def extract(sf, known_paths):
+    emits = _file_emits(sf)
+    has_table, handlers = _file_handlers(sf)
+    regs, uses = _file_metrics(sf)
+    facts = {}
+    if emits:
+        facts["emits"] = emits
+    if has_table:
+        facts["handlers"] = handlers
+    if regs:
+        facts["regs"] = regs
+    if uses:
+        facts["uses"] = uses
+    if sf.relpath == _BINDINGS:
+        facts["top_defs"] = [
+            (n.name, n.lineno)
+            for n in sf.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and not n.name.startswith("_")
+        ]
+    return facts
 
 
-def _check_ops_yaml(repo, findings):
-    yaml_path = repo.root / _OPS_YAML
-    bindings = repo.file(_BINDINGS)
-    if not yaml_path.is_file() or bindings is None:
+def _check_ops_yaml(ctx, records, findings):
+    yaml_path = ctx.root / _OPS_YAML
+    bindings = records.get(_BINDINGS, {}).get("facts", {}).get("TPL005")
+    if not yaml_path.is_file() or bindings is None or "top_defs" not in bindings:
         return
     yaml_ops = {}
     for ln, line in enumerate(
@@ -143,9 +158,8 @@ def _check_ops_yaml(repo, findings):
         if m:
             yaml_ops.setdefault(m.group(1), ln)
     gen_ops = {}
-    for node in bindings.tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and not node.name.startswith("_"):
-            gen_ops.setdefault(node.name, node.lineno)
+    for name, ln in bindings["top_defs"]:
+        gen_ops.setdefault(name, ln)
     for op, ln in sorted(yaml_ops.items()):
         if op not in gen_ops:
             findings.append(
@@ -173,58 +187,77 @@ def _check_ops_yaml(repo, findings):
             )
 
 
-def check(repo):
+def reduce(ctx, records):
     findings = []
 
-    used = _emit_kinds_used(repo)
-    handled = _handler_kinds(repo)
+    # the canonical handlers file wins the "first definition" slot so
+    # anchors stay stable when a second table shows up in a fixture
+    ordered = sorted(records.items(), key=lambda kv: (kv[0] != _HANDLERS_FILE, kv[0]))
+    used = {}  # kind -> (path, line, col)
+    handled = None  # kind -> (path, line); None when no table anywhere
+    registered = set()
+    uses = []  # (path, name, line, col)
+    for path, rec in ordered:
+        facts = rec.get("facts", {}).get("TPL005")
+        if not facts:
+            continue
+        for kind, line, col in facts.get("emits", ()):
+            used.setdefault(kind, (path, line, col))
+        if "handlers" in facts:
+            if handled is None:
+                handled = {}
+            for kind, line in facts["handlers"]:
+                handled.setdefault(kind, (path, line))
+        registered.update(facts.get("regs", ()))
+        for name, line, col in facts.get("uses", ()):
+            uses.append((path, name, line, col))
+
     if handled is not None:
-        for kind, (sf, node) in sorted(used.items()):
+        for kind, (path, line, col) in sorted(used.items()):
             if kind not in handled:
                 findings.append(
                     Finding(
                         rule="TPL005",
-                        path=sf.relpath,
-                        line=node.lineno,
-                        col=node.col_offset,
+                        path=path,
+                        line=line,
+                        col=col,
                         tag=f"unhandled-kind:{kind}",
                         message=f"emit kind `{kind}` has no _HANDLERS entry; the event is silently dropped",
                         hint="add a handler (and a metric) in observability/__init__.py",
                     )
                 )
-        for kind, (sf, ln) in sorted(handled.items()):
+        for kind, (path, line) in sorted(handled.items()):
             if kind not in used:
                 findings.append(
                     Finding(
                         rule="TPL005",
-                        path=sf.relpath,
-                        line=ln,
+                        path=path,
+                        line=line,
                         tag=f"unused-kind:{kind}",
                         message=f"_HANDLERS entry `{kind}` is never emitted by any scanned code",
                         hint="delete the dead handler or emit the kind",
                     )
                 )
 
-    registered = _registered_metrics(repo)
     if registered:
         seen = set()
-        for sf, node, name in _metric_uses(repo, registered):
+        for path, name, line, col in uses:
             if name in registered or name in seen:
                 continue
             seen.add(name)
             findings.append(
                 Finding(
                     rule="TPL005",
-                    path=sf.relpath,
-                    line=node.lineno,
-                    col=node.col_offset,
+                    path=path,
+                    line=line,
+                    col=col,
                     tag=f"unregistered-metric:{name}",
                     message=f"metric name `{name}` referenced but not registered",
                     hint="register it in observability/__init__.py or fix the name",
                 )
             )
-        if repo.readme is not None:
-            for ln, line in enumerate(repo.readme.splitlines(), start=1):
+        if ctx.readme is not None:
+            for ln, line in enumerate(ctx.readme.splitlines(), start=1):
                 for m in _DOC_METRIC_RE.finditer(line):
                     token = m.group(0).rstrip("*_")
                     if not token or token.startswith(_NOT_METRICS):
@@ -253,5 +286,5 @@ def check(repo):
                             )
                         )
 
-    _check_ops_yaml(repo, findings)
+    _check_ops_yaml(ctx, records, findings)
     return findings

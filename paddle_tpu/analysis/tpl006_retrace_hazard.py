@@ -1,0 +1,307 @@
+"""TPL006: retrace hazards around signature-keyed executable caches.
+
+The dispatch cache, bucket-plan cache, stage-executable cache and serving
+step cache all key compiled programs by a signature tuple. Anything the
+built executable depends on that is *not* in the key is a stale-serve or
+spurious-retrace bug waiting:
+
+- **unkeyed-flag**: a ``flag_value()`` / ``os.environ`` read inside a
+  cache-populating function whose value does not flow into the key
+  expression — flipping the flag keeps serving the old executable;
+- **loop-var-capture**: a jitted function defined inside a ``for`` loop
+  that closes over the loop variable — Python late binding means every
+  cached program sees the *final* iteration's value;
+- **unsorted-dict-iter**: dict iteration feeding a signature/key
+  constructor without ``sorted(...)`` — insertion order leaks into the key
+  and two semantically equal configs miss each other's cache entries.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import dotted
+
+_FLAG_READS = {"flag_value", "get_flags"}
+_JIT_WRAPPERS = {"jax.jit", "jax.shard_map", "shard_map.shard_map"}
+_PARTIALS = {"partial", "functools.partial"}
+_DICT_ITERS = {"items", "keys", "values"}
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_env_read(node) -> bool:
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        return d == "os.getenv" or d == "os.environ.get"
+    if isinstance(node, ast.Subscript):
+        return dotted(node.value) == "os.environ"
+    return False
+
+
+def _read_slug(node) -> str:
+    """'flag:name' / 'env:NAME' / generic slug for a hazard read site."""
+    if isinstance(node, ast.Call):
+        leaf = dotted(node.func).rsplit(".", 1)[-1]
+        arg = node.args[0] if node.args else None
+        name = arg.value if isinstance(arg, ast.Constant) and isinstance(arg.value, str) else "?"
+        if leaf in _FLAG_READS:
+            return f"flag:{name}"
+        return f"env:{name}"
+    if isinstance(node, ast.Subscript):
+        s = node.slice
+        name = s.value if isinstance(s, ast.Constant) and isinstance(s.value, str) else "?"
+        return f"env:{name}"
+    return "read"
+
+
+def _cache_key_exprs(fn):
+    """Key expressions of cache stores in ``fn``: ``<..cache..>[key] = ...``
+    and ``<..cache..>.setdefault(key, ...)``."""
+    keys = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    base = dotted(tgt.value).rsplit(".", 1)[-1].lower()
+                    if "cache" in base:
+                        keys.append(tgt.slice)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "setdefault" and node.args:
+                base = dotted(node.func.value).rsplit(".", 1)[-1].lower()
+                if "cache" in base:
+                    keys.append(node.args[0])
+    return keys
+
+
+def _key_feeding_names(fn, key_exprs):
+    """Names whose values (transitively, via straight-line assignments in
+    ``fn``) end up inside a cache key expression."""
+    feeding = set()
+    for k in key_exprs:
+        feeding |= _names_in(k)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            tgts = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            if tgts & feeding:
+                new = _names_in(node.value) - feeding
+                if new:
+                    feeding |= new
+                    changed = True
+    return feeding
+
+
+def _check_unkeyed_reads(sf, index, fn, findings):
+    key_exprs = _cache_key_exprs(fn)
+    if not key_exprs:
+        return
+    key_node_ids = set()
+    for k in key_exprs:
+        key_node_ids.update(id(n) for n in ast.walk(k))
+    feeding = _key_feeding_names(fn, key_exprs)
+    sym = index.qualname(fn)
+    for node in ast.walk(fn):
+        is_flag = (
+            isinstance(node, ast.Call)
+            and dotted(node.func).rsplit(".", 1)[-1] in _FLAG_READS
+        )
+        if not is_flag and not _is_env_read(node):
+            continue
+        if id(node) in key_node_ids:
+            continue  # read sits inside the key expression itself
+        # read assigned to a name that feeds the key?
+        assigned = None
+        for anc in index.ancestors(node):
+            if anc is fn:
+                break
+            if isinstance(anc, ast.Assign):
+                assigned = anc
+                break
+        if assigned is not None and any(
+            isinstance(t, ast.Name) and t.id in feeding for t in assigned.targets
+        ):
+            continue
+        slug = _read_slug(node)
+        findings.append(
+            Finding(
+                rule="TPL006",
+                path=sf.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                symbol=sym,
+                tag=f"unkeyed-{slug}",
+                message=(
+                    f"`{slug.replace(':', ' ')}` read inside cache-populating "
+                    f"`{fn.name}` does not feed the cache key: flipping it "
+                    "silently serves the stale executable"
+                ),
+                hint="fold the value into the signature tuple (or read it in the caller)",
+            )
+        )
+
+
+def _is_jitted_def(node) -> bool:
+    for dec in node.decorator_list:
+        if dotted(dec) in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            d = dotted(dec.func)
+            if d in _JIT_WRAPPERS:
+                return True
+            if d in _PARTIALS and any(dotted(a) in _JIT_WRAPPERS for a in dec.args):
+                return True
+    return False
+
+
+def _closure_locals(fn) -> set:
+    out = {
+        a.arg
+        for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs
+    }
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+    return out
+
+
+def _check_loop_capture(sf, index, findings):
+    for loop in sf.walk():
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        loop_vars = {
+            n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+        }
+        if not loop_vars:
+            continue
+        for node in ast.walk(loop):
+            closure = None
+            if isinstance(node, ast.FunctionDef) and _is_jitted_def(node):
+                closure = node
+            elif (
+                isinstance(node, ast.Call)
+                and dotted(node.func) in _JIT_WRAPPERS
+                and node.args
+                and isinstance(node.args[0], (ast.Lambda, ast.Name))
+            ):
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    closure = arg
+                else:
+                    target = index.resolve_name(arg.id, node)
+                    # only a def nested in this loop captures the loop var
+                    if target is not None and any(
+                        a is loop for a in index.ancestors(target)
+                    ):
+                        closure = target
+            if closure is None:
+                continue
+            local = _closure_locals(closure)
+            captured = sorted(
+                {
+                    n.id
+                    for n in ast.walk(closure)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in loop_vars
+                }
+                - local
+            )
+            for var in captured:
+                sym = (
+                    index.qualname(closure)
+                    if not isinstance(closure, ast.Lambda)
+                    else f"<lambda@{closure.lineno}>"
+                )
+                findings.append(
+                    Finding(
+                        rule="TPL006",
+                        path=sf.relpath,
+                        line=closure.lineno,
+                        col=closure.col_offset,
+                        symbol=sym,
+                        tag=f"loop-var-capture:{var}",
+                        message=(
+                            f"jitted closure captures loop variable `{var}` by "
+                            "reference: every cached executable sees the final "
+                            "iteration's value"
+                        ),
+                        hint=f"bind it at definition time: `{var}={var}` default arg or functools.partial",
+                        extra_anchor_lines=(loop.lineno,),
+                    )
+                )
+
+
+def _check_dict_iter(sf, index, fn, findings):
+    name = fn.name.lower()
+    if "signature" not in name and "key" not in name:
+        return
+    sym = index.qualname(fn)
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DICT_ITERS
+            and not node.args
+        ):
+            continue
+        recv = dotted(node.func.value).rsplit(".", 1)[-1].lower()
+        if "sorted" in recv:
+            continue
+        wrapped = False
+        for anc in index.ancestors(node):
+            if anc is fn:
+                break
+            if (
+                isinstance(anc, ast.Call)
+                and isinstance(anc.func, ast.Name)
+                and anc.func.id in ("sorted", "frozenset", "set")
+            ):
+                wrapped = True
+                break
+        if wrapped:
+            continue
+        findings.append(
+            Finding(
+                rule="TPL006",
+                path=sf.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                symbol=sym,
+                tag=f"unsorted-dict-iter:{node.func.attr}",
+                message=(
+                    f"unsorted `.{node.func.attr}()` iteration inside "
+                    f"signature/key constructor `{fn.name}`: dict insertion "
+                    "order leaks into the cache key and causes spurious "
+                    "steady-state retraces"
+                ),
+                hint="wrap the iteration in sorted(...)",
+            )
+        )
+
+
+def check_file(sf):
+    findings = []
+    text = sf.text
+    has_cacheish = "cache" in text.lower()
+    has_jit = "jit" in text or "shard_map" in text
+    if not has_cacheish and not has_jit:
+        return findings
+    index = sf.index()
+    if has_jit:
+        _check_loop_capture(sf, index, findings)
+    for node in sf.walk():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if has_cacheish:
+            _check_unkeyed_reads(sf, index, node, findings)
+        _check_dict_iter(sf, index, node, findings)
+    return findings

@@ -1,0 +1,307 @@
+"""TPL007: SPMD divergence through the call graph.
+
+TPL002 catches *lexical* collective-order hazards. This rule summarizes
+each function's issued-collective sequence — including collectives reached
+through intra-module calls and ``from x import y`` cross-module bindings —
+and flags divergence that only shows up via the call graph:
+
+- **rank-branch**: an ``if``/``else`` on a rank-dependent test whose arms
+  resolve to *different* collective sequences (``if rank == 0:
+  sync_grads(...)`` deadlocks every other rank inside the helper);
+- **data-branch-call**: a data-dependent branch (test reads tensor data)
+  whose arm *calls a helper* that issues collectives — the direct-call case
+  is TPL002's, the via-call case is only visible here;
+- **retry-no-verdict**: a retry loop wrapping collective issue in
+  ``try``/``except`` that never consults the elastic world-changed /
+  epoch-verdict hook — a retry that crosses a reconfiguration epoch
+  re-issues against the *new* gang and hangs.
+
+Global rule: ``extract`` records per-function sequences of
+``["op", name]`` / ``["ref", relpath, qualname]`` items plus divergence
+sites; ``reduce`` resolves refs transitively (memoized, cycle- and
+depth-bounded) over the whole tree's facts.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+from .callgraph import ImportMap, dotted
+from .tpl002_collective_order import is_collective_call, _test_reads_tensor
+
+_NOT_RANKISH = {"nranks", "ranks", "world_size", "num_ranks"}
+_VERDICT_HINTS = ("world_changed", "verdict", "world_epoch")
+_MAX_DEPTH = 8
+
+
+def _is_rankish_token(tok: str) -> bool:
+    t = tok.lower()
+    if t in _NOT_RANKISH:
+        return False
+    return t == "rank" or t.endswith("_rank") or t.startswith("rank_") or t == "get_rank"
+
+
+def _rank_test(test) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and _is_rankish_token(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and _is_rankish_token(node.attr):
+            return True
+    return False
+
+
+def _test_slug(test) -> str:
+    try:
+        return re.sub(r"\s+", "", ast.unparse(test))[:40]
+    except Exception:
+        return "?"
+
+
+def _seq_items(index, imports, fn, stmts):
+    """Lexically ordered ["op", name] / ["ref", rel, qual] items issued by
+    ``stmts``, ignoring calls that belong to functions nested inside ``fn``."""
+    items = []
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            if index.enclosing_function(node) is not fn:
+                continue
+            op = is_collective_call(node)
+            if op:
+                items.append((node.lineno, node.col_offset, ["op", op]))
+                continue
+            target = index.resolve_call(node)
+            if target is not None and target is not fn:
+                items.append(
+                    (node.lineno, node.col_offset,
+                     ["ref", index.sf.relpath, index.qualname(target)])
+                )
+                continue
+            hit = imports.resolve(node.func)
+            if hit is not None:
+                items.append((node.lineno, node.col_offset, ["ref", hit[0], hit[1]]))
+    items.sort(key=lambda t: (t[0], t[1]))
+    return [it for _ln, _col, it in items]
+
+
+def _fn_consults_verdict(fn) -> bool:
+    for node in ast.walk(fn):
+        tok = ""
+        if isinstance(node, ast.Attribute):
+            tok = node.attr
+        elif isinstance(node, ast.Name):
+            tok = node.id
+        if tok and any(h in tok.lower() for h in _VERDICT_HINTS):
+            return True
+    return False
+
+
+def extract(sf, known_paths):
+    index = sf.index()
+    imports = ImportMap(sf, known_paths)
+    funcs = {}
+    sites = []
+    for fn in sf.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = index.qualname(fn)
+        seq = _seq_items(index, imports, fn, fn.body)
+        if seq:
+            funcs[qual] = seq
+
+        for node in ast.walk(fn):
+            if index.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.If):
+                then_seq = _seq_items(index, imports, fn, node.body)
+                else_seq = _seq_items(index, imports, fn, node.orelse)
+                if not then_seq and not else_seq:
+                    continue
+                if _rank_test(node.test):
+                    sites.append(
+                        {
+                            "kind": "rank",
+                            "line": node.lineno,
+                            "col": node.col_offset,
+                            "symbol": qual,
+                            "test": _test_slug(node.test),
+                            "then": then_seq,
+                            "else": else_seq,
+                        }
+                    )
+                elif _test_reads_tensor(node.test):
+                    refs = [
+                        it for it in then_seq + else_seq if it[0] == "ref"
+                    ]
+                    if refs:
+                        sites.append(
+                            {
+                                "kind": "data",
+                                "line": node.lineno,
+                                "col": node.col_offset,
+                                "symbol": qual,
+                                "test": _test_slug(node.test),
+                                "refs": refs,
+                            }
+                        )
+            elif isinstance(node, (ast.For, ast.While)):
+                tries = [
+                    t
+                    for t in ast.walk(node)
+                    if isinstance(t, ast.Try)
+                    and index.enclosing_function(t) is fn
+                ]
+                if not tries:
+                    continue
+                loop_seq = _seq_items(index, imports, fn, node.body)
+                if not loop_seq:
+                    continue
+                sites.append(
+                    {
+                        "kind": "retry",
+                        "line": tries[0].lineno,
+                        "col": tries[0].col_offset,
+                        "symbol": qual,
+                        "seq": loop_seq,
+                        "consults": _fn_consults_verdict(fn),
+                    }
+                )
+    if not funcs and not sites:
+        return {}
+    return {"funcs": funcs, "sites": sites}
+
+
+class _Resolver:
+    """Flattens ["ref", ...] items to op-name tuples over the global fact
+    map, memoized, cycle- and depth-bounded."""
+
+    def __init__(self, records):
+        self.funcs = {}  # (relpath, qualname) -> seq
+        self.by_leaf = {}  # (relpath, last segment) -> [qualname]
+        for path, rec in sorted(records.items()):
+            facts = rec.get("facts", {}).get("TPL007")
+            if not facts:
+                continue
+            for qual, seq in facts["funcs"].items():
+                self.funcs[(path, qual)] = seq
+                leaf = qual.rsplit(".", 1)[-1]
+                self.by_leaf.setdefault((path, leaf), []).append(qual)
+        self._memo = {}
+
+    def _lookup(self, rel, qual):
+        seq = self.funcs.get((rel, qual))
+        if seq is not None:
+            return seq
+        quals = self.by_leaf.get((rel, qual.rsplit(".", 1)[-1]), [])
+        return self.funcs.get((rel, sorted(quals)[0])) if quals else None
+
+    def ops(self, item, depth=0, stack=None):
+        if item[0] == "op":
+            return (item[1],)
+        if depth > _MAX_DEPTH:
+            return ()
+        key = (item[1], item[2])
+        if key in self._memo:
+            return self._memo[key]
+        if stack is None:
+            stack = set()
+        if key in stack:
+            return ()
+        stack.add(key)
+        seq = self._lookup(item[1], item[2])
+        out = []
+        for sub in seq or ():
+            out.extend(self.ops(sub, depth + 1, stack))
+        stack.discard(key)
+        self._memo[key] = tuple(out)
+        return self._memo[key]
+
+    def flatten(self, seq):
+        out = []
+        for item in seq:
+            out.extend(self.ops(item))
+        return tuple(out)
+
+
+def reduce(ctx, records):
+    findings = []
+    res = _Resolver(records)
+    for path, rec in sorted(records.items()):
+        facts = rec.get("facts", {}).get("TPL007")
+        if not facts:
+            continue
+        for site in facts["sites"]:
+            if site["kind"] == "rank":
+                then_ops = res.flatten(site["then"])
+                else_ops = res.flatten(site["else"])
+                if then_ops == else_ops:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="TPL007",
+                        path=path,
+                        line=site["line"],
+                        col=site["col"],
+                        symbol=site["symbol"],
+                        tag=f"rank-branch:{site['test']}",
+                        message=(
+                            f"branch on rank-dependent `{site['test']}` issues "
+                            f"different collective sequences per arm "
+                            f"({list(then_ops)} vs {list(else_ops)}): ranks "
+                            "taking different arms deadlock the gang"
+                        ),
+                        hint="issue the same sequence on every rank; gate only rank-local side effects",
+                    )
+                )
+            elif site["kind"] == "data":
+                ops = ()
+                for ref in site["refs"]:
+                    ops = res.ops(ref)
+                    if ops:
+                        break
+                if not ops:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="TPL007",
+                        path=path,
+                        line=site["line"],
+                        col=site["col"],
+                        symbol=site["symbol"],
+                        tag=f"data-branch-call:{ops[0]}",
+                        message=(
+                            f"data-dependent branch `{site['test']}` calls a "
+                            f"helper that issues collective `{ops[0]}`: ranks "
+                            "can branch differently and deadlock (via-call "
+                            "variant of TPL002)"
+                        ),
+                        hint="hoist the helper call out of the branch, branch on the replicated result",
+                    )
+                )
+            elif site["kind"] == "retry":
+                if site["consults"]:
+                    continue
+                ops = res.flatten(site["seq"])
+                if not ops:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="TPL007",
+                        path=path,
+                        line=site["line"],
+                        col=site["col"],
+                        symbol=site["symbol"],
+                        tag=f"retry-no-verdict:{ops[0]}",
+                        message=(
+                            f"retry loop around collective `{ops[0]}` never "
+                            "consults the world-changed verdict hook: a retry "
+                            "that crosses a reconfiguration epoch hangs "
+                            "against the new gang"
+                        ),
+                        hint="check the epoch verdict before re-issuing (see collective.py's fenced retry)",
+                    )
+                )
+    return findings

@@ -1,0 +1,175 @@
+"""TPL008: use-after-donate.
+
+``donate_argnums`` hands the argument's device buffer to XLA for reuse:
+after the donating call returns, the old Python binding points at a
+deleted buffer. On real hardware that read raises (or worse, returns
+aliased garbage mid-overwrite); CPU interpret mode often hides it, which
+is exactly why it needs a static check.
+
+Tracked donating callables (all intra-module):
+
+- ``@functools.partial(jax.jit, donate_argnums=...)`` decorated defs;
+- ``name = jax.jit(fn, donate_argnums=...)`` bindings;
+- ``self.attr = jax.jit(fn, donate_argnums=...)`` bindings (call sites
+  matched by attribute name).
+
+Flagged: a ``Load`` of a donated ``Name`` argument after the donating
+call and before the name is rebound. The rebind-from-result idiom
+(``state = step(x, state)``) rebinds on the call line and is therefore
+never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import dotted
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIALS = {"partial", "functools.partial"}
+
+
+def _donate_positions(call: ast.Call):
+    """Constant donate_argnums positions of a jit(...) call, or None."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    out.append(el.value)
+            return tuple(out) if out else None
+    return None
+
+
+def _jit_call_positions(node) -> tuple:
+    """donate positions if ``node`` is a donating jit wrap, else ()."""
+    if not isinstance(node, ast.Call):
+        return ()
+    d = dotted(node.func)
+    if d in _JIT_NAMES:
+        return _donate_positions(node) or ()
+    if d in _PARTIALS and any(dotted(a) in _JIT_NAMES for a in node.args):
+        return _donate_positions(node) or ()
+    return ()
+
+
+def _donating_callables(sf):
+    """{callable key: donate positions}. Keys: 'name' for plain bindings
+    and decorated defs, '.attr' for self/instance attribute bindings.
+
+    Factories count too: ``def _build(): return jax.jit(f, donate_argnums=..)``
+    makes any ``step = self._build()`` binding a donating callable."""
+    out = {}
+    factories = {}  # factory function name -> donate positions of its product
+    for node in sf.walk():
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                pos = _jit_call_positions(dec)
+                if pos:
+                    out[node.name] = pos
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Return) and inner.value is not None:
+                    pos = _jit_call_positions(inner.value)
+                    if pos:
+                        factories[node.name] = pos
+    for node in sf.walk():
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        pos = _jit_call_positions(node.value)
+        if not pos:
+            func = node.value.func
+            leaf = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else ""
+            )
+            pos = factories.get(leaf, ())
+        if not pos:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = pos
+            elif isinstance(tgt, ast.Attribute):
+                out["." + tgt.attr] = pos
+    return out
+
+
+def _call_positions(call: ast.Call, donors) -> tuple:
+    func = call.func
+    if isinstance(func, ast.Name):
+        return donors.get(func.id, ())
+    if isinstance(func, ast.Attribute):
+        return donors.get("." + func.attr, ())
+    return ()
+
+
+def check_file(sf):
+    findings = []
+    if "donate_argnums" not in sf.text:
+        return findings
+    donors = _donating_callables(sf)
+    if not donors:
+        return findings
+    index = sf.index()
+    for fn in sf.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = []  # (call line, call end line, donated var name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if index.enclosing_function(node) is not fn:
+                continue
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for pos in _call_positions(node, donors):
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                    calls.append((node.lineno, end, node.args[pos].id))
+        if not calls:
+            continue
+        loads = {}  # name -> [(line, col)]
+        stores = {}  # name -> [line]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Name):
+                continue
+            if index.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node.ctx, ast.Load):
+                loads.setdefault(node.id, []).append((node.lineno, node.col_offset))
+            elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                stores.setdefault(node.id, []).append(node.lineno)
+        sym = index.qualname(fn)
+        seen = set()
+        for call_line, call_end, var in calls:
+            # a store on the call's own lines is the rebind-from-result idiom
+            rebinds = [ln for ln in stores.get(var, ()) if ln >= call_line]
+            horizon = min(rebinds) if rebinds else float("inf")
+            for ln, col in sorted(loads.get(var, ())):
+                if not (call_end < ln < horizon):
+                    continue
+                key = (var, ln, col)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        rule="TPL008",
+                        path=sf.relpath,
+                        line=ln,
+                        col=col,
+                        symbol=sym,
+                        tag=f"use-after-donate:{var}",
+                        message=(
+                            f"`{var}` is read after being donated (donate_argnums) "
+                            f"to the jitted call on line {call_line}: the buffer "
+                            "is deleted/aliased on real hardware"
+                        ),
+                        hint=f"rebind from the result (`{var} = step(..., {var})`) or stop reading the old binding",
+                        extra_anchor_lines=(call_line,),
+                    )
+                )
+                break  # one finding per donated binding per call
+    return findings

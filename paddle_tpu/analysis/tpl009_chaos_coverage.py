@@ -1,0 +1,247 @@
+"""TPL009: chaos / drill coverage, both directions.
+
+The chaos harness registers its injection grammar in two tables
+(``_SITES`` + ``_KINDS`` in fault_tolerance/chaos.py) and the watchdog its
+escalation ladder in ``_STAGES``. Drills live in the test tree and smoke
+tools as ``chaos_spec`` / ``watchdog_policy`` flag values. Checked:
+
+- **unexercised**: a registered ``site:kind`` injection no drill ever
+  fires — an untested recovery path;
+- **ladder-stage-unexercised**: a watchdog stage no policy drill reaches;
+- **unknown-injection** / **unknown-stage**: a drill spec naming an
+  unregistered injection or stage — a typo that silently tests nothing
+  (``parse_spec`` raises at runtime, but only when that drill runs).
+
+Global rule, and the only one that extracts facts from the test tree —
+drills *live* there. Reduce cross-checks tables against drills every run.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+from .callgraph import dotted
+
+_SPEC_ENTRY_RE = re.compile(r"^[a-z_]+:[a-z_]+(@.+)?$")
+_STAGE_RE = re.compile(r"^[a-z_]+(,[a-z_]+)*$")
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and isinstance(node.value, str) else None
+
+
+def _spec_like(s: str) -> bool:
+    parts = [p.strip() for p in s.split(",") if p.strip()]
+    return bool(parts) and all(_SPEC_ENTRY_RE.match(p) for p in parts)
+
+
+def _table_pairs(node):
+    """[(site, kind, line)] from a ``_KINDS = {...}`` dict literal."""
+    out = []
+    if not isinstance(node, ast.Dict):
+        return out
+    for k, v in zip(node.keys, node.values):
+        site = _const_str(k)
+        if site is None or not isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            continue
+        for el in v.elts:
+            kind = _const_str(el)
+            if kind is not None:
+                out.append((site, kind, el.lineno))
+    return out
+
+
+def _table_strings(node):
+    """[(value, line)] from a tuple/list/set of string constants."""
+    out = []
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            v = _const_str(el)
+            if v is not None:
+                out.append((v, el.lineno))
+    return out
+
+
+def _collect_drills(sf):
+    """-> ([(spec, line)], [(policy, line)]) drill strings in this file."""
+    drills, policies = [], []
+    seen = set()
+
+    def add_drill(s, line):
+        if s and _spec_like(s) and (s, line) not in seen:
+            seen.add((s, line))
+            drills.append((s, line))
+
+    def add_policy(s, line):
+        if s and _STAGE_RE.match(s) and (s, line) not in seen:
+            seen.add((s, line))
+            policies.append((s, line))
+
+    for node in sf.walk():
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                key = _const_str(k) if k is not None else None
+                val = _const_str(v)
+                if val is None:
+                    continue
+                if key == "chaos_spec":
+                    add_drill(val, v.lineno)
+                elif key == "watchdog_policy":
+                    add_policy(val, v.lineno)
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf in ("parse_spec", "reconfigure") or "chaos" in d.lower():
+                for arg in node.args:
+                    val = _const_str(arg)
+                    if val is not None:
+                        add_drill(val, arg.lineno)
+            for kw in node.keywords:
+                val = _const_str(kw.value)
+                if val is None:
+                    continue
+                if kw.arg == "chaos_spec":
+                    add_drill(val, kw.value.lineno)
+                elif kw.arg == "watchdog_policy":
+                    add_policy(val, kw.value.lineno)
+        elif isinstance(node, ast.Constant):
+            # bare spec constants (module-level SPEC = "..."): the selector
+            # "@" makes them unambiguous against ordinary colon strings
+            val = _const_str(node)
+            if val is not None and "@" in val:
+                add_drill(val, node.lineno)
+    return drills, policies
+
+
+def extract(sf, known_paths):
+    facts = {}
+    if "_KINDS" in sf.text or "_STAGES" in sf.text:
+        pairs, stages = [], []
+        for node in sf.walk():
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if tgt.id == "_KINDS":
+                    pairs.extend(_table_pairs(node.value))
+                elif tgt.id == "_STAGES":
+                    stages.extend(_table_strings(node.value))
+        if pairs:
+            facts["pairs"] = pairs
+        if stages:
+            facts["stages"] = stages
+    if any(
+        tok in sf.text
+        for tok in ("chaos_spec", "watchdog_policy", "parse_spec", "chaos", "reconfigure")
+    ):
+        drills, policies = _collect_drills(sf)
+        if drills:
+            facts["drills"] = drills
+        if policies:
+            facts["policies"] = policies
+    return facts
+
+
+def reduce(ctx, records):
+    findings = []
+    pairs = {}  # (site, kind) -> (path, line)
+    stages = {}  # stage -> (path, line)
+    drills = []  # (path, spec, line)
+    policies = []  # (path, policy, line)
+    for path, rec in sorted(records.items()):
+        facts = rec.get("facts", {}).get("TPL009")
+        if not facts:
+            continue
+        for site, kind, line in facts.get("pairs", ()):
+            pairs.setdefault((site, kind), (path, line))
+        for stage, line in facts.get("stages", ()):
+            stages.setdefault(stage, (path, line))
+        for spec, line in facts.get("drills", ()):
+            drills.append((path, spec, line))
+        for policy, line in facts.get("policies", ()):
+            policies.append((path, policy, line))
+
+    exercised = set()
+    for path, spec, line in drills:
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            head = entry.partition("@")[0]
+            site, _, kind = head.partition(":")
+            if pairs and (site, kind) not in pairs:
+                findings.append(
+                    Finding(
+                        rule="TPL009",
+                        path=path,
+                        line=line,
+                        tag=f"unknown-injection:{site}:{kind}",
+                        message=(
+                            f"drill spec `{entry}` names unregistered injection "
+                            f"`{site}:{kind}`: parse_spec will reject it and "
+                            "the drill tests nothing"
+                        ),
+                        hint="fix the site:kind (see chaos._KINDS) or register the injection",
+                    )
+                )
+            else:
+                exercised.add((site, kind))
+    if pairs:
+        for (site, kind), (path, line) in sorted(pairs.items()):
+            if (site, kind) not in exercised:
+                findings.append(
+                    Finding(
+                        rule="TPL009",
+                        path=path,
+                        line=line,
+                        tag=f"unexercised:{site}:{kind}",
+                        message=(
+                            f"registered chaos injection `{site}:{kind}` is "
+                            "exercised by no drill: the recovery path it "
+                            "targets is untested"
+                        ),
+                        hint="add a drill (chaos_spec flag in a test / smoke tool) that fires it",
+                    )
+                )
+
+    used_stages = set()
+    for path, policy, line in policies:
+        for stage in (s.strip() for s in policy.split(",")):
+            if not stage:
+                continue
+            if stages and stage not in stages:
+                findings.append(
+                    Finding(
+                        rule="TPL009",
+                        path=path,
+                        line=line,
+                        tag=f"unknown-stage:{stage}",
+                        message=(
+                            f"watchdog policy drill names unknown ladder stage "
+                            f"`{stage}` (valid: {', '.join(sorted(stages))})"
+                        ),
+                        hint="fix the stage name (see comm_watchdog._STAGES)",
+                    )
+                )
+            else:
+                used_stages.add(stage)
+    if stages:
+        for stage, (path, line) in sorted(stages.items()):
+            if stage not in used_stages:
+                findings.append(
+                    Finding(
+                        rule="TPL009",
+                        path=path,
+                        line=line,
+                        tag=f"ladder-stage-unexercised:{stage}",
+                        message=(
+                            f"watchdog ladder stage `{stage}` is reached by no "
+                            "policy drill: its escalation path is untested"
+                        ),
+                        hint="add a watchdog_policy drill that includes the stage",
+                    )
+                )
+    return findings

@@ -1,0 +1,169 @@
+"""TPL010: lexical acquire/release pairing for refcounted resources.
+
+Three refcount families in the serving/elastic stack, each a real leak
+class (the PR-7 COW-pin leak shipped exactly this way):
+
+- BlockManager page refcounts: ``_incref`` / ``_decref``;
+- COW pending-copy pins: ``pin`` / ``unpin`` / ``take_copies``;
+- TTL leases: ``acquire_lease`` / ``drop_lease`` (+ spellings).
+
+Flagged shape — **leak-on-raise**: in a function that both acquires and
+releases a family, a ``raise`` between the acquire and the matching
+release leaks the reference unless (a) a ``try``/``finally`` enclosing
+the raise releases the family, or (b) a rollback release already ran on
+the raising path (a release lexically between acquire and raise).
+
+Acquire-only functions are transfer semantics (the caller owns the ref)
+and are not flagged. Like TPL003, helper calls one hop away in the same
+module count: ``self._rollback()`` whose body decrefs is a release.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+from .callgraph import dotted
+
+_FAMILIES = {
+    "refcount": (
+        {"_incref", "incref"},
+        {"_decref", "decref"},
+    ),
+    "pin": (
+        {"pin", "_pin"},
+        {"unpin", "_unpin", "take_copies"},
+    ),
+    "lease": (
+        {"acquire_lease", "lease_acquire"},
+        {"drop_lease", "release_lease", "lease_drop"},
+    ),
+}
+_HINT_TOKENS = ("cref", "pin", "lease")
+
+
+def _call_family(node: ast.Call):
+    """(family, 'acquire'|'release') for a direct family call, else None."""
+    leaf = dotted(node.func).rsplit(".", 1)[-1]
+    if not leaf:
+        return None
+    for family, (acq, rel) in _FAMILIES.items():
+        if leaf in acq:
+            return family, "acquire"
+        if leaf in rel:
+            return family, "release"
+    return None
+
+
+def _resolved_family(index, node, depth=2, _seen=None):
+    """Family event for a call, following local helpers up to ``depth``
+    hops (a helper that both acquires and releases is self-balanced and
+    yields no event)."""
+    direct = _call_family(node)
+    if direct is not None:
+        return direct
+    if depth <= 0:
+        return None
+    if _seen is None:
+        _seen = set()
+    target = index.resolve_call(node)
+    if target is None or id(target) in _seen:
+        return None
+    _seen.add(id(target))
+    events = set()
+    for inner in ast.walk(target):
+        if isinstance(inner, ast.Call):
+            hit = _resolved_family(index, inner, depth - 1, _seen)
+            if hit is not None:
+                events.add(hit)
+    by_family = {}
+    for family, kind in events:
+        by_family.setdefault(family, set()).add(kind)
+    unbalanced = [
+        (family, kinds.pop())
+        for family, kinds in by_family.items()
+        if len(kinds) == 1
+    ]
+    return unbalanced[0] if len(unbalanced) == 1 else None
+
+
+def _finally_releases(index, try_node, family) -> bool:
+    for stmt in try_node.finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                hit = _resolved_family(index, node)
+                if hit == (family, "release"):
+                    return True
+    return False
+
+
+def check_file(sf):
+    findings = []
+    low = sf.text.lower()
+    if not any(tok in low for tok in _HINT_TOKENS):
+        return findings
+    index = sf.index()
+    for fn in sf.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        events = []  # (line, family, kind)
+        raises = []  # Raise nodes
+        for node in ast.walk(fn):
+            if index.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                hit = _resolved_family(index, node)
+                if hit is not None:
+                    events.append((node.lineno, hit[0], hit[1]))
+            elif isinstance(node, ast.Raise):
+                raises.append(node)
+        if not raises or not events:
+            continue
+        sym = index.qualname(fn)
+        for family in _FAMILIES:
+            acquires = sorted(
+                ln for ln, fam, kind in events if fam == family and kind == "acquire"
+            )
+            releases = sorted(
+                ln for ln, fam, kind in events if fam == family and kind == "release"
+            )
+            if not acquires or not releases:
+                continue  # acquire-only = transfer semantics; release-only = caller owns
+            first_acq, last_rel = acquires[0], releases[-1]
+            for rnode in raises:
+                if not (first_acq < rnode.lineno < last_rel):
+                    continue
+                # rollback release already ran on this path?
+                if any(first_acq < ln < rnode.lineno for ln in releases):
+                    continue
+                # guarded by an enclosing try/finally that releases?
+                guarded = False
+                for anc in index.ancestors(rnode):
+                    if anc is fn:
+                        break
+                    if isinstance(anc, ast.Try) and _finally_releases(
+                        index, anc, family
+                    ):
+                        guarded = True
+                        break
+                if guarded:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="TPL010",
+                        path=sf.relpath,
+                        line=rnode.lineno,
+                        col=rnode.col_offset,
+                        symbol=sym,
+                        tag=f"leak-on-raise:{family}",
+                        message=(
+                            f"raise between {family} acquire (line {first_acq}) "
+                            f"and release (line {last_rel}) leaks the reference "
+                            "on the error path"
+                        ),
+                        hint="release in a finally:, or roll back before raising",
+                        extra_anchor_lines=(first_acq,),
+                    )
+                )
+                break  # one finding per family per function
+    return findings

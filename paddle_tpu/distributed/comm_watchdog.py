@@ -44,6 +44,9 @@ flags.define_flag("watchdog_policy", "",
                   "legacy single-shot report honoring "
                   "FLAGS_comm_watchdog_abort")
 
+# tpu-lint TPL009 cross-checks this ladder against watchdog_policy drills:
+# a stage no policy drill reaches (or a policy naming an unknown stage)
+# fails the lint gate.
 _STAGES = ("warn", "dump", "retry", "elastic", "restart", "abort")
 
 _counter = itertools.count()

@@ -99,6 +99,9 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
 
 _SITES = ("collective", "store", "dispatch", "fetch", "save", "serving",
           "replica", "pipeline")
+# tpu-lint TPL009 cross-checks this table against the drill specs in the
+# test tree / smoke tools: adding a site:kind here without a drill that
+# fires it (or a drill naming a pair absent here) fails the lint gate.
 _KINDS = {
     "collective": ("delay", "timeout", "hang", "rank_dead"),
     "store": ("drop", "garble", "delay", "partition"),

@@ -371,6 +371,8 @@ def _cache_get(key):
 
 
 def _cache_put(key, entry):
+    # eviction limit only — it never shapes the built executable, so it
+    # does not belong in the key  # tpu-lint: disable=TPL006
     limit = int(flags.flag_value("jit_cache_size"))
     with _cache_lock:
         _cache[key] = entry

@@ -123,6 +123,39 @@ def test_dispatch_inf_poison():
     assert np.isinf((a - a).numpy()).all()
 
 
+def test_dispatch_rank_dead_revokes_lease_result_untouched():
+    """dispatch:rank_dead is the mid-step death drill: the victim's lease
+    is revoked through the kill hook but the op result is NOT poisoned —
+    the failure surfaces at the next collective/membership poll."""
+    seen = []
+    prev = chaos.set_rank_kill_hook(lambda victim, site: seen.append((victim,
+                                                                      site)))
+    try:
+        chaos.reconfigure("dispatch:rank_dead@op=add;victim=1;count=1")
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose((a + a).numpy(), 2.0)
+    finally:
+        chaos.set_rank_kill_hook(prev)
+    assert seen == [(1, "dispatch")]
+
+
+def test_save_rank_dead_kills_lease_but_write_completes(tmp_path):
+    """save:rank_dead revokes the victim's lease mid-checkpoint while the
+    local write still lands intact (unlike save:crash, which hard-exits)."""
+    seen = []
+    prev = chaos.set_rank_kill_hook(lambda victim, site: seen.append((victim,
+                                                                      site)))
+    try:
+        chaos.reconfigure("save:rank_dead@op=paddle_save;victim=2;count=1")
+        path = str(tmp_path / "drill.pdparams")
+        paddle.save({"w": paddle.to_tensor(np.ones(3, np.float32))}, path)
+    finally:
+        chaos.set_rank_kill_hook(prev)
+    assert seen == [(2, "save")]
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), 1.0)
+
+
 def test_step_selector_uses_chaos_clock():
     chaos.reconfigure("dispatch:nan@op=add;step=2")
     a = paddle.to_tensor(np.ones(2, np.float32))
@@ -165,6 +198,24 @@ def test_collective_timeout_retried_once():
                    {"op": "all_reduce"}) == before + 1
 
 
+def test_collective_delay_perturbs_but_completes():
+    """collective:delay is the benign latency drill: the op slows down,
+    nothing breaks, and no retry is consumed."""
+    before = _metric("paddle_chaos_injections_total",
+                     {"site": "collective", "kind": "delay"})
+    retries = _metric("paddle_collective_retries_total", {"op": "all_reduce"})
+    chaos.reconfigure("collective:delay@op=all_reduce;delay=0.15;count=1")
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    t0 = time.perf_counter()
+    dist.all_reduce(t)
+    assert time.perf_counter() - t0 >= 0.1
+    np.testing.assert_allclose(t.numpy(), 1.0)
+    assert _metric("paddle_chaos_injections_total",
+                   {"site": "collective", "kind": "delay"}) == before + 1
+    assert _metric("paddle_collective_retries_total",
+                   {"op": "all_reduce"}) == retries
+
+
 def test_collective_retries_exhausted_raises():
     flags.set_flags({"collective_retries": 1,
                      "collective_retry_backoff": 0.01})
@@ -193,6 +244,19 @@ def store_pair():
     chaos.reconfigure("")
     client.stop()
     master.stop()
+
+
+def test_store_delay_slows_request_without_retry(store_pair):
+    """store:delay stretches one request's latency; the reply still lands,
+    so no retry (and no reconnect) is burned."""
+    _, client = store_pair
+    client.set("k0", b"v0")
+    retries = _metric("paddle_store_retries_total", {"op": "get"})
+    chaos.reconfigure("store:delay@op=get;delay=0.15;count=1")
+    t0 = time.perf_counter()
+    assert client.get("k0") == b"v0"
+    assert time.perf_counter() - t0 >= 0.1
+    assert _metric("paddle_store_retries_total", {"op": "get"}) == retries
 
 
 def test_store_drop_reconnects_and_retries(store_pair):
@@ -344,6 +408,7 @@ def test_legacy_abort_flag_fires_sigabrt(no_abort):
 
 def test_unknown_policy_stage_ignored(no_abort, capfd):
     cw._policy_warned[0] = False
+    # deliberately bogus stage  # tpu-lint: disable=TPL009
     flags.set_flags({"watchdog_policy": "frobnicate,warn",
                      "comm_watchdog_abort": False})
     mgr = cw.CommTaskManager()

@@ -58,6 +58,57 @@ FIXTURES = {
             emit("good.kind")
             emit("bad.kind")
         """,
+    "mod006.py": """
+        define_flag("fixture_knob", 4, "buckets per compiled plan")
+
+        _plan_cache = {}
+
+        def build_plan(shape):
+            limit = flag_value("fixture_knob")
+            key = (shape,)
+            _plan_cache[key] = object()
+            return _plan_cache[key], limit
+        """,
+    "mod007.py": """
+        def _sync_grads(coll, grad):
+            coll.all_reduce(grad)
+
+        def _seed_grads(coll, grad):
+            coll.broadcast(grad)
+
+        def step(coll, rank, grad):
+            if rank == 0:
+                _sync_grads(coll, grad)
+            else:
+                _seed_grads(coll, grad)
+        """,
+    "mod008.py": """
+        import jax
+
+        def _step(x, state):
+            return state
+
+        step = jax.jit(_step, donate_argnums=(1,))
+
+        def train(x, state):
+            out = step(x, state)
+            norm = state.sum()
+            return out, norm
+        """,
+    "mod009.py": """
+        _KINDS = {"fixture": ("boom", "fizzle")}
+
+        def drill():
+            parse_spec("fixture:boom@count=1")
+        """,
+    "mod010.py": """
+        class Pages:
+            def grab(self, page):
+                self._incref(page)
+                if page < 0:
+                    raise ValueError(page)
+                self._decref(page)
+        """,
 }
 
 
@@ -83,10 +134,19 @@ def test_each_rule_fires_exactly_once(fixture_repo):
     by_rule = {}
     for f in findings:
         by_rule.setdefault(f.rule, []).append(f)
-    for rule in ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005"):
+    for rule in sorted(an.RULES):
         assert len(by_rule.get(rule, [])) == 1, (
             rule, [f.to_dict() for f in findings])
-    assert len(findings) == 5
+    assert len(findings) == len(an.RULES) == 10
+
+
+def test_new_rule_tags_are_stable(fixture_repo):
+    tags = {f.rule: f.tag for f in _run(fixture_repo)}
+    assert tags["TPL006"] == "unkeyed-flag:fixture_knob"
+    assert tags["TPL007"] == "rank-branch:rank==0"
+    assert tags["TPL008"] == "use-after-donate:state"
+    assert tags["TPL009"] == "unexercised:fixture:fizzle"
+    assert tags["TPL010"] == "leak-on-raise:refcount"
 
 
 def test_finding_shape_and_keys(fixture_repo):
@@ -117,7 +177,32 @@ def test_pragma_suppresses_only_that_rule(tmp_path):
         """
     findings = _run(_write_fixture_repo(tmp_path, src))
     assert not [f for f in findings if f.rule == "TPL003"]
-    assert len(findings) == 4  # other rules unaffected
+    assert len(findings) == 9  # other rules unaffected
+
+
+def test_new_rules_pragma_suppression(tmp_path):
+    src = dict(FIXTURES)
+    src["mod006.py"] = src["mod006.py"].replace(
+        'limit = flag_value("fixture_knob")',
+        'limit = flag_value("fixture_knob")  # tpu-lint: disable=TPL006')
+    src["mod007.py"] = src["mod007.py"].replace(
+        "    if rank == 0:",
+        "    # tpu-lint: disable=TPL007\n            if rank == 0:")
+    src["mod008.py"] = src["mod008.py"].replace(
+        "norm = state.sum()",
+        "norm = state.sum()  # tpu-lint: disable=TPL008")
+    src["mod009.py"] = src["mod009.py"].replace(
+        '_KINDS = {"fixture": ("boom", "fizzle")}',
+        '_KINDS = {"fixture": ("boom", "fizzle")}  # tpu-lint: disable=TPL009')
+    # TPL010 anchors the raise *and* the acquire line; suppress via the anchor
+    src["mod010.py"] = src["mod010.py"].replace(
+        "self._incref(page)",
+        "self._incref(page)  # tpu-lint: disable=TPL010")
+    findings = _run(_write_fixture_repo(tmp_path, src))
+    new_rules = {"TPL006", "TPL007", "TPL008", "TPL009", "TPL010"}
+    assert not [f for f in findings if f.rule in new_rules], [
+        f.to_dict() for f in findings if f.rule in new_rules]
+    assert len(findings) == 5  # TPL001-005 unaffected
 
 
 def test_pragma_on_line_above_and_with_anchor(tmp_path):
@@ -207,6 +292,145 @@ def test_rule_filter(fixture_repo):
     assert {f.rule for f in findings} == {"TPL003"}
 
 
+def test_tpl003_multi_item_with_and_exitstack(tmp_path):
+    src = {
+        "locks.py": """
+        import time
+        from contextlib import ExitStack
+
+        class W:
+            def multi(self):
+                with self._lock, self._cv:
+                    time.sleep(0.1)
+
+            def stacked(self):
+                with ExitStack() as es:
+                    es.enter_context(self._lock)
+                    time.sleep(0.2)
+
+            def clean(self):
+                with ExitStack() as es:
+                    es.enter_context(open("f"))
+                    time.sleep(0.3)
+        """,
+    }
+    findings = [f for f in _run(_write_fixture_repo(tmp_path, src))
+                if f.rule == "TPL003"]
+    assert len(findings) == 2, [f.to_dict() for f in findings]
+    assert {f.symbol.rsplit(".", 1)[-1] for f in findings} == {"multi", "stacked"}
+    assert all("time.sleep" in f.tag for f in findings)
+
+
+def test_import_map_cross_module_resolution(tmp_path):
+    import importlib
+
+    cg = importlib.import_module("tpu_analysis.callgraph")
+    root = _write_fixture_repo(tmp_path, {
+        "helpers.py": """
+        def sync_all(coll, g):
+            coll.all_reduce(g)
+        """,
+        "mainmod.py": """
+        from paddle_tpu.helpers import sync_all
+
+        def f(coll, g):
+            sync_all(coll, g)
+        """,
+    })
+    repo = an.Repo(root)
+    known = {s.relpath for s in repo.files}
+    assert cg.module_relpath("paddle_tpu.helpers", known) == "paddle_tpu/helpers.py"
+    sf = repo.file("paddle_tpu/mainmod.py")
+    import ast
+    call = next(
+        n for n in sf.walk()
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Name) and n.func.id == "sync_all"
+    )
+    hit = cg.ImportMap(sf, known).resolve(call.func)
+    assert hit == ("paddle_tpu/helpers.py", "sync_all")
+
+
+def test_tpl007_resolves_collectives_across_modules(tmp_path):
+    # `balanced` issues all_reduce on both arms — one via a cross-module
+    # import, one lexically — so it must NOT fire; `lopsided` must.
+    src = {
+        "helpers.py": """
+        def sync_all(coll, g):
+            coll.all_reduce(g)
+        """,
+        "mainmod.py": """
+        from .helpers import sync_all
+
+        def balanced(coll, rank, g):
+            if rank == 0:
+                sync_all(coll, g)
+            else:
+                coll.all_reduce(g)
+
+        def lopsided(coll, rank, g):
+            if rank == 0:
+                sync_all(coll, g)
+        """,
+    }
+    findings = [f for f in _run(_write_fixture_repo(tmp_path, src))
+                if f.rule == "TPL007"]
+    assert len(findings) == 1, [f.to_dict() for f in findings]
+    assert findings[0].symbol == "lopsided"
+    assert findings[0].tag == "rank-branch:rank==0"
+
+
+def test_incremental_cache_warm_and_single_invalidation(tmp_path):
+    root = _write_fixture_repo(tmp_path / "repo", FIXTURES)
+    cache = tmp_path / "cache.json"
+
+    cold = an.lint_tree(root, cache_path=cache)
+    assert cold.cache_state == "cold"
+    assert cold.files_linted == len(FIXTURES) and cold.files_cached == 0
+
+    warm = an.lint_tree(root, cache_path=cache)
+    assert warm.cache_state == "warm"
+    assert warm.files_linted == 0 and warm.files_cached == len(FIXTURES)
+    assert [f.key for f in warm.findings] == [f.key for f in cold.findings]
+
+    # editing one file re-lints exactly that file, findings unchanged
+    target = root / "paddle_tpu" / "mod001.py"
+    target.write_text(target.read_text() + "\n# touched\n")
+    partial = an.lint_tree(root, cache_path=cache)
+    assert partial.cache_state == "partial"
+    assert partial.files_linted == 1
+    assert partial.files_cached == len(FIXTURES) - 1
+    assert [f.key for f in partial.findings] == [f.key for f in cold.findings]
+
+    # editing a checker invalidates everything (rules_hash mismatch)
+    raw = json.loads(cache.read_text())
+    raw["rules_hash"] = "stale"
+    cache.write_text(json.dumps(raw))
+    recold = an.lint_tree(root, cache_path=cache)
+    assert recold.cache_state == "cold"
+    assert recold.files_linted == len(FIXTURES)
+
+
+def test_only_paths_filters_per_file_keeps_global(fixture_repo):
+    res = an.lint_tree(fixture_repo, cache_path=None,
+                       only_paths=["paddle_tpu/mod003.py"])
+    rules = {f.rule for f in res.findings}
+    assert "TPL003" in rules            # per-file finding in the selected file
+    assert "TPL001" not in rules        # per-file finding elsewhere is filtered
+    assert "TPL010" not in rules
+    # global drift rules keep the whole-tree view regardless of the filter
+    assert {"TPL004", "TPL005", "TPL007", "TPL009"} <= rules
+
+
+def test_nearest_key_suggests_moved_finding(fixture_repo):
+    findings = _run(fixture_repo)
+    keys = {f.key for f in findings}
+    target = next(f for f in findings if f.rule == "TPL003")
+    drifted = target.key.replace("poke", "poke_v2")
+    assert an.nearest_key(drifted, keys) == target.key
+    assert an.nearest_key("TPL999:zz/unrelated.py::no:match", keys) == ""
+
+
 def test_explain_has_every_rule():
     for rule, (title, severity, text) in an.RULES.items():
         assert title and text
@@ -228,17 +452,49 @@ def test_flags_near_miss_suggestions():
 # the live tree is the real fixture: lint-clean, in budget
 # ---------------------------------------------------------------------------
 
-def test_live_tree_is_lint_clean_within_budget():
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
-         "--json"],
-        capture_output=True, text=True, timeout=120, cwd=REPO)
+def _run_cli(*extra, timeout=120):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"), *extra],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+
+
+def test_live_tree_is_lint_clean_within_budget(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    out = _run_cli("--json", "--cache", cache)
     assert out.returncode == 0, out.stdout + out.stderr
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     assert payload["unbaselined"] == 0, payload["findings"]
     assert payload["stale_baseline"] == []
     assert payload["files_scanned"] > 100
-    assert payload["wall_s"] < 10.0, payload["wall_s"]
+    assert payload["cache"] == "cold"
+    assert payload["wall_s"] < 10.0, payload["wall_s"]  # cold budget
+    # per-rule timing: every rule ran and is accounted for
+    timings = payload["rule_timings_s"]
+    assert set(timings) == set(an.RULES), timings
+    assert all(t >= 0 for t in timings.values())
+
+    # second run over the unchanged tree is served from the cache
+    out = _run_cli("--json", "--cache", cache)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["cache"] == "warm"
+    assert payload["files_cached"] == payload["files_scanned"]
+    assert payload["unbaselined"] == 0
+    assert payload["wall_s"] < 2.0, payload["wall_s"]  # warm budget
+
+
+def test_changed_mode_composes_with_cache(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    out = _run_cli("--json", "--changed", "--cache", cache)
+    assert out.returncode in (0, 1), out.stdout + out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    # a filtered run never judges baseline staleness
+    assert payload["stale_baseline"] == []
+    # global drift rules still reduce over the whole tree
+    assert payload["files_scanned"] > 100
+    # --update-baseline needs the full view
+    out = _run_cli("--changed", "--update-baseline", "--no-cache")
+    assert out.returncode == 2
 
 
 def test_live_baseline_entries_are_justified():

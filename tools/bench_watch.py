@@ -356,9 +356,12 @@ _last_tpu_lint = [0.0]
 def maybe_tpu_lint(min_interval: float = 3600.0) -> None:
     """Run the static-analysis gate (tools/tpu_lint.py) at most once per
     min_interval and log a RED line on any unbaselined finding, stale
-    baseline entry, or a blown runtime budget — an invariant violation
-    (trace purity, collective order, lock discipline, flags/metrics
-    drift) is build-signal before any benchmark ever runs."""
+    baseline entry, or a blown runtime budget (10s cold, 2s warm via the
+    incremental cache) — an invariant violation (trace purity, collective
+    order, lock discipline, flags/metrics drift, retrace hazards, SPMD
+    divergence, use-after-donate, chaos coverage, refcount pairing) is
+    build-signal before any benchmark ever runs. GREEN/RED lines carry
+    the per-rule timing breakdown from --json."""
     now = time.monotonic()
     if _last_tpu_lint[0] and now - _last_tpu_lint[0] < min_interval:
         return
@@ -380,12 +383,22 @@ def maybe_tpu_lint(min_interval: float = 3600.0) -> None:
             continue
     wall = payload.get("wall_s")
     stale = payload.get("stale_baseline") or []
-    if out.returncode == 0 and wall is not None and wall <= 10.0:
+    cache = payload.get("cache", "off")
+    budget = 2.0 if cache == "warm" else 10.0
+    timings = payload.get("rule_timings_s") or {}
+    slowest = ", ".join(
+        f"{rule} {t:.2f}s"
+        for rule, t in sorted(timings.items(), key=lambda kv: -kv[1])[:3])
+    if out.returncode == 0 and wall is not None and wall <= budget:
         log(f"tpu-lint GREEN ({payload.get('files_scanned')} files, "
-            f"{payload.get('baselined')} baselined, {wall}s)")
+            f"{payload.get('files_cached', 0)} cached [{cache}], "
+            f"{payload.get('baselined')} baselined, {wall}s"
+            + (f"; slowest rules: {slowest}" if slowest else "") + ")")
         return
-    if wall is not None and wall > 10.0 and out.returncode == 0:
-        log(f"RED: tpu-lint runtime budget blown — {wall}s > 10s "
+    if wall is not None and wall > budget and out.returncode == 0:
+        log(f"RED: tpu-lint runtime budget blown — {wall}s > {budget}s "
+            f"({cache} cache"
+            + (f"; slowest rules: {slowest}" if slowest else "") + ") "
             "(tools/tpu_lint.py)")
         return
     heads = [f"{f['rule']} {f['path']}:{f['line']}"
