@@ -95,6 +95,30 @@ def _membership_snapshot():
         return None
 
 
+# pipeline in-flight provider for distress dumps — fn() -> dict (schedule
+# name, per-stage last-completed (microbatch, phase), outstanding P2P
+# wires), registered by PipelineEngine.run around each batch. Read from
+# the watchdog thread while the engine is mid-dispatch, so providers must
+# return plain python structures without touching device state.
+_pipeline_fn = [None]
+
+
+def set_pipeline_fn(fn):
+    prev = _pipeline_fn[0]
+    _pipeline_fn[0] = fn
+    return prev
+
+
+def _pipeline_snapshot():
+    fn = _pipeline_fn[0]
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 — diagnostics never mask a hang
+        return None
+
+
 _policy_warned = [False]
 
 
@@ -283,7 +307,8 @@ class CommTaskManager:
                         extra={"stage": stage,
                                "task": task.describe(),
                                "escalation": task.escalations,
-                               "membership": _membership_snapshot()})
+                               "membership": _membership_snapshot(),
+                               "pipeline": _pipeline_snapshot()})
                 except Exception:  # noqa: BLE001
                     pass
                 print(head + "still hung — " + task.describe()
@@ -349,7 +374,8 @@ class CommTaskManager:
                 "comm_watchdog_timeout",
                 extra={"timed_out": [t.describe() for t in expired],
                        "last_issued": list(last) if last else None,
-                       "membership": _membership_snapshot()})
+                       "membership": _membership_snapshot(),
+                       "pipeline": _pipeline_snapshot()})
         except Exception:  # noqa: BLE001 — diagnostics must not mask a hang
             pass
         if dump_path:

@@ -9,6 +9,10 @@ Turns rank failure from a job-killer into a bounded in-job reconfiguration:
 * :mod:`.runtime` — :class:`ElasticRuntime`: failure verdicts, world
   reconfiguration (epoch bump → queue flush → new group → DP rebind →
   ZeRO-1 reshard), and step-boundary rejoin.
+* :mod:`.pipeline` — :class:`ElasticPipelineRuntime`: the pp-axis
+  counterpart (``FLAGS_elastic_pp``): stage-death detection via the same
+  TTL leases, epoch-fenced pipeline runs, bitwise re-partition of the
+  layer stack to the surviving degree, and accumulation-window replay.
 
 Everything except ``epoch`` is imported lazily: ``collective.py`` imports
 this package at module-init time, and ``runtime`` imports ``collective``
@@ -21,14 +25,19 @@ _LAZY = {
     "StoreMembership": "membership",
     "ElasticRuntime": "runtime",
     "maybe_start": "runtime",
+    "ElasticPipelineRuntime": "pipeline",
+    "ElasticPipelineError": "pipeline",
+    "maybe_start_pp": "pipeline",
     "epoch": None,
     "membership": None,
     "runtime": None,
+    "pipeline": None,
 }
 
 __all__ = ["EpochChangedError", "ElasticRuntime", "LocalMembership",
-           "StoreMembership", "maybe_start", "epoch", "membership",
-           "runtime"]
+           "StoreMembership", "maybe_start", "ElasticPipelineRuntime",
+           "ElasticPipelineError", "maybe_start_pp", "epoch", "membership",
+           "runtime", "pipeline"]
 
 
 def __getattr__(name):

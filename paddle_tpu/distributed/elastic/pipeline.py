@@ -1,0 +1,430 @@
+"""Elastic pipeline parallelism: survive stage death without a restart.
+
+The dp axis got in-job elasticity in :mod:`.runtime` (TTL-leased
+heartbeats, epoch-fenced collectives, ZeRO-1 reshard). This module is the
+pp-axis companion — MPMD pipeline training (PAPERS.md: "Scaling Deep
+Learning Training with MPMD Pipeline Parallelism", arXiv 2412.14374) makes
+per-stage failure domains the norm, so a dead pipeline-stage replica must
+shrink the pipeline, not kill the job.
+
+Protocol (``ElasticPipelineRuntime``):
+
+- **Detection** — one TTL heartbeat lease per physical stage group
+  (:class:`~.membership.LocalMembership` over ``P_phys`` "ranks"). The
+  guard installed into the pipeline dispatcher renews every live lease
+  before each action; a stage replica that stops renewing mid-microbatch
+  (a chaos ``pipeline:rank_dead``, or a real controller death in the
+  multi-controller deployment) is declared dead by beat freshness alone.
+- **Fence** — every :meth:`PipelineEngine.run` is stamped with the elastic
+  epoch; each dispatch and P2P hop re-checks the stamp, so when the guard
+  bumps the epoch the in-flight ``_send``/``_recv`` and stage executables
+  raise :class:`EpochChangedError` at an action boundary instead of
+  hanging on a dead stage's buffers. Grads/buffers only commit after the
+  LAST action, so the abort drains the 1F1B queue to a consistent step
+  boundary: model state is exactly the previous optimizer step.
+- **Reconfigure** — epoch bump -> ``async_engine.abort_in_flight`` ->
+  choose the largest feasible degree <= surviving stage groups ->
+  re-express the layer stack through the stage-stacked blocks layout and
+  :meth:`CheckpointManager.reshard_pp` (pure reshapes — bitwise, including
+  every per-param optimizer accumulator stacked alongside) -> rebuild the
+  engine at the new degree and re-validate its schedule from data
+  (``validate()`` + ``simulate()``) before resuming.
+- **Replay** — the caller-facing :meth:`ElasticPipelineRuntime.run`
+  catches the fence, restores the RNG stream to the window start and
+  replays the whole aborted accumulation window on the new engine. Because
+  the abort left state at the previous step boundary and the migration is
+  bitwise, the post-reconfigure losses are bit-exact vs an uninterrupted
+  run that downscaled cleanly at the same boundary
+  (:meth:`reshard_to` — the gate ``tools/elastic_pp_smoke.py`` checks
+  ``loss_gap == 0.0``).
+
+Scope (v1): physical stages only (``num_virtual_pipeline_stages == 1``),
+homogeneous evenly-partitioned block stacks (the same contract as
+``reshard_pp``/``hybrid.stack_pipeline``), and no layer buffers. ZeRO-1
+flat bucket accumulators (``_dp_flat_b*``) are per-world pseudo-params and
+are NOT migrated online — they re-initialize on the new engine's dp groups
+(the per-param state that seeds them travels bitwise; the 3D
+pp-shrink + dp-shrink checkpoint path is covered by ``reshard_pp`` tests).
+
+Single-controller note: as with the dp axis, "stage replicas" are leases
+of one process — drills revoke leases rather than kill OS processes, and
+the machinery exercised (fence, abort, reshard, schedule rebuild, replay)
+is exactly what per-stage controllers need.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import async_engine, flags, rng
+from ...nn.layer.layers import Layer
+from ...observability import emit as _emit
+from ..fault_tolerance import chaos
+from ..fault_tolerance.checkpoint_manager import CheckpointManager
+from ..pipeline import runtime as pp_runtime
+from ..pipeline import schedule as pschedule
+from . import epoch as _epoch
+from .epoch import EpochChangedError
+from .membership import LocalMembership
+
+flags.define_flag(
+    "elastic_pp", False,
+    "Enable elastic pipeline parallelism: per-stage TTL heartbeat leases, "
+    "epoch-fenced pipeline runs, and on stage death an in-job reconfigure "
+    "that reshards the layer stack to the surviving pp degree (bitwise, "
+    "via reshard_pp) and replays the aborted accumulation window")
+
+
+class ElasticPipelineError(RuntimeError):
+    """The pipeline cannot be made elastic or reconfigured: heterogeneous
+    block stack, layer buffers, virtual stages, or no feasible surviving
+    degree. Raised eagerly at construction where possible so a job learns
+    it is not elastic before the first failure, not during one."""
+
+
+def maybe_start_pp(factory: Callable, pp: int,
+                   **kw) -> Optional["ElasticPipelineRuntime"]:
+    """The ``FLAGS_elastic_pp`` opt-in: build and start an
+    :class:`ElasticPipelineRuntime` when the flag is on, else ``None``.
+    ``factory(pp)`` must build a fresh ``(PipelineEngine, optimizer)`` (or
+    a bare engine) at the given degree — it is re-invoked at every
+    reconfiguration and its fresh-initialized state is overwritten with
+    the bitwise-migrated stack."""
+    if not flags.flag_value("elastic_pp"):
+        return None
+    return ElasticPipelineRuntime(factory, pp, **kw).start()
+
+
+def _stage_param_rows(engine) -> List[List[List]]:
+    """Per stage, the param lists of its param-bearing layers, in layer
+    order — the stage-major flat view of the repeating block stack.
+    Validates the elastic-pp contract: no buffers, every stage holds the
+    same number of param layers, every param layer has the same param
+    signature (so the stack restacks through ``reshard_pp``)."""
+    rows = []
+    for st in engine.stages:
+        if st.buffers:
+            raise ElasticPipelineError(
+                f"elastic pp does not migrate layer buffers; stage "
+                f"{st.index} holds {len(st.buffers)}")
+        stage_rows = []
+        for layer in st.layers:
+            if isinstance(layer, Layer):
+                ps = [p for _, p in layer.named_parameters()]
+                if ps:
+                    stage_rows.append(ps)
+        rows.append(stage_rows)
+    counts = {len(r) for r in rows}
+    if len(counts) != 1 or 0 in counts:
+        raise ElasticPipelineError(
+            f"stages hold unequal param-layer counts {sorted(counts)}; "
+            "elastic pp needs a homogeneous, evenly-partitioned block "
+            "stack (the reshard_pp stage-stacked layout)")
+    sig = None
+    for stage_rows in rows:
+        for params in stage_rows:
+            s = [(tuple(p._data.shape), str(p._data.dtype)) for p in params]
+            if sig is None:
+                sig = s
+            elif s != sig:
+                raise ElasticPipelineError(
+                    f"param-bearing layers are not homogeneous ({s} vs "
+                    f"{sig}); elastic pp reshards through the stage-stacked "
+                    "blocks layout, which needs identical repeating blocks")
+    return rows
+
+
+class ElasticPipelineRuntime:
+    """One coordinator per pipeline-trained job. Wire it around the engine
+    factory (NOT a prebuilt engine — the factory is how the runtime
+    rebuilds at a new degree)::
+
+        def factory(pp):
+            model = PipelineLayer(layers=descs(), loss_fn=loss, num_stages=pp)
+            engine = PipelineEngine(model, accumulate_steps=M)
+            opt = paddle.optimizer.Adam(parameters=model.parameters())
+            return engine, opt
+
+        ert = ElasticPipelineRuntime(factory, pp=4).start()
+        ...
+        loss = ert.run(x, y, train=True)   # fenced + auto-replayed
+        ert.optimizer.step(); ert.optimizer.clear_grad()
+
+    ``ert.engine`` / ``ert.optimizer`` are swapped in place by a
+    reconfiguration — always read them through the runtime.
+    """
+
+    def __init__(self, factory: Callable, pp: int, *, membership=None,
+                 ttl: Optional[float] = None, min_pp: int = 1,
+                 max_replays: int = 3):
+        self.factory = factory
+        self.min_pp = int(min_pp)
+        self.max_replays = int(max_replays)
+        if ttl is None:
+            try:  # shared with the dp axis; defined by .runtime when loaded
+                ttl = flags.flag_value("elastic_ttl")
+            except KeyError:
+                ttl = 6.0
+        self.ttl = float(ttl)
+        self.engine, self.optimizer = self._build(int(pp))
+        if self.engine.P != self.engine.P_phys:
+            raise ElasticPipelineError(
+                "elastic pp supports physical stages only "
+                f"(num_virtual_pipeline_stages == 1); got P={self.engine.P} "
+                f"over P_phys={self.engine.P_phys} groups")
+        rows = _stage_param_rows(self.engine)  # contract check, eagerly
+        self._n_block_layers = sum(len(r) for r in rows)
+        self._world = self.engine.P_phys
+        self.membership = membership or LocalMembership(self._world,
+                                                        ttl=self.ttl)
+        self._started = False
+        self._in_reconfigure = False
+        self._prev_guard = None
+        self._prev_kill = None
+        self.reconfigurations = 0
+        self.replays = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ElasticPipelineRuntime":
+        """Install the dispatcher guard and the chaos rank-kill hook.
+        Idempotent."""
+        if self._started:
+            return self
+        self._started = True
+        self._prev_guard = pp_runtime.set_elastic_guard(self._guard)
+        self._prev_kill = chaos.set_rank_kill_hook(self._on_rank_dead)
+        _emit("elastic.event", event="pp_start", world=self._world,
+              ttl=self.ttl)
+        return self
+
+    def stop(self):
+        """Restore the previous hooks and release the stage leases."""
+        if not self._started:
+            return
+        self._started = False
+        pp_runtime.set_elastic_guard(self._prev_guard)
+        chaos.set_rank_kill_hook(self._prev_kill)
+        self._prev_guard = self._prev_kill = None
+        try:
+            self.membership.close()
+        except Exception:  # noqa: BLE001 — best-effort lease release
+            pass
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- failure detection -------------------------------------------------
+
+    def _on_rank_dead(self, victim: int, site: str):
+        """chaos ``rank_dead``: a ``pipeline``-site victim names a STAGE
+        replica — revoke its lease so the next dispatch's guard sees the
+        lapsed beat. Other sites belong to the dp-axis runtime and are
+        forwarded down the hook chain."""
+        if site != "pipeline":
+            prev = self._prev_kill
+            if callable(prev):
+                prev(victim, site)
+            return
+        _emit("elastic.event", event="stage_dead", victim=int(victim),
+              site=site)
+        self.membership.kill(int(victim), immediate=True)
+
+    def _guard(self, phase: str, stage: int, microbatch: int):
+        """Installed into the pipeline dispatcher while started: renew the
+        surviving leases, and when one lapsed, reconfigure and fence the
+        run. Death is judged by beat freshness alone — the guard never
+        needs to be told WHO died, only that a lease went stale."""
+        if self._in_reconfigure:
+            return
+        self.membership.beat()
+        live = self.membership.live()
+        if len(live) >= self._world:
+            return
+        dead = sorted(set(range(self._world)) - set(live))
+        self._reconfigure(dead, reason=f"stage_dead:{phase}"
+                                       f"@s{stage}m{microbatch}")
+        raise EpochChangedError(
+            f"pipeline stage replica(s) {dead} died; reconfigured to "
+            f"pp={self.engine.P_phys} (epoch {_epoch.current()}) — replay "
+            f"the accumulation window on the new engine")
+
+    # -- reconfiguration ---------------------------------------------------
+
+    def _feasible_degree(self, survivors: int) -> Optional[int]:
+        """Largest pp degree that the block stack divides into, bounded by
+        the surviving group count and ``min_pp``."""
+        for d in range(min(survivors, self._world), 0, -1):
+            if self._n_block_layers % d == 0 and d >= self.min_pp:
+                return d
+        return None
+
+    def _reconfigure(self, dead: List[int], reason: str):
+        survivors = self._world - len(dead)
+        new_pp = self._feasible_degree(survivors)
+        if new_pp is None:
+            _emit("elastic.event", event="refuse", live=survivors,
+                  min=self.min_pp, reason=reason)
+            raise ElasticPipelineError(
+                f"no feasible pipeline degree <= {survivors} surviving "
+                f"groups (layers={self._n_block_layers}, "
+                f"min_pp={self.min_pp})")
+        self._do_reshard(new_pp, dead=dead, reason=reason)
+
+    def reshard_to(self, new_pp: int,
+                   reason: str = "planned") -> "pp_runtime.PipelineEngine":
+        """Planned epoch-fenced re-partition at a step boundary — the same
+        protocol as a death reconfigure minus the death (and what an
+        uninterrupted run that downscaled cleanly looks like; the smoke
+        gate compares a drill against exactly this). Returns the new
+        engine."""
+        new_pp = int(new_pp)
+        if new_pp == self._world:
+            return self.engine
+        if new_pp < 1 or self._n_block_layers % new_pp:
+            raise ElasticPipelineError(
+                f"cannot re-partition {self._n_block_layers} block layers "
+                f"to pp={new_pp}")
+        self._do_reshard(new_pp, dead=[], reason=reason)
+        return self.engine
+
+    def _do_reshard(self, new_pp: int, dead: List[int], reason: str):
+        """Epoch bump -> abort in-flight async work -> bitwise stage-state
+        migration through reshard_pp -> fresh engine/optimizer at the new
+        degree, schedule re-validated from data -> swap + fresh leases."""
+        t0 = time.perf_counter()
+        old_pp = self._world
+        self._in_reconfigure = True
+        try:
+            new_epoch = _epoch.bump()
+            aborted = async_engine.abort_in_flight(
+                reason=f"elastic_pp:{reason}")
+            state, acc_names, step_count = self._collect()
+            state = CheckpointManager.reshard_pp(state, new_pp)
+            engine, optimizer = self._build(new_pp)
+            # schedules-as-data: prove the rebuilt schedule before resuming
+            pschedule.validate(engine.actions, engine.P, engine.M,
+                               schedule=engine.schedule)
+            engine.schedule_stats = pschedule.simulate(
+                engine.actions, engine.P, groups=engine.P_phys)
+            self._install(engine, optimizer, state, acc_names, step_count)
+            self.engine, self.optimizer = engine, optimizer
+            self._world = engine.P_phys
+            try:
+                self.membership.close()
+            except Exception:  # noqa: BLE001 — stale leases die with the TTL
+                pass
+            self.membership = LocalMembership(self._world, ttl=self.ttl)
+            self.reconfigurations += 1
+            dur = time.perf_counter() - t0
+            _emit("elastic.reconfigure", dur_s=dur, world=new_pp,
+                  old_world=old_pp, lost=dead, epoch=new_epoch,
+                  aborted_async=aborted, reason=reason, axis="pp")
+            print(f"[elastic] pipeline reconfigured: pp {old_pp} -> "
+                  f"{new_pp} (dead stages {dead}, epoch {new_epoch}, "
+                  f"{dur * 1e3:.0f} ms) reason={reason}", flush=True)
+        finally:
+            self._in_reconfigure = False
+
+    # -- state migration ---------------------------------------------------
+
+    def _build(self, pp: int) -> Tuple["pp_runtime.PipelineEngine", object]:
+        out = self.factory(pp)
+        if isinstance(out, tuple):
+            engine, optimizer = out[0], (out[1] if len(out) > 1 else None)
+        else:
+            engine, optimizer = out, None
+        return engine, optimizer
+
+    def _collect(self):
+        """The live engine's param stack (and every per-param optimizer
+        accumulator) as a stage-stacked ``{"blocks": ...}`` pytree with
+        ``[pp, L/pp, ...]`` leaves — the reshard_pp layout. Host copies
+        via numpy are bitwise; ZeRO-1 flat bucket pseudo-params
+        (``_dp_flat_b*``) are per-world and intentionally left behind."""
+        rows = _stage_param_rows(self.engine)
+        k = len(rows[0][0])
+        blocks = {}
+        for j in range(k):
+            blocks[f"p{j}"] = np.stack([
+                np.stack([np.asarray(params[j]._data)
+                          for params in stage_rows])
+                for stage_rows in rows])
+        inner = getattr(self.optimizer, "inner", self.optimizer)
+        accs = getattr(inner, "_accumulators", None) or {}
+        acc_names: List[List[str]] = []
+        for j in range(k):
+            names = None
+            for stage_rows in rows:
+                for params in stage_rows:
+                    have = sorted((accs.get(params[j].name) or {}).keys())
+                    if names is None:
+                        names = have
+                    elif have != names:
+                        raise ElasticPipelineError(
+                            f"optimizer accumulators are not uniform across "
+                            f"the block stack for param slot {j} ({have} "
+                            f"vs {names})")
+            acc_names.append(names or [])
+            for an in acc_names[j]:
+                blocks[f"p{j}.acc.{an}"] = np.stack([
+                    np.stack([np.asarray(accs[params[j].name][an])
+                              for params in stage_rows])
+                    for stage_rows in rows])
+        step_count = int(getattr(inner, "_step_count", 0) or 0)
+        return {"blocks": blocks}, acc_names, step_count
+
+    def _install(self, engine, optimizer, state, acc_names, step_count):
+        """Overwrite the fresh engine's params (and seed its optimizer's
+        accumulators, re-keyed positionally to the new param names) with
+        the resharded stack, placed on each stage's devices. device_put of
+        host arrays is bitwise for every fixed-width dtype."""
+        rows = _stage_param_rows(engine)
+        blocks = state["blocks"]
+        inner = getattr(optimizer, "inner", optimizer)
+        for s, stage_rows in enumerate(rows):
+            repl = engine.stages[s].repl
+            for l, params in enumerate(stage_rows):
+                for j, p in enumerate(params):
+                    p._data = jax.device_put(
+                        jnp.asarray(blocks[f"p{j}"][s][l]), repl)
+                    if inner is None:
+                        continue
+                    for an in acc_names[j]:
+                        inner._accumulators.setdefault(p.name, {})[an] = \
+                            jax.device_put(jnp.asarray(
+                                blocks[f"p{j}.acc.{an}"][s][l]), repl)
+        if inner is not None and any(acc_names):
+            inner._step_count = step_count
+
+    # -- the fenced, replaying run -----------------------------------------
+
+    def run(self, inputs, labels, train: bool = True, **kw):
+        """Epoch-fenced ``engine.run`` with microbatch-window replay: a
+        world change mid-window aborts at an action boundary (state stays
+        at the previous step), the RNG stream is rewound to the window
+        start, and the whole accumulation window replays on the new
+        engine — so the returned loss is the one an uninterrupted run at
+        the new degree would have produced."""
+        replays = 0
+        while True:
+            self.membership.beat()
+            rng_state = rng.get_rng_state()
+            try:
+                return self.engine.run(inputs, labels, train=train, **kw)
+            except EpochChangedError:
+                rng.set_rng_state(rng_state)
+                replays += 1
+                self.replays += 1
+                _emit("elastic.event", event="pp_replay", replays=replays)
+                if replays > self.max_replays:
+                    raise

@@ -34,6 +34,11 @@ Single-controller note: under the CPU/TPU single-controller runtime all
 heartbeat leases rather than OS processes — the reconfiguration
 machinery (epoch fence, group rebuild, reshard, metrics) is exactly
 what a multi-controller deployment exercises.
+
+This runtime covers the DP axis. Pipeline-stage death (the pp axis) is
+handled by the companion coordinator in :mod:`.pipeline`
+(``FLAGS_elastic_pp``), which reuses the same TTL-lease membership and
+epoch fence to abort, reshard and replay a 1F1B accumulation window.
 """
 from __future__ import annotations
 
@@ -193,7 +198,17 @@ class ElasticRuntime:
     def _chaos_kill(self, victim: int, site: str):
         """chaos ``rank_dead`` landed: revoke the victim's lease so the
         next verdict (watchdog stage or collective-failure hook) sees a
-        changed world."""
+        changed world.
+
+        ``pipeline``-site deaths name a STAGE replica, not a dp rank —
+        they belong to the pp-axis runtime (:mod:`.pipeline`), so they are
+        forwarded down the hook chain instead of killing a dp lease that
+        happens to share the victim's number."""
+        if site == "pipeline":
+            prev = self._prev_hooks.get("rank_kill")
+            if callable(prev):
+                prev(victim, site)
+            return
         _emit("elastic.event", event="rank_dead", victim=victim, site=site)
         self.membership.kill(victim, immediate=True)
 

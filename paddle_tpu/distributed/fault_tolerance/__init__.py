@@ -13,7 +13,7 @@ collective retry wrapper in ``distributed/collective.py``.
 """
 from . import chaos
 from .chaos import ChaosCollectiveTimeout, ChaosError, parse_spec
-from .checkpoint_manager import CheckpointManager
+from .checkpoint_manager import CheckpointManager, PipelineReshardError
 
 __all__ = [
     "chaos",
@@ -21,4 +21,5 @@ __all__ = [
     "ChaosCollectiveTimeout",
     "parse_spec",
     "CheckpointManager",
+    "PipelineReshardError",
 ]

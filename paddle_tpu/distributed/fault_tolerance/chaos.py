@@ -110,7 +110,7 @@ _KINDS = {
     "save": ("crash", "rank_dead"),
     "serving": ("stall", "reject"),
     "replica": ("kill", "stall", "flap"),
-    "pipeline": ("hang",),
+    "pipeline": ("hang", "rank_dead"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
@@ -416,10 +416,20 @@ def _pipeline_hook(phase: str, stage: int, microbatch: int):
     dispatch whenever this hook is installed). 'hang' sleeps ``delay=``
     seconds inside that armed task, so the REAL watchdog expires it and
     the escalation ladder's distress dump names the hung stage and
-    microbatch via the task's description."""
+    microbatch via the task's description. 'rank_dead' drops a stage
+    replica dead mid-microbatch: its heartbeat lease is revoked through
+    the rank-kill hook (``victim=`` overrides which stage dies; the
+    default is the dispatching stage, so ``stage=`` both selects the
+    triggering dispatch and names the victim) — the NEXT dispatch's
+    elastic guard sees the lapsed lease and fences the run."""
     inj = _match("pipeline", op=phase, stage=stage, microbatch=microbatch)
-    if inj is not None and inj.kind == "hang":
+    if inj is None:
+        return
+    if inj.kind == "hang":
         time.sleep(inj.delay)
+        return
+    if inj.kind == "rank_dead":
+        _kill_victim(inj, stage, "pipeline")
 
 
 def _save_hook(phase: str):

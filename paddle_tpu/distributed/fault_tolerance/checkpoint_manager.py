@@ -82,6 +82,14 @@ def _dev_copy(a):
         return np.asarray(a).copy()
 
 
+class PipelineReshardError(ValueError):
+    """A stage-stacked state cannot be restacked to the requested pipeline
+    degree (layer count not divisible, inconsistent stage axes, or leaves
+    without the ``[pp, L/pp, ...]`` leading dims). Raised by
+    :meth:`CheckpointManager.reshard_pp` BEFORE any reshape runs, naming
+    both degrees — instead of an assertion deep in hybrid.stack_pipeline."""
+
+
 def _fsync_dir(path: str):
     try:
         fd = os.open(path, os.O_RDONLY)
@@ -409,6 +417,25 @@ class CheckpointManager:
             raise ValueError("reshard_pp needs a stage-stacked state with a "
                              "'blocks' subtree")
         from_pp = int(leaves[0].shape[0])
+        if any(getattr(leaf, "ndim", 0) < 2 for leaf in leaves):
+            raise PipelineReshardError(
+                f"cannot reshard from pp={from_pp} to pp={to_pp}: every "
+                f"blocks leaf needs [pp, layers_per_stage, ...] leading "
+                f"dims, got shapes "
+                f"{sorted({tuple(getattr(l, 'shape', ())) for l in leaves})}")
+        heads = {tuple(leaf.shape[:2]) for leaf in leaves}
+        if len(heads) != 1:
+            raise PipelineReshardError(
+                f"cannot reshard from pp={from_pp} to pp={to_pp}: blocks "
+                f"leaves disagree on the stage-major layout — leading dims "
+                f"{sorted(heads)} (every leaf must share [pp, "
+                f"layers_per_stage])")
+        n_layers = from_pp * int(leaves[0].shape[1])
+        if n_layers % to_pp:
+            raise PipelineReshardError(
+                f"cannot restack the stage-major blocks from pp={from_pp} "
+                f"to pp={to_pp}: {n_layers} layers do not divide into "
+                f"{to_pp} stages")
         t0 = time.perf_counter()
         out = hybrid.stack_pipeline(hybrid.unstack_pipeline(state), to_pp)
         _emit("ckpt.reshard_pp", dur_s=time.perf_counter() - t0,

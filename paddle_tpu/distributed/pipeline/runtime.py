@@ -55,8 +55,10 @@ from ...core import async_engine, flags, rng
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from ...observability import emit as _emit
+from .. import comm_watchdog as _cw
 from ..comm_watchdog import comm_task
 from .. import quant_comm as _qc
+from ..elastic import epoch as _ep
 from . import schedule as pschedule
 
 flags.define_flag(
@@ -93,6 +95,22 @@ _chaos_hook = [None]
 
 def set_chaos_hook(fn):
     _chaos_hook[0] = fn
+
+
+# elastic choke point: installed by distributed/elastic/pipeline.py while an
+# ElasticPipelineRuntime is active — fn(phase, stage, microbatch) -> None is
+# called before every action dispatch; it renews the stage heartbeat leases,
+# and when a lease lapsed it reconfigures the pipeline and raises
+# EpochChangedError so the run aborts at an action boundary instead of
+# hanging on a dead stage. Slot semantics match set_chaos_hook: None when no
+# runtime is active, so the steady state pays one list lookup per dispatch.
+_elastic_guard = [None]
+
+
+def set_elastic_guard(fn):
+    prev = _elastic_guard[0]
+    _elastic_guard[0] = fn
+    return prev
 
 
 def _collect_state(layers: Sequence[Any]) -> Tuple[List, List]:
@@ -296,6 +314,15 @@ class PipelineEngine:
         ]
         for st in self.stages:
             st.commit()
+        # elastic-epoch stamp of the CURRENT run (refreshed by run()): every
+        # dispatch and P2P hop checks it, so a world change mid-batch raises
+        # EpochChangedError at the next action boundary instead of hanging
+        # on a dead stage's buffers
+        self._run_epoch = _ep.current()
+        # in-flight P2P wires (sent but not yet consumed), for the comm
+        # watchdog's distress-dump pipeline snapshot
+        self._outstanding: Dict[Tuple[str, int, int], str] = {}
+        self.last_dispatch_order: List[Tuple[int, str, int]] = []
 
     # ------------------------------------------------------------------
     def _split_micro(self, arr) -> List:
@@ -315,6 +342,8 @@ class PipelineEngine:
         a compact wire (plain cast, or the block-scaled int8 codec from
         quant_comm) before the transfer; only the wire bytes cross
         devices, and :meth:`_recv` decodes on the consumer side."""
+        _ep.check(self._run_epoch, f"pipeline p2p send ({kind} -> stage "
+                                   f"{dest_stage}, microbatch {m})")
         dst = self.stages[dest_stage]
         ref_nb = int(getattr(arr, "nbytes", 0) or 0)
         t0 = time.perf_counter()
@@ -332,6 +361,8 @@ class PipelineEngine:
               dtype=wdt or str(getattr(arr, "dtype", "")), payload=kind)
         _emit("pipeline.send", dur_s=time.perf_counter() - t0, payload=kind,
               stage=dest_stage, microbatch=m, nbytes=nb)
+        self._outstanding[(kind, dest_stage, m)] = (
+            f"{kind}->stage{dest_stage}:mb{m} ({nb}B)")
         return out
 
     def _recv(self, arr, stage: int, kind: str, m: int):
@@ -341,6 +372,9 @@ class PipelineEngine:
         through ``put_input`` so the stage executables see the same
         placement (batch-sharded or replicated) as an unquantized
         handoff: the stage signatures don't change, so no retraces."""
+        _ep.check(self._run_epoch, f"pipeline p2p recv ({kind} @ stage "
+                                   f"{stage}, microbatch {m})")
+        self._outstanding.pop((kind, stage, m), None)
         if isinstance(arr, _Wire):
             _emit("pipeline.recv", payload=kind, stage=stage, microbatch=m,
                   ready=async_engine._is_ready(arr.buf))
@@ -359,8 +393,24 @@ class PipelineEngine:
         fired EXACTLY ONCE, after the backward of the last microbatch — the
         k-step accumulation contract (`no_sync` inside the wrapper is
         honored; the microbatch loop itself never triggers a collective).
+
+        The run is stamped with the elastic epoch at entry; every dispatch
+        and P2P hop re-checks it, so an elastic reconfiguration anywhere in
+        the process aborts the batch with EpochChangedError at an action
+        boundary. Grads and buffers only commit AFTER the last action, so
+        an aborted run leaves model state exactly at the previous step
+        boundary — the caller replays the whole accumulation window.
         """
+        prev_snap = _cw.set_pipeline_fn(self._inflight_snapshot)
+        try:
+            return self._run_batch(inputs, labels, train, loss_scale, dp)
+        finally:
+            _cw.set_pipeline_fn(prev_snap)
+
+    def _run_batch(self, inputs, labels, train, loss_scale, dp):
         P_, M = self.P, self.M
+        self._run_epoch = _ep.current()
+        self._outstanding.clear()
         if not flags.flag_value("pp_p2p_cache"):
             for st in self.stages:
                 st._exec.clear()
@@ -489,6 +539,13 @@ class PipelineEngine:
 
         def dispatch(s, i):
             kind, m = seqs[s].pop(i)
+            guard = _elastic_guard[0]
+            if guard is not None:
+                # renew heartbeat leases / detect a dead stage; on death
+                # the guard reconfigures and raises EpochChangedError
+                guard(kind, s, m)
+            _ep.check(self._run_epoch,
+                      f"pipeline dispatch ({kind} stage {s} microbatch {m})")
             hook = _chaos_hook[0]
             t0 = time.perf_counter()
             if hook is not None:
@@ -599,3 +656,22 @@ class PipelineEngine:
         e.g. ZeRO-1 `sharded_update`, which updates on the dp group mesh."""
         for st in self.stages:
             st.commit()
+
+    def _inflight_snapshot(self) -> dict:
+        """Pipeline in-flight state for the comm watchdog's distress dumps
+        (registered around each run via comm_watchdog.set_pipeline_fn).
+        Read from a watchdog thread while the engine may be mid-dispatch,
+        so it only copies plain python structures — no device sync."""
+        last: Dict[int, Tuple[int, str]] = {}
+        for s, kind, m in list(self.last_dispatch_order):
+            last[s] = (m, kind)
+        return {
+            "schedule": self.schedule_name,
+            "stages": self.P,
+            "microbatches": self.M,
+            "epoch": self._run_epoch,
+            "last_completed": {
+                str(s): {"microbatch": m, "phase": k}
+                for s, (m, k) in sorted(last.items())},
+            "outstanding_p2p": sorted(self._outstanding.values()),
+        }

@@ -1,0 +1,212 @@
+"""Elastic pipeline parallelism: stage-death detection via TTL leases,
+epoch-fenced pipeline runs, bitwise pp-reshard and accumulation-window
+replay (distributed/elastic/pipeline.py).
+
+The drills run on the 8-virtual-device CPU mesh (conftest.py) in
+single-controller mode: "killing a stage replica" revokes its heartbeat
+lease mid-microbatch, which exercises exactly the machinery (fence,
+abort at an action boundary, stage-state migration through reshard_pp,
+schedule re-validation, window replay) that per-stage controllers need.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+from paddle_tpu.distributed.elastic import (ElasticPipelineError,
+                                            ElasticPipelineRuntime,
+                                            EpochChangedError,
+                                            maybe_start_pp)
+from paddle_tpu.distributed.elastic import epoch as ep
+from paddle_tpu.distributed.fault_tolerance import chaos
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers import (
+    pp_layers)
+from paddle_tpu.distributed.pipeline import PipelineEngine
+from paddle_tpu.distributed.pipeline import runtime as pp_runtime
+
+pytestmark = pytest.mark.chaos
+
+L, H, M = 4, 8, 4
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """No chaos spec, guard, kill hook, or epoch bump may leak."""
+    yield
+    chaos.reconfigure("")
+    chaos.set_rank_kill_hook(None)
+    pp_runtime.set_elastic_guard(None)
+    flags.set_flags({"elastic_pp": False})
+    if ep.current() != 0:
+        ep._reset_for_tests()
+
+
+def _mse(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _factory(pp):
+    descs = []
+    for _ in range(L):
+        descs.append(pp_layers.LayerDesc(nn.Linear, H, H))
+        descs.append(pp_layers.LayerDesc(nn.ReLU))
+    model = pp_layers.PipelineLayer(layers=descs, loss_fn=_mse,
+                                    num_stages=pp)
+    rs = np.random.RandomState(0)
+    for p in model.parameters():
+        p.set_value(paddle.to_tensor(
+            rs.normal(scale=0.2, size=p.shape).astype(np.float32)))
+    engine = PipelineEngine(model, accumulate_steps=M)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    return engine, opt
+
+
+def _batch(seed):
+    rs = np.random.RandomState(seed)
+    x = paddle.to_tensor(rs.normal(size=(M, H)).astype(np.float32))
+    y = paddle.to_tensor(rs.normal(size=(M, H)).astype(np.float32))
+    return x, y
+
+
+def _step(ert, seed):
+    x, y = _batch(seed)
+    loss = ert.run(x, y, train=True)
+    ert.optimizer.step()
+    ert.optimizer.clear_grad()
+    return float(np.asarray(loss._data))
+
+
+def _metric(name, labels=None):
+    return obs.registry().value(name, labels or {})
+
+
+def test_stage_death_drill_reconfigures_once_and_training_continues():
+    """The acceptance drill (tools/elastic_pp_smoke.py runs the 4-stage
+    version as a CI gate): chaos drops a stage dead mid-1F1B; exactly one
+    reconfigure is asserted from the metrics registry, and the survivors
+    keep training at the shrunken degree."""
+    ert = ElasticPipelineRuntime(_factory, 2).start()
+    rc0 = _metric("paddle_elastic_events_total", {"kind": "reconfigure"})
+    sd0 = _metric("paddle_elastic_events_total", {"kind": "stage_dead"})
+    try:
+        losses = [_step(ert, seed=0)]
+        chaos.reconfigure("pipeline:rank_dead@stage=1;count=1")
+        losses += [_step(ert, seed=i) for i in (1, 2)]
+    finally:
+        chaos.reconfigure("")
+        ert.stop()
+    assert _metric("paddle_elastic_events_total",
+                   {"kind": "reconfigure"}) - rc0 == 1
+    assert _metric("paddle_elastic_events_total",
+                   {"kind": "stage_dead"}) - sd0 == 1
+    assert ert.engine.P_phys == 1          # 4 layers, 1 survivor
+    assert ert.reconfigurations == 1 and ert.replays == 1
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_planned_reshard_to_is_bitwise_params_and_optimizer_state():
+    """reshard_to re-partitions the live stack through reshard_pp: every
+    param AND every Adam accumulator must land bit-equal (in flattened
+    layer order) on the new stages, and the step count must carry."""
+    ert = ElasticPipelineRuntime(_factory, 2).start()
+    try:
+        for i in range(2):
+            _step(ert, seed=i)
+
+        def flat(engine, opt):
+            inner = getattr(opt, "inner", opt)
+            ps, accs = [], []
+            for st in engine.stages:
+                for p in st.params:
+                    ps.append(np.asarray(p._data).copy())
+                    accs.append({k: np.asarray(v).copy() for k, v in
+                                 inner._accumulators[p.name].items()})
+            return ps, accs, inner._step_count
+
+        ps0, accs0, step0 = flat(ert.engine, ert.optimizer)
+        assert step0 == 2 and accs0 and all(a for a in accs0)
+        ert.reshard_to(1)
+        assert ert.engine.P_phys == 1
+        ps1, accs1, step1 = flat(ert.engine, ert.optimizer)
+        assert step1 == step0
+        assert len(ps0) == len(ps1)
+        for a, b in zip(ps0, ps1):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(accs0, accs1):
+            assert sorted(a) == sorted(b)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+        # and the swapped-in optimizer still drives training
+        assert np.isfinite(_step(ert, seed=2))
+    finally:
+        ert.stop()
+
+
+def test_epoch_bump_mid_run_raises_instead_of_hanging():
+    """The fence itself: every dispatch and P2P hop re-checks the run's
+    epoch stamp, so a world change lands as EpochChangedError at an
+    action boundary — never a hang on a dead stage's buffers."""
+    engine, _ = _factory(2)
+    x, y = _batch(0)
+    fired = [0]
+
+    def bump_once(phase, stage, microbatch):
+        if fired[0] == 3:
+            ep.bump()
+        fired[0] += 1
+
+    prev = pp_runtime.set_elastic_guard(bump_once)
+    try:
+        with pytest.raises(EpochChangedError, match="pipeline"):
+            engine.run(x, y, train=True)
+    finally:
+        pp_runtime.set_elastic_guard(prev)
+    assert fired[0] >= 4
+
+
+def test_refuses_heterogeneous_stack():
+    """Elastic pp reshards through the stage-stacked blocks layout, which
+    only exists for homogeneous repeating blocks — a mixed stack must be
+    refused at construction, before any failure."""
+
+    def bad_factory(pp):
+        descs = [pp_layers.LayerDesc(nn.Linear, H, 2 * H),
+                 pp_layers.LayerDesc(nn.Linear, 2 * H, H)]
+        model = pp_layers.PipelineLayer(layers=descs, loss_fn=_mse,
+                                        num_stages=pp)
+        return PipelineEngine(model, accumulate_steps=M)
+
+    with pytest.raises(ElasticPipelineError, match="homogeneous|identical"):
+        ElasticPipelineRuntime(bad_factory, 2)
+
+
+def test_maybe_start_pp_gated_on_flag():
+    assert maybe_start_pp(_factory, 2) is None
+    flags.set_flags({"elastic_pp": True})
+    ert = maybe_start_pp(_factory, 2)
+    try:
+        assert isinstance(ert, ElasticPipelineRuntime)
+        assert ert.engine.P_phys == 2
+    finally:
+        ert.stop()
+        flags.set_flags({"elastic_pp": False})
+
+
+def test_no_feasible_degree_refuses_and_raises():
+    """min_pp above the surviving degree: the runtime must refuse (with a
+    metric) rather than silently train a mis-partitioned model."""
+    ert = ElasticPipelineRuntime(_factory, 2, min_pp=2).start()
+    rf0 = _metric("paddle_elastic_events_total", {"kind": "refuse"})
+    try:
+        _step(ert, seed=0)
+        chaos.reconfigure("pipeline:rank_dead@stage=0;count=1")
+        with pytest.raises(ElasticPipelineError, match="feasible"):
+            _step(ert, seed=1)
+    finally:
+        chaos.reconfigure("")
+        ert.stop()
+    assert _metric("paddle_elastic_events_total",
+                   {"kind": "refuse"}) - rf0 == 1

@@ -676,6 +676,66 @@ def test_reshard_on_load_after_world_change(tmp_path, save_ranks,
     assert not sd["w"]._data.sharding.is_fully_replicated
 
 
+@pytest.mark.parametrize("from_pp,to_pp,from_dp,to_dp", [
+    (4, 2, 2, 1),   # simultaneous shrink on both axes: 4x2 -> 2x1
+    (2, 4, 1, 2),   # the inverse 3D move (grow both axes back)
+    (4, 1, 4, 2),   # collapse the pipeline while halving dp
+], ids=["shrink-4x2-to-2x1", "grow-2x1-to-4x2", "collapse-4x4-to-1x2"])
+def test_reshard_pp_with_simultaneous_dp_shrink_bit_exact(
+        from_pp, to_pp, from_dp, to_dp):
+    """A 3D world change loses ranks on BOTH axes at once: the pipeline
+    degree shrinks (reshard_pp restacks the blocks) while the dp degree
+    shrinks (each per-stage ZeRO-1 flat accumulator regroups its dp-shard
+    axis). Both moves are pure reshapes over a fixed flat layer order, so
+    the composed round trip must be bitwise — including the optimizer
+    moments riding in the blocks subtree."""
+    L, S = 8, 12                       # layers; flat-shard elems per dp rank
+    flat = from_dp * S                 # per-layer flat accumulator length
+    assert flat % to_dp == 0
+    lps = L // from_pp
+
+    def leaf(tag, *shape):
+        n = int(np.prod(shape))
+        return (np.arange(n, dtype=np.float32) + 1000.0 * tag).reshape(shape)
+
+    state = {
+        "embed": leaf(1, 32, 16),      # pp-invariant, passes through
+        "blocks": {
+            "w": leaf(2, from_pp, lps, 16, 16),
+            "b": leaf(3, from_pp, lps, 16),
+            # per-stage ZeRO-1 flat Adam moment, sharded over dp ranks
+            "w.acc.m": leaf(4, from_pp, lps, from_dp, S),
+        },
+    }
+    ref = {k: v.copy() for k, v in state["blocks"].items()}
+
+    # pp axis: restack stages
+    out = CheckpointManager.reshard_pp(state, to_pp)
+    assert out["blocks"]["w"].shape == (to_pp, L // to_pp, 16, 16)
+    np.testing.assert_array_equal(np.asarray(out["embed"]), state["embed"])
+
+    # dp axis: regroup each layer's flat shard axis [from_dp, S] ->
+    # [to_dp, flat/to_dp] without touching the flat element order
+    acc = np.asarray(out["blocks"]["w.acc.m"])
+    out["blocks"]["w.acc.m"] = acc.reshape(
+        to_pp, L // to_pp, to_dp, flat // to_dp)
+
+    # flat layer order is the invariant both moves preserve
+    for k in ref:
+        np.testing.assert_array_equal(
+            np.asarray(out["blocks"][k]).reshape(L, -1),
+            ref[k].reshape(L, -1))
+
+    # compose the inverse moves: bitwise round trip on both axes
+    back = CheckpointManager.reshard_pp(out, from_pp)
+    back["blocks"]["w.acc.m"] = np.asarray(
+        back["blocks"]["w.acc.m"]).reshape(from_pp, lps, from_dp, S)
+    for k in ref:
+        got = np.asarray(back["blocks"][k])
+        assert got.dtype == ref[k].dtype and got.shape == ref[k].shape
+        np.testing.assert_array_equal(got, ref[k])
+
+
 # ---------------------------------------------------------------------------
 # Distress path exception-proofing
 # ---------------------------------------------------------------------------

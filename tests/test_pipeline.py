@@ -9,6 +9,7 @@ design (arXiv 2412.14374): a schedule is an explicit per-stage action
 list that is validated and simulated BEFORE anything executes.
 """
 import glob
+import json
 import os
 
 import numpy as np
@@ -332,6 +333,31 @@ def test_checkpoint_reshard_pp_round_trip():
         CheckpointManager.reshard_pp({"embed": state["embed"]}, 2)
 
 
+def test_checkpoint_reshard_pp_typed_errors_name_both_degrees():
+    """Input that cannot restack must fail with PipelineReshardError
+    (a ValueError) BEFORE any reshape runs, naming both degrees — not an
+    assertion from deep inside hybrid.stack_pipeline."""
+    from paddle_tpu.distributed.fault_tolerance import PipelineReshardError
+    from paddle_tpu.distributed.fault_tolerance.checkpoint_manager import (
+        CheckpointManager)
+
+    good = np.zeros((2, 4, 3, 3), np.float32)
+    # layer count that does not divide the target degree
+    with pytest.raises(PipelineReshardError,
+                       match=r"pp=2.*pp=3.*8 layers"):
+        CheckpointManager.reshard_pp({"blocks": {"w": good}}, 3)
+    # leaves that disagree on the stage-major [pp, layers_per_stage] head
+    with pytest.raises(PipelineReshardError,
+                       match=r"pp=2 to pp=4.*leading dims"):
+        CheckpointManager.reshard_pp(
+            {"blocks": {"w": good, "b": np.zeros((2, 3, 3), np.float32)}}, 4)
+    # a leaf without the stacked leading dims at all
+    with pytest.raises(PipelineReshardError, match=r"pp=2 to pp=1"):
+        CheckpointManager.reshard_pp(
+            {"blocks": {"w": good, "s": np.zeros((2,), np.float32)}}, 1)
+    assert issubclass(PipelineReshardError, ValueError)
+
+
 # ---------------------------------------------------------------------------
 # Chaos drill: a hung stage escalates the watchdog and is NAMED
 # ---------------------------------------------------------------------------
@@ -364,6 +390,19 @@ def test_chaos_stage_hang_names_stage_in_distress_dump(tmp_path, capfd):
         blob = "".join(open(f).read() for f in dumps)
         assert "stage=1 microbatch=0" in blob
         assert "pp:" in blob  # the op name carries the pipeline phase
+        # the in-flight pipeline snapshot rides next to the membership
+        # section: schedule name, per-stage last-completed (microbatch,
+        # phase), and the outstanding P2P wires at dump time
+        docs = [json.loads(open(f).read()) for f in dumps]
+        snaps = [d["extra"]["pipeline"] for d in docs
+                 if d.get("extra", {}).get("pipeline")]
+        assert snaps, "distress dump carried no pipeline snapshot"
+        snap = snaps[0]
+        assert snap["schedule"] == "1f1b"
+        assert snap["stages"] == 2
+        assert "last_completed" in snap and "outstanding_p2p" in snap
+        for entry in snap["last_completed"].values():
+            assert {"microbatch", "phase"} <= set(entry)
     finally:
         flags.set_flags({"chaos_spec": "", "comm_timeout": 0.0,
                          "watchdog_policy": "", "distress_dir": "",

@@ -497,6 +497,51 @@ def maybe_pp_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/pp_smoke.py)")
 
 
+_last_elastic_pp_smoke = [0.0]
+
+
+def maybe_elastic_pp_smoke(min_interval: float = 3600.0) -> None:
+    """Run the elastic-pipeline smoke (tools/elastic_pp_smoke.py) at most
+    once per min_interval and log a RED line on regression — a stage-death
+    drill that doesn't reconfigure exactly once, a post-death loss that is
+    not bit-equal to a planned downscale at the same boundary, or a
+    steady-state retrace after the replay step re-warms the pp=2 stages."""
+    now = time.monotonic()
+    if _last_elastic_pp_smoke[0] and now - _last_elastic_pp_smoke[0] \
+            < min_interval:
+        return
+    _last_elastic_pp_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "elastic_pp_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: elastic pp smoke hung >600s — stage-death drill "
+            "deadlocked (the hang elastic pp exists to prevent)")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"elastic pp smoke GREEN ({payload.get('wall_s')}s: "
+            f"pp {payload.get('pp')} -> {payload.get('new_pp')}, "
+            f"reconfigures={payload.get('reconfigures')}, "
+            f"replays={payload.get('replays')}, "
+            f"loss_gap={payload.get('loss_gap')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: elastic pp smoke regression rc={out.returncode} — {detail} "
+        f"(tools/elastic_pp_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -608,6 +653,7 @@ def main() -> None:
         maybe_quant_smoke()
         maybe_elastic_smoke()
         maybe_pp_smoke()
+        maybe_elastic_pp_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -622,6 +668,7 @@ def main() -> None:
             maybe_quant_smoke()
             maybe_elastic_smoke()
             maybe_pp_smoke()
+            maybe_elastic_pp_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
