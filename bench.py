@@ -87,7 +87,7 @@ def _llama_config():
     return cfg, 4, 2048, 1, 5, 2
 
 
-def _llama_build(cfg, B, T, M, warmup, attn_impl, remat):
+def _llama_build(cfg, B, T, M, warmup, attn_impl, remat, ffn_impl="stock"):
     from paddle_tpu.models import llama as L
     from paddle_tpu.distributed import hybrid as H
 
@@ -97,7 +97,7 @@ def _llama_build(cfg, B, T, M, warmup, attn_impl, remat):
     opt = H.init_opt_state(sp)
     step = H.make_train_step(cfg, mesh, num_microbatches=M,
                              hp=H.AdamWConfig(lr=1e-4), attn_impl=attn_impl,
-                             remat=remat)
+                             remat=remat, ffn_impl=ffn_impl)
     k = jax.random.PRNGKey(1)
     tokens = jax.random.randint(k, (B, T), 0, cfg.vocab_size, jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
@@ -117,15 +117,25 @@ def bench_llama():
     # Measured on the v5e-class chip: flash+dots-remat = 0.353 MFU,
     # flash+full-remat = 0.291, xla attention ~= 0.20.
     ladder = [
-        ("auto", "dots", "on (dots remat)"),
-        ("auto", True, "on (full remat)"),
-        ("xla", True, "off (fallback)"),
+        ("auto", "dots", "stock", "on (dots remat)"),
+        ("auto", True, "stock", "on (full remat)"),
+        ("xla", True, "stock", "off (fallback)"),
     ]
+    # fused-FFN rung on top where the kernel is real (TPU): the config-1
+    # MFU lever. One rung, same remat policy as the next rung down, so a
+    # Mosaic failure in the FFN kernel degrades to the identical stock
+    # build rather than changing two variables at once.
+    from paddle_tpu.ops.pallas import fused_ffn as FF
+
+    if FF.available():
+        ladder.insert(0, ("auto", "dots", "pallas",
+                          "on (dots remat + pallas ffn)"))
     errors = []
     built = None
-    for attn_impl, remat, label in ladder:
+    for attn_impl, remat, ffn_impl, label in ladder:
         try:
-            built = _llama_build(cfg, B, T, M, warmup, attn_impl, remat)
+            built = _llama_build(cfg, B, T, M, warmup, attn_impl, remat,
+                                 ffn_impl)
             flash = label
             if errors:
                 flash += f" after {len(errors)} fallback(s): {errors[-1][:160]}"
@@ -149,7 +159,8 @@ def bench_llama():
         "details": {"mfu": round(mfu, 4),
                     "step_time_s": round(dt / steps, 4),
                     "loss": float(loss), "params": cfg.num_params(),
-                    "batch": B, "seq": T, "flash": flash},
+                    "batch": B, "seq": T, "flash": flash,
+                    "ffn": ffn_impl},
     }
 
 
@@ -1231,6 +1242,27 @@ def main():
                 r["vs_baseline"] = 1.0  # first TPU run pins the baseline
             if platform != "cpu" and name not in new_baselines:
                 new_baselines[name] = r["value"]
+            # MFU red-line: on an attested platform with the pallas-ffn
+            # rung active, the flagship's MFU is pinned as its own floor
+            # ("llama_train_mfu_floor") — dropping below it REDs even when
+            # raw tokens/s stays above the throughput pin (e.g. a kernel
+            # regression masked by a faster host). Stock-ffn runs never
+            # pin or gate the floor: the floor attests the fused path.
+            mfu = (r.get("details") or {}).get("mfu")
+            if (name == "llama_train_tokens_per_sec_per_chip"
+                    and platform != "cpu" and mfu
+                    and (r.get("details") or {}).get("ffn") == "pallas"):
+                floor = baselines.get("llama_train_mfu_floor")
+                r["details"]["mfu_floor"] = floor or round(mfu, 4)
+                if floor and mfu < floor:
+                    r["red_signal"] = True
+                    _PLATFORM_NOTE.setdefault("red_signals", []).append(
+                        "llama_train_mfu")
+                    print(f"[bench] RED: pallas-ffn mfu={mfu} below "
+                          f"pinned floor {floor}", file=sys.stderr,
+                          flush=True)
+                if "llama_train_mfu_floor" not in new_baselines:
+                    new_baselines["llama_train_mfu_floor"] = round(mfu, 4)
         except Exception as e:  # noqa: BLE001 — one config must not kill the rest
             r = {"value": 0.0, "unit": "n/a", "vs_baseline": 0.0,
                  "details": {"error": f"{type(e).__name__}: {str(e)[:300]}"}}

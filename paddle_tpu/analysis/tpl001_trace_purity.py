@@ -108,6 +108,19 @@ def _entries(index: ModuleIndex):
                 yield fn, f"<lambda@{fn.lineno}>"
             else:
                 yield fn, index.qualname(fn)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "defvjp"):
+            # custom_vjp registration: `prim.defvjp(fwd, bwd)` makes fwd and
+            # bwd traced code even when neither is jitted or passed to
+            # pallas_call directly (the vjp closures run under the caller's
+            # trace) — walk both as entries
+            for arg in node.args:
+                if not isinstance(arg, ast.Name):
+                    continue
+                fn = index.resolve_name(arg.id, node)
+                if fn is not None:
+                    yield fn, index.qualname(fn)
 
 
 def _rng_slug(d: str) -> str:

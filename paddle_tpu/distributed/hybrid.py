@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import flags
 from ..models import llama as L
 
 MESH_AXES = ("dp", "pp", "cp", "tp")
@@ -284,7 +285,7 @@ def _moe_ffn(h_full, lp, cfg: L.LlamaConfig, ep_size: int):
 
 
 def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
-              attn_impl: str = "auto", cp: int = 1):
+              attn_impl: str = "auto", cp: int = 1, ffn_impl: str = "stock"):
     """One transformer block with Megatron TP + sequence parallelism.
 
     x: [B, T/tp, D] sequence-sharded. lp: this layer's local weight shards.
@@ -324,9 +325,10 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
         y_partial = _moe_ffn(h_full, lp, cfg, ep_size)  # partial over tp
         x = x + lax.psum_scatter(y_partial, "tp", scatter_dimension=1, tiled=True)
     else:
-        g = jax.nn.silu(h_full @ lp["w1"].astype(h_full.dtype))
-        g = g * (h_full @ lp["w3"].astype(h_full.dtype))
-        partial = g @ lp["w2"].astype(g.dtype)
+        # column-parallel w1/w3 + row-parallel w2 → the shard's FFN body is
+        # exactly the dense SwiGLU over local f/tp, so the fused Pallas
+        # kernel drops in per-shard, before the tp reduce-scatter
+        partial = L.ffn(h_full, lp, impl=ffn_impl)
         x = x + lax.psum_scatter(partial, "tp", scatter_dimension=1, tiled=True)
     return x
 
@@ -334,7 +336,7 @@ def _block_sp(x, lp, cfg: L.LlamaConfig, cos, sin, ep_size: int,
 def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
                      dp: int, pp: int, tp: int, cp: int = 1,
                      remat: Union[bool, str] = True,
-                     attn_impl: str = "auto"):
+                     attn_impl: str = "auto", ffn_impl: str = "stock"):
     """Build the per-shard loss(params, tokens, targets) -> scalar function.
 
     Inside: GPipe pipeline over `num_microbatches`, TP/SP per block,
@@ -344,7 +346,7 @@ def _make_shard_loss(cfg: L.LlamaConfig, num_microbatches: int,
 
     def stage_fn(x, blocks_local, cos, sin):
         body = lambda carry, lp: (_block_sp(carry, lp, cfg, cos, sin, dp,
-                                            attn_impl, cp), None)
+                                            attn_impl, cp, ffn_impl), None)
         if remat not in (True, False, "dots"):
             raise ValueError(f"remat must be True, False or 'dots', got {remat!r}")
         if remat == "dots":
@@ -441,7 +443,8 @@ def sync_grads(grads, specs):
 def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
                     hp: Optional[AdamWConfig] = None,
                     remat: Union[bool, str] = True,
-                    attn_impl: str = "auto", loss_fn=None):
+                    attn_impl: str = "auto", loss_fn=None,
+                    ffn_impl: Optional[str] = None):
     """Model-agnostic entry (VERDICT r3 task #2).
 
     cfg: a LlamaConfig (the hand-optimized flagship path below) OR any
@@ -463,6 +466,9 @@ def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
     False = save everything (usually OOMs beyond toy sizes).
     attn_impl: "auto" (Pallas flash on TPU when supported), "flash" (force),
     anything else = plain XLA attention.
+    ffn_impl: None resolves FLAGS_pallas_ffn HERE, at build time (the flag
+    never reaches traced code — trace purity); "pallas" forces the fused
+    SwiGLU kernel on supported shapes; anything else = stock XLA FFN.
     """
     if not isinstance(cfg, L.LlamaConfig):
         from .hybrid_generic import GenericHybridEngine
@@ -480,10 +486,15 @@ def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
         step.engine = eng
         return step
     hp = hp or AdamWConfig()
+    if ffn_impl is None:
+        from ..ops.pallas import fused_ffn as _ff
+
+        ffn_impl = "pallas" if (flags.flag_value("pallas_ffn")
+                                and _ff.available()) else "stock"
     dp, pp, cp, tp = (mesh.shape[a] for a in MESH_AXES)
     specs = param_specs(cfg)
     shard_loss = _make_shard_loss(cfg, num_microbatches, dp, pp, tp, cp,
-                                  remat, attn_impl)
+                                  remat, attn_impl, ffn_impl)
     opt_specs = {"m": specs, "v": specs, "step": P()}
 
     def per_shard_step(params, opt, tokens, targets):

@@ -17,6 +17,7 @@ cache for all prompt tokens in one pass.
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -24,11 +25,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core import flags
 from ..models import llama as L
 from ..observability import emit as _obs_emit
+from ..ops.pallas import fused_ffn as FF
 from . import quant as Q
 
 __all__ = ["LLMPredictor", "init_cache"]
+
+
+def _ffn_fusable(h, lp) -> bool:
+    """Static (trace-time) gate: can this block's FFN run through the fused
+    Pallas kernel? Checks the param leaf structure (fp or weight-only int8;
+    w8a8/fp8 fall back) and the kernel's shape support."""
+    kind = FF.params_kind(lp)
+    if kind is None:
+        return False
+    w1 = lp["w1"] if kind == "fp" else lp["w1_q"]
+    d, f = w1.shape[-2], w1.shape[-1]
+    return FF.supported(math.prod(h.shape[:-1]), d, f)
 
 
 def init_cache(cfg: L.LlamaConfig, batch: int, max_len: int,
@@ -58,7 +73,7 @@ def _cached_attention(q, ck, cv, pos_limit):
 
 
 def _block_cached(x, lp, cfg: L.LlamaConfig, cache_k, cache_v, pos,
-                  attn_impl: str):
+                  attn_impl: str, ffn_impl: str = "stock"):
     """One transformer block writing its k/v into the cache at `pos`.
     x [B, T, d]; cache_k/v [B, S, KV, hd]; pos: scalar start index.
     Returns (x_out, cache_k, cache_v)."""
@@ -85,6 +100,8 @@ def _block_cached(x, lp, cfg: L.LlamaConfig, cache_k, cache_v, pos,
     h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
     if cfg.num_experts:
         x = x + L.moe_mlp(h, lp, cfg)
+    elif ffn_impl == "pallas" and _ffn_fusable(h, lp):
+        x = x + FF.apply_ffn(h, lp)
     else:
         gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
                 * Q.matmul_param(h, lp, "w3"))
@@ -93,7 +110,7 @@ def _block_cached(x, lp, cfg: L.LlamaConfig, cache_k, cache_v, pos,
 
 
 def _forward_cached(params, tokens, cache, pos, cfg: L.LlamaConfig,
-                    attn_impl: str):
+                    attn_impl: str, ffn_impl: str = "stock"):
     """tokens [B, T] starting at absolute position `pos` (scalar int32).
     Returns (logits [B, T, V] f32, new cache)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
@@ -101,7 +118,8 @@ def _forward_cached(params, tokens, cache, pos, cfg: L.LlamaConfig,
     def body(carry, layer):
         x = carry
         lp, ck, cv = layer
-        x, ck, cv = _block_cached(x, lp, cfg, ck, cv, pos, attn_impl)
+        x, ck, cv = _block_cached(x, lp, cfg, ck, cv, pos, attn_impl,
+                                  ffn_impl)
         return x, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
@@ -162,7 +180,8 @@ class LLMPredictor:
     def __init__(self, cfg: L.LlamaConfig, params: Dict[str, Any],
                  max_len: Optional[int] = None, attn_impl: str = "auto",
                  cache_dtype=None, weight_dtype=None,
-                 quant_mode: Optional[str] = None, quant_manifest=None):
+                 quant_mode: Optional[str] = None, quant_manifest=None,
+                 pallas_ffn: Optional[bool] = None):
         self.cfg = cfg
         if weight_dtype is not None:
             params = jax.tree.map(
@@ -187,20 +206,31 @@ class LLMPredictor:
         self.max_len = int(max_len or cfg.max_seq_len)
         self.attn_impl = attn_impl
         self.cache_dtype = cache_dtype or cfg.dtype
+        # fused-FFN routing resolves HERE (host side, construction time):
+        # None = FLAGS_pallas_ffn on real TPU hardware; True forces the
+        # kernel (interpret mode off-TPU — the parity-test hook); False = off.
+        # The resolved string is a static closure constant, so the flag never
+        # reaches traced code and flipping it means a new predictor, not a
+        # retrace of this one.
+        if pallas_ffn is None:
+            pallas_ffn = bool(flags.flag_value("pallas_ffn")
+                              and FF.available())
+        self.ffn_impl = "pallas" if pallas_ffn else "stock"
 
         cfg_ = cfg
         impl = attn_impl
+        fimpl = self.ffn_impl
 
         @jax.jit
         def prefill(params, tokens, cache):
             logits, cache = _forward_cached(params, tokens, cache,
-                                            jnp.int32(0), cfg_, impl)
+                                            jnp.int32(0), cfg_, impl, fimpl)
             return logits[:, -1], cache
 
         @functools.partial(jax.jit, donate_argnums=(2,))
         def decode_step(params, token, cache, pos):
             logits, cache = _forward_cached(params, token[:, None], cache,
-                                            pos, cfg_, "xla")
+                                            pos, cfg_, "xla", fimpl)
             return logits[:, -1], cache
 
         self._prefill = prefill
@@ -220,6 +250,7 @@ class LLMPredictor:
         if fn is not None:
             return fn
         cfg_ = self.cfg
+        fimpl = self.ffn_impl
 
         if sample:
             @functools.partial(jax.jit, donate_argnums=(2,))
@@ -234,7 +265,8 @@ class LLMPredictor:
                     nxt = jnp.where(finished, eos, nxt)
                     finished = finished | (nxt == eos)
                     logits, cache = _forward_cached(params, nxt[:, None],
-                                                    cache, pos, cfg_, "xla")
+                                                    cache, pos, cfg_, "xla",
+                                                    fimpl)
                     return (logits[:, -1], cache, pos + 1, finished, key), nxt
 
                 (logits, cache, pos, finished, key), toks = lax.scan(
@@ -250,7 +282,8 @@ class LLMPredictor:
                     nxt = jnp.where(finished, eos, nxt)
                     finished = finished | (nxt == eos)
                     logits, cache = _forward_cached(params, nxt[:, None],
-                                                    cache, pos, cfg_, "xla")
+                                                    cache, pos, cfg_, "xla",
+                                                    fimpl)
                     return (logits[:, -1], cache, pos + 1, finished), nxt
 
                 (logits, cache, pos, finished), toks = lax.scan(

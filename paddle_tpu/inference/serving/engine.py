@@ -47,6 +47,9 @@ from ...core import flags
 from ...models import llama as L
 from ...observability import emit as _emit
 from ...ops.kernels.serving_attention import block_multihead_attention_
+from ...ops.pallas import flash_attention as FA
+from ...ops.pallas import fused_ffn as FF
+from ...ops.pallas import fused_sample as FS
 from ...ops.pallas import paged_attention as PA
 from .. import quant as Q
 from .block_manager import BlockManager
@@ -121,7 +124,8 @@ class PagedServingEngine:
                  max_queue: Optional[int] = None, cache_dtype=None,
                  weight_dtype=None, quant_mode: Optional[str] = None,
                  quant_kv: Optional[bool] = None, quant_manifest=None,
-                 pallas: Optional[bool] = None):
+                 pallas: Optional[bool] = None,
+                 pallas_ffn: Optional[bool] = None):
         if cfg.num_experts:
             raise NotImplementedError(
                 "PagedServingEngine serves dense LLaMA; route MoE decode "
@@ -188,7 +192,8 @@ class PagedServingEngine:
         self._events_by_rid: Dict[int, List[TokenEvent]] = {}
         self.stats = {"steps": 0, "step_builds": 0, "tokens_computed": 0,
                       "cow_block_copies": 0, "pallas_steps": 0,
-                      "decode_fast_steps": 0}
+                      "decode_fast_steps": 0, "ffn_steps": 0,
+                      "fused_ticks": 0, "tick_pallas_launches": 0}
         # pallas attention read: None = FLAGS_serving_pallas_attention
         # (re-read each tick, so flips retrace via the executable key);
         # True = force (interpret mode off-TPU — how CPU CI drives it);
@@ -201,6 +206,25 @@ class PagedServingEngine:
                 f"KV={cfg.num_kv_heads} hd={cfg.head_dim} "
                 f"block_size={self.block_size} is not supported() by the "
                 f"paged-attention kernel")
+        # fused-FFN routing mirrors the attention tri-state: None =
+        # FLAGS_pallas_ffn per tick; True = force (interpret off-TPU);
+        # False = off. Forced mode validates params + geometry eagerly.
+        self.pallas_ffn = pallas_ffn
+        if pallas_ffn:
+            blocks0 = self.params["blocks"]
+            kind = FF.params_kind(blocks0)
+            if kind is None:
+                raise ValueError(
+                    "pallas_ffn=True forced but the (quantized) param "
+                    "leaves are not fusable: the fused FFN kernel covers "
+                    "fp and weight-only int8 (w8); w8a8/fp8 fall back")
+            w1 = blocks0["w1"] if kind == "fp" else blocks0["w1_q"]
+            d, f = int(w1.shape[-2]), int(w1.shape[-1])
+            rows = max(self.token_budget, self.max_batch)
+            if not FF.supported(rows, d, f):
+                raise ValueError(
+                    f"pallas_ffn=True forced but FFN geometry d={d} f={f} "
+                    f"rows<={rows} is not supported() by the fused kernel")
 
         # device state: stacked per-layer paged caches (scanned with the
         # layer axis, like llm.py's init_cache)
@@ -362,13 +386,41 @@ class PagedServingEngine:
             return False, "unsupported"
         return True, None
 
-    def _build_step(self, tok_pad: int, B: int, pallas_mode=False):
+    def _resolve_ffn(self) -> Tuple[bool, Optional[str]]:
+        """Host-side fused-FFN dispatch for this tick: (on, fallback
+        reason). Same tri-state contract as `_resolve_pallas`; the result
+        rides the executable cache key so flag flips retrace exactly once."""
+        if self.pallas_ffn is False:
+            return False, None
+        if self.pallas_ffn:      # forced (params+geometry validated at init)
+            return True, None
+        if not flags.flag_value("pallas_ffn"):
+            return False, None
+        blocks0 = self.params["blocks"]
+        kind = FF.params_kind(blocks0)
+        if kind is None:
+            return False, "quant"
+        if not FF.available():
+            return False, "unavailable"
+        w1 = blocks0["w1"] if kind == "fp" else blocks0["w1_q"]
+        if not FF.supported(max(self.token_budget, self.max_batch),
+                            int(w1.shape[-2]), int(w1.shape[-1])):
+            return False, "unsupported"
+        return True, None
+
+    def _build_step(self, tok_pad: int, B: int, pallas_mode=False,
+                    ffn_mode=False):
         """Trace+compile the fixed-shape mixed prefill+decode executable
-        for the (token-budget, batch-slots, pallas-mode) signature."""
+        for the (token-budget, batch-slots, pallas-mode, ffn-mode)
+        signature. `ffn_mode` swaps the per-layer SwiGLU for the fused
+        Pallas kernel; combined with `pallas_mode == "decode"` it also
+        swaps the sampling tail for the one-launch sampler prep — the
+        fused decode tick (~2 launches/layer + 1 sampler)."""
         cfg = self.cfg
         top_k = self.top_k
         bs = self.block_size
         quant_kv = self.quant_kv   # static: selects the int8-cache trace
+        fused_tick = bool(ffn_mode) and pallas_mode == "decode"
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def step_fn(params, key_cache, value_cache, kv_scales, tokens,
@@ -400,9 +452,14 @@ class PagedServingEngine:
                     rope_theta=cfg.rope_theta, use_pallas=pallas_mode)
                 x = x + Q.matmul_param(o, lp, "wo")
                 h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-                gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
-                        * Q.matmul_param(h, lp, "w3"))
-                x = x + Q.matmul_param(gate, lp, "w2")
+                if ffn_mode:
+                    # one launch: gate+up matmuls, silu·mul, down matmul —
+                    # the d_ff intermediate never leaves VMEM
+                    x = x + FF.apply_ffn(h, lp)
+                else:
+                    gate = (jax.nn.silu(Q.matmul_param(h, lp, "w1"))
+                            * Q.matmul_param(h, lp, "w3"))
+                    x = x + Q.matmul_param(gate, lp, "w2")
                 return x, (kc, vc)
 
             xs = (params["blocks"], key_cache, value_cache)
@@ -416,18 +473,31 @@ class PagedServingEngine:
             hlast = L.rms_norm(hlast, params["final_norm"], cfg.rms_eps)
             logits = Q.matmul_param(hlast, params, "lm_head"
                                     ).astype(jnp.float32)      # [B, V]
-            nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            nxt_sampled = _sample_rows(logits, keys, temps, top_ps, top_k)
+            if fused_tick and FS.supported(B, logits.shape[-1]):
+                # fused decode tick "+1": argmax + temperature/top-k/top-p
+                # masking in ONE launch; the categorical draw stays outside
+                # on bit-identical masked logits (token parity vs stock)
+                masked, nxt_greedy = FS.fused_sample_prep(
+                    logits, temps, top_ps, top_k)
+                nxt_sampled = jax.vmap(
+                    lambda k_, row: jax.random.categorical(
+                        jax.random.wrap_key_data(k_), row)
+                )(keys, masked).astype(jnp.int32)
+            else:
+                nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt_sampled = _sample_rows(logits, keys, temps, top_ps,
+                                           top_k)
             nxt = jnp.where(greedy, nxt_greedy, nxt_sampled)
             return nxt, kcs, vcs
 
         return step_fn
 
-    def _get_step_fn(self, tok_pad: int, B: int, pallas_mode=False):
-        key = (tok_pad, B, pallas_mode)
+    def _get_step_fn(self, tok_pad: int, B: int, pallas_mode=False,
+                     ffn_mode=False):
+        key = (tok_pad, B, pallas_mode, ffn_mode)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._build_step(tok_pad, B, pallas_mode)
+            fn = self._build_step(tok_pad, B, pallas_mode, ffn_mode)
             self._step_fns[key] = fn
             self.stats["step_builds"] += 1
             _emit("serving.step_build", tok_pad=tok_pad, batch=B)
@@ -505,6 +575,9 @@ class PagedServingEngine:
         pallas_mode, pallas_fb = self._resolve_pallas()
         if pallas_fb is not None:
             _emit("serving.pallas_fallback", reason=pallas_fb)
+        ffn_mode, ffn_fb = self._resolve_ffn()
+        if ffn_fb is not None:
+            _emit("pallas_ffn.fallback", reason=ffn_fb)
         tok_pad, B = self.token_budget, self.max_batch
         if pallas_mode and all(n == 1 for _, n in batch.items):
             # decode fast path: every scheduled chunk is one token, so the
@@ -541,7 +614,10 @@ class PagedServingEngine:
                 keys[i] = _key_bits(sub)
         cu[len(batch.items) + 1:] = pos
 
-        fn = self._get_step_fn(tok_pad, B, pallas_mode)
+        builds0 = self.stats["step_builds"]
+        fn = self._get_step_fn(tok_pad, B, pallas_mode, ffn_mode)
+        fused_tick = bool(ffn_mode) and pallas_mode == "decode"
+        launches0 = FA.trace_launches()
         t0 = time.perf_counter()
         nxt, self._key_cache, self._value_cache = fn(
             self.params, self._key_cache, self._value_cache,
@@ -551,6 +627,15 @@ class PagedServingEngine:
             jnp.asarray(keys), jnp.asarray(greedy))
         nxt = np.asarray(nxt)     # the step's one sync point
         dur = time.perf_counter() - t0
+        if fused_tick and self.stats["step_builds"] > builds0:
+            # fresh trace: the launch-counter delta counts the DISTINCT
+            # Pallas launches traced into this tick's executable (the
+            # layer scan body is traced once, so per-layer kernels count
+            # once — paged attention + fused FFN + the sampler prep).
+            # Steady-state ticks re-run the same executable, so the count
+            # holds for every subsequent tick.
+            self.stats["tick_pallas_launches"] = (FA.trace_launches()
+                                                  - launches0)
         n_prefill = sum(n for s, n in batch.items
                         if s.num_computed + n < len(s.tokens))
         _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
@@ -561,6 +646,12 @@ class PagedServingEngine:
             if kind == "decode":
                 self.stats["decode_fast_steps"] += 1
             _emit("serving.pallas_step", launch=kind)
+        if ffn_mode:
+            self.stats["ffn_steps"] += 1
+            if fused_tick:
+                self.stats["fused_ticks"] += 1
+            _emit("pallas_ffn.step",
+                  launch="fused_tick" if fused_tick else "serving")
         if self.quant_kv:
             _emit("quant.kv_step",
                   tokens=batch.total_tokens * self.cfg.num_layers,

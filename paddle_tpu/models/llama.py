@@ -19,6 +19,7 @@ tokens with all_to_all over the ep axis).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Dict, Optional
 
 import jax
@@ -191,8 +192,32 @@ def moe_mlp(x: jax.Array, lp: Dict[str, jax.Array], cfg: LlamaConfig) -> jax.Arr
     return jnp.einsum("bted,bte->btd", out, comb.astype(x.dtype))
 
 
+def ffn(h: jax.Array, lp: Dict[str, jax.Array], impl: str = "stock") -> jax.Array:
+    """SwiGLU FFN body over normed activations h [..., d].
+
+    impl: 'stock' is the three-matmul XLA path; 'pallas' routes supported
+    shapes through the one-launch fused kernel (ops/pallas/fused_ffn.py)
+    and falls back to stock otherwise, mirroring attention's 'auto'.
+    """
+    if impl == "pallas":
+        try:
+            from ..ops.pallas import fused_ffn as _ff
+
+            rows = math.prod(h.shape[:-1])
+            d, f = lp["w1"].shape
+            if _ff.supported(rows, d, f):
+                return _ff.fused_ffn(h, lp["w1"].astype(h.dtype),
+                                     lp["w3"].astype(h.dtype),
+                                     lp["w2"].astype(h.dtype))
+        except ImportError:
+            pass
+    gate = jax.nn.silu(h @ lp["w1"].astype(h.dtype)) * (h @ lp["w3"].astype(h.dtype))
+    return gate @ lp["w2"].astype(h.dtype)
+
+
 def block(x: jax.Array, lp: Dict[str, jax.Array], cfg: LlamaConfig,
-          cos: jax.Array, sin: jax.Array, attn_impl: str = "auto") -> jax.Array:
+          cos: jax.Array, sin: jax.Array, attn_impl: str = "auto",
+          ffn_impl: str = "stock") -> jax.Array:
     """One transformer block; lp leaves have the layer axis already indexed."""
     B, T, d = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
@@ -208,20 +233,19 @@ def block(x: jax.Array, lp: Dict[str, jax.Array], cfg: LlamaConfig,
     if cfg.num_experts:
         x = x + moe_mlp(h, lp, cfg)
     else:
-        gate = jax.nn.silu(h @ lp["w1"].astype(h.dtype)) * (h @ lp["w3"].astype(h.dtype))
-        x = x + gate @ lp["w2"].astype(h.dtype)
+        x = x + ffn(h, lp, impl=ffn_impl)
     return x
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
-            attn_impl: str = "auto") -> jax.Array:
+            attn_impl: str = "auto", ffn_impl: str = "stock") -> jax.Array:
     """tokens [B, T] int32 → logits [B, T, vocab] (f32)."""
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     T = tokens.shape[1]
     cos, sin = rope_cos_sin(jnp.arange(T), cfg.head_dim, cfg.rope_theta)
 
     def body(carry, lp):
-        return block(carry, lp, cfg, cos, sin, attn_impl), None
+        return block(carry, lp, cfg, cos, sin, attn_impl, ffn_impl), None
 
     x, _ = lax.scan(body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -229,9 +253,10 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
 
 
 def loss_fn(params: Dict[str, Any], tokens: jax.Array, targets: jax.Array,
-            cfg: LlamaConfig, attn_impl: str = "auto") -> jax.Array:
+            cfg: LlamaConfig, attn_impl: str = "auto",
+            ffn_impl: str = "stock") -> jax.Array:
     """Next-token cross entropy, mean over tokens."""
-    logits = forward(params, tokens, cfg, attn_impl)
+    logits = forward(params, tokens, cfg, attn_impl, ffn_impl)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     true = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - true)

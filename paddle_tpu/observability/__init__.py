@@ -237,6 +237,16 @@ _c_srv_pallas_fb = _C("paddle_serving_pallas_fallback_total",
                       "Steps that wanted FLAGS_serving_pallas_attention but "
                       "served stock XLA instead, by reason (unavailable = "
                       "no TPU, unsupported = head/page geometry)")
+_c_ffn = _C("paddle_pallas_ffn_steps_total",
+            "Steps served through the fused Pallas SwiGLU FFN kernel, by "
+            "kind (serving = engine tick with fused FFN, fused_tick = the "
+            "mega-kernelized decode tick: paged attention + fused FFN + "
+            "one-launch sampler prep)")
+_c_ffn_fb = _C("paddle_pallas_ffn_fallback_total",
+               "Steps that wanted FLAGS_pallas_ffn but served the stock "
+               "XLA FFN instead, by reason (unavailable = no TPU, "
+               "unsupported = shape outside the kernel plan, quant = "
+               "activation-quantized leaves the kernel does not cover)")
 _c_elastic = _C("paddle_elastic_events_total",
                 "Elastic-runtime lifecycle events, by kind (start/"
                 "rank_dead/epoch_bump/reconfigure/rejoin/refuse/...)")
@@ -499,6 +509,10 @@ _HANDLERS = {
         labels={"kind": f.get("launch", "mixed")}),
     "serving.pallas_fallback": lambda d, f: _c_srv_pallas_fb.inc(
         labels={"reason": f.get("reason", "")}),
+    "pallas_ffn.step": lambda d, f: _c_ffn.inc(
+        labels={"kind": f.get("launch", "serving")}),
+    "pallas_ffn.fallback": lambda d, f: _c_ffn_fb.inc(
+        labels={"reason": f.get("reason", "")}),
     "serving.token": _h_srv_token,
     "serving.gauges": _h_srv_gauges,
     "router.admit": lambda d, f: _c_rt_admit.inc(
@@ -690,6 +704,13 @@ def summary() -> dict:
             "pallas_fallbacks": int(_c_srv_pallas_fb.value(
                 {"reason": "unavailable"}) + _c_srv_pallas_fb.value(
                 {"reason": "unsupported"})),
+            "ffn_steps": int(_c_ffn.value(
+                {"kind": "serving"}) + _c_ffn.value(
+                {"kind": "fused_tick"})),
+            "fused_ticks": int(_c_ffn.value({"kind": "fused_tick"})),
+            "ffn_fallbacks": int(sum(_c_ffn_fb.value({"reason": r})
+                                     for r in ("unavailable", "unsupported",
+                                               "quant"))),
             "kv_bytes_in_use": int(_g_srv_bytes.value()),
             "kv_bytes_total": int(_g_srv_bytes_total.value()),
         },

@@ -21,9 +21,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...core import flags
 from ..dispatch import register_op
 from .nn_ops import (_conv_nd, _pool, group_norm as _group_norm_op,
                      layer_norm as _layer_norm_op)
+
+
+def _pallas_epilogue_on() -> bool:
+    """Host-side routing decision for the incubate fused-op surface: the
+    Pallas epilogue kernels serve these ops only on real TPU hardware with
+    FLAGS_pallas_ffn set (CPU stays on the stock XLA composition — the
+    kernels' interpret-mode parity is covered by their own tests). Read at
+    op-call time; callers who jit an incubate op bake the decision into
+    that trace."""
+    from ..pallas import fused_ffn as _ff
+
+    return bool(flags.flag_value("pallas_ffn") and _ff.available())
 
 _ACTS = {
     "": lambda x: x, "identity": lambda x: x, "none": lambda x: x,
@@ -68,9 +81,25 @@ def fc(input, w, bias=None, in_num_col_dims=1, activation_type="",
 @register_op
 def gemm_epilogue(x, y, bias=None, trans_x=False, trans_y=False,
                   activation="none"):
-    """Reference gemm_epilogue (cublasLt epilogue): act(x @ y + bias)."""
+    """Reference gemm_epilogue (cublasLt epilogue): act(x @ y + bias).
+
+    On TPU with FLAGS_pallas_ffn, supported untransposed shapes run the
+    one-launch Pallas epilogue kernel (matmul + bias + activation without
+    an HBM round-trip between them); everything else stays on the stock
+    XLA composition."""
     a = jnp.swapaxes(x, -1, -2) if trans_x else x
     b = jnp.swapaxes(y, -1, -2) if trans_y else y
+    if (not trans_x and not trans_y and a.ndim >= 2 and b.ndim == 2
+            and (bias is None or jnp.ndim(bias) == 1)
+            and _pallas_epilogue_on()):
+        from ..pallas import fused_ffn as _ff
+
+        m = math.prod(a.shape[:-1])
+        k, n = b.shape
+        if a.shape[-1] == k and _ff.epilogue_supported(m, k, n, activation):
+            out = _ff.fused_gemm_epilogue(
+                a.reshape(m, k), b, bias, activation=activation)
+            return out.reshape(a.shape[:-1] + (n,))
     out = jnp.matmul(a, b)
     if bias is not None:
         out = out + bias
@@ -99,14 +128,26 @@ def fused_linear_param_grad_add(x, dout, dweight=None, dbias=None,
 @register_op
 def fused_bias_act(x, bias=None, act_method="gelu"):
     """Reference fused_bias_act_kernel: bias add + activation, with the
-    gated variants (geglu/swiglu) splitting the last dim in half."""
+    gated variants (geglu/swiglu) splitting the last dim in half.
+
+    On TPU with FLAGS_pallas_ffn, the gated variants run the one-launch
+    Pallas GLU kernel on supported shapes (stock XLA otherwise)."""
     if bias is not None:
         x = x + bias
     m = (act_method or "").lower()
     if m in ("geglu", "swiglu"):
-        gate_fn = jax.nn.gelu if m == "geglu" else jax.nn.silu
+        act = "gelu" if m == "geglu" else "silu"
         u, v = jnp.split(x, 2, axis=-1)
-        return gate_fn(u) * v
+        if _pallas_epilogue_on():
+            from ..pallas import fused_ffn as _ff
+
+            rows = math.prod(u.shape[:-1])
+            if _ff.glu_supported(rows, u.shape[-1], act):
+                f = u.shape[-1]
+                out = _ff.fused_glu(u.reshape(rows, f), v.reshape(rows, f),
+                                    act)
+                return out.reshape(u.shape)
+        return _act(act)(u) * v
     return _act(m)(x)
 
 

@@ -7,4 +7,6 @@ context parallelism), MoE dispatch, fused rotary/rmsnorm. Everything else
 stays on the XLA emission path.
 """
 from . import flash_attention  # noqa: F401
+from . import fused_ffn  # noqa: F401
+from . import fused_sample  # noqa: F401
 from . import paged_attention  # noqa: F401

@@ -92,6 +92,24 @@ def available() -> bool:
         return False
 
 
+# Trace-time launch accounting, shared by every kernel wrapper in this
+# package: each wrapper bumps the counter once per pl.pallas_call it emits.
+# The wrappers only run while an executable is being TRACED, so the delta
+# across a fresh jit trace equals the number of Pallas launches that
+# executable performs per call — which is how the serving engine pins its
+# per-tick launch budget (serving_smoke asserts fused decode <= 3*layers+1).
+_TRACE_LAUNCHES = [0]
+
+
+def count_launch(n: int = 1) -> None:
+    _TRACE_LAUNCHES[0] += n
+
+
+def trace_launches() -> int:
+    """Monotonic count of Pallas launches traced so far in this process."""
+    return _TRACE_LAUNCHES[0]
+
+
 # Tunable caps, measured on a v5e-class chip (B=16 T=2048 H=12 hd=128,
 # fwd+bwd, interleaved steady-state): 512 -> 22.6ms, 1024 -> 24.7ms,
 # 256 -> 30.5ms. 512 amortizes the MXU well while p = exp(s) (512x512 f32,
@@ -242,6 +260,7 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, interpret: bool,
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "fwd input")
     for spec, sds in zip(out_specs, out_shape):
         _assert_mosaic_tileable(spec.block_shape, sds.shape, "fwd output")
+    count_launch()
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -391,6 +410,7 @@ def _bwd(sm_scale, causal, interpret, res, do):
     for spec, arr in zip(dq_in_specs, dq_inputs):
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "dq input")
     _assert_mosaic_tileable(dq_out_spec.block_shape, q.shape, "dq output")
+    count_launch()
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=np.float32(sm_scale), causal=causal,
                           block_q=bq, block_k=bk, has_seg=has_seg),
@@ -435,6 +455,7 @@ def _bwd(sm_scale, causal, interpret, res, do):
         _assert_mosaic_tileable(spec.block_shape, arr.shape, "dkv input")
     for spec in dkv_out_specs:
         _assert_mosaic_tileable(spec.block_shape, k.shape, "dkv output")
+    count_launch()
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=np.float32(sm_scale), causal=causal,
                           block_q=bq, block_k=bk, group=G, has_seg=has_seg),

@@ -1,0 +1,124 @@
+"""Fused sampling-prep kernel for the serving decode tick (Pallas).
+
+The stock tail of the engine's step executable runs temperature scaling,
+top-k thresholding, the top-p sort/softmax/cumsum cascade and the greedy
+argmax as ~8 separate XLA ops over the `[B, vocab]` logits block. This
+kernel performs ALL of that masking math in ONE launch — the MPK-style
+fused decode tick's "+1 sampler" launch — emitting the masked logits and
+the greedy argmax together.
+
+The math is a line-for-line mirror of the engine's `_sample_rows` (same
+ops, same order, same f32 constants), so in interpret mode the masked
+logits are bit-identical to the stock path's. The final
+`jax.random.categorical` draw stays OUTSIDE the kernel: it is a [B]-sized
+op on bit-identical inputs, which is what keeps fused-tick token parity
+exact against the stock engine (and keeps per-row PRNG key handling on
+the one code path).
+
+Mosaic note: sort/top-k inside a TPU kernel lean on recent Mosaic
+lowering; `supported()` gates the geometry and `available()` gates
+hardware as usual, and CPU CI runs interpret mode where these are plain
+jnp ops.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only builds)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from .flash_attention import (LANES, _assert_mosaic_tileable, available,
+                              count_launch)
+
+__all__ = ["fused_sample_prep", "available", "supported"]
+
+# kernel scalar constants stay concrete np.float32 (x64 weak-float hazard)
+_EPS = np.float32(1e-6)
+_NEG_INF = np.float32(-np.inf)
+_POS_INF = np.float32(np.inf)
+
+
+def supported(batch: int, vocab: int) -> bool:
+    """Static gate: one whole-array block must fit the VMEM working set
+    (the sort cascade keeps ~4 [B, V] f32 intermediates live)."""
+    if pltpu is None:
+        return False
+    return (batch >= 1 and vocab >= 8
+            and 4 * batch * vocab * 6 <= 12 * 1024 * 1024)
+
+
+def _sample_kernel(l_ref, t_ref, p_ref, masked_ref, amax_ref, *,
+                   top_k: int):
+    l = l_ref[...].astype(jnp.float32)                 # [B, V]
+    # greedy argmax on the RAW logits (pre-temperature), as the stock
+    # step computes it
+    amax = jnp.argmax(l, axis=-1).astype(jnp.int32)[:, None]
+    l = l / jnp.maximum(t_ref[...][:, :1], _EPS)
+    if top_k:
+        vals = jax.lax.top_k(l, int(top_k))[0]  # tpu-lint: disable=TPL001
+        l = jnp.where(l < vals[..., -1:], _NEG_INF, l)
+    sl = jnp.sort(l, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sl, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < p_ref[...][:, :1]             # exclusive prefix mass
+    cutoff = jnp.min(jnp.where(keep, sl, _POS_INF), axis=-1, keepdims=True)
+    masked_ref[...] = jnp.where(l < cutoff, _NEG_INF, l)
+    amax_ref[...] = jnp.broadcast_to(amax, amax_ref.shape)
+
+
+def fused_sample_prep(logits, temps, top_ps, top_k: int = 0,
+                      interpret: Optional[bool] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """One-launch sampling prep over f32 logits [B, V].
+
+    temps/top_ps [B] f32; top_k static (0 = off). Returns
+    (masked_logits [B, V] f32 — feed `jax.random.categorical` per row —
+    and greedy argmax [B] int32). Both match the stock `_sample_rows` /
+    argmax math bit-for-bit in interpret mode.
+    """
+    if pltpu is None:
+        raise RuntimeError("pallas TPU backend unavailable; gate calls "
+                           "with fused_sample.supported()")
+    B, V = logits.shape
+    if not supported(B, V):
+        raise ValueError(f"unsupported sampler geometry B={B} V={V}; "
+                         "use the stock sampling path")
+    if interpret is None:
+        interpret = not available()
+    t = jnp.broadcast_to(temps.astype(jnp.float32)[:, None], (B, LANES))
+    p = jnp.broadcast_to(top_ps.astype(jnp.float32)[:, None], (B, LANES))
+    mem = {"memory_space": pltpu.VMEM}
+    in_specs = [
+        pl.BlockSpec((B, V), lambda: (0, 0), **mem),
+        pl.BlockSpec((B, LANES), lambda: (0, 0), **mem),
+        pl.BlockSpec((B, LANES), lambda: (0, 0), **mem),
+    ]
+    out_specs = [
+        pl.BlockSpec((B, V), lambda: (0, 0), **mem),
+        pl.BlockSpec((B, LANES), lambda: (0, 0), **mem),
+    ]
+    inputs = [logits.astype(jnp.float32), t, p]
+    for spec, arr in zip(in_specs, inputs):
+        _assert_mosaic_tileable(spec.block_shape, arr.shape, "sampler input")
+    count_launch()
+    masked, amax = pl.pallas_call(
+        functools.partial(_sample_kernel, top_k=int(top_k)),
+        grid=(),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    return masked, amax[:, 0]

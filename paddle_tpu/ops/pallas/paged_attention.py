@@ -58,7 +58,8 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from .flash_attention import NEG_INF, _assert_mosaic_tileable, _i32, available
+from .flash_attention import (NEG_INF, _assert_mosaic_tileable, _i32,
+                              available, count_launch)
 
 __all__ = ["paged_attention", "available", "supported"]
 
@@ -244,6 +245,7 @@ def paged_attention(q_rows, key_cache, value_cache, block_tables,
     kernel = functools.partial(
         _kernel, sm_scale=np.float32(sm_scale), block_size=int(bs),
         group=int(group), has_quant=has_quant)
+    count_launch()
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

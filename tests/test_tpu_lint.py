@@ -259,6 +259,37 @@ def test_pallas_kernels_are_walked(tmp_path):
     assert "clock:time.perf_counter" in tags, findings   # direct kernel name
 
 
+def test_custom_vjp_closures_are_walked(tmp_path):
+    # fwd/bwd handed to prim.defvjp(...) are traced entries for TPL001 even
+    # when neither is jitted or passed to pallas_call directly — the vjp
+    # closures run under whichever trace differentiates the primitive
+    src = {
+        "cv.py": """
+        import functools
+        import time
+
+        import jax
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+        def f(x, flag):
+            return x * 2.0
+
+        def _fwd(x, flag):
+            return x * 2.0, (x * time.time(),)
+
+        def _bwd(flag, res, g):
+            return (g * time.perf_counter(),)
+
+        f.defvjp(_fwd, _bwd)
+        """,
+    }
+    findings = [f for f in _run(_write_fixture_repo(tmp_path, src))
+                if f.rule == "TPL001"]
+    tags = {f.tag for f in findings}
+    assert "clock:time.time" in tags, findings           # fwd closure
+    assert "clock:time.perf_counter" in tags, findings   # bwd closure
+
+
 def test_baseline_round_trip(fixture_repo, tmp_path):
     baseline_path = tmp_path / "baseline.json"
     findings = _run(fixture_repo)

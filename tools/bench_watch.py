@@ -79,6 +79,15 @@ def capture() -> int:
             flagship["vs_baseline"] = round(flagship["value"] / pin, 4)
             if flagship["vs_baseline"] < 1.0:
                 flagship["red_signal"] = True
+        # MFU red-line: pallas-ffn MFU below its pinned same-platform
+        # floor REDs even when raw tokens/s clears the throughput pin
+        pin_mfu = (base.get("configs") or {}).get("llama_train_mfu_floor")
+        mfu = (flagship.get("details") or {}).get("mfu")
+        if (base.get("platform") == d.platform and pin_mfu and mfu
+                and (flagship.get("details") or {}).get("ffn") == "pallas"
+                and mfu < pin_mfu):
+            flagship["red_signal"] = True
+            flagship["mfu_red"] = True
     except (OSError, ValueError):
         pass
     t0 = time.perf_counter()
@@ -588,7 +597,12 @@ def _capture_locked(capture_timeout: float) -> bool:
     v = payload["flagship"].get("value")
     log(f"captured TPU flagship: {v} tokens/s/chip "
         f"on {payload['device'].get('device_kind')}")
-    if payload["flagship"].get("red_signal"):
+    if payload["flagship"].get("mfu_red"):
+        det = payload["flagship"].get("details") or {}
+        log(f"RED: pallas-ffn MFU {det.get('mfu')} below the pinned "
+            f"same-platform floor (llama_train_mfu_floor in "
+            f"BENCH_BASELINE.json)")
+    elif payload["flagship"].get("red_signal"):
         log(f"RED: flagship vs_baseline="
             f"{payload['flagship'].get('vs_baseline')} < 1.0 — perf "
             f"regression against the pinned floor (BENCH_BASELINE.json)")

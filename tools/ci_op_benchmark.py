@@ -234,6 +234,83 @@ def _basket():
                                           use_pallas="decode"),
     }
 
+    # fused SwiGLU FFN vs the stock three-matmul chain: fwd, bwd (through
+    # the custom_vjp — two Pallas launches), and the weight-only int8
+    # dequant variant. Pallas entries run interpret mode on CPU (same
+    # per-platform-pin policy as the block_mha entries: the CPU pin gates
+    # interpret overhead, a TPU pin gates the real kernel). Decode-ish
+    # tile: 128 rows, d=128, d_ff=256.
+    from paddle_tpu.ops.pallas import fused_ffn as FF
+
+    fx = jnp.asarray(RS.randn(128, 128).astype(np.float32))
+    fw1 = jnp.asarray(RS.randn(128, 256).astype(np.float32))
+    fw3 = jnp.asarray(RS.randn(128, 256).astype(np.float32))
+    fw2 = jnp.asarray(RS.randn(256, 128).astype(np.float32))
+
+    def _stock_ffn(x, w1, w3, w2):
+        return (jax.nn.silu(x @ w1) * (x @ w3)) @ w2
+
+    def _absmax_q8(w):
+        s = jnp.max(jnp.abs(w), axis=0, keepdims=True)
+        return jnp.round(w / s * 127.0).astype(jnp.int8), s
+
+    fw1_q, fw1_s = _absmax_q8(fw1)
+    fw3_q, fw3_s = _absmax_q8(fw3)
+    fw2_q, fw2_s = _absmax_q8(fw2)
+
+    def _stock_ffn_w8(x):
+        # the stock w8 path: int8 matmul in f32, per-out-channel scale
+        # applied post-matmul (matmul_param dequant order)
+        u = (x @ fw1_q.astype(jnp.float32)) * (fw1_s / 127.0)
+        v = (x @ fw3_q.astype(jnp.float32)) * (fw3_s / 127.0)
+        return ((jax.nn.silu(u) * v)
+                @ fw2_q.astype(jnp.float32)) * (fw2_s / 127.0)
+
+    _stock_bwd = jax.grad(lambda args: jnp.sum(_stock_ffn(*args)))
+    _pallas_bwd = jax.grad(lambda args: jnp.sum(FF.fused_ffn(*args)))
+    ffn_entries = {
+        "ffn_fwd_stock": lambda: _stock_ffn(fx, fw1, fw3, fw2),
+        "ffn_fwd_pallas": lambda: FF.fused_ffn(fx, fw1, fw3, fw2),
+        "ffn_bwd_stock": lambda: _stock_bwd((fx, fw1, fw3, fw2)),
+        "ffn_bwd_pallas": lambda: _pallas_bwd((fx, fw1, fw3, fw2)),
+        "ffn_int8_stock": lambda: _stock_ffn_w8(fx),
+        "ffn_int8_pallas": lambda: FF.fused_ffn_w8(
+            fx, fw1_q, fw1_s, fw3_q, fw3_s, fw2_q, fw2_s),
+    }
+
+    # whole decode tick through the paged serving engine, stock
+    # vs the fused tick (paged-attention + fused FFN + fused sampler
+    # prep). Eager entries: eng.step() is host orchestration around one
+    # cached executable — the number being gated is the end-to-end tick,
+    # exactly what serving latency is made of. Engines are pre-warmed
+    # (prefill + first decode tick compile outside the clock) and seeded
+    # with enough queued generation to cover warmup + reps ticks.
+    def _tick_engine(params_cfg, pallas=None, pallas_ffn=None):
+        from paddle_tpu.inference.serving import PagedServingEngine
+
+        cfg, params = params_cfg
+        eng = PagedServingEngine(cfg, params, num_blocks=64, block_size=8,
+                                 max_batch=4, token_budget=64,
+                                 max_len=cfg.max_seq_len, pallas=pallas,
+                                 pallas_ffn=pallas_ffn)
+        rs = np.random.RandomState(5)
+        for _ in range(4):
+            eng.submit(rs.randint(1, cfg.vocab_size, 16).tolist(),
+                       max_new_tokens=72)
+        eng.step()   # prefill executable
+        eng.step()   # decode executable — steady state from here
+        return eng
+
+    from paddle_tpu.models import llama as _L
+
+    _tick_cfg = _L.LlamaConfig(vocab_size=97, hidden_size=32,
+                               intermediate_size=64, num_layers=2,
+                               num_heads=4, num_kv_heads=2, max_seq_len=96,
+                               dtype=np.float32)
+    _tick_pc = (_tick_cfg, _L.init_params(_tick_cfg, jax.random.PRNGKey(0)))
+    tick_stock = _tick_engine(_tick_pc)
+    tick_fused = _tick_engine(_tick_pc, pallas=True, pallas_ffn=True)
+
     # eager entries run the PUBLIC api (dispatch + tape), not raw kernels;
     # they are marked so measure() skips jitting them
     eager = {
@@ -247,6 +324,8 @@ def _basket():
         "dp_q8_pack_cached": lambda: q8_bucket.qpack(pack_arrs, q8_res)[0],
         "dp_q8_pack_uncached": _q8_pack_uncached,
         "dp_q8_decode_cached": lambda: q8_bucket.qdecode(q8_gathered),
+        "decode_tick_stock": tick_stock.step,
+        "decode_tick_fused": tick_fused.step,
     }
     jitted = {
         "matmul_256": lambda: K["matmul"](a, b),
@@ -261,6 +340,7 @@ def _basket():
         "reduce_sum": lambda: K["sum"](img),
         "topk": lambda: K["topk"](a, 8),
         **blk_entries,
+        **ffn_entries,
     }
     return eager, jitted
 

@@ -145,6 +145,27 @@ def run() -> dict:
     p_builds_timed = p_eng.stats["step_builds"] - p_builds_warm
     pallas_ratio = pallas_tps / best_tps if best_tps else None
 
+    # fused decode tick over quantized weights: the int8-dequant fused FFN
+    # kernel (w8 leaves consumed in-register) plus the fused sampler prep,
+    # stacked on the pallas paged-attention leg above. Token parity vs the
+    # stock quant engine gates bit-exactly; zero retraces in the timed
+    # passes; the per-tick traced-launch count stays within 3·layers + 1.
+    f_eng = _engine(cfg, params, manifest, num_blocks=160,
+                    quant_mode="w8", quant_kv=True, pallas=True,
+                    pallas_ffn=True)
+    f_out = _run_trace(f_eng, prompts)        # warm
+    f_builds_warm = f_eng.stats["step_builds"]
+    fused_tps = 0.0
+    for _ in range(TIMED_REPEATS):
+        t0 = time.perf_counter()
+        f_out = _run_trace(f_eng, prompts)
+        wall = time.perf_counter() - t0
+        fused_tps = max(fused_tps, N_REQS * NEW_TOKENS / wall)
+    f_builds_timed = f_eng.stats["step_builds"] - f_builds_warm
+    fused_ratio = fused_tps / best_tps if best_tps else None
+    launch_budget = 3 * cfg.num_layers + 1
+    tick_launches = f_eng.stats["tick_pallas_launches"]
+
     # forced preemption on a starved pool must reproduce bit-for-bit
     tight = _engine(cfg, params, manifest, num_blocks=14,
                     quant_mode="w8", quant_kv=True)
@@ -166,6 +187,13 @@ def run() -> dict:
         "pallas_zero_retraces": p_builds_timed == 0,
         "pallas_not_slower_when_enabled": bool(
             not PA.available() or (pallas_ratio or 0.0) >= 1.0),
+        "fused_parity": f_out == q_out,
+        "fused_zero_retraces": f_builds_timed == 0,
+        "fused_ticks_ran": f_eng.stats["fused_ticks"] > 0,
+        "fused_tick_launch_budget": bool(
+            0 < tick_launches <= launch_budget),
+        "fused_not_slower_when_enabled": bool(
+            not PA.available() or (fused_ratio or 0.0) >= 1.0),
     }
     return {
         "ok": all(checks.values()),
@@ -188,6 +216,13 @@ def run() -> dict:
         "pallas_available": PA.available(),
         "pallas_steps": p_eng.stats["pallas_steps"],
         "pallas_decode_fast_steps": p_eng.stats["decode_fast_steps"],
+        "fused_tokens_per_s": round(fused_tps, 1),
+        "fused_throughput_ratio": round(fused_ratio, 3)
+        if fused_ratio is not None else None,
+        "fused_ticks": f_eng.stats["fused_ticks"],
+        "ffn_steps": f_eng.stats["ffn_steps"],
+        "tick_pallas_launches": tick_launches,
+        "tick_launch_budget": launch_budget,
     }
 
 

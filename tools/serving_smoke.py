@@ -49,8 +49,18 @@ def _trace(vocab: int, seed: int = 0):
             for _ in range(N_REQS)]
 
 
-def _submit_all(eng, prompts):
-    return [eng.submit(p, max_new_tokens=NEW_TOKENS) for p in prompts]
+def _submit_all(eng, prompts, sampled=False):
+    # sampled=True mixes greedy and sampled rows in one batch (odd
+    # requests sample with fixed per-request temperature/top_p/seed), so
+    # parity legs exercise BOTH tails of the step executable
+    rids = []
+    for i, p in enumerate(prompts):
+        kw = {"max_new_tokens": NEW_TOKENS}
+        if sampled and i % 2:
+            kw.update(temperature=0.7 + 0.02 * i, top_p=0.85,
+                      seed=1000 + i)
+        rids.append(eng.submit(p, **kw))
+    return rids
 
 
 def _drain(eng, rids):
@@ -77,7 +87,8 @@ def _run_dense(cfg, params, prompts):
     return outputs, best_tps, ttft_ms
 
 
-def _run_paged(cfg, params, prompts, pallas=None):
+def _run_paged(cfg, params, prompts, pallas=None, pallas_ffn=None,
+               sampled=False):
     from paddle_tpu.inference.serving import PagedServingEngine
 
     # paged memory is why the batch can be wider than the dense engine's
@@ -85,14 +96,15 @@ def _run_paged(cfg, params, prompts, pallas=None):
     # is stored once — the whole trace decodes in one wave
     eng = PagedServingEngine(cfg, params, num_blocks=224, block_size=8,
                              max_batch=N_REQS, token_budget=32,
-                             max_len=cfg.max_seq_len, pallas=pallas)
-    _drain(eng, _submit_all(eng, prompts))            # warm + seed prefix cache
+                             max_len=cfg.max_seq_len, pallas=pallas,
+                             pallas_ffn=pallas_ffn)
+    _drain(eng, _submit_all(eng, prompts, sampled))   # warm + seed prefix cache
     builds_warm = eng.stats["step_builds"]
     hits0 = eng.blocks.stats["prefix_hit_tokens"]
     best_tps, ttft_ms, outputs = 0.0, None, None
     for _ in range(TIMED_REPEATS):
         t0 = time.perf_counter()
-        rids = _submit_all(eng, prompts)
+        rids = _submit_all(eng, prompts, sampled)
         ttft = None
         while ttft is None and eng.has_work():
             if any(e.token >= 0 for e in eng.step()):
@@ -135,6 +147,24 @@ def run() -> dict:
      pallas_stats) = _run_paged(cfg, params, prompts, pallas=True)
     pallas_ratio = pallas_tps / paged_tps if paged_tps else None
 
+    # fused decode tick: paged attention + fused FFN + one-launch sampler
+    # prep. Greedy leg gates bit-exact token parity vs the stock paged
+    # engine; the sampled legs re-run the trace with mixed greedy/sampled
+    # rows (fixed per-request seeds) on BOTH engines and gate bit-exact
+    # parity there too — the fused sampler's masking math must match
+    # `_sample_rows` to the bit. Launch budget: the fused-tick executable's
+    # distinct traced Pallas launches must stay within 3·layers + 1.
+    (fused_out, fused_tps, _, fused_builds_timed, _,
+     fused_stats) = _run_paged(cfg, params, prompts, pallas=True,
+                               pallas_ffn=True)
+    fused_ratio = fused_tps / paged_tps if paged_tps else None
+    launch_budget = 3 * cfg.num_layers + 1
+    tick_launches = fused_stats["tick_pallas_launches"]
+    (sampled_stock, *_rest) = _run_paged(cfg, params, prompts, sampled=True)
+    (sampled_fused, _, _, sampled_builds_timed, _,
+     _) = _run_paged(cfg, params, prompts, pallas=True, pallas_ffn=True,
+                     sampled=True)
+
     serving = obs.summary().get("serving", {})
     checks = {
         "parity": paged_out == dense_out,
@@ -145,6 +175,15 @@ def run() -> dict:
         "pallas_zero_retraces": pallas_builds_timed == 0,
         "pallas_not_slower_when_enabled": bool(
             not PA.available() or (pallas_ratio or 0.0) >= 1.0),
+        "fused_parity": fused_out == paged_out,
+        "fused_sampled_parity": sampled_fused == sampled_stock,
+        "fused_zero_retraces": (fused_builds_timed == 0
+                                and sampled_builds_timed == 0),
+        "fused_ticks_ran": fused_stats["fused_ticks"] > 0,
+        "fused_tick_launch_budget": bool(
+            0 < tick_launches <= launch_budget),
+        "fused_not_slower_when_enabled": bool(
+            not PA.available() or (fused_ratio or 0.0) >= 1.0),
     }
     return {
         "ok": all(checks.values()),
@@ -168,6 +207,13 @@ def run() -> dict:
         "pallas_available": PA.available(),
         "pallas_steps": pallas_stats["pallas_steps"],
         "pallas_decode_fast_steps": pallas_stats["decode_fast_steps"],
+        "fused_tokens_per_s": round(fused_tps, 1),
+        "fused_throughput_ratio": round(fused_ratio, 3)
+        if fused_ratio is not None else None,
+        "fused_ticks": fused_stats["fused_ticks"],
+        "ffn_steps": fused_stats["ffn_steps"],
+        "tick_pallas_launches": tick_launches,
+        "tick_launch_budget": launch_budget,
         "ttft_p50_s": serving.get("ttft_p50_s"),
         "tpot_p50_s": serving.get("tpot_p50_s"),
     }
