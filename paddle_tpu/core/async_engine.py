@@ -193,7 +193,7 @@ def scalar_fetch(arr, tag: str = "tensor"):
     return arr
 
 
-def p2p_transfer(arr, put, tag: str = "p2p"):
+def p2p_transfer(arr, put, tag: str = "p2p", trace=None):
     """Issue an async device-to-device copy (pipeline stage handoff).
 
     ``put`` maps the source buffer onto the destination placement —
@@ -202,12 +202,22 @@ def p2p_transfer(arr, put, tag: str = "p2p"):
     microbatch i+1) overlaps this transfer of microbatch i. The consumer
     only blocks when it dereferences the returned in-flight buffer. Every
     handoff lands in ``paddle_eager_p2p_transfers_total`` with its issue
-    latency, so transfer pressure is attributable per tag."""
+    latency, so transfer pressure is attributable per tag.
+
+    ``trace``: optional ``(trace_id, parent_span_id)`` context from the
+    caller (the pipeline runtime's batch span): the issue interval is
+    additionally recorded as a ``pp.p2p`` span, so per-hop latency shows
+    up inside the merged chrome trace next to the stage spans."""
     t0 = time.perf_counter()
     out = put(arr)
-    _emit("async.p2p", dur_s=time.perf_counter() - t0, tag=tag,
+    dur = time.perf_counter() - t0
+    _emit("async.p2p", dur_s=dur, tag=tag,
           nbytes=int(getattr(arr, "nbytes", 0) or 0),
           in_flight=len(_queue))
+    if trace is not None:
+        from ..observability import tracing as _tr
+        _tr.record_span("pp.p2p", trace[0], trace[1], int(t0 * 1e9), dur,
+                        tag=tag)
     return out
 
 

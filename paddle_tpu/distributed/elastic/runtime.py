@@ -129,6 +129,7 @@ class ElasticRuntime:
         self._started = False
         self._prev_hooks = {}
         self._pending_grow = False
+        self._fleet_pub = None   # lazy FleetPublisher (store mode only)
         self.reconfigurations = 0
         self.rejoins = 0
 
@@ -352,12 +353,35 @@ class ElasticRuntime:
             _emit("elastic.event", event="rejoin", rank=rank)
             return True
 
+    def _maybe_publish_fleet(self):
+        """Push this rank's metrics snapshot to the store on the fleet
+        cadence (FLAGS_fleet_metrics_interval), riding the same step
+        boundary as the heartbeat — any rank (or an external aggregator)
+        can then merge the snapshots into ``fleet_summary()``. Local
+        membership has no store: nothing to publish to."""
+        mgr = getattr(self.membership, "_mgr", None)
+        if mgr is None:
+            return
+        if self._fleet_pub is None:
+            from ...observability import fleet as _fleet
+
+            rank = mgr._slot if mgr._slot is not None else 0
+            self._fleet_pub = _fleet.FleetPublisher(
+                mgr.store, rank, role="trainer")
+        try:
+            self._fleet_pub.maybe_publish()
+        except Exception as e:  # noqa: BLE001 — metrics export must never
+            # take down a training step; the watchdog owns store outages
+            _emit("elastic.event", event="fleet_publish_error",
+                  error=f"{type(e).__name__}: {e}")
+
     def note_step(self, step: int):
         """Step-boundary hook (wired to the checkpoint manager): apply a
         deferred grow — rejoining ranks are only admitted here, never
         mid-step."""
         with self._lock:
             self.membership.beat()
+            self._maybe_publish_fleet()
             if not self._pending_grow:
                 return
             live = self.membership.live()

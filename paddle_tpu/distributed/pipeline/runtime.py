@@ -55,6 +55,7 @@ from ...core import async_engine, flags, rng
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from ...observability import emit as _emit
+from ...observability import tracing as _tr
 from .. import comm_watchdog as _cw
 from ..comm_watchdog import comm_task
 from .. import quant_comm as _qc
@@ -323,6 +324,14 @@ class PipelineEngine:
         # watchdog's distress-dump pipeline snapshot
         self._outstanding: Dict[Tuple[str, int, int], str] = {}
         self.last_dispatch_order: List[Tuple[int, str, int]] = []
+        # measured action timeline of the last run — (stage, phase,
+        # microbatch, start offset s, dur s) per dispatched action — and
+        # its diff against the simulate() prediction
+        self.last_timeline: List[Tuple[int, str, int, float, float]] = []
+        self.last_conformance: dict = {}
+        # span context of the current batch (host-side ints only; never
+        # enters a stage executable or its signature)
+        self._trace = None
 
     # ------------------------------------------------------------------
     def _split_micro(self, arr) -> List:
@@ -347,15 +356,18 @@ class PipelineEngine:
         dst = self.stages[dest_stage]
         ref_nb = int(getattr(arr, "nbytes", 0) or 0)
         t0 = time.perf_counter()
+        trace = ((self._trace.trace_id, self._trace.span_id)
+                 if self._trace is not None else None)
         wire, decode, wdt = _qc.p2p_encode(arr)
         if decode is not None:
             out = _Wire(async_engine.p2p_transfer(
                 wire, lambda a: jax.device_put(a, dst.repl),
-                tag=f"pp:{kind}:{dest_stage}"), decode)
+                tag=f"pp:{kind}:{dest_stage}", trace=trace), decode)
             nb = int(getattr(wire, "nbytes", 0) or 0)
         else:
             out = async_engine.p2p_transfer(
-                arr, dst.put_input, tag=f"pp:{kind}:{dest_stage}")
+                arr, dst.put_input, tag=f"pp:{kind}:{dest_stage}",
+                trace=trace)
             nb = ref_nb
         _emit("pp.wire", bytes=nb, ref_bytes=ref_nb,
               dtype=wdt or str(getattr(arr, "dtype", "")), payload=kind)
@@ -406,6 +418,9 @@ class PipelineEngine:
             return self._run_batch(inputs, labels, train, loss_scale, dp)
         finally:
             _cw.set_pipeline_fn(prev_snap)
+            # idempotent: closes the batch root span on abnormal exit
+            # (epoch change / chaos kill) so it can't leak as in-flight
+            _tr.end_span(self._trace)
 
     def _run_batch(self, inputs, labels, train, loss_scale, dp):
         P_, M = self.P, self.M
@@ -436,6 +451,10 @@ class PipelineEngine:
         stage_host = [0.0] * P_
         stalled = set()
         self.last_dispatch_order: List[Tuple[int, str, int]] = []
+        timeline: List[Tuple[int, str, int, float, float]] = []
+        self._trace = _tr.new_trace("pipeline.batch", epoch=self._run_epoch,
+                                    schedule=self.schedule_name, stages=P_,
+                                    microbatches=M)
 
         def deps_met(s, kind, m):
             if kind == "F":
@@ -560,7 +579,14 @@ class PipelineEngine:
                         RUN[kind](s, m)
             elif kind == "F" or train:
                 RUN[kind](s, m)
-            stage_host[s] += time.perf_counter() - t0
+            dur = time.perf_counter() - t0
+            stage_host[s] += dur
+            timeline.append((s, kind, m, t0 - run_t0, dur))
+            if self._trace is not None:
+                _tr.record_span(f"pp.{kind}", self._trace.trace_id,
+                                self._trace.span_id, int(t0 * 1e9), dur,
+                                stage=s, microbatch=m,
+                                epoch=self._run_epoch)
             done.add((kind, s, m))
             self.last_dispatch_order.append((s, kind, m))
 
@@ -611,9 +637,38 @@ class PipelineEngine:
         mean_host = sum(stage_host) / max(1, len(stage_host))
         skew = ((max(stage_host) - mean_host) / mean_host
                 if mean_host > 0 else 0.0)
+        # schedule conformance: what the dispatcher actually did vs what
+        # simulate() predicted. Host-serial dispatch means the measured
+        # bubble includes host occupancy the unit-cost sim doesn't model;
+        # the gap and the per-group straggler split are the diagnostics.
+        self.last_timeline = timeline
+        measured = _tr.measured_schedule_stats(timeline, P_,
+                                               groups=self.P_phys)
+        self.last_conformance = {
+            "schedule": self.schedule_name,
+            "predicted_bubble_fraction": round(
+                self.schedule_stats["bubble_fraction"], 6),
+            "measured_bubble_fraction": measured["bubble_fraction"],
+            "bubble_gap": round(measured["bubble_fraction"]
+                                - self.schedule_stats["bubble_fraction"], 6),
+            "predicted_makespan_units": self.schedule_stats["makespan"],
+            "measured_makespan_s": measured["makespan_s"],
+            "per_group_busy_s": measured["busy_s"],
+            "straggler_group": measured["straggler_group"],
+            "straggler_excess": measured["straggler_excess"],
+            "order_dependency_valid": pschedule.order_is_dependency_valid(
+                self.last_dispatch_order, P_),
+            "actions": measured["actions"],
+        }
         _emit("pipeline.gauges",
               bubble_fraction=self.schedule_stats["bubble_fraction"],
-              stage_skew=skew, makespan=self.schedule_stats["makespan"])
+              stage_skew=skew, makespan=self.schedule_stats["makespan"],
+              measured_bubble_fraction=measured["bubble_fraction"],
+              bubble_gap=self.last_conformance["bubble_gap"],
+              straggler_group=measured["straggler_group"],
+              straggler_excess=measured["straggler_excess"])
+        _tr.end_span(self._trace, actions=len(timeline),
+                     measured_bubble=measured["bubble_fraction"])
         _emit("pipeline.run", dur_s=time.perf_counter() - run_t0,
               schedule=self.schedule_name, stages=P_, microbatches=M)
         total = losses[0]
@@ -674,4 +729,5 @@ class PipelineEngine:
                 str(s): {"microbatch": m, "phase": k}
                 for s, (m, k) in sorted(last.items())},
             "outstanding_p2p": sorted(self._outstanding.values()),
+            "conformance": dict(self.last_conformance),
         }

@@ -246,7 +246,7 @@ def _dep_ready(done, finish, key, t) -> bool:
 
 
 def simulate(actions: Dict[int, List[Action]], P_: int,
-             groups: int = 0) -> dict:
+             groups: int = 0, return_finish: bool = False) -> dict:
     """Dependency-timed unit-cost execution of the action lists.
 
     Each action costs one time unit; an action starts when its producer
@@ -295,5 +295,30 @@ def simulate(actions: Dict[int, List[Action]], P_: int,
         raise ScheduleError("simulation did not drain (deadlocked lists)")
     total_busy = sum(busy)
     bubble = 1.0 - total_busy / (G * makespan) if makespan else 0.0
-    return {"makespan": makespan, "busy": busy,
-            "bubble_fraction": bubble, "groups": G}
+    out = {"makespan": makespan, "busy": busy,
+           "bubble_fraction": bubble, "groups": G}
+    if return_finish:
+        # predicted per-action completion slots, for conformance diffing
+        # against a measured runtime timeline
+        out["finish"] = dict(finish)
+    return out
+
+
+def order_is_dependency_valid(order, P_: int) -> bool:
+    """True iff an observed execution order — [(stage, phase, microbatch)]
+    as the runtime's dispatcher actually ran them, serially — is a
+    linearization the dependency DAG allows: every action's producers
+    appear strictly earlier.  The conformance report uses this to tell
+    "schedule ran slower than predicted" apart from "schedule did not
+    run as written"."""
+    done = set()
+    for s, phase, m in order:
+        for key in _dep_keys(Action(s, m, phase), P_):
+            dp, ds, dm = key
+            if dp == "B*":
+                if ("B", ds, dm) not in done and ("BX", ds, dm) not in done:
+                    return False
+            elif key not in done:
+                return False
+        done.add((phase, s, m))
+    return True

@@ -46,6 +46,7 @@ from jax import lax
 from ...core import flags
 from ...models import llama as L
 from ...observability import emit as _emit
+from ...observability import tracing as _tracing
 from ...ops.kernels.serving_attention import block_multihead_attention_
 from ...ops.pallas import flash_attention as FA
 from ...ops.pallas import fused_ffn as FF
@@ -267,6 +268,9 @@ class PagedServingEngine:
         # retraces cleanly instead of serving a stale trace
         self._step_fns: Dict[Tuple[int, int, Any], Any] = {}
         self._copy_fn = None
+        # set by ReplicaHandle so this engine's tick spans say which
+        # replica served them (the merged-trace failover story)
+        self._trace_replica: Optional[int] = None
 
     # -- client API -------------------------------------------------------
     def submit(self, tokens, max_new_tokens: int = 32,
@@ -274,9 +278,14 @@ class PagedServingEngine:
                deadline_s: Optional[float] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
-               seed: int = 0) -> int:
+               seed: int = 0, trace: Optional[Tuple[int, int]] = None) -> int:
         """Enqueue a request. Raises ValueError when it cannot ever fit,
-        RejectedError (load shed) when the wait queue is full."""
+        RejectedError (load shed) when the wait queue is full.
+
+        ``trace``: optional ``(trace_id, parent_span_id)`` context (the
+        router's per-request trace) — rides the Sequence as two host
+        ints so every queue-wait/prefill/decode span of this request
+        lands in the same trace tree; never touches the jitted step."""
         tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
         total = len(tokens) + max(int(max_new_tokens), 0)
         if total > self.max_len:
@@ -310,6 +319,8 @@ class PagedServingEngine:
             temperature=float(temperature) if sample else 0.0,
             top_p=float(top_p) if top_p is not None else 1.0,
             seed=int(seed))
+        if trace is not None:
+            seq.trace_id, seq.parent_span = int(trace[0]), int(trace[1])
         seq._key = jax.random.PRNGKey(int(seed)) if sample else None
         self.scheduler.add_request(seq)   # raises RejectedError on overflow
         self._update_gauges()
@@ -570,7 +581,16 @@ class PagedServingEngine:
 
         pairs = self.blocks.take_copies()
         if pairs:
+            t0c = time.perf_counter()
             self._copy_blocks(pairs)
+            # attribute the COW interval to the first traced request in
+            # the batch (its page appends are what forced the copies)
+            tseq = next((s for s, _ in batch.items if s.trace_id), None)
+            if tseq is not None:
+                _tracing.record_span(
+                    "cow.copy", tseq.trace_id, tseq.parent_span,
+                    int(t0c * 1e9), time.perf_counter() - t0c,
+                    copies=len(pairs), replica=self._trace_replica)
 
         pallas_mode, pallas_fb = self._resolve_pallas()
         if pallas_fb is not None:
@@ -614,6 +634,10 @@ class PagedServingEngine:
                 keys[i] = _key_bits(sub)
         cu[len(batch.items) + 1:] = pos
 
+        # tick classification per request, snapshotted BEFORE the device
+        # step mutates generated: a request mid-prompt is in a prefill
+        # chunk; one with tokens out is in a decode tick
+        was_decode = [bool(s.generated) for s, _ in batch.items]
         builds0 = self.stats["step_builds"]
         fn = self._get_step_fn(tok_pad, B, pallas_mode, ffn_mode)
         fused_tick = bool(ffn_mode) and pallas_mode == "decode"
@@ -640,6 +664,19 @@ class PagedServingEngine:
                         if s.num_computed + n < len(s.tokens))
         _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
               batch=len(batch.items), prefill_tokens=n_prefill)
+        if _tracing.trace_enabled():
+            # per-request tick attribution: each traced request in the
+            # batch gets a span over this tick's device interval, so a
+            # request's TTFT decomposes into queue.wait + its prefill
+            # chunks (+ cow copies) and TPOT into decode ticks
+            step_t0_ns = int(t0 * 1e9)
+            for (seq, n), dec in zip(batch.items, was_decode):
+                if seq.trace_id:
+                    _tracing.record_span(
+                        "decode.tick" if dec else "prefill.chunk",
+                        seq.trace_id, seq.parent_span, step_t0_ns, dur,
+                        rid=seq.rid, tokens=n,
+                        replica=self._trace_replica)
         if pallas_mode:
             kind = "decode" if pallas_mode == "decode" else "mixed"
             self.stats["pallas_steps"] += 1

@@ -87,6 +87,7 @@ class ReplicaHandle:
         self.replica_id = int(replica_id)
         self.factory = engine_factory
         self.engine: Optional[PagedServingEngine] = engine_factory()
+        self._tag_engine()
         self.ttl = float(ttl)
         self.stall_timeout_s = float(stall_timeout_s)
         self.dead_after = int(dead_after)
@@ -99,6 +100,13 @@ class ReplicaHandle:
         self.death_reason: Optional[str] = None
         self.stats = {"strikes": 0, "stalls": 0, "flaps": 0, "kills": 0,
                       "readmits": 0, "steps": 0}
+
+    def _tag_engine(self):
+        """Stamp the engine with this replica's id so its per-tick trace
+        spans say which replica served them — after a failover, the
+        replayed request's spans visibly move to the survivor."""
+        if self.engine is not None:
+            self.engine._trace_replica = self.replica_id
 
     # -- lease ------------------------------------------------------------
     def beat(self):
@@ -150,6 +158,7 @@ class ReplicaHandle:
         if time.monotonic() - self._died_at < self.probation_s:
             return False
         self.engine = self.factory()
+        self._tag_engine()
         self.strikes = self.dead_after - 1   # one misstep re-kills
         self.probation = True
         self._died_at = None

@@ -45,6 +45,7 @@ from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
 from ...core import flags
 from ...observability import emit as _emit
 from ...observability import register_distress_section
+from ...observability import tracing as _tracing
 from .engine import PagedServingEngine, TokenEvent
 from .replica import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                       ReplicaHandle, ReplicaKilledError)
@@ -112,6 +113,14 @@ class RouterRequest:
     migrations: int = 0
     status: str = "waiting"
     finish_reason: Optional[str] = None
+    # span context: the client-visible request is the trace root; every
+    # engine-side span (queue.wait, prefill.chunk, decode.tick, cow.copy)
+    # parents to root_span, so one stream's whole life — across replicas
+    # and failovers — shares one trace_id. Plain host ints; never jitted.
+    trace_id: int = 0
+    root_span: int = 0
+    _root: Optional[object] = None           # open "request" Span
+    _failover_span: Optional[object] = None  # open "failover.replay" Span
 
     def confirming(self) -> bool:
         return self.confirmed < self.confirm_target
@@ -219,6 +228,12 @@ class ServingRouter:
             deadline=(time.monotonic() + float(deadline_s)
                       if deadline_s is not None else None),
             temperature=temperature, top_p=top_p, seed=int(seed))
+        root = _tracing.new_trace("request", rid=rid, tenant=tenant,
+                                  prompt_len=len(prompt))
+        if root is not None:
+            req.trace_id = root.trace_id
+            req.root_span = root.span_id
+            req._root = root
         self._reqs[rid] = req
         self._live.add(rid)
         self.stats["admitted"] += 1
@@ -394,7 +409,9 @@ class ServingRouter:
                     eos_token_id=None if req.eos < 0 else req.eos,
                     priority=req.priority, deadline_s=deadline_s,
                     temperature=req.temperature, top_p=req.top_p,
-                    seed=req.seed)
+                    seed=req.seed,
+                    trace=((req.trace_id, req.root_span)
+                           if req.trace_id else None))
             except RejectedError:
                 continue   # this replica's queue is full; try the next
             req.replica = h.replica_id
@@ -431,6 +448,14 @@ class ServingRouter:
             req.confirm_target = len(req.emitted)
             req.confirmed = 0
             req.status = "waiting"
+            # the replay rides the ORIGINAL trace: same trace_id, a
+            # failover.replay span under the request root that stays open
+            # until the survivor has re-confirmed every streamed token
+            _tracing.end_span(req._failover_span, outcome="superseded")
+            req._failover_span = _tracing.start_span(
+                "failover.replay", req.trace_id, req.root_span,
+                rid=req.rid, from_replica=h.replica_id,
+                why=h.death_reason or "dead", replay=len(req.emitted))
             # resume ahead of new arrivals, like a preempted sequence
             self._pending.setdefault(req.tenant, deque()).appendleft(req)
             self.stats["failovers"] += 1
@@ -484,6 +509,13 @@ class ServingRouter:
             if ev.token >= 0 and not ev.finished \
                     and ev.token == req.emitted[req.confirmed]:
                 req.confirmed += 1   # duplicate confirmed and suppressed
+                if not req.confirming() and req._failover_span is not None:
+                    # the survivor regenerated the whole streamed prefix:
+                    # replay complete, new tokens flow from here
+                    _tracing.end_span(req._failover_span,
+                                      replica=h.replica_id,
+                                      confirmed=req.confirmed)
+                    req._failover_span = None
                 return
             if ev.finished and ev.token < 0 \
                     and ev.reason in ("deadline", "shed", "cancelled"):
@@ -522,6 +554,14 @@ class ServingRouter:
         req.status = FINISHED
         req.finish_reason = reason
         self._live.discard(req.rid)
+        if req._failover_span is not None:   # finished mid-replay
+            _tracing.end_span(req._failover_span, outcome=reason)
+            req._failover_span = None
+        if req._root is not None:
+            _tracing.end_span(req._root, reason=reason,
+                              generated=len(req.emitted),
+                              failovers=req.failovers)
+            req._root = None
         if not terminal_logged:
             req.events.append(TokenEvent(req.rid, -1, True, reason))
         self._completions.append(Completion(req.rid, list(req.prompt),

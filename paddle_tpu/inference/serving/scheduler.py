@@ -33,6 +33,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ...core import flags
 from ...observability import emit as _emit
+from ...observability import tracing as _tracing
 from .block_manager import BlockManager, NoFreeBlocksError
 
 __all__ = ["RejectedError", "DeadlineExceededError", "Sequence",
@@ -80,6 +81,11 @@ class Sequence:
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
     finish_reason: Optional[str] = None
+    # span context (host-side ints riding the object; never jitted args —
+    # the zero-retrace contract of observability.tracing)
+    trace_id: int = 0
+    parent_span: int = 0
+    _qw_span: Optional[object] = None   # open queue.wait span, if any
 
     def __post_init__(self):
         self.tokens = list(self.prompt)
@@ -145,6 +151,8 @@ class Scheduler:
                 f"FLAGS_serving_max_queue={self.max_queue}); request "
                 f"{seq.rid} shed — back off and resubmit")
         seq.arrival = time.monotonic()
+        seq._qw_span = _tracing.start_span("queue.wait", seq.trace_id,
+                                           seq.parent_span, rid=seq.rid)
         self.waiting.append(seq)
         self._by_rid[seq.rid] = seq
         self.stats["admitted"] += 1
@@ -167,6 +175,9 @@ class Scheduler:
     def _finish(self, seq: Sequence, reason: str):
         seq.status = FINISHED
         seq.finish_reason = reason
+        if seq._qw_span is not None:   # finished without ever being scheduled
+            _tracing.end_span(seq._qw_span, outcome=reason)
+            seq._qw_span = None
         if seq in self.running:
             self.running.remove(seq)
         if seq in self.waiting:
@@ -187,6 +198,10 @@ class Scheduler:
         seq.preemptions += 1
         self.running.remove(seq)
         self.waiting.appendleft(seq)   # resumes ahead of new arrivals
+        # back in the queue: a fresh queue.wait span covers the re-wait
+        seq._qw_span = _tracing.start_span("queue.wait", seq.trace_id,
+                                           seq.parent_span, rid=seq.rid,
+                                           resumed=True)
         self.stats["preemptions"] += 1
         _emit("serving.preempt", rid=seq.rid,
               tokens=len(seq.tokens), priority=seq.priority)
@@ -264,6 +279,9 @@ class Scheduler:
             n = min(seq.remaining(), self.prefill_chunk, budget)
             self.waiting.popleft()
             seq.status = RUNNING
+            if seq._qw_span is not None:   # queue wait ends here
+                _tracing.end_span(seq._qw_span)
+                seq._qw_span = None
             self.running.append(seq)
             items.append((seq, n))
             budget -= n

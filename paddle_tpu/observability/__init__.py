@@ -27,9 +27,9 @@ from .metrics import Registry, DEFAULT_BUCKETS  # noqa: F401
 from .recorder import FlightRecorder
 
 __all__ = ["emit", "enabled", "registry", "recorder", "reset", "summary",
-           "prometheus_text", "metrics_snapshot", "dump_distress",
-           "register_distress_section", "install_signal_handler",
-           "Registry", "FlightRecorder"]
+           "fleet_summary", "prometheus_text", "metrics_snapshot",
+           "dump_distress", "register_distress_section",
+           "install_signal_handler", "Registry", "FlightRecorder"]
 
 flags.define_flag("metrics_sampling", 1,
                   "Observability sampling: 0 disables emit() entirely "
@@ -58,7 +58,7 @@ _ring_tick = [0]
 # high-frequency kinds subject to >1 ring sampling (metrics stay exact)
 _HIGH_FREQ = frozenset({"dispatch.hit", "async.fetch_stall",
                         "async.enqueue", "async.p2p", "pipeline.send",
-                        "pipeline.recv"})
+                        "pipeline.recv", "trace.span"})
 
 
 def registry() -> Registry:
@@ -331,6 +331,45 @@ _g_srv_bytes = _G("paddle_serving_kv_bytes_in_use",
                   "int8 pages count their real footprint)")
 _g_srv_bytes_total = _G("paddle_serving_kv_bytes_total",
                         "Device bytes of the whole KV page pool")
+_c_tr_spans = _C("paddle_trace_spans_total",
+                 "Finished trace spans, by span name (tracing.py)")
+_h_tr_span = _H("paddle_trace_span_seconds",
+                "Finished trace-span durations (all span names)")
+_g_tr_active = _G("paddle_trace_active_spans",
+                  "Spans currently open on this process (in-flight "
+                  "requests/steps land in distress dumps from here)")
+_c_tr_clock = _C("paddle_trace_clock_handshakes_total",
+                 "Store-based clock-offset handshakes completed")
+_c_fl_pub = _C("paddle_fleet_publishes_total",
+               "Registry snapshots published to the fleet metrics plane")
+_h_fl_pub = _H("paddle_fleet_publish_seconds",
+               "Serialize+store latency of a fleet snapshot publish")
+_c_fl_merge = _C("paddle_fleet_merges_total",
+                 "Fleet aggregations performed (fleet_summary calls)")
+_g_fl_ranks = _G("paddle_fleet_ranks",
+                 "Snapshots merged into the last fleet aggregation")
+_g_fl_ttft50 = _G("paddle_fleet_ttft_p50_seconds",
+                  "Fleet-global TTFT p50 from the last aggregation")
+_g_fl_ttft99 = _G("paddle_fleet_ttft_p99_seconds",
+                  "Fleet-global TTFT p99 from the last aggregation")
+_g_fl_tpot50 = _G("paddle_fleet_tpot_p50_seconds",
+                  "Fleet-global TPOT p50 from the last aggregation")
+_g_fl_tpot99 = _G("paddle_fleet_tpot_p99_seconds",
+                  "Fleet-global TPOT p99 from the last aggregation")
+_g_fl_shed = _G("paddle_fleet_shed_rate",
+                "Fleet-global shed fraction from the last aggregation")
+_g_pp_mbubble = _G("paddle_pp_measured_bubble_fraction",
+                   "MEASURED bubble fraction of the last pipeline run "
+                   "(host action timeline, vs the simulate() prediction)")
+_g_pp_bgap = _G("paddle_pp_bubble_gap",
+                "measured - predicted bubble fraction of the last run "
+                "(schedule conformance: ~0 when reality matches the sim)")
+_g_pp_strag = _G("paddle_pp_straggler_stage",
+                 "Physical stage group with the most measured busy time "
+                 "in the last pipeline run")
+_g_pp_strag_x = _G("paddle_pp_straggler_excess",
+                   "Straggler group's busy-time excess over the mean "
+                   "((max - mean) / mean) in the last run")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -450,6 +489,26 @@ def _h_pp_recv(dur_s, f):
 def _h_pp_gauges(dur_s, f):
     _g_pp_bubble.set(f.get("bubble_fraction", 0.0))
     _g_pp_skew.set(f.get("stage_skew", 0.0))
+    if "measured_bubble_fraction" in f:
+        _g_pp_mbubble.set(f["measured_bubble_fraction"])
+        _g_pp_bgap.set(f.get("bubble_gap", 0.0))
+        _g_pp_strag.set(f.get("straggler_group", 0))
+        _g_pp_strag_x.set(f.get("straggler_excess", 0.0))
+
+
+def _h_trace_span(dur_s, f):
+    _c_tr_spans.inc(labels={"name": f.get("name", "")})
+    _g_tr_active.set(f.get("active", 0))
+    if dur_s is not None:
+        _h_tr_span.observe(dur_s)
+
+
+def _h_fleet_slo(dur_s, f):
+    _g_fl_ttft50.set(f.get("ttft_p50", 0.0))
+    _g_fl_ttft99.set(f.get("ttft_p99", 0.0))
+    _g_fl_tpot50.set(f.get("tpot_p50", 0.0))
+    _g_fl_tpot99.set(f.get("tpot_p99", 0.0))
+    _g_fl_shed.set(f.get("shed_rate", 0.0))
 
 
 def _h_rt_assign(dur_s, f):
@@ -600,6 +659,14 @@ _HANDLERS = {
                                    _c_q_kv_dq.inc(f.get("pages", 0))),
     "quant.manifest_load": lambda d, f: _c_q_manifest.inc(
         labels={"result": f.get("result", "")}),
+    "trace.span": _h_trace_span,
+    "trace.clock": lambda d, f: _c_tr_clock.inc(),
+    "fleet.publish": lambda d, f: (_c_fl_pub.inc(),
+                                   _h_fl_pub.observe(d)
+                                   if d is not None else None),
+    "fleet.merge": lambda d, f: (_c_fl_merge.inc(),
+                                 _g_fl_ranks.set(f.get("ranks", 0))),
+    "fleet.slo": _h_fleet_slo,
 }
 
 
@@ -730,6 +797,11 @@ def summary() -> dict:
             "stage_builds": int(_c_pp_builds.value()),
             "p2p_transfers": int(_c_p2p.value()),
             "bubble_fraction": round(float(_g_pp_bubble.value()), 6),
+            "measured_bubble_fraction": round(
+                float(_g_pp_mbubble.value()), 6),
+            "bubble_gap": round(float(_g_pp_bgap.value()), 6),
+            "straggler_group": int(_g_pp_strag.value()),
+            "straggler_excess": round(float(_g_pp_strag_x.value()), 4),
             "stage_skew": round(float(_g_pp_skew.value()), 4),
             "send_p50_s": round(_h_pp_send.percentile(50), 6),
             "send_p99_s": round(_h_pp_send.percentile(99), 6),
@@ -766,10 +838,19 @@ def summary() -> dict:
     }
 
 
+def fleet_summary(store=None, ranks=None, states=None) -> dict:
+    """Fleet-global SLO digest (merged TTFT/TPOT percentiles, shed rate);
+    see fleet.py. With no store: the local registry as a fleet of one."""
+    from . import fleet
+
+    return fleet.fleet_summary(store=store, ranks=ranks, states=states)
+
+
 def reset():
     """Zero every metric and clear the ring (bench/test isolation)."""
     _registry.reset()
     _recorder.clear()
+    tracing.reset()
 
 
 def dump_distress(reason: str, extra: dict = None,
@@ -799,3 +880,9 @@ def install_signal_handler() -> bool:
 from . import distress as _distress  # noqa: E402
 
 _distress.install_enforce_hook()
+
+# span plane last (it emits through the choke point above); registers the
+# in-flight span tree as the distress "traces" section
+from . import tracing  # noqa: E402
+
+tracing.install()

@@ -1,0 +1,264 @@
+"""Fleet metrics plane: per-rank registry snapshots -> one global view.
+
+Every process (training rank, serving replica host) already owns a
+process-wide :class:`~.metrics.Registry`.  This module makes the fleet
+legible as ONE registry:
+
+- **publish** — each rank serializes its registry's *raw* state (counter
+  label-sets, gauge label-sets, histogram bucket counts + the exact
+  observation window) to the rendezvous TCPStore on a cadence
+  (``FLAGS_fleet_metrics_interval``), keyed ``paddle_fleet/snap/<rank>``;
+- **aggregate** — :func:`fleet_summary` collects whatever snapshots are
+  present and merges them: counters **sum** per label-set, gauges keep
+  **per-rank labels** (a gauge is a statement about one process), and
+  histograms **bucket-merge** — counts add element-wise and the raw
+  observation windows concatenate in rank order through the same
+  bounded deque, so the merged percentile runs the *identical*
+  ``sorted + ceil(q/100*n)-1`` algorithm on the identical window a
+  single-process registry would hold.  That makes the fleet TTFT/TPOT
+  p50/p99 **bit-for-bit** equal to the per-replica registries' merged
+  histograms — no approximation layered on top (the SLO autoscaler of
+  ROADMAP item 1 consumes these numbers; feeding it a different
+  estimator than the per-process one would make its decisions
+  unfalsifiable).
+
+Serialization is plain JSON over the store; no new wire dependencies.
+With no store attached, :func:`fleet_summary` degrades to the local
+registry (a fleet of one), which is exactly the multi-replica
+single-process router case — all replica engines feed one registry.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..core import flags
+from .metrics import Counter, Gauge, Histogram, _label_str
+
+__all__ = ["export_state", "merge_states", "merged_histogram",
+           "FleetPublisher", "publish", "collect", "fleet_summary"]
+
+flags.define_flag("fleet_metrics_interval", 5.0,
+                  "Seconds between fleet metrics snapshot publishes "
+                  "(FleetPublisher.maybe_publish cadence)")
+
+_KEY_PREFIX = "paddle_fleet/snap"
+
+
+def _local_registry():
+    from . import registry
+    return registry()
+
+
+def export_state(reg=None) -> dict:
+    """The registry's raw, merge-able state (NOT the lossy snapshot()):
+    full label-set maps and, for histograms, bucket counts plus the
+    exact bounded observation window percentiles are computed from."""
+    reg = reg if reg is not None else _local_registry()
+    out: Dict[str, dict] = {"counters": {}, "gauges": {}, "histograms": {}}
+    for name in reg.names():
+        m = reg.get(name)
+        if isinstance(m, Histogram):
+            out["histograms"][name] = {
+                "buckets": list(m.buckets),
+                "counts": list(m._counts),
+                "sum": m._sum,
+                "n": m._n,
+                "window": list(m._window),
+            }
+        elif isinstance(m, (Counter, Gauge)):
+            kind = "counters" if isinstance(m, Counter) else "gauges"
+            out[kind][name] = [[list(map(list, k)), v]
+                               for k, v in m._values.items()]
+    return out
+
+
+def merged_histogram(states: List[dict]) -> Histogram:
+    """Merge raw histogram states into a real :class:`Histogram` (never
+    registered): counts add element-wise, windows concatenate in the
+    given rank order through the same maxlen deque.  Percentiles then
+    come from the unmodified ``Histogram.percentile`` — bit-for-bit the
+    single-process algorithm on the merged window."""
+    if not states:
+        return Histogram("merged")
+    h = Histogram("merged", buckets=states[0]["buckets"])
+    for st in states:
+        counts = st["counts"]
+        if len(counts) != len(h._counts):
+            # bucket-layout drift across versions: fold the overflow in
+            counts = (counts + [0] * len(h._counts))[:len(h._counts)]
+        for i, c in enumerate(counts):
+            h._counts[i] += c
+        h._sum += st["sum"]
+        h._n += st["n"]
+        h._window.extend(st["window"])
+    return h
+
+
+def merge_states(states: List[dict]) -> dict:
+    """states: [(rank, export_state dict)] or plain dicts (rank = index).
+    -> {"counters": {name: Counter}, "gauges": {name: Gauge with an
+    added rank label per source}, "histograms": {name: Histogram}}."""
+    pairs = []
+    for i, st in enumerate(states):
+        if isinstance(st, tuple):
+            pairs.append((str(st[0]), st[1]))
+        else:
+            pairs.append((str(st.get("rank", i)) if isinstance(st, dict)
+                          and "rank" in st else str(i),
+                          st.get("state", st) if isinstance(st, dict)
+                          else st))
+    counters: Dict[str, Counter] = {}
+    gauges: Dict[str, Gauge] = {}
+    hists: Dict[str, List[dict]] = {}
+    for rank, st in pairs:
+        for name, values in st.get("counters", {}).items():
+            c = counters.setdefault(name, Counter(name))
+            for key, v in values:
+                k = tuple(tuple(p) for p in key)
+                c._values[k] = c._values.get(k, 0) + v
+        for name, values in st.get("gauges", {}).items():
+            g = gauges.setdefault(name, Gauge(name))
+            for key, v in values:
+                # a gauge is per-process truth: label it with its rank
+                k = tuple(sorted(tuple(tuple(p) for p in key)
+                                 + (("rank", rank),)))
+                g._values[k] = v
+        for name, st_h in st.get("histograms", {}).items():
+            hists.setdefault(name, []).append(st_h)
+    return {"counters": counters, "gauges": gauges,
+            "histograms": {n: merged_histogram(v) for n, v in hists.items()}}
+
+
+# ---------------------------------------------------------------------------
+# Store transport
+# ---------------------------------------------------------------------------
+
+def publish(store, rank, reg=None, role: str = "rank") -> str:
+    """Serialize this process's registry state to the store. Returns the
+    key written. Safe to call on any cadence; last write wins."""
+    t0 = time.perf_counter()
+    payload = {"rank": rank, "role": role, "wall_ts": time.time(),
+               "state": export_state(reg)}
+    key = f"{_KEY_PREFIX}/{rank}"
+    store.set(key, json.dumps(payload))
+    from . import emit as _emit
+    _emit("fleet.publish", dur_s=time.perf_counter() - t0, rank=rank,
+          role=role)
+    return key
+
+
+class FleetPublisher:
+    """Cadenced publisher: wire ``maybe_publish()`` into any existing
+    tick (elastic ``note_step``, the router step loop, a bench loop) —
+    no extra thread, publishes at most once per interval."""
+
+    def __init__(self, store, rank, interval_s: Optional[float] = None,
+                 role: str = "rank"):
+        self.store = store
+        self.rank = rank
+        self.role = role
+        self.interval_s = (float(flags.flag_value("fleet_metrics_interval"))
+                           if interval_s is None else float(interval_s))
+        self._last = 0.0
+        self.publishes = 0
+
+    def maybe_publish(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.interval_s:
+            return False
+        self._last = now
+        publish(self.store, self.rank, role=self.role)
+        self.publishes += 1
+        return True
+
+
+def collect(store, ranks) -> List[dict]:
+    """Fetch whatever snapshots exist for ``ranks`` (non-blocking per
+    rank: absent keys are skipped via check(), never waited on)."""
+    out = []
+    for r in ranks:
+        key = f"{_KEY_PREFIX}/{r}"
+        try:
+            if not store.check(key):
+                continue
+            raw = store.get(key)
+            out.append(json.loads(raw if isinstance(raw, str)
+                                  else raw.decode("utf-8")))
+        except Exception:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The fleet digest
+# ---------------------------------------------------------------------------
+
+def _pct(h: Optional[Histogram], q: float) -> float:
+    return h.percentile(q) if h is not None else 0.0
+
+
+def fleet_summary(store=None, ranks=None, states=None) -> dict:
+    """Fleet-global SLO digest: merged TTFT/TPOT p50/p99, shed rate and
+    the merged counter totals the autoscaler needs.
+
+    Sources, in precedence order: explicit ``states`` (already-fetched
+    payloads), a ``store`` + ``ranks`` to collect from, else the local
+    registry (a fleet of one — the single-process multi-replica router
+    case).  Percentiles are computed by :func:`merged_histogram`, i.e.
+    bit-for-bit the per-process algorithm on the merged windows."""
+    if states is None:
+        if store is not None:
+            payloads = collect(store, ranks if ranks is not None
+                               else range(64))
+            states = [(p.get("rank", i), p.get("state", {}))
+                      for i, p in enumerate(payloads)]
+        else:
+            states = [("local", export_state())]
+    merged = merge_states(states)
+    counters, hists = merged["counters"], merged["histograms"]
+
+    def csum(name, labels=None):
+        c = counters.get(name)
+        return float(c.value(labels)) if c is not None else 0.0
+
+    ttft = hists.get("paddle_serving_ttft_seconds")
+    tpot = hists.get("paddle_serving_tpot_seconds")
+    admitted = csum("paddle_serving_requests_total", {"event": "admitted"})
+    shed = (csum("paddle_serving_requests_total", {"event": "shed"})
+            + csum("paddle_serving_requests_total", {"event": "deadline"})
+            + csum("paddle_router_shed_total"))
+    seen = admitted + csum("paddle_router_shed_total")
+    out = {
+        "ranks": sorted({str(r) for r, _ in states}),
+        "world": len(states),
+        "ttft_p50_s": round(_pct(ttft, 50), 9),
+        "ttft_p99_s": round(_pct(ttft, 99), 9),
+        "tpot_p50_s": round(_pct(tpot, 50), 9),
+        "tpot_p99_s": round(_pct(tpot, 99), 9),
+        "ttft_count": int(ttft._n) if ttft is not None else 0,
+        "tpot_count": int(tpot._n) if tpot is not None else 0,
+        "admitted": int(admitted),
+        "completed": int(csum("paddle_serving_requests_total",
+                              {"event": "completed"})),
+        "shed": int(shed),
+        "shed_rate": round(shed / seen, 6) if seen else 0.0,
+        "failovers": int(csum("paddle_router_failovers_total")),
+        "counters": {name: {_label_str(k) or "": v
+                            for k, v in c._values.items()}
+                     for name, c in sorted(counters.items())},
+        "gauges": {name: {_label_str(k) or "": v
+                          for k, v in g._values.items()}
+                   for name, g in sorted(merged["gauges"].items())},
+        "histograms": {name: {"count": h._n, "sum": round(h._sum, 9),
+                              "p50": round(h.percentile(50), 9),
+                              "p99": round(h.percentile(99), 9)}
+                       for name, h in sorted(hists.items())},
+    }
+    from . import emit as _emit
+    _emit("fleet.merge", ranks=len(states))
+    _emit("fleet.slo", ttft_p50=out["ttft_p50_s"], ttft_p99=out["ttft_p99_s"],
+          tpot_p50=out["tpot_p50_s"], tpot_p99=out["tpot_p99_s"],
+          shed_rate=out["shed_rate"])
+    return out

@@ -259,6 +259,37 @@ def test_pallas_kernels_are_walked(tmp_path):
     assert "clock:time.perf_counter" in tags, findings   # direct kernel name
 
 
+def test_trace_emit_drift_fires_exactly_once(tmp_path):
+    # the tracing plane's hot emit ("trace.span" from end_span/record_span)
+    # is TPL005-guarded like every other kind: a fixture that emits it from
+    # two modules while the handler table lacks the entry yields exactly ONE
+    # unhandled-kind finding (deduped at the first emit site), so drift
+    # between tracing.py and observability/__init__.py cannot land silently
+    src = {
+        "handlers.py": """
+        _HANDLERS = {"trace.clock": None}
+
+        def emit(kind, **fields):
+            pass
+
+        def clock():
+            emit("trace.clock")
+        """,
+        "spans.py": """
+        from .handlers import emit
+
+        def end_span():
+            emit("trace.span", dur_s=0.0)
+
+        def record_span():
+            emit("trace.span", dur_s=1.0)
+        """,
+    }
+    findings = [f for f in _run(_write_fixture_repo(tmp_path, src))
+                if f.tag == "unhandled-kind:trace.span"]
+    assert len(findings) == 1, findings
+
+
 def test_custom_vjp_closures_are_walked(tmp_path):
     # fwd/bwd handed to prim.defvjp(...) are traced entries for TPL001 even
     # when neither is jitted or passed to pallas_call directly — the vjp

@@ -1,0 +1,429 @@
+"""Distributed tracing + fleet metrics plane (observability/tracing.py,
+observability/fleet.py).
+
+The contracts under test, in dependency order:
+
+- span plane basics: trace trees, the active-tree view, chrome-trace
+  export/merge, and the flag kill switch;
+- serving propagation: one router submission = one trace whose child
+  spans (queue.wait / prefill.chunk / decode.tick) decompose TTFT/TPOT,
+  riding the request objects as plain host ints;
+- failover parenting: a chaos-killed replica's replayed stream KEEPS its
+  original trace_id and gains exactly one failover.replay span that
+  closes on the survivor — the acceptance drill of the tracing plane;
+- pipeline conformance: the runtime's measured action timeline is
+  dependency-valid against the schedule it claims to have run, and the
+  measured-vs-predicted bubble diff lands in summary()["pipeline"];
+- fleet merge: percentiles over store-published per-rank histogram
+  snapshots are bit-for-bit what a single process holding all the
+  samples would compute;
+- the zero-retrace pin: tracing on vs off changes no executable counts.
+"""
+from __future__ import annotations
+
+import json
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+from paddle_tpu.distributed.fault_tolerance import chaos
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import fleet, tracing
+from paddle_tpu.observability.metrics import Histogram, Registry
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def store():
+    st = TCPStore("127.0.0.1", _free_port(), is_master=True, world_size=1)
+    yield st
+    st.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Span plane basics
+# ---------------------------------------------------------------------------
+
+class TestSpanPlane:
+    def test_trace_tree_and_finished_view(self):
+        root = tracing.new_trace("request", rid=7)
+        assert root.trace_id == root.span_id and root.parent_id == 0
+        child = tracing.start_span("queue.wait", root.trace_id,
+                                   root.span_id)
+        tree = tracing.active_tree()
+        assert tree["in_flight_spans"] == 2
+        (roots,) = tree["traces"].values()
+        assert roots[0]["name"] == "request"
+        assert roots[0]["children"][0]["name"] == "queue.wait"
+        tracing.end_span(child)
+        tracing.end_span(root, reason="stop")
+        done = tracing.finished_spans(trace_id=root.trace_id)
+        assert [d["name"] for d in done] == ["queue.wait", "request"]
+        assert all(d["dur_s"] >= 0 for d in done)
+        assert tracing.active_tree()["in_flight_spans"] == 0
+        # finished spans flow through the choke point into metrics
+        assert obs.registry().value("paddle_trace_spans_total",
+                                    {"name": "request"}) == 1
+
+    def test_end_span_idempotent_and_none_tolerant(self):
+        assert tracing.end_span(None) is None
+        sp = tracing.new_trace("x")
+        tracing.end_span(sp)
+        end1 = sp.end_ns
+        tracing.end_span(sp)
+        assert sp.end_ns == end1
+        assert len(tracing.finished_spans()) == 1
+
+    def test_flag_kill_switch(self):
+        flags.set_flags({"trace_spans": False})
+        try:
+            assert tracing.new_trace("request") is None
+            assert tracing.start_span("queue.wait", 123) is None
+            assert tracing.record_span("decode.tick", 123, 1, 0, 1e-3) \
+                is None
+        finally:
+            flags.set_flags({"trace_spans": True})
+        assert tracing.new_trace("request") is not None
+
+    def test_chrome_trace_export_and_multi_rank_merge(self):
+        root = tracing.new_trace("pipeline.batch", epoch=0)
+        tracing.record_span("pp.F", root.trace_id, root.span_id,
+                            root.start_ns, 1e-3, stage=0)
+        tracing.end_span(root)
+        doc = tracing.to_chrome_trace()
+        # the document must survive a JSON round trip (the file format)
+        doc = json.loads(json.dumps(doc))
+        assert {e["name"] for e in doc["traceEvents"]} == \
+            {"pipeline.batch", "pp.F"}
+        assert all(e["ph"] == "X" and e["dur"] >= 0
+                   for e in doc["traceEvents"])
+        # merging a second "rank" with a +1s clock offset shifts its
+        # events onto the shared axis and interleaves by timestamp
+        merged = tracing.merge_chrome_traces(
+            [doc, (doc, int(1e9), "rank1")])
+        assert len(merged["traceEvents"]) == 2 * len(doc["traceEvents"])
+        ts = [e["ts"] for e in merged["traceEvents"]]
+        assert ts == sorted(ts)
+        shifted = [e for e in merged["traceEvents"] if e["pid"] == "rank1"]
+        base = {e["name"]: e["ts"] for e in doc["traceEvents"]}
+        assert all(abs(e["ts"] - base[e["name"]] - 1e6) < 1e-6
+                   for e in shifted)
+
+    def test_clock_handshake_maps_perf_onto_wall_axis(self, store):
+        off0 = tracing.clock_handshake(store, 0)
+        off1 = tracing.clock_handshake(store, 1)
+        import time as _time
+        # both offsets map perf_counter_ns onto the wall axis: applying
+        # them to "now" must land within a second of wall-clock now
+        now_perf = _time.perf_counter_ns()
+        for off in (off0, off1):
+            assert abs((now_perf + off) - _time.time_ns()) < 1e9
+        assert tracing.clock_offset_ns() == off1
+        assert store.check("paddle_trace/clock/0")
+        assert obs.registry().value(
+            "paddle_trace_clock_handshakes_total") == 2
+
+    def test_distress_dump_carries_active_span_tree(self, tmp_path):
+        root = tracing.new_trace("request", rid=42)
+        tracing.start_span("decode.tick", root.trace_id, root.span_id)
+        path = obs.dump_distress("test_traces", directory=str(tmp_path))
+        doc = json.loads(open(path).read())
+        assert doc["traces"]["in_flight_spans"] == 2
+        (spans,) = doc["traces"]["traces"].values()
+        assert spans[0]["name"] == "request"
+        assert spans[0]["fields"]["rid"] == 42
+        assert spans[0]["children"][0]["name"] == "decode.tick"
+
+
+# ---------------------------------------------------------------------------
+# Serving propagation (tiny model, CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    from paddle_tpu.models import llama as L
+
+    cfg = L.LlamaConfig(vocab_size=97, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, max_seq_len=96, dtype=jnp.float32)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _factory(tiny, **kw):
+    from paddle_tpu.inference.serving import PagedServingEngine
+
+    cfg, params = tiny
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("token_budget", 16)
+
+    def build():
+        return PagedServingEngine(cfg, params, **kw)
+
+    return build
+
+
+def _prompt(cfg, n, seed=3):
+    return np.random.RandomState(seed).randint(
+        0, cfg.vocab_size, (n,)).tolist()
+
+
+class TestServingPropagation:
+    def test_request_trace_decomposes_ttft(self, tiny):
+        from paddle_tpu.inference.serving import ServingRouter
+
+        router = ServingRouter(_factory(tiny), num_replicas=1)
+        rid = router.submit(_prompt(tiny[0], 6), max_new_tokens=4)
+        tid = router._reqs[rid].trace_id
+        assert tid > 0
+        list(router.stream(rid))
+        spans = tracing.finished_spans(trace_id=tid)
+        by_name = {}
+        for d in spans:
+            by_name.setdefault(d["name"], []).append(d)
+        # the TTFT decomposition: queue wait, then prefill chunks (the
+        # final chunk yields the first token), then per-token decode
+        assert set(by_name) >= {"request", "queue.wait", "prefill.chunk",
+                                "decode.tick"}
+        root = by_name["request"][0]
+        assert root["span_id"] == tid and root["fields"]["rid"] == rid
+        for name in ("queue.wait", "prefill.chunk", "decode.tick"):
+            assert all(d["parent_id"] == tid for d in by_name[name]), name
+        # 4 new tokens: the final prefill chunk produced the first, each
+        # decode tick one more
+        assert len(by_name["decode.tick"]) == 3
+        assert by_name["decode.tick"][0]["fields"]["replica"] == 0
+        assert root["fields"]["reason"] == "length"
+
+    def test_failover_replay_keeps_trace_id(self, tiny):
+        """THE acceptance drill: replica 0 chaos-killed mid-decode; the
+        replayed stream keeps its original trace_id, gains exactly one
+        failover.replay span parented to the request root, and that span
+        closes on the survivor once the streamed prefix re-confirms."""
+        from paddle_tpu.inference.serving import ServingRouter
+
+        chaos.reconfigure("replica:kill@victim=0;call=3")
+        try:
+            router = ServingRouter(_factory(tiny), num_replicas=2,
+                                   probation_s=60.0)
+            rid = router.submit(_prompt(tiny[0], 6, seed=31),
+                                max_new_tokens=12)
+            tid = router._reqs[rid].trace_id
+            tokens = list(router.stream(rid))
+        finally:
+            chaos.reconfigure("")
+        assert len(tokens) == 12
+        assert router._reqs[rid].failovers == 1
+        assert router._reqs[rid].trace_id == tid   # identity preserved
+        spans = tracing.finished_spans(trace_id=tid)
+        replays = [d for d in spans if d["name"] == "failover.replay"]
+        assert len(replays) == 1
+        assert replays[0]["parent_id"] == tid
+        assert replays[0]["fields"]["from_replica"] == 0
+        assert replays[0]["fields"]["why"] == "chaos_kill"
+        # the replay closed on the survivor after full re-confirmation
+        assert replays[0]["fields"]["replica"] == 1
+        assert replays[0]["fields"]["confirmed"] == \
+            replays[0]["fields"]["replay"]
+        # post-failover serving spans name the survivor
+        post = [d for d in spans if d["name"] == "decode.tick"
+                and d["fields"].get("replica") == 1]
+        assert post, spans
+        # one merged chrome trace holds the whole story
+        doc = tracing.to_chrome_trace()
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e["args"]["trace_id"] == tid}
+        assert "failover.replay" in names and "request" in names
+
+    def test_zero_retrace_pin_tracing_on_vs_off(self, tiny):
+        """Trace context must never reach a jitted signature: the same
+        workload compiles the same number of step executables with the
+        span plane on and off."""
+
+        def run():
+            eng = _factory(tiny)()
+            for i in range(3):
+                root = tracing.new_trace("request", rid=i)
+                eng.submit(_prompt(tiny[0], 4 + i, seed=50 + i),
+                           max_new_tokens=6,
+                           trace=((root.trace_id, root.span_id)
+                                  if root else None))
+            while eng.has_work():
+                eng.step()
+            return eng.stats["step_builds"]
+
+        builds_on = run()
+        assert tracing.finished_spans(name="queue.wait")  # plane was live
+        obs.reset()
+        flags.set_flags({"trace_spans": False})
+        try:
+            builds_off = run()
+        finally:
+            flags.set_flags({"trace_spans": True})
+        assert builds_on == builds_off
+        assert tracing.finished_spans() == []   # off = zero spans
+
+
+# ---------------------------------------------------------------------------
+# Pipeline conformance
+# ---------------------------------------------------------------------------
+
+class TestPipelineConformance:
+    def test_measured_timeline_matches_schedule(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers \
+            .pp_layers import LayerDesc, PipelineLayer
+        from paddle_tpu.distributed.pipeline import (PipelineEngine,
+                                                     build_schedule)
+
+        model = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 16, 32), LayerDesc(nn.ReLU),
+                    LayerDesc(nn.Linear, 32, 4)],
+            loss_fn=lambda o, y: ((o - y) ** 2).mean(), num_stages=2)
+        engine = PipelineEngine(model, accumulate_steps=4, schedule="1F1B")
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rs.normal(size=(8, 4)).astype(np.float32))
+        engine.run(x, y, train=True)
+
+        conf = engine.last_conformance
+        assert conf["schedule"] == "1f1b"
+        # the dispatcher executed exactly the actions the schedule holds,
+        # in an order that respects every dependency edge
+        acts = build_schedule("1F1B", 2, 4)
+        assert conf["actions"] == sum(len(v) for v in acts.values())
+        assert conf["actions"] == len(engine.last_timeline)
+        assert conf["order_dependency_valid"] is True
+        assert 0.0 <= conf["measured_bubble_fraction"] <= 1.0
+        assert conf["bubble_gap"] == pytest.approx(
+            conf["measured_bubble_fraction"]
+            - conf["predicted_bubble_fraction"], abs=1e-6)
+        assert len(conf["per_group_busy_s"]) == 2
+        assert conf["straggler_group"] in (0, 1)
+        # the batch trace: one pipeline.batch root + a span per action
+        batch = tracing.finished_spans(name="pipeline.batch")
+        assert len(batch) == 1 and batch[0]["fields"]["epoch"] == 0
+        tid = batch[0]["trace_id"]
+        stage_spans = [d for d in tracing.finished_spans(trace_id=tid)
+                       if d["name"].startswith("pp.")
+                       and d["name"] != "pp.p2p"]
+        assert len(stage_spans) == conf["actions"]
+        # measured-vs-predicted lands in the summary gauges
+        pipe = obs.summary()["pipeline"]
+        assert pipe["measured_bubble_fraction"] == \
+            conf["measured_bubble_fraction"]
+        assert pipe["bubble_gap"] == conf["bubble_gap"]
+        assert pipe["straggler_group"] == conf["straggler_group"]
+
+    def test_measured_schedule_stats_on_known_timeline(self):
+        # two stages, perfectly packed: zero bubble, no straggler excess
+        tl = [(0, "F", 0, 0.0, 1.0), (1, "F", 0, 1.0, 1.0),
+              (0, "B", 0, 1.0, 1.0), (1, "B", 0, 2.0, 1.0)]
+        st = tracing.measured_schedule_stats(tl, 2)
+        assert st["makespan_s"] == 3.0
+        assert st["busy_s"] == [2.0, 2.0]
+        assert st["bubble_fraction"] == pytest.approx(1 - 4.0 / 6.0,
+                                                      abs=1e-6)
+        assert st["straggler_excess"] == 0.0
+        # a slow stage 1 shows up as the straggler
+        tl[1] = (1, "F", 0, 1.0, 2.0)
+        st = tracing.measured_schedule_stats(tl, 2)
+        assert st["straggler_group"] == 1
+        assert st["straggler_excess"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge
+# ---------------------------------------------------------------------------
+
+def _rank_registry(values, extra=()):
+    reg = Registry()
+    h = reg.histogram("paddle_serving_ttft_seconds")
+    for v in values:
+        h.observe(v)
+    c = reg.counter("paddle_serving_requests_total")
+    c.inc(len(values), {"event": "admitted"})
+    for name, labels, v in extra:
+        reg.counter(name).inc(v, labels)
+    return reg
+
+
+class TestFleetMerge:
+    def test_histogram_merge_bitexact_vs_single_process(self, store):
+        rs = np.random.RandomState(7)
+        vals0 = rs.exponential(0.05, 300).tolist()
+        vals1 = rs.exponential(0.08, 200).tolist()
+        fleet.publish(store, 0, reg=_rank_registry(vals0))
+        fleet.publish(store, 1, reg=_rank_registry(vals1))
+        payloads = fleet.collect(store, range(4))   # absent ranks skipped
+        assert [p["rank"] for p in payloads] == [0, 1]
+        out = fleet.fleet_summary(
+            states=[(p["rank"], p["state"]) for p in payloads])
+        # reference: ONE process observed every sample in rank order
+        ref = Histogram("ref")
+        for v in vals0 + vals1:
+            ref.observe(v)
+        assert out["ttft_p50_s"] == round(ref.percentile(50), 9)
+        assert out["ttft_p99_s"] == round(ref.percentile(99), 9)
+        assert out["ttft_count"] == 500
+        assert out["admitted"] == 500
+        assert out["world"] == 2 and out["ranks"] == ["0", "1"]
+        # bucket counts merged element-wise, not re-binned
+        merged = fleet.merged_histogram(
+            [p["state"]["histograms"]["paddle_serving_ttft_seconds"]
+             for p in payloads])
+        assert merged._counts == [a + b for a, b in zip(
+            _rank_registry(vals0).get(
+                "paddle_serving_ttft_seconds")._counts,
+            _rank_registry(vals1).get(
+                "paddle_serving_ttft_seconds")._counts)]
+        # the digest republishes as paddle_fleet_* gauges
+        reg = obs.registry()
+        assert reg.value("paddle_fleet_ttft_p50_seconds") == \
+            out["ttft_p50_s"]
+        assert reg.value("paddle_fleet_merges_total") == 1
+
+    def test_counters_sum_and_gauges_keep_rank_labels(self):
+        st0 = fleet.export_state(_rank_registry(
+            [0.1], extra=[("paddle_router_shed_total", None, 3)]))
+        st1 = fleet.export_state(_rank_registry(
+            [0.2], extra=[("paddle_router_shed_total", None, 2)]))
+        merged = fleet.merge_states([(0, st0), (1, st1)])
+        assert merged["counters"]["paddle_router_shed_total"].value() == 5
+        out = fleet.fleet_summary(states=[(0, st0), (1, st1)])
+        assert out["shed"] == 5
+        assert out["shed_rate"] == pytest.approx(5 / 7)
+
+    def test_local_fallback_is_a_fleet_of_one(self):
+        out = fleet.fleet_summary()
+        assert out["world"] == 1 and out["ranks"] == ["local"]
+
+    def test_publisher_cadence(self, store):
+        pub = fleet.FleetPublisher(store, 3, interval_s=100.0)
+        assert pub.maybe_publish(now=1000.0)
+        assert not pub.maybe_publish(now=1050.0)   # inside the interval
+        assert pub.maybe_publish(now=1100.0)
+        assert pub.publishes == 2
+        assert store.check("paddle_fleet/snap/3")

@@ -315,6 +315,51 @@ def maybe_router_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/router_smoke.py)")
 
 
+_last_trace_smoke = [0.0]
+
+
+def maybe_trace_smoke(min_interval: float = 3600.0) -> None:
+    """Run the distributed-tracing smoke (tools/trace_smoke.py) at most
+    once per min_interval and log a RED line on regression — a TTFT span
+    decomposition that stops summing to wall time, a chaos failover
+    whose replay span loses the original trace_id, fleet percentiles
+    drifting off the bit-for-bit single-process reference, a traced
+    request retracing a warmed engine, or emit overhead blowing the
+    op-bench budget are build-signal the same way the perf floor is."""
+    now = time.monotonic()
+    if _last_trace_smoke[0] and now - _last_trace_smoke[0] < min_interval:
+        return
+    _last_trace_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: trace smoke hung >600s — tracing/fleet plane broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"trace smoke GREEN ({payload.get('wall_s')}s: "
+            f"ttft_cover={payload.get('ttft_cover')}, "
+            f"{payload.get('drill_failovers')} failover traced, "
+            f"{payload.get('merged_events')} merged events, "
+            f"overhead={payload.get('overhead_pct')}%)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: trace smoke regression rc={out.returncode} — {detail} "
+        f"(tools/trace_smoke.py)")
+
+
 _last_quant_smoke = [0.0]
 
 
@@ -664,6 +709,7 @@ def main() -> None:
         maybe_dp_overlap_smoke()
         maybe_serving_smoke()
         maybe_router_smoke()
+        maybe_trace_smoke()
         maybe_quant_smoke()
         maybe_elastic_smoke()
         maybe_pp_smoke()
@@ -679,6 +725,7 @@ def main() -> None:
             maybe_dp_overlap_smoke()
             maybe_serving_smoke()
             maybe_router_smoke()
+            maybe_trace_smoke()
             maybe_quant_smoke()
             maybe_elastic_smoke()
             maybe_pp_smoke()
