@@ -50,6 +50,15 @@ one pointer check on the hot paths):
   by ``stage=``/``microbatch=``: e.g. ``pipeline:hang@stage=1`` hangs
   stage 1 so the ladder escalates and the distress dump names the
   stage/microbatch).
+- ``migration`` — disagg KV page-transport faults at the offer/pull
+  choke points (``op=offer`` / ``op=pull``; ``victim=`` filters on the
+  SENDING replica id): ``drop`` (the payload is lost — offers never
+  land, pulls time out into the retry/backoff ladder), ``delay``
+  (sleep ``delay=`` s at the choke point), ``corrupt`` (flip payload
+  bytes so the CRC check rejects the pages at ingest), ``rank_dead``
+  (kill the sending replica mid-handoff through the rank-kill hook —
+  the lease/epoch fence must then reject its pages and the decode side
+  recomputes the prefill).
 
 Selectors: ``op=<name>`` (exact op / request name), ``rank=<int>``
 (filter on the *calling* rank), ``victim=<int>`` (which rank a
@@ -98,7 +107,7 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
 
 
 _SITES = ("collective", "store", "dispatch", "fetch", "save", "serving",
-          "replica", "pipeline")
+          "replica", "pipeline", "migration")
 # tpu-lint TPL009 cross-checks this table against the drill specs in the
 # test tree / smoke tools: adding a site:kind here without a drill that
 # fires it (or a drill naming a pair absent here) fails the lint gate.
@@ -111,6 +120,7 @@ _KINDS = {
     "serving": ("stall", "reject"),
     "replica": ("kill", "stall", "flap"),
     "pipeline": ("hang", "rank_dead"),
+    "migration": ("drop", "delay", "corrupt", "rank_dead"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
@@ -432,6 +442,27 @@ def _pipeline_hook(phase: str, stage: int, microbatch: int):
         _kill_victim(inj, stage, "pipeline")
 
 
+def _migration_hook(op: str, victim: Optional[int] = None):
+    """Called by the disagg page transport (serving/disagg.py) at its
+    ``offer`` / ``pull`` choke points, with the SENDING replica id as
+    the ``victim=`` filter. 'delay' sleeps in place; 'drop' and
+    'corrupt' are returned for the transport to apply (lose the payload
+    / flip its bytes so the ingest CRC trips); 'rank_dead' kills the
+    sending replica mid-handoff through the rank-kill hook — the
+    epoch/lease fence must then reject its in-flight pages."""
+    inj = _match("migration", op=op, victim=victim)
+    if inj is None:
+        return None
+    if inj.kind == "delay":
+        time.sleep(inj.delay)
+        return None
+    if inj.kind == "rank_dead":
+        _kill_victim(inj, victim if victim is not None else 0,
+                     "migration")
+        return None
+    return inj.kind
+
+
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
     process (the kill -9 atomicity drill); 'rank_dead' revokes the
@@ -468,6 +499,9 @@ def _install():
 
     serving_engine.set_chaos_hook(_serving_hook)
     serving_replica.set_chaos_hook(_replica_hook)
+    from ...inference.serving import disagg as serving_disagg
+
+    serving_disagg.set_chaos_hook(_migration_hook)
     from ..pipeline import runtime as pp_runtime
 
     pp_runtime.set_chaos_hook(_pipeline_hook)
@@ -490,6 +524,9 @@ def _uninstall():
 
     serving_engine.set_chaos_hook(None)
     serving_replica.set_chaos_hook(None)
+    from ...inference.serving import disagg as serving_disagg
+
+    serving_disagg.set_chaos_hook(None)
     from ..pipeline import runtime as pp_runtime
 
     pp_runtime.set_chaos_hook(None)

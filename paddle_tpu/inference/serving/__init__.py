@@ -14,12 +14,19 @@ Two engines and a fleet router share this package:
   (``replica.py``) — resilient multi-replica serving: health-checked
   circuit breakers over N identical engines, mid-stream failover with
   bit-exact replay confirmation, prefix-affinity routing, per-tenant
-  weighted fair admission, graceful drain.
+  weighted fair admission, graceful drain;
+- :class:`DisaggRouter` (``disagg.py``) — disaggregated prefill/decode
+  pools over the same replicas: lease-fenced cross-replica KV page
+  migration with recompute fallback, a fleet-global prefix index, and
+  an SLO autoscaler for the decode pool.
 
 All report SLO metrics through ``observability.summary()`` (sections
-``"serving"`` and ``"router"``).
+``"serving"``, ``"router"`` and ``"disagg"``).
 """
 from .block_manager import BlockManager, NoFreeBlocksError
+from .disagg import (DisaggRouter, FleetPrefixIndex, MigrationError,
+                     MigrationTimeout, PageCorruptError, PageTransport,
+                     PoolAutoscaler, StaleEpochError, parse_pools)
 from .engine import PagedServingEngine, TokenEvent
 from .replica import ReplicaDeadError, ReplicaHandle, ReplicaKilledError
 from .router import FailoverMismatchError, RouterRequest, ServingRouter
@@ -35,4 +42,7 @@ __all__ = [
     "Completion", "Request", "ServingEngine",
     "ServingRouter", "RouterRequest", "FailoverMismatchError",
     "ReplicaHandle", "ReplicaKilledError", "ReplicaDeadError",
+    "DisaggRouter", "PoolAutoscaler", "PageTransport", "FleetPrefixIndex",
+    "MigrationError", "MigrationTimeout", "StaleEpochError",
+    "PageCorruptError", "parse_pools",
 ]

@@ -78,7 +78,8 @@ class BlockManager:
         self._pending_copies: List[Tuple[int, int]] = []
         self.stats = {"allocs": 0, "frees": 0, "prefix_hit_blocks": 0,
                       "prefix_hit_tokens": 0, "cow_copies": 0,
-                      "cache_evictions": 0, "cow_purged": 0}
+                      "cache_evictions": 0, "cow_purged": 0,
+                      "adopted_pages": 0}
 
     # -- capacity ---------------------------------------------------------
     def num_free(self) -> int:
@@ -324,21 +325,102 @@ class BlockManager:
             self._decref(src)
         return out
 
+    def prefix_chain(self,
+                     tokens: Sequence[int]) -> List[Tuple[int, int]]:
+        """Content-address the full-block prefix chain of `tokens`
+        WITHOUT touching the pool: ``[(depth, chain_hash), ...]`` where
+        ``depth`` is the token count covered through each full block.
+
+        A pure function of the token list — sender, receiver and the
+        fleet prefix index all compute the SAME pairs, so cross-replica
+        page-pull requests can address pages content-wise without
+        shipping raw tokens or re-hashing on the remote side. (The hash
+        chains tuples of ints, which Python hashes deterministically —
+        PYTHONHASHSEED only perturbs str/bytes — so the pairs agree
+        across processes too.)"""
+        tokens = [int(t) for t in tokens]
+        bs = self.block_size
+        out: List[Tuple[int, int]] = []
+        prev_h, i = 0, 0
+        while i + bs <= len(tokens):
+            prev_h = _chain_hash(prev_h, tuple(tokens[i:i + bs]))
+            i += bs
+            out.append((i, prev_h))
+        return out
+
+    def _chain_live(self, chain_hash: int) -> Optional[int]:
+        """Block id serving `chain_hash` right now (referenced or parked
+        in the cached-free LRU), else None."""
+        blk = self._hash_to_block.get(chain_hash)
+        if blk is None or (blk not in self._refs
+                           and blk not in self._cached_free):
+            return None
+        return blk
+
     def lookup_prefix(self, tokens: Sequence[int]) -> int:
         """How many leading tokens of `tokens` the pool could serve from
         the prefix cache right now (full-block chain hits only), WITHOUT
         allocating — the router's prefix-affinity signal. Capped at
-        len(tokens)-1 like allocate_sequence's `cached`."""
+        len(tokens)-1 like allocate_sequence's `cached`. Thin wrapper
+        over :meth:`prefix_chain` + pool liveness."""
         tokens = [int(t) for t in tokens]
-        bs = self.block_size
-        prev_h, i, n = 0, 0, 0
-        while i + bs <= len(tokens):
-            h = _chain_hash(prev_h, tuple(tokens[i:i + bs]))
-            blk = self._hash_to_block.get(h)
-            if blk is None or (blk not in self._refs
-                               and blk not in self._cached_free):
+        n = 0
+        for depth, h in self.prefix_chain(tokens):
+            if self._chain_live(h) is None:
                 break
-            n += bs
-            prev_h = h
-            i += bs
+            n = depth
         return min(n, max(len(tokens) - 1, 0))
+
+    def chain_blocks(self,
+                     chain: Sequence[Tuple[int, int]]) -> Optional[List[int]]:
+        """Resolve a :meth:`prefix_chain` to live block ids, or None when
+        any link is missing (pages partially evicted — this pool cannot
+        serve the chain and a sender must decline the page pull)."""
+        out: List[int] = []
+        for _, h in chain:
+            blk = self._chain_live(h)
+            if blk is None:
+                return None
+            out.append(blk)
+        return out
+
+    def adopt_page(self, chain_hash: int, prev_hash: int,
+                   chunk: Sequence[int]) -> Optional[int]:
+        """Park an externally computed (migrated) full page in the prefix
+        cache: take a free block, register the chain hash, and leave it
+        in the cached-free LRU so the next ``allocate_sequence`` revives
+        it like any freed-but-cached page — and allocation pressure can
+        reclaim it (migrated pages are an optimization, never pinned
+        state). Returns the block id the caller must fill on device, or
+        None when the hash is already live here (nothing to write).
+        Raises NoFreeBlocksError when every block is referenced."""
+        if self._chain_live(chain_hash) is not None:
+            return None
+        blk = self._take_free()
+        self._drop_hash(blk)       # fresh-list blocks may carry no hash;
+        #                            reclaim path already dropped theirs
+        self._hash_to_block[chain_hash] = blk
+        self._block_hash[blk] = chain_hash
+        self._hash_info[chain_hash] = (
+            int(prev_hash), tuple(int(t) for t in chunk))
+        self._cached_free[blk] = None
+        self.stats["adopted_pages"] += 1
+        return blk
+
+    def evict_hashes(self, hashes: Sequence[int]) -> int:
+        """Drop prefix-cache entries by chain hash (migrated pages found
+        bad at confirm time): parked pages return to the raw free list;
+        pages still referenced by live sequences only lose their hash
+        (the data stays until their refs drain). Returns entries
+        dropped."""
+        n = 0
+        for h in list(hashes):
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                continue
+            self._drop_hash(blk)
+            if blk in self._cached_free:
+                del self._cached_free[blk]
+                self._free.append(blk)
+            n += 1
+        return n

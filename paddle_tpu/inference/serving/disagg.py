@@ -1,0 +1,855 @@
+"""Disaggregated prefill/decode serving: lease-fenced KV page migration.
+
+The MPMD separate-pools argument (PAPERS.md arXiv 2412.14374) applied to
+the serving router: prefill is compute-bound and decode is memory-bound,
+so a fleet split into a prefill-heavy pool and a decode-heavy pool beats
+the same replicas serving both phases. :class:`DisaggRouter` places every
+new stream on the prefill pool with a ONE-token budget; when that token
+lands (the client's TTFT), the prompt's full-block KV pages ship to a
+decode replica over the page transport and the stream continues there —
+the handoff rides the router's existing replay-and-confirm machinery, so
+the decode replica's regenerated first token is confirmed against what
+the client already saw and suppressed.
+
+**The failure ladder is the point.** Every transfer is stamped with a
+migration epoch ``(sender replica id, sender incarnation)`` derived from
+the sender's TTL lease; ingest re-checks the sender's lease/incarnation
+so a stale sender's pages are REJECTED, never silently adopted. Page
+pulls get a typed timeout with capped exponential-backoff retries
+(``paddle_migration_retries_total``). Any terminal failure — timeout,
+CRC corruption, stale epoch, dead sender, or a post-adopt confirm
+mismatch (a lossy ``int8`` wire can perturb the regenerated token) —
+degrades to the decode side *recomputing* the prefill from the prompt:
+per-sequence PRNG determinism makes the recompute bit-exact, so the
+client stream is identical either way, only slower. Sustained migration
+failure trips the route back to monolithic same-replica serving for a
+cooldown window instead of shedding.
+
+On top sit :class:`FleetPrefixIndex` — ``BlockManager.prefix_chain``
+rolling-hash chains lifted into a (TCPStore-backed) fleet-global index,
+so a prompt routes to wherever its prefix already lives — and
+:class:`PoolAutoscaler`, which grows/shrinks the decode pool from the
+aggregate TTFT / queue-shed-rate SLO view in ``fleet_summary()``,
+admitting fresh replicas through the same probation machinery a
+readmitted replica faces and retiring them through graceful drain.
+
+Wire format: the quant_comm layout. int8 pages + their f32 scale planes
+travel as-is; fp pages optionally encode through the block-scaled codec
+(``FLAGS_migration_wire_dtype=int8``, ~4x smaller, lossy — the confirm
+ladder above is what makes lossy safe). Chaos site ``migration``
+(drop / delay / corrupt / rank_dead) hooks the transport choke points.
+"""
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import flags
+from ...distributed import quant_comm as QC
+from ...observability import emit as _emit
+from ...observability import register_distress_section
+from .engine import TokenEvent
+from .replica import DEAD, DEGRADED, HEALTHY, ReplicaHandle
+from .router import RouterRequest, ServingRouter
+
+__all__ = ["DisaggRouter", "PageTransport", "FleetPrefixIndex",
+           "PoolAutoscaler", "MigrationError", "MigrationTimeout",
+           "StaleEpochError", "PageCorruptError", "parse_pools",
+           "pack_pages", "unpack_pages"]
+
+flags.define_flag("router_pools", "",
+                  "Disagg fleet split, e.g. 'prefill=1,decode=2'; empty "
+                  "serves monolithic (every replica runs both phases)")
+flags.define_flag("migration_timeout_s", 0.2,
+                  "Per-attempt timeout for a migration page pull before "
+                  "it counts as failed (typed MigrationTimeout)")
+flags.define_flag("migration_retries", 3,
+                  "Page-pull retry attempts after the first failure "
+                  "(capped exponential backoff between attempts)")
+flags.define_flag("migration_backoff_s", 0.01,
+                  "Base backoff between page-pull retries; doubles per "
+                  "attempt, capped at 1s")
+flags.define_flag("migration_wire_dtype", "",
+                  "Page payload wire encoding: '' ships the cache dtype "
+                  "raw (int8 caches are already compact); 'int8' runs fp "
+                  "pages through the quant_comm block-scaled codec "
+                  "(~4x smaller, lossy — a confirm mismatch falls back "
+                  "to recompute, so correctness is unaffected)")
+flags.define_flag("migration_monolithic_after", 3,
+                  "Consecutive migration failures before the router "
+                  "trips back to monolithic same-replica serving")
+flags.define_flag("migration_monolithic_cooldown_s", 30.0,
+                  "How long a monolithic trip lasts before disaggregated "
+                  "handoffs are attempted again")
+flags.define_flag("autoscale_ttft_p99_s", 0.0,
+                  "SLO autoscaler: grow the decode pool when fleet TTFT "
+                  "p99 exceeds this (0 disables the TTFT rule)")
+flags.define_flag("autoscale_shed_rate", 0.05,
+                  "SLO autoscaler: grow the decode pool when the fleet "
+                  "queue-shed rate exceeds this (deadline expiries do "
+                  "NOT count — more replicas don't relax a deadline)")
+flags.define_flag("autoscale_min_decode", 1,
+                  "Decode-pool floor the autoscaler never shrinks below")
+flags.define_flag("autoscale_max_decode", 4,
+                  "Decode-pool ceiling the autoscaler never grows past")
+flags.define_flag("autoscale_cooldown_s", 5.0,
+                  "Minimum seconds between autoscaler decisions")
+
+# chaos harness hook (site "migration"): installed by
+# distributed/fault_tolerance/chaos.py while a spec is active. Called as
+# hook(op, victim) with op in ("offer", "pull") and the SENDING replica
+# id; may sleep (delay), kill the sender (rank_dead), or return
+# "drop"/"corrupt" for the transport to apply.
+_CHAOS_HOOK = [None]
+
+
+def set_chaos_hook(fn):
+    _CHAOS_HOOK[0] = fn
+
+
+class MigrationError(RuntimeError):
+    """Base of the page-migration failure family (every member degrades
+    to decode-side recompute, never to a dropped stream)."""
+
+
+class MigrationTimeout(MigrationError, TimeoutError):
+    """A page pull exhausted its per-attempt timeout."""
+
+
+class StaleEpochError(MigrationError):
+    """The payload's migration epoch no longer matches a live sender
+    lease — the pages were computed by an engine that has since died
+    (or been reincarnated) and must not be adopted."""
+
+
+class PageCorruptError(MigrationError):
+    """The payload failed its CRC (or did not parse) at ingest."""
+
+
+def parse_pools(spec: str) -> Optional[Dict[str, int]]:
+    """``'prefill=1,decode=2' -> {'prefill': 1, 'decode': 2}``; empty ->
+    None (monolithic fleet). Both pools must be present and positive."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        name, sep, val = part.partition("=")
+        name = name.strip()
+        if not sep or name not in ("prefill", "decode"):
+            raise ValueError(
+                f"FLAGS_router_pools entry {part!r}: want "
+                f"'prefill=<n>,decode=<n>'")
+        out[name] = int(val)
+        if out[name] < 1:
+            raise ValueError(
+                f"FLAGS_router_pools: pool {name!r} must be >= 1")
+    if set(out) != {"prefill", "decode"}:
+        raise ValueError(
+            f"FLAGS_router_pools={spec!r}: both pools required")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Page payload wire codec (quant_comm layout + CRC + epoch header)
+# ---------------------------------------------------------------------------
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_pages(pages: Dict[str, Any], epoch: Sequence[int],
+               wire: str = "") -> bytes:
+    """Serialize an ``engine.extract_pages`` payload: one JSON header
+    line (version, epoch stamp, chain, CRC, field table) + the raw
+    array bytes. ``wire='int8'`` runs floating K/V planes through the
+    quant_comm block-scaled codec; int8-native pages and f32 scale
+    planes always travel as-is."""
+    fields: List[List[Any]] = []
+    body = b""
+    wire_used = "raw"
+    for name in ("k", "v", "kdq", "vdq"):
+        if name not in pages:
+            continue
+        a = np.asarray(pages[name])
+        if wire == "int8" and name in ("k", "v") and a.dtype.kind == "f":
+            flat = np.asarray(a, np.float32).reshape(-1)
+            block = QC.block_size()
+            qpadded, nblocks, _ = QC.wire_layout(flat.size, block)
+            padded = np.zeros((qpadded,), np.float32)
+            padded[:flat.size] = flat
+            w = np.asarray(QC.encode_flat(jnp.asarray(padded), block)[0])
+            fields.append([name, "q8", list(a.shape), a.dtype.name,
+                           int(w.size), nblocks, block, int(flat.size)])
+            body += w.tobytes()
+            wire_used = "int8"
+        else:
+            fields.append([name, "raw", list(a.shape), a.dtype.name,
+                           int(a.nbytes), 0, 0, 0])
+            body += a.tobytes()
+    header = {"v": 1, "epoch": [int(e) for e in epoch],
+              "chain": [[int(d), int(h)] for d, h in pages["chain"]],
+              "tokens": [int(t) for t in pages["tokens"]],
+              "dtype": pages["dtype"], "wire": wire_used,
+              "fields": fields, "crc": zlib.crc32(body) & 0xFFFFFFFF}
+    return json.dumps(header).encode("utf-8") + b"\n" + body
+
+
+def unpack_pages(blob: bytes) -> Tuple[Dict[str, Any], Tuple[int, ...]]:
+    """Inverse of :func:`pack_pages`: ``(payload for ingest_pages,
+    epoch)``. Raises :class:`PageCorruptError` on CRC/parse failure —
+    the typed signal the failure ladder maps to a recompute."""
+    head, sep, body = bytes(blob).partition(b"\n")
+    if not sep:
+        raise PageCorruptError("migration payload truncated (no header)")
+    try:
+        header = json.loads(head.decode("utf-8"))
+    except Exception as e:
+        raise PageCorruptError(
+            f"migration header does not parse: {e}") from e
+    if zlib.crc32(body) & 0xFFFFFFFF != header.get("crc"):
+        raise PageCorruptError(
+            "migration payload CRC mismatch: pages rejected at ingest")
+    out: Dict[str, Any] = {
+        "chain": [(int(d), int(h)) for d, h in header["chain"]],
+        "tokens": [int(t) for t in header["tokens"]],
+        "dtype": header["dtype"],
+    }
+    offset = 0
+    for name, enc, shape, dtype, size, nblocks, block, numel \
+            in header["fields"]:
+        if enc == "q8":
+            w = np.frombuffer(body, np.int8, count=size, offset=offset)
+            offset += size
+            flat = np.asarray(QC.decode_flat(jnp.asarray(w),
+                                             nblocks, block))[:numel]
+            out[name] = flat.reshape(shape).astype(_np_dtype(dtype))
+        else:
+            dt = _np_dtype(dtype)
+            count = int(np.prod(shape)) if shape else 1
+            out[name] = np.frombuffer(
+                body, dt, count=count, offset=offset).reshape(shape)
+            offset += size
+    return out, tuple(int(e) for e in header["epoch"])
+
+
+def _flip_tail(blob: bytes) -> bytes:
+    """Chaos 'corrupt': flip the final payload byte — the header still
+    parses, the CRC check trips (how real bit-rot surfaces)."""
+    if not blob:
+        return blob
+    return blob[:-1] + bytes([blob[-1] ^ 0xFF])
+
+
+# ---------------------------------------------------------------------------
+# Page transport
+# ---------------------------------------------------------------------------
+
+class PageTransport:
+    """Content-keyed page plane: ``offer(key, blob)`` / ``pull(key)``
+    over a TCPStore when the fleet spans processes, or an in-process
+    dict for the single-process multi-replica router (the same
+    fleet-of-one degrade ``fleet_summary`` makes). The chaos
+    ``migration`` site hooks both verbs."""
+
+    def __init__(self, store=None):
+        self.store = store
+        self._local: Dict[str, bytes] = {}
+        self.stats = {"offers": 0, "pulls": 0, "dropped": 0,
+                      "corrupted": 0}
+
+    def offer(self, key: str, blob: bytes,
+              victim: Optional[int] = None) -> bool:
+        """Publish a payload; False when a chaos drop ate it (the pull
+        side will time out into the retry/fallback ladder)."""
+        hook = _CHAOS_HOOK[0]
+        fault = hook("offer", victim) if hook is not None else None
+        if fault == "drop":
+            self.stats["dropped"] += 1
+            return False
+        if fault == "corrupt":
+            blob = _flip_tail(blob)
+            self.stats["corrupted"] += 1
+        if self.store is not None:
+            self.store.set(key, blob)
+        else:
+            self._local[key] = bytes(blob)
+        self.stats["offers"] += 1
+        return True
+
+    def pull_once(self, key: str, timeout_s: float,
+                  victim: Optional[int] = None) -> bytes:
+        """One pull attempt; raises :class:`MigrationTimeout` when the
+        payload is absent past ``timeout_s`` (the caller owns retries
+        and backoff)."""
+        hook = _CHAOS_HOOK[0]
+        fault = hook("pull", victim) if hook is not None else None
+        if fault == "drop":
+            raise MigrationTimeout(
+                f"migration pull dropped (chaos): {key}")
+        blob: Optional[bytes] = None
+        if self.store is not None:
+            deadline = time.monotonic() + max(timeout_s, 0.0)
+            while True:
+                try:
+                    if self.store.check(key):
+                        blob = self.store.get(key)
+                        break
+                except Exception:
+                    pass
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.005)
+        else:
+            blob = self._local.get(key)
+        if blob is None:
+            raise MigrationTimeout(
+                f"migration pull timed out after {timeout_s}s: {key}")
+        if fault == "corrupt":
+            blob = _flip_tail(blob)
+            self.stats["corrupted"] += 1
+        self.stats["pulls"] += 1
+        return blob
+
+    def forget(self, key: str):
+        self._local.pop(key, None)
+        if self.store is not None:
+            try:
+                self.store.delete_key(key)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Fleet-global prefix index
+# ---------------------------------------------------------------------------
+
+class FleetPrefixIndex:
+    """``chain_hash -> depth`` per replica: ``BlockManager``'s rolling-
+    hash prefix cache lifted fleet-global, so placement can route a
+    prompt to wherever its prefix already lives (locally the block
+    manager answers directly; the index is what a remote replica's
+    pages look like from here). Store-backed when a TCPStore is given
+    (per-replica JSON keys, last write wins), in-process otherwise."""
+
+    KEY = "paddle_disagg/prefix"
+
+    def __init__(self, store=None, cap: int = 4096):
+        self.store = store
+        self.cap = int(cap)
+        self._local: Dict[int, Dict[int, int]] = {}
+
+    def publish(self, replica_id: int,
+                chain: Sequence[Tuple[int, int]]):
+        m = self._local.setdefault(int(replica_id), {})
+        for depth, h in chain:
+            m[int(h)] = int(depth)
+        while len(m) > self.cap:          # FIFO bound, oldest claims out
+            m.pop(next(iter(m)))
+        if self.store is not None:
+            self.store.set(f"{self.KEY}/{int(replica_id)}",
+                           json.dumps([[h, d] for h, d in m.items()]))
+
+    def drop(self, replica_id: int):
+        self._local.pop(int(replica_id), None)
+        if self.store is not None:
+            try:
+                self.store.delete_key(f"{self.KEY}/{int(replica_id)}")
+            except Exception:
+                pass
+
+    def _view(self, replica_id: int) -> Dict[int, int]:
+        if self.store is not None:
+            try:
+                key = f"{self.KEY}/{int(replica_id)}"
+                if self.store.check(key):
+                    raw = self.store.get(key)
+                    return {int(h): int(d) for h, d in json.loads(
+                        raw if isinstance(raw, str)
+                        else raw.decode("utf-8"))}
+            except Exception:
+                pass
+        return self._local.get(int(replica_id), {})
+
+    def depth(self, replica_id: int,
+              chain: Sequence[Tuple[int, int]]) -> int:
+        """Deepest contiguous prefix of `chain` this replica has
+        published (0 = no claim)."""
+        m = self._view(replica_id)
+        best = 0
+        for d, h in chain:
+            if m.get(int(h)) is None:
+                break
+            best = int(d)
+        return best
+
+
+# ---------------------------------------------------------------------------
+# SLO autoscaler
+# ---------------------------------------------------------------------------
+
+class PoolAutoscaler:
+    """Grow/shrink the decode pool from the ``fleet_summary()`` SLO
+    digest. Grow when TTFT p99 or the QUEUE-shed rate breaches target;
+    shrink when comfortably below both. Deadline-expiry pressure is
+    surfaced in every decision emit but is never a grow signal: the
+    split ``fleet_summary`` fields exist so "queue too deep" (buy more
+    replicas) and "deadlines too tight" (no pool size helps) stay
+    distinguishable."""
+
+    def __init__(self, router: "DisaggRouter",
+                 ttft_p99_s: Optional[float] = None,
+                 shed_rate: Optional[float] = None,
+                 min_decode: Optional[int] = None,
+                 max_decode: Optional[int] = None,
+                 cooldown_s: Optional[float] = None):
+        def fl(v, name):
+            return v if v is not None else flags.flag_value(name)
+        self.router = router
+        self.ttft_p99_s = float(fl(ttft_p99_s, "autoscale_ttft_p99_s"))
+        self.shed_rate = float(fl(shed_rate, "autoscale_shed_rate"))
+        self.min_decode = int(fl(min_decode, "autoscale_min_decode"))
+        self.max_decode = int(fl(max_decode, "autoscale_max_decode"))
+        self.cooldown_s = float(fl(cooldown_s, "autoscale_cooldown_s"))
+        self._last = 0.0
+        self.stats = {"grows": 0, "shrinks": 0, "holds": 0}
+
+    def tick(self, summary: Optional[dict] = None,
+             now: Optional[float] = None) -> Optional[str]:
+        """One decision: 'grow' / 'shrink' / 'hold' (None while inside
+        the cooldown window). ``summary`` defaults to the local
+        ``fleet_summary()`` — a fleet of one."""
+        now = time.monotonic() if now is None else now
+        if now - self._last < self.cooldown_s:
+            return None
+        self._last = now
+        if summary is None:
+            from ...observability import fleet
+            summary = fleet.fleet_summary()
+        pool = self.router.decode_pool_size()
+        ttft = float(summary.get("ttft_p99_s", 0.0))
+        shed_q = float(summary.get("shed_queue_rate",
+                                   summary.get("shed_rate", 0.0)))
+        deadline = int(summary.get("deadline_expired", 0))
+        breach = ((self.ttft_p99_s > 0 and ttft > self.ttft_p99_s)
+                  or (self.shed_rate > 0 and shed_q > self.shed_rate))
+        if breach and pool < self.max_decode:
+            self.router.grow_decode()
+            self.stats["grows"] += 1
+            decision = "grow"
+        elif (not breach and pool > self.min_decode and shed_q == 0.0
+              and (self.ttft_p99_s <= 0
+                   or ttft < 0.5 * self.ttft_p99_s)):
+            self.router.shrink_decode()
+            self.stats["shrinks"] += 1
+            decision = "shrink"
+        else:
+            self.stats["holds"] += 1
+            decision = "hold"
+        _emit("autoscale.decision", direction=decision,
+              pool=self.router.decode_pool_size(), ttft_p99_s=ttft,
+              shed_queue_rate=shed_q, deadline_expired=deadline)
+        return decision
+
+
+# ---------------------------------------------------------------------------
+# The disaggregated router
+# ---------------------------------------------------------------------------
+
+class DisaggRouter(ServingRouter):
+    """:class:`ServingRouter` with prefill/decode pools and lease-fenced
+    KV page migration::
+
+        router = DisaggRouter(factory, pools="prefill=1,decode=1")
+        rid = router.submit(prompt, max_new_tokens=16)
+        for tok in router.stream(rid):   # TTFT from the prefill pool,
+            ...                          # the rest from the decode pool
+
+    ``pools=None`` reads ``FLAGS_router_pools``; an empty spec serves
+    monolithic (identical to the base router). ``num_replicas`` is
+    derived from the pool spec when one is set.
+    """
+
+    def __init__(self, engine_factory, pools: Optional[str] = None,
+                 store=None, autoscale: bool = False, **kw):
+        spec = (pools if pools is not None
+                else str(flags.flag_value("router_pools") or ""))
+        self.pools = parse_pools(spec)
+        if self.pools is not None:
+            kw.setdefault("num_replicas",
+                          self.pools["prefill"] + self.pools["decode"])
+        super().__init__(engine_factory, **kw)
+        if self.pools is not None:
+            for i, h in enumerate(self.replicas):
+                h.role = ("prefill" if i < self.pools["prefill"]
+                          else "decode")
+        self.transport = PageTransport(store)
+        self.prefix_index = FleetPrefixIndex(store)
+        # rid -> handoff state: phase ("prefill"/"decode"), src replica,
+        # epoch, transport key, chain, outcome
+        self._handoffs: Dict[int, Dict[str, Any]] = {}
+        self._mig_failures = 0          # consecutive; trips monolithic
+        self._monolithic_until = 0.0
+        self.disagg_stats = {"handoffs": 0, "handoffs_ok": 0,
+                             "handoffs_local": 0, "fallbacks": 0,
+                             "retries": 0, "pages_shipped": 0,
+                             "re_pulls": 0, "monolithic_trips": 0}
+        self.autoscaler = PoolAutoscaler(self) if autoscale else None
+        # chaos migration:rank_dead kills the SENDING replica through the
+        # fleet rank-kill hook; chain non-migration sites to the previous
+        # installee (the elastic runtime's pattern)
+        from ...distributed.fault_tolerance import chaos as _chaos
+        self._prev_kill_hook = _chaos.set_rank_kill_hook(
+            self._chaos_rank_kill)
+        register_distress_section("disagg", self.disagg_snapshot)
+
+    # -- pools -------------------------------------------------------------
+    def pool(self, role: str) -> List[ReplicaHandle]:
+        return [h for h in self.replicas if h.role == role]
+
+    def decode_pool_size(self) -> int:
+        """Accepting decode replicas (the autoscaler's sizing view)."""
+        return sum(1 for h in self.replicas
+                   if h.role == "decode" and h.state in (HEALTHY,
+                                                         DEGRADED))
+
+    def _monolithic_active(self) -> bool:
+        return time.monotonic() < self._monolithic_until
+
+    def grow_decode(self) -> int:
+        """Autoscaler grow: a fresh decode replica admitted on probation
+        (DEGRADED until its first good step, one strike kills it)."""
+        h = ReplicaHandle(len(self.replicas), self.engine_factory,
+                          role="decode", **self.replica_kw)
+        h.begin_probation()
+        self.replicas.append(h)
+        self._assigned[h.replica_id] = {}
+        return h.replica_id
+
+    def shrink_decode(self) -> Optional[int]:
+        """Autoscaler shrink: gracefully drain the least-loaded active
+        decode replica (DRAINED replicas stay in place retired — list
+        positions are stable ids)."""
+        cands = [h for h in self.replicas
+                 if h.role == "decode" and h.state in (HEALTHY,
+                                                       DEGRADED)]
+        if len(cands) <= 1:
+            return None
+
+        def load(h):
+            return (h.engine.scheduler.queue_depth()
+                    + h.engine.scheduler.num_running()
+                    if h.engine is not None else 0)
+
+        victim = min(cands, key=lambda h: (load(h), -h.replica_id))
+        self.drain(victim.replica_id)
+        return victim.replica_id
+
+    def _chaos_rank_kill(self, victim: int, site: str):
+        if site == "migration":
+            if 0 <= int(victim) < len(self.replicas):
+                h = self.replicas[int(victim)]
+                if h.state != DEAD:
+                    h._kill("chaos_migration_rank_dead")
+            return
+        if self._prev_kill_hook is not None:
+            self._prev_kill_hook(victim, site)
+
+    # -- placement hooks ---------------------------------------------------
+    def _request_chain(self,
+                       req: RouterRequest) -> List[Tuple[int, int]]:
+        probe = next((h.engine for h in self.replicas
+                      if h.engine is not None), None)
+        if probe is None:
+            return []
+        return probe.blocks.prefix_chain(req.prompt)
+
+    def _placement_candidates(self, req):
+        base = super()._placement_candidates(req)
+        if self.pools is None:
+            return base
+        hs = self._handoffs.get(req.rid)
+        if hs is None:
+            if req.max_new_tokens <= 1 or self._monolithic_active():
+                return base        # same-replica serving, no handoff
+            hs = self._handoffs[req.rid] = {"phase": "prefill"}
+        role = "prefill" if hs["phase"] == "prefill" else "decode"
+        pool = [h for h in base if h.role == role]
+        # a wiped-out pool degrades to any accepting replica — serving
+        # beats purity (a same-replica handoff short-circuits anyway)
+        return pool or base
+
+    def _prefix_signal(self, req, h):
+        local = super()._prefix_signal(req, h)
+        if self.pools is None:
+            return local
+        claimed = self.prefix_index.depth(h.replica_id,
+                                          self._request_chain(req))
+        return max(local, min(claimed, max(len(req.prompt) - 1, 0)))
+
+    def _submit_budget(self, req):
+        hs = self._handoffs.get(req.rid)
+        if hs is not None and hs["phase"] == "prefill":
+            return 1               # prefill pool computes TTFT, no more
+        return req.max_new_tokens
+
+    def _prepare_submit(self, req, h):
+        hs = self._handoffs.get(req.rid)
+        if (hs is None or hs["phase"] != "decode" or hs.get("done")
+                or hs.get("src") is None):
+            return
+        self._migrate(req, hs, h)
+
+    # -- the handoff -------------------------------------------------------
+    def _process_event(self, h, amap, req, ev):
+        hs = self._handoffs.get(req.rid)
+        if (hs is not None and hs["phase"] == "prefill" and ev.finished
+                and ev.reason == "length" and ev.token >= 0
+                and not req.confirming()):
+            # prefill complete: the client sees its first token now
+            # (TTFT); the stream does NOT finish — it hands off
+            req.emitted.append(ev.token)
+            req.events.append(TokenEvent(req.rid, ev.token, False, None))
+            amap.pop(ev.rid, None)
+            self._begin_handoff(req, h, hs)
+            return
+        if (hs is not None and hs["phase"] == "decode"
+                and hs.get("done") == "pulled" and req.confirming()
+                and ev.token >= 0 and not ev.finished
+                and ev.token != req.emitted[req.confirmed]):
+            # a confirm mismatch on MIGRATED pages is a migration
+            # failure (lossy wire, bad page), not a determinism
+            # violation: evict the adopted pages and recompute
+            self._mismatch_fallback(req, h, amap, ev, hs)
+            return
+        super()._process_event(h, amap, req, ev)
+
+    def _begin_handoff(self, req: RouterRequest, src: ReplicaHandle,
+                       hs: Dict[str, Any]):
+        req.replica = None
+        req.engine_rid = None
+        req.confirm_target = len(req.emitted)   # decode replays token 1
+        req.confirmed = 0
+        req.status = "waiting"
+        hs["phase"] = "decode"
+        hs["src"] = src.replica_id
+        hs["epoch"] = (src.replica_id, src.incarnation)
+        hs["started"] = time.monotonic()
+        self.disagg_stats["handoffs"] += 1
+        chain = (src.engine.blocks.prefix_chain(req.prompt)
+                 if src.engine is not None else [])
+        hs["chain"] = chain
+        if chain:
+            hs["key"] = (f"paddle_disagg/pages/{src.replica_id}/"
+                         f"{src.incarnation}/"
+                         f"{chain[-1][1] & 0xFFFFFFFFFFFFFFFF:x}")
+            pages = src.engine.extract_pages(req.prompt)
+            if pages is not None:
+                wire = str(flags.flag_value("migration_wire_dtype")
+                           or "")
+                blob = pack_pages(pages, hs["epoch"], wire)
+                self.transport.offer(hs["key"], blob,
+                                     victim=src.replica_id)
+                self.disagg_stats["pages_shipped"] += len(chain)
+                _emit("migration.pages", pages=len(chain),
+                      bytes=len(blob),
+                      wire="int8" if (wire == "int8"
+                                      and pages["dtype"] != "int8")
+                      else "raw", rid=req.rid)
+                self.prefix_index.publish(src.replica_id, chain)
+        # the SENDER may have been killed by a chaos rank_dead riding the
+        # offer itself — the epoch check at pull time catches it
+        self._pending.setdefault(req.tenant, deque()).appendleft(req)
+
+    def _check_epoch(self, hs: Dict[str, Any]):
+        src_id, src_inc = hs["epoch"]
+        src = self.replicas[src_id]
+        if (src.state == DEAD or src.incarnation != src_inc
+                or not src.lease_live()):
+            raise StaleEpochError(
+                f"sender replica {src_id} epoch {src_inc} is stale "
+                f"(state={src.state}, incarnation={src.incarnation}, "
+                f"lease_live={src.lease_live()}): pages rejected at "
+                f"ingest")
+
+    def _migrate(self, req: RouterRequest, hs: Dict[str, Any],
+                 dst: ReplicaHandle):
+        hs["dst"] = dst.replica_id
+        if hs["src"] == dst.replica_id:
+            # the pages already live here — nothing crosses the wire
+            hs["done"] = "local"
+            self.disagg_stats["handoffs_local"] += 1
+            self._mig_failures = 0
+            _emit("migration.handoff", result="local", rid=req.rid,
+                  src=hs["src"], dst=dst.replica_id)
+            return
+        if not hs.get("chain") or not hs.get("key"):
+            self._fallback(req, hs, "no_pages")
+            return
+        timeout = float(flags.flag_value("migration_timeout_s"))
+        retries = int(flags.flag_value("migration_retries"))
+        backoff = float(flags.flag_value("migration_backoff_s"))
+        repull = bool(hs.pop("repull", False))
+        last: Optional[Exception] = None
+        for attempt in range(retries + 1):
+            try:
+                # both leases fence the transfer: the SENDER must still
+                # be the live engine that computed the pages, and the
+                # RECEIVER must itself hold a live lease (a replica
+                # about to be declared dead must not adopt state)
+                self._check_epoch(hs)
+                if dst.state == DEAD or not dst.lease_live():
+                    raise StaleEpochError(
+                        f"receiver replica {dst.replica_id} lease is "
+                        f"not live: refusing to adopt pages")
+                blob = self.transport.pull_once(hs["key"], timeout,
+                                               victim=hs["src"])
+                payload, epoch = unpack_pages(blob)
+                if tuple(epoch) != tuple(hs["epoch"]):
+                    raise StaleEpochError(
+                        f"payload epoch {tuple(epoch)} != expected "
+                        f"{tuple(hs['epoch'])}: stale sender")
+                self._check_epoch(hs)   # died between offer and ingest
+                n = dst.engine.ingest_pages(payload)
+                hs["done"] = "pulled"
+                hs["pages"] = n
+                self._mig_failures = 0
+                self.disagg_stats["handoffs_ok"] += 1
+                if repull:
+                    self.disagg_stats["re_pulls"] += 1
+                self.prefix_index.publish(dst.replica_id, hs["chain"])
+                _emit("migration.handoff", result="ok", rid=req.rid,
+                      src=hs["src"], dst=dst.replica_id, pages=n,
+                      dur_s=time.monotonic() - hs["started"])
+                return
+            except MigrationTimeout as e:
+                last = e
+                if attempt < retries:
+                    self.disagg_stats["retries"] += 1
+                    _emit("migration.retry", rid=req.rid,
+                          attempt=attempt, src=hs["src"],
+                          dst=dst.replica_id)
+                    time.sleep(min(backoff * (2 ** attempt), 1.0))
+                continue
+            except (StaleEpochError, PageCorruptError, ValueError) as e:
+                last = e            # not retryable: stale/bad payload
+                break
+        reason = {MigrationTimeout: "timeout",
+                  StaleEpochError: "stale_epoch",
+                  PageCorruptError: "corrupt",
+                  ValueError: "bad_payload"}.get(type(last), "error")
+        self._fallback(req, hs, reason)
+
+    def _fallback(self, req: RouterRequest, hs: Dict[str, Any],
+                  reason: str):
+        """Degrade to decode-side recompute: the submit proceeds with no
+        adopted pages, the engine re-prefills from the prompt, and
+        per-seq determinism replays the streamed token bit-exactly."""
+        hs["done"] = "fallback"
+        hs["fallback_reason"] = reason
+        self.disagg_stats["fallbacks"] += 1
+        self._note_failure()
+        _emit("migration.fallback", tenant=req.tenant, rid=req.rid,
+              reason=reason, src=hs.get("src"), dst=hs.get("dst"))
+        _emit("migration.handoff", result="fallback", rid=req.rid,
+              src=hs.get("src"), dst=hs.get("dst"))
+
+    def _note_failure(self):
+        self._mig_failures += 1
+        trip_after = int(flags.flag_value("migration_monolithic_after"))
+        if trip_after > 0 and self._mig_failures >= trip_after:
+            cooldown = float(
+                flags.flag_value("migration_monolithic_cooldown_s"))
+            self._monolithic_until = time.monotonic() + cooldown
+            self._mig_failures = 0
+            self.disagg_stats["monolithic_trips"] += 1
+            _emit("migration.monolithic", cooldown_s=cooldown)
+
+    def _mismatch_fallback(self, req: RouterRequest, h: ReplicaHandle,
+                           amap, ev, hs: Dict[str, Any]):
+        amap.pop(ev.rid, None)
+        if h.engine is not None:
+            h.engine.cancel(ev.rid)
+            # the adopted chain produced a wrong token: drop those pages
+            # so the recompute (here or anywhere) cannot re-hit them
+            h.engine.blocks.evict_hashes(
+                [ch for _, ch in hs.get("chain", [])])
+        req.replica = None
+        req.engine_rid = None
+        req.confirmed = 0
+        req.status = "waiting"
+        self._fallback(req, hs, "mismatch")
+        self._pending.setdefault(req.tenant, deque()).appendleft(req)
+
+    # -- router tick / failover integration --------------------------------
+    def step(self) -> int:
+        # out-of-band deaths (chaos migration:rank_dead kills a replica
+        # between ticks): fail its streams over BEFORE probation readmit
+        # could hand the id a fresh engine with orphaned assignments
+        for h in self.replicas:
+            if h.state == DEAD and self._assigned[h.replica_id]:
+                self._failover(h)
+        progress = super().step()
+        if self.autoscaler is not None:
+            self.autoscaler.tick()
+        return progress
+
+    def _failover(self, h):
+        for req in list(self._assigned[h.replica_id].values()):
+            hs = self._handoffs.get(req.rid)
+            if (hs is not None and hs["phase"] == "decode"
+                    and hs.get("done")):
+                # the decode replica died mid-decode: re-pull the pages
+                # on the survivor if the offer is still live, else the
+                # epoch/timeout ladder lands on recompute
+                hs["done"] = None
+                hs["repull"] = True
+        self.prefix_index.drop(h.replica_id)
+        super()._failover(h)
+
+    def _finish(self, req, reason, terminal_logged: bool = False):
+        hs = self._handoffs.pop(req.rid, None)
+        if hs is not None and hs.get("key"):
+            # drop the offered payload unless another in-flight handoff
+            # (same prompt content, same sender) still needs it
+            if not any(o.get("key") == hs["key"]
+                       for o in self._handoffs.values()):
+                self.transport.forget(hs["key"])
+        super()._finish(req, reason, terminal_logged)
+
+    # -- introspection -----------------------------------------------------
+    def disagg_snapshot(self) -> Dict[str, Any]:
+        """In-flight handoffs + pool picture, registered as the
+        'disagg' distress section (rendered next to the router's
+        membership snapshot)."""
+        now = time.monotonic()
+        return {
+            "pools": {role: [h.replica_id for h in self.pool(role)]
+                      for role in ("prefill", "decode", "any")
+                      if self.pool(role)},
+            "decode_pool_accepting": self.decode_pool_size(),
+            "monolithic_for_s": round(
+                max(self._monolithic_until - now, 0.0), 3),
+            "consecutive_failures": self._mig_failures,
+            "in_flight_handoffs": {
+                str(rid): {"phase": hs.get("phase"),
+                           "src": hs.get("src"),
+                           "dst": hs.get("dst"),
+                           "done": hs.get("done"),
+                           "epoch": list(hs.get("epoch", ())),
+                           "age_s": round(now - hs["started"], 3)
+                           if "started" in hs else None}
+                for rid, hs in self._handoffs.items()},
+            "transport": dict(self.transport.stats),
+            **self.disagg_stats,
+        }

@@ -337,6 +337,90 @@ class PagedServingEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_work()
 
+    # -- cross-replica page migration (serving/disagg.py) ------------------
+    def extract_pages(self, tokens) -> Optional[Dict[str, Any]]:
+        """Host-side export of the full-block prefix pages covering
+        `tokens`: the KV handoff payload a prefill replica ships to a
+        decode replica (disagg.py packs it onto the wire). Returns None
+        when this pool cannot serve the complete chain (never a partial
+        payload — the receiver recomputes instead). Quantized engines
+        export int8 pages plus their per-page dequant scale rows."""
+        chain = self.blocks.prefix_chain(tokens)
+        if not chain:
+            return None
+        blks = self.blocks.chain_blocks(chain)
+        if blks is None:
+            return None
+        ids = jnp.asarray(np.asarray(blks, np.int32))
+        out: Dict[str, Any] = {
+            "chain": [(int(d), int(h)) for d, h in chain],
+            "tokens": [int(t) for t in tokens][:chain[-1][0]],
+            "dtype": np.dtype(self.cache_dtype).name,
+            "k": np.asarray(jnp.take(self._key_cache, ids, axis=1)),
+            "v": np.asarray(jnp.take(self._value_cache, ids, axis=1)),
+        }
+        if self.quant_kv:
+            out["kdq"] = np.asarray(
+                jnp.take(self._kv_scales[2], ids, axis=1))
+            out["vdq"] = np.asarray(
+                jnp.take(self._kv_scales[3], ids, axis=1))
+        return out
+
+    def ingest_pages(self, payload: Dict[str, Any]) -> int:
+        """Adopt migrated KV pages into this engine's pool and device
+        caches. The pages park in the prefix cache exactly like locally
+        computed freed-but-cached blocks, so the next
+        ``allocate_sequence`` over the same prompt hits them — no new
+        executable shapes, only eager page writes (the zero-retrace pin
+        holds). Returns pages adopted (0 = all already present). Raises
+        ValueError on cache-geometry/dtype mismatch (heterogeneous
+        pools must recompute, not adopt)."""
+        if payload["dtype"] != np.dtype(self.cache_dtype).name:
+            raise ValueError(
+                f"migrated pages are {payload['dtype']} but this engine "
+                f"caches {np.dtype(self.cache_dtype).name}: recompute "
+                f"instead of adopting across cache dtypes")
+        k, v = payload["k"], payload["v"]
+        L, _, kvh, bs, hd = self._key_cache.shape
+        want = (L, kvh, bs, hd)
+        got = (k.shape[0],) + tuple(k.shape[2:])
+        if got != want or k.shape != v.shape:
+            raise ValueError(
+                f"migrated page geometry {got} != engine cache {want}: "
+                f"pools must share [L, KV, block_size, hd] to adopt pages")
+        chain = payload["chain"]
+        toks = payload["tokens"]
+        adopted: List[Tuple[int, int]] = []   # (payload row, block id)
+        for idx, (depth, h) in enumerate(chain):
+            prev_h = 0 if idx == 0 else int(chain[idx - 1][1])
+            chunk = toks[depth - self.block_size:depth]
+            try:
+                blk = self.blocks.adopt_page(int(h), prev_h, chunk)
+            except Exception:
+                break   # pool fully referenced: keep what landed so far
+            if blk is not None:
+                adopted.append((idx, blk))
+        if not adopted:
+            return 0
+        rows = np.asarray([r for r, _ in adopted], np.int32)
+        ids = np.asarray([b for _, b in adopted], np.int32)
+        kp = jnp.asarray(np.ascontiguousarray(k[:, rows]),
+                         self.cache_dtype)
+        vp = jnp.asarray(np.ascontiguousarray(v[:, rows]),
+                         self.cache_dtype)
+        self._key_cache = self._key_cache.at[:, ids].set(kp)
+        self._value_cache = self._value_cache.at[:, ids].set(vp)
+        if self.quant_kv and "kdq" in payload:
+            kq, vq, kdq, vdq = self._kv_scales
+            kdq = kdq.at[:, ids].set(
+                jnp.asarray(np.ascontiguousarray(
+                    payload["kdq"][:, rows]), jnp.float32))
+            vdq = vdq.at[:, ids].set(
+                jnp.asarray(np.ascontiguousarray(
+                    payload["vdq"][:, rows]), jnp.float32))
+            self._kv_scales = (kq, vq, kdq, vdq)
+        return len(adopted)
+
     def run(self) -> List[Completion]:
         """Drive until queue and batch drain; completions in finish order."""
         while self.has_work():

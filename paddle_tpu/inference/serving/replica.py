@@ -83,7 +83,8 @@ class ReplicaHandle:
     def __init__(self, replica_id: int,
                  engine_factory: Callable[[], PagedServingEngine],
                  ttl: float = 5.0, stall_timeout_s: float = 5.0,
-                 dead_after: int = 2, probation_s: float = 0.0):
+                 dead_after: int = 2, probation_s: float = 0.0,
+                 role: str = "any"):
         self.replica_id = int(replica_id)
         self.factory = engine_factory
         self.engine: Optional[PagedServingEngine] = engine_factory()
@@ -92,6 +93,14 @@ class ReplicaHandle:
         self.stall_timeout_s = float(stall_timeout_s)
         self.dead_after = int(dead_after)
         self.probation_s = float(probation_s)
+        # disagg pool role: "prefill" / "decode" / "any" (monolithic).
+        # Placement policy only — the engine underneath is identical.
+        self.role = str(role)
+        # epoch fence for cross-replica page migration: bumped on every
+        # death, so a payload stamped under incarnation N is rejected at
+        # ingest once this replica has died (N+1 means "same id, but NOT
+        # the engine that computed those pages")
+        self.incarnation = 0
         self.state = HEALTHY
         self.probation = False
         self.strikes = 0
@@ -138,6 +147,7 @@ class ReplicaHandle:
     def _kill(self, why: str):
         self.stats["kills"] += 1
         self.engine = None        # device state untrusted past this point
+        self.incarnation += 1     # fence: in-flight migrations go stale
         self._died_at = time.monotonic()
         self.death_reason = why
         self.probation = False
@@ -167,6 +177,16 @@ class ReplicaHandle:
         self._set_state(DEGRADED, "probation")
         _emit("router.readmit", replica=self.replica_id)
         return True
+
+    def begin_probation(self):
+        """Enter probation with the CURRENT engine — how the autoscaler
+        admits a freshly added replica through the same machinery a
+        readmitted one faces: DEGRADED until its first good step, any
+        strike kills it immediately."""
+        self.strikes = self.dead_after - 1
+        self.probation = True
+        self.beat()
+        self._set_state(DEGRADED, "probation")
 
     # -- drain ------------------------------------------------------------
     def start_drain(self):
@@ -248,7 +268,8 @@ class ReplicaHandle:
     # -- introspection ----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         out = {"state": self.state, "strikes": self.strikes,
-               "probation": self.probation,
+               "probation": self.probation, "role": self.role,
+               "incarnation": self.incarnation,
                "lease_age_s": round(self.lease_age(), 3),
                "death_reason": self.death_reason, **self.stats}
         if self.engine is not None:

@@ -159,15 +159,17 @@ class ServingRouter:
         n = int(_flag_or(num_replicas, "router_num_replicas"))
         if n < 1:
             raise ValueError("num_replicas must be >= 1")
+        # kept for subclasses that add replicas at runtime (the disagg
+        # autoscaler grows the decode pool through the same breaker knobs)
+        self.engine_factory = engine_factory
+        self.replica_kw = dict(
+            ttl=float(_flag_or(ttl, "router_ttl_s")),
+            stall_timeout_s=float(
+                _flag_or(stall_timeout_s, "router_stall_timeout_s")),
+            dead_after=int(_flag_or(dead_after, "router_dead_after")),
+            probation_s=float(_flag_or(probation_s, "router_probation_s")))
         self.replicas = [
-            ReplicaHandle(
-                i, engine_factory,
-                ttl=float(_flag_or(ttl, "router_ttl_s")),
-                stall_timeout_s=float(
-                    _flag_or(stall_timeout_s, "router_stall_timeout_s")),
-                dead_after=int(_flag_or(dead_after, "router_dead_after")),
-                probation_s=float(
-                    _flag_or(probation_s, "router_probation_s")))
+            ReplicaHandle(i, engine_factory, **self.replica_kw)
             for i in range(n)]
         self.tenant_max_queue = int(
             _flag_or(tenant_max_queue, "router_tenant_max_queue"))
@@ -368,12 +370,32 @@ class ServingRouter:
                     break
                 q.popleft()
 
+    def _placement_candidates(self,
+                              req: RouterRequest) -> List[ReplicaHandle]:
+        """Replicas eligible to receive `req` right now (subclass hook:
+        the disagg router narrows this to the request's pool)."""
+        return [h for h in self.replicas
+                if h.accepts_new() and h.engine is not None]
+
+    def _prefix_signal(self, req: RouterRequest, h: ReplicaHandle) -> int:
+        """Prefix-affinity score for placing `req` on `h` (subclass
+        hook: the disagg router folds in the fleet-global index)."""
+        return h.engine.blocks.lookup_prefix(req.prompt)
+
+    def _submit_budget(self, req: RouterRequest) -> int:
+        """max_new_tokens for the engine submit (subclass hook: the
+        disagg router caps prefill-phase placements at one token)."""
+        return req.max_new_tokens
+
+    def _prepare_submit(self, req: RouterRequest, h: ReplicaHandle):
+        """Runs just before `req` is submitted to `h` (subclass hook:
+        the disagg router pulls migrated pages here)."""
+
     def _place(self, req: RouterRequest) -> bool:
         """Prefix-affinity placement with least-loaded fallback; False
         when no accepting replica has room right now (the request stays
         queued — engine-level backpressure, not a shed)."""
-        cands = [h for h in self.replicas
-                 if h.accepts_new() and h.engine is not None]
+        cands = self._placement_candidates(req)
         if not cands:
             return False
 
@@ -389,8 +411,7 @@ class ServingRouter:
                     + h.engine.scheduler.num_running(),
                     h.engine.blocks.bytes_in_use() if mixed else 0)
 
-        scored = [(h.engine.blocks.lookup_prefix(req.prompt), h)
-                  for h in cands]
+        scored = [(self._prefix_signal(req, h), h) for h in cands]
         best_prefix = max(s for s, _ in scored)
         if best_prefix > 0:
             order = sorted(scored,
@@ -403,9 +424,10 @@ class ServingRouter:
             deadline_s = None
             if req.deadline is not None:
                 deadline_s = req.deadline - time.monotonic()
+            self._prepare_submit(req, h)
             try:
                 engine_rid = h.engine.submit(
-                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    req.prompt, max_new_tokens=self._submit_budget(req),
                     eos_token_id=None if req.eos < 0 else req.eos,
                     priority=req.priority, deadline_s=deadline_s,
                     temperature=req.temperature, top_p=req.top_p,

@@ -289,6 +289,31 @@ _g_rt_pending = _G("paddle_router_pending_requests",
                    "Router-side requests awaiting placement")
 _g_rt_live = _G("paddle_router_live_streams",
                 "Streams admitted and not yet finished")
+_c_mig_handoffs = _C("paddle_migration_handoffs_total",
+                     "Disagg prefill→decode handoffs, by result (ok = "
+                     "pages pulled and adopted, local = same-replica "
+                     "shortcut, fallback = decode-side recompute)")
+_c_mig_pages = _C("paddle_migration_pages_total",
+                  "KV pages shipped over the migration page transport")
+_c_mig_bytes = _C("paddle_migration_wire_bytes_total",
+                  "Bytes offered to the migration page transport, by "
+                  "wire encoding")
+_c_mig_retries = _C("paddle_migration_retries_total",
+                    "Migration page-pull retries (typed timeout + capped "
+                    "exponential backoff)")
+_c_mig_fallbacks = _C("paddle_migration_fallbacks_total",
+                      "Handoffs degraded to decode-side prefill "
+                      "recompute, by reason (timeout/stale_epoch/"
+                      "corrupt/mismatch/...)")
+_c_mig_mono = _C("paddle_migration_monolithic_trips_total",
+                 "Sustained-migration-failure trips back to monolithic "
+                 "same-replica serving")
+_c_as_decisions = _C("paddle_autoscaler_decisions_total",
+                     "SLO autoscaler decisions, by direction "
+                     "(grow/shrink/hold)")
+_g_as_pool = _G("paddle_autoscaler_decode_pool",
+                "Accepting decode-pool replicas as of the last "
+                "autoscaler tick")
 _c_pp_sends = _C("paddle_pp_sends_total",
                  "Pipeline stage handoffs issued (activation/grad), by kind")
 _h_pp_send = _H("paddle_pp_send_seconds",
@@ -524,6 +549,17 @@ def _h_rt_gauges(dur_s, f):
         _g_rt_replicas.set(f.get(state, 0), labels={"state": state})
 
 
+def _h_mig_pages(dur_s, f):
+    _c_mig_pages.inc(f.get("pages", 0))
+    _c_mig_bytes.inc(f.get("bytes", 0),
+                     labels={"wire": f.get("wire", "raw")})
+
+
+def _h_as_decision(dur_s, f):
+    _c_as_decisions.inc(labels={"direction": f.get("direction", "hold")})
+    _g_as_pool.set(f.get("pool", 0))
+
+
 _HANDLERS = {
     "dispatch.hit": _h_dispatch_hit,
     "dispatch.miss": _h_dispatch_miss,
@@ -596,6 +632,14 @@ _HANDLERS = {
         f.get("kv_utilization", 0.0),
         labels={"replica": str(f.get("replica", ""))}),
     "router.gauges": _h_rt_gauges,
+    "migration.handoff": lambda d, f: _c_mig_handoffs.inc(
+        labels={"result": f.get("result", "")}),
+    "migration.pages": _h_mig_pages,
+    "migration.retry": lambda d, f: _c_mig_retries.inc(),
+    "migration.fallback": lambda d, f: _c_mig_fallbacks.inc(
+        labels={"reason": f.get("reason", "")}),
+    "migration.monolithic": lambda d, f: _c_mig_mono.inc(),
+    "autoscale.decision": _h_as_decision,
     "async.p2p": lambda d, f: _c_p2p.inc(),
     "pipeline.send": _h_pp_send_h,
     "pipeline.recv": _h_pp_recv,
@@ -834,6 +878,23 @@ def summary() -> dict:
             "ttft_p99_s": round(_h_srv_ttft.percentile(99), 6),
             "tpot_p50_s": round(_h_srv_tpot.percentile(50), 6),
             "tpot_p99_s": round(_h_srv_tpot.percentile(99), 6),
+        },
+        "disagg": {
+            "handoffs_ok": int(_c_mig_handoffs.value({"result": "ok"})),
+            "handoffs_local": int(_c_mig_handoffs.value(
+                {"result": "local"})),
+            "handoffs_fallback": int(_c_mig_handoffs.value(
+                {"result": "fallback"})),
+            "pages_shipped": int(_c_mig_pages.value()),
+            "wire_bytes": int(_c_mig_bytes.value()),
+            "pull_retries": int(_c_mig_retries.value()),
+            "recompute_fallbacks": int(_c_mig_fallbacks.value()),
+            "monolithic_trips": int(_c_mig_mono.value()),
+            "autoscaler_grows": int(_c_as_decisions.value(
+                {"direction": "grow"})),
+            "autoscaler_shrinks": int(_c_as_decisions.value(
+                {"direction": "shrink"})),
+            "decode_pool": int(_g_as_pool.value()),
         },
     }
 

@@ -226,9 +226,16 @@ def fleet_summary(store=None, ranks=None, states=None) -> dict:
     ttft = hists.get("paddle_serving_ttft_seconds")
     tpot = hists.get("paddle_serving_tpot_seconds")
     admitted = csum("paddle_serving_requests_total", {"event": "admitted"})
-    shed = (csum("paddle_serving_requests_total", {"event": "shed"})
-            + csum("paddle_serving_requests_total", {"event": "deadline"})
-            + csum("paddle_router_shed_total"))
+    # "queue too deep" (admission sheds) and "deadlines too tight"
+    # (mid-flight expiries) are different capacity signals: the SLO
+    # autoscaler grows the pool for the former, while the latter means
+    # clients asked for latencies no pool size buys back. `shed` stays
+    # the combined total for dashboard back-compat.
+    shed_queue = (csum("paddle_serving_requests_total", {"event": "shed"})
+                  + csum("paddle_router_shed_total"))
+    deadline_expired = csum("paddle_serving_requests_total",
+                            {"event": "deadline"})
+    shed = shed_queue + deadline_expired
     seen = admitted + csum("paddle_router_shed_total")
     out = {
         "ranks": sorted({str(r) for r, _ in states}),
@@ -244,6 +251,11 @@ def fleet_summary(store=None, ranks=None, states=None) -> dict:
                               {"event": "completed"})),
         "shed": int(shed),
         "shed_rate": round(shed / seen, 6) if seen else 0.0,
+        "shed_queue": int(shed_queue),
+        "shed_queue_rate": round(shed_queue / seen, 6) if seen else 0.0,
+        "deadline_expired": int(deadline_expired),
+        "deadline_rate": round(deadline_expired / seen, 6)
+                         if seen else 0.0,
         "failovers": int(csum("paddle_router_failovers_total")),
         "counters": {name: {_label_str(k) or "": v
                             for k, v in c._values.items()}

@@ -1,0 +1,626 @@
+"""Disaggregated prefill/decode fleet tests.
+
+The contract under test: a stream served across the prefill/decode pool
+split — first token on a prefill replica, KV pages migrated, the rest on
+a decode replica — must be BIT-EXACT vs the same request on one
+monolithic engine, and every rung of the migration failure ladder
+(timeout+retry, stale epoch, CRC corruption, post-adopt mismatch) must
+degrade to recompute, never to a wrong or dropped stream.
+
+Also covers: ``BlockManager.prefix_chain`` (the rolling-hash chain
+``lookup_prefix`` now wraps), the chaos ``migration`` site drills
+(drop / delay / corrupt / rank_dead), the monolithic trip breaker, the
+fleet-global prefix index, the SLO autoscaler's grow/shrink/hold ladder
+through probation + drain, and the ``fleet_summary`` split of queue
+sheds vs deadline expiries the autoscaler keys on.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.core import flags
+from paddle_tpu.distributed.fault_tolerance import chaos
+from paddle_tpu.inference.serving import (BlockManager, DisaggRouter,
+                                          MigrationTimeout,
+                                          PageCorruptError,
+                                          PagedServingEngine,
+                                          StaleEpochError, parse_pools)
+from paddle_tpu.inference.serving.disagg import (FleetPrefixIndex,
+                                                 PageTransport,
+                                                 PoolAutoscaler,
+                                                 _flip_tail, pack_pages,
+                                                 unpack_pages)
+from paddle_tpu.inference.serving.replica import (DEAD, DEGRADED, DRAINED,
+                                                  DRAINING, HEALTHY)
+from paddle_tpu.models import llama as L
+from paddle_tpu.observability.fleet import fleet_summary
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = L.LlamaConfig(vocab_size=97, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, max_seq_len=96, dtype=jnp.float32)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _factory(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("token_budget", 16)
+
+    def build():
+        return PagedServingEngine(cfg, params, **kw)
+
+    return build
+
+
+def _prompts(cfg, n, lens, seed=1):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, cfg.vocab_size, (ln,)).tolist()
+            for ln, _ in zip((lens * n)[:n], range(n))]
+
+
+def _mono_ref(tiny, prompts, max_new=8, **kw):
+    """Uninterrupted single-engine reference outputs, by prompt index."""
+    eng = _factory(tiny, **kw)()
+    rmap = {eng.submit(p, max_new_tokens=max_new): i
+            for i, p in enumerate(prompts)}
+    return {rmap[c.rid]: c.output_tokens for c in eng.run()}
+
+
+def _run_disagg(tiny, prompts, max_new=8, **router_kw):
+    router = DisaggRouter(_factory(tiny), **router_kw)
+    rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+    done = {c.rid: c.output_tokens for c in router.run()}
+    return router, {i: done[rid] for i, rid in enumerate(rids)}
+
+
+@pytest.fixture()
+def _flags():
+    """Set flags for one test, restore after."""
+    saved = {}
+
+    def set_(kv):
+        for k in kv:
+            saved.setdefault(k, flags.flag_value(k))
+        flags.set_flags(kv)
+
+    yield set_
+    flags.set_flags(saved)
+
+
+# ---------------------------------------------------------------------------
+# prefix_chain (the rolling-hash chain lookup_prefix now wraps)
+# ---------------------------------------------------------------------------
+
+class TestPrefixChain:
+    def test_chain_shape_and_determinism(self):
+        bm = BlockManager(num_blocks=16, block_size=4)
+        toks = list(range(11))
+        chain = bm.prefix_chain(toks)
+        assert [d for d, _ in chain] == [4, 8]   # full blocks only
+        # pure function of tokens: identical across managers/geometry-peers
+        bm2 = BlockManager(num_blocks=99, block_size=4)
+        assert bm2.prefix_chain(toks) == chain
+        # a chain is prefix-stable: extending tokens extends the chain
+        longer = bm.prefix_chain(toks + [93, 94])
+        assert longer[:2] == chain and longer[2][0] == 12
+
+    def test_chain_diverges_on_content(self):
+        bm = BlockManager(num_blocks=16, block_size=4)
+        a = bm.prefix_chain([1, 2, 3, 4, 5, 6, 7, 8])
+        b = bm.prefix_chain([1, 2, 3, 9, 5, 6, 7, 8])
+        assert a[0][1] != b[0][1]
+        assert a[1][1] != b[1][1]   # divergence propagates down the chain
+
+    def test_lookup_prefix_is_chain_walk(self):
+        bm = BlockManager(num_blocks=16, block_size=4)
+        toks = list(range(12))
+        seq = bm.allocate_sequence("s", toks)
+        bm.register_computed("s", toks, len(toks))
+        probe = toks + [50, 51]
+        # every live link the chain reports must be what lookup finds
+        depth = bm.lookup_prefix(probe)
+        chain = bm.prefix_chain(probe)
+        live = [d for d, h in chain if bm._chain_live(h) is not None]
+        assert depth == min(max(live, default=0), len(probe) - 1)
+        assert depth == 12
+        bm.free_sequence("s")
+        del seq
+
+    def test_lookup_prefix_caps_below_full_prompt(self):
+        bm = BlockManager(num_blocks=16, block_size=4)
+        toks = list(range(8))
+        bm.allocate_sequence("s", toks)
+        bm.register_computed("s", toks, len(toks))
+        # whole prompt cached: must still leave >= 1 token to compute
+        assert bm.lookup_prefix(toks) == 7
+
+
+# ---------------------------------------------------------------------------
+# wire codec + transport + index units
+# ---------------------------------------------------------------------------
+
+class TestWireCodec:
+    def _pages(self, dtype=np.float32, nblk=2):
+        rs = np.random.RandomState(3)
+        return {"chain": [(4 * (i + 1), 11 * (i + 1))
+                          for i in range(nblk)],
+                "tokens": list(range(4 * nblk)),
+                "dtype": np.dtype(dtype).name,
+                "k": rs.randn(2, nblk, 2, 4, 4).astype(dtype),
+                "v": rs.randn(2, nblk, 2, 4, 4).astype(dtype)}
+
+    def test_raw_roundtrip_bit_exact(self):
+        pages = self._pages()
+        payload, epoch = unpack_pages(pack_pages(pages, (3, 7)))
+        assert epoch == (3, 7)
+        assert payload["chain"] == pages["chain"]
+        assert payload["tokens"] == pages["tokens"]
+        assert np.array_equal(payload["k"], pages["k"])
+        assert np.array_equal(payload["v"], pages["v"])
+
+    def test_q8_wire_smaller_and_close(self):
+        # enough pages that the wire body dominates the JSON header
+        pages = self._pages(nblk=16)
+        raw = pack_pages(pages, (0, 0))
+        q8 = pack_pages(pages, (0, 0), wire="int8")
+        assert len(q8) < 0.5 * len(raw)
+        payload, _ = unpack_pages(q8)
+        assert payload["k"].dtype == pages["k"].dtype
+        # block-scaled int8: lossy but tight (absmax/127 per block)
+        assert np.abs(payload["k"] - pages["k"]).max() < 0.05
+
+    def test_int8_pages_never_requantized(self):
+        pages = self._pages(np.int8)
+        blob = pack_pages(pages, (0, 0), wire="int8")
+        payload, _ = unpack_pages(blob)
+        assert np.array_equal(payload["k"], pages["k"])   # as-is, exact
+
+    def test_corrupt_trips_crc(self):
+        blob = pack_pages(self._pages(), (0, 0))
+        with pytest.raises(PageCorruptError):
+            unpack_pages(_flip_tail(blob))
+        with pytest.raises(PageCorruptError):
+            unpack_pages(b"not a payload")
+
+    def test_parse_pools(self):
+        assert parse_pools("") is None
+        assert parse_pools("prefill=1,decode=2") == {"prefill": 1,
+                                                     "decode": 2}
+        for bad in ("prefill=1", "prefill=0,decode=1", "a=1,b=2",
+                    "prefill,decode"):
+            with pytest.raises(ValueError):
+                parse_pools(bad)
+
+
+class TestTransportAndIndex:
+    def test_local_offer_pull_forget(self):
+        t = PageTransport()
+        t.offer("k1", b"payload")
+        assert t.pull_once("k1", 0.01) == b"payload"
+        t.forget("k1")
+        with pytest.raises(MigrationTimeout):
+            t.pull_once("k1", 0.01)
+
+    def test_prefix_index_contiguous_depth(self):
+        idx = FleetPrefixIndex()
+        idx.publish(0, [(4, 100), (8, 200), (12, 300)])
+        assert idx.depth(0, [(4, 100), (8, 200), (12, 300)]) == 12
+        # a hole stops the walk even if deeper links are published
+        assert idx.depth(0, [(4, 100), (8, 999), (12, 300)]) == 4
+        assert idx.depth(1, [(4, 100)]) == 0   # other replica: no claim
+        idx.drop(0)
+        assert idx.depth(0, [(4, 100)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the handoff: happy path + every rung of the failure ladder
+# ---------------------------------------------------------------------------
+
+class TestDisaggHandoff:
+    def test_happy_path_bit_exact_and_metrics(self, tiny, _flags):
+        obs.reset()
+        prompts = _prompts(tiny[0], 4, [9, 5, 13, 7], seed=11)
+        ref = _mono_ref(tiny, prompts)
+        router, out = _run_disagg(tiny, prompts,
+                                  pools="prefill=1,decode=1")
+        assert out == ref
+        st = router.disagg_stats
+        assert st["handoffs"] == 4 and st["handoffs_ok"] == 4
+        assert st["fallbacks"] == 0 and router.stats["mismatches"] == 0
+        # decode replica adopted real pages (not recomputed)
+        dec = router.pool("decode")[0]
+        assert dec.engine.blocks.stats["adopted_pages"] > 0
+        s = obs.summary()["disagg"]
+        assert s["handoffs_ok"] == 4 and s["pages_shipped"] > 0
+        assert s["wire_bytes"] > 0 and s["recompute_fallbacks"] == 0
+
+    def test_monolithic_spec_is_plain_router(self, tiny):
+        prompts = _prompts(tiny[0], 2, [6, 9], seed=4)
+        ref = _mono_ref(tiny, prompts)
+        router, out = _run_disagg(tiny, prompts, pools="",
+                                  num_replicas=2)
+        assert out == ref
+        assert router.disagg_stats["handoffs"] == 0
+        assert all(h.role == "any" for h in router.replicas)
+
+    def test_single_token_requests_skip_handoff(self, tiny):
+        prompts = _prompts(tiny[0], 2, [5, 8], seed=9)
+        ref = _mono_ref(tiny, prompts, max_new=1)
+        router, out = _run_disagg(tiny, prompts, max_new=1,
+                                  pools="prefill=1,decode=1")
+        assert out == ref
+        assert router.disagg_stats["handoffs"] == 0
+
+    def test_rank_dead_mid_handoff_recomputes_bit_exact(self, tiny,
+                                                        _flags):
+        """The acceptance drill: the prefill replica dies mid-handoff
+        (rank_dead riding the page offer). Exactly one recompute
+        fallback, bit-exact output, zero survivor retraces."""
+        obs.reset()
+        _flags({"router_probation_s": 60.0})   # victim stays down
+        prompts = _prompts(tiny[0], 3, [9, 7, 11], seed=7)
+        ref = _mono_ref(tiny, prompts)
+        try:
+            chaos.reconfigure(
+                "migration:rank_dead@op=offer;victim=0;count=1")
+            router = DisaggRouter(_factory(tiny),
+                                  pools="prefill=1,decode=1")
+            dec = router.pool("decode")[0]
+            rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+            builds0 = None
+            done = {}
+            while router.has_work():
+                router.step()
+                for c in router._completions:
+                    done[c.rid] = c.output_tokens
+                if builds0 is None and dec.engine is not None \
+                        and dec.engine.stats["steps"] > 2:
+                    builds0 = dec.engine.stats["step_builds"]
+        finally:
+            chaos.reconfigure(None)
+        out = {i: done[rid] for i, rid in enumerate(rids)}
+        assert out == ref                      # bit-exact despite death
+        st = router.disagg_stats
+        assert st["fallbacks"] == 1            # exactly one
+        assert router.stats["mismatches"] == 0
+        assert router.replicas[0].state == DEAD
+        assert router.replicas[0].incarnation == 1
+        s = obs.summary()["disagg"]
+        assert s["recompute_fallbacks"] == 1
+        assert obs.registry().value(
+            "paddle_chaos_injections_total",
+            {"site": "migration", "kind": "rank_dead"}) == 1
+        # survivor decode replica never retraced once warm
+        assert dec.engine.stats["step_builds"] == builds0
+
+    def test_drop_pull_exhausts_retries_then_falls_back(self, tiny,
+                                                        _flags):
+        obs.reset()
+        _flags({"migration_retries": 2, "migration_timeout_s": 0.01,
+                "migration_backoff_s": 0.0})
+        prompts = _prompts(tiny[0], 1, [9], seed=5)
+        ref = _mono_ref(tiny, prompts)
+        try:
+            chaos.reconfigure("migration:drop@op=pull;count=0")
+            router, out = _run_disagg(tiny, prompts,
+                                      pools="prefill=1,decode=1")
+        finally:
+            chaos.reconfigure(None)
+        assert out == ref
+        st = router.disagg_stats
+        assert st["fallbacks"] == 1
+        assert st["retries"] == 2              # every configured retry
+        s = obs.summary()["disagg"]
+        assert s["pull_retries"] == 2 and s["recompute_fallbacks"] == 1
+
+    def test_delay_on_pull_still_lands(self, tiny):
+        prompts = _prompts(tiny[0], 1, [9], seed=6)
+        ref = _mono_ref(tiny, prompts)
+        try:
+            chaos.reconfigure("migration:delay@op=pull;delay=0.01")
+            router, out = _run_disagg(tiny, prompts,
+                                      pools="prefill=1,decode=1")
+        finally:
+            chaos.reconfigure(None)
+        assert out == ref
+        assert router.disagg_stats["handoffs_ok"] == 1
+        assert router.disagg_stats["fallbacks"] == 0
+
+    def test_corrupt_offer_rejected_at_ingest(self, tiny, _flags):
+        obs.reset()
+        prompts = _prompts(tiny[0], 1, [9], seed=8)
+        ref = _mono_ref(tiny, prompts)
+        try:
+            chaos.reconfigure("migration:corrupt@op=offer")
+            router, out = _run_disagg(tiny, prompts,
+                                      pools="prefill=1,decode=1")
+        finally:
+            chaos.reconfigure(None)
+        assert out == ref                      # CRC trip -> recompute
+        assert router.disagg_stats["fallbacks"] == 1
+        assert router.transport.stats["corrupted"] == 1
+        dec = router.pool("decode")[0]
+        assert dec.engine.blocks.stats["adopted_pages"] == 0
+
+    def test_sustained_failure_trips_monolithic(self, tiny, _flags):
+        _flags({"migration_monolithic_after": 2,
+                "migration_monolithic_cooldown_s": 60.0,
+                "migration_retries": 0, "migration_timeout_s": 0.01,
+                "migration_backoff_s": 0.0})
+        prompts = _prompts(tiny[0], 4, [9, 7, 11, 5], seed=13)
+        ref = _mono_ref(tiny, prompts)
+        try:
+            chaos.reconfigure("migration:drop@op=offer;count=0")
+            router, out = _run_disagg(tiny, prompts,
+                                      pools="prefill=1,decode=1")
+        finally:
+            chaos.reconfigure(None)
+        assert out == ref
+        st = router.disagg_stats
+        assert st["monolithic_trips"] == 1
+        assert st["fallbacks"] == 2            # then the breaker opened
+        assert st["handoffs"] < len(prompts)   # later reqs never split
+        assert router._monolithic_active()
+        snap = router.disagg_snapshot()
+        assert snap["monolithic_for_s"] > 0
+
+    def test_wire_int8_lossy_mismatch_falls_back_not_fatal(self, tiny,
+                                                           _flags):
+        """A post-adopt confirm mismatch on migrated pages must degrade
+        to recompute (evicting the bad pages), NOT raise the router's
+        determinism-violation error."""
+        obs.reset()
+        prompts = _prompts(tiny[0], 1, [9], seed=15)
+        ref = _mono_ref(tiny, prompts)
+        router = DisaggRouter(_factory(tiny), pools="prefill=1,decode=1")
+        real_unpack = unpack_pages
+
+        def tamper(key, timeout_s, victim=None):
+            blob = PageTransport.pull_once(router.transport, key,
+                                           timeout_s, victim=victim)
+            payload, epoch = real_unpack(blob)
+            payload["k"] = np.zeros_like(payload["k"])   # valid, wrong
+            payload["v"] = np.zeros_like(payload["v"])
+            return pack_pages(payload, epoch)
+
+        router.transport.pull_once = tamper
+        rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+        done = {c.rid: c.output_tokens for c in router.run()}
+        out = {i: done[rid] for i, rid in enumerate(rids)}
+        assert out == ref
+        st = router.disagg_stats
+        assert st["fallbacks"] == 1
+        assert router.stats["mismatches"] == 0   # never "determinism broke"
+        s = obs.summary()["disagg"]
+        assert s["recompute_fallbacks"] == 1
+
+    def test_ingest_rejects_geometry_mismatch(self, tiny):
+        eng = _factory(tiny)()
+        other = _factory(tiny, block_size=8)()
+        toks = list(range(9))
+        rid = eng.submit(toks, max_new_tokens=1)
+        eng.run()
+        del rid
+        pages = eng.extract_pages(toks)
+        assert pages is not None
+        with pytest.raises(ValueError):
+            other.ingest_pages(pages)
+
+
+class TestEpochFence:
+    def test_stale_sender_rejected(self, tiny):
+        router = DisaggRouter(_factory(tiny), pools="prefill=1,decode=1")
+        src = router.replicas[0]
+        hs = {"epoch": (0, src.incarnation)}
+        router._check_epoch(hs)                 # live sender: fine
+        src._kill("test")                       # lease revoked + bumped
+        with pytest.raises(StaleEpochError):
+            router._check_epoch(hs)
+
+    def test_reincarnated_sender_is_still_stale(self, tiny, _flags):
+        _flags({"router_probation_s": 0.0})
+        router = DisaggRouter(_factory(tiny), pools="prefill=1,decode=1")
+        src = router.replicas[0]
+        hs = {"epoch": (0, src.incarnation)}
+        src._kill("test")
+        assert src.maybe_readmit()              # fresh engine, same id
+        assert src.state == DEGRADED
+        with pytest.raises(StaleEpochError):
+            router._check_epoch(hs)             # N+1 != N: not those pages
+
+    def test_payload_epoch_must_match_handoff(self, tiny):
+        pages = {"chain": [(4, 1)], "tokens": [1, 2, 3, 4],
+                 "dtype": "float32",
+                 "k": np.zeros((2, 1, 2, 4, 4), np.float32),
+                 "v": np.zeros((2, 1, 2, 4, 4), np.float32)}
+        _, epoch = unpack_pages(pack_pages(pages, (0, 5)))
+        assert epoch == (0, 5)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler + pools
+# ---------------------------------------------------------------------------
+
+class TestAutoscaler:
+    def _router(self, tiny):
+        return DisaggRouter(_factory(tiny), pools="prefill=1,decode=1")
+
+    def test_grow_on_ttft_breach_through_probation(self, tiny):
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=0.1, shed_rate=0.0,
+                                min_decode=1, max_decode=3,
+                                cooldown_s=0.0)
+        assert router.decode_pool_size() == 1
+        d = scaler.tick(summary={"ttft_p99_s": 0.5,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "grow" and router.decode_pool_size() == 2
+        new = router.replicas[-1]
+        assert new.role == "decode" and new.probation
+        assert new.state == DEGRADED            # same admission machinery
+        assert new.replica_id in router._assigned
+
+    def test_grow_respects_ceiling(self, tiny):
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=0.1, shed_rate=0.0,
+                                min_decode=1, max_decode=1,
+                                cooldown_s=0.0)
+        d = scaler.tick(summary={"ttft_p99_s": 9.9,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "hold" and router.decode_pool_size() == 1
+
+    def test_shrink_drains_gracefully(self, tiny):
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=1.0, shed_rate=0.05,
+                                min_decode=1, max_decode=3,
+                                cooldown_s=0.0)
+        scaler.tick(summary={"ttft_p99_s": 5.0, "shed_queue_rate": 0.0,
+                             "deadline_expired": 0})
+        assert router.decode_pool_size() == 2
+        d = scaler.tick(summary={"ttft_p99_s": 0.01,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "shrink"
+        drained = [h for h in router.replicas
+                   if h.state in (DRAINING, DRAINED)]
+        assert len(drained) == 1 and drained[0].role == "decode"
+        assert router.decode_pool_size() == 1
+
+    def test_never_shrinks_below_floor(self, tiny):
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=1.0, shed_rate=0.05,
+                                min_decode=1, max_decode=3,
+                                cooldown_s=0.0)
+        d = scaler.tick(summary={"ttft_p99_s": 0.0,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "hold" and router.decode_pool_size() == 1
+
+    def test_deadline_pressure_never_grows(self, tiny):
+        """'Deadlines too tight' is not 'queue too deep': expiries alone
+        must not buy replicas."""
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=1.0, shed_rate=0.05,
+                                min_decode=1, max_decode=3,
+                                cooldown_s=0.0)
+        d = scaler.tick(summary={"ttft_p99_s": 0.9,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 500})
+        assert d == "hold" and router.decode_pool_size() == 1
+        # ...while queue sheds at the same everything-else DO grow
+        d = scaler.tick(summary={"ttft_p99_s": 0.9,
+                                 "shed_queue_rate": 0.5,
+                                 "deadline_expired": 500})
+        assert d == "grow" and router.decode_pool_size() == 2
+
+    def test_cooldown_gates_decisions(self, tiny):
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=0.1, shed_rate=0.0,
+                                min_decode=1, max_decode=4,
+                                cooldown_s=3600.0)
+        s = {"ttft_p99_s": 9.9, "shed_queue_rate": 0.0,
+             "deadline_expired": 0}
+        assert scaler.tick(summary=s) == "grow"
+        assert scaler.tick(summary=s) is None    # inside cooldown
+        assert router.decode_pool_size() == 2
+
+    def test_grown_replica_serves_and_emits_metrics(self, tiny):
+        obs.reset()
+        router = self._router(tiny)
+        router.grow_decode()
+        prompts = _prompts(tiny[0], 2, [7, 9], seed=17)
+        ref = _mono_ref(tiny, prompts)
+        rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+        done = {c.rid: c.output_tokens for c in router.run()}
+        assert {i: done[r] for i, r in enumerate(rids)} == ref
+        grown = router.replicas[-1]
+        assert grown.state == HEALTHY            # probation healed
+        # a hold decision still publishes the pool-size gauge
+        scaler = PoolAutoscaler(router, ttft_p99_s=0.0, shed_rate=0.0,
+                                min_decode=2, max_decode=2,
+                                cooldown_s=0.0)
+        assert scaler.tick(summary={"ttft_p99_s": 0.0,
+                                    "shed_queue_rate": 0.0,
+                                    "deadline_expired": 0}) == "hold"
+        s = obs.summary()["disagg"]
+        assert s["decode_pool"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet_summary: queue sheds vs deadline expiries move independently
+# ---------------------------------------------------------------------------
+
+class TestShedSplit:
+    def test_queue_shed_and_deadline_counted_separately(self):
+        obs.reset()
+        obs.emit("serving.admit", tenant="t", rid=1)
+        obs.emit("serving.admit", tenant="t", rid=2)
+        obs.emit("serving.admit", tenant="t", rid=3)
+        obs.emit("serving.shed", tenant="t", reason="queue_full")
+        s1 = fleet_summary()
+        assert s1["shed_queue"] == 1 and s1["deadline_expired"] == 0
+        assert s1["shed"] == 1
+        obs.emit("serving.shed", tenant="t", reason="deadline")
+        s2 = fleet_summary()
+        # the deadline expiry moved ONLY the deadline counter
+        assert s2["shed_queue"] == 1 and s2["deadline_expired"] == 1
+        assert s2["shed"] == 2
+        assert s2["deadline_rate"] > 0 and s2["shed_queue_rate"] > 0
+        assert s2["shed_queue_rate"] != s2["deadline_rate"] or \
+            s2["shed_queue"] == s2["deadline_expired"]
+
+    def test_disagg_distress_section_registered(self, tiny):
+        router = DisaggRouter(_factory(tiny), pools="prefill=1,decode=1")
+        snap = router.disagg_snapshot()
+        assert snap["pools"]["prefill"] == [0]
+        assert snap["pools"]["decode"] == [1]
+        assert "in_flight_handoffs" in snap
+        assert snap["decode_pool_accepting"] == 1
+        # registered under the distress plane next to the router section
+        from paddle_tpu.observability import distress
+        assert "disagg" in distress._sections
+        assert "router" in distress._sections
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix index routing
+# ---------------------------------------------------------------------------
+
+class TestFleetPrefixRouting:
+    def test_index_steers_placement_to_page_owner(self, tiny):
+        """After one handoff, the decode replica has published its claim
+        on the prompt's chain — a same-prefix follow-up must score it
+        above an empty decode peer."""
+        router = DisaggRouter(_factory(tiny), pools="prefill=1,decode=2")
+        cfg = tiny[0]
+        prompt = _prompts(cfg, 1, [12], seed=19)[0]
+        rid = router.submit(prompt, max_new_tokens=4)
+        router.run()
+        hs_done = router.disagg_stats["handoffs_ok"] \
+            + router.disagg_stats["handoffs_local"]
+        assert hs_done == 1
+        del rid
+        # whichever decode replica adopted the pages now outranks the other
+        owner = [h for h in router.pool("decode")
+                 if h.engine.blocks.stats["adopted_pages"] > 0]
+        assert len(owner) == 1
+        from paddle_tpu.inference.serving.router import RouterRequest
+        probe = RouterRequest(999, "default", prompt + [3, 4], 4)
+        scores = {h.replica_id: router._prefix_signal(probe, h)
+                  for h in router.pool("decode")}
+        others = [v for k, v in scores.items()
+                  if k != owner[0].replica_id]
+        assert scores[owner[0].replica_id] > max(others)

@@ -596,6 +596,51 @@ def maybe_elastic_pp_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/elastic_pp_smoke.py)")
 
 
+_last_disagg_smoke = [0.0]
+
+
+def maybe_disagg_smoke(min_interval: float = 3600.0) -> None:
+    """Run the disaggregated-serving smoke (tools/disagg_smoke.py) at
+    most once per min_interval and log a RED line on regression — a
+    mid-handoff sender kill that doesn't land on exactly one recompute
+    fallback with bit-exact output, a steady-state handoff that falls
+    back instead of migrating pages, a fleet retrace, or an autoscaler
+    that fails to grow through probation / drain back gracefully."""
+    now = time.monotonic()
+    if _last_disagg_smoke[0] and now - _last_disagg_smoke[0] < min_interval:
+        return
+    _last_disagg_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "disagg_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: disagg smoke hung >600s — prefill/decode handoff "
+            "deadlocked (the hang the migration timeout exists to bound)")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"disagg smoke GREEN ({payload.get('wall_s')}s: "
+            f"{payload.get('steady_handoffs_ok')} handoffs, "
+            f"{payload.get('recompute_fallbacks')} recompute fallback "
+            f"under kill, "
+            f"{payload.get('steady_pages_shipped')} pages shipped)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: disagg smoke regression rc={out.returncode} — {detail} "
+        f"(tools/disagg_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -714,6 +759,7 @@ def main() -> None:
         maybe_elastic_smoke()
         maybe_pp_smoke()
         maybe_elastic_pp_smoke()
+        maybe_disagg_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -730,6 +776,7 @@ def main() -> None:
             maybe_elastic_smoke()
             maybe_pp_smoke()
             maybe_elastic_pp_smoke()
+            maybe_disagg_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
