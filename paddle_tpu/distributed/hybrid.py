@@ -440,7 +440,7 @@ def sync_grads(grads, specs):
 # Public train step factory
 # --------------------------------------------------------------------------
 
-def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
+def make_train_step(cfg, mesh: Mesh, num_microbatches: Optional[int] = None,
                     hp: Optional[AdamWConfig] = None,
                     remat: Union[bool, str] = True,
                     attn_impl: str = "auto", loss_fn=None,
@@ -469,7 +469,18 @@ def make_train_step(cfg, mesh: Mesh, num_microbatches: int = 1,
     ffn_impl: None resolves FLAGS_pallas_ffn HERE, at build time (the flag
     never reaches traced code — trace purity); "pallas" forces the fused
     SwiGLU kernel on supported shapes; anything else = stock XLA FFN.
+    num_microbatches: None resolves FLAGS_pp_accumulate_steps at build
+    time (same discipline), so a tuned profile's microbatch pin applies
+    without threading a ctor arg through every training entry.
     """
+    # apply any FLAGS_tuned_profile before the flag-backed knobs
+    # (microbatches, pallas_ffn) are resolved into the executable
+    from .. import tuner as _tuner
+    from .pipeline import runtime as _pprt  # noqa: F401 (defines pp_* flags)
+    _tuner.maybe_apply_flagged()
+    if num_microbatches is None:
+        num_microbatches = max(
+            1, int(flags.flag_value("pp_accumulate_steps")))
     if not isinstance(cfg, L.LlamaConfig):
         from .hybrid_generic import GenericHybridEngine
 

@@ -90,6 +90,11 @@ flags.define_flag("migration_monolithic_cooldown_s", 30.0,
 flags.define_flag("autoscale_ttft_p99_s", 0.0,
                   "SLO autoscaler: grow the decode pool when fleet TTFT "
                   "p99 exceeds this (0 disables the TTFT rule)")
+flags.define_flag("autoscale_tpot_p99_s", 0.0,
+                  "SLO autoscaler: grow the decode pool when fleet TPOT "
+                  "p99 exceeds this (0 disables the TPOT rule). TPOT is "
+                  "the decode pool's own latency, so unlike TTFT it "
+                  "breaches even when prefill is healthy")
 flags.define_flag("autoscale_shed_rate", 0.05,
                   "SLO autoscaler: grow the decode pool when the fleet "
                   "queue-shed rate exceeds this (deadline expiries do "
@@ -400,23 +405,27 @@ class FleetPrefixIndex:
 
 class PoolAutoscaler:
     """Grow/shrink the decode pool from the ``fleet_summary()`` SLO
-    digest. Grow when TTFT p99 or the QUEUE-shed rate breaches target;
-    shrink when comfortably below both. Deadline-expiry pressure is
-    surfaced in every decision emit but is never a grow signal: the
-    split ``fleet_summary`` fields exist so "queue too deep" (buy more
-    replicas) and "deadlines too tight" (no pool size helps) stay
-    distinguishable."""
+    digest. Grow when TTFT p99, TPOT p99 or the QUEUE-shed rate breaches
+    target; shrink when comfortably below all three. TPOT matters
+    because it is the decode pool's OWN latency: a saturated decode pool
+    with a healthy prefill pool never breaches TTFT, only TPOT.
+    Deadline-expiry pressure is surfaced in every decision emit but is
+    never a grow signal: the split ``fleet_summary`` fields exist so
+    "queue too deep" (buy more replicas) and "deadlines too tight" (no
+    pool size helps) stay distinguishable."""
 
     def __init__(self, router: "DisaggRouter",
                  ttft_p99_s: Optional[float] = None,
                  shed_rate: Optional[float] = None,
                  min_decode: Optional[int] = None,
                  max_decode: Optional[int] = None,
-                 cooldown_s: Optional[float] = None):
+                 cooldown_s: Optional[float] = None,
+                 tpot_p99_s: Optional[float] = None):
         def fl(v, name):
             return v if v is not None else flags.flag_value(name)
         self.router = router
         self.ttft_p99_s = float(fl(ttft_p99_s, "autoscale_ttft_p99_s"))
+        self.tpot_p99_s = float(fl(tpot_p99_s, "autoscale_tpot_p99_s"))
         self.shed_rate = float(fl(shed_rate, "autoscale_shed_rate"))
         self.min_decode = int(fl(min_decode, "autoscale_min_decode"))
         self.max_decode = int(fl(max_decode, "autoscale_max_decode"))
@@ -438,10 +447,12 @@ class PoolAutoscaler:
             summary = fleet.fleet_summary()
         pool = self.router.decode_pool_size()
         ttft = float(summary.get("ttft_p99_s", 0.0))
+        tpot = float(summary.get("tpot_p99_s", 0.0))
         shed_q = float(summary.get("shed_queue_rate",
                                    summary.get("shed_rate", 0.0)))
         deadline = int(summary.get("deadline_expired", 0))
         breach = ((self.ttft_p99_s > 0 and ttft > self.ttft_p99_s)
+                  or (self.tpot_p99_s > 0 and tpot > self.tpot_p99_s)
                   or (self.shed_rate > 0 and shed_q > self.shed_rate))
         if breach and pool < self.max_decode:
             self.router.grow_decode()
@@ -449,7 +460,9 @@ class PoolAutoscaler:
             decision = "grow"
         elif (not breach and pool > self.min_decode and shed_q == 0.0
               and (self.ttft_p99_s <= 0
-                   or ttft < 0.5 * self.ttft_p99_s)):
+                   or ttft < 0.5 * self.ttft_p99_s)
+              and (self.tpot_p99_s <= 0
+                   or tpot < 0.5 * self.tpot_p99_s)):
             self.router.shrink_decode()
             self.stats["shrinks"] += 1
             decision = "shrink"
@@ -458,7 +471,8 @@ class PoolAutoscaler:
             decision = "hold"
         _emit("autoscale.decision", direction=decision,
               pool=self.router.decode_pool_size(), ttft_p99_s=ttft,
-              shed_queue_rate=shed_q, deadline_expired=deadline)
+              tpot_p99_s=tpot, shed_queue_rate=shed_q,
+              deadline_expired=deadline)
         return decision
 
 

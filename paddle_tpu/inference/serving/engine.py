@@ -58,6 +58,19 @@ from .scheduler import (DeadlineExceededError, RejectedError, ScheduledBatch,
                         Scheduler, Sequence)
 from .slot_engine import Completion
 
+# step-geometry flags: the executable signature is keyed on
+# (token_budget, batch_slots), so these are exactly the knobs a tuned
+# profile (tuner/profile.py) pins per (model, topology). Ctor args left
+# at None read them, so applying a profile BEFORE engine construction
+# takes effect with zero steady-state retraces.
+flags.define_flag("serving_token_budget", 64,
+                  "Default token budget per scheduler tick (the padded "
+                  "token-vector length of the fused step executable) "
+                  "when the PagedServingEngine ctor leaves it unset.")
+flags.define_flag("serving_max_batch", 8,
+                  "Default concurrent sequence slots per step when the "
+                  "PagedServingEngine ctor leaves max_batch unset.")
+
 __all__ = ["PagedServingEngine", "TokenEvent", "RejectedError",
            "DeadlineExceededError"]
 
@@ -119,7 +132,8 @@ class PagedServingEngine:
 
     def __init__(self, cfg: L.LlamaConfig, params: Dict[str, Any],
                  num_blocks: Optional[int] = None, block_size: int = 16,
-                 max_batch: int = 8, token_budget: int = 64,
+                 max_batch: Optional[int] = None,
+                 token_budget: Optional[int] = None,
                  max_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None, top_k: int = 0,
                  max_queue: Optional[int] = None, cache_dtype=None,
@@ -131,6 +145,14 @@ class PagedServingEngine:
             raise NotImplementedError(
                 "PagedServingEngine serves dense LLaMA; route MoE decode "
                 "through LLMPredictor until the paged MoE step lands")
+        # apply any FLAGS_tuned_profile before geometry is resolved and
+        # executables are keyed, so a pinned profile is zero-retrace
+        from ... import tuner as _tuner
+        _tuner.maybe_apply_flagged()
+        if max_batch is None:
+            max_batch = int(flags.flag_value("serving_max_batch"))
+        if token_budget is None:
+            token_budget = int(flags.flag_value("serving_token_budget"))
         self.cfg = cfg
         if weight_dtype is not None:
             params = jax.tree.map(

@@ -314,6 +314,21 @@ _c_as_decisions = _C("paddle_autoscaler_decisions_total",
 _g_as_pool = _G("paddle_autoscaler_decode_pool",
                 "Accepting decode-pool replicas as of the last "
                 "autoscaler tick")
+_c_tune_cand = _C("paddle_tuner_candidates_total",
+                  "Autotuner candidates, by outcome (enumerated/pruned/"
+                  "infeasible/measured)")
+_g_tune_pred = _G("paddle_tuner_predicted_step_seconds",
+                  "Analytic cost of the last validated tuner finalist")
+_g_tune_meas = _G("paddle_tuner_measured_step_seconds",
+                  "Measured step time of the last validated tuner "
+                  "finalist")
+_g_tune_gap = _G("paddle_tuner_gap_ratio",
+                 "measured/predicted of the last validated tuner "
+                 "finalist — the cost model's live calibration error")
+_c_tune_profile = _C("paddle_tuner_profile_loads_total",
+                     "Tuned-profile load attempts, by result (ok/applied/"
+                     "crc_mismatch/bad_version/bad_format/parse_error/"
+                     "topology_mismatch)")
 _c_pp_sends = _C("paddle_pp_sends_total",
                  "Pipeline stage handoffs issued (activation/grad), by kind")
 _h_pp_send = _H("paddle_pp_send_seconds",
@@ -560,6 +575,12 @@ def _h_as_decision(dur_s, f):
     _g_as_pool.set(f.get("pool", 0))
 
 
+def _h_tuner_validate(dur_s, f):
+    _g_tune_pred.set(f.get("predicted_s", 0.0))
+    _g_tune_meas.set(f.get("measured_s", 0.0))
+    _g_tune_gap.set(f.get("gap_ratio", 0.0))
+
+
 _HANDLERS = {
     "dispatch.hit": _h_dispatch_hit,
     "dispatch.miss": _h_dispatch_miss,
@@ -640,6 +661,11 @@ _HANDLERS = {
         labels={"reason": f.get("reason", "")}),
     "migration.monolithic": lambda d, f: _c_mig_mono.inc(),
     "autoscale.decision": _h_as_decision,
+    "tuner.candidates": lambda d, f: _c_tune_cand.inc(
+        f.get("n", 1), labels={"outcome": f.get("outcome", "enumerated")}),
+    "tuner.validate": _h_tuner_validate,
+    "tuner.profile_load": lambda d, f: _c_tune_profile.inc(
+        labels={"result": f.get("result", "")}),
     "async.p2p": lambda d, f: _c_p2p.inc(),
     "pipeline.send": _h_pp_send_h,
     "pipeline.recv": _h_pp_recv,
@@ -895,6 +921,21 @@ def summary() -> dict:
             "autoscaler_shrinks": int(_c_as_decisions.value(
                 {"direction": "shrink"})),
             "decode_pool": int(_g_as_pool.value()),
+        },
+        "tuner": {
+            "candidates_enumerated": int(_c_tune_cand.value(
+                {"outcome": "enumerated"})),
+            "candidates_pruned": int(_c_tune_cand.value(
+                {"outcome": "pruned"})),
+            "candidates_measured": int(_c_tune_cand.value(
+                {"outcome": "measured"})),
+            "predicted_step_s": round(float(_g_tune_pred.value()), 6),
+            "measured_step_s": round(float(_g_tune_meas.value()), 6),
+            "gap_ratio": round(float(_g_tune_gap.value()), 4),
+            "profile_loads_ok": int(_c_tune_profile.value(
+                {"result": "ok"})),
+            "profiles_applied": int(_c_tune_profile.value(
+                {"result": "applied"})),
         },
     }
 

@@ -1,0 +1,278 @@
+"""Tuned-profile manifests + short-real-run validation of finalists.
+
+The offline half of the tuner ends here: the analytic search's top-k
+finalists each get a few real warm steps through a caller-supplied
+runner, the measured winner is pinned into a versioned CRC'd JSON
+manifest per (model, topology) — the same atomic-publish discipline as
+``inference/quant/manifest.py`` — and every serving/training entry
+consumes it at startup via ``FLAGS_tuned_profile``:
+
+- a torn write, hand-edit, or wrong-version file FAILS LOUDLY at load
+  (CRC over the canonical payload, explicit format + version);
+- a profile tuned for a different (model, topology) fails
+  :meth:`TunedProfile.validate_for` instead of silently applying a
+  config tuned for other hardware;
+- application is one ``flags.set_flags`` call made BEFORE executables
+  are built, so the steady state under an applied profile performs
+  zero retraces (gated by tools/tune_smoke.py).
+
+The predicted-vs-measured gap of every validated finalist feeds the
+``paddle_tuner_*`` metrics, so a cost model drifting away from the
+hardware shows up on the dashboard before it mis-ranks a search.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..core import flags
+from ..observability import emit as _emit
+from .cost_model import CostModel, Workload, machine_key
+from .search import Candidate, Ranked, search
+
+__all__ = ["TunedProfile", "save_profile", "load_profile", "apply_profile",
+           "maybe_apply_flagged", "validate_candidates", "tune",
+           "topology_signature", "PROFILE_VERSION", "PROFILE_FORMAT"]
+
+PROFILE_VERSION = 1
+PROFILE_FORMAT = "paddle-tpu-tuned-profile"
+
+
+def _canonical(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def topology_signature(platform: Optional[str] = None,
+                       n_devices: Optional[int] = None) -> Dict[str, object]:
+    """The (platform, device count, device kind) a profile is pinned to.
+    Absolute tuned timings only transfer within one machine class — the
+    same reasoning as the op-bench baseline key."""
+    import jax
+
+    devs = jax.devices()
+    return {"platform": platform or devs[0].platform,
+            "n_devices": int(n_devices if n_devices is not None
+                             else len(devs)),
+            "device_kind": getattr(devs[0], "device_kind", "") or ""}
+
+
+@dataclass
+class TunedProfile:
+    """One tuned (model, topology) pin: the winning flag assignment plus
+    the evidence that selected it."""
+    workload: str                     # Workload.name
+    topology: Dict[str, object]
+    flags: Dict[str, object]          # FLAGS_* name -> value
+    predicted_cost: float = 0.0
+    measured_s: float = 0.0
+    baseline_measured_s: float = 0.0  # the hand-picked incumbent's time
+    source_key: str = ""              # op-bench machine key of the costs
+    candidates_considered: int = 0
+    version: int = PROFILE_VERSION
+
+    def payload(self) -> dict:
+        return {"workload": self.workload, "topology": self.topology,
+                "flags": self.flags,
+                "predicted_cost": self.predicted_cost,
+                "measured_s": self.measured_s,
+                "baseline_measured_s": self.baseline_measured_s,
+                "source_key": self.source_key,
+                "candidates_considered": self.candidates_considered}
+
+    def candidate(self) -> Candidate:
+        return Candidate.from_flags(self.flags)
+
+    def validate_for(self, topology: Optional[Dict[str, object]] = None
+                     ) -> None:
+        """Raise ValueError when this profile was tuned on a different
+        (platform, device count) than the current process. device_kind
+        differences within a platform are tolerated only when one side
+        left it blank (CPU fallbacks record '')."""
+        want = dict(topology if topology is not None
+                    else topology_signature())
+        got = dict(self.topology)
+        mismatched = {}
+        for k in ("platform", "n_devices"):
+            if str(got.get(k)) != str(want.get(k)):
+                mismatched[k] = (got.get(k), want.get(k))
+        gk, wk = str(got.get("device_kind", "")), str(
+            want.get("device_kind", ""))
+        if gk and wk and gk != wk:
+            mismatched["device_kind"] = (gk, wk)
+        if mismatched:
+            _emit("tuner.profile_load", result="topology_mismatch")
+            raise ValueError(
+                f"tuned profile was pinned for a different topology: "
+                f"mismatched fields (profile, here) = {mismatched} — "
+                f"re-run the tuner on this machine class")
+
+
+def save_profile(profile: TunedProfile, path: str) -> str:
+    """Atomic write (tmp + fsync + os.replace) of the CRC'd manifest."""
+    payload = profile.payload()
+    doc = {"format": PROFILE_FORMAT, "version": int(profile.version),
+           "crc32": zlib.crc32(_canonical(payload)), "payload": payload}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tuned_profile_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_profile(path: str) -> TunedProfile:
+    """Load + verify a tuned profile; ValueError (after emitting the
+    failure kind) on unreadable/format/version/CRC problems."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _emit("tuner.profile_load", result="parse_error", path=str(path))
+        raise ValueError(f"tuned profile {path!r} unreadable: {e}") from e
+    if not isinstance(doc, dict) or doc.get("format") != PROFILE_FORMAT:
+        _emit("tuner.profile_load", result="bad_format", path=str(path))
+        raise ValueError(f"{path!r} is not a {PROFILE_FORMAT} file")
+    if int(doc.get("version", -1)) != PROFILE_VERSION:
+        _emit("tuner.profile_load", result="bad_version", path=str(path))
+        raise ValueError(
+            f"tuned profile {path!r} has version {doc.get('version')}; "
+            f"this build reads version {PROFILE_VERSION} — re-run the "
+            f"tuner")
+    payload = doc.get("payload") or {}
+    crc = zlib.crc32(_canonical(payload))
+    if crc != int(doc.get("crc32", -1)):
+        _emit("tuner.profile_load", result="crc_mismatch", path=str(path))
+        raise ValueError(
+            f"tuned profile {path!r} failed its CRC check (stored "
+            f"{doc.get('crc32')}, computed {crc}): the file is corrupt "
+            f"or was hand-edited — re-run the tuner")
+    _emit("tuner.profile_load", result="ok", path=str(path))
+    return TunedProfile(
+        workload=str(payload.get("workload", "")),
+        topology=dict(payload.get("topology") or {}),
+        flags=dict(payload.get("flags") or {}),
+        predicted_cost=float(payload.get("predicted_cost", 0.0)),
+        measured_s=float(payload.get("measured_s", 0.0)),
+        baseline_measured_s=float(payload.get("baseline_measured_s", 0.0)),
+        source_key=str(payload.get("source_key", "")),
+        candidates_considered=int(payload.get("candidates_considered", 0)),
+        version=int(doc["version"]))
+
+
+def apply_profile(profile, strict: bool = True) -> TunedProfile:
+    """Set the profile's flags process-wide (one ``flags.set_flags``
+    call). ``profile`` may be a path or a :class:`TunedProfile`.
+    strict=True validates the topology first — the default, because a
+    profile tuned elsewhere applying silently is exactly the failure
+    mode the manifest exists to prevent."""
+    if isinstance(profile, (str, os.PathLike)):
+        profile = load_profile(os.fspath(profile))
+    if strict:
+        profile.validate_for()
+    flags.set_flags(dict(profile.flags))
+    _emit("tuner.profile_load", result="applied",
+          workload=profile.workload)
+    return profile
+
+
+# path -> applied TunedProfile, so every consumer (bench, the train-step
+# factory, every PagedServingEngine ctor) can call maybe_apply_flagged()
+# without re-reading or re-applying the same manifest
+_applied = {"path": None, "profile": None}
+
+
+def maybe_apply_flagged() -> Optional[TunedProfile]:
+    """Apply ``FLAGS_tuned_profile`` if set and not yet applied this
+    process (idempotent per path; a flag change re-applies). Load and
+    topology failures raise — consumers opt into fail-loud startup by
+    setting the flag at all."""
+    path = str(flags.flag_value("tuned_profile") or "")
+    if not path:
+        return None
+    if _applied["path"] == path:
+        return _applied["profile"]
+    prof = apply_profile(path, strict=True)
+    # re-assert the path: apply_profile() would clobber it if a saved
+    # profile ever carried a tuned_profile flag of its own
+    if str(flags.flag_value("tuned_profile") or "") != path:
+        flags.set_flags({"tuned_profile": path})
+    _applied.update(path=path, profile=prof)
+    return prof
+
+
+def validate_candidates(finalists: List[Ranked],
+                        runner: Callable[[Candidate], float],
+                        steps: Optional[int] = None) -> List[Ranked]:
+    """Short real runs for each analytic finalist: ``runner(c)`` runs
+    ONE warm step/tick under candidate ``c`` (the caller owns warmup
+    and flag application) and returns its wall seconds; the median of
+    ``steps`` repeats is the measured cost. Emits the
+    predicted-vs-measured gap per finalist and returns the list
+    re-sorted by measurement (cheapest first)."""
+    import statistics
+
+    steps = int(steps if steps is not None
+                else flags.flag_value("tune_validation_steps"))
+    for r in finalists:
+        times = [float(runner(r.candidate)) for _ in range(max(1, steps))]
+        r.measured_s = statistics.median(times)
+        gap = (r.measured_s / r.cost) if r.cost > 0 else 0.0
+        _emit("tuner.validate", predicted_s=r.cost,
+              measured_s=r.measured_s, gap_ratio=gap,
+              candidate=r.candidate.describe())
+    _emit("tuner.candidates", outcome="measured", n=len(finalists))
+    finalists.sort(key=lambda r: r.measured_s)
+    return finalists
+
+
+def tune(model: CostModel, workload: Workload, axes: Dict[str, list],
+         runner: Callable[[Candidate], float],
+         topk: Optional[int] = None, prune_ratio: Optional[float] = None,
+         steps: Optional[int] = None,
+         out_path: Optional[str] = None) -> TunedProfile:
+    """End-to-end offline tune: enumerate -> analytic prune -> validate
+    the top-k with real runs -> pin the measured winner as a
+    :class:`TunedProfile` (saved when ``out_path`` is given)."""
+    from .search import enumerate_space
+
+    t0 = time.perf_counter()
+    cands = enumerate_space(axes)
+    finalists = search(model, workload, cands, topk=topk,
+                       prune_ratio=prune_ratio)
+    if not any(r.candidate == Candidate() for r in finalists):
+        # always measure the hand-picked incumbent too, so the profile's
+        # baseline_measured_s (the "did tuning actually win" evidence)
+        # is a real number even when the analytic ranking dropped it
+        finalists.append(Ranked(Candidate(),
+                                model.predict(workload, Candidate())))
+    finalists = validate_candidates(finalists, runner, steps=steps)
+    winner = finalists[0]
+    baseline = next((r for r in finalists
+                     if r.candidate == Candidate()), None)
+    prof = TunedProfile(
+        workload=workload.name, topology=topology_signature(),
+        flags=winner.candidate.to_flags(),
+        predicted_cost=winner.cost, measured_s=winner.measured_s,
+        baseline_measured_s=(baseline.measured_s if baseline else 0.0),
+        source_key=model.costs.key, candidates_considered=len(cands))
+    _emit("tuner.tune", dur_s=time.perf_counter() - t0,
+          workload=workload.name, winner=winner.candidate.describe())
+    if out_path:
+        save_profile(prof, out_path)
+    return prof

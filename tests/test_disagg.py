@@ -526,6 +526,48 @@ class TestAutoscaler:
                                  "deadline_expired": 500})
         assert d == "grow" and router.decode_pool_size() == 2
 
+    def test_grow_on_tpot_breach(self, tiny):
+        """TPOT is the decode pool's own latency: a saturated decode
+        pool behind a healthy prefill pool never breaches TTFT, so the
+        TPOT rule alone must buy a decode replica."""
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=1.0, shed_rate=0.05,
+                                tpot_p99_s=0.02, min_decode=1,
+                                max_decode=3, cooldown_s=0.0)
+        assert router.decode_pool_size() == 1
+        d = scaler.tick(summary={"ttft_p99_s": 0.01,   # TTFT healthy
+                                 "tpot_p99_s": 0.2,    # decode saturated
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "grow" and router.decode_pool_size() == 2
+        assert router.replicas[-1].role == "decode"
+        # shrink needs comfortable TPOT too: just-under-target holds
+        d = scaler.tick(summary={"ttft_p99_s": 0.01,
+                                 "tpot_p99_s": 0.015,  # < target, > half
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "hold" and router.decode_pool_size() == 2
+        d = scaler.tick(summary={"ttft_p99_s": 0.01,
+                                 "tpot_p99_s": 0.001,  # comfortable
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "shrink" and router.decode_pool_size() == 1
+
+    def test_tpot_rule_off_by_default(self, tiny):
+        """Default flag value 0.0 disables the TPOT rule entirely, so
+        pre-existing deployments keep their exact behavior."""
+        router = self._router(tiny)
+        scaler = PoolAutoscaler(router, ttft_p99_s=1.0, shed_rate=0.05,
+                                min_decode=1, max_decode=3,
+                                cooldown_s=0.0)
+        assert scaler.tpot_p99_s == 0.0
+        router.grow_decode()
+        d = scaler.tick(summary={"ttft_p99_s": 0.01,
+                                 "tpot_p99_s": 99.0,
+                                 "shed_queue_rate": 0.0,
+                                 "deadline_expired": 0})
+        assert d == "shrink"                     # TPOT ignored when off
+
     def test_cooldown_gates_decisions(self, tiny):
         router = self._router(tiny)
         scaler = PoolAutoscaler(router, ttft_p99_s=0.1, shed_rate=0.0,

@@ -641,6 +641,51 @@ def maybe_disagg_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/disagg_smoke.py)")
 
 
+_last_tune_smoke = [0.0]
+
+
+def maybe_tune_smoke(min_interval: float = 3600.0) -> None:
+    """Run the autotuner smoke (tools/tune_smoke.py) at most once per
+    min_interval and log a RED line on regression — the analytic top-1
+    disagreeing with the measured top-1 on the 3-candidate toy space,
+    the predicted-vs-measured gap blowing its budget (the cost model
+    drifting off the hardware), pruning discarding the measured winner,
+    a tuned-profile manifest failing its round-trip, or an engine under
+    an applied profile retracing in steady state."""
+    now = time.monotonic()
+    if _last_tune_smoke[0] and now - _last_tune_smoke[0] < min_interval:
+        return
+    _last_tune_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "tune_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: tune smoke hung >600s — a finalist's validation ticks "
+            "wedged (tools/tune_smoke.py)")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"tune smoke GREEN ({payload.get('wall_s')}s: "
+            f"top-1 '{payload.get('measured_top1')}' analytic==measured, "
+            f"gap x{payload.get('gap_ratio')}, "
+            f"{payload.get('steady_state_retraces')} retraces)")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: tune smoke regression rc={out.returncode} — {detail} "
+        f"(tools/tune_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -760,6 +805,7 @@ def main() -> None:
         maybe_pp_smoke()
         maybe_elastic_pp_smoke()
         maybe_disagg_smoke()
+        maybe_tune_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -777,6 +823,7 @@ def main() -> None:
             maybe_pp_smoke()
             maybe_elastic_pp_smoke()
             maybe_disagg_smoke()
+            maybe_tune_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing

@@ -55,6 +55,37 @@ RS = np.random.RandomState(0)
 OBS_OVERHEAD_BUDGET_PCT = 3.0
 OBS_OVERHEAD_FLOOR_US = 1.5
 
+# noise-aware gating: the RED threshold for an op widens by the measured
+# dispersion of BOTH sides of the comparison (the pin's rel-IQR recorded
+# at --update time plus the current run's), so an op that is simply noisy
+# on this machine class doesn't trip the gate at a fixed ratio while a
+# genuinely regressed quiet op still does. The widened threshold is
+# capped: past 4x even a noisy op is a real regression.
+NOISE_WIDEN_K = 2.0
+NOISE_WIDEN_CAP = 4.0
+
+
+def entry_time(entry):
+    """Pinned/measured seconds from either baseline format: the legacy
+    flat float or the {"t": ..., "noise": ...} dict."""
+    if isinstance(entry, (int, float)):
+        return float(entry)
+    if isinstance(entry, dict) and "t" in entry:
+        return float(entry["t"])
+    return None
+
+
+def entry_noise(entry) -> float:
+    if isinstance(entry, dict):
+        return float(entry.get("noise", 0.0))
+    return 0.0
+
+
+def effective_threshold(base: float, pin_entry, cur_entry) -> float:
+    widened = base + NOISE_WIDEN_K * (entry_noise(pin_entry)
+                                      + entry_noise(cur_entry))
+    return min(widened, max(base, NOISE_WIDEN_CAP))
+
 
 def measure_observability_overhead(batch: int = 2000, rounds: int = 7,
                                    attempts: int = 3):
@@ -372,7 +403,22 @@ def _basket():
     return eager, jitted
 
 
-def measure(reps: int = 20, warmup: int = 3, only=None):
+def _rel_iqr(times) -> float:
+    """Measurement dispersion as (q75 - q25) / median — scale-free, so
+    a 3us op and a 3ms tick report comparable noise, and robust to the
+    one-outlier reps that a shared-CI box produces."""
+    med = statistics.median(times)
+    if med <= 0 or len(times) < 4:
+        return 0.0
+    q = statistics.quantiles(times, n=4)
+    return max(0.0, (q[2] - q[0]) / med)
+
+
+def measure(reps: int = 20, warmup: int = 3, only=None, detail: bool = False):
+    """Median seconds per basket entry ({name: float}); broken entries
+    report {"error": ...}. detail=True returns {"t": median, "noise":
+    rel_IQR} per entry instead, so callers (the gate's --update path,
+    the tuner's OpCosts.refresh) can persist dispersion next to the pin."""
     out = {}
     eager, jitted = _basket()
     from paddle_tpu.ops import dispatch as _dispatch
@@ -396,7 +442,9 @@ def measure(reps: int = 20, warmup: int = 3, only=None):
                     lambda x: x.block_until_ready() if hasattr(
                         x, "block_until_ready") else x, jfn())
                 times.append(time.perf_counter() - t0)
-            out[name] = statistics.median(times)
+            med = statistics.median(times)
+            out[name] = ({"t": med, "noise": _rel_iqr(times)}
+                         if detail else med)
         except Exception as e:  # basket op broken counts as a failure too
             out[name] = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
     return out
@@ -419,7 +467,7 @@ def main():
     except AttributeError:  # non-Linux
         ncpu = os.cpu_count()
     key = f"{platform}/{ncpu}cpu"
-    current = measure(args.reps)
+    current = measure(args.reps, detail=True)
     from paddle_tpu.ops.dispatch import dispatch_cache_stats
 
     cache = dispatch_cache_stats()
@@ -432,7 +480,8 @@ def main():
                      indent=1))
 
     if args.update:
-        broken = {n: t for n, t in current.items() if isinstance(t, dict)}
+        broken = {n: t for n, t in current.items()
+                  if isinstance(t, dict) and "error" in t}
         if broken:
             print(f"[op-bench] refusing to pin a broken baseline: "
                   f"{sorted(broken)}", file=sys.stderr)
@@ -466,17 +515,22 @@ def main():
         failures.append(
             f"observability_overhead: {obs['overhead_pct']:.2f}% "
             f"> {OBS_OVERHEAD_BUDGET_PCT:.0f}% budget")
+    # per-op (current seconds, pinned seconds, effective threshold): the
+    # threshold widens by the recorded dispersion of the pin plus the
+    # current run, so "this op is noisy on this box" is structural state
+    # in the baseline, not a one-off --threshold bump someone hand-tunes
     ratios = {}
-    for name, t in current.items():
+    for name, cur in current.items():
         pinned = base.get(name)
-        if isinstance(t, dict):
-            failures.append(f"{name}: {t['error']}")
+        if isinstance(cur, dict) and "error" in cur:
+            failures.append(f"{name}: {cur['error']}")
             continue
-        if not isinstance(pinned, (int, float)):
+        t, p = entry_time(cur), entry_time(pinned)
+        if t is None or p is None:
             continue
-        ratios[name] = (t, pinned)
-    over = sorted(n for n, (t, p) in ratios.items()
-                  if t / p > args.threshold)
+        ratios[name] = (t, p, effective_threshold(args.threshold,
+                                                  pinned, cur))
+    over = sorted(n for n, (t, p, th) in ratios.items() if t / p > th)
     if over:
         # outlier tolerance: one shared-CI scheduler hiccup lands on one
         # measurement, a real regression lands on every one — re-measure
@@ -484,20 +538,22 @@ def main():
         # gate fails only on reproducible slowdowns
         print(f"[op-bench] re-measuring {len(over)} over-threshold op(s) "
               f"to rule out one-shot noise: {over}", file=sys.stderr)
-        retry = measure(args.reps, only=set(over))
+        retry = measure(args.reps, only=set(over), detail=True)
         for name in over:
-            t2 = retry.get(name)
-            if isinstance(t2, (int, float)):
-                ratios[name] = (min(ratios[name][0], t2),
-                                ratios[name][1])
-    for name, (t, pinned) in sorted(ratios.items()):
+            t2 = entry_time(retry.get(name))
+            if t2 is not None:
+                t, p, th = ratios[name]
+                ratios[name] = (min(t, t2), p, th)
+    for name, (t, pinned, th) in sorted(ratios.items()):
         ratio = t / pinned
-        flag = " <-- REGRESSION" if ratio > args.threshold else ""
+        flag = " <-- REGRESSION" if ratio > th else ""
+        widened = f", gate x{th:.2f}" if th != args.threshold else ""
         print(f"[op-bench] {name}: {t * 1e6:.0f}us vs pinned "
-              f"{pinned * 1e6:.0f}us (x{ratio:.2f}){flag}",
+              f"{pinned * 1e6:.0f}us (x{ratio:.2f}{widened}){flag}",
               file=sys.stderr)
-        if ratio > args.threshold:
-            failures.append(f"{name}: x{ratio:.2f} slower")
+        if ratio > th:
+            failures.append(f"{name}: x{ratio:.2f} slower "
+                            f"(noise-widened gate x{th:.2f})")
     if failures:
         print("[op-bench] FAILED: " + "; ".join(failures), file=sys.stderr)
         return 1
