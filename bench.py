@@ -777,6 +777,156 @@ def _serving_quant_details():
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
+def _serving_spec_details():
+    """Sub-config: speculative decoding (half-depth draft sharing the
+    target's own layer-prefix weights) vs the plain paged engine on the
+    same trace. red_signal fires on a greedy parity break, a dead
+    acceptance rate, or a steady-state retrace; tokens/s spec-vs-plain
+    is reported but NOT gated on CPU hosts (per-launch overhead the TPU
+    doesn't pay — tools/spec_smoke.py is the full gate with preemption
+    and failover drills)."""
+    from paddle_tpu.inference.serving import DraftModel, PagedServingEngine
+    from paddle_tpu.models import llama as L
+
+    try:
+        cfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=4, max_seq_len=96, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        dcfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                             intermediate_size=128, num_layers=1,
+                             num_heads=4, num_kv_heads=4, max_seq_len=96,
+                             dtype=jnp.float32)
+        dparams = {"embed": params["embed"],
+                   "final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"],
+                   "blocks": jax.tree.map(lambda a: a[:1], params["blocks"])}
+        n_req, new = 8, 8
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(1, cfg.vocab_size, size=12).tolist()
+                   for _ in range(n_req)]
+
+        def timed(eng):
+            [eng.submit(p, max_new_tokens=new) for p in prompts]
+            eng.run()                       # warm pass
+            best, outs = 0.0, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                rids = [eng.submit(p, max_new_tokens=new) for p in prompts]
+                out = {c.rid: c.output_tokens for c in eng.run()}
+                dt = time.perf_counter() - t0
+                best, outs = max(best, n_req * new / dt), [out[r]
+                                                           for r in rids]
+            return outs, best
+
+        def make(**kw):
+            return PagedServingEngine(cfg, params, num_blocks=96,
+                                      block_size=8, max_batch=8,
+                                      token_budget=32,
+                                      max_len=cfg.max_seq_len, **kw)
+
+        plain_out, plain_tps = timed(make())
+        spec = make(draft=DraftModel(dcfg, dparams), spec_k=3)
+        spec_out, spec_tps = timed(spec)
+        builds0 = spec.stats["step_builds"]
+        spec_out2, _ = timed(spec)
+        retraces = spec.stats["step_builds"] - builds0
+        acceptance = spec.spec.acceptance_rate
+        return {
+            "requests": n_req, "new_tokens": new, "spec_k": 3,
+            "spec_tokens_per_s": round(spec_tps, 1),
+            "plain_tokens_per_s": round(plain_tps, 1),
+            "ratio": round(spec_tps / plain_tps, 3) if plain_tps else None,
+            "parity": spec_out == plain_out and spec_out2 == plain_out,
+            "acceptance_rate": acceptance,
+            "spec_ticks": spec.stats["spec_ticks"],
+            "steady_state_retraces": retraces,
+            "red_signal": bool(spec_out != plain_out
+                               or spec_out2 != plain_out
+                               or acceptance <= 0.0 or retraces),
+        }
+    except Exception as e:  # noqa: BLE001 — keep the config measurable
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
+def _serving_adapters_details():
+    """Sub-config: multi-tenant LoRA hot-swap under the paged engine —
+    a mixed batch (base + two adapters of one rank class, more tenants
+    than needed to prove slot reuse) vs per-tenant reference runs.
+    red_signal fires when a base-row stream in the mixed batch is not
+    bit-identical to the adapter-off engine, when repeating the mixed
+    trace retraces the steady-state step, or when no swap was exercised
+    (tools/spec_smoke.py carries the chaos-evict drill)."""
+    from paddle_tpu.inference.serving import PagedServingEngine, make_adapter
+    from paddle_tpu.models import llama as L
+
+    try:
+        cfg = L.LlamaConfig(vocab_size=256, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            num_kv_heads=4, max_seq_len=96, dtype=jnp.float32)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        n_req, new = 9, 8
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(1, cfg.vocab_size, size=12).tolist()
+                   for _ in range(n_req)]
+        tenants = [None, "tenant-a", "tenant-b"] * (n_req // 3)
+
+        def make(**kw):
+            return PagedServingEngine(cfg, params, num_blocks=96,
+                                      block_size=8, max_batch=n_req,
+                                      token_budget=48,
+                                      max_len=cfg.max_seq_len, **kw)
+
+        base = make()
+        rids = [base.submit(p, max_new_tokens=new) for p in prompts]
+        ref = {c.rid: c.output_tokens for c in base.run()}
+        base_out = [ref[r] for r in rids]
+
+        eng = make(adapter_slots=2)
+        for name, seed in (("tenant-a", 3), ("tenant-b", 4)):
+            # scale up from the default 0.02: the delta must be strong
+            # enough to move every stream's greedy argmax, or the
+            # rows-diverge sanity check below is vacuous
+            eng.adapters.register(make_adapter(cfg, name, rank=4,
+                                               alpha=8.0, seed=seed,
+                                               scale=0.3))
+
+        def mixed():
+            t0 = time.perf_counter()
+            rids = [eng.submit(p, max_new_tokens=new,
+                               **({"adapter": t} if t else {}))
+                    for p, t in zip(prompts, tenants)]
+            out = {c.rid: c.output_tokens for c in eng.run()}
+            return [out[r] for r in rids], time.perf_counter() - t0
+
+        mix1, _ = mixed()               # warm: loads, traces the ad_sig step
+        builds0 = eng.stats["step_builds"]
+        mix2, wall = mixed()
+        retraces = eng.stats["step_builds"] - builds0
+        base_rows_equal = all(
+            m == b for m, b, t in zip(mix2, base_out, tenants) if t is None)
+        adapter_rows_differ = all(
+            m != b for m, b, t in zip(mix2, base_out, tenants)
+            if t is not None)
+        return {
+            "requests": n_req, "new_tokens": new, "tenants": 2,
+            "adapter_slots": 2,
+            "mixed_tokens_per_s": round(n_req * new / wall, 1),
+            "base_row_parity": base_rows_equal,
+            "adapter_rows_diverge": adapter_rows_differ,
+            "deterministic": mix1 == mix2,
+            "loads": eng.adapters.stats["loads"],
+            "hits": eng.adapters.stats["hits"],
+            "adapter_bytes_in_use": eng.adapters.bytes_in_use(),
+            "steady_state_retraces": retraces,
+            "red_signal": bool(not base_rows_equal
+                               or not adapter_rows_differ
+                               or mix1 != mix2 or retraces),
+        }
+    except Exception as e:  # noqa: BLE001 — keep the config measurable
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def bench_llama_decode():
     """tokens/s of the jitted cached decode step (inference/llm.py) — the
     serving-path analog of the reference's block/masked-MHA decode loop."""
@@ -837,6 +987,8 @@ def bench_llama_decode():
     details["llama_serving_paged"] = _serving_paged_details()
     details["llama_serving_router"] = _serving_router_details()
     details["llama_serving_quant"] = _serving_quant_details()
+    details["llama_serving_spec"] = _serving_spec_details()
+    details["llama_serving_adapters"] = _serving_adapters_details()
     return {
         "value": round(tps, 2), "unit": "decode_tokens/s/chip",
         "details": details,

@@ -50,6 +50,14 @@ one pointer check on the hot paths):
   by ``stage=``/``microbatch=``: e.g. ``pipeline:hang@stage=1`` hangs
   stage 1 so the ladder escalates and the distress dump names the
   stage/microbatch).
+- ``adapter`` — multi-tenant LoRA adapter faults at the serving
+  engine's per-tick residency check (``op=use``) and the
+  AdapterTransport's store choke points (``op=publish`` /
+  ``op=fetch``): ``evict`` (force-drop the adapter's device slot
+  mid-stream — the next tick must reload it, counted as a swap, and
+  the token stream must stay bit-exact), ``corrupt`` (flip wire-pack
+  bytes so the CRC check rejects the blob at publish/fetch), ``delay``
+  (sleep ``delay=`` s at the choke point).
 - ``migration`` — disagg KV page-transport faults at the offer/pull
   choke points (``op=offer`` / ``op=pull``; ``victim=`` filters on the
   SENDING replica id): ``drop`` (the payload is lost — offers never
@@ -107,7 +115,7 @@ class ChaosCollectiveTimeout(ChaosError, TimeoutError):
 
 
 _SITES = ("collective", "store", "dispatch", "fetch", "save", "serving",
-          "replica", "pipeline", "migration")
+          "replica", "pipeline", "migration", "adapter")
 # tpu-lint TPL009 cross-checks this table against the drill specs in the
 # test tree / smoke tools: adding a site:kind here without a drill that
 # fires it (or a drill naming a pair absent here) fails the lint gate.
@@ -121,6 +129,7 @@ _KINDS = {
     "replica": ("kill", "stall", "flap"),
     "pipeline": ("hang", "rank_dead"),
     "migration": ("drop", "delay", "corrupt", "rank_dead"),
+    "adapter": ("evict", "corrupt", "delay"),
 }
 
 _FLOAT_SELECTORS = ("delay", "prob")
@@ -463,6 +472,25 @@ def _migration_hook(op: str, victim: Optional[int] = None):
     return inj.kind
 
 
+def _adapter_hook(op: str, name: Optional[str] = None):
+    """Called by the serving engine's adapter residency check (op
+    'use', once per referenced adapter per tick) and by the
+    AdapterTransport store path (op 'publish'/'fetch'). 'delay' sleeps
+    in place; 'evict' and 'corrupt' are returned for the caller to
+    apply (force-drop the device slot / flip wire bytes so the CRC
+    trips). ``op=`` filters on the choke point; the adapter name rides
+    the injection's op selector namespace via ``op=<name>`` too."""
+    inj = _match("adapter", op=op)
+    if inj is None and name is not None:
+        inj = _match("adapter", op=name)
+    if inj is None:
+        return None
+    if inj.kind == "delay":
+        time.sleep(inj.delay)
+        return None
+    return inj.kind
+
+
 def _save_hook(phase: str):
     """Called by the checkpoint writers mid-write; 'crash' hard-kills the
     process (the kill -9 atomicity drill); 'rank_dead' revokes the
@@ -502,6 +530,9 @@ def _install():
     from ...inference.serving import disagg as serving_disagg
 
     serving_disagg.set_chaos_hook(_migration_hook)
+    from ...inference.serving import adapters as serving_adapters
+
+    serving_adapters.set_chaos_hook(_adapter_hook)
     from ..pipeline import runtime as pp_runtime
 
     pp_runtime.set_chaos_hook(_pipeline_hook)
@@ -527,6 +558,9 @@ def _uninstall():
     from ...inference.serving import disagg as serving_disagg
 
     serving_disagg.set_chaos_hook(None)
+    from ...inference.serving import adapters as serving_adapters
+
+    serving_adapters.set_chaos_hook(None)
     from ..pipeline import runtime as pp_runtime
 
     pp_runtime.set_chaos_hook(None)

@@ -1,0 +1,688 @@
+"""Multi-tenant LoRA adapter serving: paged, ref-counted adapter slots.
+
+Millions of users means thousands of fine-tuned variants over ONE base
+model, not one checkpoint per fleet. This module treats adapter weight
+sets the way the engine treats KV pages — as paged, ref-counted,
+LRU-evictable device resources:
+
+- :class:`LoraAdapter` — a named (rank, alpha) low-rank delta over the
+  attention projections (``wq``/``wk``/``wv``/``wo``), host-resident
+  numpy weights;
+- :func:`save_adapter` / :func:`load_adapter` — CRC'd versioned
+  manifest persistence following ``quant/manifest.py`` discipline
+  (atomic replace, typed load-result metrics, model-signature
+  validation);
+- :func:`pack_adapter` / :func:`unpack_adapter` — the wire codec for
+  fleet distribution (JSON header + raw arrays, CRC-checked, optionally
+  q8 block-scaled int8 via the quant_comm codec — the EQuARX wire);
+- :class:`AdapterTransport` — store-backed (or in-process) publish/
+  fetch plane the router prefetches over;
+- :class:`AdapterManager` — the BlockManager pattern applied to
+  adapters: a fixed number of device SLOTS per rank class, pin/unpin
+  refcounts while requests are in flight, refcount-0 residents parked
+  in LRU order and reclaimed on demand (a re-load after eviction counts
+  as a *swap*).
+
+Zero-retrace contract: every adapter of a rank class lives in the SAME
+stacked device arrays (``A [L, S, din, c]`` / ``B [L, S, c, dout]``, S =
+slots, c = padded rank), loaded by eager ``.at[:, slot].set`` writes.
+The jitted serving step takes the whole stack plus a per-token slot
+selector, so WHICH adapter a request uses is pure data — only the SET
+of active rank classes (and adapter-on vs -off) keys a new executable.
+
+Chaos site ``adapter`` (kinds ``evict``/``corrupt``/``delay``) drills
+mid-stream device eviction, wire corruption and slow prefetch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import flags
+from ...models import llama as L
+from ...observability import emit as _emit
+from ..quant.manifest import model_signature
+
+flags.define_flag("adapter_slots", 4,
+                  "Device adapter slots per rank class in the "
+                  "AdapterManager (the stacked-pack width S). More slots "
+                  "= fewer swaps, more device bytes.")
+flags.define_flag("adapter_wire_dtype", "",
+                  "Wire encoding for adapter distribution: '' ships "
+                  "float32 arrays, 'int8' rides the block-scaled q8 "
+                  "quant_comm codec (~3.6-3.9x fewer bytes).")
+
+__all__ = ["LoraAdapter", "AdapterManager", "AdapterTransport",
+           "AdapterMissingError", "NoAdapterSlotsError",
+           "AdapterCorruptError", "save_adapter", "load_adapter",
+           "pack_adapter", "unpack_adapter", "make_adapter",
+           "rank_class", "target_dims", "ADAPTER_TARGETS",
+           "ADAPTER_MANIFEST_FORMAT"]
+
+# the fixed target set every device pack covers (missing targets are
+# zero-filled — an all-zero delta is exactly 0.0, so partial adapters
+# share executables with full ones)
+ADAPTER_TARGETS = ("wq", "wk", "wv", "wo")
+
+ADAPTER_MANIFEST_FORMAT = "paddle-tpu-adapter-manifest"
+ADAPTER_MANIFEST_VERSION = 1
+
+# chaos harness hook (site "adapter"): installed by
+# distributed/fault_tolerance/chaos.py while a spec is active
+_CHAOS_HOOK = [None]
+
+
+def set_chaos_hook(fn):
+    _CHAOS_HOOK[0] = fn
+
+
+class AdapterMissingError(KeyError):
+    """The named adapter is not registered with this AdapterManager."""
+
+
+class NoAdapterSlotsError(RuntimeError):
+    """Every device slot of the rank class is pinned by in-flight
+    requests — nothing is LRU-evictable."""
+
+
+class AdapterCorruptError(ValueError):
+    """A wire blob or manifest failed its CRC/shape validation."""
+
+
+def rank_class(rank: int) -> int:
+    """Pad a LoRA rank up to its power-of-2 class (the executable key).
+    Ranks 3 and 4 share one compiled step; the pad columns are zero, so
+    the padded matmul is bit-identical to the unpadded one."""
+    r = max(1, int(rank))
+    return 1 << (r - 1).bit_length()
+
+
+def target_dims(cfg: L.LlamaConfig) -> Dict[str, Tuple[int, int]]:
+    """(din, dout) of each adapter target projection for this model."""
+    d = cfg.hidden_size
+    qo = cfg.num_heads * cfg.head_dim
+    kv = cfg.num_kv_heads * cfg.head_dim
+    return {"wq": (d, qo), "wk": (d, kv), "wv": (d, kv), "wo": (qo, d)}
+
+
+@dataclass
+class LoraAdapter:
+    """One named LoRA delta: per-target (A [L, din, r], B [L, r, dout])
+    float32 host arrays; the applied delta is ``scaling * (h @ A) @ B``
+    with ``scaling = alpha / rank`` (the reference LoRA convention)."""
+    name: str
+    rank: int
+    alpha: float
+    weights: Dict[str, Tuple[np.ndarray, np.ndarray]] = field(
+        default_factory=dict)
+    version: int = 1
+
+    @property
+    def scaling(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes + b.nbytes
+                       for a, b in self.weights.values()))
+
+    def validate_for(self, cfg: L.LlamaConfig) -> None:
+        dims = target_dims(cfg)
+        for t, (a, b) in self.weights.items():
+            if t not in dims:
+                raise ValueError(f"adapter {self.name!r}: unknown target "
+                                 f"{t!r} (serving covers {ADAPTER_TARGETS})")
+            din, dout = dims[t]
+            want_a = (cfg.num_layers, din, self.rank)
+            want_b = (cfg.num_layers, self.rank, dout)
+            if tuple(a.shape) != want_a or tuple(b.shape) != want_b:
+                raise ValueError(
+                    f"adapter {self.name!r} target {t}: A{tuple(a.shape)} "
+                    f"B{tuple(b.shape)} != expected A{want_a} B{want_b} "
+                    f"for this model")
+
+
+def make_adapter(cfg: L.LlamaConfig, name: str, rank: int = 4,
+                 alpha: Optional[float] = None,
+                 targets: Tuple[str, ...] = ADAPTER_TARGETS,
+                 seed: int = 0, scale: float = 0.02) -> LoraAdapter:
+    """Deterministic random adapter (tests/benches/smokes): A ~ N(0, scale),
+    B ~ N(0, scale) — a *nonzero* B so the delta actually changes logits."""
+    rng = np.random.default_rng(
+        zlib.crc32(name.encode("utf-8")) + int(seed))
+    dims = target_dims(cfg)
+    weights = {}
+    for t in targets:
+        din, dout = dims[t]
+        weights[t] = (
+            rng.standard_normal((cfg.num_layers, din, rank)).astype(
+                np.float32) * scale,
+            rng.standard_normal((cfg.num_layers, rank, dout)).astype(
+                np.float32) * scale)
+    return LoraAdapter(name=name, rank=int(rank),
+                       alpha=float(alpha if alpha is not None else rank),
+                       weights=weights)
+
+
+# ---------------------------------------------------------------------------
+# Manifest persistence — quant/manifest.py discipline: canonical JSON,
+# CRC32, atomic replace, typed load-result metrics, model signature.
+# ---------------------------------------------------------------------------
+
+def _canonical(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def save_adapter(adapter: LoraAdapter, cfg: L.LlamaConfig,
+                 path: str) -> str:
+    """Persist an adapter as a CRC'd versioned manifest. float32 values
+    round-trip json exactly (float64 is a superset), so load_adapter
+    reconstructs bit-identical arrays."""
+    adapter.validate_for(cfg)
+    payload = {
+        "name": adapter.name,
+        "rank": int(adapter.rank),
+        "alpha": float(adapter.alpha),
+        "adapter_version": int(adapter.version),
+        "model": model_signature(cfg),
+        "weights": {t: {"A": np.asarray(a, np.float32).tolist(),
+                        "B": np.asarray(b, np.float32).tolist()}
+                    for t, (a, b) in sorted(adapter.weights.items())},
+    }
+    doc = {"format": ADAPTER_MANIFEST_FORMAT,
+           "version": ADAPTER_MANIFEST_VERSION,
+           "crc32": zlib.crc32(_canonical(payload)) & 0xFFFFFFFF,
+           "payload": payload}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".adapter_manifest_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_adapter(path: str,
+                 cfg: Optional[L.LlamaConfig] = None) -> LoraAdapter:
+    """Load + validate an adapter manifest. Every outcome lands in
+    ``paddle_adapter_manifest_loads_total`` by result before the typed
+    ValueError raises (parse_error / bad_format / bad_version /
+    crc_mismatch / signature_mismatch / ok)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _emit("adapter.manifest_load", result="parse_error", path=path)
+        raise ValueError(f"unreadable adapter manifest {path}: {e}") from e
+    if doc.get("format") != ADAPTER_MANIFEST_FORMAT:
+        _emit("adapter.manifest_load", result="bad_format", path=path)
+        raise ValueError(f"{path}: format {doc.get('format')!r} is not "
+                         f"{ADAPTER_MANIFEST_FORMAT!r}")
+    if doc.get("version") != ADAPTER_MANIFEST_VERSION:
+        _emit("adapter.manifest_load", result="bad_version", path=path)
+        raise ValueError(f"{path}: manifest version {doc.get('version')} "
+                         f"!= {ADAPTER_MANIFEST_VERSION}")
+    payload = doc.get("payload") or {}
+    if (zlib.crc32(_canonical(payload)) & 0xFFFFFFFF) != doc.get("crc32"):
+        _emit("adapter.manifest_load", result="crc_mismatch", path=path)
+        raise ValueError(f"{path}: adapter manifest CRC mismatch "
+                         f"(truncated or hand-edited)")
+    adapter = LoraAdapter(
+        name=str(payload["name"]), rank=int(payload["rank"]),
+        alpha=float(payload["alpha"]),
+        version=int(payload.get("adapter_version", 1)),
+        weights={t: (np.asarray(w["A"], np.float32),
+                     np.asarray(w["B"], np.float32))
+                 for t, w in payload.get("weights", {}).items()})
+    if cfg is not None:
+        if payload.get("model") != model_signature(cfg):
+            _emit("adapter.manifest_load", result="signature_mismatch",
+                  path=path)
+            raise ValueError(
+                f"{path}: adapter was built for a different model "
+                f"(signature {payload.get('model')} != "
+                f"{model_signature(cfg)})")
+        adapter.validate_for(cfg)
+    _emit("adapter.manifest_load", result="ok", path=path)
+    return adapter
+
+
+# ---------------------------------------------------------------------------
+# Wire codec — disagg.pack_pages discipline: one JSON header line + raw
+# array bytes, CRC over the body, optional q8 block-scaled int8 payload.
+# ---------------------------------------------------------------------------
+
+def _resolve_wire(wire: Optional[str]) -> str:
+    if wire is None:
+        wire = str(flags.flag_value("adapter_wire_dtype"))
+    if wire not in ("", "raw", "int8"):
+        raise ValueError(f"adapter_wire_dtype={wire!r} (want '' or 'int8')")
+    return "int8" if wire == "int8" else "raw"
+
+
+def pack_adapter(adapter: LoraAdapter, wire: Optional[str] = None) -> bytes:
+    """Serialize an adapter for the fleet wire. ``wire='int8'`` encodes
+    each array through the quant_comm block-scaled q8 codec (payload +
+    f32 block scales — the same EQuARX wire the DP reducer and disagg
+    page transport ride)."""
+    wire = _resolve_wire(wire)
+    fields: List[dict] = []
+    parts: List[bytes] = []
+    for t, (a, b) in sorted(adapter.weights.items()):
+        for side, arr in (("A", a), ("B", b)):
+            flat = np.asarray(arr, np.float32).reshape(-1)
+            if wire == "int8":
+                from ...distributed import quant_comm as QC
+                block = QC.block_size()
+                qpadded, nblocks, wire_len = QC.wire_layout(flat.size,
+                                                            block)
+                padded = np.zeros((qpadded,), np.float32)
+                padded[:flat.size] = flat
+                # encode_flat returns (int8 wire incl. trailing scale
+                # bytes, error-feedback residual); one-shot shipping
+                # drops the residual
+                w8 = np.asarray(
+                    QC.encode_flat(jnp.asarray(padded), block)[0], np.int8)
+                payload = w8.tobytes()
+                fields.append({"t": t, "s": side,
+                               "shape": list(arr.shape),
+                               "numel": int(flat.size),
+                               "nblocks": int(nblocks),
+                               "bytes": len(payload)})
+            else:
+                payload = flat.tobytes()
+                fields.append({"t": t, "s": side,
+                               "shape": list(arr.shape),
+                               "numel": int(flat.size),
+                               "bytes": len(payload)})
+            parts.append(payload)
+    body = b"".join(parts)
+    header = {"v": 1, "name": adapter.name, "rank": int(adapter.rank),
+              "alpha": float(adapter.alpha),
+              "adapter_version": int(adapter.version), "wire": wire,
+              "fields": fields, "crc": zlib.crc32(body) & 0xFFFFFFFF}
+    return json.dumps(header).encode("utf-8") + b"\n" + body
+
+
+def unpack_adapter(blob: bytes) -> LoraAdapter:
+    """Inverse of :func:`pack_adapter`. Raises
+    :class:`AdapterCorruptError` on CRC/layout damage — the prefetch
+    path surfaces it as result="corrupt" and falls back."""
+    try:
+        nl = blob.index(b"\n")
+        header = json.loads(blob[:nl].decode("utf-8"))
+        body = blob[nl + 1:]
+    except (ValueError, UnicodeDecodeError) as e:
+        raise AdapterCorruptError(f"unparseable adapter wire blob: {e}") \
+            from e
+    if (zlib.crc32(body) & 0xFFFFFFFF) != header.get("crc"):
+        raise AdapterCorruptError(
+            f"adapter wire CRC mismatch for {header.get('name')!r}")
+    wire = header.get("wire", "raw")
+    weights: Dict[str, Any] = {}
+    off = 0
+    for fld in header["fields"]:
+        raw = body[off:off + fld["bytes"]]
+        off += fld["bytes"]
+        numel = int(fld["numel"])
+        if wire == "int8":
+            from ...distributed import quant_comm as QC
+            block = QC.block_size()
+            qpadded, nblocks, wire_len = QC.wire_layout(numel, block)
+            w8 = np.frombuffer(raw, np.int8)
+            if w8.size != wire_len:
+                raise AdapterCorruptError(
+                    f"adapter q8 payload layout damaged for "
+                    f"{header.get('name')!r}")
+            flat = np.asarray(QC.decode_flat(
+                jnp.asarray(w8), int(nblocks), block))[:numel]
+        else:
+            flat = np.frombuffer(raw, np.float32)
+            if flat.size != numel:
+                raise AdapterCorruptError(
+                    f"adapter raw payload truncated for "
+                    f"{header.get('name')!r}")
+        arr = np.asarray(flat, np.float32).reshape(fld["shape"])
+        weights.setdefault(fld["t"], {})[fld["s"]] = arr
+    return LoraAdapter(
+        name=str(header["name"]), rank=int(header["rank"]),
+        alpha=float(header["alpha"]),
+        version=int(header.get("adapter_version", 1)),
+        weights={t: (w["A"], w["B"]) for t, w in weights.items()})
+
+
+def _flip_tail(blob: bytes) -> bytes:
+    """Chaos `adapter:corrupt` damage model: flip the last body byte."""
+    if not blob:
+        return blob
+    return blob[:-1] + bytes([blob[-1] ^ 0xFF])
+
+
+class AdapterTransport:
+    """Publish/fetch plane for adapter distribution: a TCPStore when the
+    fleet spans processes, an in-process dict otherwise. The chaos
+    ``adapter`` site drills both directions (``op=publish`` /
+    ``op=fetch``): ``corrupt`` flips a payload byte (the CRC rejects
+    it), ``delay`` sleeps inside the hook."""
+
+    def __init__(self, store=None, prefix: str = "adapters"):
+        self.store = store
+        self.prefix = prefix
+        self._local: Dict[str, bytes] = {}
+        self.stats = {"publishes": 0, "fetches": 0, "wire_bytes": 0}
+
+    def _key(self, name: str) -> str:
+        return f"{self.prefix}/{name}"
+
+    def publish(self, adapter: LoraAdapter,
+                wire: Optional[str] = None) -> int:
+        blob = pack_adapter(adapter, wire=wire)
+        hook = _CHAOS_HOOK[0]
+        if hook is not None and hook("publish", name=adapter.name) \
+                == "corrupt":
+            blob = _flip_tail(blob)
+        if self.store is not None:
+            self.store.set(self._key(adapter.name), blob)
+        else:
+            self._local[adapter.name] = blob
+        self.stats["publishes"] += 1
+        self.stats["wire_bytes"] += len(blob)
+        return len(blob)
+
+    def fetch(self, name: str) -> Optional[LoraAdapter]:
+        """Pull + decode one adapter; None when unpublished, raises
+        :class:`AdapterCorruptError` on wire damage."""
+        if self.store is not None:
+            try:
+                blob = self.store.get(self._key(name))
+            except Exception:
+                blob = None
+        else:
+            blob = self._local.get(name)
+        if blob is None:
+            return None
+        hook = _CHAOS_HOOK[0]
+        if hook is not None and hook("fetch", name=name) == "corrupt":
+            blob = _flip_tail(blob)
+        self.stats["fetches"] += 1
+        return unpack_adapter(bytes(blob))
+
+
+# ---------------------------------------------------------------------------
+# AdapterManager — paged, ref-counted, LRU-evictable device residency.
+# ---------------------------------------------------------------------------
+
+class _RankClassPack:
+    """Stacked device arrays for one rank class: per target
+    A [L, S, din, c], B [L, S, c, dout] (S slots, c padded rank).
+    Allocated lazily on the first adapter of the class."""
+
+    def __init__(self, cfg: L.LlamaConfig, cls: int, slots: int):
+        self.cls = int(cls)
+        self.slots = int(slots)
+        self.slot_names: List[Optional[str]] = [None] * self.slots
+        self.packs: Dict[str, Tuple[Any, Any]] = {}
+        for t, (din, dout) in target_dims(cfg).items():
+            self.packs[t] = (
+                jnp.zeros((cfg.num_layers, self.slots, din, cls),
+                          jnp.float32),
+                jnp.zeros((cfg.num_layers, self.slots, cls, dout),
+                          jnp.float32))
+
+    @property
+    def nbytes_total(self) -> int:
+        return int(sum(a.size * 4 + b.size * 4
+                       for a, b in self.packs.values()))
+
+    @property
+    def nbytes_per_slot(self) -> int:
+        return self.nbytes_total // max(1, self.slots)
+
+    def write_slot(self, slot: int, adapter: LoraAdapter) -> None:
+        """Eager zero-retrace slot load: pad rank -> class with zeros
+        (exactly preserves the un-padded matmul), zero-fill targets the
+        adapter does not carry (delta is exactly 0.0 there)."""
+        r = adapter.rank
+        for t, (a_dev, b_dev) in self.packs.items():
+            lw = adapter.weights.get(t)
+            a_host = np.zeros(
+                (a_dev.shape[0], a_dev.shape[2], self.cls), np.float32)
+            b_host = np.zeros(
+                (b_dev.shape[0], self.cls, b_dev.shape[3]), np.float32)
+            if lw is not None:
+                a_host[:, :, :r] = lw[0]
+                b_host[:, :r, :] = lw[1]
+            self.packs[t] = (
+                a_dev.at[:, slot].set(jnp.asarray(a_host)),
+                b_dev.at[:, slot].set(jnp.asarray(b_host)))
+
+
+class AdapterManager:
+    """N LoRA adapters as paged device resources (the BlockManager
+    pattern): :meth:`register` makes an adapter known (host copy),
+    :meth:`pin`/:meth:`unpin` refcount it while requests are in flight,
+    :meth:`ensure_loaded` places it in a device slot of its rank class —
+    evicting the LRU refcount-0 resident when the class is full
+    (:class:`NoAdapterSlotsError` when every slot is pinned). The host
+    copy survives device eviction, so a chaos mid-stream evict re-pins
+    bit-identically on the next tick."""
+
+    def __init__(self, cfg: L.LlamaConfig, slots: Optional[int] = None):
+        self.cfg = cfg
+        self.slots = int(slots if slots is not None
+                         else flags.flag_value("adapter_slots"))
+        if self.slots < 1:
+            raise ValueError(f"adapter_slots={self.slots} (want >= 1)")
+        self._registry: Dict[str, LoraAdapter] = {}
+        self._classes: Dict[int, _RankClassPack] = {}
+        self._resident: Dict[str, Tuple[int, int]] = {}   # name -> (cls, slot)
+        self._refs: Dict[str, int] = {}
+        # refcount-0 residents in eviction order (oldest first)
+        self._lru: "OrderedDict[str, None]" = OrderedDict()
+        self._ever_loaded: set = set()
+        self.stats = {"registered": 0, "loads": 0, "swaps": 0,
+                      "evictions": 0, "hits": 0, "pins": 0, "unpins": 0,
+                      "prefetches": 0}
+
+    # -- registry ---------------------------------------------------------
+    def register(self, adapter: LoraAdapter) -> None:
+        """Make an adapter known (host-resident). Re-registering the same
+        name replaces the host copy and drops stale device residency."""
+        adapter.validate_for(self.cfg)
+        if adapter.name in self._refs and self._refs[adapter.name] > 0:
+            raise ValueError(
+                f"adapter {adapter.name!r} is pinned by in-flight "
+                f"requests; drain before replacing it")
+        if adapter.name in self._resident:
+            self.evict_device(adapter.name, why="replace")
+        self._registry[adapter.name] = adapter
+        self.stats["registered"] += 1
+        _emit("adapter.register", adapter=adapter.name,
+              rank=adapter.rank, bytes=adapter.nbytes)
+
+    def registered(self, name: str) -> bool:
+        return name in self._registry
+
+    def names(self) -> List[str]:
+        return sorted(self._registry)
+
+    def get(self, name: str) -> LoraAdapter:
+        a = self._registry.get(name)
+        if a is None:
+            raise AdapterMissingError(name)
+        return a
+
+    def has(self, name: str) -> bool:
+        """Device-resident right now (the router's placement signal)."""
+        return name in self._resident
+
+    # -- refcounts --------------------------------------------------------
+    def pin(self, name: str) -> None:
+        """Take a reference for an in-flight request. Unknown names raise
+        :class:`AdapterMissingError` BEFORE any count moves (TPL010:
+        nothing to roll back)."""
+        if name not in self._registry:
+            raise AdapterMissingError(name)
+        self._refs[name] = self._refs.get(name, 0) + 1
+        self.stats["pins"] += 1
+        # a pinned resident is no longer evictable
+        self._lru.pop(name, None)
+
+    def unpin(self, name: str) -> None:
+        n = self._refs.get(name, 0)
+        if n <= 0:
+            raise ValueError(f"unpin of unpinned adapter {name!r}")
+        n -= 1
+        self._refs[name] = n
+        self.stats["unpins"] += 1
+        if n == 0 and name in self._resident:
+            self._lru[name] = None   # becomes LRU-evictable
+
+    def ref_count(self, name: str) -> int:
+        return self._refs.get(name, 0)
+
+    # -- device residency -------------------------------------------------
+    def _class_for(self, name: str) -> int:
+        return rank_class(self.get(name).rank)
+
+    def ensure_loaded(self, name: str) -> Tuple[int, int]:
+        """Place `name` in a device slot of its rank class, loading (and
+        LRU-evicting) as needed. Returns (rank_class, slot)."""
+        loc = self._resident.get(name)
+        if loc is not None:
+            self.stats["hits"] += 1
+            _emit("adapter.use", adapter=name)
+            return loc
+        adapter = self.get(name)
+        cls = rank_class(adapter.rank)
+        pack = self._classes.get(cls)
+        if pack is None:
+            pack = _RankClassPack(self.cfg, cls, self.slots)
+            self._classes[cls] = pack
+        slot = next((s for s, n in enumerate(pack.slot_names)
+                     if n is None), None)
+        if slot is None:
+            victim = next((n for n in self._lru
+                           if self._resident.get(n, (None,))[0] == cls),
+                          None)
+            if victim is None:
+                raise NoAdapterSlotsError(
+                    f"all {self.slots} rank-{cls} adapter slots are "
+                    f"pinned; raise adapter_slots or drain traffic")
+            slot = self._resident[victim][1]
+            self.evict_device(victim, why="lru")
+        pack.write_slot(slot, adapter)
+        pack.slot_names[slot] = name
+        self._resident[name] = (cls, slot)
+        if self._refs.get(name, 0) == 0:
+            self._lru[name] = None
+        swap = name in self._ever_loaded
+        self._ever_loaded.add(name)
+        self.stats["loads"] += 1
+        if swap:
+            self.stats["swaps"] += 1
+        _emit("adapter.load", adapter=name, rank_class=cls, slot=slot,
+              swap=swap)
+        self._emit_gauges()
+        return cls, slot
+
+    def evict_device(self, name: str, why: str = "lru") -> bool:
+        """Drop device residency (the host copy stays, so a later
+        ensure_loaded re-pins bit-identically and counts a swap). Chaos
+        uses this mid-stream: a pinned adapter may be force-evicted and
+        simply reloads on the next tick."""
+        loc = self._resident.pop(name, None)
+        if loc is None:
+            return False
+        cls, slot = loc
+        self._classes[cls].slot_names[slot] = None
+        self._lru.pop(name, None)
+        self.stats["evictions"] += 1
+        _emit("adapter.evict", adapter=name, reason=why)
+        self._emit_gauges()
+        return True
+
+    def device_packs(self, cls: int) -> Dict[str, Tuple[Any, Any]]:
+        return self._classes[cls].packs
+
+    def slot_of(self, name: str) -> Tuple[int, int]:
+        loc = self._resident.get(name)
+        if loc is None:
+            raise AdapterMissingError(name)
+        return loc
+
+    # -- fleet distribution -----------------------------------------------
+    def prefetch(self, name: str, transport: AdapterTransport) -> str:
+        """Pull an unregistered adapter over the store transport. Returns
+        the result kind (``registered``/``ok``/``miss``/``corrupt``),
+        mirrored into ``paddle_adapter_prefetches_total``."""
+        if name in self._registry:
+            _emit("adapter.prefetch", adapter=name, result="registered")
+            return "registered"
+        self.stats["prefetches"] += 1
+        try:
+            adapter = transport.fetch(name)
+        except AdapterCorruptError:
+            _emit("adapter.prefetch", adapter=name, result="corrupt")
+            return "corrupt"
+        if adapter is None or adapter.name != name:
+            _emit("adapter.prefetch", adapter=name, result="miss")
+            return "miss"
+        self.register(adapter)
+        _emit("adapter.prefetch", adapter=name, result="ok")
+        return "ok"
+
+    # -- accounting -------------------------------------------------------
+    def bytes_total(self) -> int:
+        """Device bytes of every allocated rank-class pack (slots are
+        pre-allocated like the KV pool, so empty slots still cost)."""
+        return int(sum(p.nbytes_total for p in self._classes.values()))
+
+    def bytes_in_use(self) -> int:
+        """Device bytes behind OCCUPIED slots — what a replica stuffed
+        with adapters actually spends (feeds the BlockManager byte
+        gauges and the router's least-loaded tiebreak)."""
+        return int(sum(
+            p.nbytes_per_slot * sum(n is not None for n in p.slot_names)
+            for p in self._classes.values()))
+
+    def num_resident(self) -> int:
+        return len(self._resident)
+
+    def _emit_gauges(self):
+        _emit("adapter.gauges", resident=len(self._resident),
+              bytes_in_use=self.bytes_in_use(),
+              bytes_total=self.bytes_total())
+
+    def snapshot(self) -> dict:
+        """Distress-dump / replica-snapshot section."""
+        return {
+            "slots_per_class": self.slots,
+            "registered": self.names(),
+            "resident": {n: {"rank_class": c, "slot": s,
+                             "refs": self._refs.get(n, 0)}
+                         for n, (c, s) in sorted(self._resident.items())},
+            "lru": list(self._lru),
+            "bytes_in_use": self.bytes_in_use(),
+            "bytes_total": self.bytes_total(),
+            # stats' "registered" counter would clobber the name list
+            **{("registrations" if k == "registered" else k): v
+               for k, v in self.stats.items()},
+        }

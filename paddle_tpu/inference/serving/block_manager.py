@@ -76,6 +76,11 @@ class BlockManager:
         # is released by take_copies() or by purging the pair when the
         # owning sequence is freed first (cancel mid-chunked-prefill).
         self._pending_copies: List[Tuple[int, int]] = []
+        # optional () -> (in_use, total) callback for NON-KV paged device
+        # residency sharing this pool's byte gauges (today: the
+        # AdapterManager's slot packs) — so a replica stuffed with
+        # adapters is never scored as empty by the router's byte tiebreak
+        self.extra_bytes = None
         self.stats = {"allocs": 0, "frees": 0, "prefix_hit_blocks": 0,
                       "prefix_hit_tokens": 0, "cow_copies": 0,
                       "cache_evictions": 0, "cow_purged": 0,
@@ -93,12 +98,16 @@ class BlockManager:
 
     def bytes_total(self) -> int:
         """Device bytes of the whole page pool (0 when the engine did not
-        report a page size — e.g. unit tests building bare managers)."""
-        return self.num_blocks * self.page_bytes
+        report a page size — e.g. unit tests building bare managers),
+        plus any registered extra paged residency (adapter slot packs)."""
+        extra = self.extra_bytes()[1] if self.extra_bytes else 0
+        return self.num_blocks * self.page_bytes + extra
 
     def bytes_in_use(self) -> int:
-        """Device bytes behind allocated pages, dtype-aware."""
-        return self.num_allocated() * self.page_bytes
+        """Device bytes behind allocated pages, dtype-aware, plus any
+        registered extra paged residency (adapter slot packs)."""
+        extra = self.extra_bytes()[0] if self.extra_bytes else 0
+        return self.num_allocated() * self.page_bytes + extra
 
     def blocks_needed(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)

@@ -53,7 +53,9 @@ from ...ops.pallas import fused_ffn as FF
 from ...ops.pallas import fused_sample as FS
 from ...ops.pallas import paged_attention as PA
 from .. import quant as Q
-from .block_manager import BlockManager
+from . import adapters as AD
+from . import speculative as SP
+from .block_manager import BlockManager, NoFreeBlocksError
 from .scheduler import (DeadlineExceededError, RejectedError, ScheduledBatch,
                         Scheduler, Sequence)
 from .slot_engine import Completion
@@ -140,7 +142,10 @@ class PagedServingEngine:
                  weight_dtype=None, quant_mode: Optional[str] = None,
                  quant_kv: Optional[bool] = None, quant_manifest=None,
                  pallas: Optional[bool] = None,
-                 pallas_ffn: Optional[bool] = None):
+                 pallas_ffn: Optional[bool] = None,
+                 adapter_slots: Optional[int] = None,
+                 draft: Optional[Any] = None,
+                 spec_k: Optional[int] = None):
         if cfg.num_experts:
             raise NotImplementedError(
                 "PagedServingEngine serves dense LLaMA; route MoE decode "
@@ -216,7 +221,32 @@ class PagedServingEngine:
         self.stats = {"steps": 0, "step_builds": 0, "tokens_computed": 0,
                       "cow_block_copies": 0, "pallas_steps": 0,
                       "decode_fast_steps": 0, "ffn_steps": 0,
-                      "fused_ticks": 0, "tick_pallas_launches": 0}
+                      "fused_ticks": 0, "tick_pallas_launches": 0,
+                      "spec_ticks": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
+        # multi-tenant LoRA adapters: paged ref-counted device slots.
+        # Always constructed (device packs allocate lazily on the first
+        # registered adapter), so submit(adapter=...) works out of the box
+        self.adapters = AD.AdapterManager(cfg, slots=adapter_slots)
+        # adapter residency shares the KV pool's byte gauges so the
+        # router's least-loaded byte tiebreak sees the real footprint
+        self.blocks.extra_bytes = lambda: (self.adapters.bytes_in_use(),
+                                           self.adapters.bytes_total())
+        # speculative decoding: a DraftModel (or a (cfg, params) pair)
+        # sharing this engine's paged-KV geometry; spec_k=0 disables
+        self.spec: Optional[SP.DraftModel] = None
+        self.spec_k = int(spec_k) if spec_k is not None \
+            else int(flags.flag_value("spec_k"))
+        if draft is not None:
+            self.spec = (draft if isinstance(draft, SP.DraftModel)
+                         else SP.DraftModel(*draft))
+            self.spec.bind(self)
+        # post-mortem sections (router precedent: last engine wins the
+        # name — fleets snapshot through the router section instead)
+        from ...observability import register_distress_section
+        register_distress_section("adapters", self.adapters.snapshot)
+        if self.spec is not None:
+            register_distress_section("spec", self.spec.snapshot)
         # pallas attention read: None = FLAGS_serving_pallas_attention
         # (re-read each tick, so flips retrace via the executable key);
         # True = force (interpret mode off-TPU — how CPU CI drives it);
@@ -300,9 +330,12 @@ class PagedServingEngine:
                deadline_s: Optional[float] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
-               seed: int = 0, trace: Optional[Tuple[int, int]] = None) -> int:
+               seed: int = 0, trace: Optional[Tuple[int, int]] = None,
+               adapter: Optional[str] = None) -> int:
         """Enqueue a request. Raises ValueError when it cannot ever fit,
-        RejectedError (load shed) when the wait queue is full.
+        RejectedError (load shed) when the wait queue is full,
+        :class:`~.adapters.AdapterMissingError` when ``adapter`` names an
+        unregistered LoRA adapter (pinned while the request is live).
 
         ``trace``: optional ``(trace_id, parent_span_id)`` context (the
         router's per-request trace) — rides the Sequence as two host
@@ -344,7 +377,19 @@ class PagedServingEngine:
         if trace is not None:
             seq.trace_id, seq.parent_span = int(trace[0]), int(trace[1])
         seq._key = jax.random.PRNGKey(int(seed)) if sample else None
-        self.scheduler.add_request(seq)   # raises RejectedError on overflow
+        if adapter is not None:
+            # pin BEFORE enqueue (AdapterMissingError moves no counts);
+            # unpinned on every completion path via _record_completion
+            self.adapters.pin(adapter)
+            seq.adapter = adapter
+            seq._adapter_pinned = True
+        try:
+            self.scheduler.add_request(seq)   # RejectedError on overflow
+        except BaseException:
+            if adapter is not None:
+                seq._adapter_pinned = False
+                self.adapters.unpin(adapter)
+            raise
         self._update_gauges()
         return rid
 
@@ -526,13 +571,23 @@ class PagedServingEngine:
         return True, None
 
     def _build_step(self, tok_pad: int, B: int, pallas_mode=False,
-                    ffn_mode=False):
+                    ffn_mode=False, ad_sig: Tuple[int, ...] = (),
+                    spec_mode: bool = False):
         """Trace+compile the fixed-shape mixed prefill+decode executable
-        for the (token-budget, batch-slots, pallas-mode, ffn-mode)
-        signature. `ffn_mode` swaps the per-layer SwiGLU for the fused
-        Pallas kernel; combined with `pallas_mode == "decode"` it also
-        swaps the sampling tail for the one-launch sampler prep — the
-        fused decode tick (~2 launches/layer + 1 sampler)."""
+        for the (token-budget, batch-slots, pallas-mode, ffn-mode,
+        adapter-signature, spec-mode) signature. `ffn_mode` swaps the
+        per-layer SwiGLU for the fused Pallas kernel; combined with
+        `pallas_mode == "decode"` it also swaps the sampling tail for
+        the one-launch sampler prep — the fused decode tick
+        (~2 launches/layer + 1 sampler).
+
+        `ad_sig` is the sorted tuple of active LoRA rank classes
+        (() = adapter-off): per class the step takes the WHOLE stacked
+        slot pack plus a [tok_pad, slots] selector, so which adapter a
+        token routes through is pure data — mixed-adapter batches run
+        segmented/gathered in one executable, and only the SET of rank
+        classes keys a retrace. `spec_mode` additionally returns the
+        all-position argmax — the speculative-decoding verify read."""
         cfg = self.cfg
         top_k = self.top_k
         bs = self.block_size
@@ -543,20 +598,44 @@ class PagedServingEngine:
         def step_fn(params, key_cache, value_cache, kv_scales, tokens,
                     block_tables, cu_seqlens_q, seq_lens_decoder,
                     seq_lens_this_time, rope_emb, temps, top_ps, keys,
-                    greedy):
+                    greedy, ad_args):
             x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
             zeros_b = jnp.zeros((B,), jnp.int32)
+            # per-class token->slot scaling selectors (closed over by the
+            # scan body — they carry no layer axis)
+            ad_sels = tuple(a["sel"] for a in ad_args)
 
             def body(carry, layer):
                 x = carry
                 if quant_kv:
-                    lp, kc, vc, kq, vq, kdq, vdq = layer
+                    lp, kc, vc, kq, vq, kdq, vdq = layer[:7]
+                    ad_layers = layer[7:]
                 else:
-                    (lp, kc, vc), kq, vq, kdq, vdq = layer, *([None] * 4)
+                    lp, kc, vc = layer[:3]
+                    ad_layers = layer[3:]
+                    kq = vq = kdq = vdq = None
+
+                def lora(h, t, y):
+                    # segmented/gathered LoRA: every slot of every active
+                    # rank class applies at once; sel[row, slot] carries
+                    # alpha/rank for the row's adapter and 0 elsewhere,
+                    # so a zero row contributes an EXACT 0.0 delta (base
+                    # rows bit-match the adapter-free math) and the
+                    # slot-reduction has one nonzero term (mixed batches
+                    # bit-match solo runs)
+                    for sel, packs in zip(ad_sels, ad_layers):
+                        A, Bm = packs[t]        # [S,din,c] / [S,c,dout]
+                        u = jnp.einsum("td,sdr->tsr",
+                                       h.astype(jnp.float32), A)
+                        w = jnp.einsum("tsr,sro->tso", u, Bm)
+                        y = y + jnp.einsum("tso,ts->to", w,
+                                           sel).astype(y.dtype)
+                    return y
+
                 h = L.rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-                q = Q.matmul_param(h, lp, "wq")
-                k = Q.matmul_param(h, lp, "wk")
-                v = Q.matmul_param(h, lp, "wv")
+                q = lora(h, "wq", Q.matmul_param(h, lp, "wq"))
+                k = lora(h, "wk", Q.matmul_param(h, lp, "wk"))
+                v = lora(h, "wv", Q.matmul_param(h, lp, "wv"))
                 qkv = jnp.concatenate([q, k, v], axis=-1)
                 o, _, kc, vc = block_multihead_attention_.__wrapped__(
                     qkv, kc, vc, zeros_b, seq_lens_decoder,
@@ -567,7 +646,7 @@ class PagedServingEngine:
                     cache_v_dequant_scales=vdq,
                     use_neox_style=True, block_size=bs,
                     rope_theta=cfg.rope_theta, use_pallas=pallas_mode)
-                x = x + Q.matmul_param(o, lp, "wo")
+                x = x + lora(o, "wo", Q.matmul_param(o, lp, "wo"))
                 h = L.rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
                 if ffn_mode:
                     # one launch: gate+up matmuls, silu·mul, down matmul —
@@ -582,6 +661,8 @@ class PagedServingEngine:
             xs = (params["blocks"], key_cache, value_cache)
             if quant_kv:
                 xs = xs + tuple(kv_scales)   # kq, vq [L,KV]; kdq,vdq [L,nb,KV]
+            # stacked adapter packs ride the layer scan like param leaves
+            xs = xs + tuple(a["packs"] for a in ad_args)
             x, (kcs, vcs) = lax.scan(body, x, xs)
             # last-token hidden state per slot (cu[1:]-1; idle slots gather
             # garbage the host never reads)
@@ -605,19 +686,31 @@ class PagedServingEngine:
                 nxt_sampled = _sample_rows(logits, keys, temps, top_ps,
                                            top_k)
             nxt = jnp.where(greedy, nxt_greedy, nxt_sampled)
+            if spec_mode:
+                # the verify read: greedy argmax at EVERY packed row, so
+                # a k+1-wide speculative chunk's per-position targets
+                # come out of this same single launch
+                hall = L.rms_norm(x, params["final_norm"], cfg.rms_eps)
+                all_logits = Q.matmul_param(hall, params, "lm_head"
+                                            ).astype(jnp.float32)
+                all_arg = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+                return nxt, all_arg, kcs, vcs
             return nxt, kcs, vcs
 
         return step_fn
 
     def _get_step_fn(self, tok_pad: int, B: int, pallas_mode=False,
-                     ffn_mode=False):
-        key = (tok_pad, B, pallas_mode, ffn_mode)
+                     ffn_mode=False, ad_sig: Tuple[int, ...] = (),
+                     spec_mode: bool = False):
+        key = (tok_pad, B, pallas_mode, ffn_mode, ad_sig, spec_mode)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._build_step(tok_pad, B, pallas_mode, ffn_mode)
+            fn = self._build_step(tok_pad, B, pallas_mode, ffn_mode,
+                                  ad_sig, spec_mode)
             self._step_fns[key] = fn
             self.stats["step_builds"] += 1
-            _emit("serving.step_build", tok_pad=tok_pad, batch=B)
+            _emit("serving.step_build", tok_pad=tok_pad, batch=B,
+                  ad_sig=list(ad_sig), spec=bool(spec_mode))
         return fn
 
     def _copy_blocks(self, pairs: List[Tuple[int, int]]):
@@ -668,6 +761,10 @@ class PagedServingEngine:
                 self._kv_scales = (kq, vq, kdq, vdq)
             self.stats["cow_block_copies"] += len(chunk)
             _emit("serving.cow", copies=len(chunk))
+        if self.spec is not None:
+            # mirror COW into the draft caches so draft KV at a copied
+            # page stays valid for the copy's owner
+            self.spec.copy_blocks(pairs)
 
     # -- scheduler tick ---------------------------------------------------
     def step(self) -> List[TokenEvent]:
@@ -704,8 +801,50 @@ class PagedServingEngine:
         ffn_mode, ffn_fb = self._resolve_ffn()
         if ffn_fb is not None:
             _emit("pallas_ffn.fallback", reason=ffn_fb)
+
+        # adapter residency for this tick: every adapter referenced by the
+        # batch gets a device slot (loading/LRU-swapping as needed). The
+        # chaos "adapter" site drills mid-stream eviction here — a forced
+        # evict simply reloads below, counted as a swap.
+        ad_hook = AD._CHAOS_HOOK[0]
+        active: Dict[str, Tuple[int, int]] = {}
+        for seq, _n in batch.items:
+            name = seq.adapter
+            if name is None or name in active:
+                continue
+            if ad_hook is not None and ad_hook("use", name=name) == "evict":
+                self.adapters.evict_device(name, why="chaos")
+            active[name] = self.adapters.ensure_loaded(name)
+        ad_sig = tuple(sorted({cls for cls, _ in active.values()}))
+
+        # speculative plan: widen each greedy decode-ready chunk by k
+        # draft tokens (inside the token budget and the block pool), so
+        # the ONE fused step below verifies the whole proposal
+        spec_plan: Dict[int, List[int]] = {}
+        if self.spec is not None and self.spec_k > 0:
+            budget_left = self.token_budget - batch.total_tokens
+            for i, (seq, n) in enumerate(batch.items):
+                if budget_left < 1:
+                    break
+                if (n != 1 or seq.temperature > 0.0
+                        or seq.num_computed + 1 != len(seq.tokens)):
+                    continue
+                k_eff = min(self.spec_k, budget_left,
+                            seq.max_new_tokens - len(seq.generated) - 1)
+                if k_eff < 1:
+                    continue
+                try:
+                    self.blocks.ensure_capacity(
+                        seq.rid, len(seq.tokens) + k_eff)
+                except NoFreeBlocksError:
+                    continue   # pool exhausted: this tick unspeculated
+                spec_plan[i] = self.spec.propose(seq, k_eff)
+                budget_left -= k_eff
+        spec_mode = bool(spec_plan)
+
         tok_pad, B = self.token_budget, self.max_batch
-        if pallas_mode and all(n == 1 for _, n in batch.items):
+        if (pallas_mode and not spec_plan
+                and all(n == 1 for _, n in batch.items)):
             # decode fast path: every scheduled chunk is one token, so the
             # step packs [max_batch] tokens instead of [token_budget] and
             # the kernel runs its max_q=1 specialized launch — the
@@ -725,6 +864,10 @@ class PagedServingEngine:
         pos = 0
         for i, (seq, n) in enumerate(batch.items):
             chunk = seq.tokens[seq.num_computed:seq.num_computed + n]
+            props = spec_plan.get(i)
+            if props is not None:
+                chunk = list(chunk) + props   # [t_c, d1..dk]: verify rows
+                n = len(chunk)
             tokens[pos:pos + n] = chunk
             pos += n
             cu[i + 1] = pos
@@ -740,21 +883,45 @@ class PagedServingEngine:
                 keys[i] = _key_bits(sub)
         cu[len(batch.items) + 1:] = pos
 
+        # per-class [tok_pad, slots] selectors: each adapter-bound chunk's
+        # rows carry its slot's alpha/rank scaling; everything else is 0.0
+        ad_args: Tuple[Any, ...] = ()
+        if ad_sig:
+            sels = {cls: np.zeros((tok_pad, self.adapters.slots),
+                                  np.float32) for cls in ad_sig}
+            for i, (seq, _n) in enumerate(batch.items):
+                name = seq.adapter
+                if name is None:
+                    continue
+                cls, slot = active[name]
+                sels[cls][cu[i]:cu[i + 1], slot] = \
+                    self.adapters.get(name).scaling
+            ad_args = tuple({"sel": jnp.asarray(sels[cls]),
+                             "packs": self.adapters.device_packs(cls)}
+                            for cls in ad_sig)
+
         # tick classification per request, snapshotted BEFORE the device
         # step mutates generated: a request mid-prompt is in a prefill
         # chunk; one with tokens out is in a decode tick
         was_decode = [bool(s.generated) for s, _ in batch.items]
         builds0 = self.stats["step_builds"]
-        fn = self._get_step_fn(tok_pad, B, pallas_mode, ffn_mode)
+        fn = self._get_step_fn(tok_pad, B, pallas_mode, ffn_mode,
+                               ad_sig, spec_mode)
         fused_tick = bool(ffn_mode) and pallas_mode == "decode"
         launches0 = FA.trace_launches()
         t0 = time.perf_counter()
-        nxt, self._key_cache, self._value_cache = fn(
+        out = fn(
             self.params, self._key_cache, self._value_cache,
             self._kv_scales, jnp.asarray(tokens), jnp.asarray(tables),
             jnp.asarray(cu), jnp.asarray(dec_lens), jnp.asarray(this_lens),
             self._rope_emb, jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(keys), jnp.asarray(greedy))
+            jnp.asarray(keys), jnp.asarray(greedy), ad_args)
+        all_arg = None
+        if spec_mode:
+            nxt, all_arg, self._key_cache, self._value_cache = out
+            all_arg = np.asarray(all_arg)
+        else:
+            nxt, self._key_cache, self._value_cache = out
         nxt = np.asarray(nxt)     # the step's one sync point
         dur = time.perf_counter() - t0
         if fused_tick and self.stats["step_builds"] > builds0:
@@ -768,7 +935,9 @@ class PagedServingEngine:
                                                   - launches0)
         n_prefill = sum(n for s, n in batch.items
                         if s.num_computed + n < len(s.tokens))
-        _emit("serving.step", dur_s=dur, tokens=batch.total_tokens,
+        spec_extra = sum(len(p) for p in spec_plan.values())
+        _emit("serving.step", dur_s=dur,
+              tokens=batch.total_tokens + spec_extra,
               batch=len(batch.items), prefill_tokens=n_prefill)
         if _tracing.trace_enabled():
             # per-request tick attribution: each traced request in the
@@ -800,11 +969,16 @@ class PagedServingEngine:
                   tokens=batch.total_tokens * self.cfg.num_layers,
                   pages=int((tables >= 0).sum()) * self.cfg.num_layers)
         self.stats["steps"] += 1
-        self.stats["tokens_computed"] += batch.total_tokens
+        self.stats["tokens_computed"] += batch.total_tokens + spec_extra
 
         # harvest: a slot yields a token iff its chunk reached the end of
         # the sequence's current tokens (final prefill chunk or decode row)
         for i, (seq, n) in enumerate(batch.items):
+            props = spec_plan.get(i)
+            if props is not None:
+                events.extend(self._harvest_spec(seq, props, int(cu[i]),
+                                                 all_arg))
+                continue
             self.scheduler.on_computed(seq, n)
             if seq.num_computed < len(seq.tokens):
                 continue   # mid-prefill: logits row is not a next token
@@ -833,6 +1007,58 @@ class PagedServingEngine:
         self._update_gauges()
         return events
 
+    def _harvest_spec(self, seq: Sequence, props: List[int], base: int,
+                      all_arg: np.ndarray) -> List[TokenEvent]:
+        """Greedy-verify one widened decode chunk. Row ``base`` held the
+        scheduled token, rows ``base+1..base+k`` the draft proposals;
+        ``all_arg[base+j]`` is the target's own argmax given the chunk
+        through row ``j``. Accept the longest proposal prefix that
+        matches, then emit it plus one bonus token — byte-for-byte the
+        stream plain greedy decode would have produced, just more of it
+        per tick. ``num_computed`` advances only over verified rows, so
+        the ``num_computed == len(tokens)-1`` decode invariant (and with
+        it preemption recompute and prefix caching) is preserved."""
+        k = len(props)
+        g = [int(all_arg[base + j]) for j in range(k + 1)]
+        a = 0
+        while a < k and props[a] == g[a]:
+            a += 1
+        emitted = props[:a] + [g[a]]
+        self.spec.commit(seq, a)
+        self.spec.record_tick(k, a)
+        self.stats["spec_ticks"] += 1
+        self.stats["spec_proposed"] += k
+        self.stats["spec_accepted"] += a
+        _emit("spec.tick", rid=seq.rid, proposed=k, accepted=a,
+              emitted=len(emitted))
+        events: List[TokenEvent] = []
+        for tok in emitted:
+            self.scheduler.on_computed(seq, 1)
+            now = time.monotonic()
+            first = seq.first_token_at is None
+            if seq.eos >= 0 and tok == seq.eos:
+                self.scheduler.append_token(seq, tok)  # timestamps
+                seq.generated.pop()                    # eos not surfaced
+                seq.tokens.pop()
+                events.append(self._finish_event(seq, "stop"))
+                return events
+            self.scheduler.append_token(seq, tok)
+            _emit("serving.token", rid=seq.rid, first=first,
+                  ttft_s=(now - seq.arrival) if first else None,
+                  tpot_s=None if first else now - seq._prev_token_at)
+            seq._prev_token_at = now
+            if len(seq.generated) >= seq.max_new_tokens:
+                ev = TokenEvent(seq.rid, tok, True, "length")
+                self._record_completion(seq, "length")
+                self.scheduler.finish(seq, "length")
+                events.append(ev)
+                self._events_by_rid[seq.rid].append(ev)
+                return events
+            ev = TokenEvent(seq.rid, tok, False)
+            events.append(ev)
+            self._events_by_rid[seq.rid].append(ev)
+        return events
+
     # -- bookkeeping ------------------------------------------------------
     def _finish_event(self, seq: Sequence, reason: str,
                       already_finished: bool = False) -> TokenEvent:
@@ -844,6 +1070,11 @@ class PagedServingEngine:
         return ev
 
     def _record_completion(self, seq: Sequence, reason: str):
+        if getattr(seq, "_adapter_pinned", False):
+            seq._adapter_pinned = False   # before unpin: re-entrancy safe
+            self.adapters.unpin(seq.adapter)
+        if self.spec is not None:
+            self.spec.forget(seq.rid)
         self._completions.append(Completion(seq.rid, list(seq.prompt),
                                             list(seq.generated), reason))
         _emit("serving.complete", rid=seq.rid, reason=reason,
@@ -860,8 +1091,15 @@ class PagedServingEngine:
     @property
     def engine_stats(self) -> dict:
         """One merged host-side view (engine + scheduler + block pool)."""
-        return {**self.stats, **self.scheduler.stats,
-                "kv_utilization": round(self.blocks.utilization(), 4),
-                "kv_page_bytes": self.kv_page_bytes,
-                "kv_bytes_in_use": self.blocks.bytes_in_use(),
-                **{f"blocks_{k}": v for k, v in self.blocks.stats.items()}}
+        out = {**self.stats, **self.scheduler.stats,
+               "kv_utilization": round(self.blocks.utilization(), 4),
+               "kv_page_bytes": self.kv_page_bytes,
+               "kv_bytes_in_use": self.blocks.bytes_in_use(),
+               **{f"blocks_{k}": v for k, v in self.blocks.stats.items()},
+               "adapters_resident": self.adapters.num_resident(),
+               "adapter_bytes_in_use": self.adapters.bytes_in_use(),
+               "adapter_swaps": self.adapters.stats["swaps"],
+               "adapter_evictions": self.adapters.stats["evictions"]}
+        if self.spec is not None:
+            out["spec_acceptance_rate"] = self.spec.acceptance_rate
+        return out

@@ -279,4 +279,12 @@ class ReplicaHandle:
             out["queue_depth"] = self.engine.scheduler.queue_depth()
             out["running"] = self.engine.scheduler.num_running()
             out["step_builds"] = self.engine.stats["step_builds"]
+            mgr = self.engine.adapters
+            out["adapters_resident"] = sorted(mgr.snapshot()["resident"])
+            out["adapter_bytes_in_use"] = mgr.bytes_in_use()
+            out["adapter_swaps"] = mgr.stats["swaps"]
+            out["adapter_hits"] = mgr.stats["hits"]
+            if self.engine.spec is not None:
+                out["spec_acceptance_rate"] = \
+                    self.engine.spec.acceptance_rate
         return out

@@ -46,6 +46,7 @@ from ...core import flags
 from ...observability import emit as _emit
 from ...observability import register_distress_section
 from ...observability import tracing as _tracing
+from .adapters import AdapterMissingError
 from .engine import PagedServingEngine, TokenEvent
 from .replica import (DEAD, DEGRADED, DRAINED, DRAINING, HEALTHY,
                       ReplicaHandle, ReplicaKilledError)
@@ -102,6 +103,7 @@ class RouterRequest:
     temperature: Optional[float] = None
     top_p: Optional[float] = None
     seed: int = 0
+    adapter: Optional[str] = None       # LoRA adapter the stream decodes through
     # live state
     emitted: List[int] = field(default_factory=list)  # client-visible
     events: List[TokenEvent] = field(default_factory=list)
@@ -155,7 +157,8 @@ class ServingRouter:
                  probation_s: Optional[float] = None,
                  tenant_max_queue: Optional[int] = None,
                  tenant_weights: Optional[Dict[str, int]] = None,
-                 max_failovers: Optional[int] = None):
+                 max_failovers: Optional[int] = None,
+                 adapter_transport=None):
         n = int(_flag_or(num_replicas, "router_num_replicas"))
         if n < 1:
             raise ValueError("num_replicas must be >= 1")
@@ -185,9 +188,13 @@ class ServingRouter:
         self._wrr_pos = 0
         self._next_rid = 0
         self._completions: List[Completion] = []
+        # store-backed AdapterTransport: replicas missing a requested
+        # adapter prefetch its wire pack instead of shedding the stream
+        self.adapter_transport = adapter_transport
         self.stats = {"admitted": 0, "shed": 0, "assigned": 0,
                       "failovers": 0, "failover_exhausted": 0,
-                      "migrations": 0, "drains": 0, "mismatches": 0}
+                      "migrations": 0, "drains": 0, "mismatches": 0,
+                      "adapter_routed": 0, "adapter_prefetches": 0}
         # fleet state lands in every distress dump (latest router wins)
         register_distress_section("router", self.snapshot)
 
@@ -196,7 +203,8 @@ class ServingRouter:
                eos_token_id: Optional[int] = None, tenant: str = "default",
                priority: int = 0, deadline_s: Optional[float] = None,
                temperature: Optional[float] = None,
-               top_p: Optional[float] = None, seed: int = 0) -> int:
+               top_p: Optional[float] = None, seed: int = 0,
+               adapter: Optional[str] = None) -> int:
         """Enqueue a stream. Raises RejectedError when `tenant`'s router
         queue is at its cap (other tenants are unaffected), ValueError
         when the request can never fit a replica."""
@@ -229,7 +237,8 @@ class ServingRouter:
             priority=int(priority),
             deadline=(time.monotonic() + float(deadline_s)
                       if deadline_s is not None else None),
-            temperature=temperature, top_p=top_p, seed=int(seed))
+            temperature=temperature, top_p=top_p, seed=int(seed),
+            adapter=adapter)
         root = _tracing.new_trace("request", rid=rid, tenant=tenant,
                                   prompt_len=len(prompt))
         if root is not None:
@@ -295,7 +304,8 @@ class ServingRouter:
                         raise DeadlineExceededError(
                             f"request {rid} expired mid-stream after "
                             f"{len(req.emitted)} tokens")
-                    if ev.reason in ("shed", "failover_exhausted"):
+                    if ev.reason in ("shed", "failover_exhausted",
+                                     "adapter_missing"):
                         raise RejectedError(
                             f"request {rid} shed mid-stream "
                             f"(reason={ev.reason})")
@@ -391,10 +401,36 @@ class ServingRouter:
         """Runs just before `req` is submitted to `h` (subclass hook:
         the disagg router pulls migrated pages here)."""
 
+    def _adapter_signal(self, req: RouterRequest, h: ReplicaHandle) -> int:
+        """Adapter-affinity score: 2 = device-resident (zero-cost hit),
+        1 = host-registered (a slot write away), 0 = absent (needs a
+        transport prefetch or the stream can't run there)."""
+        if req.adapter is None:
+            return 0
+        mgr = h.engine.adapters
+        if not mgr.registered(req.adapter):
+            return 0
+        try:
+            mgr.slot_of(req.adapter)
+            return 2
+        except AdapterMissingError:
+            return 1
+
+    def publish_adapter(self, adapter) -> None:
+        """Register a LoRA adapter on every live replica and (when a
+        transport is wired) publish its wire pack so future/probation
+        replicas can prefetch it."""
+        for h in self.replicas:
+            if h.engine is not None:
+                h.engine.adapters.register(adapter)
+        if self.adapter_transport is not None:
+            self.adapter_transport.publish(adapter)
+
     def _place(self, req: RouterRequest) -> bool:
-        """Prefix-affinity placement with least-loaded fallback; False
-        when no accepting replica has room right now (the request stays
-        queued — engine-level backpressure, not a shed)."""
+        """Prefix- and adapter-affinity placement with least-loaded
+        fallback; False when no accepting replica has room right now
+        (the request stays queued — engine-level backpressure, not a
+        shed)."""
         cands = self._placement_candidates(req)
         if not cands:
             return False
@@ -402,28 +438,48 @@ class ServingRouter:
         # On a mixed int8/fp fleet, equal outstanding work can hide very
         # different device pressure (an int8-cache replica's pages are
         # 2-4x cheaper than an fp replica's), so actual KV bytes break
-        # the tie. Homogeneous fleets keep the pure depth ordering —
-        # bytes would add no information, only placement churn.
-        mixed = len({h.engine.kv_page_bytes for h in cands}) > 1
+        # the tie. Adapter residency skews bytes the same way (a replica
+        # stuffed with slot packs pays real HBM), so an uneven adapter
+        # footprint also arms the byte tiebreak — bytes_in_use() already
+        # folds adapter bytes in via the block manager's extra-bytes
+        # callback. Homogeneous fleets keep the pure depth ordering.
+        mixed = (len({h.engine.kv_page_bytes for h in cands}) > 1
+                 or len({h.engine.adapters.bytes_in_use()
+                         for h in cands}) > 1)
 
         def load(h):
             return (h.engine.scheduler.queue_depth()
                     + h.engine.scheduler.num_running(),
                     h.engine.blocks.bytes_in_use() if mixed else 0)
 
-        scored = [(self._prefix_signal(req, h), h) for h in cands]
-        best_prefix = max(s for s, _ in scored)
+        scored = [(self._prefix_signal(req, h),
+                   self._adapter_signal(req, h), h) for h in cands]
+        best_prefix = max(s for s, _, _ in scored)
         if best_prefix > 0:
+            # prefix affinity stays the primary signal (paid-for KV beats
+            # a cheap slot write); adapter residency breaks prefix ties
             order = sorted(scored,
-                           key=lambda sh: (-sh[0], load(sh[1]),
-                                           sh[1].replica_id))
+                           key=lambda sh: (-sh[0], -sh[1], load(sh[2]),
+                                           sh[2].replica_id))
+        elif req.adapter is not None and any(a for _, a, _ in scored):
+            order = sorted(scored,
+                           key=lambda sh: (-sh[1], load(sh[2]),
+                                           sh[2].replica_id))
         else:
             order = sorted(scored,
-                           key=lambda sh: (load(sh[1]), sh[1].replica_id))
-        for prefix, h in order:
+                           key=lambda sh: (load(sh[2]), sh[2].replica_id))
+        adapter_missing = 0
+        for prefix, ad_sig, h in order:
             deadline_s = None
             if req.deadline is not None:
                 deadline_s = req.deadline - time.monotonic()
+            if (req.adapter is not None and ad_sig == 0
+                    and self.adapter_transport is not None):
+                # least-loaded fallback landed on a replica without the
+                # adapter: pull the wire pack over the store transport
+                if h.engine.adapters.prefetch(
+                        req.adapter, self.adapter_transport) == "ok":
+                    self.stats["adapter_prefetches"] += 1
             self._prepare_submit(req, h)
             try:
                 engine_rid = h.engine.submit(
@@ -431,11 +487,14 @@ class ServingRouter:
                     eos_token_id=None if req.eos < 0 else req.eos,
                     priority=req.priority, deadline_s=deadline_s,
                     temperature=req.temperature, top_p=req.top_p,
-                    seed=req.seed,
+                    seed=req.seed, adapter=req.adapter,
                     trace=((req.trace_id, req.root_span)
                            if req.trace_id else None))
             except RejectedError:
                 continue   # this replica's queue is full; try the next
+            except AdapterMissingError:
+                adapter_missing += 1
+                continue   # not registered here and no transport copy
             req.replica = h.replica_id
             req.engine_rid = engine_rid
             req.status = "assigned"
@@ -443,9 +502,21 @@ class ServingRouter:
             h.beat()   # accepting work refreshes the lease: the age
             #            clock starts from placement, not construction
             self.stats["assigned"] += 1
+            if req.adapter is not None:
+                self.stats["adapter_routed"] += 1
             _emit("router.assign", tenant=req.tenant, rid=req.rid,
                   replica=h.replica_id, prefix_hit=prefix,
-                  replay=req.confirm_target)
+                  adapter_hit=ad_sig, replay=req.confirm_target)
+            return True
+        if adapter_missing == len(order):
+            # every eligible replica refused for the same terminal
+            # reason: the adapter isn't registered anywhere and the
+            # transport has no copy. Queue-full is transient, this is
+            # not — leaving it pending would livelock run().
+            self.stats["shed"] += 1
+            _emit("router.shed", tenant=req.tenant,
+                  reason="adapter_missing", adapter=req.adapter)
+            self._finish(req, "adapter_missing")
             return True
         return False
 

@@ -71,6 +71,9 @@ class Sequence:
     temperature: float = 0.0            # 0 = greedy
     top_p: float = 1.0
     seed: int = 0
+    # LoRA adapter this request decodes through (None = base model);
+    # pinned in the AdapterManager while the sequence is live
+    adapter: Optional[str] = None
     # mutable state
     tokens: List[int] = field(default_factory=list)  # prompt + generated
     generated: List[int] = field(default_factory=list)

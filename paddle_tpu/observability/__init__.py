@@ -329,6 +329,12 @@ _c_tune_profile = _C("paddle_tuner_profile_loads_total",
                      "Tuned-profile load attempts, by result (ok/applied/"
                      "crc_mismatch/bad_version/bad_format/parse_error/"
                      "topology_mismatch)")
+_c_tune_predicts = _C("paddle_tuner_predictions_total",
+                      "Cost-model candidate predictions issued")
+_c_tune_runs = _C("paddle_tuner_runs_total",
+                  "End-to-end tune() searches completed")
+_h_tune_run = _H("paddle_tuner_run_seconds",
+                 "Wall time of one end-to-end tune() search")
 _c_pp_sends = _C("paddle_pp_sends_total",
                  "Pipeline stage handoffs issued (activation/grad), by kind")
 _h_pp_send = _H("paddle_pp_send_seconds",
@@ -410,6 +416,52 @@ _g_pp_strag = _G("paddle_pp_straggler_stage",
 _g_pp_strag_x = _G("paddle_pp_straggler_excess",
                    "Straggler group's busy-time excess over the mean "
                    "((max - mean) / mean) in the last run")
+_c_ad_reg = _C("paddle_adapter_registered_total",
+               "LoRA adapters registered with an AdapterManager")
+_c_ad_loads = _C("paddle_adapter_loads_total",
+                 "Adapter device loads (host pack -> stacked slot pack)")
+_c_ad_swaps = _C("paddle_adapter_swaps_total",
+                 "Adapter device RE-loads (hot-swap churn: the adapter "
+                 "had been resident before and is loading again)")
+_c_ad_evict = _C("paddle_adapter_evictions_total",
+                 "Adapter device evictions, by reason (lru/manual/"
+                 "replace/chaos)")
+_c_ad_hits = _C("paddle_adapter_hits_total",
+                "Adapter uses served by an already-resident slot")
+_c_ad_manifest = _C("paddle_adapter_manifest_loads_total",
+                    "Adapter manifest load attempts, by result (ok/"
+                    "crc_mismatch/bad_version/bad_format/parse_error/"
+                    "signature_mismatch)")
+_c_ad_prefetch = _C("paddle_adapter_prefetches_total",
+                    "Adapter store-transport prefetches, by result "
+                    "(ok/registered/miss/corrupt)")
+_g_ad_resident = _G("paddle_adapter_resident",
+                    "Adapters currently holding a device slot")
+_g_ad_bytes = _G("paddle_adapter_bytes_in_use",
+                 "Device bytes behind occupied adapter slots (also folded "
+                 "into paddle_serving_kv_bytes_in_use via the block "
+                 "manager's extra-bytes callback)")
+_g_ad_bytes_total = _G("paddle_adapter_bytes_total",
+                       "Device bytes of all allocated adapter slot packs")
+_g_ad_res_by = _G("paddle_adapter_device_resident",
+                  "1 while the labeled adapter holds a device slot on "
+                  "this process, 0 after eviction (fleet_summary counts "
+                  "rank-labeled 1s into per-adapter residency)")
+_c_spec_ticks = _C("paddle_spec_ticks_total",
+                   "Speculative verify ticks (one widened decode chunk)")
+_c_spec_prop = _C("paddle_spec_proposed_total",
+                  "Draft tokens proposed for verification")
+_c_spec_acc = _C("paddle_spec_accepted_total",
+                 "Draft tokens accepted by greedy verification")
+_c_spec_bonus = _C("paddle_spec_bonus_total",
+                   "Bonus tokens emitted by verify ticks (one per tick — "
+                   "the tick's output even at zero acceptance)")
+_c_spec_draft = _C("paddle_spec_draft_steps_total",
+                   "Draft-model device steps (catch-up chunks + 1-token "
+                   "proposal steps)")
+_g_spec_rate = _G("paddle_spec_acceptance_rate",
+                  "accepted/proposed over the process lifetime (the "
+                  "speculation speedup signal: tokens/tick ~ 1 + rate*k)")
 
 
 # hit-path fast handler: one dict op, no Counter.inc/_label_key calls.
@@ -581,6 +633,35 @@ def _h_tuner_validate(dur_s, f):
     _g_tune_gap.set(f.get("gap_ratio", 0.0))
 
 
+def _h_ad_load(dur_s, f):
+    name = f.get("adapter", "")
+    _c_ad_loads.inc(labels={"adapter": name})
+    _g_ad_res_by.set(1, labels={"adapter": name})
+    if f.get("swap"):
+        _c_ad_swaps.inc(labels={"adapter": name})
+
+
+def _h_ad_evict(dur_s, f):
+    _c_ad_evict.inc(labels={"reason": f.get("reason", "lru")})
+    _g_ad_res_by.set(0, labels={"adapter": f.get("adapter", "")})
+
+
+def _h_ad_gauges(dur_s, f):
+    _g_ad_resident.set(f.get("resident", 0))
+    _g_ad_bytes.set(f.get("bytes_in_use", 0))
+    _g_ad_bytes_total.set(f.get("bytes_total", 0))
+
+
+def _h_spec_tick(dur_s, f):
+    _c_spec_ticks.inc()
+    _c_spec_prop.inc(f.get("proposed", 0))
+    _c_spec_acc.inc(f.get("accepted", 0))
+    _c_spec_bonus.inc()
+    prop = _c_spec_prop.value()
+    if prop:
+        _g_spec_rate.set(round(_c_spec_acc.value() / prop, 4))
+
+
 _HANDLERS = {
     "dispatch.hit": _h_dispatch_hit,
     "dispatch.miss": _h_dispatch_miss,
@@ -664,6 +745,10 @@ _HANDLERS = {
     "tuner.candidates": lambda d, f: _c_tune_cand.inc(
         f.get("n", 1), labels={"outcome": f.get("outcome", "enumerated")}),
     "tuner.validate": _h_tuner_validate,
+    "tuner.predict": lambda d, f: _c_tune_predicts.inc(),
+    "tuner.tune": lambda d, f: (_c_tune_runs.inc(),
+                                _h_tune_run.observe(f.get("dur_s", d)
+                                                    or 0.0)),
     "tuner.profile_load": lambda d, f: _c_tune_profile.inc(
         labels={"result": f.get("result", "")}),
     "async.p2p": lambda d, f: _c_p2p.inc(),
@@ -737,6 +822,18 @@ _HANDLERS = {
     "fleet.merge": lambda d, f: (_c_fl_merge.inc(),
                                  _g_fl_ranks.set(f.get("ranks", 0))),
     "fleet.slo": _h_fleet_slo,
+    "adapter.register": lambda d, f: _c_ad_reg.inc(),
+    "adapter.load": _h_ad_load,
+    "adapter.use": lambda d, f: _c_ad_hits.inc(
+        labels={"adapter": f.get("adapter", "")}),
+    "adapter.evict": _h_ad_evict,
+    "adapter.manifest_load": lambda d, f: _c_ad_manifest.inc(
+        labels={"result": f.get("result", "")}),
+    "adapter.prefetch": lambda d, f: _c_ad_prefetch.inc(
+        labels={"result": f.get("result", "")}),
+    "adapter.gauges": _h_ad_gauges,
+    "spec.tick": _h_spec_tick,
+    "spec.draft_step": lambda d, f: _c_spec_draft.inc(),
 }
 
 
@@ -921,6 +1018,30 @@ def summary() -> dict:
             "autoscaler_shrinks": int(_c_as_decisions.value(
                 {"direction": "shrink"})),
             "decode_pool": int(_g_as_pool.value()),
+        },
+        "adapters": {
+            "registered": int(_c_ad_reg.value()),
+            "loads": int(_c_ad_loads.value()),
+            "swaps": int(_c_ad_swaps.value()),
+            "evictions": int(_c_ad_evict.value()),
+            "hits": int(_c_ad_hits.value()),
+            "resident": int(_g_ad_resident.value()),
+            "bytes_in_use": int(_g_ad_bytes.value()),
+            "bytes_total": int(_g_ad_bytes_total.value()),
+            "manifest_loads_ok": int(_c_ad_manifest.value(
+                {"result": "ok"})),
+            "prefetches_ok": int(_c_ad_prefetch.value({"result": "ok"})),
+            "prefetch_misses": int(_c_ad_prefetch.value(
+                {"result": "miss"}) + _c_ad_prefetch.value(
+                {"result": "corrupt"})),
+        },
+        "spec": {
+            "ticks": int(_c_spec_ticks.value()),
+            "proposed": int(_c_spec_prop.value()),
+            "accepted": int(_c_spec_acc.value()),
+            "bonus": int(_c_spec_bonus.value()),
+            "draft_steps": int(_c_spec_draft.value()),
+            "acceptance_rate": round(float(_g_spec_rate.value()), 4),
         },
         "tuner": {
             "candidates_enumerated": int(_c_tune_cand.value(

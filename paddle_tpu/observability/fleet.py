@@ -199,6 +199,47 @@ def _pct(h: Optional[Histogram], q: float) -> float:
     return h.percentile(q) if h is not None else 0.0
 
 
+def _by_adapter(counters: dict, name: str) -> Dict[str, float]:
+    """Fold a merged adapter-labeled counter into {adapter: total}."""
+    c = counters.get(name)
+    out: Dict[str, float] = {}
+    if c is not None:
+        for key, v in c._values.items():
+            ad = dict(key).get("adapter")
+            if ad:
+                out[ad] = out.get(ad, 0) + v
+    return out
+
+
+def _adapter_digest(counters: dict, gauges: dict) -> dict:
+    """Per-adapter fleet view: merged hit/load/swap totals plus how many
+    ranks currently hold the adapter in a device slot (the rank-labeled
+    paddle_adapter_device_resident flags)."""
+    hits = _by_adapter(counters, "paddle_adapter_hits_total")
+    loads = _by_adapter(counters, "paddle_adapter_loads_total")
+    swaps = _by_adapter(counters, "paddle_adapter_swaps_total")
+    resident: Dict[str, int] = {}
+    g = gauges.get("paddle_adapter_device_resident")
+    if g is not None:
+        for key, v in g._values.items():
+            ad = dict(key).get("adapter")
+            if ad and v:
+                resident[ad] = resident.get(ad, 0) + 1
+    return {n: {"hits": int(hits.get(n, 0)),
+                "loads": int(loads.get(n, 0)),
+                "swaps": int(swaps.get(n, 0)),
+                "resident_ranks": int(resident.get(n, 0))}
+            for n in sorted(set(hits) | set(loads) | set(swaps)
+                            | set(resident))}
+
+
+def _spec_rate(counters: dict) -> float:
+    prop = counters.get("paddle_spec_proposed_total")
+    acc = counters.get("paddle_spec_accepted_total")
+    p = float(prop.value()) if prop is not None else 0.0
+    return round(float(acc.value()) / p, 4) if p and acc is not None else 0.0
+
+
 def fleet_summary(store=None, ranks=None, states=None) -> dict:
     """Fleet-global SLO digest: merged TTFT/TPOT p50/p99, shed rate and
     the merged counter totals the autoscaler needs.
@@ -257,6 +298,8 @@ def fleet_summary(store=None, ranks=None, states=None) -> dict:
         "deadline_rate": round(deadline_expired / seen, 6)
                          if seen else 0.0,
         "failovers": int(csum("paddle_router_failovers_total")),
+        "adapters": _adapter_digest(counters, merged["gauges"]),
+        "spec_acceptance_rate": _spec_rate(counters),
         "counters": {name: {_label_str(k) or "": v
                             for k, v in c._values.items()}
                      for name, c in sorted(counters.items())},

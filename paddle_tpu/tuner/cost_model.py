@@ -294,13 +294,46 @@ class CostModel:
         ffn_s = L * ffn_e * (c.token_budget / max(1, w.ffn_rows))
         host_s = max(0.0, anchor - L * attn_e - L * ffn_e
                      * (w.tick_budget / max(1, w.ffn_rows)))
-        tick_s = host_s + attn_s + ffn_s
-        tok_s = c.max_batch / max(tick_s, 1e-12)
-        return {"cost": tick_s / max(1, c.max_batch),
-                "tick_s": tick_s, "tokens_per_s": tok_s,
+        # multi-tenant LoRA: the segmented apply is an S-slot-wide
+        # gathered einsum riding the FFN-shaped row walk — compute grows
+        # linearly in device slots (the pack is dense over slots, active
+        # or not), while the LRU miss probability under uniform tenant
+        # traffic falls as slots approach the tenant count, each miss
+        # paying a measured host-side swap. Both extras default to 0, so
+        # a workload that doesn't serve adapters prices every slot count
+        # identically.
+        slots = max(1, int(getattr(c, "adapter_slots", 1)))
+        ad_ratio = float(w.extra.get("adapter_flop_ratio", 0.0))
+        adapter_s = ffn_s * ad_ratio * slots
+        tenants = int(w.extra.get("adapter_tenants", 0))
+        swap_s = 0.0
+        if tenants > slots:
+            swap_s = (float(w.extra.get("adapter_swap_s", 0.0))
+                      * (1.0 - slots / tenants))
+        tick_s = host_s + attn_s + ffn_s + adapter_s + swap_s
+        # speculative decoding: k draft steps (each draft_cost_ratio of a
+        # target tick) buy 1 + acceptance*k emitted tokens per verify
+        # tick. With no draft priced (draft_cost_ratio absent/0) the term
+        # vanishes and spec_k is cost-neutral — the engine without a
+        # draft attached never runs spec ticks.
+        k = max(0, int(getattr(c, "spec_k", 0)))
+        draft_ratio = float(w.extra.get("draft_cost_ratio", 0.0))
+        spec_s = 0.0
+        tokens_per_tick = 1.0
+        if k and draft_ratio > 0.0:
+            spec_s = tick_s * k * draft_ratio
+            tokens_per_tick = 1.0 + float(
+                w.extra.get("spec_acceptance", 0.0)) * k
+        tick_total = tick_s + spec_s
+        tok_s = c.max_batch * tokens_per_tick / max(tick_total, 1e-12)
+        return {"cost": tick_total / (max(1, c.max_batch)
+                                      * tokens_per_tick),
+                "tick_s": tick_total, "tokens_per_s": tok_s,
                 "anchor": anchor_name,
                 "terms": {"host_s": host_s, "attn_s": attn_s,
-                          "ffn_s": ffn_s}}
+                          "ffn_s": ffn_s, "adapter_s": adapter_s,
+                          "swap_s": swap_s, "spec_s": spec_s,
+                          "tokens_per_tick": tokens_per_tick}}
 
     def predict(self, w: Workload, c) -> dict:
         """Predicted cost dict for one candidate. ``cost`` is the

@@ -44,6 +44,9 @@ class Candidate:
     # serving step geometry
     token_budget: int = 64           # FLAGS_serving_token_budget
     max_batch: int = 8               # FLAGS_serving_max_batch
+    # multi-tenant serving: speculative depth + adapter device slots
+    spec_k: int = 4                  # FLAGS_spec_k (draft tokens/tick)
+    adapter_slots: int = 4           # FLAGS_adapter_slots (per rank class)
 
     def to_flags(self) -> Dict[str, object]:
         """The FLAGS_* assignment this candidate means (bucket sizes are
@@ -60,6 +63,8 @@ class Candidate:
             "pallas_ffn": bool(self.pallas_ffn),
             "serving_token_budget": int(self.token_budget),
             "serving_max_batch": int(self.max_batch),
+            "spec_k": int(self.spec_k),
+            "adapter_slots": int(self.adapter_slots),
         }
 
     @classmethod
@@ -74,7 +79,9 @@ class Candidate:
              "serving_pallas_attention": "pallas_attention",
              "pallas_ffn": "pallas_ffn",
              "serving_token_budget": "token_budget",
-             "serving_max_batch": "max_batch"}
+             "serving_max_batch": "max_batch",
+             "spec_k": "spec_k",
+             "adapter_slots": "adapter_slots"}
         kw = {m[k]: v for k, v in fl.items() if k in m}
         return replace(c, **kw) if kw else c
 

@@ -132,6 +132,62 @@ class TestCostModel:
         assert fused["anchor"] == "decode_tick_fused"
         assert m.predict(w, Candidate())["anchor"] == "decode_tick_stock"
 
+    def test_spec_k_term_rides_acceptance_and_draft_cost(self):
+        """Speculation pays k draft steps (draft_cost_ratio of a tick
+        each) to emit 1+acceptance*k tokens per verify tick: a cheap,
+        accurate draft makes spec_k>0 win; an expensive or wild draft
+        makes it lose. Without a priced draft the term vanishes —
+        spec_k is cost-neutral on a draftless workload."""
+        m = _model()
+        good = Workload("s", kind="serving",
+                        extra={"draft_cost_ratio": 0.05,
+                               "spec_acceptance": 0.8})
+        bad = Workload("s", kind="serving",
+                       extra={"draft_cost_ratio": 0.9,
+                              "spec_acceptance": 0.05})
+        off, on = Candidate(spec_k=0), Candidate(spec_k=4)
+        assert m.predict(good, on)["cost"] < m.predict(good, off)["cost"]
+        assert m.predict(bad, on)["cost"] > m.predict(bad, off)["cost"]
+        draftless = Workload("s", kind="serving")
+        assert (m.predict(draftless, on)["cost"]
+                == m.predict(draftless, off)["cost"])
+        assert m.predict(good, on)["terms"]["spec_s"] > 0.0
+        assert m.predict(good, off)["terms"]["spec_s"] == 0.0
+
+    def test_adapter_slots_trade_gather_compute_for_swap_misses(self):
+        """The S-slot gathered einsum prices compute linearly in slots;
+        the LRU miss term falls as slots approach the tenant count.
+        With swaps free, fewer slots win; with swaps expensive, more
+        slots win — the trade the axis exists to explore."""
+        m = _model()
+        cheap_swaps = Workload("s", kind="serving",
+                               extra={"adapter_flop_ratio": 0.1,
+                                      "adapter_tenants": 8,
+                                      "adapter_swap_s": 0.0})
+        dear_swaps = Workload("s", kind="serving",
+                              extra={"adapter_flop_ratio": 0.1,
+                                     "adapter_tenants": 8,
+                                     "adapter_swap_s": 1.0})
+        one, eight = Candidate(adapter_slots=1), Candidate(adapter_slots=8)
+        assert (m.predict(cheap_swaps, one)["cost"]
+                < m.predict(cheap_swaps, eight)["cost"])
+        assert (m.predict(dear_swaps, eight)["cost"]
+                < m.predict(dear_swaps, one)["cost"])
+        # adapter-free workload: every slot count prices identically
+        plain = Workload("s", kind="serving")
+        assert (m.predict(plain, one)["cost"]
+                == m.predict(plain, eight)["cost"])
+
+    def test_spec_adapter_knobs_round_trip_flags(self):
+        """spec_k/adapter_slots ride to_flags()/from_flags() like every
+        other axis, under the exact FLAGS_* names the engine reads."""
+        c = Candidate(spec_k=2, adapter_slots=8, max_batch=16)
+        fl = c.to_flags()
+        assert fl["spec_k"] == 2 and fl["adapter_slots"] == 8
+        assert Candidate.from_flags(fl) == c
+        assert flags.flag_value("spec_k") is not None
+        assert flags.flag_value("adapter_slots") is not None
+
     def test_missing_tick_anchor_fails_loud(self):
         m = CostModel(costs=_toy_costs(ffn_fwd_stock=1e-6),
                       link_bytes_per_s=1e9)

@@ -686,6 +686,53 @@ def maybe_tune_smoke(min_interval: float = 3600.0) -> None:
         f"(tools/tune_smoke.py)")
 
 
+_last_spec_smoke = [0.0]
+
+
+def maybe_spec_smoke(min_interval: float = 3600.0) -> None:
+    """Run the spec/adapter smoke (tools/spec_smoke.py) at most once per
+    min_interval and log a RED line on regression — a draft model whose
+    acceptance failures leak into greedy output (parity break, incl.
+    after a forced preemption or a mid-spec replica kill), an adapter
+    hot-swap that retraces the steady-state step, or a chaos device
+    evict the stream notices are build-signal the same way the perf
+    floor is. tokens/s spec-vs-plain is reported, not gated (CPU hosts
+    pay per-launch overhead the TPU doesn't)."""
+    now = time.monotonic()
+    if _last_spec_smoke[0] and now - _last_spec_smoke[0] < min_interval:
+        return
+    _last_spec_smoke[0] = now
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "spec_smoke.py")],
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        log("RED: spec smoke hung >600s — speculative decoding broken")
+        return
+    payload = {}
+    for line in (out.stdout or "").strip().splitlines()[::-1]:
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if out.returncode == 0 and payload.get("ok"):
+        log(f"spec smoke GREEN ({payload.get('wall_s')}s: "
+            f"acceptance={payload.get('acceptance_rate')}, "
+            f"{payload.get('preemptions')} preemption, "
+            f"{payload.get('failovers')} failover, "
+            f"{payload.get('adapter_swaps_on_evict')} evict-reload, "
+            f"ratio={payload.get('tokens_per_s_ratio_spec_vs_plain')})")
+        return
+    failed = [k for k, v in (payload.get("checks") or {}).items() if not v]
+    detail = (", ".join(failed) if failed
+              else payload.get("error") or (out.stderr or "").strip()[-200:])
+    log(f"RED: spec smoke regression rc={out.returncode} — {detail} "
+        f"(tools/spec_smoke.py)")
+
+
 def try_capture(capture_timeout: float) -> bool:
     """Returns True when a chip-stamped artifact was captured+committed.
     Holds the advisory chip lock for the whole capture INCLUDING the
@@ -806,6 +853,7 @@ def main() -> None:
         maybe_elastic_pp_smoke()
         maybe_disagg_smoke()
         maybe_tune_smoke()
+        maybe_spec_smoke()
         sys.exit(0 if try_capture(args.capture_timeout) else 1)
     # --watch (default)
     log(f"watch loop: probe every {args.interval:.0f}s, "
@@ -824,6 +872,7 @@ def main() -> None:
             maybe_elastic_pp_smoke()
             maybe_disagg_smoke()
             maybe_tune_smoke()
+            maybe_spec_smoke()
             ok = try_capture(args.capture_timeout)
         except Exception as e:  # noqa: BLE001 — the watcher must outlive any
             # single failure (git timeout, full disk); log and keep probing
